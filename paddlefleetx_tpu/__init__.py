@@ -1,0 +1,21 @@
+"""PaddleFleetX-TPU: a TPU-native large-model training framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of PaddleFleetX
+(reference: /root/reference): end-to-end big-model pretraining, finetuning,
+evaluation, generation and deployment for language / vision / multimodal
+models with hybrid parallelism (DP / TP / SP / PP / FSDP-ZeRO / MoE-EP).
+
+Reference layer map (see SURVEY.md §1): tools -> core engine -> models/optims/
+data -> distributed -> utils.  Here the same capability stack is realised as:
+
+    tools/              CLI entry points (train / eval / export / generate)
+    core/               Engine + Module protocol (train/eval loops, ckpt)
+    models/             pure-JAX functional model zoo (GPT, ViT, ERNIE, ...)
+    parallel/           mesh builder, sharding rules, pipeline, MoE comm
+    optims/             optax-based optimizers, LR schedules, grad clip
+    data/               mmap token datasets, samplers, tokenizers, C++ helpers
+    ops/                Pallas TPU kernels (flash attention, fused LN, top-p)
+    utils/              config (YAML + _base_ + -o overrides), logging, registry
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,7 @@
+"""Parallelism layer: one device mesh + sharding rules replace the reference's
+HCG/SCG comm-group zoo (ppfleetx/distributed/apis/comm_groups.py,
+protein_folding/scg.py).  All collectives are XLA-inserted via pjit shardings
+or explicit psum/all_gather/ppermute/all_to_all inside shard_map."""
+
+from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh, get_mesh, set_mesh
+from paddlefleetx_tpu.parallel.seed import SeedTracker, init_seed, get_seed_tracker

@@ -1,0 +1,55 @@
+"""Distributed environment bootstrap.
+
+TPU-native replacement for the reference's ``init_dist_env``
+(ppfleetx/distributed/apis/env.py:121-151): where the reference builds a
+fleet DistributedStrategy + NCCL hybrid groups, we initialise multi-host JAX
+(if needed), build the global mesh from the ``Distributed`` config block, and
+seed the PRNG streams.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh, set_mesh
+from paddlefleetx_tpu.parallel.seed import init_seed
+from paddlefleetx_tpu.utils.log import logger
+
+
+def init_dist_env(cfg, devices=None) -> jax.sharding.Mesh:
+    """Initialise mesh + seeds from a processed config.
+
+    Multi-host: controlled by standard JAX env vars; ``jax.distributed.
+    initialize`` is invoked when a coordinator address is configured
+    (the ``paddle.distributed.launch --master`` analogue).
+    """
+    coord = os.environ.get("PFX_COORDINATOR_ADDRESS")
+    if coord and jax.process_count() == 1 and not _dist_initialized():
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["PFX_NUM_PROCESSES"]),
+            process_id=int(os.environ["PFX_PROCESS_ID"]),
+        )
+        logger.info(
+            f"jax.distributed initialised: process {jax.process_index()}/{jax.process_count()}"
+        )
+
+    mesh_cfg = MeshConfig.from_config(cfg)
+    mesh = build_mesh(mesh_cfg, devices)
+    set_mesh(mesh)
+    seed = int(cfg.get("Global", {}).get("seed", 1024))
+    init_seed(seed)
+    logger.info(f"mesh axes {dict(mesh.shape)} over {mesh.size} devices; seed {seed}")
+    return mesh
+
+
+def _dist_initialized() -> bool:
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:
+        return False

@@ -1,0 +1,72 @@
+"""Seed / PRNG-key discipline.
+
+The reference maintains three seed streams (``ppfleetx/distributed/apis/
+env.py:34-98``): a parameter seed shared across dp/sharding ranks, a
+``global_seed`` equal within an mp group (dropout on replicated activations)
+and a ``local_seed`` unique per rank (dropout on sharded activations),
+registered in Paddle's RNG-state tracker for TP determinism.
+
+Under JAX+GSPMD the same guarantees come from key *derivation*, not rank
+bookkeeping: programs are written against global arrays, so one root key
+yields identical init/dropout regardless of the mesh layout — which is
+exactly the reference's "precision validation across layouts" goal
+(env.py:62-71).  The tracker below provides named, collision-free streams:
+
+    params    — model init (root, fold_in=0)
+    global    — dropout applied to activations replicated across `model`
+    local     — dropout applied to activations sharded across `model`
+    data      — dataset shuffling / sampler seeds
+
+Per-step keys fold in the step counter; per-layer keys fold in layer id.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+_STREAM_IDS = {"params": 0, "global": 1, "local": 2, "data": 3}
+
+
+class SeedTracker:
+    """Named PRNG streams derived from one root seed."""
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        self._root = jax.random.key(self.seed)
+        self._streams: Dict[str, jax.Array] = {
+            name: jax.random.fold_in(self._root, sid) for name, sid in _STREAM_IDS.items()
+        }
+
+    def key(self, stream: str, *folds: int) -> jax.Array:
+        """Key for ``stream`` with optional (step, layer, ...) folds."""
+        k = self._streams[stream]
+        for f in folds:
+            k = jax.random.fold_in(k, f)
+        return k
+
+    def params_key(self) -> jax.Array:
+        return self.key("params")
+
+    def dropout_key(self, step: int) -> jax.Array:
+        return self.key("global", step)
+
+    def data_seed(self) -> int:
+        # int seed for host-side numpy RNGs (sampler shuffling)
+        return int(jax.random.randint(self.key("data"), (), 0, 2**31 - 1))
+
+
+_TRACKER: Optional[SeedTracker] = None
+
+
+def init_seed(seed: int) -> SeedTracker:
+    global _TRACKER
+    _TRACKER = SeedTracker(seed)
+    return _TRACKER
+
+
+def get_seed_tracker() -> SeedTracker:
+    if _TRACKER is None:
+        raise RuntimeError("seed tracker not initialised; call init_seed first")
+    return _TRACKER

@@ -1,0 +1,122 @@
+"""Sharding rules: logical axis names -> mesh PartitionSpecs.
+
+This module is the TPU-native replacement for the reference's explicit
+parallel layers (``ColumnParallelLinear`` / ``RowParallelLinear`` /
+``VocabParallelEmbedding`` in hybrid_model.py:153-196,699 and the ZeRO
+``group_sharded_parallel`` wrap, eager_engine.py:281-307).  Models annotate
+every parameter with *logical* axis names; rules map logical names to mesh
+axes; pjit/GSPMD inserts the same collectives the reference issues manually:
+
+    column-parallel matmul  = kernel sharded on output dim over `model`
+    row-parallel matmul     = kernel sharded on input dim over `model`
+                              (psum of partial products inserted by XLA)
+    vocab-parallel embed    = embedding sharded on vocab dim over `model`
+    ZeRO-1/2/3              = params/opt-state additionally sharded on `fsdp`
+    Megatron SP             = activations sharded on seq dim over `model`
+
+Logical axis vocabulary (model code uses ONLY these names):
+
+    batch      — batch dim of activations
+    seq        — sequence dim of activations (sharded over `sep`; over `model`
+                 too when Megatron sequence_parallel is on)
+    embed      — hidden/residual dim (fsdp-sharded for ZeRO-3)
+    mlp        — FFN intermediate dim (model-sharded: column-parallel)
+    heads      — attention heads dim (model-sharded)
+    kv         — per-head dim (never sharded)
+    vocab      — vocabulary dim (model-sharded: vocab-parallel)
+    layers     — stacked-layer dim of scanned params (stage-sharded under PP)
+    expert     — MoE expert dim (sharded over data×fsdp×sep expert group)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddlefleetx_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_SEP,
+    AXIS_STAGES,
+)
+
+# Each rule: logical name -> mesh axis (or tuple of axes), or None (replicated)
+BASE_RULES: Tuple[Tuple[str, Any], ...] = (
+    ("batch", (AXIS_DATA, AXIS_FSDP)),
+    ("seq", AXIS_SEP),
+    ("embed", None),
+    ("mlp", AXIS_MODEL),
+    ("heads", AXIS_MODEL),
+    ("kv", None),
+    ("vocab", AXIS_MODEL),
+    ("layers", AXIS_STAGES),
+    ("expert", (AXIS_DATA, AXIS_FSDP, AXIS_SEP)),
+)
+
+
+def make_rules(
+    fsdp_enabled: bool = False,
+    sequence_parallel: bool = False,
+) -> Tuple[Tuple[str, Any], ...]:
+    """Build logical->mesh rules for the configured strategies.
+
+    fsdp_enabled: shard the `embed` dim of params over `fsdp` (ZeRO-3-style
+    param sharding; ZeRO-1/2 are handled by sharding optimizer states /
+    gradients with the same rule set, see optims.build_optimizer).
+
+    sequence_parallel: activations' `seq` dim additionally sharded over
+    `model` between attention/MLP blocks (Megatron SP,
+    reference sequence_parallel_utils.py) — with GSPMD this is just a
+    different activation-sharding rule; all_gather/reduce_scatter fall out.
+    """
+    rules = dict(BASE_RULES)
+    if fsdp_enabled:
+        rules["embed"] = AXIS_FSDP
+    if sequence_parallel:
+        rules["seq"] = (AXIS_SEP, AXIS_MODEL)
+    return tuple(rules.items())
+
+
+def logical_to_spec(
+    logical_axes: Sequence[Optional[str]], rules: Sequence[Tuple[str, Any]]
+) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec."""
+    table = dict(rules)
+    used: set = set()
+    spec = []
+    for name in logical_axes:
+        if name is None:
+            spec.append(None)
+            continue
+        axes = table.get(name)
+        if axes is None:
+            spec.append(None)
+            continue
+        # one mesh axis may appear at most once in a spec
+        if isinstance(axes, str):
+            axes = (axes,)
+        free = tuple(a for a in axes if a not in used)
+        used.update(free)
+        spec.append(free if len(free) > 1 else (free[0] if free else None))
+    return P(*spec)
+
+
+def tree_logical_to_sharding(
+    logical_tree: Any, mesh: Mesh, rules: Sequence[Tuple[str, Any]]
+) -> Any:
+    """Map a pytree of logical-axis tuples to NamedShardings."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(a is None or isinstance(a, str) for a in x),
+    )
+
+
+def with_logical_constraint(x: jax.Array, logical_axes, rules, mesh: Mesh):
+    """`lax.with_sharding_constraint` via logical names (activation sharding)."""
+    spec = logical_to_spec(logical_axes, rules)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -1,0 +1,50 @@
+"""Rank-aware colored logger (reference ppfleetx/utils/log.py:65-189)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Optional
+
+_LOGGER: Optional[logging.Logger] = None
+
+_COLORS = {
+    "DEBUG": "\033[36m",
+    "INFO": "\033[32m",
+    "WARNING": "\033[33m",
+    "ERROR": "\033[31m",
+    "CRITICAL": "\033[35m",
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        color = _COLORS.get(record.levelname, "")
+        prefix = f"{color}[{time.strftime('%Y-%m-%d %H:%M:%S')}] [{record.levelname:>7s}]{_RESET}"
+        return f"{prefix} {record.getMessage()}"
+
+
+def get_logger(name: str = "paddlefleetx_tpu") -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        lg = logging.getLogger(name)
+        lg.setLevel(logging.INFO)
+        lg.propagate = False
+        if not lg.handlers:
+            h = logging.StreamHandler(sys.stdout)
+            h.setFormatter(_ColorFormatter())
+            lg.addHandler(h)
+        _LOGGER = lg
+    return _LOGGER
+
+
+logger = get_logger()
+
+
+def advertise() -> None:
+    """Startup banner (reference log.py:153)."""
+    logger.info("=" * 60)
+    logger.info("PaddleFleetX-TPU: TPU-native big model toolkit (JAX/XLA/Pallas)")
+    logger.info("=" * 60)

@@ -1,0 +1,28 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+This is the mock-multinode capability the reference lacks (SURVEY.md §4):
+every parallel layout (dp/tp/pp/sp/ep) runs as a multi-device unit test on
+one host, numerics asserted against single-device references.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The image's sitecustomize force-registers the 'axon' TPU platform ahead of
+# env vars, so pin the platform via jax.config (must run before backend init).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]

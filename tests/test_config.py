@@ -1,0 +1,121 @@
+"""Config system tests (behavioral parity with reference utils/config.py)."""
+
+import os
+import textwrap
+
+import pytest
+
+from paddlefleetx_tpu.utils.config import (
+    AttrDict,
+    get_config,
+    override_config,
+    parse_config,
+    process_configs,
+)
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(text))
+    return str(p)
+
+
+def test_attrdict_access():
+    d = AttrDict.from_nested({"a": {"b": 1}, "c": [1, {"d": 2}]})
+    assert d.a.b == 1
+    assert d.c[1].d == 2
+    d.a.e = 5
+    assert d["a"]["e"] == 5
+
+
+def test_base_inheritance(tmp_path):
+    _write(tmp_path, "base.yaml", """
+        Global:
+          seed: 42
+          global_batch_size: 8
+        Model:
+          hidden_size: 128
+          num_layers: 2
+    """)
+    child = _write(tmp_path, "child.yaml", """
+        _base_: ./base.yaml
+        Model:
+          num_layers: 4
+    """)
+    cfg = parse_config(child)
+    assert cfg.Global.seed == 42          # inherited
+    assert cfg.Model.hidden_size == 128   # inherited
+    assert cfg.Model.num_layers == 4      # overridden
+
+
+def test_inherited_optout(tmp_path):
+    _write(tmp_path, "base.yaml", """
+        Profiler:
+          enable: true
+        Global:
+          seed: 1
+    """)
+    child = _write(tmp_path, "child.yaml", """
+        _base_: ./base.yaml
+        Profiler:
+          _inherited_: False
+    """)
+    cfg = parse_config(child)
+    assert "Profiler" not in cfg
+    assert cfg.Global.seed == 1
+
+
+def test_overrides():
+    cfg = AttrDict.from_nested({"Model": {"hidden_size": 10}})
+    override_config(cfg, ["Model.hidden_size=64", "Engine.max_steps=5", "Global.flag=true"])
+    assert cfg.Model.hidden_size == 64
+    assert cfg.Engine.max_steps == 5
+    assert cfg.Global.flag is True
+
+
+def test_dist_degree_inference():
+    cfg = AttrDict.from_nested(
+        {
+            "Global": {"global_batch_size": 16},
+            "Distributed": {"mp_degree": 2, "pp_degree": 2},
+        }
+    )
+    cfg = process_configs(cfg, num_devices=8)
+    assert cfg.Distributed.dp_degree == 2  # 8 / (2*2)
+    assert cfg.Global.local_batch_size == 8  # 16 / dp_world(2)
+    assert cfg.Engine.accumulate_steps == 1
+
+
+def test_batch_reconciliation_error():
+    cfg = AttrDict.from_nested(
+        {
+            "Global": {"global_batch_size": 16, "local_batch_size": 4},
+            "Distributed": {},
+        }
+    )
+    with pytest.raises(ValueError):
+        process_configs(cfg, num_devices=2)  # 4*2 != 16
+
+
+def test_micro_batch_accumulate():
+    cfg = AttrDict.from_nested(
+        {
+            "Global": {"local_batch_size": 8, "micro_batch_size": 2},
+            "Distributed": {},
+        }
+    )
+    cfg = process_configs(cfg, num_devices=1)
+    assert cfg.Engine.accumulate_steps == 4
+    assert cfg.Global.global_batch_size == 8
+
+
+def test_get_config_with_override(tmp_path):
+    path = _write(tmp_path, "c.yaml", """
+        Global:
+          global_batch_size: 4
+        Distributed:
+          mp_degree: 1
+    """)
+    cfg = get_config(path, overrides=["Global.seed=7"], num_devices=1)
+    assert cfg.Global.seed == 7
+    assert cfg.Engine.mix_precision.dtype == "bfloat16"

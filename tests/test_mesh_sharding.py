@@ -1,0 +1,91 @@
+"""Mesh builder + sharding-rule tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh, data_parallel_world
+from paddlefleetx_tpu.parallel.seed import SeedTracker
+from paddlefleetx_tpu.parallel.sharding import (
+    logical_to_spec,
+    make_rules,
+    tree_logical_to_sharding,
+)
+
+
+def test_mesh_shapes(devices8):
+    mesh = build_mesh(MeshConfig(dp_degree=2, mp_degree=4), devices8)
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["model"] == 4
+    assert mesh.shape["stages"] == 1
+    assert data_parallel_world(mesh) == 2
+
+    mesh = build_mesh(MeshConfig(dp_degree=2, sharding_degree=2, pp_degree=2), devices8)
+    assert data_parallel_world(mesh) == 4
+
+
+def test_mesh_degree_mismatch(devices8):
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(dp_degree=3), devices8)
+
+
+def test_logical_to_spec_tp():
+    rules = make_rules()
+    # column-parallel kernel [embed, mlp] -> (None, 'model')
+    assert logical_to_spec(("embed", "mlp"), rules) == P(None, "model")
+    # row-parallel kernel [mlp, embed] -> ('model', None)
+    assert logical_to_spec(("mlp", "embed"), rules) == P("model", None)
+    # vocab embedding [vocab, embed]
+    assert logical_to_spec(("vocab", "embed"), rules) == P("model", None)
+    # activations [batch, seq, embed]
+    assert logical_to_spec(("batch", "seq", "embed"), rules) == P(("data", "fsdp"), "sep", None)
+
+
+def test_logical_to_spec_fsdp_sp():
+    rules = make_rules(fsdp_enabled=True, sequence_parallel=True)
+    assert logical_to_spec(("embed", "mlp"), rules) == P("fsdp", "model")
+    assert logical_to_spec(("batch", "seq", "embed"), rules) == P(
+        ("data", "fsdp"), ("sep", "model"), None
+    )
+
+
+def test_duplicate_mesh_axis_dropped():
+    # seq uses model under SP; heads also wants model -> second use must drop
+    rules = make_rules(sequence_parallel=True)
+    spec = logical_to_spec(("seq", "heads"), rules)
+    assert spec == P(("sep", "model"), None)
+
+
+def test_tree_sharding_and_matmul(devices8):
+    mesh = build_mesh(MeshConfig(dp_degree=2, mp_degree=4), devices8)
+    rules = make_rules()
+    logical = {"w": ("embed", "mlp"), "b": ("mlp",)}
+    shardings = tree_logical_to_sharding(logical, mesh, rules)
+    assert shardings["w"].spec == P(None, "model")
+
+    w = jax.device_put(jnp.ones((16, 32)), shardings["w"])
+    x = jax.device_put(
+        jnp.ones((8, 16)), NamedSharding(mesh, P(("data", "fsdp"), None))
+    )
+    y = jax.jit(lambda a, b: a @ b)(x, w)
+    np.testing.assert_allclose(np.asarray(y), 16.0)
+
+
+def test_seed_tracker_streams():
+    t = SeedTracker(1234)
+    k1 = t.key("params")
+    k2 = t.key("global")
+    assert not np.array_equal(
+        jax.random.key_data(k1), jax.random.key_data(k2)
+    )
+    # deterministic
+    t2 = SeedTracker(1234)
+    assert np.array_equal(
+        jax.random.key_data(t2.key("params")), jax.random.key_data(k1)
+    )
+    # per-step folds differ
+    assert not np.array_equal(
+        jax.random.key_data(t.dropout_key(1)), jax.random.key_data(t.dropout_key(2))
+    )
