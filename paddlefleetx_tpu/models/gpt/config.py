@@ -1,0 +1,79 @@
+"""GPT model hyperparameter config.
+
+Field vocabulary matches the reference's GPT YAML ``Model`` block
+(ppfleetx/configs/nlp/gpt/pretrain_gpt_base.yaml and
+models/language_model/gpt/dygraph/single_model.py:608 ``GPTModel.__init__``),
+so reference configs translate 1:1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_attention_heads: int = 16
+    ffn_hidden_size: Optional[int] = None  # defaults to 4*hidden
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    # recompute (reference recompute_granularity full/full_attn/core_attn,
+    # single_model.py:320-405)
+    use_recompute: bool = False
+    recompute_granularity: str = "full"
+    # fused qkv projection (reference fuse_attn_qkv, hybrid_model.py:153)
+    fuse_attn_qkv: bool = True
+    # attention implementation: "xla" (jnp reference) | "flash" (Pallas kernel)
+    attn_impl: str = "xla"
+    # Megatron sequence parallelism: activations sharded on seq over `model`
+    sequence_parallel: bool = False
+    # compute dtype for activations (params/optimizer stay fp32)
+    dtype: str = "bfloat16"
+    # MoE (0 or 1 = dense; >1 enables expert-parallel FFN, reference
+    # single_model.py:480-492 num_experts)
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.2
+    moe_gate: str = "gshard"  # naive | gshard | switch
+    moe_aux_loss_weight: float = 0.01
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
+        if self.hidden_size % self.num_attention_heads:
+            raise ValueError("hidden_size must divide num_attention_heads")
+        if self.recompute_granularity not in ("full", "full_attn", "core_attn"):
+            raise ValueError(f"bad recompute_granularity {self.recompute_granularity}")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def from_config(model_cfg) -> "GPTConfig":
+        """Build from a YAML ``Model`` section (unknown keys ignored)."""
+        fields = {f.name for f in dataclasses.fields(GPTConfig)}
+        kwargs = {k: v for k, v in dict(model_cfg).items() if k in fields}
+        return GPTConfig(**kwargs)
+
+
+# Reference model sizes (projects/gpt/docs, configs/nlp/gpt/*.yaml)
+PRESETS = {
+    "gpt-345M": dict(hidden_size=1024, num_layers=24, num_attention_heads=16),
+    "gpt-1.3B": dict(hidden_size=2048, num_layers=24, num_attention_heads=16),
+    "gpt-6.7B": dict(hidden_size=4096, num_layers=32, num_attention_heads=32),
+    "gpt-13B": dict(hidden_size=5120, num_layers=40, num_attention_heads=40),
+    "gpt-175B": dict(hidden_size=12288, num_layers=96, num_attention_heads=96),
+}
+
+
+def preset(name: str, **overrides) -> GPTConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name}; known: {sorted(PRESETS)}")
+    return GPTConfig(**{**PRESETS[name], **overrides})

