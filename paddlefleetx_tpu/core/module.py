@@ -1,0 +1,95 @@
+"""Module protocol: binds a model family to the engine.
+
+Reference: ``BasicModule`` (ppfleetx/core/module/basic_module.py:29-86, a
+Lightning-style protocol) + ``GPTModule`` (language_module.py:148).  Here a
+module is the *functional* bundle the engine needs: param specs + loss +
+metrics; train/eval stepping lives in the engine (pure jitted functions),
+so the protocol is data-flow only — no training_step/backward hooks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from paddlefleetx_tpu.utils.registry import MODULES
+
+
+class BasicModule:
+    """Interface consumed by the Engine."""
+
+    def init_params(self, key: jax.Array) -> Any:
+        raise NotImplementedError
+
+    def logical_axes(self) -> Any:
+        """Pytree of logical sharding-axis tuples matching params."""
+        raise NotImplementedError
+
+    def loss_fn(
+        self,
+        params: Any,
+        batch: Dict[str, jax.Array],
+        *,
+        ctx=None,
+        dropout_key: Optional[jax.Array] = None,
+        train: bool = True,
+    ) -> jax.Array:
+        raise NotImplementedError
+
+    def eval_metrics(self, loss: jax.Array) -> Dict[str, jax.Array]:
+        return {"loss": loss}
+
+    # tokens per sample for ips reporting (reference language_module.py:100)
+    tokens_per_sample: Optional[int] = None
+
+
+@MODULES.register("GPTModule")
+class GPTModule(BasicModule):
+    """GPT pretraining (reference GPTModule language_module.py:148-227).
+
+    Where the reference dispatches to single/hybrid/pipe model classes by
+    world size (language_module.py:181-192), parallelism here is carried by
+    the sharding rules the engine applies — one model."""
+
+    def __init__(self, cfg):
+        from paddlefleetx_tpu.models.gpt.config import GPTConfig
+
+        model_cfg = dict(cfg.Model)
+        model_cfg.pop("module", None)
+        model_cfg.pop("name", None)
+        mix = cfg.get("Engine", {}).get("mix_precision", {})
+        if mix.get("enable", True) and "dtype" not in model_cfg:
+            model_cfg["dtype"] = mix.get("dtype", "bfloat16")
+        dist = cfg.get("Distributed", {})
+        if dist.get("sequence_parallel", False):
+            model_cfg["sequence_parallel"] = True
+        self.config = GPTConfig.from_config(model_cfg)
+        self.tokens_per_sample = self.config.max_position_embeddings
+        seq_len = cfg.get("Data", {}).get("Train", {}).get("dataset", {}).get("max_seq_len")
+        if seq_len:
+            self.tokens_per_sample = int(seq_len)
+
+    def init_params(self, key):
+        from paddlefleetx_tpu.models.gpt import model as gpt
+
+        return gpt.init(self.config, key)
+
+    def logical_axes(self):
+        from paddlefleetx_tpu.models.gpt import model as gpt
+
+        return gpt.gpt_logical_axes(self.config)
+
+    def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=True):
+        from paddlefleetx_tpu.models.gpt import model as gpt
+
+        return gpt.loss_fn(
+            params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
+        )
+
+
+def build_module(cfg) -> BasicModule:
+    """Name-dispatched module construction (reference models/__init__.py:30,
+    minus the eval())."""
+    name = cfg.Model.get("module", "GPTModule")
+    return MODULES.get(name)(cfg)

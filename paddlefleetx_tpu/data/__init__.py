@@ -1,0 +1,13 @@
+"""Data layer: datasets, samplers, loaders, tokenizers, native index helpers.
+
+Importing this package registers all built-in datasets/samplers (replacing
+the reference's eval()-based name dispatch, data/__init__.py:69-119).
+"""
+
+from paddlefleetx_tpu.data import gpt_dataset as _gpt_dataset  # noqa: F401 (registers)
+from paddlefleetx_tpu.data.batch_sampler import (  # noqa: F401
+    DataLoader,
+    DistributedBatchSampler,
+    collate_stack,
+)
+from paddlefleetx_tpu.data.builders import build_dataloader, build_dataset  # noqa: F401

@@ -1,0 +1,52 @@
+"""Dataset/dataloader builders from config (reference data/__init__.py:28-119)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddlefleetx_tpu.data.batch_sampler import DataLoader, DistributedBatchSampler, collate_stack
+from paddlefleetx_tpu.parallel.seed import get_seed_tracker
+from paddlefleetx_tpu.utils.registry import DATASETS
+
+
+def build_dataset(cfg, mode: str, **extra):
+    ds_cfg = dict(cfg.Data[mode].dataset)
+    name = ds_cfg.pop("name")
+    ds_cfg.setdefault("mode", mode)
+    ds_cfg.update(extra)
+    return DATASETS.get(name)(**ds_cfg)
+
+
+def build_dataloader(cfg, mode: str, dataset=None, consumed_samples: int = 0) -> DataLoader:
+    """Build dataset + sampler + loader for a config mode (Train/Eval/Test).
+
+    The sampler yields *global* batches; dp-rank slicing is done by the
+    device_put sharding, not the sampler (see batch_sampler.py docstring).
+    ``consumed_samples`` (from a restored checkpoint's meta) resumes the
+    data order mid-epoch (reference GPTBatchSampler batch_sampler.py:87,118).
+    """
+    if dataset is None:
+        num_samples = None
+        if mode == "Train":
+            num_samples = int(cfg.Engine.max_steps) * int(cfg.Global.global_batch_size)
+        dataset = build_dataset(cfg, mode, **({"num_samples": num_samples} if num_samples else {}))
+    sampler_cfg = dict(cfg.Data[mode].get("sampler", {}))
+    sampler = DistributedBatchSampler(
+        dataset_len=len(dataset),
+        batch_size=int(cfg.Global.global_batch_size)
+        if mode == "Train"
+        else int(cfg.Global.get("eval_batch_size", cfg.Global.global_batch_size)),
+        shuffle=bool(sampler_cfg.get("shuffle", mode == "Train")),
+        drop_last=bool(sampler_cfg.get("drop_last", True)),
+        seed=get_seed_tracker().data_seed() if _seed_ready() else 1234,
+        consumed_samples=consumed_samples,
+    )
+    return DataLoader(dataset, sampler, collate_stack)
+
+
+def _seed_ready() -> bool:
+    try:
+        get_seed_tracker()
+        return True
+    except RuntimeError:
+        return False

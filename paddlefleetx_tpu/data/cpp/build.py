@@ -1,0 +1,43 @@
+"""JIT build of the C++ index helpers (reference compiles its pybind11 module
+at first use via data_tools/cpp/compile.py + Makefile; we shell out to g++
+once and cache the .so next to the source)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "helpers.cpp")
+_SO = os.path.join(_DIR, "libpfx_helpers.so")
+
+
+def build(force: bool = False) -> str:
+    if force or not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        # build to a temp name then rename: concurrent ranks racing the build
+        # each produce a complete .so (reference rank0-builds + others poll;
+        # atomic rename is simpler and lock-free)
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+        os.close(fd)
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, _SO)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return _SO
+
+
+def build_and_load() -> ctypes.CDLL:
+    lib = ctypes.CDLL(build())
+    lib.build_sample_idx.restype = None
+    lib.build_blending_indices.restype = None
+    lib.build_mapping.restype = ctypes.c_int64
+    lib.build_blocks_mapping.restype = ctypes.c_int64
+    return lib

@@ -1,0 +1,241 @@
+"""Megatron-style GPT pretraining dataset over mmap token files.
+
+Reference: ppfleetx/data/dataset/gpt_dataset.py:42-465 (GPTDataset).  Data
+format: ``{prefix}_ids.npy`` — all documents' tokens concatenated (uint16/
+uint32); ``{prefix}_idx.npz`` — document token lengths (key ``lens``).
+Samples are fixed ``seq_length`` windows walked across shuffled documents
+over enough epochs to cover ``num_samples``; index maps (doc_idx /
+sample_idx / shuffle_idx) are built once (C++ helper with numpy fallback)
+and cached as .npy beside the data.  Each item yields tokens / position_ids
+/ labels / loss_mask (reference :153-171).
+
+Also here: LM_Eval_Dataset (overlapping-window perplexity eval, reference
+:484) and Lambada_Eval_Dataset (:589) used by the GPT eval module.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddlefleetx_tpu.data.indexed import build_doc_idx, build_sample_idx, build_shuffle_idx
+from paddlefleetx_tpu.utils.log import logger
+from paddlefleetx_tpu.utils.registry import DATASETS
+
+
+def _split_docs(num_docs: int, split: Sequence[float]):
+    """Train/valid/test doc ranges from fractions (reference :95-116)."""
+    split = np.asarray(split, dtype=np.float64)
+    split = split / split.sum()
+    bounds = np.concatenate([[0], np.cumsum(split)])
+    edges = (bounds * num_docs).astype(np.int64)
+    return [(int(edges[i]), int(edges[i + 1])) for i in range(len(split))]
+
+
+@DATASETS.register("GPTDataset")
+class GPTDataset:
+    MODES = {"Train": 0, "Eval": 1, "Test": 2}
+
+    def __init__(
+        self,
+        input_dir: str = None,
+        data_prefix: str = None,
+        split: Sequence[float] = (949, 50, 1),
+        max_seq_len: int = 1024,
+        num_samples: int = None,
+        mode: str = "Train",
+        seed: int = 1234,
+        build_cache: bool = True,
+        **_unused,
+    ):
+        if data_prefix is None:
+            files = sorted(
+                f[: -len("_ids.npy")]
+                for f in os.listdir(input_dir)
+                if f.endswith("_ids.npy")
+            )
+            if not files:
+                raise FileNotFoundError(f"no *_ids.npy under {input_dir}")
+            data_prefix = os.path.join(input_dir, files[0])
+        self.prefix = data_prefix
+        self.seq_len = int(max_seq_len)
+        self.mode = mode
+
+        self.tokens = np.load(data_prefix + "_ids.npy", mmap_mode="r")
+        idx = np.load(data_prefix + "_idx.npz")
+        lens = idx["lens"].astype(np.int32)
+        self.doc_offsets = np.concatenate([[0], np.cumsum(lens.astype(np.int64))])
+
+        ranges = _split_docs(len(lens), split)
+        lo, hi = ranges[self.MODES[mode]]
+        if hi <= lo:
+            lo, hi = 0, len(lens)  # degenerate split: use everything
+        self.doc_lo = lo
+        self.docs = np.arange(lo, hi, dtype=np.int32)
+        self.sizes = lens[lo:hi]
+        tokens_per_epoch = int(self.sizes.sum())
+        if num_samples is None:
+            num_samples = max((tokens_per_epoch - 1) // self.seq_len, 1)
+        self.num_samples = int(num_samples)
+
+        num_epochs = max(
+            1, int(np.ceil((self.num_samples * self.seq_len + 1) / tokens_per_epoch))
+        )
+
+        # cache key fingerprints the actual doc lengths + split, so a
+        # regenerated corpus or changed split can never reuse stale maps
+        hasher = hashlib.md5(
+            json.dumps([mode, self.seq_len, self.num_samples, seed, list(map(float, split))]).encode()
+        )
+        hasher.update(self.sizes.tobytes())
+        cache = f"{data_prefix}_{mode.lower()}_{hasher.hexdigest()[:10]}"
+
+        cache_files = [cache + s for s in ("_doc_idx.npy", "_sample_idx.npy", "_shuffle_idx.npy")]
+        if build_cache and all(os.path.exists(f) for f in cache_files):
+            self.doc_idx = np.load(cache + "_doc_idx.npy")
+            self.sample_idx = np.load(cache + "_sample_idx.npy")
+            self.shuffle_idx = np.load(cache + "_shuffle_idx.npy")
+        else:
+            rng = np.random.default_rng(seed)
+            self.doc_idx = build_doc_idx(len(self.sizes), num_epochs, rng)
+            self.sample_idx = build_sample_idx(
+                self.sizes, self.doc_idx, self.seq_len, num_epochs, tokens_per_epoch
+            )
+            total = self.sample_idx.shape[0] - 1
+            self.shuffle_idx = build_shuffle_idx(
+                min(self.num_samples, total), total, rng
+            )
+            if build_cache:
+                try:
+                    np.save(cache + "_doc_idx.npy", self.doc_idx)
+                    np.save(cache + "_sample_idx.npy", self.sample_idx)
+                    np.save(cache + "_shuffle_idx.npy", self.shuffle_idx)
+                except OSError as e:  # read-only data dir: keep in memory
+                    logger.warning(f"index cache not written: {e}")
+        logger.info(
+            f"GPTDataset[{mode}] docs={len(self.sizes)} epochs={num_epochs} "
+            f"samples={self.num_samples} seq={self.seq_len}"
+        )
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def _doc_tokens(self, doc: int, start: int, end: Optional[int] = None) -> np.ndarray:
+        g = self.doc_lo + doc  # global doc id
+        a = self.doc_offsets[g] + start
+        b = self.doc_offsets[g + 1] if end is None else self.doc_offsets[g] + end
+        return self.tokens[a:b]
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        idx = int(self.shuffle_idx[idx % len(self.shuffle_idx)])
+        di_first, off_first = self.sample_idx[idx]
+        di_last, off_last = self.sample_idx[idx + 1]
+        parts: List[np.ndarray] = []
+        if di_first == di_last:
+            parts.append(
+                self._doc_tokens(self.doc_idx[di_first], off_first, off_last + 1)
+            )
+        else:
+            parts.append(self._doc_tokens(self.doc_idx[di_first], off_first))
+            for di in range(di_first + 1, di_last):
+                parts.append(self._doc_tokens(self.doc_idx[di], 0))
+            parts.append(self._doc_tokens(self.doc_idx[di_last], 0, off_last + 1))
+        seq = np.concatenate(parts).astype(np.int64)
+        assert len(seq) == self.seq_len + 1, (len(seq), self.seq_len)
+        return {
+            "tokens": seq[:-1],
+            "labels": seq[1:],
+            "loss_mask": np.ones(self.seq_len, dtype=np.float32),
+            "position_ids": np.arange(self.seq_len, dtype=np.int64),
+        }
+
+
+@DATASETS.register("LM_Eval_Dataset")
+class LMEvalDataset:
+    """Overlapping-window LM perplexity eval (reference gpt_dataset.py:484):
+    windows of seq_len stride ``overlapping_eval``; only new tokens counted
+    in the loss mask."""
+
+    def __init__(
+        self, tokens: np.ndarray, seq_len: int = 1024, overlapping_eval: int = 32, **_
+    ):
+        self.tokens = np.asarray(tokens, dtype=np.int64)
+        self.seq_len = seq_len
+        self.stride = overlapping_eval
+        total = len(self.tokens)
+        self.num = max(1, 1 + max(0, (total - seq_len - 1 + self.stride - 1) // self.stride))
+
+    def __len__(self):
+        return self.num
+
+    def __getitem__(self, i: int):
+        start = i * self.stride
+        seq = self.tokens[start : start + self.seq_len + 1]
+        pad = self.seq_len + 1 - len(seq)
+        if pad:
+            seq = np.concatenate([seq, np.zeros(pad, np.int64)])
+        mask = np.ones(self.seq_len, np.float32)
+        if pad:
+            mask[-pad:] = 0.0
+        if i > 0:  # only the non-overlapping tail counts
+            mask[: self.seq_len - self.stride] = 0.0
+        return {
+            "tokens": seq[:-1],
+            "labels": seq[1:],
+            "loss_mask": mask,
+            "position_ids": np.arange(self.seq_len, dtype=np.int64),
+        }
+
+
+@DATASETS.register("Lambada_Eval_Dataset")
+class LambadaEvalDataset:
+    """LAMBADA last-word accuracy (reference gpt_dataset.py:589): loss mask
+    covers only the target-word tokens."""
+
+    def __init__(self, examples, seq_len: int = 1024, **_):
+        # examples: list of (context_token_ids, target_token_ids)
+        self.examples = examples
+        self.seq_len = seq_len
+
+    def __len__(self):
+        return len(self.examples)
+
+    def __getitem__(self, i: int):
+        ctx, tgt = self.examples[i]
+        seq = np.concatenate([ctx, tgt]).astype(np.int64)[: self.seq_len + 1]
+        pad = self.seq_len + 1 - len(seq)
+        if pad:
+            seq = np.concatenate([seq, np.zeros(pad, np.int64)])
+        mask = np.zeros(self.seq_len, np.float32)
+        lo = max(len(ctx) - 1, 0)
+        hi = min(len(ctx) - 1 + len(tgt), self.seq_len)
+        mask[lo:hi] = 1.0
+        return {
+            "tokens": seq[:-1],
+            "labels": seq[1:],
+            "loss_mask": mask,
+            "position_ids": np.arange(self.seq_len, dtype=np.int64),
+        }
+
+
+def write_synthetic_corpus(
+    prefix: str, vocab_size: int = 50304, num_docs: int = 64, mean_len: int = 600, seed: int = 0
+) -> str:
+    """Generate a tiny corpus in the mmap format (for tests and benches)."""
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(mean_len // 2, mean_len * 2, num_docs).astype(np.int32)
+    # Zipf-ish unigram distribution: gives the model learnable structure
+    # (uniform data would make ln(vocab) the optimum — useless for loss-drop
+    # tests and unrepresentative for benches)
+    probs = 1.0 / (np.arange(vocab_size) + 5.0)
+    probs /= probs.sum()
+    tokens = rng.choice(vocab_size, size=int(lens.sum()), p=probs).astype(
+        np.uint16 if vocab_size < 2**16 else np.uint32
+    )
+    np.save(prefix + "_ids.npy", tokens)
+    np.savez(prefix + "_idx.npz", lens=lens)
+    return prefix

@@ -1,0 +1,133 @@
+"""LR schedules (reference ppfleetx/optims/lr_scheduler.py:31-192).
+
+Schedules are pure functions ``step -> lr`` (optax convention).  The
+reference's ``use_increments`` token-based stepping
+(CosineAnnealingWithWarmupDecay steps by global_batch_size each iteration,
+eager_engine.py:354-357) maps to passing ``num_tokens`` processed as the
+schedule argument; the engine chooses the counter.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.utils.registry import LR_SCHEDULERS
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+@LR_SCHEDULERS.register("CosineAnnealingWithWarmupDecay")
+def cosine_annealing_with_warmup_decay(
+    max_lr: float,
+    min_lr: float,
+    warmup_rate: Optional[float] = None,
+    decay_steps: int = 0,
+    warmup_steps: Optional[int] = None,
+    **_unused,
+) -> Schedule:
+    """Megatron-style: linear warmup to max_lr, cosine decay to min_lr
+    (reference lr_scheduler.py:31-74).  ``warmup_rate`` is the fraction of
+    decay_steps spent warming up (reference passes warmup_rate*decay_steps)."""
+    if warmup_steps is None:
+        warmup_steps = int((warmup_rate or 0.0) * decay_steps)
+
+    def schedule(count):
+        count = jnp.asarray(count, jnp.float32)
+        warm = max_lr * count / jnp.maximum(warmup_steps, 1)
+        frac = jnp.clip(
+            (count - warmup_steps) / jnp.maximum(decay_steps - warmup_steps, 1), 0.0, 1.0
+        )
+        cos = min_lr + 0.5 * (max_lr - min_lr) * (1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.where(count < warmup_steps, warm, cos)
+
+    return schedule
+
+
+@LR_SCHEDULERS.register("LinearDecayWithWarmup")
+def linear_decay_with_warmup(
+    learning_rate: float,
+    total_steps: int,
+    warmup: float = 0.1,
+    **_unused,
+) -> Schedule:
+    """Linear warmup then linear decay to 0 (reference lr_scheduler.py:77)."""
+    warmup_steps = int(warmup * total_steps) if warmup < 1 else int(warmup)
+
+    def schedule(count):
+        count = jnp.asarray(count, jnp.float32)
+        warm = learning_rate * count / jnp.maximum(warmup_steps, 1)
+        decay = learning_rate * jnp.maximum(
+            (total_steps - count) / jnp.maximum(total_steps - warmup_steps, 1), 0.0
+        )
+        return jnp.where(count < warmup_steps, warm, decay)
+
+    return schedule
+
+
+@LR_SCHEDULERS.register("ViTLRScheduler")
+def vit_lr_scheduler(
+    learning_rate: float,
+    total_steps: int = 0,
+    warmup_steps: int = 0,
+    decay_type: str = "cosine",
+    linear_end: float = 1e-5,
+    **_unused,
+) -> Schedule:
+    """ViT schedule (reference lr_scheduler.py:103): warmup + cosine/linear."""
+
+    def schedule(count):
+        count = jnp.asarray(count, jnp.float32)
+        warm = learning_rate * count / jnp.maximum(warmup_steps, 1)
+        frac = jnp.clip(
+            (count - warmup_steps) / jnp.maximum(total_steps - warmup_steps, 1), 0.0, 1.0
+        )
+        if decay_type == "cosine":
+            main = linear_end + 0.5 * (learning_rate - linear_end) * (
+                1.0 + jnp.cos(jnp.pi * frac)
+            )
+        else:
+            main = learning_rate + (linear_end - learning_rate) * frac
+        return jnp.where(count < warmup_steps, warm, main)
+
+    return schedule
+
+
+@LR_SCHEDULERS.register("MultiStepDecay")
+def multi_step_decay(
+    learning_rate: float, milestones=(30, 60, 90), gamma: float = 0.1, **_unused
+) -> Schedule:
+    """Step decay at milestones (reference lr_scheduler.py:144)."""
+    ms = jnp.asarray(sorted(milestones), jnp.float32)
+
+    def schedule(count):
+        count = jnp.asarray(count, jnp.float32)
+        n = jnp.sum(count >= ms)
+        return learning_rate * gamma**n
+
+    return schedule
+
+
+@LR_SCHEDULERS.register("CosineDecay")
+def cosine_decay(learning_rate: float, total_steps: int, **_unused) -> Schedule:
+    """Plain cosine to 0 (reference lr_scheduler.py:162)."""
+
+    def schedule(count):
+        frac = jnp.clip(jnp.asarray(count, jnp.float32) / max(total_steps, 1), 0.0, 1.0)
+        return 0.5 * learning_rate * (1.0 + jnp.cos(jnp.pi * frac))
+
+    return schedule
+
+
+@LR_SCHEDULERS.register("Constant")
+def constant(learning_rate: float, **_unused) -> Schedule:
+    return lambda count: jnp.asarray(learning_rate, jnp.float32)
+
+
+def build_lr_scheduler(cfg) -> Schedule:
+    """From YAML ``Optimizer.lr`` block (reference optims/__init__.py:29)."""
+    cfg = dict(cfg)
+    name = cfg.pop("name")
+    return LR_SCHEDULERS.get(name)(**cfg)

@@ -1,0 +1,133 @@
+"""End-to-end engine tests: tiny GPT pretrain on the 8-device CPU mesh —
+loss decreases, checkpoint save/load resumes, layouts agree.
+
+This is the TIPC-harness analogue (SURVEY §4): loss-curve + throughput are
+the golden signals; here we assert the loss actually drops."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.core.engine import Engine
+from paddlefleetx_tpu.core.module import build_module
+from paddlefleetx_tpu.data.builders import build_dataloader
+from paddlefleetx_tpu.data.gpt_dataset import write_synthetic_corpus
+from paddlefleetx_tpu.parallel.env import init_dist_env
+from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+
+def tiny_cfg(tmp_path, **dist):
+    data_dir = str(tmp_path / "data")
+    os.makedirs(data_dir, exist_ok=True)
+    write_synthetic_corpus(os.path.join(data_dir, "corpus"), vocab_size=128, num_docs=16)
+    cfg = AttrDict.from_nested(
+        {
+            "Global": {"global_batch_size": 16, "micro_batch_size": 1, "seed": 7},
+            "Engine": {
+                "max_steps": 12,
+                "eval_freq": 0,
+                "logging_freq": 4,
+                "mix_precision": {"enable": False},
+                "save_load": {"save_steps": 0, "output_dir": str(tmp_path / "out")},
+            },
+            "Model": {
+                "module": "GPTModule",
+                "vocab_size": 128,
+                "hidden_size": 64,
+                "num_layers": 2,
+                "num_attention_heads": 8,
+                "max_position_embeddings": 32,
+                "hidden_dropout_prob": 0.0,
+                "attention_probs_dropout_prob": 0.0,
+                "dtype": "float32",
+            },
+            "Distributed": dist,
+            "Data": {
+                "Train": {
+                    "dataset": {
+                        "name": "GPTDataset",
+                        "input_dir": data_dir,
+                        "max_seq_len": 32,
+                        "split": [1, 0, 0],
+                    },
+                    "sampler": {"shuffle": True},
+                },
+            },
+            "Optimizer": {
+                "name": "FusedAdamW",
+                "weight_decay": 0.01,
+                "lr": {"name": "Constant", "learning_rate": 3e-3},
+                "grad_clip": {"name": "ClipGradByGlobalNorm", "clip_norm": 1.0},
+            },
+        }
+    )
+    return process_configs(cfg, num_devices=8)
+
+
+def _losses_from_run(cfg, steps=12):
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    loader = build_dataloader(cfg, "Train")
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        losses = []
+        it = iter(loader)
+        for _ in range(steps):
+            batch = next(it)
+            engine.state, m = engine._train_step(engine.state, engine._put_batch(batch))
+            losses.append(float(m["loss"]))
+    return losses, engine
+
+
+def test_train_loss_decreases(tmp_path, devices8):
+    cfg = tiny_cfg(tmp_path)
+    losses, _ = _losses_from_run(cfg)
+    assert losses[0] > 4.0  # ~ln(128)=4.85
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]) - 0.2
+
+
+def test_layout_loss_parity_first_step(tmp_path, devices8):
+    """Same data+seed, different layouts -> same first-step loss (the
+    reference's cross-layout precision-validation contract)."""
+    first = {}
+    for name, dist in {
+        "dp8": {},
+        "mp8": {"mp_degree": 8},
+        "dp2mp4": {"mp_degree": 4},
+        "fsdp": {"sharding": {"sharding_degree": 8, "sharding_stage": 2}},
+    }.items():
+        cfg = tiny_cfg(tmp_path, **dist)
+        losses, _ = _losses_from_run(cfg, steps=2)
+        first[name] = losses
+    base = first["dp8"]
+    for name, ls in first.items():
+        np.testing.assert_allclose(ls, base, rtol=2e-4, err_msg=name)
+
+
+def test_checkpoint_roundtrip(tmp_path, devices8):
+    cfg = tiny_cfg(tmp_path)
+    losses, engine = _losses_from_run(cfg, steps=4)
+    path = engine.save(str(tmp_path / "ckpt"))
+
+    cfg2 = tiny_cfg(tmp_path)
+    mesh = init_dist_env(cfg2)
+    module = build_module(cfg2)
+    with mesh:
+        engine2 = Engine(cfg2, module, mesh)
+        engine2.load(path)
+        assert int(engine2.state.step) == 4
+        for a, b in zip(jax.tree.leaves(engine.state.params), jax.tree.leaves(engine2.state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fit_smoke(tmp_path, devices8, capsys):
+    cfg = tiny_cfg(tmp_path)
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    loader = build_dataloader(cfg, "Train")
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        state = engine.fit(loader)
+    assert int(state.step) == 12

@@ -1,0 +1,79 @@
+"""Optimizer / LR schedule tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlefleetx_tpu.optims.lr_scheduler import build_lr_scheduler
+from paddlefleetx_tpu.optims.optimizer import build_optimizer
+
+
+def test_cosine_warmup_shape():
+    sch = build_lr_scheduler(
+        dict(
+            name="CosineAnnealingWithWarmupDecay",
+            max_lr=1e-3,
+            min_lr=1e-5,
+            warmup_rate=0.1,
+            decay_steps=1000,
+        )
+    )
+    assert float(sch(0)) == 0.0
+    assert abs(float(sch(100)) - 1e-3) < 1e-6  # end of warmup
+    assert float(sch(50)) < 1e-3
+    assert abs(float(sch(1000)) - 1e-5) < 1e-6
+    assert float(sch(2000)) == float(sch(1000))  # clamps at min
+
+
+def test_linear_decay():
+    sch = build_lr_scheduler(
+        dict(name="LinearDecayWithWarmup", learning_rate=1e-2, total_steps=100, warmup=0.1)
+    )
+    assert abs(float(sch(10)) - 1e-2) < 1e-6
+    assert abs(float(sch(100))) < 1e-6
+
+
+def test_multistep():
+    sch = build_lr_scheduler(dict(name="MultiStepDecay", learning_rate=1.0, milestones=[5, 10]))
+    assert float(sch(0)) == 1.0
+    assert abs(float(sch(5)) - 0.1) < 1e-6
+    assert abs(float(sch(10)) - 0.01) < 1e-6
+
+
+def test_adamw_decay_mask_and_step():
+    tx, sch = build_optimizer(
+        dict(
+            name="FusedAdamW",
+            weight_decay=0.5,
+            beta1=0.9,
+            beta2=0.999,
+            epsilon=1e-8,
+            lr={"name": "Constant", "learning_rate": 0.1},
+            grad_clip={"name": "ClipGradByGlobalNorm", "clip_norm": 1.0},
+        )
+    )
+    params = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+    state = tx.init(params)
+    grads = {"w": jnp.zeros((4, 4)), "b": jnp.zeros((4,))}
+    upd, state = tx.update(grads, state, params)
+    # zero grad: decayed weights move, bias (1-D, masked) does not
+    assert float(jnp.abs(upd["w"]).sum()) > 0
+    assert float(jnp.abs(upd["b"]).sum()) == 0
+
+
+def test_grad_clip_applied():
+    tx, _ = build_optimizer(
+        dict(
+            name="AdamW",
+            lr={"name": "Constant", "learning_rate": 1.0},
+            grad_clip={"name": "ClipGradByGlobalNorm", "clip_norm": 1e-6},
+            weight_decay=0.0,
+        )
+    )
+    params = {"w": jnp.ones((2,))}
+    state = tx.init(params)
+    g1 = {"w": jnp.array([1000.0, 0.0])}
+    u1, _ = tx.update(g1, state, params)
+    # tiny clip norm -> tiny effective grads -> update ~ lr * sign only after
+    # adam normalization; just check finite + bounded
+    assert np.all(np.isfinite(np.asarray(u1["w"])))

@@ -1,0 +1,44 @@
+"""Training entry point (reference tools/train.py:44-73):
+config -> dist init -> build module -> dataloaders -> engine.fit."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddlefleetx_tpu.core.engine import Engine
+from paddlefleetx_tpu.core.module import build_module
+from paddlefleetx_tpu.data.builders import build_dataloader
+from paddlefleetx_tpu.parallel.env import init_dist_env
+from paddlefleetx_tpu.utils.config import get_config, parse_args
+from paddlefleetx_tpu.utils.log import advertise, logger
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    cfg = get_config(args.config, overrides=args.override)
+    advertise()
+
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        ckpt_dir = cfg.Engine.save_load.get("ckpt_dir")
+        if ckpt_dir:
+            engine.load(ckpt_dir)
+        # loaders built after load so the sampler resumes the data order
+        # from the checkpoint's consumed_samples
+        train_loader = build_dataloader(
+            cfg, "Train", consumed_samples=engine._consumed_samples
+        )
+        eval_loader = (
+            build_dataloader(cfg, "Eval") if "Eval" in cfg.get("Data", {}) else None
+        )
+        engine.fit(train_loader, eval_loader)
+        if cfg.Engine.save_load.get("save_steps"):
+            engine.save()
+
+
+if __name__ == "__main__":
+    main()
