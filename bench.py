@@ -1,0 +1,108 @@
+"""Benchmark: GPT-345M pretrain throughput (tokens/s) on the local device(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: reference PaddleFleetX GPT-345M single-card pretrain ~16,260
+tokens/s on 1x V100-32G (BASELINE.md / projects/gpt/docs/single_card.md:40-49).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_TOKENS_PER_S = 16260.0
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from paddlefleetx_tpu.core.engine import Engine
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    n_dev = jax.device_count()
+    batch = int(os.environ.get("BENCH_BATCH", 16)) * n_dev
+    seq = int(os.environ.get("BENCH_SEQ", 1024))
+    steps = int(os.environ.get("BENCH_STEPS", 10))
+
+    cfg = AttrDict.from_nested(
+        {
+            "Global": {"global_batch_size": batch, "micro_batch_size": batch // n_dev, "seed": 1024},
+            "Engine": {
+                "max_steps": steps,
+                "eval_freq": 0,
+                "logging_freq": 10**9,
+                "mix_precision": {"enable": True, "dtype": "bfloat16"},
+                "save_load": {"save_steps": 0},
+            },
+            "Model": {
+                "module": "GPTModule",
+                "vocab_size": 50304,
+                "hidden_size": 1024,
+                "num_layers": 24,
+                "num_attention_heads": 16,
+                "max_position_embeddings": seq,
+                "hidden_dropout_prob": 0.1,
+                "attention_probs_dropout_prob": 0.1,
+                "attn_impl": os.environ.get("BENCH_ATTN", "flash"),
+                # 16GB v5e HBM: full-layer remat keeps only layer-boundary
+                # activations (the reference's 1.3B recipe does the same on
+                # 32GB V100s, hybrid_parallel.md:47-54)
+                "use_recompute": os.environ.get("BENCH_RECOMPUTE", "1") == "1",
+                "recompute_granularity": "full",
+            },
+            "Distributed": {},
+            "Optimizer": {
+                "name": "FusedAdamW",
+                "weight_decay": 0.01,
+                "beta1": 0.9,
+                "beta2": 0.95,
+                "lr": {"name": "Constant", "learning_rate": 1e-4},
+                "grad_clip": {"name": "ClipGradByGlobalNorm", "clip_norm": 1.0},
+            },
+        }
+    )
+    cfg = process_configs(cfg, num_devices=n_dev)
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+
+    rng = np.random.default_rng(0)
+    host_batch = {
+        "tokens": rng.integers(0, 50304, (batch, seq)).astype(np.int64),
+        "labels": rng.integers(0, 50304, (batch, seq)).astype(np.int64),
+        "loss_mask": np.ones((batch, seq), np.float32),
+        "position_ids": np.tile(np.arange(seq), (batch, 1)),
+    }
+
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        dev_batch = engine._put_batch(host_batch)
+        # warmup (compile)
+        for _ in range(3):
+            engine.state, m = engine._train_step(engine.state, dev_batch)
+        jax.block_until_ready(m["loss"])
+        t0 = time.time()
+        for _ in range(steps):
+            engine.state, m = engine._train_step(engine.state, dev_batch)
+        jax.block_until_ready(m["loss"])
+        dt = time.time() - t0
+
+    tokens_per_s = batch * seq * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "gpt345m_pretrain_throughput_per_chip",
+                "value": round(tokens_per_s / n_dev, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(tokens_per_s / n_dev / BASELINE_TOKENS_PER_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

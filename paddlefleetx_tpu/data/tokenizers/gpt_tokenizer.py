@@ -1,0 +1,122 @@
+"""GPT byte-level BPE tokenizer (reference ppfleetx/data/tokenizers/
+gpt_tokenizer.py, 819 LoC wrapping the standard GPT-2 BPE).
+
+From-scratch implementation of the standard algorithm: reversible
+byte->unicode mapping, greedy pair merging by learned rank, GPT-2 word
+pattern.  Loads the usual ``vocab.json`` + ``merges.txt`` artifacts.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import regex as re
+
+from paddlefleetx_tpu.utils.registry import TOKENIZERS
+
+_WORD_PAT = re.compile(
+    r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
+)
+
+
+@functools.lru_cache()
+def bytes_to_unicode() -> Dict[int, str]:
+    """Reversible byte->printable-unicode map (standard GPT-2 construction:
+    printable ASCII/latin bytes map to themselves, the rest to 256+n)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+def _get_pairs(word: Tuple[str, ...]) -> set:
+    return {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+
+
+@TOKENIZERS.register("GPTTokenizer")
+class GPTTokenizer:
+    def __init__(self, vocab_file: str, merges_file: str, eos_token: str = "<|endoftext|>"):
+        with open(vocab_file) as f:
+            self.encoder: Dict[str, int] = json.load(f)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        with open(merges_file, encoding="utf-8") as f:
+            merges = [
+                tuple(line.split())
+                for line in f.read().split("\n")
+                if line and not line.startswith("#version")
+            ]
+        self.bpe_ranks = {m: i for i, m in enumerate(merges)}
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self.cache: Dict[str, str] = {}
+        self.eos_token = eos_token
+        self.eos_token_id = self.encoder.get(eos_token)
+        self.pad_token_id = self.eos_token_id
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.encoder)
+
+    def _bpe(self, token: str) -> str:
+        if token in self.cache:
+            return self.cache[token]
+        word: Tuple[str, ...] = tuple(token)
+        pairs = _get_pairs(word)
+        if not pairs:
+            return token
+        while True:
+            pair = min(pairs, key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if pair not in self.bpe_ranks:
+                break
+            a, b = pair
+            new_word: List[str] = []
+            i = 0
+            while i < len(word):
+                try:
+                    j = word.index(a, i)
+                except ValueError:
+                    new_word.extend(word[i:])
+                    break
+                new_word.extend(word[i:j])
+                i = j
+                if i < len(word) - 1 and word[i] == a and word[i + 1] == b:
+                    new_word.append(a + b)
+                    i += 2
+                else:
+                    new_word.append(word[i])
+                    i += 1
+            word = tuple(new_word)
+            if len(word) == 1:
+                break
+            pairs = _get_pairs(word)
+        out = " ".join(word)
+        self.cache[token] = out
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for tok in re.findall(_WORD_PAT, text):
+            mapped = "".join(self.byte_encoder[b] for b in tok.encode("utf-8"))
+            ids.extend(self.encoder[t] for t in self._bpe(mapped).split(" "))
+        return ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        text = "".join(self.decoder[int(i)] for i in ids if int(i) in self.decoder)
+        return bytearray(self.byte_decoder[c] for c in text).decode("utf-8", errors="replace")
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "GPTTokenizer":
+        """Load from a directory with vocab.json + merges.txt."""
+        return cls(os.path.join(path, "vocab.json"), os.path.join(path, "merges.txt"))

@@ -1,0 +1,238 @@
+"""GPT autoregressive generation: KV-cache decode + logits processors.
+
+Reference: ``GPTForGeneration`` (single_model.py:898-1320 — prepare inputs,
+logits processors, per-token sample loop with incremental KV-cache decode)
+and ``processor.py`` (LogitsProcessorList etc.).
+
+TPU-native shape discipline: the reference's dynamic Python while-loop
+becomes a static ``lax.scan`` over ``max_dec_len`` slots with an
+``unfinished`` flag (padded static shapes; XLA traces one step).  The KV
+cache is a preallocated [layers, b, max_len, heads, head_dim] pair updated
+with ``dynamic_update_slice``; prefill packs the prompt in one forward.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.models.gpt.config import GPTConfig
+from paddlefleetx_tpu.models.gpt.model import layer_norm
+from paddlefleetx_tpu.ops.attention import xla_attention
+from paddlefleetx_tpu.ops.sampling import sample_logits
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [layers, b, max_len, heads, head_dim]
+    v: jax.Array
+
+
+def init_cache(cfg: GPTConfig, batch: int, max_len: int, dtype=None) -> KVCache:
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (cfg.num_layers, batch, max_len, cfg.num_attention_heads, cfg.head_dim)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+# ---------------------------------------------------------------------------
+# Cache-aware forward (shares weights with model.gpt_specs; the training
+# forward in model.py stays cache-free)
+# ---------------------------------------------------------------------------
+
+
+def _layer_with_cache(
+    p: Dict[str, Any],
+    x: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    pos: jax.Array,
+    cfg: GPTConfig,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder layer over x [b, t, h] writing K/V at offset ``pos``.
+
+    Attends over cache[:pos+t] (left-padded garbage masked by position).
+    """
+    dtype = x.dtype
+    b, t, h = x.shape
+    max_len = k_cache.shape[1]
+
+    y = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
+    qkv = jnp.einsum("bsh,htnd->bstnd", y, p["attn"]["qkv_kernel"].astype(dtype))
+    qkv = qkv + p["attn"]["qkv_bias"].astype(dtype)[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+
+    # bias: query i (global pos+i) attends keys j <= pos+i, j < pos+t valid
+    q_pos = pos + jnp.arange(t)[:, None]
+    k_pos = jnp.arange(max_len)[None, :]
+    bias = jnp.where(k_pos <= q_pos, 0.0, -1e9)[None, None, :, :]  # [1,1,t,max]
+
+    attn_out = xla_attention(q, k_cache, v_cache, causal=False, bias=bias)
+    attn_out = jnp.einsum(
+        "bsnd,ndh->bsh", attn_out, p["attn"]["out_kernel"].astype(dtype)
+    ) + p["attn"]["out_bias"].astype(dtype)
+    x = x + attn_out
+
+    y = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
+    mp = p["mlp"]
+    y = y @ mp["fc_in_kernel"].astype(dtype) + mp["fc_in_bias"].astype(dtype)
+    y = jax.nn.gelu(y, approximate=True)
+    y = y @ mp["fc_out_kernel"].astype(dtype) + mp["fc_out_bias"].astype(dtype)
+    return x + y, k_cache, v_cache
+
+
+def forward_cached(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cache: KVCache,
+    pos: jax.Array,
+    cfg: GPTConfig,
+) -> Tuple[jax.Array, KVCache]:
+    """tokens [b, t] at positions [pos, pos+t) -> (logits [b, t, v], cache)."""
+    dtype = jnp.dtype(cfg.dtype)
+    b, t = tokens.shape
+    word = params["embeddings"]["word"].astype(dtype)
+    pe = params["embeddings"]["position"].astype(dtype)
+    positions = pos + jnp.arange(t)
+    x = word[tokens] + pe[positions][None, :, :]
+
+    def body(x, inp):
+        p_l, kc, vc = inp
+        x, kc, vc = _layer_with_cache(p_l, x, kc, vc, pos, cfg)
+        return x, (kc, vc)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
+    logits = jnp.einsum("bsh,vh->bsv", x, word)
+    return logits, KVCache(ks, vs)
+
+
+# ---------------------------------------------------------------------------
+# Logits processors (reference processor.py)
+# ---------------------------------------------------------------------------
+
+
+def apply_repetition_penalty(logits, generated_mask_counts, penalty: float):
+    """Divide positive / multiply negative logits of already-generated tokens
+    (reference RepetitionPenaltyLogitsProcessor)."""
+    if penalty == 1.0:
+        return logits
+    seen = generated_mask_counts > 0
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
+def apply_min_length(logits, cur_len, min_len: int, eos_token_id: int):
+    """Suppress EOS before min_length (reference MinLengthLogitsProcessor)."""
+    if min_len <= 0:
+        return logits
+    return jnp.where(
+        (cur_len < min_len)[..., None]
+        & (jnp.arange(logits.shape[-1]) == eos_token_id)[None, :],
+        -1e10,
+        logits,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Generation loop
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Reference GPTForGeneration config surface (single_model.py:898-960)."""
+
+    max_dec_len: int = 64
+    min_dec_len: int = 1
+    decode_strategy: str = "sampling"  # sampling | greedy_search
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    repetition_penalty: float = 1.0
+    eos_token_id: int = 50256
+    pad_token_id: int = 0
+
+
+def generate(
+    params: Dict[str, Any],
+    input_ids: jax.Array,
+    cfg: GPTConfig,
+    gen: GenerationConfig,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """input_ids [b, prompt_len] (right-aligned, no padding) ->
+    generated ids [b, max_dec_len] (eos/pad-filled after finish)."""
+    b, prompt_len = input_ids.shape
+    max_len = prompt_len + gen.max_dec_len
+    if max_len > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt_len {prompt_len} + max_dec_len {gen.max_dec_len} exceeds "
+            f"max_position_embeddings {cfg.max_position_embeddings}"
+        )
+    if key is None:
+        key = jax.random.key(0)
+
+    cache = init_cache(cfg, b, max_len)
+    vocab = cfg.vocab_size
+    token_counts0 = jnp.zeros((b, vocab), jnp.int32).at[
+        jnp.arange(b)[:, None], input_ids
+    ].add(1)
+
+    # prefill: cache K/V for the prompt; its last-row logits seed the loop
+    logits, cache = forward_cached(params, input_ids, cache, jnp.int32(0), cfg)
+    last_logits = logits[:, -1, :].astype(jnp.float32)
+
+    class Carry(NamedTuple):
+        cache: KVCache
+        logits: jax.Array  # [b, vocab] — logits of the position to sample
+        pos: jax.Array
+        unfinished: jax.Array  # [b] bool
+        token_counts: jax.Array
+        key: jax.Array
+
+    def step(carry: Carry, i):
+        logits = apply_min_length(
+            carry.logits, jnp.full((b,), i), gen.min_dec_len, gen.eos_token_id
+        )
+        logits = apply_repetition_penalty(
+            logits, carry.token_counts, gen.repetition_penalty
+        )
+        key, sub = jax.random.split(carry.key)
+        if gen.decode_strategy == "greedy_search":
+            nxt = jnp.argmax(logits, axis=-1)
+        else:
+            nxt = sample_logits(
+                sub, logits, temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p
+            )
+        nxt = jnp.where(carry.unfinished, nxt, gen.pad_token_id)
+        unfinished = carry.unfinished & (nxt != gen.eos_token_id)
+        counts = carry.token_counts.at[jnp.arange(b), nxt].add(1)
+        new_logits, cache = forward_cached(
+            params, nxt[:, None], carry.cache, carry.pos, cfg
+        )
+        new_carry = Carry(
+            cache=cache,
+            logits=new_logits[:, -1, :].astype(jnp.float32),
+            pos=carry.pos + 1,
+            unfinished=unfinished,
+            token_counts=counts,
+            key=key,
+        )
+        return new_carry, nxt
+
+    carry0 = Carry(
+        cache=cache,
+        logits=last_logits,
+        pos=jnp.int32(prompt_len),
+        unfinished=jnp.ones((b,), bool),
+        token_counts=token_counts0,
+        key=key,
+    )
+    carry, tokens = jax.lax.scan(step, carry0, jnp.arange(gen.max_dec_len))
+    return tokens.T  # [b, max_dec_len]

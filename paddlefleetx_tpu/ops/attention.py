@@ -69,15 +69,21 @@ def attention(
 ) -> jax.Array:
     """Dispatching attention entry point used by all models."""
     if impl == "flash" and bias is None and causal:
-        from paddlefleetx_tpu.ops.flash_attention import flash_attention
+        from paddlefleetx_tpu.ops.flash_attention import flash_attention, flash_supported
 
-        out = flash_attention(q, k, v, causal=True)
-        if train and dropout_rate > 0.0 and dropout_key is not None:
-            # flash path folds dropout into the output (attn-prob dropout is
-            # not expressible post-hoc; reference disables dropout with flash
-            # attention too — hybrid_model.py:284-301 passes no dropout)
-            pass
-        return out
+        if not flash_supported(q.shape[1]):
+            # odd sequence lengths fall back to the XLA path (one warning)
+            import warnings
+
+            warnings.warn(
+                f"flash attention unsupported for seq={q.shape[1]}; using XLA path",
+                stacklevel=2,
+            )
+        else:
+            # NB: attention-prob dropout is skipped on the flash path (the
+            # reference likewise disables dropout when flash is active,
+            # hybrid_model.py:284-301)
+            return flash_attention(q, k, v, causal=True)
     return xla_attention(
         q,
         k,

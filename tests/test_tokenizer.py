@@ -1,0 +1,41 @@
+"""Tokenizer tests with a tiny constructed BPE vocab; round-trip always holds
+regardless of merges (byte-level)."""
+
+import json
+
+import pytest
+
+from paddlefleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer, bytes_to_unicode
+
+
+@pytest.fixture
+def tok(tmp_path):
+    b2u = bytes_to_unicode()
+    # base vocab: all 256 byte symbols + a couple of merges + eos
+    symbols = [b2u[b] for b in range(256)]
+    merges = [("h", "e"), ("l", "l"), ("he", "ll"), ("hell", "o")]
+    for a, b in merges:
+        symbols.append(a + b)
+    symbols.append("<|endoftext|>")
+    vocab = {s: i for i, s in enumerate(dict.fromkeys(symbols))}
+    (tmp_path / "vocab.json").write_text(json.dumps(vocab))
+    (tmp_path / "merges.txt").write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges)
+    )
+    return GPTTokenizer.from_pretrained(str(tmp_path))
+
+
+def test_roundtrip(tok):
+    for text in ["hello world", "hello", "a b  c\nd", "héllo ☂"]:
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_merges_applied(tok):
+    ids = tok.encode("hello")
+    # 'hello' fully merges into one token
+    assert len(ids) == 1
+    assert tok.decoder[ids[0]] == "hello"
+
+
+def test_eos(tok):
+    assert tok.eos_token_id == tok.encoder["<|endoftext|>"]

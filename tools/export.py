@@ -1,0 +1,49 @@
+"""Export entry point (reference tools/export.py:33-50): stage the model's
+forward to a serialized StableHLO artifact + params checkpoint."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.core.module import build_module
+from paddlefleetx_tpu.parallel.env import init_dist_env
+from paddlefleetx_tpu.parallel.seed import get_seed_tracker
+from paddlefleetx_tpu.utils.config import get_config, parse_args
+from paddlefleetx_tpu.utils.export import export_inference_model
+from paddlefleetx_tpu.utils.log import logger
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    cfg = get_config(args.config, overrides=args.override)
+    init_dist_env(cfg)
+    module = build_module(cfg)
+
+    params = module.init_params(get_seed_tracker().params_key())
+    ckpt_dir = cfg.Engine.save_load.get("ckpt_dir")
+    if ckpt_dir:
+        import orbax.checkpoint as ocp
+
+        restored = ocp.StandardCheckpointer().restore(
+            os.path.join(os.path.abspath(ckpt_dir), "state")
+        )
+        params = restored["params"]
+
+    from paddlefleetx_tpu.models.gpt import model as gpt
+
+    mcfg = module.config
+    seq = int(cfg.get("Data", {}).get("Train", {}).get("dataset", {}).get("max_seq_len", mcfg.max_position_embeddings))
+    tokens = jnp.zeros((1, seq), jnp.int32)
+
+    def fwd(params, tokens):
+        return gpt.forward(params, tokens, mcfg, train=False)
+
+    out_dir = cfg.Engine.save_load.get("output_dir", "./output")
+    export_inference_model(fwd, (tokens,), params, os.path.join(out_dir, "inference"))
+
+
+if __name__ == "__main__":
+    main()
