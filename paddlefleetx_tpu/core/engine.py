@@ -113,8 +113,23 @@ class Engine:
             fsdp_enabled=sharding_stage >= 2
             or int(dist.get("sharding", {}).get("sharding_degree", 1)) > 1,
             sequence_parallel=bool(dist.get("sequence_parallel", False)),
+            mesh=mesh,
+            num_experts=int(getattr(getattr(module, "config", None), "num_experts", 0) or 0),
         )
-        self.ctx = ShardingCtx(mesh, self.rules)
+        pp_degree = int(dist.get("pp_degree", 1))
+        pipeline = None
+        if pp_degree > 1:
+            from paddlefleetx_tpu.parallel.pipeline import PipelineConfig
+
+            # pipeline microbatches default to the stage count (reference
+            # accumulate_steps >= pp semantics); batch must divide
+            pipeline = PipelineConfig(
+                num_stages=pp_degree,
+                num_microbatches=int(
+                    dist.get("pipeline", {}).get("micro_batches", pp_degree)
+                ),
+            )
+        self.ctx = ShardingCtx(mesh, self.rules, pipeline=pipeline)
 
         # token/sample-counted schedules (use_increments) are scaled inside
         # build_optimizer so optax's per-step count yields the right lr

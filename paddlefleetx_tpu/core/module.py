@@ -59,8 +59,11 @@ class GPTModule(BasicModule):
         model_cfg.pop("module", None)
         model_cfg.pop("name", None)
         mix = cfg.get("Engine", {}).get("mix_precision", {})
-        if mix.get("enable", True) and "dtype" not in model_cfg:
-            model_cfg["dtype"] = mix.get("dtype", "bfloat16")
+        if "dtype" not in model_cfg:
+            # mix disabled == O0: fp32 compute (reference amp levels)
+            model_cfg["dtype"] = (
+                mix.get("dtype", "bfloat16") if mix.get("enable", True) else "float32"
+            )
         dist = cfg.get("Distributed", {})
         if dist.get("sequence_parallel", False):
             model_cfg["sequence_parallel"] = True
@@ -86,6 +89,51 @@ class GPTModule(BasicModule):
         return gpt.loss_fn(
             params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
         )
+
+
+@MODULES.register("GeneralClsModule")
+@MODULES.register("ViTModule")
+class ViTModule(BasicModule):
+    """ViT / general image classification (reference
+    GeneralClsModule general_classification_module.py + vit modules)."""
+
+    def __init__(self, cfg):
+        from paddlefleetx_tpu.models.vit.model import ViTConfig
+
+        model_cfg = dict(cfg.Model)
+        model_cfg.pop("module", None)
+        model_cfg.pop("name", None)
+        mix = cfg.get("Engine", {}).get("mix_precision", {})
+        if "dtype" not in model_cfg:
+            model_cfg["dtype"] = (
+                mix.get("dtype", "bfloat16") if mix.get("enable", True) else "float32"
+            )
+        self.config = ViTConfig.from_config(model_cfg)
+        self.label_smoothing = float(model_cfg.get("label_smoothing", 0.0))
+        self.tokens_per_sample = self.config.num_patches + 1  # ips = patches/s
+
+    def init_params(self, key):
+        from paddlefleetx_tpu.models import vit
+
+        return vit.init(self.config, key)
+
+    def logical_axes(self):
+        from paddlefleetx_tpu.models import vit
+
+        return vit.vit_logical_axes(self.config)
+
+    def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=True):
+        from paddlefleetx_tpu.models import vit
+
+        logits = vit.forward(
+            params,
+            batch["images"],
+            self.config,
+            ctx=ctx,
+            dropout_key=dropout_key,
+            train=train,
+        )
+        return vit.cls_loss(logits, batch["labels"], self.label_smoothing)
 
 
 def build_module(cfg) -> BasicModule:

@@ -1,0 +1,105 @@
+"""Vision datasets (reference ppfleetx/data/dataset/vision_dataset.py:33-426:
+GeneralClsDataset / ImageFolder / CIFAR10 / ContrastiveLearningDataset).
+
+Host-side numpy pipelines; images flow to devices as [b, H, W, C] float32
+batches (normalisation folded in here, augmentation kept minimal and
+composable)."""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlefleetx_tpu.utils.registry import DATASETS
+
+IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+
+def normalize(img: np.ndarray) -> np.ndarray:
+    return (img.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+
+
+def random_flip(img: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+    return img[:, ::-1] if rng.random() < 0.5 else img
+
+
+@DATASETS.register("GeneralClsDataset")
+class GeneralClsDataset:
+    """Image-list file dataset (reference :33): each line
+    ``relative/path.jpg<sep>label``."""
+
+    def __init__(
+        self,
+        image_root: str,
+        cls_label_path: str,
+        mode: str = "Train",
+        transform_ops=None,
+        delimiter: str = " ",
+        **_unused,
+    ):
+        self.root = image_root
+        self.train = mode == "Train"
+        self.samples: List[Tuple[str, int]] = []
+        with open(cls_label_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                path, label = line.rsplit(delimiter, 1)
+                self.samples.append((path, int(label)))
+        self.rng = np.random.default_rng(0)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def _load(self, path: str) -> np.ndarray:
+        full = os.path.join(self.root, path)
+        if full.endswith(".npy"):
+            return np.load(full)
+        from PIL import Image  # lazy: PIL only needed for real image files
+
+        return np.asarray(Image.open(full).convert("RGB"))
+
+    def __getitem__(self, idx: int):
+        path, label = self.samples[idx]
+        img = self._load(path)
+        if self.train:
+            img = random_flip(img, self.rng)
+        return {"images": normalize(img), "labels": np.int64(label)}
+
+
+@DATASETS.register("SyntheticClsDataset")
+class SyntheticClsDataset:
+    """Class-conditional synthetic images (tests/benches): each class is a
+    distinct mean pattern + noise, so accuracy is learnable."""
+
+    def __init__(
+        self,
+        num_samples: int = 512,
+        image_size: int = 32,
+        num_classes: int = 8,
+        seed: int = 0,
+        mode: str = "Train",
+        **_unused,
+    ):
+        self.n = num_samples
+        self.size = image_size
+        self.classes = num_classes
+        rng = np.random.default_rng(seed)
+        self.patterns = rng.normal(0, 1, (num_classes, image_size, image_size, 3)).astype(
+            np.float32
+        )
+        self.labels = rng.integers(0, num_classes, num_samples)
+        self.seed = seed
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, idx: int):
+        rng = np.random.default_rng(self.seed * 100003 + idx)
+        label = int(self.labels[idx])
+        img = self.patterns[label] + 0.5 * rng.normal(0, 1, self.patterns[label].shape)
+        return {"images": img.astype(np.float32), "labels": np.int64(label)}

@@ -168,6 +168,8 @@ def generate(
 ) -> jax.Array:
     """input_ids [b, prompt_len] (right-aligned, no padding) ->
     generated ids [b, max_dec_len] (eos/pad-filled after finish)."""
+    if cfg.num_experts > 1:
+        raise NotImplementedError("KV-cache generation for MoE models unsupported")
     b, prompt_len = input_ids.shape
     max_len = prompt_len + gen.max_dec_len
     if max_len > cfg.max_position_embeddings:

@@ -45,10 +45,14 @@ from paddlefleetx_tpu.ops.attention import attention
 
 @dataclasses.dataclass(frozen=True)
 class ShardingCtx:
-    """Optional activation-sharding context (mesh + logical rules)."""
+    """Optional activation-sharding context (mesh + logical rules).
+
+    ``pipeline`` switches the transformer stack from plain scan-over-layers
+    to the stage-pipelined schedule (parallel/pipeline.py)."""
 
     mesh: Any
     rules: Tuple[Tuple[str, Any], ...]
+    pipeline: Any = None  # Optional[PipelineConfig]
 
     def constrain(self, x: jax.Array, logical: Tuple[Optional[str], ...]) -> jax.Array:
         from paddlefleetx_tpu.parallel.sharding import with_logical_constraint
@@ -90,6 +94,10 @@ def _layer_specs(cfg: GPTConfig) -> Dict[str, Any]:
             "fc_out_bias": ParamSpec((h,), ("embed",), zeros_init()),
         },
     }
+    if cfg.num_experts > 1:
+        from paddlefleetx_tpu.models.gpt.moe import moe_layer_specs
+
+        specs["mlp"] = moe_layer_specs(cfg)
     return specs
 
 
@@ -148,6 +156,27 @@ def _attention_block(
     qkv = jnp.einsum("bsh,htnd->bstnd", x, p["qkv_kernel"].astype(dtype))
     qkv = qkv + p["qkv_bias"].astype(dtype)[None, None]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    if cfg.attn_impl == "ring" and ctx is not None:
+        # context parallelism: seq stays sep-sharded; K/V rotate the ring.
+        # NB: attention-prob dropout is skipped here, like the flash path
+        # (reference disables dropout under flash too, hybrid_model.py:284)
+        from paddlefleetx_tpu.parallel.ring_attention import ring_attention
+
+        q = _constrain(ctx, q, ("batch", "seq", "heads", "kv"))
+        ring = ring_attention
+        if cfg.use_recompute and cfg.recompute_granularity == "core_attn":
+            ring = jax.checkpoint(
+                lambda q, k, v, mesh=ctx.mesh: ring_attention(q, k, v, mesh, causal=True)
+            )
+            out = ring(q, k, v)
+        else:
+            out = ring(q, k, v, ctx.mesh, causal=True)
+        out = jnp.einsum("bsnd,ndh->bsh", out, p["out_kernel"].astype(dtype))
+        out = out + p["out_bias"].astype(dtype)
+        return dropout(k_resid, out, cfg.hidden_dropout_prob, train)
+
+    # Ulysses/TP reshard: heads spread over (model, sep), seq gathered
     q = _constrain(ctx, q, ("batch", None, "heads", "kv"))
 
     def core(q, k, v, dk):
@@ -180,14 +209,19 @@ def _mlp_block(
     ctx: Optional[ShardingCtx],
     key: Optional[jax.Array],
     train: bool,
-) -> jax.Array:
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (out, moe_aux_loss); aux is 0 for the dense FFN."""
+    if cfg.num_experts > 1:
+        from paddlefleetx_tpu.models.gpt.moe import moe_mlp_block
+
+        return moe_mlp_block(p, x, cfg, ctx, key, train)
     dtype = x.dtype
     h = x @ p["fc_in_kernel"].astype(dtype) + p["fc_in_bias"].astype(dtype)
     h = _constrain(ctx, h, ("batch", None, "mlp"))
     h = jax.nn.gelu(h, approximate=True)
     h = h @ p["fc_out_kernel"].astype(dtype) + p["fc_out_bias"].astype(dtype)
     h = dropout(key, h, cfg.hidden_dropout_prob, train)
-    return h
+    return h, jnp.zeros((), jnp.float32)
 
 
 def _decoder_layer(
@@ -197,7 +231,7 @@ def _decoder_layer(
     ctx: Optional[ShardingCtx],
     key: Optional[jax.Array],
     train: bool,
-) -> jax.Array:
+) -> Tuple[jax.Array, jax.Array]:
     """Pre-LN decoder block (reference TransformerDecoderLayer
     single_model.py:406: x + attn(ln(x)); x + mlp(ln(x)))."""
     k_attn, k_mlp = (jax.random.split(key) if key is not None else (None, None))
@@ -214,9 +248,9 @@ def _decoder_layer(
     x = _constrain(ctx, x, ("batch", "seq", "embed"))
 
     y = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
-    y = _mlp_block(p["mlp"], y, cfg, ctx, k_mlp, train)
+    y, aux = _mlp_block(p["mlp"], y, cfg, ctx, k_mlp, train)
     x = x + y
-    return _constrain(ctx, x, ("batch", "seq", "embed"))
+    return _constrain(ctx, x, ("batch", "seq", "embed")), aux
 
 
 def transformer_stack(
@@ -226,21 +260,63 @@ def transformer_stack(
     ctx: Optional[ShardingCtx],
     key: Optional[jax.Array],
     train: bool,
-) -> jax.Array:
-    """lax.scan over stacked layer params."""
+) -> Tuple[jax.Array, jax.Array]:
+    """Stacked-layer body: lax.scan (accumulating MoE aux losses), or the
+    stage pipeline when enabled.  Returns (hidden, aux_loss_sum)."""
+
+    if ctx is not None and ctx.pipeline is not None and ctx.pipeline.num_stages > 1:
+        if cfg.num_experts > 1:
+            # reference parity: MoE requires pp==1 (HybridCommGroupForMoE
+            # asserts, comm_groups.py:150)
+            raise NotImplementedError("MoE with pipeline parallelism unsupported")
+        from paddlefleetx_tpu.parallel.pipeline import pipelined_stack
+
+        S = ctx.pipeline.num_stages
+        if cfg.num_layers % S:
+            raise ValueError(f"num_layers {cfg.num_layers} not divisible by stages {S}")
+        per_stage = cfg.num_layers // S
+
+        def stage_fn(local_params, x_mb, stage):
+            def sbody(carry, inp):
+                params_l, local_idx = inp
+                # dropout key folds on the GLOBAL layer index so pp layouts
+                # reproduce the non-pp dropout pattern
+                k = (
+                    jax.random.fold_in(key, stage * per_stage + local_idx)
+                    if key is not None
+                    else None
+                )
+                out, _aux = _decoder_layer(params_l, carry, cfg, ctx, k, train)
+                return out, None
+
+            sbody_fn = sbody
+            if cfg.use_recompute and cfg.recompute_granularity == "full":
+                sbody_fn = jax.checkpoint(sbody)
+            x_mb, _ = jax.lax.scan(
+                sbody_fn, x_mb, (local_params, jnp.arange(per_stage))
+            )
+            return x_mb
+
+        return (
+            pipelined_stack(stage_fn, layers_params, x, ctx.pipeline, ctx.mesh),
+            jnp.zeros((), jnp.float32),
+        )
 
     def body(carry, inp):
+        x, aux_sum = carry
         params_l, idx = inp
         k = jax.random.fold_in(key, idx) if key is not None else None
-        out = _decoder_layer(params_l, carry, cfg, ctx, k, train)
-        return out, None
+        out, aux = _decoder_layer(params_l, x, cfg, ctx, k, train)
+        return (out, aux_sum + aux), None
 
     body_fn = body
     if cfg.use_recompute and cfg.recompute_granularity == "full":
         body_fn = jax.checkpoint(body)
 
-    x, _ = jax.lax.scan(body_fn, x, (layers_params, jnp.arange(cfg.num_layers)))
-    return x
+    (x, aux), _ = jax.lax.scan(
+        body_fn, (x, jnp.zeros((), jnp.float32)), (layers_params, jnp.arange(cfg.num_layers))
+    )
+    return x, aux
 
 
 def forward_hidden(
@@ -252,8 +328,8 @@ def forward_hidden(
     ctx: Optional[ShardingCtx] = None,
     dropout_key: Optional[jax.Array] = None,
     train: bool = False,
-) -> jax.Array:
-    """Token ids [b, s] -> final hidden states [b, s, h] (after final LN)."""
+) -> Tuple[jax.Array, jax.Array]:
+    """Token ids [b, s] -> (final hidden [b, s, h], moe aux loss sum)."""
     dtype = jnp.dtype(cfg.dtype)
     b, s = input_ids.shape
     if position_ids is None:
@@ -269,9 +345,9 @@ def forward_hidden(
     x = _constrain(ctx, x, ("batch", "seq", "embed"))
     x = dropout(k_embed, x, cfg.hidden_dropout_prob, train)
 
-    x = transformer_stack(params["layers"], x, cfg, ctx, k_layers, train)
+    x, aux = transformer_stack(params["layers"], x, cfg, ctx, k_layers, train)
     x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
-    return _constrain(ctx, x, ("batch", "seq", "embed"))
+    return _constrain(ctx, x, ("batch", "seq", "embed")), aux
 
 
 def logits_from_hidden(
@@ -293,7 +369,7 @@ def forward(
     dropout_key: Optional[jax.Array] = None,
     train: bool = False,
 ) -> jax.Array:
-    hidden = forward_hidden(
+    hidden, _ = forward_hidden(
         params,
         input_ids,
         cfg,
@@ -338,8 +414,11 @@ def loss_fn(
     dropout_key: Optional[jax.Array] = None,
     train: bool = True,
 ) -> jax.Array:
-    """batch: tokens [b,s], labels [b,s], loss_mask [b,s], position_ids opt."""
-    logits = forward(
+    """batch: tokens [b,s], labels [b,s], loss_mask [b,s], position_ids opt.
+
+    MoE models add the load-balance aux loss scaled by moe_aux_loss_weight
+    (reference sharded_moe.py l_aux handling)."""
+    hidden, aux = forward_hidden(
         params,
         batch["tokens"],
         cfg,
@@ -348,4 +427,8 @@ def loss_fn(
         dropout_key=dropout_key,
         train=train,
     )
-    return cross_entropy(logits, batch["labels"], batch.get("loss_mask"))
+    logits = logits_from_hidden(params, hidden, ctx)
+    loss = cross_entropy(logits, batch["labels"], batch.get("loss_mask"))
+    if cfg.num_experts > 1:
+        loss = loss + cfg.moe_aux_loss_weight * aux
+    return loss

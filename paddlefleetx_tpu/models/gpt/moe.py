@@ -1,0 +1,157 @@
+"""Mixture-of-Experts FFN: gating, capacity, dispatch/combine.
+
+TPU-native consolidation of the reference's TWO MoE stacks
+(fastmoe-style ``MoELayer`` models/language_model/moe/ — alltoall
+MoEScatter/MoEGather + per-expert loop; deepspeed-style ``moe_exp/``
+sharded_moe.py:300-379 — TopKGate with capacity factor, token dropping,
+load-balance aux loss): one fixed-capacity dense formulation.
+
+Shape discipline (SURVEY §7.3: "MoE capacity/token-drop numerics under jit
+need a fixed-capacity dense formulation"): dispatch/combine are dense
+[tokens, experts, capacity] einsum masks — no dynamic shapes; dropped
+tokens fall out of the mask.  The expert dim is sharded over the expert
+group (``data``×``fsdp``×``sep``, mirroring HybridCommGroupForMoE's fused
+dp×mp group, comm_groups.py:149-153), so XLA inserts the alltoall the
+reference issues manually in MoEScatter/MoEGather (moe/comm_ops.py:28,74).
+
+Gates: ``naive`` (top-k renormalised, no aux), ``gshard`` (top-2 +
+load-balance aux), ``switch`` (top-1 + aux) — reference gate/*.py and
+sharded_moe.py TopKGate.
+
+Grad-clip parity note: the reference needs ``ClipGradForMOEByGlobalNorm``
+(optims/grad_clip.py:27-156) to allreduce expert-param norms over the moe
+group because expert params differ per rank; under GSPMD the param pytree
+is global, so plain optax.clip_by_global_norm already computes the same
+global norm.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.models.common import ParamSpec, normal_init, zeros_init
+
+
+def moe_layer_specs(cfg) -> Dict[str, Any]:
+    """Expert-parallel FFN param specs (drop-in for the dense 'mlp' subtree)."""
+    h, ffn, E = cfg.hidden_size, cfg.ffn_hidden_size, cfg.num_experts
+    w = normal_init(cfg.initializer_range)
+    return {
+        "gate_kernel": ParamSpec((h, E), ("embed", None), w),
+        "fc_in_kernel": ParamSpec((E, h, ffn), ("expert", "embed", "mlp"), w),
+        "fc_in_bias": ParamSpec((E, ffn), ("expert", "mlp"), zeros_init()),
+        "fc_out_kernel": ParamSpec((E, ffn, h), ("expert", "mlp", "embed"), w),
+        "fc_out_bias": ParamSpec((E, h), ("expert", "embed"), zeros_init()),
+    }
+
+
+def _top_k_positions(expert_mask: jax.Array) -> jax.Array:
+    """Position of each (token, choice) inside its expert's capacity buffer.
+
+    expert_mask: [N, k, E] one-hot.  Rank-0 choices get priority over rank-1
+    (GShard policy): positions count down the flattened (k-major) order.
+    Returns [N, k, E] int positions (-1 where not dispatched)."""
+    n, k, e = expert_mask.shape
+    flat = expert_mask.transpose(1, 0, 2).reshape(k * n, e)
+    pos_flat = jnp.cumsum(flat, axis=0) * flat - 1  # -1 where mask==0
+    return pos_flat.reshape(k, n, e).transpose(1, 0, 2).astype(jnp.int32)
+
+
+def effective_top_k(gate_type: str, top_k: int) -> int:
+    """switch is top-1 and gshard top-2 by definition (reference gate/*.py)."""
+    return {"switch": 1, "gshard": 2}.get(gate_type, top_k)
+
+
+def gate_and_dispatch(
+    x: jax.Array,  # [N, h] tokens
+    gate_logits: jax.Array,  # [N, E]
+    num_experts: int,
+    top_k: int,
+    capacity: int,
+    gate_type: str,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """-> (combine [N, E, C], dispatch bool [N, E, C], aux_loss scalar)."""
+    n = x.shape[0]
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    top_k = effective_top_k(gate_type, top_k)
+
+    top_w, top_idx = jax.lax.top_k(probs, top_k)  # [N, k]
+    if gate_type in ("gshard", "switch"):
+        # load-balance aux (GShard eq.: E * sum_e fraction_tokens_e * mean_prob_e)
+        top1_mask = jax.nn.one_hot(top_idx[:, 0], num_experts)
+        density = top1_mask.mean(axis=0)
+        density_proxy = probs.mean(axis=0)
+        aux = num_experts * jnp.sum(density * density_proxy)
+    else:
+        aux = jnp.zeros((), jnp.float32)
+
+    if top_k > 1:
+        # renormalise among chosen experts (GShard top-2)
+        top_w = top_w / jnp.maximum(top_w.sum(axis=-1, keepdims=True), 1e-9)
+    # top-1 (switch) keeps the RAW gate prob: scaling the expert output by it
+    # is the router's only task-loss gradient path (Switch Transformer)
+
+    expert_mask = jax.nn.one_hot(top_idx, num_experts, dtype=jnp.float32)  # [N,k,E]
+    pos = _top_k_positions(expert_mask)  # [N,k,E]
+    keep = (pos >= 0) & (pos < capacity)
+    pos = jnp.where(keep, pos, 0)
+
+    cap_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [N,k,E,C]
+    cap_onehot = cap_onehot * keep[..., None] * expert_mask[..., None]
+    combine = jnp.einsum("nk,nkec->nec", top_w, cap_onehot)
+    dispatch = combine > 0
+    return combine, dispatch, aux
+
+
+def moe_mlp_block(
+    p: Dict[str, Any],
+    x: jax.Array,  # [b, s, h]
+    cfg,
+    ctx,
+    key,
+    train: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Expert-parallel FFN.  Returns (out [b,s,h], aux loss scalar)."""
+    from paddlefleetx_tpu.models.common import dropout
+    from paddlefleetx_tpu.models.gpt.model import _constrain
+
+    dtype = x.dtype
+    b, s, h = x.shape
+    E = cfg.num_experts
+    k = effective_top_k(cfg.moe_gate, cfg.moe_top_k)
+    tokens = x.reshape(b * s, h)
+    n = b * s
+    capacity = max(int(math.ceil(n * k * cfg.moe_capacity_factor / E)), 4)
+
+    gate_logits = tokens.astype(jnp.float32) @ p["gate_kernel"].astype(jnp.float32)
+    combine, dispatch, aux = gate_and_dispatch(
+        tokens, gate_logits, E, k, capacity, cfg.moe_gate
+    )
+
+    # dispatch: [E, C, h] expert inputs (alltoall inserted by XLA when the
+    # expert axis sharding differs from the token axis sharding)
+    expert_in = jnp.einsum("nec,nh->ech", dispatch.astype(dtype), tokens)
+    expert_in = _constrain(ctx, expert_in, ("expert", None, "embed"))
+
+    def ffn(e_in, kern_in, b_in, kern_out, b_out):
+        y = e_in @ kern_in.astype(dtype) + b_in.astype(dtype)
+        y = jax.nn.gelu(y, approximate=True)
+        return y @ kern_out.astype(dtype) + b_out.astype(dtype)
+
+    expert_out = jax.vmap(ffn)(
+        expert_in,
+        p["fc_in_kernel"],
+        p["fc_in_bias"],
+        p["fc_out_kernel"],
+        p["fc_out_bias"],
+    )
+    expert_out = _constrain(ctx, expert_out, ("expert", None, "embed"))
+
+    out = jnp.einsum("nec,ech->nh", combine.astype(dtype), expert_out)
+    out = out.reshape(b, s, h)
+    out = dropout(key, out, cfg.hidden_dropout_prob, train)
+    return out, aux.astype(jnp.float32)

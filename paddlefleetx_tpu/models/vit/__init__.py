@@ -1,0 +1,11 @@
+from paddlefleetx_tpu.models.vit.model import (  # noqa: F401
+    PRESETS,
+    ViTConfig,
+    cls_loss,
+    forward,
+    init,
+    interpolate_pos_embed,
+    top_k_accuracy,
+    vit_logical_axes,
+    vit_specs,
+)

@@ -1,0 +1,262 @@
+"""Vision Transformer (reference ppfleetx/models/vision_model/vit/vit.py).
+
+Covers the reference surface: patch embedding, class token, learned position
+embeddings, pre-LN encoder blocks, optional representation layer ("pre_logits")
+and classification head (vit.py:54-166); position-embedding interpolation for
+resolution changes (:282-308).  The reference's ``FusedBlock``
+(FusedMultiHeadAttention/FusedFeedForward, vit.py:23-80) corresponds to the
+same fused compute XLA emits for these einsum blocks — there is one block
+definition here, no fused/unfused duality (checkpoint conversion moot).
+
+Sharding uses the same logical vocabulary as GPT (heads/mlp over ``model``,
+batch over data axes), so all parallel layouts apply unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.models.common import (
+    ParamSpec,
+    dropout,
+    init_params,
+    logical_axes,
+    normal_init,
+    ones_init,
+    stack_spec_tree,
+    zeros_init,
+)
+from paddlefleetx_tpu.models.gpt.model import ShardingCtx, _constrain, layer_norm
+from paddlefleetx_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    in_channels: int = 3
+    num_classes: int = 1000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_attention_heads: int = 12
+    ffn_hidden_size: Optional[int] = None
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    initializer_range: float = 0.02
+    use_recompute: bool = False
+    attn_impl: str = "xla"  # bidirectional: flash (causal-only) not applicable
+    dtype: str = "bfloat16"
+    # "token": use cls token; "mean": global average pool (reference global_pool)
+    pool: str = "token"
+    representation_size: Optional[int] = None
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def from_config(model_cfg) -> "ViTConfig":
+        fields = {f.name for f in dataclasses.fields(ViTConfig)}
+        return ViTConfig(**{k: v for k, v in dict(model_cfg).items() if k in fields})
+
+
+PRESETS = {
+    "ViT-B/16": dict(hidden_size=768, num_layers=12, num_attention_heads=12, patch_size=16),
+    "ViT-L/16": dict(hidden_size=1024, num_layers=24, num_attention_heads=16, patch_size=16),
+    "ViT-H/14": dict(hidden_size=1280, num_layers=32, num_attention_heads=16, patch_size=14),
+}
+
+
+def _encoder_layer_specs(cfg: ViTConfig) -> Dict[str, Any]:
+    h, nh, hd, ffn = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim, cfg.ffn_hidden_size
+    w = normal_init(cfg.initializer_range)
+    return {
+        "ln_1": {"scale": ParamSpec((h,), ("embed",), ones_init()),
+                 "bias": ParamSpec((h,), ("embed",), zeros_init())},
+        "attn": {
+            "qkv_kernel": ParamSpec((h, 3, nh, hd), ("embed", None, "heads", "kv"), w),
+            "qkv_bias": ParamSpec((3, nh, hd), (None, "heads", "kv"), zeros_init()),
+            "out_kernel": ParamSpec((nh, hd, h), ("heads", "kv", "embed"), w),
+            "out_bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+        "ln_2": {"scale": ParamSpec((h,), ("embed",), ones_init()),
+                 "bias": ParamSpec((h,), ("embed",), zeros_init())},
+        "mlp": {
+            "fc_in_kernel": ParamSpec((h, ffn), ("embed", "mlp"), w),
+            "fc_in_bias": ParamSpec((ffn,), ("mlp",), zeros_init()),
+            "fc_out_kernel": ParamSpec((ffn, h), ("mlp", "embed"), w),
+            "fc_out_bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+    }
+
+
+def vit_specs(cfg: ViTConfig) -> Dict[str, Any]:
+    h = cfg.hidden_size
+    w = normal_init(cfg.initializer_range)
+    p = cfg.patch_size
+    specs: Dict[str, Any] = {
+        "patch_embed": {
+            "kernel": ParamSpec(
+                (p * p * cfg.in_channels, h), (None, "embed"), w
+            ),
+            "bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+        "cls_token": ParamSpec((1, 1, h), (None, None, "embed"), zeros_init()),
+        "pos_embed": ParamSpec((1, cfg.num_patches + 1, h), (None, None, "embed"), w),
+        "layers": stack_spec_tree(_encoder_layer_specs(cfg), cfg.num_layers),
+        "final_ln": {"scale": ParamSpec((h,), ("embed",), ones_init()),
+                     "bias": ParamSpec((h,), ("embed",), zeros_init())},
+        "head": {
+            "kernel": ParamSpec((h, cfg.num_classes), ("embed", "vocab"), w),
+            "bias": ParamSpec((cfg.num_classes,), ("vocab",), zeros_init()),
+        },
+    }
+    if cfg.representation_size:
+        specs["pre_logits"] = {
+            "kernel": ParamSpec((h, cfg.representation_size), ("embed", "mlp"), w),
+            "bias": ParamSpec((cfg.representation_size,), ("mlp",), zeros_init()),
+        }
+        specs["head"]["kernel"] = ParamSpec(
+            (cfg.representation_size, cfg.num_classes), ("mlp", "vocab"), w
+        )
+    return specs
+
+
+def init(cfg: ViTConfig, key: jax.Array) -> Dict[str, Any]:
+    return init_params(key, vit_specs(cfg))
+
+
+def vit_logical_axes(cfg: ViTConfig) -> Dict[str, Any]:
+    return logical_axes(vit_specs(cfg))
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """[b, H, W, C] -> [b, (H/p)*(W/p), p*p*C] (conv-as-reshape: the patch
+    projection is a matmul on unfolded patches — MXU-friendly, identical to
+    the reference's Conv2d stride=p patch embed)."""
+    b, H, W, C = images.shape
+    gh, gw = H // patch, W // patch
+    x = images.reshape(b, gh, patch, gw, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, patch * patch * C)
+
+
+def interpolate_pos_embed(pos_embed: jax.Array, new_num_patches: int) -> jax.Array:
+    """Bilinear-resize grid position embeddings for a new resolution
+    (reference vit.py:282-308)."""
+    cls_pe, grid_pe = pos_embed[:, :1], pos_embed[:, 1:]
+    old = int(grid_pe.shape[1] ** 0.5)
+    new = int(new_num_patches**0.5)
+    if old == new:
+        return pos_embed
+    grid = grid_pe.reshape(1, old, old, -1)
+    grid = jax.image.resize(grid, (1, new, new, grid.shape[-1]), "bilinear")
+    return jnp.concatenate([cls_pe, grid.reshape(1, new * new, -1)], axis=1)
+
+
+def _encoder_layer(p, x, cfg: ViTConfig, ctx, key, train):
+    k_attn, k_mlp = (jax.random.split(key) if key is not None else (None, None))
+    dtype = x.dtype
+
+    y = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
+    qkv = jnp.einsum("bsh,htnd->bstnd", y, p["attn"]["qkv_kernel"].astype(dtype))
+    qkv = qkv + p["attn"]["qkv_bias"].astype(dtype)[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = _constrain(ctx, q, ("batch", None, "heads", "kv"))
+    out = attention(
+        q, k, v, impl="xla", causal=False,
+        dropout_key=k_attn, dropout_rate=cfg.attention_probs_dropout_prob, train=train,
+    )
+    out = jnp.einsum("bsnd,ndh->bsh", out, p["attn"]["out_kernel"].astype(dtype))
+    out = out + p["attn"]["out_bias"].astype(dtype)
+    x = x + dropout(k_attn, out, cfg.hidden_dropout_prob, train)
+
+    y = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
+    mp = p["mlp"]
+    y = y @ mp["fc_in_kernel"].astype(dtype) + mp["fc_in_bias"].astype(dtype)
+    y = jax.nn.gelu(y, approximate=True)
+    y = y @ mp["fc_out_kernel"].astype(dtype) + mp["fc_out_bias"].astype(dtype)
+    x = x + dropout(k_mlp, y, cfg.hidden_dropout_prob, train)
+    return _constrain(ctx, x, ("batch", None, "embed"))
+
+
+def forward(
+    params: Dict[str, Any],
+    images: jax.Array,  # [b, H, W, C] float
+    cfg: ViTConfig,
+    *,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> jax.Array:
+    """-> logits [b, num_classes]."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = patchify(images.astype(dtype), cfg.patch_size)
+    x = x @ params["patch_embed"]["kernel"].astype(dtype) + params["patch_embed"][
+        "bias"
+    ].astype(dtype)
+    b = x.shape[0]
+    cls = jnp.tile(params["cls_token"].astype(dtype), (b, 1, 1))
+    x = jnp.concatenate([cls, x], axis=1)
+    pe = params["pos_embed"]
+    if pe.shape[1] != x.shape[1]:
+        pe = interpolate_pos_embed(pe, x.shape[1] - 1)
+    x = x + pe.astype(dtype)
+    k_embed, k_layers = (
+        jax.random.split(dropout_key) if dropout_key is not None else (None, None)
+    )
+    x = dropout(k_embed, x, cfg.hidden_dropout_prob, train)
+    x = _constrain(ctx, x, ("batch", None, "embed"))
+
+    def body(carry, inp):
+        p_l, idx = inp
+        k = jax.random.fold_in(k_layers, idx) if k_layers is not None else None
+        return _encoder_layer(p_l, carry, cfg, ctx, k, train), None
+
+    body_fn = jax.checkpoint(body) if cfg.use_recompute else body
+    x, _ = jax.lax.scan(body_fn, x, (params["layers"], jnp.arange(cfg.num_layers)))
+
+    x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
+    feat = x[:, 0] if cfg.pool == "token" else x[:, 1:].mean(axis=1)
+    if cfg.representation_size:
+        feat = jnp.tanh(
+            feat @ params["pre_logits"]["kernel"].astype(dtype)
+            + params["pre_logits"]["bias"].astype(dtype)
+        )
+    logits = feat @ params["head"]["kernel"].astype(dtype) + params["head"]["bias"].astype(dtype)
+    return logits
+
+
+def cls_loss(
+    logits: jax.Array, labels: jax.Array, label_smoothing: float = 0.0
+) -> jax.Array:
+    """ViTCELoss (reference vision_model/layers loss): CE with optional
+    smoothing; labels may be int [b] or soft [b, classes] (mixup)."""
+    logits = logits.astype(jnp.float32)
+    if labels.ndim == 1:
+        onehot = jax.nn.one_hot(labels, logits.shape[-1])
+    else:
+        onehot = labels.astype(jnp.float32)
+    if label_smoothing > 0:
+        n = logits.shape[-1]
+        onehot = onehot * (1 - label_smoothing) + label_smoothing / n
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def top_k_accuracy(logits: jax.Array, labels: jax.Array, k: int = 1) -> jax.Array:
+    """top-1/top-5 metrics (reference general_classification_module.py:84)."""
+    topk = jnp.argsort(-logits, axis=-1)[:, :k]
+    return jnp.mean(jnp.any(topk == labels[:, None], axis=-1).astype(jnp.float32))

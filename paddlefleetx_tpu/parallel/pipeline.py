@@ -1,0 +1,108 @@
+"""Pipeline parallelism: microbatch schedule over the ``stages`` mesh axis.
+
+TPU-native replacement for the reference's ``PipelineLayer`` runtime
+(``GPTForPretrainingPipe`` hybrid_model.py:1055-1206: LayerDesc flattening,
+1F1B schedule, p2p send/recv between pp ranks, tied embeddings via
+SharedLayerDesc): layers are stacked on a leading axis and sharded over
+``stages``; the schedule runs inside a *partially-manual* ``jax.shard_map``
+— manual over ``stages`` (explicit ``ppermute`` hops between neighbour
+stages, riding ICI), auto everywhere else (TP/FSDP/DP keep flowing through
+GSPMD inside each stage).
+
+Schedule: GPipe-style fill-drain over M microbatches and S stages
+(T = M+S-1 ticks; bubble fraction (S-1)/T).  Memory behaves like 1F1B when
+combined with full-layer rematerialisation (the default for pp configs —
+same recipe as the reference's pp+recompute YAMLs).  Tied embeddings need no
+SharedLayerDesc machinery: the embedding lives outside the pipelined stack,
+replicated over ``stages``, and XLA psums its gradient contributions.
+
+The backward schedule is jax.grad through the forward ``ppermute``s — the
+transpose of a ppermute is the reverse ppermute, so the reverse pipeline
+drains in the opposite direction automatically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddlefleetx_tpu.parallel.mesh import AXIS_STAGES
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    num_stages: int
+    num_microbatches: int
+
+
+def pipelined_stack(
+    layer_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    layers_params: Any,
+    x: jax.Array,
+    pcfg: PipelineConfig,
+    mesh,
+) -> jax.Array:
+    """Run a stacked-layer transformer body as a stage pipeline.
+
+    layer_fn(local_params, x_mb, stage_index) -> y_mb runs this stage's
+    layer block (a lax.scan over the local layers).  ``layers_params`` leaves
+    have leading dim num_layers, sharded over ``stages``; x: [b, s, h].
+    """
+    S, M = pcfg.num_stages, pcfg.num_microbatches
+    b = x.shape[0]
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by pipeline microbatches {M}")
+
+    in_dtype = x.dtype
+
+    def pipe(local_layers, x):
+        x = x.astype(in_dtype)  # f32 at the boundary (see cast note below)
+        stage = jax.lax.axis_index(AXIS_STAGES)
+        mbs = x.reshape((M, b // M) + x.shape[1:])
+        T = M + S - 1
+
+        def tick(carry, t):
+            buf, out = carry
+            mb_idx = jnp.minimum(t, M - 1)
+            x0 = jax.lax.dynamic_index_in_dim(mbs, mb_idx, axis=0, keepdims=False)
+            x_in = jnp.where(stage == 0, jnp.where(t < M, 1.0, 0.0) * x0, buf)
+            y = layer_fn(local_layers, x_in, stage)
+            # last stage emits microbatch t-(S-1) at tick t
+            emit_idx = jnp.maximum(t - (S - 1), 0)
+            emit = jnp.where((stage == S - 1) & (t >= S - 1), y, 0.0)
+            prev = jax.lax.dynamic_index_in_dim(out, emit_idx, axis=0, keepdims=False)
+            out = jax.lax.dynamic_update_index_in_dim(out, prev + emit, emit_idx, axis=0)
+            buf = jax.lax.ppermute(
+                y, AXIS_STAGES, [(i, (i + 1) % S) for i in range(S)]
+            )
+            return (buf, out), None
+
+        buf0 = jnp.zeros_like(mbs[0])
+        out0 = jnp.zeros_like(mbs)
+        (_, out), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(T))
+        # outputs live on the last stage only; replicate across stages so the
+        # (stage-replicated) LM head can consume them everywhere.  psum in
+        # fp32: XLA CPU's AllReducePromotion pass crashes on bf16 allreduce
+        # (and fp32 accumulation is numerically safer anyway)
+        out = jax.lax.psum(out.astype(jnp.float32), AXIS_STAGES)
+        return out.reshape(x.shape)
+
+    # cast note: activations cross the shard_map boundary in fp32 — XLA
+    # CPU's AllReducePromotion pass crashes on the bf16 all-reduces this
+    # boundary generates (the fwd psum above and the bwd psum that is the
+    # transpose of the stage-replicated input); fp32 at the seam sidesteps
+    # both and costs only a cast each way
+    out = jax.shard_map(
+        pipe,
+        mesh=mesh,
+        in_specs=(P(AXIS_STAGES), P()),
+        out_specs=P(),
+        axis_names={AXIS_STAGES},
+        check_vma=False,
+    )(layers_params, x.astype(jnp.float32))
+    return out.astype(in_dtype)

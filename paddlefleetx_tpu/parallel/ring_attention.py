@@ -1,0 +1,108 @@
+"""Ring attention: context parallelism for long sequences.
+
+The reference has NO long-context context-parallel path (SURVEY §5.7: max
+trained context 1024; closest features are Megatron SP + the DAP axial
+alltoall).  This is the idiomatic TPU answer: the sequence stays sharded
+over the ``sep`` axis end-to-end; each device keeps its Q shard and the K/V
+shards rotate around the ring (``ppermute`` hops over ICI), with
+online-softmax accumulation so no device ever materialises the full
+sequence — memory O(s/P), compute O(s²/P) per device.
+
+Implemented as a partially-manual ``jax.shard_map`` (manual over ``sep``;
+batch/heads/model axes stay GSPMD-auto inside), with ``lax.scan`` over ring
+steps so reverse-mode autodiff produces the reverse-ring backward
+automatically.  Complements Ulysses (sharding.py heads/(model,sep) rule):
+Ulysses reshards seq<->heads with all-to-alls and needs heads >= sep
+degree; ring has no head-count constraint and overlaps compute with
+neighbour exchange.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddlefleetx_tpu.parallel.mesh import AXIS_SEP
+
+NEG_INF = -1e30
+
+
+def _ring_body(q, kv, step, *, ring_size, seq_local, causal, scale):
+    """One ring step: partial attention of local q vs the currently-held
+    K/V chunk.  q: [b, sl, n, d]; returns running (m, l, acc) update."""
+    k_c, v_c, m, l, acc, src = kv
+    # scores in fp32
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_c, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        my = jax.lax.axis_index(AXIS_SEP)
+        q_pos = my * seq_local + jnp.arange(seq_local)[:, None]
+        k_pos = src * seq_local + jnp.arange(seq_local)[None, :]
+        s = jnp.where((k_pos <= q_pos)[None, None], s, NEG_INF)
+
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + p.sum(axis=-1)
+    acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v_c, preferred_element_type=jnp.float32
+    )
+    # rotate K/V to the next rank; track which global chunk we now hold
+    perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+    k_c = jax.lax.ppermute(k_c, AXIS_SEP, perm)
+    v_c = jax.lax.ppermute(v_c, AXIS_SEP, perm)
+    src = jax.lax.ppermute(src, AXIS_SEP, perm)
+    return (k_c, v_c, m_new, l_new, acc_new, src)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    causal: bool = True,
+) -> jax.Array:
+    """q,k,v: [b, s, n, d] with s sharded over ``sep``.  Output same spec."""
+    ring = mesh.shape[AXIS_SEP]
+    if ring == 1:
+        from paddlefleetx_tpu.ops.attention import xla_attention
+
+        return xla_attention(q, k, v, causal=causal)
+    d = q.shape[-1]
+    scale = 1.0 / (d**0.5)
+
+    def local_fn(q, k, v):
+        b, sl, n, _ = q.shape
+        m0 = jnp.full((b, n, sl), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, n, sl), jnp.float32)
+        acc0 = jnp.zeros((b, sl, n, d), jnp.float32)
+        src0 = jax.lax.axis_index(AXIS_SEP)
+
+        body = functools.partial(
+            _ring_body, q, ring_size=ring, seq_local=sl, causal=causal, scale=scale
+        )
+
+        def scan_step(carry, _):
+            return body(carry, None), None
+
+        (k_f, v_f, m, l, acc, _), _ = jax.lax.scan(
+            scan_step, (k, v, m0, l0, acc0, src0), None, length=ring
+        )
+        l_safe = jnp.maximum(l, 1e-30)
+        out = acc / l_safe.transpose(0, 2, 1)[..., None]
+        return out.astype(q.dtype)
+
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(None, AXIS_SEP), P(None, AXIS_SEP), P(None, AXIS_SEP)),
+        out_specs=P(None, AXIS_SEP),
+        axis_names={AXIS_SEP},
+        check_vma=False,
+    )(q, k, v)

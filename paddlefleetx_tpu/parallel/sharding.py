@@ -49,7 +49,11 @@ BASE_RULES: Tuple[Tuple[str, Any], ...] = (
     ("seq", AXIS_SEP),
     ("embed", None),
     ("mlp", AXIS_MODEL),
-    ("heads", AXIS_MODEL),
+    # heads spread over model AND sep: with sep>1 this is Ulysses — outside
+    # attention the seq dim is sep-sharded, inside attention heads are; the
+    # reshard between them is the DAP/Ulysses all-to-all (reference
+    # protein_folding/dap.py:244-398), inserted by XLA
+    ("heads", (AXIS_MODEL, AXIS_SEP)),
     ("kv", None),
     ("vocab", AXIS_MODEL),
     ("layers", AXIS_STAGES),
@@ -60,6 +64,8 @@ BASE_RULES: Tuple[Tuple[str, Any], ...] = (
 def make_rules(
     fsdp_enabled: bool = False,
     sequence_parallel: bool = False,
+    mesh: Optional[Mesh] = None,
+    num_experts: int = 0,
 ) -> Tuple[Tuple[str, Any], ...]:
     """Build logical->mesh rules for the configured strategies.
 
@@ -77,6 +83,18 @@ def make_rules(
         rules["embed"] = AXIS_FSDP
     if sequence_parallel:
         rules["seq"] = (AXIS_SEP, AXIS_MODEL)
+    if mesh is not None and num_experts > 1:
+        # expert-parallel degree must divide num_experts: greedily take
+        # expert-group axes whose combined size still divides E (experts
+        # replicate over the rest — EP degree <= E, reference moe semantics)
+        chosen = []
+        prod = 1
+        for ax in (AXIS_DATA, AXIS_FSDP, AXIS_SEP):
+            size = mesh.shape[ax]
+            if size > 1 and num_experts % (prod * size) == 0:
+                chosen.append(ax)
+                prod *= size
+        rules["expert"] = tuple(chosen) if chosen else None
     return tuple(rules.items())
 
 
@@ -117,6 +135,13 @@ def tree_logical_to_sharding(
 
 
 def with_logical_constraint(x: jax.Array, logical_axes, rules, mesh: Mesh):
-    """`lax.with_sharding_constraint` via logical names (activation sharding)."""
+    """`lax.with_sharding_constraint` via logical names (activation sharding).
+
+    Inside an active mesh context (incl. partially-manual shard_map bodies,
+    where some axes are Manual) the bare PartitionSpec form must be used —
+    a NamedSharding would pin the all-Auto outer mesh and mismatch."""
     spec = logical_to_spec(logical_axes, rules)
+    abstract = jax.sharding.get_abstract_mesh()
+    if abstract is not None and abstract.axis_names:
+        return jax.lax.with_sharding_constraint(x, spec)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

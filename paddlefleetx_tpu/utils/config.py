@@ -149,28 +149,30 @@ def process_dist_config(cfg: AttrDict, num_devices: Optional[int] = None) -> Att
 
     mp = int(dist.get("mp_degree", 1) or 1)
     pp = int(dist.get("pp_degree", 1) or 1)
+    sep = int(dist.get("sep_degree", 1) or 1)  # Ulysses/ring context axis
     sharding_cfg = dist.setdefault("sharding", AttrDict())
     sd = int(sharding_cfg.get("sharding_degree", 1) or 1)
     sharding_cfg.sharding_degree = sd
     sharding_cfg.setdefault("sharding_stage", 0)
     sharding_cfg.setdefault("sharding_offload", False)
 
-    other = mp * pp * sd
+    other = mp * pp * sd * sep
     if num_devices % other != 0:
         raise ValueError(
-            f"device count {num_devices} not divisible by mp*pp*sharding = {mp}*{pp}*{sd}"
+            f"device count {num_devices} not divisible by mp*pp*sharding*sep = "
+            f"{mp}*{pp}*{sd}*{sep}"
         )
     dp = int(dist.get("dp_degree", 0) or 0)
     inferred_dp = num_devices // other
     if dp and dp != inferred_dp:
         raise ValueError(
             f"dp_degree={dp} inconsistent with num_devices={num_devices}, "
-            f"mp={mp}, pp={pp}, sharding={sd} (expected {inferred_dp})"
+            f"mp={mp}, pp={pp}, sharding={sd}, sep={sep} (expected {inferred_dp})"
         )
     dist.dp_degree = inferred_dp
     dist.mp_degree = mp
     dist.pp_degree = pp
-    dist.setdefault("sep_degree", 1)  # Ulysses sequence/expert alltoall axis
+    dist.sep_degree = sep
     dist.setdefault("sequence_parallel", False)
     if dist.sequence_parallel and mp == 1:
         # Megatron SP only reshards over the model axis; degenerate otherwise

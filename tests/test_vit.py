@@ -1,0 +1,96 @@
+"""ViT model + vision data tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlefleetx_tpu.data.vision_dataset import SyntheticClsDataset
+from paddlefleetx_tpu.models import vit
+from paddlefleetx_tpu.models.vit.model import ViTConfig, patchify, top_k_accuracy
+from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh
+from paddlefleetx_tpu.parallel.sharding import make_rules, tree_logical_to_sharding
+
+TINY = ViTConfig(
+    image_size=32,
+    patch_size=8,
+    num_classes=8,
+    hidden_size=64,
+    num_layers=2,
+    num_attention_heads=8,
+    hidden_dropout_prob=0.0,
+    attention_probs_dropout_prob=0.0,
+    dtype="float32",
+)
+
+
+def test_forward_shape():
+    params = vit.init(TINY, jax.random.key(0))
+    imgs = jnp.ones((2, 32, 32, 3))
+    logits = vit.forward(params, imgs, TINY)
+    assert logits.shape == (2, 8)
+
+
+def test_patchify_roundtrip_values():
+    imgs = jnp.arange(2 * 32 * 32 * 3, dtype=jnp.float32).reshape(2, 32, 32, 3)
+    x = patchify(imgs, 8)
+    assert x.shape == (2, 16, 8 * 8 * 3)
+    # first patch first row equals original top-left pixels
+    np.testing.assert_array_equal(np.asarray(x[0, 0, :24]).reshape(8, 3), np.asarray(imgs[0, 0, :8]))
+
+
+def test_pos_embed_interpolation():
+    params = vit.init(TINY, jax.random.key(0))
+    imgs = jnp.ones((1, 64, 64, 3))  # 2x resolution -> 64 patches vs 16
+    logits = vit.forward(params, imgs, TINY)
+    assert logits.shape == (1, 8)
+
+
+def test_vit_learns_synthetic():
+    import optax
+
+    params = vit.init(TINY, jax.random.key(0))
+    ds = SyntheticClsDataset(num_samples=64, image_size=32, num_classes=8)
+    batch = {
+        "images": jnp.stack([ds[i]["images"] for i in range(32)]),
+        "labels": jnp.asarray([ds[i]["labels"] for i in range(32)]),
+    }
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        loss, g = jax.value_and_grad(
+            lambda p: vit.cls_loss(vit.forward(p, batch["images"], TINY, train=False), batch["labels"])
+        )(params)
+        upd, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, upd), opt, loss
+
+    losses = []
+    for _ in range(15):
+        params, opt, loss = step(params, opt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.5
+
+
+def test_vit_tp_parity(devices8):
+    params = vit.init(TINY, jax.random.key(0))
+    imgs = jnp.ones((4, 32, 32, 3))
+    ref = vit.forward(params, imgs, TINY)
+    mesh = build_mesh(MeshConfig(dp_degree=2, mp_degree=4), devices8)
+    rules = make_rules()
+    shardings = tree_logical_to_sharding(vit.vit_logical_axes(TINY), mesh, rules)
+    from paddlefleetx_tpu.models.gpt.model import ShardingCtx
+
+    ctx = ShardingCtx(mesh, rules)
+    with mesh:
+        got = jax.jit(lambda p, x: vit.forward(p, x, TINY, ctx=ctx))(
+            jax.device_put(params, shardings), imgs
+        )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_topk_accuracy():
+    logits = jnp.asarray([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]])
+    labels = jnp.asarray([1, 2])
+    assert float(top_k_accuracy(logits, labels, 1)) == 0.5
+    assert float(top_k_accuracy(logits, labels, 3)) == 1.0
