@@ -16,6 +16,18 @@ import jax
 from paddlefleetx_tpu.utils.registry import MODULES
 
 
+def resolve_model_dtype(cfg, model_cfg: Dict[str, Any]) -> None:
+    """Fill model_cfg['dtype'] from Engine.mix_precision unless pinned.
+
+    mix disabled == O0: fp32 compute (reference amp levels,
+    distributed/apis/amp.py)."""
+    if "dtype" not in model_cfg:
+        mix = cfg.get("Engine", {}).get("mix_precision", {})
+        model_cfg["dtype"] = (
+            mix.get("dtype", "bfloat16") if mix.get("enable", True) else "float32"
+        )
+
+
 class BasicModule:
     """Interface consumed by the Engine."""
 
@@ -58,12 +70,7 @@ class GPTModule(BasicModule):
         model_cfg = dict(cfg.Model)
         model_cfg.pop("module", None)
         model_cfg.pop("name", None)
-        mix = cfg.get("Engine", {}).get("mix_precision", {})
-        if "dtype" not in model_cfg:
-            # mix disabled == O0: fp32 compute (reference amp levels)
-            model_cfg["dtype"] = (
-                mix.get("dtype", "bfloat16") if mix.get("enable", True) else "float32"
-            )
+        resolve_model_dtype(cfg, model_cfg)
         dist = cfg.get("Distributed", {})
         if dist.get("sequence_parallel", False):
             model_cfg["sequence_parallel"] = True
@@ -103,11 +110,7 @@ class ViTModule(BasicModule):
         model_cfg = dict(cfg.Model)
         model_cfg.pop("module", None)
         model_cfg.pop("name", None)
-        mix = cfg.get("Engine", {}).get("mix_precision", {})
-        if "dtype" not in model_cfg:
-            model_cfg["dtype"] = (
-                mix.get("dtype", "bfloat16") if mix.get("enable", True) else "float32"
-            )
+        resolve_model_dtype(cfg, model_cfg)
         self.config = ViTConfig.from_config(model_cfg)
         self.label_smoothing = float(model_cfg.get("label_smoothing", 0.0))
         self.tokens_per_sample = self.config.num_patches + 1  # ips = patches/s
@@ -139,5 +142,15 @@ class ViTModule(BasicModule):
 def build_module(cfg) -> BasicModule:
     """Name-dispatched module construction (reference models/__init__.py:30,
     minus the eval())."""
+    _register_family_modules()
     name = cfg.Model.get("module", "GPTModule")
     return MODULES.get(name)(cfg)
+
+
+def _register_family_modules():
+    """Import model-family module adapters so their @MODULES.register run.
+
+    Lazy (not at package import) to keep `import paddlefleetx_tpu` light;
+    idempotent because Registry rejects double registration only on distinct
+    functions and imports are cached."""
+    import paddlefleetx_tpu.models.ernie.module  # noqa: F401

@@ -1,0 +1,212 @@
+"""ERNIE pretraining dataset: sentence-pair construction + ngram masking.
+
+Behavior modeled on the reference's ERNIE data pipeline
+(ppfleetx/data/dataset/ernie/ernie_dataset.py:46-129 +
+dataset_utils.py:254-470 ``create_masked_lm_predictions``): documents of
+tokenized sentences -> sentence-pair samples (C++ ``build_mapping`` index,
+data/indexed.py) -> per-sample ngram span masking (80% [MASK] / 10% random
+/ 10% keep) + NSP label by random segment swap.
+
+Corpus format (created by :func:`write_synthetic_sentence_corpus` or the
+preprocessing tools): ``prefix_ids.npy`` flat token stream plus
+``prefix_idx.npz`` with ``sent_lens`` (int32 per-sentence token counts) and
+``doc_sent_counts`` (int32 sentences per document).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from paddlefleetx_tpu.data.indexed import build_mapping
+from paddlefleetx_tpu.utils.registry import DATASETS
+
+
+@DATASETS.register("ErnieDataset")
+class ErnieDataset:
+    def __init__(
+        self,
+        input_dir: Optional[str] = None,
+        tokens: Optional[np.ndarray] = None,
+        sent_lens: Optional[np.ndarray] = None,
+        doc_sent_counts: Optional[np.ndarray] = None,
+        max_seq_len: int = 512,
+        masked_lm_prob: float = 0.15,
+        max_predictions_per_seq: Optional[int] = None,
+        short_seq_prob: float = 0.1,
+        max_ngrams: int = 3,
+        vocab_size: int = 40000,
+        cls_id: int = 1,
+        sep_id: int = 2,
+        mask_id: int = 3,
+        pad_id: int = 0,
+        binary_head: bool = True,
+        seed: int = 1234,
+        num_samples: Optional[int] = None,
+        mode: str = "Train",
+        **_,
+    ):
+        if input_dir is not None:
+            tokens = np.load(input_dir + "_ids.npy", mmap_mode="r")
+            idx = np.load(input_dir + "_idx.npz")
+            sent_lens = idx["sent_lens"]
+            doc_sent_counts = idx["doc_sent_counts"]
+        assert tokens is not None and sent_lens is not None and doc_sent_counts is not None
+        self.tokens = tokens
+        self.sent_lens = np.asarray(sent_lens, dtype=np.int32)
+        # token-stream offset of each sentence
+        self.sent_offsets = np.concatenate(
+            [[0], np.cumsum(self.sent_lens)]
+        ).astype(np.int64)
+        docs = np.concatenate([[0], np.cumsum(doc_sent_counts)]).astype(np.int64)
+
+        self.max_seq_len = int(max_seq_len)
+        self.masked_lm_prob = float(masked_lm_prob)
+        self.max_predictions = int(
+            max_predictions_per_seq
+            if max_predictions_per_seq is not None
+            else round(masked_lm_prob * max_seq_len)
+        )
+        self.max_ngrams = int(max_ngrams)
+        self.vocab_size = int(vocab_size)
+        self.cls_id, self.sep_id, self.mask_id, self.pad_id = cls_id, sep_id, mask_id, pad_id
+        self.binary_head = bool(binary_head)
+        self.seed = int(seed)
+
+        self.samples = build_mapping(
+            docs,
+            self.sent_lens,
+            self.max_seq_len,
+            short_seq_prob=short_seq_prob,
+            seed=self.seed,
+            min_num_sent=2 if self.binary_head else 1,
+        )
+        self._epoch_len = len(self.samples)
+        self.num_samples = int(num_samples) if num_samples else self._epoch_len
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def _sentence(self, s: int) -> np.ndarray:
+        a, b = self.sent_offsets[s], self.sent_offsets[s + 1]
+        return np.asarray(self.tokens[a:b], dtype=np.int64)
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        row = self.samples[idx % self._epoch_len]
+        sent_begin, sent_end, target_len = int(row[0]), int(row[1]), int(row[2])
+        rng = np.random.default_rng((self.seed, idx))
+        sents = [self._sentence(s) for s in range(sent_begin, sent_end)]
+
+        # --- segment split + NSP label (random A/B swap, BERT-style) ------
+        if self.binary_head and len(sents) > 1:
+            split = int(rng.integers(1, len(sents)))
+            a = np.concatenate(sents[:split])
+            b = np.concatenate(sents[split:])
+            if rng.random() < 0.5:
+                a, b = b, a
+                nsp_label = 1  # swapped / "random next"
+            else:
+                nsp_label = 0
+        else:
+            a = np.concatenate(sents)
+            b = np.zeros(0, dtype=np.int64)
+            nsp_label = 0
+
+        # truncate longest-first to target_len
+        budget = min(target_len, self.max_seq_len - 3)
+        while len(a) + len(b) > budget:
+            if len(a) >= len(b):
+                a = a[:-1] if rng.random() < 0.5 else a[1:]
+            else:
+                b = b[:-1] if rng.random() < 0.5 else b[1:]
+
+        ids = np.concatenate(
+            [[self.cls_id], a, [self.sep_id], b, [self.sep_id]]
+        ).astype(np.int64)
+        token_type = np.concatenate(
+            [np.zeros(len(a) + 2, np.int64), np.ones(len(b) + 1, np.int64)]
+        )
+        special = np.zeros(len(ids), dtype=bool)
+        special[0] = special[len(a) + 1] = special[-1] = True
+
+        input_ids, mlm_labels = self._mask_tokens(ids, special, rng)
+
+        # pad to max_seq_len
+        L = self.max_seq_len
+        pad = L - len(input_ids)
+        attn = np.concatenate([np.ones(len(input_ids), np.float32), np.zeros(pad, np.float32)])
+        input_ids = np.concatenate([input_ids, np.full(pad, self.pad_id, np.int64)])
+        token_type = np.concatenate([token_type, np.zeros(pad, np.int64)])
+        mlm_labels = np.concatenate([mlm_labels, np.full(pad, -1, np.int64)])
+        return {
+            "input_ids": input_ids,
+            "token_type_ids": token_type,
+            "attention_mask": attn,
+            "masked_lm_labels": mlm_labels,
+            "next_sentence_label": np.int64(nsp_label),
+        }
+
+    def _mask_tokens(self, ids: np.ndarray, special: np.ndarray, rng) -> tuple:
+        """Ngram span masking (reference create_masked_lm_predictions
+        dataset_utils.py:254-470): candidate positions get ngram spans with
+        pvals ~ 1/n; each masked token is 80% [MASK], 10% random, 10% kept."""
+        ids = ids.copy()
+        labels = np.full(len(ids), -1, dtype=np.int64)
+        num_to_predict = min(
+            self.max_predictions,
+            max(1, int(round(len(ids) * self.masked_lm_prob))),
+        )
+        candidates = np.flatnonzero(~special)
+        rng.shuffle(candidates)
+        pvals = 1.0 / np.arange(1, self.max_ngrams + 1)
+        pvals = pvals / pvals.sum()
+        covered = np.zeros(len(ids), dtype=bool)
+        n_masked = 0
+        for start in candidates:
+            if n_masked >= num_to_predict:
+                break
+            n = int(rng.choice(np.arange(1, self.max_ngrams + 1), p=pvals))
+            span = range(start, min(start + n, len(ids)))
+            if any(covered[i] or special[i] for i in span):
+                continue
+            for i in span:
+                if n_masked >= num_to_predict:
+                    break
+                covered[i] = True
+                labels[i] = ids[i]
+                r = rng.random()
+                if r < 0.8:
+                    ids[i] = self.mask_id
+                elif r < 0.9:
+                    ids[i] = int(rng.integers(4, self.vocab_size))
+                n_masked += 1
+        return ids, labels
+
+
+def write_synthetic_sentence_corpus(
+    prefix: str,
+    vocab_size: int = 40000,
+    num_docs: int = 32,
+    sents_per_doc: int = 8,
+    mean_sent_len: int = 24,
+    seed: int = 0,
+) -> str:
+    """Tiny sentence-structured corpus in the ERNIE mmap format (tests)."""
+    rng = np.random.default_rng(seed)
+    doc_sent_counts = rng.integers(
+        max(2, sents_per_doc // 2), sents_per_doc * 2, num_docs
+    ).astype(np.int32)
+    total_sents = int(doc_sent_counts.sum())
+    sent_lens = rng.integers(
+        max(4, mean_sent_len // 2), mean_sent_len * 2, total_sents
+    ).astype(np.int32)
+    probs = 1.0 / (np.arange(vocab_size) + 5.0)
+    probs[:4] = 0.0  # special tokens never appear in raw text
+    probs /= probs.sum()
+    tokens = rng.choice(vocab_size, size=int(sent_lens.sum()), p=probs).astype(
+        np.uint16 if vocab_size < 2**16 else np.uint32
+    )
+    np.save(prefix + "_ids.npy", tokens)
+    np.savez(prefix + "_idx.npz", sent_lens=sent_lens, doc_sent_counts=doc_sent_counts)
+    return prefix

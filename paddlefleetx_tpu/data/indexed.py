@@ -150,3 +150,113 @@ def build_blending_indices(
         ds_sample[i] = counts[d]
         counts[d] += 1
     return ds_index, ds_sample
+
+
+def build_mapping(
+    docs: np.ndarray,
+    sizes: np.ndarray,
+    max_seq_length: int,
+    short_seq_prob: float = 0.1,
+    seed: int = 1,
+    min_num_sent: int = 2,
+    use_cpp: bool = True,
+) -> np.ndarray:
+    """BERT/ERNIE sentence-pair sample map (reference build_mapping,
+    fast_index_map_helpers.cpp:693): greedily packs consecutive sentences of
+    each document into samples of up to max_seq_length-3 tokens (room for
+    [CLS] a [SEP] b [SEP]); a short_seq_prob fraction get random shorter
+    targets.  Returns int64 [n, 3] rows (sent_begin, sent_end, target_len).
+
+    docs:  int64 [num_docs+1] sentence-index boundary per doc.
+    sizes: int32 [num_sentences] token length per sentence.
+    """
+    docs = np.asarray(docs, dtype=np.int64)
+    sizes = np.asarray(sizes, dtype=np.int32)
+    num_docs = len(docs) - 1
+
+    lib = _load_lib() if use_cpp else None
+    if lib is not None:
+        max_out = len(sizes) + num_docs + 1
+        out = np.zeros((max_out, 3), dtype=np.int64)
+        n = lib.build_mapping(
+            docs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(num_docs),
+            sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(max_seq_length),
+            ctypes.c_double(short_seq_prob),
+            ctypes.c_uint64(seed),
+            ctypes.c_int64(max_out),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int32(min_num_sent),
+        )
+        return out[:n]
+
+    # numpy fallback: same walk, same RNG *semantics* (not bit-identical to
+    # the C++ mt19937 stream — callers must pick one path per index cache)
+    rng = np.random.default_rng(seed)
+    max_tokens = max_seq_length - 3
+    rows = []
+
+    def target():
+        if short_seq_prob > 0.0 and rng.random() < short_seq_prob:
+            return 2 + int(rng.random() * (max_tokens - 1))
+        return max_tokens
+
+    for doc in range(num_docs):
+        begin, end = docs[doc], docs[doc + 1]
+        t = target()
+        start, tok_count, num_sent = begin, 0, 0
+        for s in range(begin, end):
+            tok_count += int(sizes[s])
+            num_sent += 1
+            last = s == end - 1
+            if (tok_count >= t and num_sent >= min_num_sent) or last:
+                if num_sent >= min_num_sent and tok_count > 1:
+                    rows.append((start, s + 1, t))
+                start, tok_count, num_sent = s + 1, 0, 0
+                t = target()
+    return np.asarray(rows, dtype=np.int64).reshape(-1, 3)
+
+
+def build_blocks_mapping(
+    docs: np.ndarray,
+    sizes: np.ndarray,
+    max_seq_length: int,
+    seed: int = 1,
+    use_cpp: bool = True,
+) -> np.ndarray:
+    """Fixed-block sample map (reference build_blocks_mapping): consecutive
+    sentences packed into blocks of max_seq_length-2 tokens.  Returns int64
+    [n, 4] rows (sent_begin, sent_end, doc_index, block_len)."""
+    docs = np.asarray(docs, dtype=np.int64)
+    sizes = np.asarray(sizes, dtype=np.int32)
+    num_docs = len(docs) - 1
+
+    lib = _load_lib() if use_cpp else None
+    if lib is not None:
+        max_out = len(sizes) + num_docs + 1
+        out = np.zeros((max_out, 4), dtype=np.int64)
+        n = lib.build_blocks_mapping(
+            docs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(num_docs),
+            sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(max_seq_length),
+            ctypes.c_uint64(seed),
+            ctypes.c_int64(max_out),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return out[:n]
+
+    max_tokens = max_seq_length - 2
+    rows = []
+    for doc in range(num_docs):
+        begin, end = docs[doc], docs[doc + 1]
+        start, tok_count = begin, 0
+        for s in range(begin, end):
+            tok_count += int(sizes[s])
+            last = s == end - 1
+            if tok_count >= max_tokens or last:
+                if tok_count > 1:
+                    rows.append((start, s + 1, doc, min(tok_count, max_tokens)))
+                start, tok_count = s + 1, 0
+    return np.asarray(rows, dtype=np.int64).reshape(-1, 4)

@@ -2,13 +2,13 @@
 GeneralClsDataset / ImageFolder / CIFAR10 / ContrastiveLearningDataset).
 
 Host-side numpy pipelines; images flow to devices as [b, H, W, C] float32
-batches (normalisation folded in here, augmentation kept minimal and
-composable)."""
+batches.  Transforms are name-dispatched from config ``transform_ops`` lists
+(the reference builds paddle.vision transforms the same way)."""
 
 from __future__ import annotations
 
 import os
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -18,12 +18,85 @@ IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
 
 
-def normalize(img: np.ndarray) -> np.ndarray:
-    return (img.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+def normalize(img: np.ndarray, mean=IMAGENET_MEAN, std=IMAGENET_STD) -> np.ndarray:
+    return (img.astype(np.float32) / 255.0 - mean) / std
 
 
-def random_flip(img: np.ndarray, rng: np.random.Generator) -> np.ndarray:
-    return img[:, ::-1] if rng.random() < 0.5 else img
+def _resize(img: np.ndarray, size: int) -> np.ndarray:
+    """Bilinear resize shorter side to ``size`` (numpy; no PIL dependency)."""
+    h, w = img.shape[:2]
+    if h < w:
+        nh, nw = size, max(1, round(w * size / h))
+    else:
+        nh, nw = max(1, round(h * size / w)), size
+    ys = np.linspace(0, h - 1, nh)
+    xs = np.linspace(0, w - 1, nw)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    img = img.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def _center_crop(img: np.ndarray, size: int) -> np.ndarray:
+    h, w = img.shape[:2]
+    top = max(0, (h - size) // 2)
+    left = max(0, (w - size) // 2)
+    return img[top : top + size, left : left + size]
+
+
+def _random_crop(img: np.ndarray, size: int, rng: np.random.Generator) -> np.ndarray:
+    h, w = img.shape[:2]
+    top = int(rng.integers(0, max(1, h - size + 1)))
+    left = int(rng.integers(0, max(1, w - size + 1)))
+    return img[top : top + size, left : left + size]
+
+
+def build_transforms(ops: Optional[Sequence[Dict]]):
+    """Compose a transform pipeline from config (reference transform_ops
+    yaml lists: RandCropImage/RandFlipImage/ResizeImage/CropImage/
+    NormalizeImage...).  Each op: {Name: {kwargs}}.  Returns
+    fn(img, rng, train) -> img float32."""
+    specs = []
+    for op in ops or []:
+        (name, kwargs), = op.items() if isinstance(op, dict) else [(op, {})]
+        specs.append((name, dict(kwargs or {})))
+
+    def apply(img: np.ndarray, rng: np.random.Generator, train: bool) -> np.ndarray:
+        normalized = False
+        for name, kw in specs:
+            if name in ("ResizeImage", "Resize"):
+                img = _resize(img, int(kw.get("resize_short", kw.get("size", 256))))
+            elif name in ("RandCropImage", "RandomResizedCrop"):
+                size = int(kw.get("size", 224))
+                if train:
+                    img = _random_crop(_resize(img, max(size, int(size * 1.15))), size, rng)
+                else:
+                    img = _center_crop(_resize(img, max(size, int(size * 1.15))), size)
+            elif name in ("CropImage", "CenterCrop"):
+                img = _center_crop(img, int(kw.get("size", 224)))
+            elif name in ("RandFlipImage", "RandomHorizontalFlip"):
+                if train and rng.random() < 0.5:
+                    img = img[:, ::-1]
+            elif name in ("NormalizeImage", "Normalize"):
+                mean = np.asarray(kw.get("mean", IMAGENET_MEAN), np.float32)
+                std = np.asarray(kw.get("std", IMAGENET_STD), np.float32)
+                scale = float(kw.get("scale", 1.0 / 255.0))
+                img = (img.astype(np.float32) * scale - mean) / std
+                normalized = True
+            # unknown ops raise: silent skips would change training inputs
+            elif name != "ToCHWImage":  # layout handled at batch level (NHWC native)
+                raise ValueError(f"unknown transform op {name!r}")
+        if not normalized:
+            img = normalize(img)
+        return np.ascontiguousarray(img, np.float32)
+
+    return apply
 
 
 @DATASETS.register("GeneralClsDataset")
@@ -38,6 +111,7 @@ class GeneralClsDataset:
         mode: str = "Train",
         transform_ops=None,
         delimiter: str = " ",
+        seed: int = 1024,
         **_unused,
     ):
         self.root = image_root
@@ -50,7 +124,8 @@ class GeneralClsDataset:
                     continue
                 path, label = line.rsplit(delimiter, 1)
                 self.samples.append((path, int(label)))
-        self.rng = np.random.default_rng(0)
+        self.transform = build_transforms(transform_ops)
+        self.seed = int(seed)
 
     def __len__(self):
         return len(self.samples)
@@ -66,9 +141,11 @@ class GeneralClsDataset:
     def __getitem__(self, idx: int):
         path, label = self.samples[idx]
         img = self._load(path)
-        if self.train:
-            img = random_flip(img, self.rng)
-        return {"images": normalize(img), "labels": np.int64(label)}
+        # per-(seed, idx) stream: reproducible under shuffling and forked
+        # loader workers alike
+        rng = np.random.default_rng((self.seed, idx))
+        img = self.transform(img, rng, self.train)
+        return {"images": img, "labels": np.int64(label)}
 
 
 @DATASETS.register("SyntheticClsDataset")

@@ -1,0 +1,13 @@
+"""ERNIE model family (reference ppfleetx/models/language_model/ernie/)."""
+
+from paddlefleetx_tpu.models.ernie.config import ErnieConfig  # noqa: F401
+from paddlefleetx_tpu.models.ernie.model import (  # noqa: F401
+    cls_forward,
+    cls_loss,
+    encode,
+    ernie_logical_axes,
+    ernie_specs,
+    init,
+    pretrain_logits,
+    pretrain_loss,
+)

@@ -1,0 +1,44 @@
+"""ERNIE model configuration (reference ErnieModel kwargs,
+ppfleetx/models/language_model/ernie/dygraph/single_model.py:131-241)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_attention_heads: int = 12
+    ffn_hidden_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 4
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+    num_classes: int = 2  # sequence-classification head width
+    dtype: str = "bfloat16"
+    attn_impl: str = "xla"
+    use_recompute: bool = False
+    recompute_granularity: str = "full"
+    binary_head: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_size % self.num_attention_heads == 0
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_config(cls, d: Dict[str, Any]) -> "ErnieConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+    @property
+    def np_dtype(self):
+        return jnp.dtype(self.dtype)

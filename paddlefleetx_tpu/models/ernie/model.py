@@ -1,0 +1,332 @@
+"""ERNIE — BERT-style bidirectional encoder, pure-JAX functional.
+
+Covers the reference's ErnieModel / ErnieForPretraining /
+ErnieForSequenceClassification and their hybrid/pipe variants
+(ppfleetx/models/language_model/ernie/dygraph/single_model.py:131,464,647;
+hybrid_model.py:88,796): one definition, parallelism by logical-axis
+annotation (TP shards heads/ffn/vocab exactly like GPT; the stacked
+``layers`` axis is what pipeline stage-sharding partitions).
+
+Architecture: word+position+token-type embeddings -> LayerNorm -> dropout;
+N *post-LN* encoder blocks (LN after residual — BERT convention, unlike
+GPT's pre-LN); tanh pooler on [CLS]; heads:
+  - MLM: dense+gelu+LN transform, decoder tied to word embeddings + bias
+    (ErnieLMPredictionHead single_model.py:401-441)
+  - NSP/SOP: binary classifier on pooled output (ErniePretrainingHeads :443)
+Pretraining loss = masked-token CE (ignore label -1) + NSP CE
+(ErniePretrainingCriterion :591-644).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.models.common import (
+    ParamSpec,
+    dropout,
+    init_params,
+    logical_axes,
+    normal_init,
+    ones_init,
+    stack_spec_tree,
+    zeros_init,
+)
+from paddlefleetx_tpu.models.ernie.config import ErnieConfig
+from paddlefleetx_tpu.models.gpt.model import ShardingCtx, layer_norm, _constrain
+from paddlefleetx_tpu.ops.attention import attention
+
+
+# ---------------------------------------------------------------------------
+# Parameter specs
+# ---------------------------------------------------------------------------
+
+
+def _layer_specs(cfg: ErnieConfig) -> Dict[str, Any]:
+    h, nh, hd, ffn = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim, cfg.ffn_hidden_size
+    w = normal_init(cfg.initializer_range)
+    return {
+        "attn": {
+            "qkv_kernel": ParamSpec((h, 3, nh, hd), ("embed", None, "heads", "kv"), w),
+            "qkv_bias": ParamSpec((3, nh, hd), (None, "heads", "kv"), zeros_init()),
+            "out_kernel": ParamSpec((nh, hd, h), ("heads", "kv", "embed"), w),
+            "out_bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+        "ln_1": {  # post-attention LN
+            "scale": ParamSpec((h,), ("embed",), ones_init()),
+            "bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+        "mlp": {
+            "fc_in_kernel": ParamSpec((h, ffn), ("embed", "mlp"), w),
+            "fc_in_bias": ParamSpec((ffn,), ("mlp",), zeros_init()),
+            "fc_out_kernel": ParamSpec((ffn, h), ("mlp", "embed"), w),
+            "fc_out_bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+        "ln_2": {  # post-FFN LN
+            "scale": ParamSpec((h,), ("embed",), ones_init()),
+            "bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+    }
+
+
+def ernie_specs(cfg: ErnieConfig) -> Dict[str, Any]:
+    h = cfg.hidden_size
+    w = normal_init(cfg.initializer_range)
+    specs: Dict[str, Any] = {
+        "embeddings": {
+            "word": ParamSpec((cfg.vocab_size, h), ("vocab", "embed"), w),
+            "position": ParamSpec((cfg.max_position_embeddings, h), (None, "embed"), w),
+            "token_type": ParamSpec((cfg.type_vocab_size, h), (None, "embed"), w),
+            "ln": {
+                "scale": ParamSpec((h,), ("embed",), ones_init()),
+                "bias": ParamSpec((h,), ("embed",), zeros_init()),
+            },
+        },
+        "layers": stack_spec_tree(_layer_specs(cfg), cfg.num_layers),
+        "pooler": {
+            "kernel": ParamSpec((h, h), ("embed", None), w),
+            "bias": ParamSpec((h,), (None,), zeros_init()),
+        },
+        "mlm": {
+            "transform_kernel": ParamSpec((h, h), ("embed", None), w),
+            "transform_bias": ParamSpec((h,), (None,), zeros_init()),
+            "ln": {
+                "scale": ParamSpec((h,), ("embed",), ones_init()),
+                "bias": ParamSpec((h,), ("embed",), zeros_init()),
+            },
+            # decoder weight is tied to embeddings.word; only the bias is new
+            "decoder_bias": ParamSpec((cfg.vocab_size,), ("vocab",), zeros_init()),
+        },
+    }
+    if cfg.binary_head:
+        specs["nsp"] = {
+            "kernel": ParamSpec((h, 2), ("embed", None), w),
+            "bias": ParamSpec((2,), (None,), zeros_init()),
+        }
+    specs["cls_head"] = {
+        "kernel": ParamSpec((h, cfg.num_classes), ("embed", None), w),
+        "bias": ParamSpec((cfg.num_classes,), (None,), zeros_init()),
+    }
+    return specs
+
+
+def init(cfg: ErnieConfig, key: jax.Array) -> Dict[str, Any]:
+    return init_params(key, ernie_specs(cfg))
+
+
+def ernie_logical_axes(cfg: ErnieConfig) -> Dict[str, Any]:
+    return logical_axes(ernie_specs(cfg))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _attention_block(p, x, bias, cfg: ErnieConfig, ctx, key, train):
+    dtype = x.dtype
+    k_attn, k_resid = (jax.random.split(key) if key is not None else (None, None))
+    qkv = jnp.einsum("bsh,htnd->bstnd", x, p["qkv_kernel"].astype(dtype))
+    qkv = qkv + p["qkv_bias"].astype(dtype)[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = _constrain(ctx, q, ("batch", None, "heads", "kv"))
+
+    def core(q, k, v, dk):
+        return attention(
+            q, k, v,
+            impl=cfg.attn_impl,
+            causal=False,
+            bias=bias,
+            dropout_key=dk,
+            dropout_rate=cfg.attention_probs_dropout_prob,
+            train=train,
+        )
+
+    if cfg.use_recompute and cfg.recompute_granularity == "core_attn":
+        core = jax.checkpoint(core)
+    out = core(q, k, v, k_attn)
+    out = jnp.einsum("bsnd,ndh->bsh", out, p["out_kernel"].astype(dtype))
+    out = out + p["out_bias"].astype(dtype)
+    return dropout(k_resid, out, cfg.hidden_dropout_prob, train)
+
+
+def _encoder_layer(p, x, bias, cfg: ErnieConfig, ctx, key, train):
+    """Post-LN encoder block: LN(x + attn(x)); LN(x + ffn(x))."""
+    k_attn, k_mlp = (jax.random.split(key) if key is not None else (None, None))
+    dtype = x.dtype
+
+    x = x + _attention_block(p["attn"], x, bias, cfg, ctx, k_attn, train)
+    x = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"], eps=1e-12)
+    x = _constrain(ctx, x, ("batch", "seq", "embed"))
+
+    h = x @ p["mlp"]["fc_in_kernel"].astype(dtype) + p["mlp"]["fc_in_bias"].astype(dtype)
+    h = _constrain(ctx, h, ("batch", None, "mlp"))
+    h = jax.nn.gelu(h, approximate=True)
+    h = h @ p["mlp"]["fc_out_kernel"].astype(dtype) + p["mlp"]["fc_out_bias"].astype(dtype)
+    h = dropout(k_mlp, h, cfg.hidden_dropout_prob, train)
+    x = layer_norm(x + h, p["ln_2"]["scale"], p["ln_2"]["bias"], eps=1e-12)
+    return _constrain(ctx, x, ("batch", "seq", "embed"))
+
+
+def encode(
+    params: Dict[str, Any],
+    input_ids: jax.Array,
+    cfg: ErnieConfig,
+    *,
+    token_type_ids: Optional[jax.Array] = None,
+    position_ids: Optional[jax.Array] = None,
+    attention_mask: Optional[jax.Array] = None,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """-> (sequence_output [b,s,h], pooled_output [b,h]).
+
+    ``attention_mask``: [b, s] with 1 = attend, 0 = padding (reference
+    derives it from pad_token_id when absent, single_model.py:241-330)."""
+    dtype = jnp.dtype(cfg.dtype)
+    b, s = input_ids.shape
+    if position_ids is None:
+        position_ids = jnp.arange(s, dtype=jnp.int32)[None, :]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros((b, s), jnp.int32)
+    if attention_mask is None:
+        attention_mask = (input_ids != cfg.pad_token_id).astype(jnp.float32)
+
+    k_embed, k_layers = (
+        jax.random.split(dropout_key) if dropout_key is not None else (None, None)
+    )
+
+    emb = params["embeddings"]
+    x = (
+        emb["word"].astype(dtype)[input_ids]
+        + emb["position"].astype(dtype)[position_ids]
+        + emb["token_type"].astype(dtype)[token_type_ids]
+    )
+    x = layer_norm(x, emb["ln"]["scale"], emb["ln"]["bias"], eps=1e-12)
+    x = _constrain(ctx, x, ("batch", "seq", "embed"))
+    x = dropout(k_embed, x, cfg.hidden_dropout_prob, train)
+
+    # additive padding bias [b, 1, 1, s]
+    bias = (1.0 - attention_mask.astype(jnp.float32)) * -1e9
+    bias = bias[:, None, None, :]
+
+    def body(carry, inp):
+        params_l, idx = inp
+        k = jax.random.fold_in(k_layers, idx) if k_layers is not None else None
+        out = _encoder_layer(params_l, carry, bias, cfg, ctx, k, train)
+        return out, None
+
+    body_fn = body
+    if cfg.use_recompute and cfg.recompute_granularity == "full":
+        body_fn = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body_fn, x, (params["layers"], jnp.arange(cfg.num_layers)))
+
+    pooled = jnp.tanh(
+        x[:, 0] @ params["pooler"]["kernel"].astype(dtype)
+        + params["pooler"]["bias"].astype(dtype)
+    )
+    return x, pooled
+
+
+def pretrain_logits(
+    params: Dict[str, Any], sequence_output: jax.Array, pooled: jax.Array, cfg: ErnieConfig,
+    ctx: Optional[ShardingCtx] = None,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """-> (mlm logits [b,s,v], nsp logits [b,2] or None)."""
+    dtype = sequence_output.dtype
+    p = params["mlm"]
+    h = sequence_output @ p["transform_kernel"].astype(dtype) + p["transform_bias"].astype(dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = layer_norm(h, p["ln"]["scale"], p["ln"]["bias"], eps=1e-12)
+    word = params["embeddings"]["word"].astype(dtype)
+    logits = jnp.einsum("bsh,vh->bsv", h, word) + p["decoder_bias"].astype(dtype)
+    logits = _constrain(ctx, logits, ("batch", "seq", "vocab"))
+    nsp = None
+    if cfg.binary_head and "nsp" in params:
+        nsp = pooled @ params["nsp"]["kernel"].astype(dtype) + params["nsp"]["bias"].astype(dtype)
+    return logits, nsp
+
+
+def _token_ce(logits: jax.Array, labels: jax.Array, ignore_index: int = -1) -> jax.Array:
+    """Mean CE over labels != ignore_index, fp32."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (lse - picked) * valid.astype(jnp.float32)
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+
+
+def pretrain_loss(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: ErnieConfig,
+    *,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = True,
+) -> jax.Array:
+    """batch: input_ids, token_type_ids, attention_mask?, masked_lm_labels
+    (-1 for unmasked), next_sentence_label [b] (optional).
+
+    loss = MLM CE + NSP CE (ErniePretrainingCriterion single_model.py:631-644)."""
+    seq_out, pooled = encode(
+        params,
+        batch["input_ids"],
+        cfg,
+        token_type_ids=batch.get("token_type_ids"),
+        attention_mask=batch.get("attention_mask"),
+        ctx=ctx,
+        dropout_key=dropout_key,
+        train=train,
+    )
+    mlm_logits, nsp_logits = pretrain_logits(params, seq_out, pooled, cfg, ctx)
+    loss = _token_ce(mlm_logits, batch["masked_lm_labels"])
+    if nsp_logits is not None and "next_sentence_label" in batch:
+        nsp = nsp_logits.astype(jnp.float32)
+        labels = batch["next_sentence_label"].reshape(-1)
+        nsp_nll = jax.nn.logsumexp(nsp, -1) - jnp.take_along_axis(
+            nsp, labels[:, None], axis=-1
+        )[:, 0]
+        loss = loss + jnp.mean(nsp_nll)
+    return loss
+
+
+def cls_forward(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: ErnieConfig,
+    *,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> jax.Array:
+    """Sequence classification logits [b, num_classes]
+    (ErnieForSequenceClassification single_model.py:647-700)."""
+    k_enc, k_cls = (
+        jax.random.split(dropout_key) if dropout_key is not None else (None, None)
+    )
+    _, pooled = encode(
+        params,
+        batch["input_ids"],
+        cfg,
+        token_type_ids=batch.get("token_type_ids"),
+        attention_mask=batch.get("attention_mask"),
+        ctx=ctx,
+        dropout_key=k_enc,
+        train=train,
+    )
+    pooled = dropout(k_cls, pooled, cfg.hidden_dropout_prob, train)
+    p = params["cls_head"]
+    return pooled @ p["kernel"].astype(pooled.dtype) + p["bias"].astype(pooled.dtype)
+
+
+def cls_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, -1)
+    picked = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(lse - picked)

@@ -1,0 +1,62 @@
+"""ERNIE engine modules (reference ErnieModule / ErnieSeqClsModule,
+ppfleetx/models/language_model/ernie/ernie_module.py:120+)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.core.module import BasicModule
+from paddlefleetx_tpu.models.ernie import model as ernie
+from paddlefleetx_tpu.models.ernie.config import ErnieConfig
+from paddlefleetx_tpu.utils.registry import MODULES
+
+
+def _config_from(cfg) -> ErnieConfig:
+    model_cfg = dict(cfg.Model)
+    model_cfg.pop("module", None)
+    model_cfg.pop("name", None)
+    from paddlefleetx_tpu.core.module import resolve_model_dtype
+
+    resolve_model_dtype(cfg, model_cfg)
+    # reference knob alias: with_nsp_loss toggles the NSP head+loss
+    # (ErniePretrainingCriterion single_model.py:598)
+    if "with_nsp_loss" in model_cfg:
+        model_cfg.setdefault("binary_head", bool(model_cfg.pop("with_nsp_loss")))
+    return ErnieConfig.from_config(model_cfg)
+
+
+@MODULES.register("ErnieModule")
+class ErnieModule(BasicModule):
+    """MLM+NSP pretraining."""
+
+    def __init__(self, cfg):
+        self.config = _config_from(cfg)
+        self.tokens_per_sample = self.config.max_position_embeddings
+        seq = cfg.get("Data", {}).get("Train", {}).get("dataset", {}).get("max_seq_len")
+        if seq:
+            self.tokens_per_sample = int(seq)
+
+    def init_params(self, key):
+        return ernie.init(self.config, key)
+
+    def logical_axes(self):
+        return ernie.ernie_logical_axes(self.config)
+
+    def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=True):
+        return ernie.pretrain_loss(
+            params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
+        )
+
+
+@MODULES.register("ErnieSeqClsModule")
+class ErnieSeqClsModule(ErnieModule):
+    """Sequence-classification finetune (GLUE-style)."""
+
+    def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=True):
+        logits = ernie.cls_forward(
+            params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
+        )
+        return ernie.cls_loss(logits, batch["labels"])
+
+    def eval_metrics(self, loss):
+        return {"loss": loss, "ppl": jnp.exp(loss)}

@@ -276,13 +276,15 @@ def transformer_stack(
             raise ValueError(f"num_layers {cfg.num_layers} not divisible by stages {S}")
         per_stage = cfg.num_layers // S
 
-        def stage_fn(local_params, x_mb, stage):
+        def stage_fn(local_params, x_mb, stage, mb):
             def sbody(carry, inp):
                 params_l, local_idx = inp
-                # dropout key folds on the GLOBAL layer index so pp layouts
-                # reproduce the non-pp dropout pattern
+                # dropout key folds on the GLOBAL layer index AND the
+                # microbatch index — each microbatch must draw its own mask
                 k = (
-                    jax.random.fold_in(key, stage * per_stage + local_idx)
+                    jax.random.fold_in(
+                        jax.random.fold_in(key, stage * per_stage + local_idx), mb
+                    )
                     if key is not None
                     else None
                 )

@@ -167,7 +167,9 @@ def interpolate_pos_embed(pos_embed: jax.Array, new_num_patches: int) -> jax.Arr
 
 
 def _encoder_layer(p, x, cfg: ViTConfig, ctx, key, train):
-    k_attn, k_mlp = (jax.random.split(key) if key is not None else (None, None))
+    k_attn, k_resid, k_mlp = (
+        jax.random.split(key, 3) if key is not None else (None, None, None)
+    )
     dtype = x.dtype
 
     y = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
@@ -181,7 +183,7 @@ def _encoder_layer(p, x, cfg: ViTConfig, ctx, key, train):
     )
     out = jnp.einsum("bsnd,ndh->bsh", out, p["attn"]["out_kernel"].astype(dtype))
     out = out + p["attn"]["out_bias"].astype(dtype)
-    x = x + dropout(k_attn, out, cfg.hidden_dropout_prob, train)
+    x = x + dropout(k_resid, out, cfg.hidden_dropout_prob, train)
 
     y = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
     mp = p["mlp"]

@@ -49,9 +49,11 @@ def pipelined_stack(
 ) -> jax.Array:
     """Run a stacked-layer transformer body as a stage pipeline.
 
-    layer_fn(local_params, x_mb, stage_index) -> y_mb runs this stage's
-    layer block (a lax.scan over the local layers).  ``layers_params`` leaves
-    have leading dim num_layers, sharded over ``stages``; x: [b, s, h].
+    layer_fn(local_params, x_mb, stage_index, mb_index) -> y_mb runs this
+    stage's layer block (a lax.scan over the local layers); ``mb_index`` is
+    the microbatch the stage is processing this tick (for per-microbatch
+    dropout keys).  ``layers_params`` leaves have leading dim num_layers,
+    sharded over ``stages``; x: [b, s, h].
     """
     S, M = pcfg.num_stages, pcfg.num_microbatches
     b = x.shape[0]
@@ -71,7 +73,10 @@ def pipelined_stack(
             mb_idx = jnp.minimum(t, M - 1)
             x0 = jax.lax.dynamic_index_in_dim(mbs, mb_idx, axis=0, keepdims=False)
             x_in = jnp.where(stage == 0, jnp.where(t < M, 1.0, 0.0) * x0, buf)
-            y = layer_fn(local_layers, x_in, stage)
+            # stage s processes microbatch t-s at tick t (clamped: out-of-
+            # range ticks compute on garbage that is never emitted)
+            mb_live = jnp.clip(t - stage, 0, M - 1)
+            y = layer_fn(local_layers, x_in, stage, mb_live)
             # last stage emits microbatch t-(S-1) at tick t
             emit_idx = jnp.maximum(t - (S - 1), 0)
             emit = jnp.where((stage == S - 1) & (t >= S - 1), y, 0.0)

@@ -1,0 +1,183 @@
+"""ERNIE family tests: model numerics, TP parity, dataset invariants."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddlefleetx_tpu.data.ernie_dataset import (
+    ErnieDataset,
+    write_synthetic_sentence_corpus,
+)
+from paddlefleetx_tpu.models.ernie import model as ernie
+from paddlefleetx_tpu.models.ernie.config import ErnieConfig
+from paddlefleetx_tpu.models.gpt.model import ShardingCtx
+from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh
+from paddlefleetx_tpu.parallel.sharding import make_rules, tree_logical_to_sharding
+
+TINY = ErnieConfig(
+    vocab_size=128,
+    hidden_size=32,
+    num_layers=2,
+    num_attention_heads=4,
+    ffn_hidden_size=64,
+    max_position_embeddings=64,
+    dtype="float32",
+)
+
+
+def _batch(cfg, b=2, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(4, cfg.vocab_size, (b, s))
+    labels = np.full((b, s), -1, np.int64)
+    labels[:, 3:6] = ids[:, 3:6]  # pretend these were masked
+    return {
+        "input_ids": jnp.asarray(ids),
+        "token_type_ids": jnp.asarray((np.arange(s)[None] > s // 2).astype(np.int64) * np.ones((b, 1), np.int64)),
+        "attention_mask": jnp.ones((b, s), jnp.float32),
+        "masked_lm_labels": jnp.asarray(labels),
+        "next_sentence_label": jnp.asarray(rng.integers(0, 2, (b,))),
+    }
+
+
+def test_encode_shapes_and_loss():
+    params = ernie.init(TINY, jax.random.key(0))
+    batch = _batch(TINY)
+    seq, pooled = ernie.encode(params, batch["input_ids"], TINY)
+    assert seq.shape == (2, 16, 32)
+    assert pooled.shape == (2, 32)
+    mlm, nsp = ernie.pretrain_logits(params, seq, pooled, TINY)
+    assert mlm.shape == (2, 16, 128) and nsp.shape == (2, 2)
+    loss = ernie.pretrain_loss(params, batch, TINY)
+    assert np.isfinite(float(loss))
+    # random init, uniformish logits: MLM CE ~ ln(V), NSP ~ ln 2
+    assert abs(float(loss) - (np.log(128) + np.log(2))) < 1.0
+
+
+def test_padding_mask_invariance():
+    """Padding tokens must not change unpadded positions' outputs."""
+    params = ernie.init(TINY, jax.random.key(0))
+    rng = np.random.default_rng(1)
+    ids = rng.integers(4, TINY.vocab_size, (1, 12))
+    short, _ = ernie.encode(params, jnp.asarray(ids), TINY)
+    padded = np.concatenate([ids, np.zeros((1, 4), np.int64)], axis=1)
+    mask = np.concatenate([np.ones((1, 12)), np.zeros((1, 4))], axis=1).astype(np.float32)
+    long, _ = ernie.encode(
+        params, jnp.asarray(padded), TINY, attention_mask=jnp.asarray(mask)
+    )
+    np.testing.assert_allclose(np.asarray(short[0]), np.asarray(long[0, :12]), atol=1e-5)
+
+
+def test_cls_loss_decreases_under_grad():
+    cfg = TINY
+    params = ernie.init(cfg, jax.random.key(1))
+    batch = {
+        "input_ids": jnp.asarray(np.random.default_rng(0).integers(4, 128, (4, 16))),
+        "labels": jnp.asarray([0, 1, 0, 1]),
+    }
+
+    def loss(p):
+        return ernie.cls_loss(ernie.cls_forward(p, batch, cfg), batch["labels"])
+
+    l0, g = jax.value_and_grad(loss)(params)
+    params2 = jax.tree.map(lambda p, gg: p - 0.1 * gg, params, g)
+    l1 = loss(params2)
+    assert float(l1) < float(l0)
+
+
+def test_tp_parity(devices8):
+    """mp=4 sharded pretrain loss matches single-device loss."""
+    cfg = TINY
+    params = ernie.init(cfg, jax.random.key(0))
+    batch = _batch(cfg)
+    ref = float(ernie.pretrain_loss(params, batch, cfg))
+
+    mesh = build_mesh(MeshConfig(dp_degree=2, mp_degree=4), jax.devices()[:8])
+    rules = make_rules(mesh=mesh)
+    shardings = tree_logical_to_sharding(ernie.ernie_logical_axes(cfg), mesh, rules)
+    sharded = jax.device_put(params, shardings)
+    ctx = ShardingCtx(mesh, rules)
+    batch_sharding = NamedSharding(mesh, P(("data", "fsdp")))
+    dev_batch = jax.tree.map(lambda x: jax.device_put(x, batch_sharding), batch)
+
+    with mesh:
+        got = float(
+            jax.jit(lambda p, b: ernie.pretrain_loss(p, b, cfg, ctx=ctx))(
+                sharded, dev_batch
+            )
+        )
+    assert abs(got - ref) < 1e-4
+
+
+def test_ernie_dataset(tmp_path):
+    prefix = write_synthetic_sentence_corpus(str(tmp_path / "corpus"), vocab_size=2000)
+    ds = ErnieDataset(input_dir=prefix, max_seq_len=128, vocab_size=2000, seed=7)
+    assert len(ds) > 0
+    item = ds[0]
+    L = 128
+    assert item["input_ids"].shape == (L,)
+    assert item["token_type_ids"].shape == (L,)
+    assert item["masked_lm_labels"].shape == (L,)
+    assert item["next_sentence_label"] in (0, 1)
+    # structure: starts with CLS, contains exactly two SEPs in the live region
+    live = int(item["attention_mask"].sum())
+    assert item["input_ids"][0] == ds.cls_id
+    assert (item["input_ids"][:live] == ds.sep_id).sum() == 2
+    # masking: some positions have labels; every labeled position was a real
+    # token (label >= 4); at least one [MASK] token present
+    labeled = item["masked_lm_labels"] >= 0
+    assert 0 < labeled.sum() <= ds.max_predictions
+    assert (item["masked_lm_labels"][labeled] >= 4).all()
+    # padding region fully dead
+    assert (item["masked_lm_labels"][live:] == -1).all()
+    assert (item["input_ids"][live:] == ds.pad_id).all()
+    # deterministic per index
+    item2 = ds[0]
+    np.testing.assert_array_equal(item["input_ids"], item2["input_ids"])
+    # different indices differ
+    assert not np.array_equal(ds[0]["input_ids"], ds[1]["input_ids"])
+
+
+def test_build_mapping_cpp_matches_structure(tmp_path):
+    """C++ and numpy build_mapping agree on sample structure (not RNG)."""
+    from paddlefleetx_tpu.data.indexed import build_mapping
+
+    rng = np.random.default_rng(0)
+    counts = rng.integers(2, 8, 16).astype(np.int32)
+    docs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    sizes = rng.integers(5, 40, int(counts.sum())).astype(np.int32)
+    # short_seq_prob=0 removes RNG from the walk: outputs must be identical
+    a = build_mapping(docs, sizes, 128, short_seq_prob=0.0, seed=3, use_cpp=True)
+    b = build_mapping(docs, sizes, 128, short_seq_prob=0.0, seed=3, use_cpp=False)
+    np.testing.assert_array_equal(a, b)
+    assert len(a) > 0
+    # sample sentence ranges are within bounds and non-empty
+    assert (a[:, 0] < a[:, 1]).all()
+    assert (a[:, 1] <= docs[-1]).all()
+
+
+def test_ernie_module_registered():
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.utils.config import get_config
+
+    cfg = get_config(
+        os.path.join(os.path.dirname(__file__), "..", "configs/ernie/pretrain_ernie_base.yaml"),
+        overrides=[
+            "Global.global_batch_size=8",
+            "Global.local_batch_size=1",
+            "Global.micro_batch_size=1",
+            "Model.num_layers=2",
+            "Model.hidden_size=32",
+            "Model.num_attention_heads=4",
+            "Model.ffn_hidden_size=64",
+            "Model.vocab_size=128",
+            "Model.max_position_embeddings=64",
+        ],
+    )
+    module = build_module(cfg)
+    params = module.init_params(jax.random.key(0))
+    loss = module.loss_fn(params, _batch(module.config), train=False)
+    assert np.isfinite(float(loss))
