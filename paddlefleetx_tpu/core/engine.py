@@ -252,6 +252,22 @@ class Engine:
 
         return train_step
 
+    def _get_predict_step(self):
+        """Jitted module.predict_fn, built once (recompiling per evaluate()
+        call would retrace every eval round)."""
+        if getattr(self, "_predict_step", None) is None:
+            module, ctx = self.module, self.ctx
+
+            def predict(state, batch):
+                return module.predict_fn(state.params, batch, ctx=ctx)
+
+            self._predict_step = jax.jit(
+                predict,
+                in_shardings=(None, self.batch_spec),
+                out_shardings=self.replicated,
+            )
+        return self._predict_step
+
     def _build_eval_step(self):
         module, ctx = self.module, self.ctx
 
@@ -310,13 +326,32 @@ class Engine:
         # loaders iterate forever (epoch-looping sampler): always bound
         iters = iters if iters is not None else self.eval_iters
         losses = []
+        # modules exposing predict_fn + build_metric (finetune) stream
+        # predictions into a host-side metric accumulator (reference
+        # GPTFinetuneModule validation_step, language_module.py:370-420)
+        metric = None
+        predict = None
+        if hasattr(self.module, "build_metric") and hasattr(self.module, "predict_fn"):
+            metric = self.module.build_metric()
+            if metric is not None:
+                predict = self._get_predict_step()
         it = iter(loader)
         for i, batch in enumerate(it):
             if i >= iters:
                 break
-            losses.append(float(self._eval_step(self.state, self._put_batch(batch))))
+            dev_batch = self._put_batch(batch)
+            losses.append(float(self._eval_step(self.state, dev_batch)))
+            if metric is not None:
+                preds = np.asarray(jax.device_get(predict(self.state, dev_batch)))
+                metric.update(preds, np.asarray(batch["labels"]))
         avg = float(np.mean(losses)) if losses else float("nan")
-        logger.info(f"eval loss: {avg:.5f} (ppl {np.exp(min(avg, 20.0)):.2f})")
+        if metric is not None:
+            from paddlefleetx_tpu.models.metrics import format_metric
+
+            vals = " ".join(f"{k}: {v:.4f}" for k, v in format_metric(metric).items())
+            logger.info(f"eval loss: {avg:.5f} {vals}")
+        else:
+            logger.info(f"eval loss: {avg:.5f} (ppl {np.exp(min(avg, 20.0)):.2f})")
         return avg
 
     # ------------------------------------------------------------------

@@ -154,3 +154,5 @@ def _register_family_modules():
     idempotent because Registry rejects double registration only on distinct
     functions and imports are cached."""
     import paddlefleetx_tpu.models.ernie.module  # noqa: F401
+    import paddlefleetx_tpu.models.gpt.evaluation  # noqa: F401
+    import paddlefleetx_tpu.models.gpt.finetune  # noqa: F401

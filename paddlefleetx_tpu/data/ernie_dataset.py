@@ -84,6 +84,7 @@ class ErnieDataset:
         )
         self._epoch_len = len(self.samples)
         self.num_samples = int(num_samples) if num_samples else self._epoch_len
+        self._visits: Dict[int, int] = {}
 
     def __len__(self) -> int:
         return self.num_samples
@@ -95,7 +96,11 @@ class ErnieDataset:
     def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
         row = self.samples[idx % self._epoch_len]
         sent_begin, sent_end, target_len = int(row[0]), int(row[1]), int(row[2])
-        rng = np.random.default_rng((self.seed, idx))
+        # fresh masking each epoch (visit counter), deterministic per visit
+        # (the reference re-masks per epoch the same way, via epoch seeds)
+        visit = self._visits.get(idx, 0)
+        self._visits[idx] = visit + 1
+        rng = np.random.default_rng((self.seed, idx, visit))
         sents = [self._sentence(s) for s in range(sent_begin, sent_end)]
 
         # --- segment split + NSP label (random A/B swap, BERT-style) ------

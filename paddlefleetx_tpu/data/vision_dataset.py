@@ -23,12 +23,18 @@ def normalize(img: np.ndarray, mean=IMAGENET_MEAN, std=IMAGENET_STD) -> np.ndarr
 
 
 def _resize(img: np.ndarray, size: int) -> np.ndarray:
-    """Bilinear resize shorter side to ``size`` (numpy; no PIL dependency)."""
+    """Bilinear resize shorter side to ``size``, keeping aspect ratio."""
     h, w = img.shape[:2]
     if h < w:
         nh, nw = size, max(1, round(w * size / h))
     else:
         nh, nw = max(1, round(h * size / w)), size
+    return _resize_exact(img, nh, nw)
+
+
+def _resize_exact(img: np.ndarray, nh: int, nw: int) -> np.ndarray:
+    """Bilinear resize to exactly [nh, nw] (numpy; no PIL dependency)."""
+    h, w = img.shape[:2]
     ys = np.linspace(0, h - 1, nh)
     xs = np.linspace(0, w - 1, nw)
     y0 = np.floor(ys).astype(int)
@@ -71,7 +77,11 @@ def build_transforms(ops: Optional[Sequence[Dict]]):
         normalized = False
         for name, kw in specs:
             if name in ("ResizeImage", "Resize"):
-                img = _resize(img, int(kw.get("resize_short", kw.get("size", 256))))
+                if "resize_short" in kw:
+                    img = _resize(img, int(kw["resize_short"]))
+                else:  # 'size' = exact HxW resize (reference semantics)
+                    size = int(kw.get("size", 256))
+                    img = _resize_exact(img, size, size)
             elif name in ("RandCropImage", "RandomResizedCrop"):
                 size = int(kw.get("size", 224))
                 if train:
@@ -126,6 +136,7 @@ class GeneralClsDataset:
                 self.samples.append((path, int(label)))
         self.transform = build_transforms(transform_ops)
         self.seed = int(seed)
+        self._visits: Dict[int, int] = {}
 
     def __len__(self):
         return len(self.samples)
@@ -141,9 +152,12 @@ class GeneralClsDataset:
     def __getitem__(self, idx: int):
         path, label = self.samples[idx]
         img = self._load(path)
-        # per-(seed, idx) stream: reproducible under shuffling and forked
-        # loader workers alike
-        rng = np.random.default_rng((self.seed, idx))
+        # per-(seed, idx, visit) stream: reproducible under shuffling, yet a
+        # fresh augmentation draw each epoch (visit = how many times this
+        # sample has been served)
+        visit = self._visits.get(idx, 0)
+        self._visits[idx] = visit + 1
+        rng = np.random.default_rng((self.seed, idx, visit))
         img = self.transform(img, rng, self.train)
         return {"images": img, "labels": np.int64(label)}
 

@@ -3,8 +3,6 @@ ppfleetx/models/language_model/ernie/ernie_module.py:120+)."""
 
 from __future__ import annotations
 
-import jax.numpy as jnp
-
 from paddlefleetx_tpu.core.module import BasicModule
 from paddlefleetx_tpu.models.ernie import model as ernie
 from paddlefleetx_tpu.models.ernie.config import ErnieConfig
@@ -52,11 +50,23 @@ class ErnieModule(BasicModule):
 class ErnieSeqClsModule(ErnieModule):
     """Sequence-classification finetune (GLUE-style)."""
 
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.metric_cfg = dict(cfg.Model.get("metric", {}) or {})
+
     def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=True):
         logits = ernie.cls_forward(
             params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
         )
         return ernie.cls_loss(logits, batch["labels"])
 
-    def eval_metrics(self, loss):
-        return {"loss": loss, "ppl": jnp.exp(loss)}
+    # metric streaming (consumed by Engine.evaluate)
+    def predict_fn(self, params, batch, *, ctx=None):
+        return ernie.cls_forward(params, batch, self.config, ctx=ctx, train=False)
+
+    def build_metric(self):
+        from paddlefleetx_tpu.models.metrics import Accuracy, build_metric
+
+        if self.metric_cfg.get("eval"):
+            return build_metric(self.metric_cfg["eval"])
+        return Accuracy()

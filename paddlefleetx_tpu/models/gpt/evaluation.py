@@ -1,0 +1,94 @@
+"""GPT zero-shot evaluation module (reference GPTEvalModule
+language_module.py:600-735): WikiText perplexity over overlapping windows
+and LAMBADA last-word accuracy, driven by the LM_Eval_Dataset /
+Lambada_Eval_Dataset (data/gpt_dataset.py)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlefleetx_tpu.core.module import BasicModule, resolve_model_dtype
+from paddlefleetx_tpu.models.gpt import model as gpt
+from paddlefleetx_tpu.models.gpt.config import GPTConfig
+from paddlefleetx_tpu.models.metrics import METRICS, Metric
+from paddlefleetx_tpu.utils.registry import MODULES
+
+
+@METRICS.register("LMEval")
+class LMEvalMetric(Metric):
+    """Accumulates (masked nll sum, mask count, all-correct count, seqs):
+    exact corpus PPL + sequence accuracy from one stream (reference tracks
+    total_score/total_tokens the same way)."""
+
+    def __init__(self, **_):
+        self.reset()
+
+    def update(self, preds, labels=None):
+        # preds: [b, 3] rows (nll_sum, mask_count, all_correct)
+        preds = np.asarray(preds)
+        self.nll += float(preds[:, 0].sum())
+        self.tokens += float(preds[:, 1].sum())
+        self.correct += float(preds[:, 2].sum())
+        self.seqs += preds.shape[0]
+
+    def accumulate(self) -> Dict[str, float]:
+        ppl = float(np.exp(min(self.nll / max(self.tokens, 1.0), 20.0)))
+        return {
+            "ppl": ppl,
+            "acc": self.correct / max(self.seqs, 1),
+            "tokens": self.tokens,
+        }
+
+    def reset(self):
+        self.nll = 0.0
+        self.tokens = 0.0
+        self.correct = 0.0
+        self.seqs = 0
+
+
+@MODULES.register("GPTEvalModule")
+class GPTEvalModule(BasicModule):
+    def __init__(self, cfg):
+        model_cfg = dict(cfg.Model)
+        model_cfg.pop("module", None)
+        model_cfg.pop("name", None)
+        resolve_model_dtype(cfg, model_cfg)
+        self.config = GPTConfig.from_config(model_cfg)
+        self.tokens_per_sample = self.config.max_position_embeddings
+
+    def init_params(self, key):
+        return gpt.init(self.config, key)
+
+    def logical_axes(self):
+        return gpt.gpt_logical_axes(self.config)
+
+    def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=False):
+        return gpt.loss_fn(
+            params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=False
+        )
+
+    def predict_fn(self, params, batch, *, ctx=None):
+        """-> [b, 3] rows (masked nll sum, mask count, all-masked-correct)."""
+        logits = gpt.forward(
+            params,
+            batch["tokens"],
+            self.config,
+            position_ids=batch.get("position_ids"),
+            ctx=ctx,
+            train=False,
+        ).astype(jnp.float32)
+        labels = batch["labels"]
+        mask = batch["loss_mask"].astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        nll = (lse - picked) * mask
+        correct = (jnp.argmax(logits, axis=-1) == labels) | (mask == 0)
+        all_correct = jnp.all(correct, axis=-1).astype(jnp.float32)
+        return jnp.stack([nll.sum(-1), mask.sum(-1), all_correct], axis=-1)
+
+    def build_metric(self):
+        return LMEvalMetric()

@@ -1,0 +1,110 @@
+"""GPT sequence-classification finetuning (reference
+GPTForSequenceClassification single_model.py:856-897 + GPTFinetuneModule
+language_module.py:228-488).
+
+The classifier scores the hidden state of the LAST real token of each
+sequence (decoder-only convention; the reference gathers by position of the
+final non-pad token).  Loss: CE for classification tasks, MSE for the STS-B
+regression task (reference loss config paddle.nn.loss.* dispatch)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.core.module import BasicModule, resolve_model_dtype
+from paddlefleetx_tpu.models.common import ParamSpec, init_params, logical_axes, normal_init, zeros_init
+from paddlefleetx_tpu.models.gpt import model as gpt
+from paddlefleetx_tpu.models.gpt.config import GPTConfig
+from paddlefleetx_tpu.utils.registry import MODULES
+
+
+def seqcls_specs(cfg: GPTConfig, num_classes: int) -> Dict[str, Any]:
+    specs = gpt.gpt_specs(cfg)
+    specs["score"] = {
+        "kernel": ParamSpec(
+            (cfg.hidden_size, num_classes), ("embed", None), normal_init(cfg.initializer_range)
+        ),
+        "bias": ParamSpec((num_classes,), (None,), zeros_init()),
+    }
+    return specs
+
+
+def seqcls_forward(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: GPTConfig,
+    *,
+    ctx=None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> jax.Array:
+    """-> logits [b, num_classes]; batch needs tokens + cls_position."""
+    hidden, _ = gpt.forward_hidden(
+        params,
+        batch["tokens"],
+        cfg,
+        position_ids=batch.get("position_ids"),
+        ctx=ctx,
+        dropout_key=dropout_key,
+        train=train,
+    )
+    # gather the last real token's hidden state per sequence
+    pos = batch["cls_position"].astype(jnp.int32)  # [b]
+    picked = jnp.take_along_axis(hidden, pos[:, None, None], axis=1)[:, 0]  # [b, h]
+    p = params["score"]
+    return picked @ p["kernel"].astype(picked.dtype) + p["bias"].astype(picked.dtype)
+
+
+@MODULES.register("GPTFinetuneModule")
+class GPTFinetuneModule(BasicModule):
+    """GLUE-style finetune: CE (classification) or MSE (regression) on the
+    last-token classifier; eval metric built from ``Model.metric``."""
+
+    def __init__(self, cfg):
+        model_cfg = dict(cfg.Model)
+        model_cfg.pop("module", None)
+        model_cfg.pop("name", None)
+        self.loss_cfg = dict(model_cfg.pop("loss", {}) or {})
+        self.metric_cfg = dict(model_cfg.pop("metric", {}) or {})
+        self.num_classes = int(model_cfg.pop("num_classes", 2))
+        resolve_model_dtype(cfg, model_cfg)
+        self.config = GPTConfig.from_config(model_cfg)
+        self.tokens_per_sample = (
+            int(cfg.get("Data", {}).get("Train", {}).get("dataset", {}).get("max_seq_len", 0))
+            or self.config.max_position_embeddings
+        )
+        train_loss = self.loss_cfg.get("train", {}).get("name", "CrossEntropyLoss")
+        self.regression = train_loss in ("MSELoss", "mse") or self.num_classes == 1
+
+    def init_params(self, key):
+        return init_params(key, seqcls_specs(self.config, self.num_classes))
+
+    def logical_axes(self):
+        return logical_axes(seqcls_specs(self.config, self.num_classes))
+
+    def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=True):
+        logits = seqcls_forward(
+            params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
+        )
+        labels = batch["labels"]
+        if self.regression:
+            return jnp.mean(jnp.square(logits[:, 0].astype(jnp.float32) - labels))
+        logits = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, -1)
+        picked = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+        return jnp.mean(lse - picked)
+
+    # ---- metric protocol (consumed by Engine.evaluate) -----------------
+    def predict_fn(self, params, batch, *, ctx=None):
+        logits = seqcls_forward(params, batch, self.config, ctx=ctx, train=False)
+        return logits[:, 0] if self.regression else logits
+
+    def build_metric(self):
+        from paddlefleetx_tpu.models.metrics import build_metric
+
+        if self.metric_cfg.get("eval"):
+            return build_metric(self.metric_cfg["eval"])
+        return None

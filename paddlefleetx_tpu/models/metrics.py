@@ -1,0 +1,237 @@
+"""Evaluation metrics for finetuning (reference
+ppfleetx/models/language_model/metrics.py:31,180,305,445 — AccuracyAndF1,
+Mcc, PearsonAndSpearman, MultiLabelsMetric — same update/accumulate/reset
+streaming protocol, implemented in numpy on host; predictions stream out of
+jitted eval steps as arrays)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from paddlefleetx_tpu.utils.registry import Registry
+
+METRICS = Registry("metric")
+
+
+class Metric:
+    """Streaming metric: update(preds, labels) per batch; accumulate() -> value(s)."""
+
+    def update(self, preds: np.ndarray, labels: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+@METRICS.register("Accuracy")
+class Accuracy(Metric):
+    def __init__(self, **_):
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim > 1:
+            preds = preds.argmax(-1)
+        labels = np.asarray(labels).reshape(preds.shape)
+        self._correct += int((preds == labels).sum())
+        self._total += preds.size
+
+    def accumulate(self) -> float:
+        return self._correct / max(self._total, 1)
+
+    def reset(self):
+        self._correct = 0
+        self._total = 0
+
+
+@METRICS.register("AccuracyAndF1")
+class AccuracyAndF1(Metric):
+    """Binary accuracy + precision/recall/F1 (reference metrics.py:31-178;
+    positive class = ``pos_label``).  accumulate() returns
+    (acc, precision, recall, f1, (acc+f1)/2) like the reference."""
+
+    def __init__(self, pos_label: int = 1, **_):
+        self.pos_label = pos_label
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim > 1:
+            preds = preds.argmax(-1)
+        labels = np.asarray(labels).reshape(preds.shape)
+        pos = preds == self.pos_label
+        true = labels == self.pos_label
+        self.tp += int((pos & true).sum())
+        self.fp += int((pos & ~true).sum())
+        self.fn += int((~pos & true).sum())
+        self.tn += int((~pos & ~true).sum())
+
+    def accumulate(self) -> Tuple[float, float, float, float, float]:
+        total = self.tp + self.fp + self.fn + self.tn
+        acc = (self.tp + self.tn) / max(total, 1)
+        precision = self.tp / max(self.tp + self.fp, 1)
+        recall = self.tp / max(self.tp + self.fn, 1)
+        f1 = (
+            2 * precision * recall / (precision + recall)
+            if precision + recall > 0
+            else 0.0
+        )
+        return acc, precision, recall, f1, (acc + f1) / 2
+
+    def reset(self):
+        self.tp = self.fp = self.fn = self.tn = 0
+
+
+@METRICS.register("Mcc")
+class Mcc(Metric):
+    """Matthews correlation coefficient (reference metrics.py:180-302)."""
+
+    def __init__(self, **_):
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim > 1:
+            preds = preds.argmax(-1)
+        labels = np.asarray(labels).reshape(preds.shape)
+        pos = preds == 1
+        true = labels == 1
+        self.tp += int((pos & true).sum())
+        self.fp += int((pos & ~true).sum())
+        self.fn += int((~pos & true).sum())
+        self.tn += int((~pos & ~true).sum())
+
+    def accumulate(self) -> float:
+        num = self.tp * self.tn - self.fp * self.fn
+        den = (
+            (self.tp + self.fp)
+            * (self.tp + self.fn)
+            * (self.tn + self.fp)
+            * (self.tn + self.fn)
+        )
+        return num / np.sqrt(den) if den > 0 else 0.0
+
+    def reset(self):
+        self.tp = self.fp = self.fn = self.tn = 0
+
+
+@METRICS.register("PearsonAndSpearman")
+class PearsonAndSpearman(Metric):
+    """Regression correlations (reference metrics.py:305-441).  accumulate()
+    -> (pearson, spearman, mean)."""
+
+    def __init__(self, **_):
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds, np.float64).reshape(-1)
+        labels = np.asarray(labels, np.float64).reshape(-1)
+        self.preds.append(preds)
+        self.labels.append(labels)
+
+    def accumulate(self) -> Tuple[float, float, float]:
+        p = np.concatenate(self.preds) if self.preds else np.zeros(0)
+        l = np.concatenate(self.labels) if self.labels else np.zeros(0)
+        if len(p) < 2:
+            return 0.0, 0.0, 0.0
+        pearson = float(np.corrcoef(p, l)[0, 1])
+        spearman = float(np.corrcoef(_rank(p), _rank(l))[0, 1])
+        return pearson, spearman, (pearson + spearman) / 2
+
+    def reset(self):
+        self.preds = []
+        self.labels = []
+
+
+def _rank(x: np.ndarray) -> np.ndarray:
+    """Average ranks (ties get mean rank), matching scipy.stats.rankdata."""
+    order = np.argsort(x, kind="stable")
+    ranks = np.empty(len(x), np.float64)
+    ranks[order] = np.arange(1, len(x) + 1)
+    # average tied groups
+    sorted_x = x[order]
+    i = 0
+    while i < len(x):
+        j = i
+        while j + 1 < len(x) and sorted_x[j + 1] == sorted_x[i]:
+            j += 1
+        if j > i:
+            ranks[order[i : j + 1]] = ranks[order[i : j + 1]].mean()
+        i = j + 1
+    return ranks
+
+
+@METRICS.register("MultiLabelsMetric")
+class MultiLabelsMetric(Metric):
+    """Multi-class precision/recall/F1 with micro/macro averaging
+    (reference metrics.py:445-688)."""
+
+    def __init__(self, num_labels: int, **_):
+        assert num_labels > 1
+        self.num_labels = num_labels
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim > 1:
+            preds = preds.argmax(-1)
+        labels = np.asarray(labels).reshape(preds.shape)
+        for c in range(self.num_labels):
+            pos = preds == c
+            true = labels == c
+            self.tp[c] += int((pos & true).sum())
+            self.fp[c] += int((pos & ~true).sum())
+            self.fn[c] += int((~pos & true).sum())
+
+    def accumulate(self, average: Optional[str] = None, pos_label: int = 1):
+        def prf(tp, fp, fn):
+            p = tp / max(tp + fp, 1)
+            r = tp / max(tp + fn, 1)
+            f = 2 * p * r / (p + r) if p + r > 0 else 0.0
+            return p, r, f
+
+        if average == "micro":
+            return prf(self.tp.sum(), self.fp.sum(), self.fn.sum())
+        if average == "macro":
+            per = [prf(self.tp[c], self.fp[c], self.fn[c]) for c in range(self.num_labels)]
+            arr = np.asarray(per)
+            return tuple(arr.mean(0))
+        if average is None:
+            return prf(self.tp[pos_label], self.fp[pos_label], self.fn[pos_label])
+        raise ValueError(f"unknown average {average!r}")
+
+    def reset(self):
+        self.tp = np.zeros(self.num_labels, np.int64)
+        self.fp = np.zeros(self.num_labels, np.int64)
+        self.fn = np.zeros(self.num_labels, np.int64)
+
+
+def build_metric(cfg) -> Metric:
+    cfg = dict(cfg)
+    name = cfg.pop("name")
+    return METRICS.get(name)(**cfg)
+
+
+def format_metric(m: Metric) -> Dict[str, float]:
+    """Flatten accumulate() output into a {name: value} dict for logging."""
+    val = m.accumulate()
+    if isinstance(val, dict):
+        return {k: float(v) for k, v in val.items()}
+    if isinstance(val, tuple):
+        if isinstance(m, AccuracyAndF1):
+            keys = ("acc", "precision", "recall", "f1", "acc_and_f1")
+        elif isinstance(m, PearsonAndSpearman):
+            keys = ("pearson", "spearman", "corr")
+        else:
+            keys = tuple(f"v{i}" for i in range(len(val)))
+        return {k: float(v) for k, v in zip(keys, val)}
+    return {m.name.lower() if isinstance(m.name, str) else "metric": float(val)}

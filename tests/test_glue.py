@@ -1,0 +1,171 @@
+"""GLUE datasets, metrics, and GPT finetune module tests."""
+
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.data.glue_dataset import (
+    GLUEDataset,
+    TASK_METRICS,
+    write_synthetic_glue_task,
+)
+from paddlefleetx_tpu.models.metrics import (
+    Accuracy,
+    AccuracyAndF1,
+    Mcc,
+    MultiLabelsMetric,
+    PearsonAndSpearman,
+    build_metric,
+    format_metric,
+)
+
+
+def test_accuracy():
+    m = Accuracy()
+    m.update(np.array([[0.9, 0.1], [0.2, 0.8]]), np.array([0, 0]))
+    assert m.accumulate() == 0.5
+    m.reset()
+    m.update(np.array([1, 1]), np.array([1, 1]))
+    assert m.accumulate() == 1.0
+
+
+def test_accuracy_and_f1_matches_sklearn_formulas():
+    preds = np.array([1, 1, 0, 1, 0, 0, 1, 0])
+    labels = np.array([1, 0, 0, 1, 1, 0, 1, 1])
+    m = AccuracyAndF1()
+    m.update(preds, labels)
+    acc, p, r, f1, avg = m.accumulate()
+    # tp=3 fp=1 fn=2 tn=2
+    assert acc == pytest.approx(5 / 8)
+    assert p == pytest.approx(3 / 4)
+    assert r == pytest.approx(3 / 5)
+    assert f1 == pytest.approx(2 * (3 / 4) * (3 / 5) / (3 / 4 + 3 / 5))
+    assert avg == pytest.approx((acc + f1) / 2)
+
+
+def test_mcc_known_value():
+    # perfectly correlated -> 1.0; anti-correlated -> -1.0
+    m = Mcc()
+    m.update(np.array([1, 0, 1, 0]), np.array([1, 0, 1, 0]))
+    assert m.accumulate() == pytest.approx(1.0)
+    m.reset()
+    m.update(np.array([1, 0, 1, 0]), np.array([0, 1, 0, 1]))
+    assert m.accumulate() == pytest.approx(-1.0)
+
+
+def test_pearson_spearman():
+    m = PearsonAndSpearman()
+    x = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    m.update(x, 2 * x + 1)  # perfect linear
+    pear, spear, avg = m.accumulate()
+    assert pear == pytest.approx(1.0)
+    assert spear == pytest.approx(1.0)
+    m.reset()
+    m.update(x, np.array([1.0, 4.0, 9.0, 16.0, 25.0]))  # monotone nonlinear
+    pear, spear, _ = m.accumulate()
+    assert spear == pytest.approx(1.0)
+    assert pear < 1.0
+
+
+def test_multilabels_micro_macro():
+    m = MultiLabelsMetric(num_labels=3)
+    m.update(np.array([0, 1, 2, 1, 0]), np.array([0, 1, 1, 1, 2]))
+    micro_p, micro_r, micro_f = m.accumulate(average="micro")
+    assert micro_p == pytest.approx(3 / 5)
+    p1, r1, f1 = m.accumulate(pos_label=1)
+    assert p1 == pytest.approx(1.0) and r1 == pytest.approx(2 / 3)
+    macro = m.accumulate(average="macro")
+    assert len(macro) == 3
+
+
+def test_metric_registry_and_format():
+    m = build_metric({"name": "AccuracyAndF1"})
+    m.update(np.array([1, 0]), np.array([1, 0]))
+    d = format_metric(m)
+    assert set(d) == {"acc", "precision", "recall", "f1", "acc_and_f1"}
+    assert d["acc"] == 1.0
+
+
+def test_glue_dataset_gpt_style(tmp_path):
+    root = write_synthetic_glue_task(str(tmp_path / "sst2"), "sst2", n=32)
+    ds = GLUEDataset(task="SST-2", root=root, max_seq_len=32, style="gpt")
+    assert len(ds) == 32
+    item = ds[0]
+    assert item["tokens"].shape == (32,)
+    assert 0 <= item["cls_position"] < 32
+    assert item["labels"] in (0, 1)
+    # cls_position points at the last non-pad token
+    n = int(item["cls_position"]) + 1
+    assert (item["tokens"][n:] == 0).all()
+
+
+def test_glue_dataset_bert_style(tmp_path):
+    root = write_synthetic_glue_task(str(tmp_path / "sst2"), "sst2", n=16)
+    ds = GLUEDataset(task="sst2", root=root, max_seq_len=32, style="bert")
+    item = ds[0]
+    assert item["input_ids"][0] == ds.cls_id
+    live = int(item["attention_mask"].sum())
+    assert item["input_ids"][live - 1] == ds.sep_id
+    assert set(TASK_METRICS) == {
+        "cola", "sst2", "mrpc", "stsb", "qqp", "mnli", "qnli", "rte", "wnli",
+    }
+
+
+def test_gpt_finetune_learns(tmp_path):
+    """End-to-end: tiny GPT finetune on synthetic SST-2 via the Engine, with
+    metric-streaming eval; accuracy must beat chance."""
+    import jax
+
+    from paddlefleetx_tpu.core.engine import Engine
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.data.builders import build_dataloader
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import get_config
+    import os
+
+    root = write_synthetic_glue_task(str(tmp_path / "sst2"), "sst2", n=64, seed=3)
+    cfg = get_config(
+        os.path.join(os.path.dirname(__file__), "..", "configs/gpt/finetune_gpt_345M_glue.yaml"),
+        overrides=[
+            "Global.global_batch_size=16",
+            "Global.local_batch_size=2",
+            "Global.micro_batch_size=2",
+            "Engine.max_steps=30",
+            "Engine.eval_freq=0",
+            "Engine.logging_freq=10",
+            "Engine.save_load.save_steps=0",
+            "Model.vocab_size=30100",
+            "Model.hidden_size=64",
+            "Model.num_layers=2",
+            "Model.num_attention_heads=4",
+            "Model.max_position_embeddings=64",
+            "Model.attn_impl=xla",
+            "Model.hidden_dropout_prob=0.0",
+            "Model.attention_probs_dropout_prob=0.0",
+            f"Data.Train.dataset.root={root}",
+            "Data.Train.dataset.max_seq_len=32",
+            f"Data.Eval.dataset.root={root}",
+            "Data.Eval.dataset.max_seq_len=32",
+            "Optimizer.lr.learning_rate=1.0e-3",
+            "Optimizer.lr.total_steps=30",
+        ],
+    )
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        train_loader = build_dataloader(cfg, "Train")
+        engine.fit(train_loader)
+        eval_loader = build_dataloader(cfg, "Eval")
+        metric = module.build_metric()
+        assert metric is not None
+        # manual metric pass (evaluate() logs it; assert via direct stream)
+        import numpy as np
+
+        preds_fn = jax.jit(lambda p, b: module.predict_fn(p, b, ctx=engine.ctx))
+        for i, batch in enumerate(eval_loader):
+            if i >= 4:
+                break
+            dev = engine._put_batch(batch)
+            metric.update(np.asarray(preds_fn(engine.state.params, dev)), batch["labels"])
+        acc = metric.accumulate()
+        assert acc > 0.8, f"finetune failed to learn: acc={acc}"
