@@ -51,9 +51,13 @@ class TrainState:
     step: jax.Array
     params: Any
     opt_state: Any
+    # non-gradient mutable state (BN running stats, MoCo queue/momentum
+    # params — the reference carries these as buffers/stop-gradient params,
+    # e.g. moco.py:130-159); None for stateless modules
+    extra: Any = None
 
     def tree_flatten(self):
-        return (self.step, self.params, self.opt_state), None
+        return (self.step, self.params, self.opt_state, self.extra), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -159,12 +163,22 @@ class Engine:
             opt_shapes, params_shapes, self.param_shardings, self.mesh
         )
 
+        has_extra = getattr(self.module, "has_extra_state", False)
+        if has_extra:
+            extra_logical = self.module.extra_logical_axes()
+            self.extra_shardings = tree_logical_to_sharding(
+                extra_logical, self.mesh, self.rules
+            )
+        else:
+            self.extra_shardings = None
+
         @functools.partial(
             jax.jit,
             out_shardings=TrainState(
                 step=self.replicated,
                 params=self.param_shardings,
                 opt_state=self.opt_shardings,
+                extra=self.extra_shardings,
             ),
         )
         def make_state(key):
@@ -173,10 +187,15 @@ class Engine:
                 step=jnp.zeros((), jnp.int32),
                 params=params,
                 opt_state=self.tx.init(params),
+                extra=self.module.init_extra(key, params) if has_extra else None,
             )
 
         t0 = time.time()
         state = make_state(key)
+        if hasattr(self.module, "post_init_state"):
+            # module hook for installing pretrained weights into fresh state
+            # (e.g. MOCOClsModule's frozen backbone, moco_module.py:160-180)
+            state = self.module.post_init_state(self, state)
         n_params = sum(x.size for x in jax.tree.leaves(state.params))
         logger.info(
             f"init: {n_params/1e6:.1f}M params sharded over {self.mesh.size} devices "
@@ -188,6 +207,7 @@ class Engine:
     def _build_train_step(self):
         module, ctx, tx = self.module, self.ctx, self.tx
         accum = self.accumulate_steps
+        has_extra = getattr(module, "has_extra_state", False)
 
         @functools.partial(
             jax.jit,
@@ -201,33 +221,41 @@ class Engine:
             base_key = get_seed_tracker().key("global")
             step_key = jax.random.fold_in(base_key, state.step)
 
+            def run_loss(p, mb, extra):
+                if has_extra:
+                    return module.loss_fn(
+                        p, mb, ctx=ctx, extra=extra, dropout_key=step_key, train=True
+                    )
+                loss = module.loss_fn(
+                    p, mb, ctx=ctx, dropout_key=step_key, train=True
+                )
+                return loss, None
+
             def micro_batches(b):
                 return jax.tree.map(
                     lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]), b
                 )
 
             def micro(carry, mb):
-                gacc, lacc = carry
-                loss, grads = jax.value_and_grad(
-                    lambda p: module.loss_fn(
-                        p, mb, ctx=ctx, dropout_key=step_key, train=True
-                    )
-                )(state.params)
-                return (jax.tree.map(jnp.add, gacc, grads), lacc + loss), None
+                gacc, lacc, extra = carry
+                (loss, new_extra), grads = jax.value_and_grad(
+                    run_loss, has_aux=True
+                )(state.params, mb, extra)
+                return (jax.tree.map(jnp.add, gacc, grads), lacc + loss, new_extra), None
 
             zeros = jax.tree.map(jnp.zeros_like, state.params)
             if accum > 1:
-                (gsum, lsum), _ = jax.lax.scan(
-                    micro, (zeros, jnp.zeros((), jnp.float32)), micro_batches(batch)
+                (gsum, lsum, new_extra), _ = jax.lax.scan(
+                    micro,
+                    (zeros, jnp.zeros((), jnp.float32), state.extra),
+                    micro_batches(batch),
                 )
                 grads = jax.tree.map(lambda g: g / accum, gsum)
                 loss = lsum / accum
             else:
-                loss, grads = jax.value_and_grad(
-                    lambda p: module.loss_fn(
-                        p, batch, ctx=ctx, dropout_key=step_key, train=True
-                    )
-                )(state.params)
+                (loss, new_extra), grads = jax.value_and_grad(
+                    run_loss, has_aux=True
+                )(state.params, batch, state.extra)
 
             gnorm = optax.global_norm(grads)
             finite = jnp.isfinite(gnorm)
@@ -241,7 +269,12 @@ class Engine:
             new_opt = jax.tree.map(
                 lambda n, o: jnp.where(finite, n, o), new_opt, state.opt_state
             )
-            new_state = TrainState(state.step + 1, new_params, new_opt)
+            # extra (queue/BN/EMA) must revert too: a NaN forward would
+            # otherwise poison enqueued keys / running stats permanently
+            new_extra = jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_extra, state.extra
+            )
+            new_state = TrainState(state.step + 1, new_params, new_opt, new_extra)
             metrics = {
                 "loss": loss,
                 "grad_norm": gnorm,
@@ -271,8 +304,15 @@ class Engine:
     def _build_eval_step(self):
         module, ctx = self.module, self.ctx
 
+        has_extra = getattr(module, "has_extra_state", False)
+
         @functools.partial(jax.jit, in_shardings=(None, self.batch_spec), out_shardings=self.replicated)
         def eval_step(state: TrainState, batch):
+            if has_extra:
+                loss, _ = module.loss_fn(
+                    state.params, batch, ctx=ctx, extra=state.extra, train=False
+                )
+                return loss
             return module.loss_fn(state.params, batch, ctx=ctx, train=False)
 
         return eval_step
@@ -362,11 +402,10 @@ class Engine:
         step = int(self.state.step)
         path = os.path.abspath(path or os.path.join(self.output_dir, f"step_{step}"))
         ckptr = ocp.StandardCheckpointer()
-        ckptr.save(
-            os.path.join(path, "state"),
-            {"params": self.state.params, "opt_state": self.state.opt_state},
-            force=True,
-        )
+        payload = {"params": self.state.params, "opt_state": self.state.opt_state}
+        if self.state.extra is not None:
+            payload["extra"] = self.state.extra
+        ckptr.save(os.path.join(path, "state"), payload, force=True)
         ckptr.wait_until_finished()
         meta = {"step": step, "consumed_samples": self._consumed_samples}
         with open(os.path.join(path, "meta.json"), "w") as f:
@@ -395,6 +434,12 @@ class Engine:
                 self.opt_shardings,
             ),
         }
+        if self.state.extra is not None:
+            target["extra"] = jax.tree.map(
+                lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+                self.state.extra,
+                self.extra_shardings,
+            )
         restored = ckptr.restore(os.path.join(path, "state"), target)
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
@@ -404,5 +449,6 @@ class Engine:
             step=jnp.asarray(meta["step"], jnp.int32),
             params=restored["params"],
             opt_state=restored["opt_state"],
+            extra=restored.get("extra"),
         )
         logger.info(f"loaded checkpoint: {path} (step {meta['step']})")

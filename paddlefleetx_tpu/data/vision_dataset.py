@@ -143,7 +143,7 @@ class GeneralClsDataset:
 
     def _load(self, path: str) -> np.ndarray:
         full = os.path.join(self.root, path)
-        if full.endswith(".npy"):
+        if full.lower().endswith(".npy"):
             return np.load(full)
         from PIL import Image  # lazy: PIL only needed for real image files
 
@@ -194,3 +194,76 @@ class SyntheticClsDataset:
         label = int(self.labels[idx])
         img = self.patterns[label] + 0.5 * rng.normal(0, 1, self.patterns[label].shape)
         return {"images": img.astype(np.float32), "labels": np.int64(label)}
+
+
+@DATASETS.register("ImageFolder")
+class ImageFolder(GeneralClsDataset):
+    """Directory-per-class layout (reference ImageFolder vision_dataset.py:112:
+    ``root/<class>/<image>`` with classes sorted alphabetically).  Shares
+    loading/augmentation with GeneralClsDataset; only sample discovery differs."""
+
+    IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp", ".npy")
+
+    def __init__(self, root: str, mode: str = "Train", transform_ops=None,
+                 seed: int = 1024, **_unused):
+        self.root = root
+        self.train = mode == "Train"
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        if not classes:
+            raise FileNotFoundError(f"no class folders under {root}")
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(self.IMG_EXTS):
+                    self.samples.append((os.path.join(c, fname), self.class_to_idx[c]))
+        self.transform = build_transforms(transform_ops)
+        self.seed = int(seed)
+        self._visits = {}
+
+
+@DATASETS.register("ContrastiveLearningDataset")
+@DATASETS.register("ContrativeLearningDataset")  # reference spelling (:29)
+class ContrastiveLearningDataset:
+    """Two independently-augmented views per image for MoCo
+    (reference vision_dataset.py ContrativeLearningDataset): returns
+    ``img_q`` / ``img_k`` drawn from the same underlying sample."""
+
+    def __init__(self, base: Optional[Dict] = None, root: Optional[str] = None,
+                 cls_label_path: Optional[str] = None, mode: str = "Train",
+                 transform_ops=None, seed: int = 1024, **kw):
+        if base is not None:
+            base = dict(base)
+            name = base.pop("name")
+            base.setdefault("mode", mode)
+            self.base = DATASETS.get(name)(**base)
+        elif cls_label_path is not None:
+            self.base = GeneralClsDataset(
+                image_root=root or ".", cls_label_path=cls_label_path, mode=mode,
+                transform_ops=transform_ops, seed=seed, **kw)
+        else:
+            self.base = SyntheticClsDataset(mode=mode, seed=seed, **kw)
+
+        self.seed = int(seed)
+        self._visits: Dict[int, int] = {}
+
+    def __len__(self):
+        return len(self.base)
+
+    def _augment(self, img: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        # view-specific augmentation on top of the base transform (MoCo's two
+        # views must differ even when the base pipeline is deterministic)
+        if rng.random() < 0.5:
+            img = img[:, ::-1]
+        return img + rng.normal(0, 0.05, img.shape).astype(np.float32)
+
+    def __getitem__(self, idx: int):
+        visit = self._visits.get(idx, 0)
+        self._visits[idx] = visit + 1
+        img = self.base[idx]["images"]  # load once, augment twice
+        q = self._augment(img, np.random.default_rng((self.seed, idx, visit, 0)))
+        k = self._augment(img, np.random.default_rng((self.seed, idx, visit, 1)))
+        return {"img_q": np.ascontiguousarray(q), "img_k": np.ascontiguousarray(k)}

@@ -1,0 +1,9 @@
+"""Vision model family: ResNet backbones, MoCo v1/v2 contrastive pretrain,
+vision losses and metrics.
+
+Reference surface: ppfleetx/models/vision_model/{resnet,moco,loss,metrics}
+(resnet re-exported from paddle.vision — here implemented natively,
+NHWC + XLA convs for the TPU MXU).
+"""
+
+from paddlefleetx_tpu.models.vision import loss, metrics, moco, resnet  # noqa: F401

@@ -1,0 +1,49 @@
+"""Vision losses (reference ppfleetx/models/vision_model/loss/cross_entropy.py).
+
+``ce_loss``  = CELoss: softmax CE, optional label smoothing, accepts int
+labels or soft-label distributions (:25-61).
+``vit_ce_loss`` = ViTCELoss: sigmoid (binary CE over one-hot) with
+ViT-style additive smoothing ``y*(1-eps)+eps`` (:64-93).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _one_hot_if_needed(labels: jax.Array, num_classes: int) -> jax.Array:
+    if labels.ndim >= 1 and labels.shape[-1] == num_classes and jnp.issubdtype(
+        labels.dtype, jnp.floating
+    ):
+        return labels
+    return jax.nn.one_hot(labels.reshape(-1), num_classes, dtype=jnp.float32)
+
+
+def ce_loss(
+    logits: jax.Array, labels: jax.Array, epsilon: Optional[float] = None
+) -> jax.Array:
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    target = _one_hot_if_needed(labels, num_classes)
+    if epsilon is not None:
+        # paddle F.label_smooth: y*(1-eps) + eps/num_classes
+        target = target * (1.0 - epsilon) + epsilon / num_classes
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.mean(-jnp.sum(target * logp, axis=-1))
+
+
+def vit_ce_loss(
+    logits: jax.Array, labels: jax.Array, epsilon: Optional[float] = None
+) -> jax.Array:
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    target = _one_hot_if_needed(labels, num_classes)
+    if epsilon is not None:
+        target = target * (1.0 - epsilon) + epsilon
+    per_class = jnp.maximum(logits, 0) - logits * target + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+    return jnp.mean(jnp.sum(per_class, axis=-1))

@@ -1,0 +1,22 @@
+"""Vision metrics (reference ppfleetx/models/vision_model/metrics/accuracy.py
+TopkAcc :19-43 — top-1/top-5 accuracy over logits)."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def topk_acc(
+    logits: jax.Array, labels: jax.Array, topk: Sequence[int] = (1, 5)
+) -> Dict[str, jax.Array]:
+    labels = labels.reshape(-1)
+    k_max = max(topk)
+    _, pred = jax.lax.top_k(logits, k_max)  # [b, k_max]
+    hit = pred == labels[:, None]
+    out = {}
+    for k in topk:
+        out[f"top{k}"] = jnp.mean(jnp.any(hit[:, :k], axis=-1).astype(jnp.float32))
+    return out

@@ -1,0 +1,196 @@
+"""MoCo v1/v2 momentum-contrast pretraining, functional.
+
+Reference: ppfleetx/models/vision_model/moco/moco.py (MoCo :94-246,
+MoCoV2Projector :50, MoCoClassifier :70).  Mapping to the functional design:
+
+  base encoder params        -> trainable ``params``
+  momentum encoder params    -> ``extra['momentum']`` (EMA-updated, no grads)
+  queue / queue_ptr buffers  -> ``extra['queue']`` / ``extra['ptr']``
+  BN running stats (both)    -> ``extra['bn']`` / ``extra['bn_m']``
+
+The reference's cross-GPU machinery maps as:
+  concat_all_gather (moco.py:35-46)  -> nothing: under pjit the batch IS
+    global, so keys enqueued per step are already the full global batch
+  _batch_shuffle_ddp (:162-187)      -> one global random permutation of the
+    key batch before the momentum encoder, inverted after — same semantics
+    (defeat BN information leakage), no explicit collectives
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.models.common import (
+    ParamSpec,
+    init_params,
+    logical_axes as spec_logical_axes,
+    normal_init,
+    zeros_init,
+)
+from paddlefleetx_tpu.models.vision import resnet
+
+
+@dataclasses.dataclass(frozen=True)
+class MoCoConfig:
+    depth: int = 50
+    dim: int = 128  # output embedding dim
+    K: int = 65536  # queue length
+    m: float = 0.999  # momentum coefficient
+    T: float = 0.07  # softmax temperature
+    v2: bool = False  # v2 = extra MLP projector (MoCoV2Projector)
+    # loss_fn runs once per micro-batch; with grad accumulation the EMA is
+    # applied accumulate_steps times per optimizer step, so use m^(1/accum)
+    # per call to keep the per-step momentum exactly m (reference applies it
+    # once per step, moco.py:135-144)
+    ema_substeps: int = 1
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def from_config(cls, cfg: Dict[str, Any]) -> "MoCoConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in cfg.items() if k in known}
+        if isinstance(kw.get("dtype"), str):
+            kw["dtype"] = jnp.dtype(kw["dtype"]).type
+        return cls(**kw)
+
+    @property
+    def backbone(self) -> resnet.ResNetConfig:
+        return resnet.ResNetConfig(depth=self.depth, num_classes=0, dtype=self.dtype)
+
+
+def _encoder_param_specs(cfg: MoCoConfig) -> Dict[str, Any]:
+    f = cfg.backbone.num_features
+    specs: Dict[str, Any] = {"backbone": resnet.param_specs(cfg.backbone)}
+    if cfg.v2:
+        specs["proj"] = {
+            "kernel": ParamSpec((f, f), (None, None), normal_init(1.0 / math.sqrt(f))),
+            "bias": ParamSpec((f,), (None,), zeros_init()),
+        }
+    # MoCoClassifier: normal(std=0.01) fc (moco.py:82-86)
+    specs["cls"] = {
+        "kernel": ParamSpec((f, cfg.dim), (None, None), normal_init(0.01)),
+        "bias": ParamSpec((cfg.dim,), (None,), zeros_init()),
+    }
+    return specs
+
+
+def param_specs(cfg: MoCoConfig) -> Dict[str, Any]:
+    return _encoder_param_specs(cfg)
+
+
+def extra_specs(cfg: MoCoConfig) -> Dict[str, Any]:
+    enc = _encoder_param_specs(cfg)
+
+    def queue_init(key, shape, dtype):
+        q = jax.random.normal(key, shape, dtype)  # randn, L2-normalized cols
+        return q / jnp.linalg.norm(q, axis=0, keepdims=True)
+
+    return {
+        "momentum": enc,  # initialized == base (copied at init, moco.py:124-127)
+        "queue": ParamSpec((cfg.dim, cfg.K), (None, None), queue_init),
+        "ptr": ParamSpec((), (), lambda k, s, d: jnp.zeros(s, d), dtype=jnp.int32),
+        "bn": resnet.state_specs(cfg.backbone),
+        "bn_m": resnet.state_specs(cfg.backbone),
+    }
+
+
+def init(cfg: MoCoConfig, key: jax.Array) -> Dict[str, Any]:
+    return init_params(key, param_specs(cfg))
+
+
+def init_extra(cfg: MoCoConfig, key: jax.Array, params: Dict[str, Any]) -> Dict[str, Any]:
+    """Momentum branch starts as an exact copy of the base (moco.py:124-127)."""
+    extra = init_params(key, extra_specs(cfg))
+    extra["momentum"] = jax.tree.map(lambda p: p, params)
+    return extra
+
+
+def _encode(
+    enc_params: Dict[str, Any],
+    bn_state: Dict[str, Any],
+    images: jax.Array,
+    cfg: MoCoConfig,
+    train: bool,
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    feats, new_bn = resnet.features(
+        enc_params["backbone"], bn_state, images, cfg.backbone, train=train
+    )
+    feats = feats.astype(jnp.float32)
+    if cfg.v2:
+        p = enc_params["proj"]
+        feats = jax.nn.relu(feats @ p["kernel"].astype(jnp.float32) + p["bias"])
+    c = enc_params["cls"]
+    out = feats @ c["kernel"].astype(jnp.float32) + c["bias"]
+    return out, new_bn
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: MoCoConfig,
+    extra: Dict[str, Any],
+    *,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = True,
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    """InfoNCE over (query, key) views (reference forward moco.py:209-246)."""
+    img_q, img_k = batch["img_q"], batch["img_k"]
+    n = img_q.shape[0]
+
+    # queries
+    q, new_bn = _encode(params, extra["bn"], img_q, cfg, train)
+    q = q / (jnp.linalg.norm(q, axis=1, keepdims=True) + 1e-12)
+
+    # momentum encoder update (EMA, no grad — moco.py:135-144)
+    m_eff = cfg.m ** (1.0 / max(cfg.ema_substeps, 1))
+    new_momentum = jax.tree.map(
+        lambda m, b: m_eff * m + (1.0 - m_eff) * jax.lax.stop_gradient(b),
+        extra["momentum"],
+        params,
+    )
+
+    # keys: global shuffle -> momentum-encode -> unshuffle (shuffle-BN)
+    shuffle_key = (
+        dropout_key if dropout_key is not None else jax.random.PRNGKey(0)
+    )
+    perm = jax.random.permutation(jax.random.fold_in(shuffle_key, 17), n)
+    inv = jnp.argsort(perm)
+    k, new_bn_m = _encode(new_momentum, extra["bn_m"], img_k[perm], cfg, train)
+    k = jax.lax.stop_gradient(k)
+    k = k / (jnp.linalg.norm(k, axis=1, keepdims=True) + 1e-12)
+    k = k[inv]
+
+    # logits: positives Nx1 against paired key, negatives NxK against queue
+    l_pos = jnp.sum(q * k, axis=1, keepdims=True)
+    l_neg = q @ extra["queue"]
+    logits = jnp.concatenate([l_pos, l_neg], axis=1) / cfg.T
+    loss = -jnp.mean(jax.nn.log_softmax(logits, axis=-1)[:, 0])
+
+    # dequeue+enqueue at ptr (K % N == 0 keeps slices aligned, moco.py:146-159)
+    new_queue = jax.lax.dynamic_update_slice(
+        extra["queue"], k.T, (jnp.int32(0), extra["ptr"])
+    )
+    new_ptr = (extra["ptr"] + n) % cfg.K
+    new_extra = {
+        "momentum": new_momentum,
+        "queue": jax.lax.stop_gradient(new_queue),
+        "ptr": new_ptr,
+        "bn": new_bn,
+        "bn_m": new_bn_m,
+    }
+    if not train:
+        new_extra = extra
+    return loss, new_extra
+
+
+def moco_logical_axes(cfg: MoCoConfig):
+    return spec_logical_axes(param_specs(cfg))
+
+
+def moco_extra_logical_axes(cfg: MoCoConfig):
+    return spec_logical_axes(extra_specs(cfg))

@@ -1,0 +1,170 @@
+"""Vision module adapters: MoCo pretrain, MoCo linear probe, ResNet cls.
+
+Reference: ppfleetx/models/vision_model/moco_module.py (MOCOModule :32,
+MOCOClsModule :117) and general_classification_module.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.core.module import BasicModule, resolve_model_dtype
+from paddlefleetx_tpu.models.common import (
+    ParamSpec,
+    init_params,
+    logical_axes as spec_logical_axes,
+    normal_init,
+    zeros_init,
+)
+from paddlefleetx_tpu.models.vision import loss as vloss, moco, resnet
+from paddlefleetx_tpu.utils.registry import MODULES
+
+
+def _model_cfg(cfg) -> Dict[str, Any]:
+    model_cfg = dict(cfg.Model)
+    model_cfg.pop("module", None)
+    model_cfg.pop("name", None)
+    resolve_model_dtype(cfg, model_cfg)
+    return model_cfg
+
+
+@MODULES.register("MOCOModule")
+class MOCOModule(BasicModule):
+    """MoCo v1/v2 contrastive pretraining (moco_module.py:32-114)."""
+
+    has_extra_state = True
+
+    def __init__(self, cfg):
+        mc = _model_cfg(cfg)
+        mc["ema_substeps"] = int(cfg.Engine.get("accumulate_steps", 1))
+        self.config = moco.MoCoConfig.from_config(mc)
+        gbs = int(cfg.Global.global_batch_size)
+        assert self.config.K % gbs == 0, (
+            f"queue K={self.config.K} must be divisible by global batch {gbs} "
+            "(reference moco.py:153)"
+        )
+        self.tokens_per_sample = 1  # ips = images/s
+
+    def init_params(self, key):
+        return moco.init(self.config, key)
+
+    def init_extra(self, key, params):
+        return moco.init_extra(self.config, key, params)
+
+    def logical_axes(self):
+        return moco.moco_logical_axes(self.config)
+
+    def extra_logical_axes(self):
+        return moco.moco_extra_logical_axes(self.config)
+
+    def loss_fn(self, params, batch, *, ctx=None, extra=None, dropout_key=None, train=True):
+        return moco.loss_fn(
+            params, batch, self.config, extra, dropout_key=dropout_key, train=train
+        )
+
+
+@MODULES.register("MOCOClsModule")
+class MOCOClsModule(BasicModule):
+    """Linear probe on a frozen MoCo backbone (moco_module.py:117-240):
+    backbone params + BN stats live in `extra` (never updated, BN uses
+    global running stats — _freeze_backbone :144-152); only the fc head
+    trains."""
+
+    has_extra_state = True
+
+    def __init__(self, cfg):
+        mc = _model_cfg(cfg)
+        self.num_classes = int(mc.get("num_classes", 1000))
+        self.backbone_cfg = resnet.ResNetConfig.from_config(
+            {**mc, "num_classes": 0}
+        )
+        self.pretrained = mc.get("pretrained")
+        f = self.backbone_cfg.num_features
+        self._head_specs = {
+            "kernel": ParamSpec((f, self.num_classes), (None, None), normal_init(0.01)),
+            "bias": ParamSpec((self.num_classes,), (None,), zeros_init()),
+        }
+        self.tokens_per_sample = 1
+
+    def init_params(self, key):
+        return init_params(key, self._head_specs)
+
+    def init_extra(self, key, params):
+        return {
+            "backbone": init_params(key, resnet.param_specs(self.backbone_cfg)),
+            "bn": init_params(key, resnet.state_specs(self.backbone_cfg)),
+        }
+
+    def logical_axes(self):
+        return spec_logical_axes(self._head_specs)
+
+    def extra_logical_axes(self):
+        return {
+            "backbone": spec_logical_axes(resnet.param_specs(self.backbone_cfg)),
+            "bn": spec_logical_axes(resnet.state_specs(self.backbone_cfg)),
+        }
+
+    def post_init_state(self, engine, state):
+        """Install the pretrained MoCo base encoder from `Model.pretrained`
+        (an Engine checkpoint dir from MOCOModule pretraining; reference
+        loads `base_encoder.0.*` weights, moco_module.py:160-180)."""
+        if not self.pretrained:
+            return state
+        import orbax.checkpoint as ocp
+        import os
+
+        path = os.path.abspath(self.pretrained)
+        assert os.path.exists(path), f"{path} does not exist (moco_module.py:163)"
+        restored = ocp.StandardCheckpointer().restore(os.path.join(path, "state"))
+        state.extra = dict(state.extra)
+        state.extra["backbone"] = jax.tree.map(
+            jnp.asarray, restored["params"]["backbone"]
+        )
+        state.extra["bn"] = jax.tree.map(jnp.asarray, restored["extra"]["bn"])
+        return state
+
+    def loss_fn(self, params, batch, *, ctx=None, extra=None, dropout_key=None, train=True):
+        feats, _ = resnet.features(
+            extra["backbone"], extra["bn"], batch["images"], self.backbone_cfg,
+            train=False,  # frozen BN: always global stats
+        )
+        feats = jax.lax.stop_gradient(feats).astype(jnp.float32)
+        logits = feats @ params["kernel"].astype(jnp.float32) + params["bias"]
+        loss = vloss.ce_loss(logits, batch["labels"])
+        return loss, extra
+
+
+@MODULES.register("ResNetModule")
+class ResNetModule(BasicModule):
+    """Supervised ResNet classification (reference resolves resnet through
+    GeneralClsModule + vision factory)."""
+
+    has_extra_state = True
+
+    def __init__(self, cfg):
+        mc = _model_cfg(cfg)
+        self.config = resnet.ResNetConfig.from_config(mc)
+        self.label_smoothing = mc.get("label_smoothing")
+        self.tokens_per_sample = 1
+
+    def init_params(self, key):
+        return init_params(key, resnet.param_specs(self.config))
+
+    def init_extra(self, key, params):
+        return init_params(key, resnet.state_specs(self.config))
+
+    def logical_axes(self):
+        return spec_logical_axes(resnet.param_specs(self.config))
+
+    def extra_logical_axes(self):
+        return spec_logical_axes(resnet.state_specs(self.config))
+
+    def loss_fn(self, params, batch, *, ctx=None, extra=None, dropout_key=None, train=True):
+        logits, new_bn = resnet.forward(
+            params, extra, batch["images"], self.config, train=train
+        )
+        loss = vloss.ce_loss(logits, batch["labels"], self.label_smoothing)
+        return loss, (new_bn if train else extra)

@@ -1,0 +1,272 @@
+"""Functional ResNet (18/34/50/101/152) in NHWC for the TPU MXU.
+
+The reference consumes ``paddle.vision.models.resnet`` re-exported through
+ppfleetx/models/vision_model/resnet/__init__.py:16-23; behavior matched here:
+7x7/2 stem + 3x3/2 maxpool, 4 stages, BasicBlock (<50) / Bottleneck (>=50),
+stride-2 downsample convs, global average pool, optional fc head.
+
+BatchNorm running statistics are *state*, not params — threaded through the
+engine's ``extra`` slot (Paddle keeps them as buffers).  Batch statistics are
+computed over the GLOBAL (sharded) batch: under pjit the mean/var reductions
+psum over the data axis, i.e. SyncBN semantics for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.models.common import ParamSpec, normal_init, ones_init, zeros_init
+
+# depth -> (block kind, per-stage block counts)
+ARCHS = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+    101: ("bottleneck", (3, 4, 23, 3)),
+    152: ("bottleneck", (3, 8, 36, 3)),
+}
+
+STAGE_WIDTHS = (64, 128, 256, 512)
+BN_MOMENTUM = 0.9  # paddle BatchNorm default momentum
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    depth: int = 50
+    num_classes: int = 1000  # 0 = feature extractor (no fc)
+    in_channels: int = 3
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def from_config(cls, cfg: Dict[str, Any]) -> "ResNetConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in cfg.items() if k in known}
+        if isinstance(kw.get("dtype"), str):
+            kw["dtype"] = jnp.dtype(kw["dtype"]).type
+        return cls(**kw)
+
+    @property
+    def block(self) -> str:
+        return ARCHS[self.depth][0]
+
+    @property
+    def stage_blocks(self) -> Tuple[int, ...]:
+        return ARCHS[self.depth][1]
+
+    @property
+    def expansion(self) -> int:
+        return 1 if self.block == "basic" else 4
+
+    @property
+    def num_features(self) -> int:
+        return STAGE_WIDTHS[-1] * self.expansion
+
+
+def _he_init(fan_out_scale: Tuple[int, ...] = ()) -> Any:
+    """Kaiming-normal on fan_out (conv default in paddle.vision resnet)."""
+
+    def f(key, shape, dtype):
+        kh, kw, _, cout = shape
+        std = math.sqrt(2.0 / (kh * kw * cout))
+        return std * jax.random.normal(key, shape, dtype)
+
+    return f
+
+
+def _conv_spec(kh: int, kw: int, cin: int, cout: int) -> ParamSpec:
+    return ParamSpec((kh, kw, cin, cout), (None, None, None, None), _he_init())
+
+
+def _bn_param_specs(c: int) -> Dict[str, ParamSpec]:
+    return {
+        "scale": ParamSpec((c,), (None,), ones_init()),
+        "bias": ParamSpec((c,), (None,), zeros_init()),
+    }
+
+
+def _bn_state_specs(c: int) -> Dict[str, ParamSpec]:
+    return {
+        "mean": ParamSpec((c,), (None,), zeros_init()),
+        "var": ParamSpec((c,), (None,), ones_init()),
+    }
+
+
+def _block_channels(cfg: ResNetConfig):
+    """Yield (cin, width, cout, stride) per block, flattened over stages."""
+    cin = 64
+    for stage, (width, n) in enumerate(zip(STAGE_WIDTHS, cfg.stage_blocks)):
+        for b in range(n):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            cout = width * cfg.expansion
+            yield stage, b, cin, width, cout, stride
+            cin = cout
+
+
+def param_specs(cfg: ResNetConfig) -> Dict[str, Any]:
+    specs: Dict[str, Any] = {
+        "stem": {"conv": _conv_spec(7, 7, cfg.in_channels, 64), "bn": _bn_param_specs(64)}
+    }
+    blocks = []
+    for stage, b, cin, width, cout, stride in _block_channels(cfg):
+        if cfg.block == "basic":
+            blk = {
+                "conv1": _conv_spec(3, 3, cin, width),
+                "bn1": _bn_param_specs(width),
+                "conv2": _conv_spec(3, 3, width, cout),
+                "bn2": _bn_param_specs(cout),
+            }
+        else:
+            blk = {
+                "conv1": _conv_spec(1, 1, cin, width),
+                "bn1": _bn_param_specs(width),
+                "conv2": _conv_spec(3, 3, width, width),
+                "bn2": _bn_param_specs(width),
+                "conv3": _conv_spec(1, 1, width, cout),
+                "bn3": _bn_param_specs(cout),
+            }
+        if stride != 1 or cin != cout:
+            blk["down_conv"] = _conv_spec(1, 1, cin, cout)
+            blk["down_bn"] = _bn_param_specs(cout)
+        blocks.append(blk)
+    specs["blocks"] = blocks
+    if cfg.num_classes:
+        f = cfg.num_features
+        specs["fc"] = {
+            "kernel": ParamSpec(
+                (f, cfg.num_classes), ("embed", None), normal_init(1.0 / math.sqrt(f))
+            ),
+            "bias": ParamSpec((cfg.num_classes,), (None,), zeros_init()),
+        }
+    return specs
+
+
+def state_specs(cfg: ResNetConfig) -> Dict[str, Any]:
+    specs: Dict[str, Any] = {"stem": {"bn": _bn_state_specs(64)}}
+    blocks = []
+    for stage, b, cin, width, cout, stride in _block_channels(cfg):
+        if cfg.block == "basic":
+            blk = {"bn1": _bn_state_specs(width), "bn2": _bn_state_specs(cout)}
+        else:
+            blk = {
+                "bn1": _bn_state_specs(width),
+                "bn2": _bn_state_specs(width),
+                "bn3": _bn_state_specs(cout),
+            }
+        if stride != 1 or cin != cout:
+            blk["down_bn"] = _bn_state_specs(cout)
+        blocks.append(blk)
+    specs["blocks"] = blocks
+    return specs
+
+
+# ----------------------------------------------------------------------
+def _conv(x: jax.Array, kernel: jax.Array, stride: int, dtype) -> jax.Array:
+    kh = kernel.shape[0]
+    pad = (kh - 1) // 2
+    return jax.lax.conv_general_dilated(
+        x.astype(dtype),
+        kernel.astype(dtype),
+        window_strides=(stride, stride),
+        padding=((pad, pad), (pad, pad)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _batch_norm(
+    x: jax.Array,
+    p: Dict[str, jax.Array],
+    s: Dict[str, jax.Array],
+    train: bool,
+    eps: float = 1e-5,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    if train:
+        mean = jnp.mean(x.astype(jnp.float32), axis=(0, 1, 2))
+        var = jnp.var(x.astype(jnp.float32), axis=(0, 1, 2))
+        new_s = {
+            "mean": BN_MOMENTUM * s["mean"] + (1 - BN_MOMENTUM) * mean,
+            "var": BN_MOMENTUM * s["var"] + (1 - BN_MOMENTUM) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x - mean.astype(x.dtype)) * (inv * p["scale"]).astype(x.dtype) + p[
+        "bias"
+    ].astype(x.dtype)
+    return y, new_s
+
+
+def features(
+    params: Dict[str, Any],
+    state: Dict[str, Any],
+    images: jax.Array,
+    cfg: ResNetConfig,
+    train: bool = False,
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    """images [b, H, W, C] -> pooled features [b, num_features] + new BN state."""
+    dtype = cfg.dtype
+    new_state: Dict[str, Any] = {"stem": {}, "blocks": []}
+    x = _conv(images, params["stem"]["conv"], 2, dtype)
+    x, new_state["stem"]["bn"] = _batch_norm(
+        x, params["stem"]["bn"], state["stem"]["bn"], train
+    )
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x,
+        -jnp.inf,
+        jax.lax.max,
+        window_dimensions=(1, 3, 3, 1),
+        window_strides=(1, 2, 2, 1),
+        padding=((0, 0), (1, 1), (1, 1), (0, 0)),
+    )
+
+    for blk_idx, (stage, b, cin, width, cout, stride) in enumerate(
+        _block_channels(cfg)
+    ):
+        p, s = params["blocks"][blk_idx], state["blocks"][blk_idx]
+        ns: Dict[str, Any] = {}
+        identity = x
+        if cfg.block == "basic":
+            y = _conv(x, p["conv1"], stride, dtype)
+            y, ns["bn1"] = _batch_norm(y, p["bn1"], s["bn1"], train)
+            y = jax.nn.relu(y)
+            y = _conv(y, p["conv2"], 1, dtype)
+            y, ns["bn2"] = _batch_norm(y, p["bn2"], s["bn2"], train)
+        else:
+            y = _conv(x, p["conv1"], 1, dtype)
+            y, ns["bn1"] = _batch_norm(y, p["bn1"], s["bn1"], train)
+            y = jax.nn.relu(y)
+            y = _conv(y, p["conv2"], stride, dtype)
+            y, ns["bn2"] = _batch_norm(y, p["bn2"], s["bn2"], train)
+            y = jax.nn.relu(y)
+            y = _conv(y, p["conv3"], 1, dtype)
+            y, ns["bn3"] = _batch_norm(y, p["bn3"], s["bn3"], train)
+        if "down_conv" in p:
+            identity = _conv(x, p["down_conv"], stride, dtype)
+            identity, ns["down_bn"] = _batch_norm(
+                identity, p["down_bn"], s["down_bn"], train
+            )
+        x = jax.nn.relu(y + identity)
+        new_state["blocks"].append(ns)
+
+    feats = jnp.mean(x, axis=(1, 2))  # global average pool
+    return feats, new_state
+
+
+def forward(
+    params: Dict[str, Any],
+    state: Dict[str, Any],
+    images: jax.Array,
+    cfg: ResNetConfig,
+    train: bool = False,
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Full classifier forward -> logits [b, num_classes] (fp32) + new state."""
+    feats, new_state = features(params, state, images, cfg, train)
+    fc = params["fc"]
+    logits = feats.astype(jnp.float32) @ fc["kernel"].astype(jnp.float32) + fc["bias"]
+    return logits, new_state

@@ -244,21 +244,16 @@ def forward(
 def cls_loss(
     logits: jax.Array, labels: jax.Array, label_smoothing: float = 0.0
 ) -> jax.Array:
-    """ViTCELoss (reference vision_model/layers loss): CE with optional
-    smoothing; labels may be int [b] or soft [b, classes] (mixup)."""
-    logits = logits.astype(jnp.float32)
-    if labels.ndim == 1:
-        onehot = jax.nn.one_hot(labels, logits.shape[-1])
-    else:
-        onehot = labels.astype(jnp.float32)
-    if label_smoothing > 0:
-        n = logits.shape[-1]
-        onehot = onehot * (1 - label_smoothing) + label_smoothing / n
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+    """CE with optional smoothing; labels may be int [b] or soft [b, classes]
+    (mixup).  Canonical impl: models/vision/loss.py (CELoss parity)."""
+    from paddlefleetx_tpu.models.vision.loss import ce_loss
+
+    return ce_loss(logits, labels, label_smoothing or None)
 
 
 def top_k_accuracy(logits: jax.Array, labels: jax.Array, k: int = 1) -> jax.Array:
-    """top-1/top-5 metrics (reference general_classification_module.py:84)."""
-    topk = jnp.argsort(-logits, axis=-1)[:, :k]
-    return jnp.mean(jnp.any(topk == labels[:, None], axis=-1).astype(jnp.float32))
+    """top-1/top-5 metrics (reference general_classification_module.py:84).
+    Canonical impl: models/vision/metrics.py."""
+    from paddlefleetx_tpu.models.vision.metrics import topk_acc
+
+    return topk_acc(logits, labels, (k,))[f"top{k}"]
