@@ -1,0 +1,157 @@
+"""T5 sentencepiece-style unigram tokenizer (pure Python).
+
+The reference vendors a full sentencepiece-backed T5Tokenizer
+(ppfleetx/data/tokenizers/t5_tokenizer.py + tokenizer_base, ~2.9k LoC
+wrapping the sentencepiece C library).  This is a dependency-free
+re-implementation of the inference side: Viterbi unigram segmentation over
+a piece->logprob vocabulary with the "▁" whitespace marker, byte-level
+<unk> fallback, and the T5 special tokens (</s>=1, <pad>=0, <unk>=2,
+<extra_id_0..99> sentinel ids at the top of the vocab).
+
+Vocab format: JSON {"pieces": [[piece, logprob], ...]} in sentencepiece
+order (id = index).  `from_tiny_corpus` builds a toy vocab for tests.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SPIECE_UNDERLINE = "▁"  # ▁
+
+
+class T5Tokenizer:
+    def __init__(
+        self,
+        pieces: Sequence[Tuple[str, float]],
+        *,
+        num_extra_ids: int = 100,
+        pad_token: str = "<pad>",
+        eos_token: str = "</s>",
+        unk_token: str = "<unk>",
+    ):
+        self.pieces = list(pieces)
+        self.extra_tokens = [f"<extra_id_{i}>" for i in range(num_extra_ids)]
+        self.vocab: Dict[str, int] = {p: i for i, (p, _) in enumerate(self.pieces)}
+        # sentinels occupy the ids above the base vocab, highest sentinel
+        # first does NOT apply here: HF/reference order appends extra ids
+        # after the sp vocab, with extra_id_0 = len(vocab)+num_extra-1... we
+        # keep the simpler ascending layout and expose it via helpers.
+        base = len(self.pieces)
+        for i, t in enumerate(self.extra_tokens):
+            self.vocab[t] = base + i
+        self.inv_vocab = {i: p for p, i in self.vocab.items()}
+        self.scores = {p: s for p, s in self.pieces}
+        self.pad_token, self.eos_token, self.unk_token = pad_token, eos_token, unk_token
+        self.max_piece_len = max((len(p) for p, _ in self.pieces), default=1)
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_file(cls, path: str, **kw) -> "T5Tokenizer":
+        with open(path) as f:
+            data = json.load(f)
+        return cls([(p, s) for p, s in data["pieces"]], **kw)
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"pieces": self.pieces}, f, ensure_ascii=False)
+
+    @classmethod
+    def from_tiny_corpus(cls, texts: Iterable[str], max_pieces: int = 1000, **kw) -> "T5Tokenizer":
+        """Toy vocab: specials + chars + frequent words (unigram scores from
+        counts). Good enough for tests and demos; real deployments load a
+        trained sentencepiece vocab via from_file."""
+        from collections import Counter
+
+        counts: Counter = Counter()
+        chars: Counter = Counter()
+        for t in texts:
+            for w in t.split():
+                counts[SPIECE_UNDERLINE + w] += 1
+                for c in w:
+                    chars[c] += 1
+        pieces: List[Tuple[str, float]] = [("<pad>", 0.0), ("</s>", 0.0), ("<unk>", 0.0)]
+        total = sum(counts.values()) + sum(chars.values()) + 1
+        for c, n in chars.most_common():
+            pieces.append((c, math.log(n / total)))
+            pieces.append((SPIECE_UNDERLINE + c, math.log(n / total) - 1.0))
+        for w, n in counts.most_common(max_pieces - len(pieces)):
+            if w not in dict(pieces):
+                pieces.append((w, math.log(n / total)))
+        return cls(pieces, **kw)
+
+    # -- core unigram segmentation -----------------------------------------
+
+    def _viterbi(self, text: str) -> List[str]:
+        """Best segmentation of one pre-tokenized chunk (▁-prefixed word)."""
+        n = len(text)
+        best: List[float] = [0.0] + [-math.inf] * n
+        back: List[int] = [0] * (n + 1)
+        unk_pen = min(self.scores.values(), default=-10.0) - 10.0
+        for end in range(1, n + 1):
+            for start in range(max(0, end - self.max_piece_len), end):
+                piece = text[start:end]
+                score = self.scores.get(piece)
+                if score is None:
+                    if end - start == 1:
+                        score = unk_pen  # single-char fallback -> maybe <unk>
+                    else:
+                        continue
+                cand = best[start] + score
+                if cand > best[end]:
+                    best[end] = cand
+                    back[end] = start
+        out: List[str] = []
+        end = n
+        while end > 0:
+            start = back[end]
+            out.append(text[start:end])
+            end = start
+        return out[::-1]
+
+    def tokenize(self, text: str) -> List[str]:
+        toks: List[str] = []
+        for word in text.strip().split():
+            toks.extend(self._viterbi(SPIECE_UNDERLINE + word))
+        return toks
+
+    # -- encode / decode ----------------------------------------------------
+
+    def convert_tokens_to_ids(self, tokens: Sequence[str]) -> List[int]:
+        unk = self.vocab[self.unk_token]
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def encode(self, text: str, add_eos: bool = True) -> List[int]:
+        ids = self.convert_tokens_to_ids(self.tokenize(text))
+        if add_eos:
+            ids.append(self.vocab[self.eos_token])
+        return ids
+
+    def decode(self, ids: Iterable[int], skip_special_tokens: bool = True) -> str:
+        specials = {self.pad_token, self.eos_token, self.unk_token, *self.extra_tokens}
+        parts: List[str] = []
+        for i in ids:
+            p = self.inv_vocab.get(int(i), self.unk_token)
+            if skip_special_tokens and p in specials:
+                continue
+            parts.append(p)
+        return "".join(parts).replace(SPIECE_UNDERLINE, " ").strip()
+
+    def extra_id(self, i: int) -> int:
+        return self.vocab[f"<extra_id_{i}>"]
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    @property
+    def pad_id(self) -> int:
+        return self.vocab[self.pad_token]
+
+    @property
+    def eos_id(self) -> int:
+        return self.vocab[self.eos_token]

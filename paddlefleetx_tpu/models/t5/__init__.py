@@ -1,0 +1,1 @@
+from paddlefleetx_tpu.models.t5.config import T5Config  # noqa: F401

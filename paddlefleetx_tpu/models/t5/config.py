@@ -1,0 +1,41 @@
+"""T5 model configuration (reference T5Config kwargs,
+ppfleetx/models/language_model/t5/modeling.py:434-471)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 768
+    d_kv: int = 64  # per-head dim (NOT required to equal d_model/num_heads)
+    d_ff: int = 2048
+    num_layers: int = 12
+    num_decoder_layers: int = 12
+    num_heads: int = 12
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    dropout_rate: float = 0.1
+    layer_norm_epsilon: float = 1e-6
+    initializer_factor: float = 1.0
+    # "gated-gelu" (T5 v1.1, reference default is_gated_act=True) or "relu"
+    feed_forward_proj: str = "gated-gelu"
+    tie_word_embeddings: bool = True
+    pad_token_id: int = 0
+    eos_token_id: int = 1
+    decoder_start_token_id: int = 0
+    dtype: str = "bfloat16"
+    attn_impl: str = "xla"
+    use_recompute: bool = False
+
+    @property
+    def is_gated_act(self) -> bool:
+        return "gated" in self.feed_forward_proj
+
+    @classmethod
+    def from_config(cls, d: Dict[str, Any]) -> "T5Config":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})

@@ -1,0 +1,425 @@
+"""T5 encoder-decoder LM — pure-JAX functional, sharded by annotation.
+
+TPU-native re-design of the reference T5 family
+(ppfleetx/models/language_model/t5/modeling.py: T5LayerNorm :473,
+T5DenseActDense :504, T5DenseGatedActDense :520, T5Attention :559,
+T5Stack / T5Model / T5ForConditionalGeneration below it): one functional
+definition, parallelism via logical-axis annotations (same TP layout as
+GPT: heads/ffn/vocab sharded on the ``model`` mesh axis).
+
+Architecture notes (faithful to the reference semantics):
+  - RMS LayerNorm without bias or mean-subtraction, fp32 variance
+    (T5LayerNorm :473-490).
+  - Attention is UNSCALED (1/sqrt(d) folded into initializer — Mesh-TF
+    convention the reference inherits); q/k/v/o initialized with the
+    factor-scaled normals of T5Config.initializer_factor.
+  - Relative position bias: one (num_buckets, num_heads) embedding per
+    stack, computed once and shared by every layer (the reference stores
+    it in block 0 and passes it down — same sharing, scan-friendly form).
+  - FFN: gated-gelu (wi_0 * gelu, T5 v1.1, reference is_gated_act default
+    True :451) or plain relu dense.
+  - Logits: tied word embedding with d_model**-0.5 rescale when
+    tie_word_embeddings (T5ForConditionalGeneration convention).
+
+Layers are stacked on a leading ``layers`` axis and run with ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.models.common import (
+    ParamSpec,
+    dropout,
+    init_params,
+    logical_axes,
+    normal_init,
+    ones_init,
+    stack_spec_tree,
+    zeros_init,
+)
+from paddlefleetx_tpu.models.gpt.model import ShardingCtx, _constrain
+from paddlefleetx_tpu.models.t5.config import T5Config
+from paddlefleetx_tpu.ops.attention import attention
+
+NEG_INF = -1e9
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """T5LayerNorm: no mean subtraction, no bias, fp32 variance."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(dtype) * scale
+
+
+# ---------------------------------------------------------------------------
+# Relative position buckets (T5Attention._relative_position_bucket)
+# ---------------------------------------------------------------------------
+
+
+def relative_position_bucket(
+    relative_position: jax.Array,
+    *,
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> jax.Array:
+    """Map signed relative positions to bucket ids (int32).
+
+    Half the buckets are exact small offsets, the other half log-spaced up
+    to max_distance; bidirectional splits the space for +/- directions.
+    """
+    ret = jnp.zeros_like(relative_position)
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + jnp.where(n < 0, num_buckets, 0)
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+def compute_position_bias(
+    rel_emb: jax.Array, q_len: int, k_len: int, *, bidirectional: bool, cfg: T5Config
+) -> jax.Array:
+    """[1, heads, q_len, k_len] additive bias from a (buckets, heads) table."""
+    ctx_pos = jnp.arange(q_len)[:, None]
+    mem_pos = jnp.arange(k_len)[None, :]
+    buckets = relative_position_bucket(
+        mem_pos - ctx_pos,
+        bidirectional=bidirectional,
+        num_buckets=cfg.relative_attention_num_buckets,
+        max_distance=cfg.relative_attention_max_distance,
+    )
+    bias = rel_emb[buckets]  # [q, k, heads]
+    return jnp.transpose(bias, (2, 0, 1))[None]
+
+
+# ---------------------------------------------------------------------------
+# Parameter specs
+# ---------------------------------------------------------------------------
+
+
+def _attn_specs(cfg: T5Config) -> Dict[str, ParamSpec]:
+    d, nh, dkv = cfg.d_model, cfg.num_heads, cfg.d_kv
+    f = cfg.initializer_factor
+    return {
+        "q_kernel": ParamSpec((d, nh, dkv), ("embed", "heads", "kv"), normal_init(f * (d * dkv) ** -0.5)),
+        "k_kernel": ParamSpec((d, nh, dkv), ("embed", "heads", "kv"), normal_init(f * d ** -0.5)),
+        "v_kernel": ParamSpec((d, nh, dkv), ("embed", "heads", "kv"), normal_init(f * d ** -0.5)),
+        "o_kernel": ParamSpec((nh, dkv, d), ("heads", "kv", "embed"), normal_init(f * (nh * dkv) ** -0.5)),
+    }
+
+
+def _ffn_specs(cfg: T5Config) -> Dict[str, ParamSpec]:
+    d, dff, f = cfg.d_model, cfg.d_ff, cfg.initializer_factor
+    wi = normal_init(f * d ** -0.5)
+    wo = normal_init(f * dff ** -0.5)
+    specs = {
+        "wi_kernel": ParamSpec((d, dff), ("embed", "mlp"), wi),
+        "wo_kernel": ParamSpec((dff, d), ("mlp", "embed"), wo),
+    }
+    if cfg.is_gated_act:
+        specs["wi_gate_kernel"] = ParamSpec((d, dff), ("embed", "mlp"), wi)
+    return specs
+
+
+def _enc_layer_specs(cfg: T5Config) -> Dict[str, Any]:
+    ln = lambda: ParamSpec((cfg.d_model,), ("embed",), ones_init())
+    return {
+        "ln_attn": {"scale": ln()},
+        "attn": _attn_specs(cfg),
+        "ln_ffn": {"scale": ln()},
+        "ffn": _ffn_specs(cfg),
+    }
+
+
+def _dec_layer_specs(cfg: T5Config) -> Dict[str, Any]:
+    ln = lambda: ParamSpec((cfg.d_model,), ("embed",), ones_init())
+    return {
+        "ln_self": {"scale": ln()},
+        "self_attn": _attn_specs(cfg),
+        "ln_cross": {"scale": ln()},
+        "cross_attn": _attn_specs(cfg),
+        "ln_ffn": {"scale": ln()},
+        "ffn": _ffn_specs(cfg),
+    }
+
+
+def t5_specs(cfg: T5Config) -> Dict[str, Any]:
+    d, f = cfg.d_model, cfg.initializer_factor
+    rel = lambda: ParamSpec(
+        (cfg.relative_attention_num_buckets, cfg.num_heads),
+        (None, "heads"),
+        normal_init(f * d ** -0.5),
+    )
+    specs: Dict[str, Any] = {
+        "shared_embedding": ParamSpec((cfg.vocab_size, d), ("vocab", "embed"), normal_init(f * 1.0)),
+        "encoder": {
+            "rel_bias": rel(),
+            "layers": stack_spec_tree(_enc_layer_specs(cfg), cfg.num_layers),
+            "final_ln": {"scale": ParamSpec((d,), ("embed",), ones_init())},
+        },
+        "decoder": {
+            "rel_bias": rel(),
+            "layers": stack_spec_tree(_dec_layer_specs(cfg), cfg.num_decoder_layers),
+            "final_ln": {"scale": ParamSpec((d,), ("embed",), ones_init())},
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = ParamSpec((d, cfg.vocab_size), ("embed", "vocab"), normal_init(f * d ** -0.5))
+    return specs
+
+
+def init(cfg: T5Config, key: jax.Array) -> Dict[str, Any]:
+    return init_params(key, t5_specs(cfg))
+
+
+def t5_logical_axes(cfg: T5Config) -> Dict[str, Any]:
+    return logical_axes(t5_specs(cfg))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _proj(x: jax.Array, kernel: jax.Array) -> jax.Array:
+    """[b,s,d] @ [d,h,k] -> [b,s,h,k]."""
+    return jnp.einsum("bsd,dhk->bshk", x, kernel)
+
+
+def _attn(
+    p: Dict[str, jax.Array],
+    x_q: jax.Array,
+    x_kv: jax.Array,
+    bias: Optional[jax.Array],
+    cfg: T5Config,
+    key: Optional[jax.Array],
+    train: bool,
+) -> jax.Array:
+    q = _proj(x_q, p["q_kernel"])
+    k = _proj(x_kv, p["k_kernel"])
+    v = _proj(x_kv, p["v_kernel"])
+    out = attention(
+        q, k, v,
+        impl="xla",  # bias-carrying attention always takes the XLA path
+        causal=False,
+        bias=bias,
+        dropout_key=key,
+        dropout_rate=cfg.dropout_rate,
+        train=train,
+        scale=1.0,
+    )
+    return jnp.einsum("bshk,hkd->bsd", out, p["o_kernel"])
+
+
+def _ffn(p: Dict[str, jax.Array], x: jax.Array, cfg: T5Config, key, train) -> jax.Array:
+    if cfg.is_gated_act:
+        h = jax.nn.gelu(x @ p["wi_gate_kernel"], approximate=True) * (x @ p["wi_kernel"])
+    else:
+        h = jax.nn.relu(x @ p["wi_kernel"])
+    h = dropout(key, h, cfg.dropout_rate, train)
+    return h @ p["wo_kernel"]
+
+
+def _pad_bias(mask: jax.Array, dtype) -> jax.Array:
+    """[b, k] 1/0 keep-mask -> additive [b, 1, 1, k]."""
+    return jnp.where(mask[:, None, None, :].astype(jnp.bool_), 0.0, NEG_INF).astype(dtype)
+
+
+def _run_stack(
+    layers_params: Any,
+    x: jax.Array,
+    cfg: T5Config,
+    *,
+    self_bias: jax.Array,
+    enc_out: Optional[jax.Array],
+    cross_bias: Optional[jax.Array],
+    dropout_key: Optional[jax.Array],
+    train: bool,
+    ctx: Optional[ShardingCtx],
+    decoder: bool,
+) -> jax.Array:
+    n_layers = cfg.num_decoder_layers if decoder else cfg.num_layers
+
+    def block(carry, xs):
+        h, idx = carry
+        lp = xs
+        keys = {}
+        if dropout_key is not None and train:
+            lk = jax.random.fold_in(dropout_key, idx)
+            names = ("attn", "res1", "cross", "res_c", "ffn_in", "res2")
+            keys = dict(zip(names, jax.random.split(lk, len(names))))
+        h = _constrain(ctx, h, ("batch", "seq", "embed"))
+        if decoder:
+            y = _attn(lp["self_attn"], rms_norm(h, lp["ln_self"]["scale"], cfg.layer_norm_epsilon),
+                      rms_norm(h, lp["ln_self"]["scale"], cfg.layer_norm_epsilon),
+                      self_bias, cfg, keys.get("attn"), train)
+            h = h + dropout(keys.get("res1"), y, cfg.dropout_rate, train)
+            y = _attn(lp["cross_attn"], rms_norm(h, lp["ln_cross"]["scale"], cfg.layer_norm_epsilon),
+                      enc_out, cross_bias, cfg, keys.get("cross"), train)
+            h = h + dropout(keys.get("res_c"), y, cfg.dropout_rate, train)
+        else:
+            xn = rms_norm(h, lp["ln_attn"]["scale"], cfg.layer_norm_epsilon)
+            y = _attn(lp["attn"], xn, xn, self_bias, cfg, keys.get("attn"), train)
+            h = h + dropout(keys.get("res1"), y, cfg.dropout_rate, train)
+        y = _ffn(lp["ffn"], rms_norm(h, lp["ln_ffn"]["scale"], cfg.layer_norm_epsilon),
+                 cfg, keys.get("ffn_in"), train)
+        h = h + dropout(keys.get("res2"), y, cfg.dropout_rate, train)
+        return (h, idx + 1), None
+
+    fn = block
+    if cfg.use_recompute:
+        fn = jax.checkpoint(block, prevent_cse=False)
+    (x, _), _ = jax.lax.scan(fn, (x, jnp.int32(0)), layers_params, length=n_layers)
+    return x
+
+
+def encode(
+    params: Dict[str, Any],
+    input_ids: jax.Array,
+    cfg: T5Config,
+    *,
+    attention_mask: Optional[jax.Array] = None,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> jax.Array:
+    dtype = jnp.dtype(cfg.dtype)
+    if attention_mask is None:
+        attention_mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
+    x = params["shared_embedding"][input_ids].astype(dtype)
+    k1 = k2 = k3 = None
+    if dropout_key is not None:
+        k1, k2, k3 = jax.random.split(dropout_key, 3)
+    x = dropout(k1, x, cfg.dropout_rate, train)
+    s = input_ids.shape[1]
+    bias = compute_position_bias(
+        params["encoder"]["rel_bias"].astype(jnp.float32), s, s, bidirectional=True, cfg=cfg
+    ) + _pad_bias(attention_mask, jnp.float32)
+    x = _run_stack(
+        params["encoder"]["layers"], x, cfg,
+        self_bias=bias, enc_out=None, cross_bias=None,
+        dropout_key=k2, train=train, ctx=ctx, decoder=False,
+    )
+    x = rms_norm(x, params["encoder"]["final_ln"]["scale"], cfg.layer_norm_epsilon)
+    return dropout(k3, x, cfg.dropout_rate, train)
+
+
+def decode(
+    params: Dict[str, Any],
+    decoder_input_ids: jax.Array,
+    enc_out: jax.Array,
+    enc_mask: jax.Array,
+    cfg: T5Config,
+    *,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> jax.Array:
+    """Returns decoder hidden states [b, s, d]."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["shared_embedding"][decoder_input_ids].astype(dtype)
+    k1 = k2 = k3 = None
+    if dropout_key is not None:
+        k1, k2, k3 = jax.random.split(dropout_key, 3)
+    x = dropout(k1, x, cfg.dropout_rate, train)
+    s = decoder_input_ids.shape[1]
+    causal = jnp.where(
+        jnp.tril(jnp.ones((s, s), jnp.bool_))[None, None], 0.0, NEG_INF
+    ).astype(jnp.float32)
+    self_bias = compute_position_bias(
+        params["decoder"]["rel_bias"].astype(jnp.float32), s, s, bidirectional=False, cfg=cfg
+    ) + causal
+    cross_bias = _pad_bias(enc_mask, jnp.float32)
+    x = _run_stack(
+        params["decoder"]["layers"], x, cfg,
+        self_bias=self_bias, enc_out=enc_out, cross_bias=cross_bias,
+        dropout_key=k2, train=train, ctx=ctx, decoder=True,
+    )
+    x = rms_norm(x, params["decoder"]["final_ln"]["scale"], cfg.layer_norm_epsilon)
+    return dropout(k3, x, cfg.dropout_rate, train)
+
+
+def logits_from_hidden(params: Dict[str, Any], hidden: jax.Array, cfg: T5Config) -> jax.Array:
+    if cfg.tie_word_embeddings:
+        # Mesh-TF rescale before the tied projection
+        hidden = hidden * (cfg.d_model ** -0.5)
+        return jnp.einsum("bsd,vd->bsv", hidden, params["shared_embedding"].astype(hidden.dtype))
+    return hidden @ params["lm_head"].astype(hidden.dtype)
+
+
+def shift_right(labels: jax.Array, cfg: T5Config) -> jax.Array:
+    """Teacher-forcing decoder inputs: prepend decoder_start, drop last."""
+    start = jnp.full((labels.shape[0], 1), cfg.decoder_start_token_id, labels.dtype)
+    shifted = jnp.concatenate([start, labels[:, :-1]], axis=1)
+    # labels may use -100 as ignore — feed pad instead
+    return jnp.where(shifted < 0, cfg.pad_token_id, shifted)
+
+
+def forward(
+    params: Dict[str, Any],
+    input_ids: jax.Array,
+    decoder_input_ids: jax.Array,
+    cfg: T5Config,
+    *,
+    attention_mask: Optional[jax.Array] = None,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> jax.Array:
+    """Full seq2seq forward -> logits [b, s_dec, vocab]."""
+    if attention_mask is None:
+        attention_mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
+    ke = kd = None
+    if dropout_key is not None:
+        ke, kd = jax.random.split(dropout_key)
+    enc = encode(params, input_ids, cfg, attention_mask=attention_mask,
+                 ctx=ctx, dropout_key=ke, train=train)
+    hid = decode(params, decoder_input_ids, enc, attention_mask, cfg,
+                 ctx=ctx, dropout_key=kd, train=train)
+    return logits_from_hidden(params, hid, cfg)
+
+
+def seq2seq_loss(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: T5Config,
+    *,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = True,
+) -> jax.Array:
+    """Token CE over labels (ignore -100 / pad positions).
+
+    batch: input_ids [b,s_enc], labels [b,s_dec], optional attention_mask,
+    optional decoder_input_ids (defaults to shift_right(labels))."""
+    labels = batch["labels"]
+    dec_in = batch.get("decoder_input_ids")
+    if dec_in is None:
+        dec_in = shift_right(labels, cfg)
+    logits = forward(
+        params, batch["input_ids"], dec_in, cfg,
+        attention_mask=batch.get("attention_mask"),
+        ctx=ctx, dropout_key=dropout_key, train=train,
+    ).astype(jnp.float32)
+    mask = jnp.logical_and(labels != cfg.pad_token_id, labels >= 0)
+    safe = jnp.where(mask, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mask.sum(), 1)
+    return jnp.where(mask, nll, 0.0).sum() / denom

@@ -1,0 +1,42 @@
+"""T5 engine module (seq2seq LM training/finetune).
+
+The reference exposes T5 purely as a model library (modeling.py) consumed
+by custom loops; here it plugs into the Engine like every other family."""
+
+from __future__ import annotations
+
+from paddlefleetx_tpu.core.module import BasicModule, resolve_model_dtype
+from paddlefleetx_tpu.models.t5 import model as t5
+from paddlefleetx_tpu.models.t5.config import T5Config
+from paddlefleetx_tpu.utils.registry import MODULES
+
+
+def _config_from(cfg) -> T5Config:
+    model_cfg = dict(cfg.Model)
+    model_cfg.pop("module", None)
+    model_cfg.pop("name", None)
+    resolve_model_dtype(cfg, model_cfg)
+    return T5Config.from_config(model_cfg)
+
+
+@MODULES.register("T5Module")
+class T5Module(BasicModule):
+    """Seq2seq (span-corruption pretrain or text-to-text finetune)."""
+
+    def __init__(self, cfg):
+        self.config = _config_from(cfg)
+        data_cfg = cfg.get("Data", {}).get("Train", {}).get("dataset", {})
+        self.tokens_per_sample = int(
+            data_cfg.get("max_seq_len", 512)
+        ) + int(data_cfg.get("max_target_len", 0))
+
+    def init_params(self, key):
+        return t5.init(self.config, key)
+
+    def logical_axes(self):
+        return t5.t5_logical_axes(self.config)
+
+    def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=True):
+        return t5.seq2seq_loss(
+            params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
+        )

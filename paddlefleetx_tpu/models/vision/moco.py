@@ -11,9 +11,11 @@ MoCoV2Projector :50, MoCoClassifier :70).  Mapping to the functional design:
 The reference's cross-GPU machinery maps as:
   concat_all_gather (moco.py:35-46)  -> nothing: under pjit the batch IS
     global, so keys enqueued per step are already the full global batch
-  _batch_shuffle_ddp (:162-187)      -> one global random permutation of the
-    key batch before the momentum encoder, inverted after — same semantics
-    (defeat BN information leakage), no explicit collectives
+  _batch_shuffle_ddp (:162-187)      -> dropped: shuffle-BN exists to defeat
+    leakage through PER-DEVICE BN statistics; our _batch_norm reduces over
+    the full global batch (SimCLR-style "Global BN"), whose statistics are
+    permutation-invariant, so a shuffle would be a mathematical no-op and
+    the leakage it guards against cannot occur in the first place
 """
 
 from __future__ import annotations
@@ -154,20 +156,16 @@ def loss_fn(
         params,
     )
 
-    # keys: global shuffle -> momentum-encode -> unshuffle (shuffle-BN)
-    shuffle_key = (
-        dropout_key if dropout_key is not None else jax.random.PRNGKey(0)
-    )
-    perm = jax.random.permutation(jax.random.fold_in(shuffle_key, 17), n)
-    inv = jnp.argsort(perm)
-    k, new_bn_m = _encode(new_momentum, extra["bn_m"], img_k[perm], cfg, train)
+    # keys via momentum encoder. No shuffle-BN (see module docstring):
+    # global-batch BN statistics are permutation-invariant.
+    k, new_bn_m = _encode(new_momentum, extra["bn_m"], img_k, cfg, train)
     k = jax.lax.stop_gradient(k)
     k = k / (jnp.linalg.norm(k, axis=1, keepdims=True) + 1e-12)
-    k = k[inv]
 
     # logits: positives Nx1 against paired key, negatives NxK against queue
     l_pos = jnp.sum(q * k, axis=1, keepdims=True)
-    l_neg = q @ extra["queue"]
+    # queue is a buffer, not a parameter: no gradient flows into it
+    l_neg = q @ jax.lax.stop_gradient(extra["queue"])
     logits = jnp.concatenate([l_pos, l_neg], axis=1) / cfg.T
     loss = -jnp.mean(jax.nn.log_softmax(logits, axis=-1)[:, 0])
 

@@ -33,12 +33,16 @@ def xla_attention(
     dropout_key: Optional[jax.Array] = None,
     dropout_rate: float = 0.0,
     train: bool = False,
+    scale: Optional[float] = None,
 ) -> jax.Array:
-    """Reference attention.  q,k,v: [batch, seq, heads, head_dim]."""
-    *_, seq_q, = q.shape[:2] + ()
+    """Reference attention.  q,k,v: [batch, seq, heads, head_dim].
+
+    ``scale=None`` means 1/sqrt(head_dim); pass ``scale=1.0`` for T5-style
+    unscaled attention (scale folded into initialization)."""
     seq_q = q.shape[1]
     seq_k = k.shape[1]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     # scores in fp32 for softmax stability (reference uses fused fp16 softmax
     # with max-subtract; bf16 TPU matmul accumulates fp32 natively)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -66,9 +70,10 @@ def attention(
     dropout_key: Optional[jax.Array] = None,
     dropout_rate: float = 0.0,
     train: bool = False,
+    scale: Optional[float] = None,
 ) -> jax.Array:
     """Dispatching attention entry point used by all models."""
-    if impl == "flash" and bias is None and causal:
+    if impl == "flash" and bias is None and causal and scale is None:
         from paddlefleetx_tpu.ops.flash_attention import flash_attention, flash_supported
 
         if not flash_supported(q.shape[1]):
@@ -93,4 +98,5 @@ def attention(
         dropout_key=dropout_key,
         dropout_rate=dropout_rate,
         train=train,
+        scale=scale,
     )

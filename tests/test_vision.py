@@ -137,13 +137,20 @@ def test_moco_grads_only_touch_base(moco_bits):
         "img_q": jnp.ones((8, 32, 32, 3)) * 0.1,
         "img_k": jnp.ones((8, 32, 32, 3)) * 0.3,
     }
-    grads = jax.grad(
-        lambda p: moco.loss_fn(
-            p, batch, TINY_MOCO, extra, dropout_key=jax.random.key(3), train=True
-        )[0]
-    )(params)
+    grads, extra_grads = jax.grad(
+        lambda p, e: moco.loss_fn(
+            p, batch, TINY_MOCO, e, dropout_key=jax.random.key(3), train=True
+        )[0],
+        argnums=(0, 1),
+        allow_int=True,  # extra['ptr'] is an int32 buffer
+    )(params, extra)
     gnorm = sum(float(jnp.sum(g**2)) for g in jax.tree.leaves(grads))
     assert gnorm > 0.0
+    # momentum encoder / queue sit behind stop_gradient: zero cotangents
+    for path in ("momentum", "queue"):
+        for g in jax.tree.leaves(extra_grads[path]):
+            if jnp.issubdtype(g.dtype, jnp.floating):
+                assert float(jnp.max(jnp.abs(g))) == 0.0
 
 
 def test_moco_engine_end_to_end(tmp_path):
