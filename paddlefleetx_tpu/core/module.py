@@ -153,6 +153,7 @@ def _register_family_modules():
     Lazy (not at package import) to keep `import paddlefleetx_tpu` light;
     idempotent because Registry rejects double registration only on distinct
     functions and imports are cached."""
+    import paddlefleetx_tpu.models.debertav2.module  # noqa: F401
     import paddlefleetx_tpu.models.ernie.module  # noqa: F401
     import paddlefleetx_tpu.models.gpt.evaluation  # noqa: F401
     import paddlefleetx_tpu.models.gpt.finetune  # noqa: F401

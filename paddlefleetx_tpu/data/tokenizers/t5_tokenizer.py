@@ -76,12 +76,15 @@ class T5Tokenizer:
                     chars[c] += 1
         pieces: List[Tuple[str, float]] = [("<pad>", 0.0), ("</s>", 0.0), ("<unk>", 0.0)]
         total = sum(counts.values()) + sum(chars.values()) + 1
+        seen = {p for p, _ in pieces}
         for c, n in chars.most_common():
             pieces.append((c, math.log(n / total)))
             pieces.append((SPIECE_UNDERLINE + c, math.log(n / total) - 1.0))
+            seen.update((c, SPIECE_UNDERLINE + c))
         for w, n in counts.most_common(max_pieces - len(pieces)):
-            if w not in dict(pieces):
+            if w not in seen:
                 pieces.append((w, math.log(n / total)))
+                seen.add(w)
         return cls(pieces, **kw)
 
     # -- core unigram segmentation -----------------------------------------

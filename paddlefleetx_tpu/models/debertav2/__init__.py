@@ -1,0 +1,1 @@
+from paddlefleetx_tpu.models.debertav2.config import DebertaV2Config  # noqa: F401

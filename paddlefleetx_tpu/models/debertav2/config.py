@@ -1,0 +1,55 @@
+"""DebertaV2 configuration (reference DebertaV2Encoder /
+DisentangledSelfAttention kwargs, ppfleetx/models/language_model/debertav2/
+modeling.py:428-508, 688-745)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DebertaV2Config:
+    vocab_size: int = 128100
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-7
+    # disentangled attention
+    relative_attention: bool = True
+    position_buckets: int = 256
+    max_relative_positions: int = -1  # -1 -> max_position_embeddings
+    pos_att_type: Tuple[str, ...] = ("p2c", "c2p")
+    share_att_key: bool = True
+    # absolute positions added to the input embedding (off for v2-xxlarge)
+    position_biased_input: bool = False
+    # optional token conv branch on the first layer output (ConvLayer :381)
+    conv_kernel_size: int = 0
+    pad_token_id: int = 0
+    num_classes: int = 2
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_size % self.num_attention_heads == 0
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def pos_ebd_size(self) -> int:
+        if self.position_buckets > 0:
+            return self.position_buckets
+        m = self.max_relative_positions
+        return m if m > 0 else self.max_position_embeddings
+
+    @classmethod
+    def from_config(cls, d: Dict[str, Any]) -> "DebertaV2Config":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in fields}
+        if isinstance(kw.get("pos_att_type"), (list, tuple)):
+            kw["pos_att_type"] = tuple(kw["pos_att_type"])
+        return cls(**kw)

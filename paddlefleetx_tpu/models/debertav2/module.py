@@ -1,0 +1,71 @@
+"""DebertaV2 engine modules (MLM pretrain / sequence classification).
+
+The reference ships DebertaV2 as a model library (used standalone and as an
+Imagen text encoder); here it also plugs into the Engine."""
+
+from __future__ import annotations
+
+from paddlefleetx_tpu.core.module import BasicModule, resolve_model_dtype
+from paddlefleetx_tpu.models.debertav2 import model as deberta
+from paddlefleetx_tpu.models.debertav2.config import DebertaV2Config
+from paddlefleetx_tpu.utils.registry import MODULES
+
+
+def _config_from(cfg) -> DebertaV2Config:
+    model_cfg = dict(cfg.Model)
+    model_cfg.pop("module", None)
+    model_cfg.pop("name", None)
+    resolve_model_dtype(cfg, model_cfg)
+    return DebertaV2Config.from_config(model_cfg)
+
+
+@MODULES.register("DebertaV2Module")
+class DebertaV2Module(BasicModule):
+    """Masked-LM pretraining."""
+
+    head = "mlm"
+
+    def __init__(self, cfg):
+        self.config = _config_from(cfg)
+        self.tokens_per_sample = self.config.max_position_embeddings
+        seq = cfg.get("Data", {}).get("Train", {}).get("dataset", {}).get("max_seq_len")
+        if seq:
+            self.tokens_per_sample = int(seq)
+
+    def init_params(self, key):
+        return deberta.init(self.config, key, head=self.head)
+
+    def logical_axes(self):
+        return deberta.debertav2_logical_axes(self.config, head=self.head)
+
+    def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=True):
+        return deberta.mlm_loss(
+            params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
+        )
+
+
+@MODULES.register("DebertaV2SeqClsModule")
+class DebertaV2SeqClsModule(DebertaV2Module):
+    """Sequence-classification finetune."""
+
+    head = "cls"
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.metric_cfg = dict(cfg.Model.get("metric", {}) or {})
+
+    def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=True):
+        logits = deberta.cls_forward(
+            params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
+        )
+        return deberta.cls_loss(logits, batch["labels"])
+
+    def predict_fn(self, params, batch, *, ctx=None):
+        return deberta.cls_forward(params, batch, self.config, ctx=ctx, train=False)
+
+    def build_metric(self):
+        from paddlefleetx_tpu.models.metrics import Accuracy, build_metric
+
+        if self.metric_cfg.get("eval"):
+            return build_metric(self.metric_cfg["eval"])
+        return Accuracy()

@@ -28,7 +28,6 @@ class T5Config:
     eos_token_id: int = 1
     decoder_start_token_id: int = 0
     dtype: str = "bfloat16"
-    attn_impl: str = "xla"
     use_recompute: bool = False
 
     @property

@@ -216,7 +216,7 @@ def _attn(
     v = _proj(x_kv, p["v_kernel"])
     out = attention(
         q, k, v,
-        impl="xla",  # bias-carrying attention always takes the XLA path
+        impl="xla",  # T5 attention always carries a bias -> XLA path only
         causal=False,
         bias=bias,
         dropout_key=key,
@@ -266,9 +266,8 @@ def _run_stack(
             keys = dict(zip(names, jax.random.split(lk, len(names))))
         h = _constrain(ctx, h, ("batch", "seq", "embed"))
         if decoder:
-            y = _attn(lp["self_attn"], rms_norm(h, lp["ln_self"]["scale"], cfg.layer_norm_epsilon),
-                      rms_norm(h, lp["ln_self"]["scale"], cfg.layer_norm_epsilon),
-                      self_bias, cfg, keys.get("attn"), train)
+            xn = rms_norm(h, lp["ln_self"]["scale"], cfg.layer_norm_epsilon)
+            y = _attn(lp["self_attn"], xn, xn, self_bias, cfg, keys.get("attn"), train)
             h = h + dropout(keys.get("res1"), y, cfg.dropout_rate, train)
             y = _attn(lp["cross_attn"], rms_norm(h, lp["ln_cross"]["scale"], cfg.layer_norm_epsilon),
                       enc_out, cross_bias, cfg, keys.get("cross"), train)

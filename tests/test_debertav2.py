@@ -1,0 +1,174 @@
+"""DebertaV2 tests: log buckets, disentangled attention numerics, heads,
+conv branch, TP parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.models.debertav2 import model as dbv2
+from paddlefleetx_tpu.models.debertav2.config import DebertaV2Config
+from paddlefleetx_tpu.models.gpt.model import ShardingCtx
+from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh
+from paddlefleetx_tpu.parallel.sharding import make_rules, tree_logical_to_sharding
+
+TINY = DebertaV2Config(
+    vocab_size=120,
+    hidden_size=32,
+    num_layers=2,
+    num_attention_heads=4,
+    intermediate_size=48,
+    max_position_embeddings=64,
+    position_buckets=8,
+    hidden_dropout_prob=0.0,
+    attention_probs_dropout_prob=0.0,
+    dtype="float32",
+)
+
+
+def _batch(cfg, b=2, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(3, cfg.vocab_size, (b, s))
+    ids[:, -2:] = cfg.pad_token_id
+    labels = np.full((b, s), -1, np.int64)
+    labels[:, 2:5] = ids[:, 2:5]
+    return {
+        "input_ids": jnp.asarray(ids),
+        "attention_mask": jnp.asarray((ids != cfg.pad_token_id).astype(np.int32)),
+        "labels": jnp.asarray(labels),
+    }
+
+
+def test_log_bucket_positions():
+    rel = jnp.arange(-60, 61)
+    buck = dbv2.make_log_bucket_position(rel, bucket_size=8, max_position=64)
+    # small offsets pass through
+    np.testing.assert_array_equal(np.asarray(buck[57:64]), np.arange(-3, 4))
+    # bounded by +-mid..ish (log region compresses to <= mid)
+    assert int(jnp.max(jnp.abs(buck))) <= 8
+    # monotone non-decreasing
+    assert bool(jnp.all(jnp.diff(buck) >= 0))
+
+
+def test_mlm_forward_and_loss_level():
+    params = dbv2.init(TINY, jax.random.key(0), head="mlm")
+    batch = _batch(TINY)
+    hidden = dbv2.encode(params, batch["input_ids"], TINY, attention_mask=batch["attention_mask"])
+    assert hidden.shape == (2, 16, 32)
+    logits = dbv2.mlm_logits(params, hidden, TINY)
+    assert logits.shape == (2, 16, 120)
+    loss = dbv2.mlm_loss(params, batch, TINY, train=False)
+    assert np.isfinite(float(loss))
+    assert abs(float(loss) - np.log(TINY.vocab_size)) < 1.0
+
+
+def test_pad_invariance():
+    params = dbv2.init(TINY, jax.random.key(1), head="mlm")
+    batch = _batch(TINY)
+    a = dbv2.encode(params, batch["input_ids"], TINY, attention_mask=batch["attention_mask"])
+    scrambled = batch["input_ids"].at[:, -2:].set(7)
+    b = dbv2.encode(params, scrambled, TINY, attention_mask=batch["attention_mask"])
+    np.testing.assert_allclose(np.asarray(a[:, :-2]), np.asarray(b[:, :-2]), rtol=1e-5, atol=1e-5)
+
+
+def test_rel_attention_changes_scores():
+    """Disentangled bias must actually contribute: zeroing rel_embeddings
+    changes the output."""
+    params = dbv2.init(TINY, jax.random.key(2), head="mlm")
+    batch = _batch(TINY)
+    a = dbv2.encode(params, batch["input_ids"], TINY)
+    # same content weights, relative attention disabled -> different output
+    # (rel_embeddings is LayerNormed, so scaling it is invisible; on/off is
+    # the honest wiring check)
+    cfg_off = DebertaV2Config(**{**TINY.__dict__, "relative_attention": False})
+    b = dbv2.encode(params, batch["input_ids"], cfg_off)
+    assert float(jnp.max(jnp.abs(a - b))) > 1e-4
+
+
+def test_share_att_key_false_has_extra_params():
+    cfg = DebertaV2Config(**{**TINY.__dict__, "share_att_key": False})
+    params = dbv2.init(cfg, jax.random.key(3))
+    attn = params["layers"]["attn"]
+    assert "pos_k_kernel" in attn and "pos_q_kernel" in attn
+    out = dbv2.encode(params, _batch(cfg)["input_ids"], cfg)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_conv_branch():
+    cfg = DebertaV2Config(**{**TINY.__dict__, "conv_kernel_size": 3})
+    params = dbv2.init(cfg, jax.random.key(4))
+    assert "conv" in params
+    out = dbv2.encode(params, _batch(cfg)["input_ids"], cfg)
+    assert out.shape == (2, 16, 32)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_cls_head_and_overfit():
+    import optax
+
+    cfg = DebertaV2Config(**{**TINY.__dict__, "num_classes": 3})
+    params = dbv2.init(cfg, jax.random.key(5), head="cls")
+    batch = _batch(cfg)
+    batch["labels"] = jnp.asarray([0, 2])
+    logits = dbv2.cls_forward(params, batch, cfg)
+    assert logits.shape == (2, 3)
+
+    tx = optax.adam(5e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o):
+        def f(pp):
+            lg = dbv2.cls_forward(pp, batch, cfg, train=True)
+            return dbv2.cls_loss(lg, batch["labels"])
+
+        loss, g = jax.value_and_grad(f)(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    first = None
+    for _ in range(15):
+        params, opt, loss = step(params, opt)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first * 0.5
+
+
+def test_tp_parity(devices8):
+    params = dbv2.init(TINY, jax.random.key(6), head="mlm")
+    batch = _batch(TINY)
+    ref = dbv2.encode(params, batch["input_ids"], TINY)
+
+    mesh = build_mesh(MeshConfig(dp_degree=2, mp_degree=4))
+    rules = make_rules()
+    shardings = tree_logical_to_sharding(
+        dbv2.debertav2_logical_axes(TINY, head="mlm"), mesh, rules
+    )
+    p_sharded = jax.tree.map(lambda x, s: jax.device_put(x, s), params, shardings)
+    ctx = ShardingCtx(mesh=mesh, rules=rules)
+
+    @jax.jit
+    def fwd(p, ids):
+        return dbv2.encode(p, ids, TINY, ctx=ctx)
+
+    out = fwd(p_sharded, batch["input_ids"])
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4)
+
+
+def test_module_registry():
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.utils.config import AttrDict
+
+    cfg = AttrDict(
+        {
+            "Model": dict(module="DebertaV2Module", vocab_size=120, hidden_size=32,
+                          num_layers=2, num_attention_heads=4, intermediate_size=48,
+                          max_position_embeddings=64, position_buckets=8,
+                          hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                          dtype="float32"),
+            "Data": {},
+        }
+    )
+    mod = build_module(cfg)
+    params = mod.init_params(jax.random.key(0))
+    loss = mod.loss_fn(params, _batch(mod.config), train=False)
+    assert np.isfinite(float(loss))
