@@ -247,8 +247,12 @@ def encode(
     x = dropout(k_emb, x, cfg.hidden_dropout_prob, train)
     x = _constrain(ctx, x, ("batch", "seq", "embed"))
 
-    # shared rel-position machinery, computed once per forward
-    rel_q = rel_k = c2p_onehot = p2c_onehot = None
+    # shared rel-position machinery, computed once per forward.  rel_idx
+    # [q, k] = bucket(q-k) + span indexes the projected rel-embedding rows
+    # for BOTH terms: c2p gathers it directly (q_i . pos_k[bucket(i-j)]),
+    # p2c gathers its transpose (k_j . pos_q[bucket(i-j)] consulted at
+    # [k, q] — reference disentangled_attention_bias p2c gather+transpose)
+    rel_emb = rel_idx = None
     if cfg.relative_attention:
         span = cfg.pos_ebd_size
         rel_emb = layer_norm(
@@ -256,13 +260,7 @@ def encode(
             params["rel_ln"]["scale"], params["rel_ln"]["bias"], cfg.layer_norm_eps,
         )
         rel = build_relative_position(s, s, cfg)  # [q, k] in [-span, span)
-        if "c2p" in cfg.pos_att_type:
-            idx = jnp.clip(rel + span, 0, 2 * span - 1)
-            c2p_onehot = jax.nn.one_hot(idx, 2 * span, dtype=jnp.float32)
-        if "p2c" in cfg.pos_att_type:
-            idx = jnp.clip(-rel + span, 0, 2 * span - 1)
-            # table indexed [k, q, p] — consumed as einsum 'bhkp,kqp->bhqk'
-            p2c_onehot = jax.nn.one_hot(idx.T, 2 * span, dtype=jnp.float32)
+        rel_idx = jnp.clip(rel + span, 0, 2 * span - 1)
 
     def block(carry, lp):
         h, idx = carry
@@ -283,7 +281,7 @@ def encode(
                 if "p2c" in cfg.pos_att_type:
                     lrel_q = _heads(rel_emb, lp["attn"]["pos_q_kernel"], lp["attn"]["pos_q_bias"])
         y = _disentangled(
-            lp["attn"], h, lrel_q, lrel_k, c2p_onehot, p2c_onehot, pad_bias,
+            lp["attn"], h, lrel_q, lrel_k, rel_idx, pad_bias,
             cfg, keys.get("attn"), train,
         )
         y = dropout(keys.get("post_attn"), y, cfg.hidden_dropout_prob, train)
@@ -306,8 +304,13 @@ def encode(
     return x
 
 
-def _disentangled(p, h, rel_q, rel_k, c2p_onehot, p2c_onehot, pad_bias, cfg, key, train):
-    """Core scores (separated from the projection-sharing logic above)."""
+def _disentangled(p, h, rel_q, rel_k, rel_idx, pad_bias, cfg, key, train):
+    """Core scores (separated from the projection-sharing logic above).
+
+    The reference's take_along_axis gathers are kept as gathers (same
+    O(b·h·s·s) cost as the content score) rather than one-hot matmuls,
+    which would cost 2·span/head_dim times the content matmul and hold
+    [s, s, 2·span] tables live in HBM."""
     b, s, _ = h.shape
     nh, hd = cfg.num_attention_heads, cfg.head_dim
     q = _heads(h, p["q_kernel"], p["q_bias"])
@@ -324,10 +327,13 @@ def _disentangled(p, h, rel_q, rel_k, c2p_onehot, p2c_onehot, pad_bias, cfg, key
     score = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     if cfg.relative_attention and "c2p" in cfg.pos_att_type and rel_k is not None:
         cp = jnp.einsum("bqhd,phd->bhqp", q, rel_k, preferred_element_type=jnp.float32)
-        score = score + jnp.einsum("bhqp,qkp->bhqk", cp, c2p_onehot)
+        # score(q,k) += q_q . pos_k[bucket(q-k)]
+        score = score + jnp.take_along_axis(cp, rel_idx[None, None, :, :], axis=-1)
     if cfg.relative_attention and "p2c" in cfg.pos_att_type and rel_q is not None:
         pc = jnp.einsum("bkhd,phd->bhkp", k, rel_q, preferred_element_type=jnp.float32)
-        score = score + jnp.einsum("bhkp,kqp->bhqk", pc, p2c_onehot)
+        # score(q,k) += k_k . pos_q[bucket(q-k)]: gather at [k, q] then swap
+        pcg = jnp.take_along_axis(pc, rel_idx.T[None, None, :, :], axis=-1)
+        score = score + jnp.swapaxes(pcg, -1, -2)
     score = score * scale
     if pad_bias is not None:
         score = score + pad_bias

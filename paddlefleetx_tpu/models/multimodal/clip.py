@@ -1,0 +1,258 @@
+"""CLIP — contrastive language-image pretraining, functional.
+
+The reference ships only a stub clip package
+(ppfleetx/models/multimodal_model/clip/__init__.py is empty — SURVEY §2.3
+"partial"); this is a complete implementation to close that gap the TPU way:
+
+  - vision tower: the existing ViT (models/vit) with its classification
+    head re-purposed as the image->embedding projection
+  - text tower: compact pre-LN causal transformer; the sequence feature is
+    taken at each sample's last non-pad token (CLIP's "EOT pooling")
+  - symmetric InfoNCE over the GLOBAL batch: under pjit the batch axis is
+    already global, so the cross-device feature all_gather that a
+    NCCL implementation needs (same pattern as MoCo concat_all_gather,
+    reference moco.py:35-46) is implied by the sharding — logits_per_image
+    = scale * img @ txt.T directly
+  - learnable temperature stored as log scale, clamped at 100 (CLIP paper)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.models.common import (
+    ParamSpec,
+    dropout,
+    init_params,
+    logical_axes,
+    normal_init,
+    ones_init,
+    stack_spec_tree,
+    zeros_init,
+)
+from paddlefleetx_tpu.models.gpt.model import ShardingCtx, _constrain, layer_norm
+from paddlefleetx_tpu.models.vit import model as vit
+from paddlefleetx_tpu.models.vit.model import ViTConfig
+from paddlefleetx_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    projection_dim: int = 512
+    # vision tower (ViT-B/16 by default)
+    image_size: int = 224
+    patch_size: int = 16
+    vision_hidden_size: int = 768
+    vision_layers: int = 12
+    vision_heads: int = 12
+    # text tower
+    vocab_size: int = 49408
+    max_text_len: int = 77
+    text_hidden_size: int = 512
+    text_layers: int = 12
+    text_heads: int = 8
+    pad_token_id: int = 0
+    logit_scale_init: float = math.log(1.0 / 0.07)
+    initializer_range: float = 0.02
+    dropout_prob: float = 0.0
+    dtype: str = "bfloat16"
+    attn_impl: str = "xla"
+
+    @property
+    def vision_config(self) -> ViTConfig:
+        return ViTConfig(
+            image_size=self.image_size,
+            patch_size=self.patch_size,
+            num_classes=self.projection_dim,  # head == projection
+            hidden_size=self.vision_hidden_size,
+            num_layers=self.vision_layers,
+            num_attention_heads=self.vision_heads,
+            hidden_dropout_prob=self.dropout_prob,
+            attention_probs_dropout_prob=self.dropout_prob,
+            initializer_range=self.initializer_range,
+            dtype=self.dtype,
+        )
+
+    @classmethod
+    def from_config(cls, d: Dict[str, Any]) -> "CLIPConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+# ---------------------------------------------------------------------------
+# Text tower specs
+# ---------------------------------------------------------------------------
+
+
+def _text_layer_specs(cfg: CLIPConfig) -> Dict[str, Any]:
+    h = cfg.text_hidden_size
+    nh = cfg.text_heads
+    hd = h // nh
+    ffn = 4 * h
+    w = normal_init(cfg.initializer_range)
+    return {
+        "ln_1": {"scale": ParamSpec((h,), ("embed",), ones_init()),
+                 "bias": ParamSpec((h,), ("embed",), zeros_init())},
+        "attn": {
+            "qkv_kernel": ParamSpec((h, 3, nh, hd), ("embed", None, "heads", "kv"), w),
+            "qkv_bias": ParamSpec((3, nh, hd), (None, "heads", "kv"), zeros_init()),
+            "out_kernel": ParamSpec((nh, hd, h), ("heads", "kv", "embed"), w),
+            "out_bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+        "ln_2": {"scale": ParamSpec((h,), ("embed",), ones_init()),
+                 "bias": ParamSpec((h,), ("embed",), zeros_init())},
+        "mlp": {
+            "fc_in_kernel": ParamSpec((h, ffn), ("embed", "mlp"), w),
+            "fc_in_bias": ParamSpec((ffn,), ("mlp",), zeros_init()),
+            "fc_out_kernel": ParamSpec((ffn, h), ("mlp", "embed"), w),
+            "fc_out_bias": ParamSpec((h,), ("embed",), zeros_init()),
+        },
+    }
+
+
+def clip_specs(cfg: CLIPConfig) -> Dict[str, Any]:
+    h = cfg.text_hidden_size
+    w = normal_init(cfg.initializer_range)
+    return {
+        "vision": vit.vit_specs(cfg.vision_config),
+        "text": {
+            "token_embedding": ParamSpec((cfg.vocab_size, h), ("vocab", "embed"), w),
+            "pos_embedding": ParamSpec((cfg.max_text_len, h), (None, "embed"), w),
+            "layers": stack_spec_tree(_text_layer_specs(cfg), cfg.text_layers),
+            "final_ln": {"scale": ParamSpec((h,), ("embed",), ones_init()),
+                         "bias": ParamSpec((h,), ("embed",), zeros_init())},
+            "projection": ParamSpec((h, cfg.projection_dim), ("embed", None), w),
+        },
+        "logit_scale": ParamSpec(
+            (), (), lambda key, shape, dtype: jnp.asarray(cfg.logit_scale_init, dtype)
+        ),
+    }
+
+
+def init(cfg: CLIPConfig, key: jax.Array) -> Dict[str, Any]:
+    return init_params(key, clip_specs(cfg))
+
+
+def clip_logical_axes(cfg: CLIPConfig) -> Dict[str, Any]:
+    return logical_axes(clip_specs(cfg))
+
+
+# ---------------------------------------------------------------------------
+# Towers
+# ---------------------------------------------------------------------------
+
+
+def encode_image(
+    params: Dict[str, Any],
+    images: jax.Array,
+    cfg: CLIPConfig,
+    *,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> jax.Array:
+    """-> L2-normalized image embeddings [b, projection_dim]."""
+    emb = vit.forward(
+        params["vision"], images, cfg.vision_config,
+        ctx=ctx, dropout_key=dropout_key, train=train,
+    )
+    return emb / (jnp.linalg.norm(emb.astype(jnp.float32), axis=-1, keepdims=True) + 1e-8).astype(emb.dtype)
+
+
+def encode_text(
+    params: Dict[str, Any],
+    input_ids: jax.Array,
+    cfg: CLIPConfig,
+    *,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> jax.Array:
+    """-> L2-normalized text embeddings [b, projection_dim] (EOT pooling)."""
+    tp = params["text"]
+    dtype = jnp.dtype(cfg.dtype)
+    b, s = input_ids.shape
+    x = tp["token_embedding"][input_ids].astype(dtype) + tp["pos_embedding"][:s][None].astype(dtype)
+    x = _constrain(ctx, x, ("batch", "seq", "embed"))
+
+    nh = cfg.text_heads
+    hd = cfg.text_hidden_size // nh
+
+    def block(carry, inp):
+        h, idx = carry
+        lp = inp
+        key = (
+            jax.random.fold_in(dropout_key, idx) if dropout_key is not None else None
+        )
+        h = _constrain(ctx, h, ("batch", "seq", "embed"))
+        xn = layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"])
+        qkv = jnp.einsum("bsd,dthk->bsthk", xn, lp["attn"]["qkv_kernel"]) + lp["attn"]["qkv_bias"]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        y = attention(
+            q, k, v, impl=cfg.attn_impl, causal=True,
+            dropout_key=key, dropout_rate=cfg.dropout_prob, train=train,
+        )
+        y = jnp.einsum("bshk,hkd->bsd", y, lp["attn"]["out_kernel"]) + lp["attn"]["out_bias"]
+        h = h + y
+        xn = layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"])
+        y = jax.nn.gelu(xn @ lp["mlp"]["fc_in_kernel"] + lp["mlp"]["fc_in_bias"], approximate=True)
+        y = y @ lp["mlp"]["fc_out_kernel"] + lp["mlp"]["fc_out_bias"]
+        return (h + y, idx + 1), None
+
+    (x, _), _ = jax.lax.scan(block, (x, jnp.int32(0)), tp["layers"], length=cfg.text_layers)
+    x = layer_norm(x, tp["final_ln"]["scale"], tp["final_ln"]["bias"])
+
+    # EOT pooling: feature at each sample's last non-pad position
+    lengths = jnp.sum((input_ids != cfg.pad_token_id).astype(jnp.int32), axis=1)
+    eot = jnp.clip(lengths - 1, 0, s - 1)
+    feat = jnp.take_along_axis(x, eot[:, None, None], axis=1)[:, 0]
+    emb = feat @ tp["projection"].astype(feat.dtype)
+    return emb / (jnp.linalg.norm(emb.astype(jnp.float32), axis=-1, keepdims=True) + 1e-8).astype(emb.dtype)
+
+
+def forward(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: CLIPConfig,
+    *,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    ki = kt = None
+    if dropout_key is not None:
+        ki, kt = jax.random.split(dropout_key)
+    img = encode_image(params, batch["images"], cfg, ctx=ctx, dropout_key=ki, train=train)
+    txt = encode_text(params, batch["input_ids"], cfg, ctx=ctx, dropout_key=kt, train=train)
+    # straight-through clamp at ln(100): value is clipped but the gradient
+    # passes through, so the parameter stays trainable at the boundary
+    # (OpenAI CLIP clamps the param post-step; a plain min() would zero the
+    # gradient and freeze the temperature once it crossed the cap)
+    ls = params["logit_scale"]
+    ls = ls - jax.lax.stop_gradient(jnp.maximum(ls - math.log(100.0), 0.0))
+    return img, txt, jnp.exp(ls)
+
+
+def clip_loss(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: CLIPConfig,
+    *,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = True,
+) -> jax.Array:
+    """Symmetric InfoNCE over the global batch."""
+    img, txt, scale = forward(
+        params, batch, cfg, ctx=ctx, dropout_key=dropout_key, train=train
+    )
+    logits = (scale * img @ txt.T).astype(jnp.float32)  # [b, b]
+    labels = jnp.arange(logits.shape[0])
+    li = -jnp.mean(jnp.take_along_axis(jax.nn.log_softmax(logits, axis=1), labels[:, None], axis=1))
+    lt = -jnp.mean(jnp.take_along_axis(jax.nn.log_softmax(logits, axis=0), labels[None, :], axis=0))
+    return 0.5 * (li + lt)

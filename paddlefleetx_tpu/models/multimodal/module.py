@@ -1,0 +1,33 @@
+"""Multimodal engine modules (reference multimodal_module.py ImagenModule;
+CLIPModule added — the reference's clip package is an empty stub)."""
+
+from __future__ import annotations
+
+from paddlefleetx_tpu.core.module import BasicModule, resolve_model_dtype
+from paddlefleetx_tpu.models.multimodal import clip as clip_model
+from paddlefleetx_tpu.models.multimodal.clip import CLIPConfig
+from paddlefleetx_tpu.utils.registry import MODULES
+
+
+@MODULES.register("CLIPModule")
+class CLIPModule(BasicModule):
+    """Contrastive image-text pretraining."""
+
+    def __init__(self, cfg):
+        model_cfg = dict(cfg.Model)
+        model_cfg.pop("module", None)
+        model_cfg.pop("name", None)
+        resolve_model_dtype(cfg, model_cfg)
+        self.config = CLIPConfig.from_config(model_cfg)
+        self.tokens_per_sample = self.config.max_text_len
+
+    def init_params(self, key):
+        return clip_model.init(self.config, key)
+
+    def logical_axes(self):
+        return clip_model.clip_logical_axes(self.config)
+
+    def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=True):
+        return clip_model.clip_loss(
+            params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
+        )
