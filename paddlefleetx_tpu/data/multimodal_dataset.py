@@ -1,0 +1,125 @@
+"""Imagen image-text dataset.
+
+Re-design of the reference ImagenDataset
+(ppfleetx/data/dataset/multimodal_dataset.py:62-202): a file list of
+json lines, each with a base64-encoded image + caption; images decoded,
+resized, scaled to [0, 1]; captions tokenized to fixed length.
+
+Line format (either key set works):
+  {"image_base64": "<b64 png/jpeg>", "caption": "..."}
+  {"image_npy_base64": "<b64 of np.save bytes>", "caption": "..."}
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from paddlefleetx_tpu.utils.registry import DATASETS
+
+
+@DATASETS.register("ImagenDataset")
+class ImagenDataset:
+    def __init__(
+        self,
+        input_path: str,
+        image_size: int = 64,
+        max_seq_len: int = 128,
+        tokenizer: Optional[Any] = None,
+        filter_image_size: int = 0,
+        mode: str = "Train",
+        num_samples: Optional[int] = None,
+    ):
+        self.image_size = image_size
+        self.max_seq_len = max_seq_len
+        self.tokenizer = tokenizer
+        self.mode = mode
+        self.records: List[Dict[str, Any]] = []
+        with open(input_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    self.records.append(json.loads(line))
+        if filter_image_size > 0:
+            # drop records whose stored size metadata is below threshold
+            # (reference ImagenDataset filters small source images)
+            self.records = [
+                r for r in self.records
+                if min(r.get("width", filter_image_size), r.get("height", filter_image_size))
+                >= filter_image_size
+            ]
+        if num_samples is not None and num_samples < len(self.records):
+            self.records = self.records[:num_samples]
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def _decode_image(self, rec: Dict[str, Any]) -> np.ndarray:
+        if "image_npy_base64" in rec:
+            arr = np.load(io.BytesIO(base64.b64decode(rec["image_npy_base64"])))
+        else:
+            from PIL import Image
+
+            img = Image.open(io.BytesIO(base64.b64decode(rec["image_base64"])))
+            arr = np.asarray(img.convert("RGB"))
+        return arr
+
+    def _resize(self, arr: np.ndarray) -> np.ndarray:
+        h, w = arr.shape[:2]
+        s = self.image_size
+        if (h, w) != (s, s):
+            try:
+                from PIL import Image
+
+                arr = np.asarray(
+                    Image.fromarray(arr.astype(np.uint8)).resize((s, s), Image.BILINEAR)
+                )
+            except Exception:
+                # nearest-neighbor numpy fallback
+                yi = (np.arange(s) * h // s).clip(0, h - 1)
+                xi = (np.arange(s) * w // s).clip(0, w - 1)
+                arr = arr[yi][:, xi]
+        return arr
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        rec = self.records[idx]
+        raw = self._decode_image(rec)
+        to_unit = np.issubdtype(raw.dtype, np.integer)
+        arr = self._resize(raw).astype(np.float32)
+        if to_unit:
+            arr = arr / 255.0
+        out: Dict[str, np.ndarray] = {"images": arr}
+        caption = rec.get("caption", "")
+        if self.tokenizer is not None:
+            ids = self.tokenizer.encode(caption)[: self.max_seq_len]
+            pad = getattr(self.tokenizer, "pad_id", 0)
+            ids = ids + [pad] * (self.max_seq_len - len(ids))
+            out["input_ids"] = np.asarray(ids, np.int64)
+        if "text_embed" in rec:
+            out["text_embeds"] = np.asarray(rec["text_embed"], np.float32)
+        return out
+
+
+def write_synthetic_image_text_corpus(
+    path: str, n: int = 8, image_size: int = 32, seed: int = 0
+) -> str:
+    """Tiny synthetic jsonl corpus (tests/demos)."""
+    rng = np.random.default_rng(seed)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    words = ["red", "green", "cat", "dog", "sky", "tree", "sun", "sea"]
+    with open(path, "w") as f:
+        for i in range(n):
+            img = (rng.uniform(size=(image_size, image_size, 3)) * 255).astype(np.uint8)
+            buf = io.BytesIO()
+            np.save(buf, img)
+            rec = {
+                "image_npy_base64": base64.b64encode(buf.getvalue()).decode(),
+                "caption": " ".join(rng.choice(words, 3)),
+            }
+            f.write(json.dumps(rec) + "\n")
+    return path

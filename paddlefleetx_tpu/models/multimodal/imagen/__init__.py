@@ -1,0 +1,4 @@
+from paddlefleetx_tpu.models.multimodal.imagen.imagen import (  # noqa: F401
+    ImagenConfig,
+    UnetConfig,
+)

@@ -1,0 +1,98 @@
+"""Continuous-time Gaussian diffusion (Imagen flavor).
+
+Re-design of the reference GaussianDiffusionContinuousTimes
+(ppfleetx/models/multimodal_model/imagen/utils.py:384-481) with its two
+log-SNR noise schedules (beta_linear_log_snr :370, alpha_cosine_log_snr
+:374, log_snr_to_alpha_sigma :380).  Everything is a pure function of
+continuous time t in [0, 1]; sampling discretizes t uniformly.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def beta_linear_log_snr(t: jax.Array) -> jax.Array:
+    return -jnp.log(jnp.expm1(1e-4 + 10.0 * t * t))
+
+
+def alpha_cosine_log_snr(t: jax.Array, s: float = 0.008) -> jax.Array:
+    # -log(cos^{-2}(pi/2 * (t+s)/(1+s)) - 1)
+    c = jnp.cos((t + s) / (1 + s) * math.pi * 0.5) ** -2
+    return -jnp.log(jnp.clip(c - 1.0, 1e-5, None))
+
+
+def log_snr_to_alpha_sigma(log_snr: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    return jnp.sqrt(jax.nn.sigmoid(log_snr)), jnp.sqrt(jax.nn.sigmoid(-log_snr))
+
+
+class GaussianDiffusionContinuousTimes:
+    """Stateless schedule helper (cheap to construct anywhere)."""
+
+    def __init__(self, noise_schedule: str = "cosine", num_timesteps: int = 1000):
+        if noise_schedule == "linear":
+            self.log_snr = beta_linear_log_snr
+        elif noise_schedule == "cosine":
+            self.log_snr = alpha_cosine_log_snr
+        else:
+            raise ValueError(f"unknown noise schedule {noise_schedule}")
+        self.num_timesteps = num_timesteps
+
+    # -- forward process ----------------------------------------------------
+
+    def sample_random_times(self, key: jax.Array, batch: int) -> jax.Array:
+        return jax.random.uniform(key, (batch,), minval=0.0, maxval=1.0)
+
+    def q_sample(
+        self, x0: jax.Array, t: jax.Array, noise: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """x_t = alpha_t x0 + sigma_t eps.  t: [b]. Returns (x_t, log_snr, alpha)."""
+        log_snr = self.log_snr(t)
+        pad = (slice(None),) + (None,) * (x0.ndim - 1)
+        alpha, sigma = log_snr_to_alpha_sigma(log_snr)
+        x_t = alpha[pad] * x0 + sigma[pad] * noise
+        return x_t, log_snr, alpha
+
+    # -- reverse process ----------------------------------------------------
+
+    def get_times(self) -> jax.Array:
+        """[T+1] descending times 1 -> 0 (pairs (t, s) slide along this)."""
+        return jnp.linspace(1.0, 0.0, self.num_timesteps + 1)
+
+    def q_posterior(
+        self, x0: jax.Array, x_t: jax.Array, t: jax.Array, s: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Mean/log-variance of q(x_s | x_t, x0) for s < t
+        (reference q_posterior utils.py:428-447)."""
+        log_snr_t = self.log_snr(t)
+        log_snr_s = self.log_snr(s)
+        pad = (slice(None),) + (None,) * (x0.ndim - 1)
+        alpha_t, sigma_t = log_snr_to_alpha_sigma(log_snr_t)
+        alpha_s, sigma_s = log_snr_to_alpha_sigma(log_snr_s)
+        # c = -expm1(log_snr_t - log_snr_s)  (variance-preserving transition)
+        c = -jnp.expm1(log_snr_t - log_snr_s)
+        mean = alpha_s[pad] * (x_t * (1 - c)[pad] / jnp.maximum(alpha_t, 1e-8)[pad] + c[pad] * x0)
+        var = (sigma_s ** 2) * c
+        return mean, jnp.log(jnp.clip(var, 1e-20, None))[pad]
+
+    def predict_start_from_noise(self, x_t: jax.Array, t: jax.Array, noise: jax.Array) -> jax.Array:
+        log_snr = self.log_snr(t)
+        pad = (slice(None),) + (None,) * (x_t.ndim - 1)
+        alpha, sigma = log_snr_to_alpha_sigma(log_snr)
+        return (x_t - sigma[pad] * noise) / jnp.maximum(alpha[pad], 1e-8)
+
+    def predict_start_from_v(self, x_t: jax.Array, t: jax.Array, v: jax.Array) -> jax.Array:
+        log_snr = self.log_snr(t)
+        pad = (slice(None),) + (None,) * (x_t.ndim - 1)
+        alpha, sigma = log_snr_to_alpha_sigma(log_snr)
+        return alpha[pad] * x_t - sigma[pad] * v
+
+    def calculate_v(self, x0: jax.Array, t: jax.Array, noise: jax.Array) -> jax.Array:
+        log_snr = self.log_snr(t)
+        pad = (slice(None),) + (None,) * (x0.ndim - 1)
+        alpha, sigma = log_snr_to_alpha_sigma(log_snr)
+        return alpha[pad] * noise - sigma[pad] * x0

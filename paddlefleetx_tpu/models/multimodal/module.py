@@ -9,6 +9,93 @@ from paddlefleetx_tpu.models.multimodal.clip import CLIPConfig
 from paddlefleetx_tpu.utils.registry import MODULES
 
 
+@MODULES.register("ImagenModule")
+class ImagenModule(BasicModule):
+    """Text-to-image diffusion: trains ONE unet of the cascade
+    (reference ImagenModule multimodal_module.py + ImagenModel.forward
+    unet_number contract).
+
+    Text conditioning: batches may carry precomputed ``text_embeds`` /
+    ``text_mask``; otherwise a FROZEN text encoder (T5 or DebertaV2,
+    random-init unless restored from a checkpoint) rides the Engine's
+    non-gradient ``extra`` state and embeds ``input_ids`` on the fly."""
+
+    has_extra_state = True
+
+    def __init__(self, cfg):
+        from paddlefleetx_tpu.models.multimodal.imagen.imagen import ImagenConfig
+
+        model_cfg = dict(cfg.Model)
+        model_cfg.pop("module", None)
+        model_cfg.pop("name", None)
+        resolve_model_dtype(cfg, model_cfg)
+        self.text_encoder_cfg = model_cfg.pop("text_encoder", None)
+        self.config = ImagenConfig.from_config(model_cfg)
+        self.tokens_per_sample = self.config.image_sizes[self.config.train_index] ** 2
+        # resolve the frozen text-encoder family ONCE: (config, init,
+        # logical_axes, encode) — every other method goes through these
+        self._enc_cfg = self._enc_init = self._enc_axes = self._enc_encode = None
+        if self.text_encoder_cfg:
+            name = self.text_encoder_cfg.get("name", "t5")
+            if name == "t5":
+                from paddlefleetx_tpu.models.t5 import model as t5
+                from paddlefleetx_tpu.models.t5.config import T5Config
+
+                self._enc_cfg = T5Config.from_config(dict(self.text_encoder_cfg))
+                self._enc_init, self._enc_axes = t5.init, t5.t5_logical_axes
+                self._enc_encode = t5.encode
+            elif name == "debertav2":
+                from paddlefleetx_tpu.models.debertav2 import model as dbv2
+                from paddlefleetx_tpu.models.debertav2.config import DebertaV2Config
+
+                self._enc_cfg = DebertaV2Config.from_config(dict(self.text_encoder_cfg))
+                self._enc_init, self._enc_axes = dbv2.init, dbv2.debertav2_logical_axes
+                self._enc_encode = dbv2.encode
+            else:
+                raise ValueError(f"unknown text encoder {name}")
+
+    def init_params(self, key):
+        from paddlefleetx_tpu.models.multimodal.imagen import imagen
+
+        return imagen.init(self.config, key)
+
+    def logical_axes(self):
+        from paddlefleetx_tpu.models.multimodal.imagen import imagen
+
+        return imagen.imagen_logical_axes(self.config)
+
+    def init_extra(self, key, params):
+        if self._enc_init is None:
+            return {}
+        return {"text_encoder": self._enc_init(self._enc_cfg, key)}
+
+    def extra_logical_axes(self):
+        if self._enc_axes is None:
+            return {}
+        return {"text_encoder": self._enc_axes(self._enc_cfg)}
+
+    def _embed_text(self, extra, batch):
+        import jax
+
+        ids = batch["input_ids"]
+        enc = jax.tree.map(jax.lax.stop_gradient, extra["text_encoder"])
+        emb = self._enc_encode(enc, ids, self._enc_cfg)
+        mask = (ids != self._enc_cfg.pad_token_id).astype("int32")
+        return emb, mask
+
+    def loss_fn(self, params, batch, *, ctx=None, extra=None, dropout_key=None, train=True):
+        import jax
+
+        from paddlefleetx_tpu.models.multimodal.imagen import imagen
+
+        if "text_embeds" not in batch and extra and "text_encoder" in extra:
+            emb, mask = self._embed_text(extra, batch)
+            batch = {**batch, "text_embeds": emb, "text_mask": mask}
+        key = dropout_key if dropout_key is not None else jax.random.key(0)
+        loss = imagen.p_losses(params, batch, self.config, key, train=train)
+        return loss, extra
+
+
 @MODULES.register("CLIPModule")
 class CLIPModule(BasicModule):
     """Contrastive image-text pretraining."""
