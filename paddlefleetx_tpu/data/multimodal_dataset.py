@@ -31,12 +31,19 @@ class ImagenDataset:
         image_size: int = 64,
         max_seq_len: int = 128,
         tokenizer: Optional[Any] = None,
+        tokenizer_vocab: Optional[str] = None,
         filter_image_size: int = 0,
         mode: str = "Train",
         num_samples: Optional[int] = None,
     ):
         self.image_size = image_size
         self.max_seq_len = max_seq_len
+        if tokenizer is None and tokenizer_vocab:
+            # config path: Data.Train.dataset.tokenizer_vocab points at a
+            # saved T5Tokenizer vocab json (builders pass only yaml kwargs)
+            from paddlefleetx_tpu.data.tokenizers.t5_tokenizer import T5Tokenizer
+
+            tokenizer = T5Tokenizer.from_file(tokenizer_vocab)
         self.tokenizer = tokenizer
         self.mode = mode
         self.records: List[Dict[str, Any]] = []
@@ -72,19 +79,31 @@ class ImagenDataset:
     def _resize(self, arr: np.ndarray) -> np.ndarray:
         h, w = arr.shape[:2]
         s = self.image_size
-        if (h, w) != (s, s):
-            try:
-                from PIL import Image
+        if (h, w) == (s, s):
+            return arr
+        try:
+            from PIL import Image
 
-                arr = np.asarray(
+            if np.issubdtype(arr.dtype, np.integer):
+                return np.asarray(
                     Image.fromarray(arr.astype(np.uint8)).resize((s, s), Image.BILINEAR)
                 )
-            except Exception:
-                # nearest-neighbor numpy fallback
-                yi = (np.arange(s) * h // s).clip(0, h - 1)
-                xi = (np.arange(s) * w // s).clip(0, w - 1)
-                arr = arr[yi][:, xi]
-        return arr
+            # float images: PIL 'F' mode per channel (uint8 cast would
+            # truncate [0,1] floats to 0)
+            chans = [
+                np.asarray(
+                    Image.fromarray(arr[..., c].astype(np.float32), mode="F").resize(
+                        (s, s), Image.BILINEAR
+                    )
+                )
+                for c in range(arr.shape[-1])
+            ]
+            return np.stack(chans, axis=-1)
+        except ImportError:
+            # nearest-neighbor numpy fallback
+            yi = (np.arange(s) * h // s).clip(0, h - 1)
+            xi = (np.arange(s) * w // s).clip(0, w - 1)
+            return arr[yi][:, xi]
 
     def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
         rec = self.records[idx]

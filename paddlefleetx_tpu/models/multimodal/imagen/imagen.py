@@ -27,7 +27,6 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from paddlefleetx_tpu.models.common import init_params, logical_axes
 from paddlefleetx_tpu.models.multimodal.imagen import unet as unet_lib
 from paddlefleetx_tpu.models.multimodal.imagen.diffusion import (
     GaussianDiffusionContinuousTimes,

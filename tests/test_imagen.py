@@ -182,6 +182,29 @@ def test_imagen_dataset(tmp_path):
     assert 0.0 <= item["images"].min() and item["images"].max() <= 1.0
     assert item["input_ids"].shape == (8,)
 
+    # tokenizer from a saved vocab (the config-yaml path) + resize of a
+    # FLOAT npy image must not truncate to black
+    import base64 as b64
+    import io
+    import json
+
+    vocab_path = str(tmp_path / "vocab.json")
+    tok.save(vocab_path)
+    buf = io.BytesIO()
+    np.save(buf, np.full((24, 24, 3), 0.6, np.float32))
+    float_corpus = str(tmp_path / "float.jsonl")
+    with open(float_corpus, "w") as f:
+        f.write(json.dumps({
+            "image_npy_base64": b64.b64encode(buf.getvalue()).decode(),
+            "caption": "red cat",
+        }) + "\n")
+    ds2 = ImagenDataset(float_corpus, image_size=16, max_seq_len=8,
+                        tokenizer_vocab=vocab_path)
+    item2 = ds2[0]
+    assert item2["images"].shape == (16, 16, 3)
+    np.testing.assert_allclose(item2["images"], 0.6, atol=1e-3)
+    assert item2["input_ids"].shape == (8,)
+
 
 def test_imagen_module_with_frozen_t5(tmp_path):
     """ImagenModule end-to-end with a frozen T5 text encoder in extra."""

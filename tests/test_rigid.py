@@ -1,0 +1,84 @@
+"""Rigid-body geometry tests: quaternion round-trips, rigid algebra, FAPE."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlefleetx_tpu.models.protein import rigid as r3
+
+
+def _rand_quat(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return r3.quat_normalize(jnp.asarray(rng.normal(size=(n, 4)), jnp.float32))
+
+
+def test_quat_to_rot_orthonormal():
+    rot = r3.quat_to_rot(_rand_quat())
+    eye = np.eye(3)
+    np.testing.assert_allclose(
+        np.asarray(jnp.einsum("nij,nkj->nik", rot, rot)), np.tile(eye, (8, 1, 1)), atol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(jnp.linalg.det(rot)), 1.0, atol=1e-5)
+
+
+def test_quat_rot_roundtrip():
+    q = _rand_quat()
+    q = q * jnp.sign(q[:, :1])  # canonical w >= 0
+    q2 = r3.rot_to_quat(r3.quat_to_rot(q))
+    np.testing.assert_allclose(np.abs(np.asarray(q2)), np.abs(np.asarray(q)), atol=1e-4)
+
+
+def test_quat_multiply_matches_rot_compose():
+    qa, qb = _rand_quat(seed=1), _rand_quat(seed=2)
+    rot_ab = r3.rot_mul_rot(r3.quat_to_rot(qa), r3.quat_to_rot(qb))
+    rot_q = r3.quat_to_rot(r3.quat_multiply(qa, qb))
+    np.testing.assert_allclose(np.asarray(rot_ab), np.asarray(rot_q), atol=1e-5)
+
+
+def test_rigid_compose_invert():
+    rng = np.random.default_rng(3)
+    r = (r3.quat_to_rot(_rand_quat(seed=4)), jnp.asarray(rng.normal(size=(8, 3)), jnp.float32))
+    inv = r3.rigid_invert(r)
+    ident = r3.rigid_compose(r, inv)
+    np.testing.assert_allclose(np.asarray(ident[0]), np.tile(np.eye(3), (8, 1, 1)), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ident[1]), 0.0, atol=1e-5)
+
+    pts = jnp.asarray(rng.normal(size=(8, 3)), jnp.float32)
+    back = r3.rigid_invert_apply(r, r3.rigid_apply(r, pts))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(pts), atol=1e-4)
+
+
+def test_rigids_from_3_points_frame():
+    rng = np.random.default_rng(5)
+    n_pt = jnp.asarray(rng.normal(size=(4, 3)), jnp.float32)
+    ca = jnp.asarray(rng.normal(size=(4, 3)), jnp.float32)
+    c = jnp.asarray(rng.normal(size=(4, 3)), jnp.float32)
+    rot, origin = r3.rigids_from_3_points(n_pt, ca, c)
+    # orthonormal, right-handed
+    np.testing.assert_allclose(
+        np.asarray(jnp.einsum("nij,nik->njk", rot, rot)), np.tile(np.eye(3), (4, 1, 1)), atol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(jnp.linalg.det(rot)), 1.0, atol=1e-5)
+    # invariance: the same frame maps C onto the +x axis direction
+    local_c = r3.rigid_invert_apply((rot, origin), c)
+    np.testing.assert_allclose(np.asarray(local_c[:, 1:]), 0.0, atol=1e-4)
+    assert np.all(np.asarray(local_c[:, 0]) > 0)
+
+
+def test_pre_compose_identity_update():
+    q = _rand_quat(seed=6)
+    t = jnp.zeros((8, 3))
+    q2, t2 = r3.pre_compose(q, t, jnp.zeros((8, 6)))
+    np.testing.assert_allclose(np.asarray(q2), np.asarray(q), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(t2), np.asarray(t), atol=1e-5)
+
+
+def test_fape_zero_for_identical():
+    rng = np.random.default_rng(7)
+    frames = (r3.quat_to_rot(_rand_quat(seed=8)), jnp.asarray(rng.normal(size=(8, 3)), jnp.float32))
+    pts = jnp.asarray(rng.normal(size=(12, 3)), jnp.float32)
+    loss = r3.frame_aligned_point_error(frames, frames, pts, pts)
+    assert float(loss) < 1e-3
+    # perturbed points -> positive loss
+    loss2 = r3.frame_aligned_point_error(frames, frames, pts + 1.0, pts)
+    assert float(loss2) > float(loss)
