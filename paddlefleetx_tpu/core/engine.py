@@ -328,6 +328,20 @@ class Engine:
         eval_iter = iter(eval_loader) if eval_loader is not None else None
         tokens_per_sample = self.module.tokens_per_sample or 1
 
+        # config-gated trace window (reference Profiler block,
+        # eager_engine.py:250-272 + profiler.step :419)
+        from paddlefleetx_tpu.utils.profiler import ProfilerHook
+
+        profiler = ProfilerHook(self.cfg.get("Profiler"))
+        try:
+            return self._fit_loop(
+                train_loader, eval_iter, tokens_per_sample, profiler, t_last, window_tokens
+            )
+        finally:
+            # flush an in-flight trace even when a step raises
+            profiler.close()
+
+    def _fit_loop(self, train_loader, eval_iter, tokens_per_sample, profiler, t_last, window_tokens):
         for batch in train_loader:
             if self._step >= self.max_steps:
                 break
@@ -337,6 +351,7 @@ class Engine:
             window_tokens += self.global_batch_size * tokens_per_sample
             self._step += 1
             step = self._step
+            profiler.step(step)
 
             if step % self.logging_freq == 0:
                 metrics = jax.device_get(metrics)

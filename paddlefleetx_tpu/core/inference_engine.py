@@ -1,0 +1,139 @@
+"""InferenceEngine — AOT-compiled multi-chip serving.
+
+TPU-native re-design of the reference InferenceEngine
+(ppfleetx/core/engine/inference_engine.py: TensorRTConfig :41,
+InferenceEngine :104, _generate_comm_init_config :173, predict :252).
+The reference loads an exported static graph into paddle.inference, builds
+an NCCL ring from a CSV it writes itself, and optionally hands subgraphs
+to TensorRT.  Here:
+
+  - the artifact is the StableHLO export (utils/export.py) or a live
+    module; either way the forward is jit-compiled ahead of serving
+  - multi-rank TP serving = the same `model` mesh axis used in training;
+    the NCCL-ring CSV machinery is replaced by the jax.sharding.Mesh (for
+    multi-host serving, jax.distributed.initialize plays launcher)
+  - TensorRTConfig becomes CompileConfig: precision (bf16 weights cast /
+    int8 weight-only via utils.compression), buffer donation, and XLA
+    compile options instead of TRT engine knobs
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlefleetx_tpu.utils.log import logger
+
+
+@dataclasses.dataclass
+class CompileConfig:
+    """TensorRTConfig analogue (inference_engine.py:41-103).
+
+    No param-donation knob: donating weight buffers into a jit that is
+    called repeatedly deletes them after the first call — a server must
+    keep its params alive."""
+
+    precision: str = "bf16"  # fp32 | bf16 | int8 (weight-only quant)
+    max_batch_size: int = 0  # 0 = compile at the given example shape only
+    xla_options: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def from_config(cls, d) -> "CompileConfig":
+        d = dict(d or {})
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+class InferenceEngine:
+    """Serve a forward function over a (possibly multi-chip) mesh.
+
+    Two construction paths (mirroring the reference's exported-model dir):
+
+      InferenceEngine.from_export(model_dir, ...)  — StableHLO + params
+      InferenceEngine(fn, params, ...)             — live function
+    """
+
+    def __init__(
+        self,
+        fn: Callable,
+        params: Any,
+        *,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        param_shardings: Any = None,
+        batch_spec: Any = None,
+        compile_cfg: Optional[CompileConfig] = None,
+    ):
+        self.compile_cfg = compile_cfg or CompileConfig()
+        self.mesh = mesh
+        params, fn = self._apply_precision(params, fn)
+        if mesh is not None and param_shardings is not None:
+            params = jax.tree.map(lambda x, s: jax.device_put(x, s), params, param_shardings)
+        self.params = params
+        jit_kwargs: Dict[str, Any] = {}
+        if mesh is not None and batch_spec is not None:
+            jit_kwargs["in_shardings"] = (param_shardings, batch_spec)
+        self._fn = jax.jit(fn, **jit_kwargs)
+        self._compiled = False
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_export(cls, model_dir: str, **kw) -> "InferenceEngine":
+        from paddlefleetx_tpu.utils.export import load_inference_model
+
+        fn, params = load_inference_model(model_dir)
+        eng = cls(lambda p, *a: fn(p, *a), params, **kw)
+        return eng
+
+    # -- internals -----------------------------------------------------------
+
+    def _apply_precision(self, params: Any, fn: Callable) -> Tuple[Any, Callable]:
+        p = self.compile_cfg.precision
+        if p == "bf16":
+            from paddlefleetx_tpu.models.common import cast_floating
+
+            return cast_floating(params, jnp.bfloat16), fn
+        if p == "int8":
+            # weight-only quantization: HBM holds the int8 tree; weights are
+            # dequantized to bf16 INSIDE the jitted forward (XLA fuses the
+            # scale-multiply into the consumer) so the memory saving is real
+            from paddlefleetx_tpu.utils.compression import (
+                dequantize_params,
+                quantize_params,
+            )
+
+            q, scales = quantize_params(params)
+
+            def int8_fn(qp, *args):
+                return fn(dequantize_params(qp, scales, dtype=jnp.bfloat16), *args)
+
+            return q, int8_fn
+        return params, fn
+
+    # -- serving -------------------------------------------------------------
+
+    def predict(self, *args: Any) -> Any:
+        """Run one batch; returns host numpy pytree
+        (reference predict :252-271)."""
+        t0 = time.time()
+        out = self._fn(self.params, *args)
+        out = jax.device_get(out)
+        if not self._compiled:
+            self._compiled = True
+            logger.info(f"inference: first call (incl. compile) {time.time()-t0:.2f}s")
+        return out
+
+    def benchmark(self, *args: Any, iters: int = 10) -> Dict[str, float]:
+        self.predict(*args)  # warmup/compile
+        t0 = time.time()
+        for _ in range(iters):
+            out = self._fn(self.params, *args)
+        jax.block_until_ready(out)
+        dt = (time.time() - t0) / iters
+        return {"latency_ms": dt * 1e3, "qps": 1.0 / dt}

@@ -89,7 +89,13 @@ class ImagenDataset:
                     Image.fromarray(arr.astype(np.uint8)).resize((s, s), Image.BILINEAR)
                 )
             # float images: PIL 'F' mode per channel (uint8 cast would
-            # truncate [0,1] floats to 0)
+            # truncate [0,1] floats to 0); grayscale handled as one channel
+            if arr.ndim == 2:
+                return np.asarray(
+                    Image.fromarray(arr.astype(np.float32), mode="F").resize(
+                        (s, s), Image.BILINEAR
+                    )
+                )
             chans = [
                 np.asarray(
                     Image.fromarray(arr[..., c].astype(np.float32), mode="F").resize(
@@ -99,8 +105,8 @@ class ImagenDataset:
                 for c in range(arr.shape[-1])
             ]
             return np.stack(chans, axis=-1)
-        except ImportError:
-            # nearest-neighbor numpy fallback
+        except Exception:
+            # nearest-neighbor numpy fallback (PIL missing or exotic shape)
             yi = (np.arange(s) * h // s).clip(0, h - 1)
             xi = (np.arange(s) * w // s).clip(0, w - 1)
             return arr[yi][:, xi]

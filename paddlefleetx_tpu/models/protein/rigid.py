@@ -143,11 +143,12 @@ def rigid_from_quat(quat: jax.Array, trans: jax.Array) -> Rigid:
 
 def rigids_from_3_points(p_neg_x: jax.Array, origin: jax.Array, p_xy: jax.Array) -> Rigid:
     """Gram-Schmidt frame from three points (r3.rigids_from_3_points,
-    AlphaFold Suppl. Alg. 21): e0 from origin->p_xy... reference builds the
-    backbone frame from (N, CA, C)."""
-    e0 = p_xy - origin
+    AlphaFold Suppl. Alg. 21), backbone convention (N, CA, C):
+    p_neg_x (N) lands on the NEGATIVE x axis, p_xy (C) in the xy-plane
+    with positive y."""
+    e0 = origin - p_neg_x
     e0 = e0 / (jnp.linalg.norm(e0, axis=-1, keepdims=True) + 1e-8)
-    v1 = p_neg_x - origin
+    v1 = p_xy - origin
     dot = jnp.sum(e0 * v1, axis=-1, keepdims=True)
     e1 = v1 - dot * e0
     e1 = e1 / (jnp.linalg.norm(e1, axis=-1, keepdims=True) + 1e-8)

@@ -1,0 +1,168 @@
+"""Model compression: magnitude pruning + int8 quantization.
+
+TPU-native re-design of the reference compression helpers
+(ppfleetx/utils/compression_helper.py:19-79: ``prune_model`` via PaddleSlim
+GlobalMagnitude/L1/L2 pruning, ``quant_model`` via QAT).  PaddleSlim's
+graph-rewriting machinery is replaced by pure pytree transforms:
+
+  - prune_params:  per-tensor or global magnitude masks at a target ratio
+    (criteria l1 / l2 / global-magnitude), applied to the matmul weights
+    (ndim >= 2 leaves), returning (pruned_params, masks).  Masks can be
+    re-applied after each optimizer step to keep sparsity during finetune.
+  - quantize_params / dequantize_params: symmetric per-channel int8 PTQ
+    for matmul weights; activations stay in bf16/fp32 (XLA has no int8
+    activation kernels worth using off-TPU-int8 hardware here).
+  - fake_quant: straight-through int8 fake-quantization for QAT-style
+    finetuning (quant error in the forward, identity gradient).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_weight(x: jax.Array) -> bool:
+    return hasattr(x, "ndim") and x.ndim >= 2 and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+# ---------------------------------------------------------------------------
+# Pruning
+# ---------------------------------------------------------------------------
+
+
+def prune_params(
+    params: Any,
+    ratio: float,
+    criterion: str = "l1",
+    global_ranking: bool = False,
+) -> Tuple[Any, Any]:
+    """Zero out the smallest-magnitude fraction ``ratio`` of weight entries.
+
+    criterion 'l1' |weights| or 'l2' weights^2 (same ordering); ranking per
+    tensor by default, or across ALL weight tensors when global_ranking
+    (reference GlobalMagnitudePruner).  Returns (pruned, masks) where masks
+    has a boolean leaf per weight tensor (None-like ones for non-weights).
+    """
+    assert 0.0 <= ratio < 1.0
+    score_fn = jnp.abs if criterion == "l1" else jnp.square
+
+    leaves, treedef = jax.tree.flatten(params)
+    weight_idx = [i for i, x in enumerate(leaves) if _is_weight(x)]
+
+    if global_ranking and weight_idx:
+        all_scores = jnp.concatenate([score_fn(leaves[i]).ravel() for i in weight_idx])
+        k = int(ratio * all_scores.size)
+        thresh = jnp.sort(all_scores)[k] if k > 0 else -jnp.inf
+        masks_w = {i: score_fn(leaves[i]) >= thresh for i in weight_idx}
+    else:
+        masks_w = {}
+        for i in weight_idx:
+            s = score_fn(leaves[i]).ravel()
+            k = int(ratio * s.size)
+            thresh = jnp.sort(s)[k] if k > 0 else -jnp.inf
+            masks_w[i] = score_fn(leaves[i]) >= thresh
+
+    new_leaves = list(leaves)
+    mask_leaves = [jnp.ones_like(x, bool) if hasattr(x, "shape") else x for x in leaves]
+    for i in weight_idx:
+        new_leaves[i] = jnp.where(masks_w[i], leaves[i], 0.0)
+        mask_leaves[i] = masks_w[i]
+    return jax.tree.unflatten(treedef, new_leaves), jax.tree.unflatten(treedef, mask_leaves)
+
+
+def apply_masks(params: Any, masks: Any) -> Any:
+    """Re-apply pruning masks (after an optimizer step, sparse finetune)."""
+    return jax.tree.map(
+        lambda p, m: jnp.where(m, p, 0.0) if _is_weight(p) else p, params, masks
+    )
+
+
+def sparsity(params: Any) -> float:
+    """Fraction of exactly-zero entries across weight tensors."""
+    total, zeros = 0, 0
+    for x in jax.tree.leaves(params):
+        if _is_weight(x):
+            total += x.size
+            zeros += int(jnp.sum(x == 0.0))
+    return zeros / max(total, 1)
+
+
+# ---------------------------------------------------------------------------
+# Quantization
+# ---------------------------------------------------------------------------
+
+
+def _chan_scale(w: jax.Array) -> jax.Array:
+    """Symmetric absmax scale per output channel (last dim)."""
+    reduce_axes = tuple(range(w.ndim - 1))
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    return jnp.maximum(absmax, 1e-8) / 127.0
+
+
+def quantize_params(params: Any) -> Tuple[Any, Any]:
+    """Weights -> int8 + fp32 per-channel scales; non-weights untouched.
+
+    Returns (q_params, scales): q leaf is int8 where quantized; scale leaf
+    is the multiplier to recover floats (None marker = not quantized)."""
+
+    def q(x):
+        if not _is_weight(x):
+            return x, None
+        s = _chan_scale(x)
+        return jnp.clip(jnp.round(x / s), -127, 127).astype(jnp.int8), s
+
+    leaves, treedef = jax.tree.flatten(params)
+    pairs = [q(x) for x in leaves]
+    return (
+        jax.tree.unflatten(treedef, [p[0] for p in pairs]),
+        jax.tree.unflatten(treedef, [p[1] if p[1] is not None else () for p in pairs]),
+    )
+
+
+def dequantize_params(q_params: Any, scales: Any, dtype=jnp.float32) -> Any:
+    def dq(x, s):
+        if isinstance(s, tuple):  # () marker: not quantized
+            return x
+        return (x.astype(dtype)) * s.astype(dtype)
+
+    return jax.tree.map(dq, q_params, scales, is_leaf=lambda x: isinstance(x, tuple) and x == ())
+
+
+def quant_error(params: Any) -> float:
+    """Max relative reconstruction error over weight tensors (sanity)."""
+    qp, sc = quantize_params(params)
+    deq = dequantize_params(qp, sc)
+    err = 0.0
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(deq)):
+        if _is_weight(a):
+            denom = float(jnp.max(jnp.abs(a))) + 1e-12
+            err = max(err, float(jnp.max(jnp.abs(a - b))) / denom)
+    return err
+
+
+@jax.custom_vjp
+def fake_quant(w: jax.Array) -> jax.Array:
+    """QAT fake-quantization: int8 rounding in the forward, straight-through
+    gradient (reference quant_model QAT semantics)."""
+    s = _chan_scale(w)
+    return jnp.clip(jnp.round(w / s), -127, 127) * s
+
+
+def _fq_fwd(w):
+    return fake_quant(w), None
+
+
+def _fq_bwd(_, g):
+    return (g,)
+
+
+fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quantize_tree_for_export(params: Any) -> Dict[str, Any]:
+    """Package for the export path: {'q': int8 tree, 'scales': tree}."""
+    q, s = quantize_params(params)
+    return {"q": q, "scales": s}

@@ -1,0 +1,55 @@
+"""Profiler integration (config-gated, reference eager_engine.py:250-272,
+419-420, 866-925: paddle.profiler scheduler window + chrome-trace export).
+
+TPU-native: ``jax.profiler`` writes an XPlane/TensorBoard trace for the
+configured step window.  Config block::
+
+    Profiler:
+      enable: True
+      scheduler: [3, 8]     # [start_step, stop_step)
+      log_dir: ./profiler_log
+
+View with TensorBoard's profile plugin (or xprof).  Per-step op/memory
+summary views come from the trace viewer instead of the reference's
+printed tables.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+from paddlefleetx_tpu.utils.log import logger
+
+
+class ProfilerHook:
+    """Start/stop jax.profiler.trace around a step window."""
+
+    def __init__(self, cfg: Optional[Dict[str, Any]]):
+        cfg = cfg or {}
+        self.enabled = bool(cfg.get("enable", False))
+        sched = cfg.get("scheduler") or [3, 8]
+        self.start_step, self.stop_step = int(sched[0]), int(sched[1])
+        self.log_dir = os.path.abspath(cfg.get("log_dir", "./profiler_log"))
+        self._active = False
+
+    def step(self, step: int) -> None:
+        """Call once per training step with the 1-based step counter."""
+        if not self.enabled:
+            return
+        if not self._active and self.start_step <= step < self.stop_step:
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            logger.info(f"profiler: trace started (steps {self.start_step}-{self.stop_step}) -> {self.log_dir}")
+        elif self._active and step >= self.stop_step:
+            jax.profiler.stop_trace()
+            self._active = False
+            logger.info(f"profiler: trace written to {self.log_dir} (view with TensorBoard)")
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

@@ -1,0 +1,119 @@
+"""Compression (prune/quant) + profiler hook + MoE grad-clip parity tests."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from paddlefleetx_tpu.utils import compression as comp
+
+
+def _params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w1": jnp.asarray(rng.normal(size=(16, 32)), jnp.float32),
+        "b1": jnp.asarray(rng.normal(size=(32,)), jnp.float32),
+        "block": {"w2": jnp.asarray(rng.normal(size=(32, 8)), jnp.float32)},
+    }
+
+
+def test_prune_per_tensor_ratio():
+    p = _params()
+    pruned, masks = comp.prune_params(p, ratio=0.5, criterion="l1")
+    sp = comp.sparsity(pruned)
+    assert 0.45 < sp < 0.55
+    # biases untouched
+    np.testing.assert_array_equal(np.asarray(pruned["b1"]), np.asarray(p["b1"]))
+    # masks reapply idempotently
+    again = comp.apply_masks(pruned, masks)
+    np.testing.assert_array_equal(np.asarray(again["w1"]), np.asarray(pruned["w1"]))
+    # surviving entries are the largest-magnitude ones
+    w = np.asarray(p["w1"]).ravel()
+    kept = np.asarray(masks["w1"]).ravel()
+    assert np.abs(w[kept]).min() >= np.abs(w[~kept]).max() - 1e-6
+
+
+def test_prune_global_ranking():
+    p = {
+        "small": jnp.ones((4, 4)) * 0.01,
+        "big": jnp.ones((4, 4)) * 10.0,
+    }
+    pruned, _ = comp.prune_params(p, ratio=0.5, global_ranking=True)
+    # global ranking kills the small tensor entirely, keeps the big one
+    assert float(jnp.sum(pruned["small"] == 0)) == 16
+    assert float(jnp.sum(pruned["big"] == 0)) == 0
+
+
+def test_quant_roundtrip_error():
+    p = _params()
+    assert comp.quant_error(p) < 0.02  # int8 per-channel: <2% of absmax
+    q, s = comp.quantize_params(p)
+    assert q["w1"].dtype == jnp.int8
+    assert q["b1"].dtype == jnp.float32  # non-weight untouched
+    deq = comp.dequantize_params(q, s)
+    assert deq["w1"].dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(deq["w1"]), np.asarray(p["w1"]), atol=float(jnp.max(jnp.abs(p["w1"]))) / 100
+    )
+
+
+def test_fake_quant_straight_through():
+    w = jnp.asarray(np.random.default_rng(1).normal(size=(8, 8)), jnp.float32)
+    out = comp.fake_quant(w)
+    assert float(jnp.max(jnp.abs(out - w))) < float(jnp.max(jnp.abs(w))) / 100
+    # straight-through: gradient of sum(fake_quant(w)) is all ones
+    g = jax.grad(lambda x: comp.fake_quant(x).sum())(w)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_profiler_hook_writes_trace(tmp_path):
+    from paddlefleetx_tpu.utils.profiler import ProfilerHook
+
+    log_dir = str(tmp_path / "prof")
+    hook = ProfilerHook({"enable": True, "scheduler": [1, 3], "log_dir": log_dir})
+    for step in range(1, 5):
+        jnp.ones((8, 8)) @ jnp.ones((8, 8))  # some device work
+        hook.step(step)
+    hook.close()
+    files = [os.path.join(r, f) for r, _, fs in os.walk(log_dir) for f in fs]
+    assert any("xplane" in f or "trace" in f for f in files), files
+
+
+def test_moe_grad_clip_parity(devices8):
+    """GSPMD makes the reference ClipGradForMOEByGlobalNorm
+    (optims/grad_clip.py:27-156) a plain global-norm clip: expert params
+    are ONE sharded pytree, so optax.global_norm over sharded grads equals
+    the single-device norm — the expert-group allreduce is implicit."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    rng = np.random.default_rng(2)
+    grads = {
+        "dense": jnp.asarray(rng.normal(size=(16, 16)), jnp.float32),
+        "experts": jnp.asarray(rng.normal(size=(8, 16, 16)), jnp.float32),
+    }
+    ref_norm = float(optax.global_norm(grads))
+    clip = optax.clip_by_global_norm(1.0)
+    ref_clipped, _ = clip.update(grads, clip.init(grads))
+
+    mesh = build_mesh(MeshConfig(dp_degree=8))
+    sharded = {
+        "dense": jax.device_put(grads["dense"], NamedSharding(mesh, P())),
+        "experts": jax.device_put(grads["experts"], NamedSharding(mesh, P("data"))),
+    }
+
+    @jax.jit
+    def clipped_norm(g):
+        state = clip.init(g)
+        out, _ = clip.update(g, state)
+        return optax.global_norm(g), out
+
+    norm, out = clipped_norm(sharded)
+    assert abs(float(norm) - ref_norm) < 1e-4
+    np.testing.assert_allclose(
+        np.asarray(out["experts"]), np.asarray(ref_clipped["experts"]), rtol=1e-5
+    )

@@ -59,10 +59,14 @@ def test_rigids_from_3_points_frame():
         np.asarray(jnp.einsum("nij,nik->njk", rot, rot)), np.tile(np.eye(3), (4, 1, 1)), atol=1e-5
     )
     np.testing.assert_allclose(np.asarray(jnp.linalg.det(rot)), 1.0, atol=1e-5)
-    # invariance: the same frame maps C onto the +x axis direction
+    # AlphaFold r3 convention: N on the negative x axis, C in the
+    # xy-plane with positive y
+    local_n = r3.rigid_invert_apply((rot, origin), n_pt)
+    np.testing.assert_allclose(np.asarray(local_n[:, 1:]), 0.0, atol=1e-4)
+    assert np.all(np.asarray(local_n[:, 0]) < 0)
     local_c = r3.rigid_invert_apply((rot, origin), c)
-    np.testing.assert_allclose(np.asarray(local_c[:, 1:]), 0.0, atol=1e-4)
-    assert np.all(np.asarray(local_c[:, 0]) > 0)
+    np.testing.assert_allclose(np.asarray(local_c[:, 2]), 0.0, atol=1e-4)
+    assert np.all(np.asarray(local_c[:, 1]) > 0)
 
 
 def test_pre_compose_identity_update():
