@@ -40,7 +40,6 @@ class CompileConfig:
     keep its params alive."""
 
     precision: str = "bf16"  # fp32 | bf16 | int8 (weight-only quant)
-    max_batch_size: int = 0  # 0 = compile at the given example shape only
     xla_options: Optional[Dict[str, Any]] = None
 
     @classmethod
@@ -88,8 +87,18 @@ class InferenceEngine:
         from paddlefleetx_tpu.utils.export import load_inference_model
 
         fn, params = load_inference_model(model_dir)
-        eng = cls(lambda p, *a: fn(p, *a), params, **kw)
-        return eng
+        # a serialized StableHLO artifact enforces the param avals it was
+        # traced with — precision transforms must happen at EXPORT time,
+        # not here (casting restored params would dtype-mismatch the call)
+        cc = kw.get("compile_cfg")
+        if cc is not None and cc.precision != "fp32":
+            logger.info(
+                f"from_export: ignoring precision={cc.precision!r} — the "
+                "artifact fixes param dtypes; re-export with cast params "
+                "for reduced precision"
+            )
+        kw["compile_cfg"] = dataclasses.replace(cc or CompileConfig(), precision="fp32")
+        return cls(lambda p, *a: fn(p, *a), params, **kw)
 
     # -- internals -----------------------------------------------------------
 

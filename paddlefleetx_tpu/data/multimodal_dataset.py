@@ -105,8 +105,12 @@ class ImagenDataset:
                 for c in range(arr.shape[-1])
             ]
             return np.stack(chans, axis=-1)
-        except Exception:
-            # nearest-neighbor numpy fallback (PIL missing or exotic shape)
+        except Exception as e:
+            # nearest-neighbor numpy fallback (PIL missing or exotic shape);
+            # log it — silent quality degradation is worse than noise
+            import warnings
+
+            warnings.warn(f"PIL resize failed ({e!r}); using nearest-neighbor", stacklevel=2)
             yi = (np.arange(s) * h // s).clip(0, h - 1)
             xi = (np.arange(s) * w // s).clip(0, w - 1)
             return arr[yi][:, xi]

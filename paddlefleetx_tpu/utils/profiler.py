@@ -31,6 +31,11 @@ class ProfilerHook:
         cfg = cfg or {}
         self.enabled = bool(cfg.get("enable", False))
         sched = cfg.get("scheduler") or [3, 8]
+        if len(sched) != 2 or int(sched[0]) >= int(sched[1]):
+            raise ValueError(
+                f"Profiler.scheduler must be [start_step, stop_step] with "
+                f"start < stop, got {sched}"
+            )
         self.start_step, self.stop_step = int(sched[0]), int(sched[1])
         self.log_dir = os.path.abspath(cfg.get("log_dir", "./profiler_log"))
         self._active = False

@@ -51,6 +51,12 @@ def test_export_reload_predict(tmp_path):
     out = eng.predict(np.zeros((2, 16), np.int32))
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-5, atol=1e-5)
 
+    # the DEFAULT precision (bf16) must not break exported serving: the
+    # artifact pins fp32 avals, so from_export overrides precision
+    eng2 = InferenceEngine.from_export(out_dir)
+    out2 = eng2.predict(np.zeros((2, 16), np.int32))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out2), rtol=1e-5, atol=1e-5)
+
 
 def test_precision_paths():
     params = gpt.init(TINY, jax.random.key(2))

@@ -5,6 +5,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# PFX_PLATFORM=cpu forces the CPU backend in-process (the axon
+# sitecustomize overrides the JAX_PLATFORMS env var; jax.config wins)
+if os.environ.get("PFX_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["PFX_PLATFORM"])
+
 from paddlefleetx_tpu.core.engine import Engine
 from paddlefleetx_tpu.core.module import build_module
 from paddlefleetx_tpu.data.builders import build_dataloader

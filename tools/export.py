@@ -6,6 +6,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# PFX_PLATFORM=cpu forces the CPU backend in-process (the axon
+# sitecustomize overrides the JAX_PLATFORMS env var; jax.config wins)
+if os.environ.get("PFX_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["PFX_PLATFORM"])
+
 import jax.numpy as jnp
 
 from paddlefleetx_tpu.core.module import build_module

@@ -7,6 +7,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# PFX_PLATFORM=cpu forces the CPU backend in-process (the axon
+# sitecustomize overrides the JAX_PLATFORMS env var; jax.config wins)
+if os.environ.get("PFX_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["PFX_PLATFORM"])
+
 import numpy as np
 
 from paddlefleetx_tpu.core.inference_engine import CompileConfig, InferenceEngine
@@ -41,13 +48,13 @@ def main(argv=None):
             tree_logical_to_sharding,
         )
 
-        module = build_module(cfg)
         if cfg.Model.get("module", "GPTModule") not in ("GPTModule", "GPTGenerationModule"):
             raise ValueError(
                 "live-module inference currently serves the GPT forward; "
                 f"got module={cfg.Model.get('module')} — export it first and "
                 "set Inference.model_dir"
             )
+        module = build_module(cfg)
         params = module.init_params(get_seed_tracker().params_key())
         ckpt_dir = cfg.Engine.save_load.get("ckpt_dir")
         if ckpt_dir:

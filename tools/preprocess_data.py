@@ -1,0 +1,102 @@
+"""Tokenize + pack a jsonl corpus into the mmap training format.
+
+Re-design of the reference preprocessing pipeline
+(ppfleetx/data/data_tools/gpt/preprocess_data.py: jsonl {"text"} ->
+tokenize (multiprocess) -> append eos per doc -> <prefix>_ids.npy (token
+stream) + <prefix>_idx.npz (per-doc lengths), consumed by GPTDataset
+(gpt_dataset.py:95-116 in the reference; data/gpt_dataset.py here).
+
+Tokenizers: gpt (byte-level BPE; needs --vocab_file/--merges_file) or
+t5 (unigram; needs --vocab_file json).
+
+Usage:
+  python tools/preprocess_data.py --input corpus.jsonl --output_prefix data/corpus \
+      --tokenizer gpt --vocab_file vocab.json --merges_file merges.txt [--workers 8]
+"""
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+_TOK = None
+
+
+def _init_worker(kind, vocab_file, merges_file):
+    global _TOK
+    if kind == "gpt":
+        from paddlefleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+        _TOK = GPTTokenizer(vocab_file, merges_file)
+        _TOK._eos = _TOK.eos_token_id
+    else:
+        from paddlefleetx_tpu.data.tokenizers.t5_tokenizer import T5Tokenizer
+
+        _TOK = T5Tokenizer.from_file(vocab_file)
+        _TOK._eos = _TOK.eos_id
+
+
+def _encode(line):
+    line = line.strip()
+    if not line:
+        return None
+    text = json.loads(line).get("text", "")
+    if not text:
+        return None
+    ids = _TOK.encode(text)
+    if not ids or ids[-1] != _TOK._eos:
+        ids = list(ids) + [_TOK._eos]
+    return ids
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", required=True, help="jsonl with {'text': ...}")
+    ap.add_argument("--output_prefix", required=True)
+    ap.add_argument("--tokenizer", choices=["gpt", "t5"], default="gpt")
+    ap.add_argument("--vocab_file", required=True)
+    ap.add_argument("--merges_file", default=None)
+    ap.add_argument("--workers", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    init_args = (args.tokenizer, args.vocab_file, args.merges_file)
+    with open(args.input) as f:
+        lines = f.readlines()
+
+    if args.workers > 1:
+        with mp.Pool(args.workers, initializer=_init_worker, initargs=init_args) as pool:
+            docs = pool.map(_encode, lines, chunksize=64)
+    else:
+        _init_worker(*init_args)
+        docs = [_encode(l) for l in lines]
+    docs = [d for d in docs if d]
+    if not docs:
+        print("no documents with text found — nothing written", file=sys.stderr)
+        sys.exit(1)
+
+    lens = np.asarray([len(d) for d in docs], np.int32)
+    total = int(lens.sum())
+    vocab_guess = max(max(d) for d in docs) + 1
+    dtype = np.uint16 if vocab_guess < 2**16 else np.uint32
+    stream = np.empty(total, dtype)
+    off = 0
+    for d in docs:
+        stream[off : off + len(d)] = d
+        off += len(d)
+
+    os.makedirs(os.path.dirname(os.path.abspath(args.output_prefix)) or ".", exist_ok=True)
+    np.save(args.output_prefix + "_ids.npy", stream)
+    np.savez(args.output_prefix + "_idx.npz", lens=lens)
+    print(
+        f"packed {len(docs)} docs, {total} tokens ({dtype.__name__}) -> "
+        f"{args.output_prefix}_ids.npy / _idx.npz"
+    )
+
+
+if __name__ == "__main__":
+    main()

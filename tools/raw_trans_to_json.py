@@ -1,0 +1,62 @@
+"""Convert raw text files to the jsonl corpus format.
+
+Re-design of the reference preprocessing step
+(ppfleetx/data/data_tools/gpt/raw_trans_to_json.py): every input text file
+becomes json lines {"text": ...}, one document per blank-line-separated
+block (or per line with --per-line).
+
+Usage:
+  python tools/raw_trans_to_json.py --input_path dir_or_file --output_path out.jsonl
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def iter_docs(path: str, per_line: bool):
+    with open(path, errors="ignore") as f:
+        if per_line:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line
+            return
+        block = []
+        for line in f:
+            if line.strip():
+                block.append(line.strip())
+            elif block:
+                yield " ".join(block)
+                block = []
+        if block:
+            yield " ".join(block)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input_path", required=True, help="file, dir, or glob")
+    ap.add_argument("--output_path", required=True)
+    ap.add_argument("--per-line", action="store_true", help="one doc per line")
+    args = ap.parse_args(argv)
+
+    if os.path.isdir(args.input_path):
+        files = sorted(glob.glob(os.path.join(args.input_path, "**/*"), recursive=True))
+        files = [f for f in files if os.path.isfile(f)]
+    else:
+        files = sorted(glob.glob(args.input_path)) or [args.input_path]
+
+    n = 0
+    os.makedirs(os.path.dirname(os.path.abspath(args.output_path)), exist_ok=True)
+    with open(args.output_path, "w") as out:
+        for path in files:
+            for doc in iter_docs(path, args.per_line):
+                out.write(json.dumps({"text": doc}, ensure_ascii=False) + "\n")
+                n += 1
+    print(f"wrote {n} documents from {len(files)} files -> {args.output_path}")
+
+
+if __name__ == "__main__":
+    main()
