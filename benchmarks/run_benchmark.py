@@ -40,8 +40,14 @@ def _ensure_synthetic_data(case: dict, name: str) -> list:
     if not spec:
         return []
     data_dir = os.path.join("/tmp", "pfx_bench_data", name)
-    marker = os.path.join(data_dir, "corpus_ids.npy")
-    if not os.path.exists(marker):
+    # cache keyed on the spec, not mere existence: an edited case regenerates
+    spec_path = os.path.join(data_dir, "spec.json")
+    spec_str = json.dumps(spec, sort_keys=True)
+    stale = True
+    if os.path.exists(spec_path):
+        with open(spec_path) as f:
+            stale = f.read() != spec_str
+    if stale or not os.path.exists(os.path.join(data_dir, "corpus_ids.npy")):
         os.makedirs(data_dir, exist_ok=True)
         sys.path.insert(0, ROOT)
         from paddlefleetx_tpu.data.gpt_dataset import write_synthetic_corpus
@@ -51,6 +57,8 @@ def _ensure_synthetic_data(case: dict, name: str) -> list:
             vocab_size=int(spec.get("vocab_size", 50304)),
             num_docs=int(spec.get("num_docs", 64)),
         )
+        with open(spec_path, "w") as f:
+            f.write(spec_str)
     return [
         f"Data.Train.dataset.input_dir={data_dir}",
         f"Data.Eval.dataset.input_dir={data_dir}",

@@ -1,0 +1,213 @@
+"""ERNIE / BERT-style WordPiece tokenizer (pure Python).
+
+Re-implementation of the tokenizer the reference wraps
+(ppfleetx/data/tokenizers/ernie_tokenizer.py, a thin shim over the
+paddlenlp ErnieTokenizer — BERT basic-tokenize + greedy-longest-match
+WordPiece with '##' continuation, [CLS]/[SEP]/[MASK]/[PAD]/[UNK]
+specials).
+
+Vocab format: one token per line (id = line number), the BERT convention.
+`from_tiny_corpus` builds a toy vocab for tests.
+"""
+
+from __future__ import annotations
+
+import os
+import unicodedata
+from typing import Dict, Iterable, List, Optional, Sequence
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_chinese_char(cp: int) -> bool:
+    return (
+        0x4E00 <= cp <= 0x9FFF
+        or 0x3400 <= cp <= 0x4DBF
+        or 0x20000 <= cp <= 0x2A6DF
+        or 0xF900 <= cp <= 0xFAFF
+    )
+
+
+class ErnieTokenizer:
+    def __init__(
+        self,
+        vocab: Dict[str, int],
+        *,
+        do_lower_case: bool = True,
+        unk_token: str = "[UNK]",
+        pad_token: str = "[PAD]",
+        cls_token: str = "[CLS]",
+        sep_token: str = "[SEP]",
+        mask_token: str = "[MASK]",
+        max_input_chars_per_word: int = 100,
+    ):
+        self.vocab = dict(vocab)
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+        self.do_lower_case = do_lower_case
+        self.unk_token, self.pad_token = unk_token, pad_token
+        self.cls_token, self.sep_token, self.mask_token = cls_token, sep_token, mask_token
+        self.max_input_chars_per_word = max_input_chars_per_word
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_file(cls, vocab_file: str, **kw) -> "ErnieTokenizer":
+        vocab: Dict[str, int] = {}
+        with open(vocab_file, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                tok = line.rstrip("\n")
+                if tok:
+                    vocab[tok] = i
+        return cls(vocab, **kw)
+
+    def save(self, vocab_file: str) -> None:
+        os.makedirs(os.path.dirname(vocab_file) or ".", exist_ok=True)
+        with open(vocab_file, "w", encoding="utf-8") as f:
+            for tok, _ in sorted(self.vocab.items(), key=lambda kv: kv[1]):
+                f.write(tok + "\n")
+
+    @classmethod
+    def from_tiny_corpus(cls, texts: Iterable[str], **kw) -> "ErnieTokenizer":
+        specials = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+        words, chars = set(), set()
+        for t in texts:
+            for w in t.lower().split():
+                words.add(w)
+                chars.update(w)
+        vocab = {t: i for i, t in enumerate(specials)}
+        for c in sorted(chars):
+            vocab.setdefault(c, len(vocab))
+            vocab.setdefault("##" + c, len(vocab))
+        for w in sorted(words):
+            vocab.setdefault(w, len(vocab))
+        return cls(vocab, **kw)
+
+    # -- basic tokenization --------------------------------------------------
+
+    def _basic_tokenize(self, text: str) -> List[str]:
+        if self.do_lower_case:
+            # BERT BasicTokenizer: lowercase + strip accents (NFD then drop
+            # combining marks) so 'café' -> 'cafe' like uncased vocabs expect
+            text = unicodedata.normalize("NFD", text.lower())
+            text = "".join(c for c in text if unicodedata.category(c) != "Mn")
+        else:
+            text = unicodedata.normalize("NFC", text)
+        out: List[str] = []
+        word: List[str] = []
+
+        def flush():
+            if word:
+                out.append("".join(word))
+                word.clear()
+
+        for ch in text:
+            if ch.isspace():
+                flush()
+            elif _is_punctuation(ch) or _is_chinese_char(ord(ch)):
+                flush()
+                out.append(ch)
+            else:
+                word.append(ch)
+        flush()
+        return out
+
+    # -- wordpiece -----------------------------------------------------------
+
+    def _wordpiece(self, word: str) -> List[str]:
+        if len(word) > self.max_input_chars_per_word:
+            return [self.unk_token]
+        pieces: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    cur = sub
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            pieces.append(cur)
+            start = end
+        return pieces
+
+    def tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        for word in self._basic_tokenize(text):
+            out.extend(self._wordpiece(word))
+        return out
+
+    # -- encode / decode -----------------------------------------------------
+
+    def convert_tokens_to_ids(self, tokens: Sequence[str]) -> List[int]:
+        unk = self.vocab[self.unk_token]
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def encode(
+        self,
+        text: str,
+        text_pair: Optional[str] = None,
+        max_seq_len: Optional[int] = None,
+    ) -> Dict[str, List[int]]:
+        """[CLS] a [SEP] (b [SEP]) with token_type_ids, BERT layout."""
+        a = self.convert_tokens_to_ids(self.tokenize(text))
+        b = self.convert_tokens_to_ids(self.tokenize(text_pair)) if text_pair else []
+        if max_seq_len:
+            budget = max_seq_len - 2 - (1 if b else 0)
+            if budget < 1:
+                raise ValueError(
+                    f"max_seq_len={max_seq_len} leaves no room for content "
+                    f"after special tokens"
+                )
+            # longest-first truncation across the pair
+            while len(a) + len(b) > budget:
+                (a if len(a) >= len(b) else b).pop()
+        cls_id, sep_id = self.vocab[self.cls_token], self.vocab[self.sep_token]
+        ids = [cls_id] + a + [sep_id]
+        type_ids = [0] * len(ids)
+        if b:
+            ids += b + [sep_id]
+            type_ids += [1] * (len(b) + 1)
+        return {"input_ids": ids, "token_type_ids": type_ids}
+
+    def decode(self, ids: Iterable[int], skip_special_tokens: bool = True) -> str:
+        specials = {self.pad_token, self.cls_token, self.sep_token, self.mask_token}
+        parts: List[str] = []
+        for i in ids:
+            tok = self.inv_vocab.get(int(i), self.unk_token)
+            if skip_special_tokens and tok in specials:
+                continue
+            if tok.startswith("##") and parts:
+                parts[-1] += tok[2:]
+            else:
+                parts.append(tok)
+        return " ".join(parts)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    @property
+    def pad_token_id(self) -> int:
+        return self.vocab[self.pad_token]
+
+    @property
+    def mask_token_id(self) -> int:
+        return self.vocab[self.mask_token]
+
+    @property
+    def cls_token_id(self) -> int:
+        return self.vocab[self.cls_token]
+
+    @property
+    def sep_token_id(self) -> int:
+        return self.vocab[self.sep_token]

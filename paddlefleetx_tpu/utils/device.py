@@ -1,0 +1,57 @@
+"""Device detection / synchronization (reference utils/device.py:19-62,
+which maps gpu/xpu/rocm/npu/mlu/intel_gpu/cpu and exposes synchronize()).
+
+On the JAX side the backend zoo collapses: tpu / gpu / cpu, picked by
+``jax.default_backend()``; synchronize = block on an empty computation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+import jax
+
+
+def apply_platform_env() -> None:
+    """Honor PFX_PLATFORM before backend init (the axon sitecustomize
+    overrides a bare JAX_PLATFORMS env var; jax.config wins).  Call this
+    at the top of every CLI entry point."""
+    plat = os.environ.get("PFX_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+
+def get_device_type() -> str:
+    """'tpu' | 'gpu' | 'cpu' (plus experimental plugin names)."""
+    return jax.default_backend()
+
+
+def get_devices() -> List[jax.Device]:
+    return list(jax.devices())
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def synchronize() -> None:
+    """Block until all in-flight device work completes (reference
+    paddle.device.synchronize equivalent)."""
+    for d in jax.local_devices():
+        jax.device_put(0.0, d).block_until_ready()
+
+
+def memory_stats() -> dict:
+    """Per-device memory stats where the backend reports them."""
+    out = {}
+    for d in jax.local_devices():
+        try:
+            out[str(d)] = d.memory_stats()
+        except Exception:
+            out[str(d)] = {}
+    return out

@@ -1,0 +1,9 @@
+"""Version info (reference utils/version.py)."""
+
+__version__ = "0.1.0"
+
+
+def show() -> str:
+    import jax
+
+    return f"paddlefleetx-tpu {__version__} (jax {jax.__version__})"

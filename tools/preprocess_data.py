@@ -65,35 +65,41 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     init_args = (args.tokenizer, args.vocab_file, args.merges_file)
-    with open(args.input) as f:
-        lines = f.readlines()
 
-    if args.workers > 1:
-        with mp.Pool(args.workers, initializer=_init_worker, initargs=init_args) as pool:
-            docs = pool.map(_encode, lines, chunksize=64)
-    else:
-        _init_worker(*init_args)
-        docs = [_encode(l) for l in lines]
-    docs = [d for d in docs if d]
-    if not docs:
+    # stream line -> tokens -> compact uint32 chunks (never hold the whole
+    # corpus as Python lists: ~4 bytes/token peak instead of ~36)
+    def doc_arrays():
+        with open(args.input) as f:
+            if args.workers > 1:
+                with mp.Pool(args.workers, initializer=_init_worker, initargs=init_args) as pool:
+                    for d in pool.imap(_encode, f, chunksize=64):
+                        if d:
+                            yield np.asarray(d, np.uint32)
+            else:
+                _init_worker(*init_args)
+                for line in f:
+                    d = _encode(line)
+                    if d:
+                        yield np.asarray(d, np.uint32)
+
+    chunks, lens, max_id = [], [], 0
+    for arr in doc_arrays():
+        chunks.append(arr)
+        lens.append(len(arr))
+        max_id = max(max_id, int(arr.max()))
+    if not chunks:
         print("no documents with text found — nothing written", file=sys.stderr)
         sys.exit(1)
 
-    lens = np.asarray([len(d) for d in docs], np.int32)
-    total = int(lens.sum())
-    vocab_guess = max(max(d) for d in docs) + 1
-    dtype = np.uint16 if vocab_guess < 2**16 else np.uint32
-    stream = np.empty(total, dtype)
-    off = 0
-    for d in docs:
-        stream[off : off + len(d)] = d
-        off += len(d)
+    dtype = np.uint16 if max_id < 2**16 else np.uint32
+    stream = np.concatenate(chunks).astype(dtype)
+    lens = np.asarray(lens, np.int32)
 
     os.makedirs(os.path.dirname(os.path.abspath(args.output_prefix)) or ".", exist_ok=True)
     np.save(args.output_prefix + "_ids.npy", stream)
     np.savez(args.output_prefix + "_idx.npz", lens=lens)
     print(
-        f"packed {len(docs)} docs, {total} tokens ({dtype.__name__}) -> "
+        f"packed {len(lens)} docs, {stream.size} tokens ({dtype.__name__}) -> "
         f"{args.output_prefix}_ids.npy / _idx.npz"
     )
 

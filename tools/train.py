@@ -6,12 +6,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# PFX_PLATFORM=cpu forces the CPU backend in-process (the axon
-# sitecustomize overrides the JAX_PLATFORMS env var; jax.config wins)
-if os.environ.get("PFX_PLATFORM"):
-    import jax
+from paddlefleetx_tpu.utils.device import apply_platform_env
 
-    jax.config.update("jax_platforms", os.environ["PFX_PLATFORM"])
+apply_platform_env()  # PFX_PLATFORM=cpu etc., before backend init
 
 from paddlefleetx_tpu.core.engine import Engine
 from paddlefleetx_tpu.core.module import build_module
