@@ -24,9 +24,13 @@ class GPTConfig:
     attention_probs_dropout_prob: float = 0.1
     initializer_range: float = 0.02
     # recompute (reference recompute_granularity full/full_attn/core_attn,
-    # single_model.py:320-405)
+    # single_model.py:320-405; "selective" is TPU-native: saves the expensive
+    # matmul outputs by name and recomputes only cheap elementwise ops)
     use_recompute: bool = False
     recompute_granularity: str = "full"
+    # fused LayerNorm Pallas kernel (ops/fused_layernorm.py) instead of the
+    # jnp composite (reference consumes paddle fused norm ops, vit.py:23-115)
+    use_fused_ln: bool = False
     # fused qkv projection (reference fuse_attn_qkv, hybrid_model.py:153)
     fuse_attn_qkv: bool = True
     # attention implementation: "xla" (jnp reference) | "flash" (Pallas kernel)
@@ -48,7 +52,7 @@ class GPTConfig:
             object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
         if self.hidden_size % self.num_attention_heads:
             raise ValueError("hidden_size must divide num_attention_heads")
-        if self.recompute_granularity not in ("full", "full_attn", "core_attn"):
+        if self.recompute_granularity not in ("full", "selective", "full_attn", "core_attn"):
             raise ValueError(f"bad recompute_granularity {self.recompute_granularity}")
 
     @property

@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from paddlefleetx_tpu.models.common import (
     ParamSpec,
@@ -131,13 +132,37 @@ def gpt_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 
-def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-5):
+def layer_norm(
+    x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-5, fused: bool = False
+):
+    if fused:
+        from paddlefleetx_tpu.ops.fused_layernorm import fused_layer_norm
+
+        return fused_layer_norm(x, scale, bias, eps=eps)
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
     y = (xf - mean) * jax.lax.rsqrt(var + eps)
     return (y * scale + bias).astype(dtype)
+
+
+def _layer_remat(cfg: GPTConfig, fn):
+    """Wrap a per-layer scan body in jax.checkpoint per recompute granularity.
+
+    "full" saves only layer-boundary activations (reference recompute
+    single_model.py:320-405); "selective" additionally saves the named
+    expensive matmul outputs (qkv, mlp hidden) so the backward pass
+    recomputes only cheap elementwise ops — the TPU-native middle ground
+    the reference lacks."""
+    if not cfg.use_recompute:
+        return fn
+    if cfg.recompute_granularity == "full":
+        return jax.checkpoint(fn)
+    if cfg.recompute_granularity == "selective":
+        policy = jax.checkpoint_policies.save_only_these_names("qkv", "mlp_hidden")
+        return jax.checkpoint(fn, policy=policy)
+    return fn
 
 
 def _attention_block(
@@ -155,6 +180,7 @@ def _attention_block(
     # qkv: [b, s, 3, nh, hd]  (column-parallel: nh sharded over `model`)
     qkv = jnp.einsum("bsh,htnd->bstnd", x, p["qkv_kernel"].astype(dtype))
     qkv = qkv + p["qkv_bias"].astype(dtype)[None, None]
+    qkv = checkpoint_name(qkv, "qkv")
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
     if cfg.attn_impl == "ring" and ctx is not None:
@@ -218,6 +244,7 @@ def _mlp_block(
     dtype = x.dtype
     h = x @ p["fc_in_kernel"].astype(dtype) + p["fc_in_bias"].astype(dtype)
     h = _constrain(ctx, h, ("batch", None, "mlp"))
+    h = checkpoint_name(h, "mlp_hidden")
     h = jax.nn.gelu(h, approximate=True)
     h = h @ p["fc_out_kernel"].astype(dtype) + p["fc_out_bias"].astype(dtype)
     h = dropout(key, h, cfg.hidden_dropout_prob, train)
@@ -237,7 +264,7 @@ def _decoder_layer(
     k_attn, k_mlp = (jax.random.split(key) if key is not None else (None, None))
 
     def attn_part(p, x, k):
-        y = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
+        y = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"], fused=cfg.use_fused_ln)
         y = _constrain(ctx, y, ("batch", "seq", "embed"))
         return _attention_block(p["attn"], y, cfg, ctx, k, train)
 
@@ -247,7 +274,7 @@ def _decoder_layer(
     x = x + attn_part(p, x, k_attn)
     x = _constrain(ctx, x, ("batch", "seq", "embed"))
 
-    y = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
+    y = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"], fused=cfg.use_fused_ln)
     y, aux = _mlp_block(p["mlp"], y, cfg, ctx, k_mlp, train)
     x = x + y
     return _constrain(ctx, x, ("batch", "seq", "embed")), aux
@@ -291,9 +318,7 @@ def transformer_stack(
                 out, _aux = _decoder_layer(params_l, carry, cfg, ctx, k, train)
                 return out, None
 
-            sbody_fn = sbody
-            if cfg.use_recompute and cfg.recompute_granularity == "full":
-                sbody_fn = jax.checkpoint(sbody)
+            sbody_fn = _layer_remat(cfg, sbody)
             x_mb, _ = jax.lax.scan(
                 sbody_fn, x_mb, (local_params, jnp.arange(per_stage))
             )
@@ -311,9 +336,7 @@ def transformer_stack(
         out, aux = _decoder_layer(params_l, x, cfg, ctx, k, train)
         return (out, aux_sum + aux), None
 
-    body_fn = body
-    if cfg.use_recompute and cfg.recompute_granularity == "full":
-        body_fn = jax.checkpoint(body)
+    body_fn = _layer_remat(cfg, body)
 
     (x, aux), _ = jax.lax.scan(
         body_fn, (x, jnp.zeros((), jnp.float32)), (layers_params, jnp.arange(cfg.num_layers))
@@ -348,7 +371,9 @@ def forward_hidden(
     x = dropout(k_embed, x, cfg.hidden_dropout_prob, train)
 
     x, aux = transformer_stack(params["layers"], x, cfg, ctx, k_layers, train)
-    x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
+    x = layer_norm(
+        x, params["final_ln"]["scale"], params["final_ln"]["bias"], fused=cfg.use_fused_ln
+    )
     return _constrain(ctx, x, ("batch", "seq", "embed")), aux
 
 

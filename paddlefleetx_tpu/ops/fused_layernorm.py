@@ -52,8 +52,10 @@ def _fwd_kernel(x_ref, res_ref, scale_ref, bias_ref, o_ref, mean_ref, rstd_ref, 
     xhat = (x - mean) * rstd
     y = xhat * scale_ref[...].astype(jnp.float32) + bias_ref[...].astype(jnp.float32)
     o_ref[...] = y.astype(o_ref.dtype)
-    mean_ref[...] = mean[..., 0]
-    rstd_ref[...] = rstd[..., 0]
+    # (bq, 1) lane-1 blocks: TPU tiling wants the last dim equal to the
+    # array dim, same trick as the flash kernel's lse carry
+    mean_ref[...] = mean
+    rstd_ref[...] = rstd
 
 
 def _bwd_kernel(x_ref, res_ref, scale_ref, mean_ref, rstd_ref, g_ref,
@@ -61,8 +63,8 @@ def _bwd_kernel(x_ref, res_ref, scale_ref, mean_ref, rstd_ref, g_ref,
     x = x_ref[...].astype(jnp.float32)
     if has_res:
         x = x + res_ref[...].astype(jnp.float32)
-    mean = mean_ref[...][..., None]
-    rstd = rstd_ref[...][..., None]
+    mean = mean_ref[...]  # (bq, 1)
+    rstd = rstd_ref[...]
     xhat = (x - mean) * rstd
     g = g_ref[...].astype(jnp.float32)
     scale = scale_ref[...].astype(jnp.float32)
@@ -72,9 +74,15 @@ def _bwd_kernel(x_ref, res_ref, scale_ref, mean_ref, rstd_ref, g_ref,
     m1 = jnp.mean(gs, axis=-1, keepdims=True)
     m2 = jnp.mean(gs * xhat, axis=-1, keepdims=True)
     dx_ref[...] = (rstd * (gs - m1 - xhat * m2)).astype(dx_ref.dtype)
-    # per-block partial reductions; host sums the (rows//bq, n) partials
-    dscale_ref[...] = jnp.sum(g * xhat, axis=tuple(range(g.ndim - 1)))[None]
-    dbias_ref[...] = jnp.sum(g, axis=tuple(range(g.ndim - 1)))[None]
+    # dscale/dbias: accumulate across the sequential TPU grid into one
+    # (n,)-shaped output block (block == array dims satisfies tiling)
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dscale_ref[...] = jnp.zeros_like(dscale_ref)
+        dbias_ref[...] = jnp.zeros_like(dbias_ref)
+
+    dscale_ref[...] += jnp.sum(g * xhat, axis=tuple(range(g.ndim - 1)))
+    dbias_ref[...] += jnp.sum(g, axis=tuple(range(g.ndim - 1)))
 
 
 # ---------------------------------------------------------------------------
@@ -95,13 +103,13 @@ def _run_fwd(x2, res2, scale, bias, eps):
     ]
     out_shapes = (
         jax.ShapeDtypeStruct((rows, n), x2.dtype),
-        jax.ShapeDtypeStruct((rows,), jnp.float32),
-        jax.ShapeDtypeStruct((rows,), jnp.float32),
+        jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        jax.ShapeDtypeStruct((rows, 1), jnp.float32),
     )
     out_specs = (
         pl.BlockSpec((bq, n), lambda i: (i, 0)),
-        pl.BlockSpec((bq,), lambda i: (i,)),
-        pl.BlockSpec((bq,), lambda i: (i,)),
+        pl.BlockSpec((bq, 1), lambda i: (i, 0)),
+        pl.BlockSpec((bq, 1), lambda i: (i, 0)),
     )
     return pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps, has_res=has_res),
@@ -137,19 +145,19 @@ def _fused_ln_bwd(eps, has_res, saved, g):
         pl.BlockSpec((bq, n), lambda i: (i, 0)),
         pl.BlockSpec((bq, n), lambda i: (i, 0)) if has_res else pl.BlockSpec((1, n), lambda i: (0, 0)),
         pl.BlockSpec((n,), lambda i: (0,)),
-        pl.BlockSpec((bq,), lambda i: (i,)),
-        pl.BlockSpec((bq,), lambda i: (i,)),
+        pl.BlockSpec((bq, 1), lambda i: (i, 0)),
+        pl.BlockSpec((bq, 1), lambda i: (i, 0)),
         pl.BlockSpec((bq, n), lambda i: (i, 0)),
     ]
     out_shapes = (
         jax.ShapeDtypeStruct((rows, n), x2.dtype),
-        jax.ShapeDtypeStruct((rows // bq, n), jnp.float32),
-        jax.ShapeDtypeStruct((rows // bq, n), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.float32),
     )
     out_specs = (
         pl.BlockSpec((bq, n), lambda i: (i, 0)),
-        pl.BlockSpec((1, n), lambda i: (i, 0)),
-        pl.BlockSpec((1, n), lambda i: (i, 0)),
+        pl.BlockSpec((n,), lambda i: (0,)),
+        pl.BlockSpec((n,), lambda i: (0,)),
     )
     dx, dscale_p, dbias_p = pl.pallas_call(
         functools.partial(_bwd_kernel, has_res=has_res),
@@ -159,8 +167,8 @@ def _fused_ln_bwd(eps, has_res, saved, g):
         out_shape=out_shapes,
         interpret=_interpret(),
     )(*args)
-    dscale = dscale_p.sum(axis=0).astype(scale.dtype)
-    dbias = dbias_p.sum(axis=0).astype(scale.dtype)
+    dscale = dscale_p.astype(scale.dtype)
+    dbias = dbias_p.astype(scale.dtype)
     dres = dx if has_res else None
     return dx, dres, dscale, dbias
 

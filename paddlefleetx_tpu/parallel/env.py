@@ -41,7 +41,8 @@ def init_dist_env(cfg, devices=None) -> jax.sharding.Mesh:
     mesh = build_mesh(mesh_cfg, devices)
     set_mesh(mesh)
     seed = int(cfg.get("Global", {}).get("seed", 1024))
-    init_seed(seed)
+    # prng_impl "rbg" = hardware RNG (cheap TPU dropout); default threefry
+    init_seed(seed, impl=cfg.get("Global", {}).get("prng_impl", None))
     logger.info(f"mesh axes {dict(mesh.shape)} over {mesh.size} devices; seed {seed}")
     return mesh
 

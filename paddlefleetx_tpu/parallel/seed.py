@@ -30,11 +30,17 @@ _STREAM_IDS = {"params": 0, "global": 1, "local": 2, "data": 3}
 
 
 class SeedTracker:
-    """Named PRNG streams derived from one root seed."""
+    """Named PRNG streams derived from one root seed.
 
-    def __init__(self, seed: int):
+    ``impl`` selects the PRNG bit generator: "threefry2x32" (default,
+    fully reproducible across backends) or "rbg" (hardware RNG path —
+    substantially cheaper dropout on TPU at the cost of weaker
+    cross-backend reproducibility guarantees)."""
+
+    def __init__(self, seed: int, impl: Optional[str] = None):
         self.seed = int(seed)
-        self._root = jax.random.key(self.seed)
+        self.impl = impl
+        self._root = jax.random.key(self.seed, impl=impl)
         self._streams: Dict[str, jax.Array] = {
             name: jax.random.fold_in(self._root, sid) for name, sid in _STREAM_IDS.items()
         }
@@ -60,9 +66,9 @@ class SeedTracker:
 _TRACKER: Optional[SeedTracker] = None
 
 
-def init_seed(seed: int) -> SeedTracker:
+def init_seed(seed: int, impl: Optional[str] = None) -> SeedTracker:
     global _TRACKER
-    _TRACKER = SeedTracker(seed)
+    _TRACKER = SeedTracker(seed, impl=impl)
     return _TRACKER
 
 
