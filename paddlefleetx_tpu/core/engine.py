@@ -132,6 +132,10 @@ class Engine:
                 num_microbatches=int(
                     dist.get("pipeline", {}).get("micro_batches", pp_degree)
                 ),
+                # reference num_virtual_pipeline_stages (hybrid_model.py:1206)
+                num_virtual_stages=int(
+                    dist.get("pipeline", {}).get("virtual_pp_degree", 1)
+                ),
             )
         self.ctx = ShardingCtx(mesh, self.rules, pipeline=pipeline)
 
@@ -306,14 +310,32 @@ class Engine:
 
         has_extra = getattr(module, "has_extra_state", False)
 
-        @functools.partial(jax.jit, in_shardings=(None, self.batch_spec), out_shardings=self.replicated)
-        def eval_step(state: TrainState, batch):
+        @functools.partial(
+            jax.jit,
+            in_shardings=(None, self.batch_spec, None),
+            out_shardings=self.replicated,
+        )
+        def eval_step(state: TrainState, batch, eval_it):
+            # per-eval-batch key (folded with step AND batch index): modules
+            # that sample stochastic quantities at eval time — e.g. Imagen's
+            # diffusion timesteps — must not see a constant key, or eval
+            # loss becomes a low-variance biased estimate
+            ekey = jax.random.fold_in(
+                jax.random.fold_in(get_seed_tracker().key("global"), state.step), eval_it
+            )
             if has_extra:
                 loss, _ = module.loss_fn(
-                    state.params, batch, ctx=ctx, extra=state.extra, train=False
+                    state.params,
+                    batch,
+                    ctx=ctx,
+                    extra=state.extra,
+                    dropout_key=ekey,
+                    train=False,
                 )
                 return loss
-            return module.loss_fn(state.params, batch, ctx=ctx, train=False)
+            return module.loss_fn(
+                state.params, batch, ctx=ctx, dropout_key=ekey, train=False
+            )
 
         return eval_step
 
@@ -395,7 +417,7 @@ class Engine:
             if i >= iters:
                 break
             dev_batch = self._put_batch(batch)
-            losses.append(float(self._eval_step(self.state, dev_batch)))
+            losses.append(float(self._eval_step(self.state, dev_batch, jnp.int32(i))))
             if metric is not None:
                 preds = np.asarray(jax.device_get(predict(self.state, dev_batch)))
                 metric.update(preds, np.asarray(batch["labels"]))

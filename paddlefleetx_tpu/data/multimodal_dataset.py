@@ -32,6 +32,7 @@ class ImagenDataset:
         max_seq_len: int = 128,
         tokenizer: Optional[Any] = None,
         tokenizer_vocab: Optional[str] = None,
+        tokenizer_name: str = "t5",
         filter_image_size: int = 0,
         mode: str = "Train",
         num_samples: Optional[int] = None,
@@ -40,10 +41,18 @@ class ImagenDataset:
         self.max_seq_len = max_seq_len
         if tokenizer is None and tokenizer_vocab:
             # config path: Data.Train.dataset.tokenizer_vocab points at a
-            # saved T5Tokenizer vocab json (builders pass only yaml kwargs)
-            from paddlefleetx_tpu.data.tokenizers.t5_tokenizer import T5Tokenizer
+            # saved vocab json; tokenizer_name picks the family (the Imagen
+            # DebertaV2 text-encoder option needs its matching tokenizer)
+            if tokenizer_name.lower() in ("debertav2", "deberta_v2", "deberta"):
+                from paddlefleetx_tpu.data.tokenizers.debertav2_tokenizer import (
+                    DebertaV2Tokenizer,
+                )
 
-            tokenizer = T5Tokenizer.from_file(tokenizer_vocab)
+                tokenizer = DebertaV2Tokenizer.from_file(tokenizer_vocab)
+            else:
+                from paddlefleetx_tpu.data.tokenizers.t5_tokenizer import T5Tokenizer
+
+                tokenizer = T5Tokenizer.from_file(tokenizer_vocab)
         self.tokenizer = tokenizer
         self.mode = mode
         self.records: List[Dict[str, Any]] = []
@@ -125,7 +134,10 @@ class ImagenDataset:
         out: Dict[str, np.ndarray] = {"images": arr}
         caption = rec.get("caption", "")
         if self.tokenizer is not None:
-            ids = self.tokenizer.encode(caption)[: self.max_seq_len]
+            # encode_ids: flat id list without specials (DebertaV2Tokenizer);
+            # T5Tokenizer.encode already returns a flat list
+            enc = getattr(self.tokenizer, "encode_ids", self.tokenizer.encode)
+            ids = enc(caption)[: self.max_seq_len]
             pad = getattr(self.tokenizer, "pad_id", 0)
             ids = ids + [pad] * (self.max_seq_len - len(ids))
             out["input_ids"] = np.asarray(ids, np.int64)

@@ -35,13 +35,12 @@ class T5Tokenizer:
         self.pieces = list(pieces)
         self.extra_tokens = [f"<extra_id_{i}>" for i in range(num_extra_ids)]
         self.vocab: Dict[str, int] = {p: i for i, (p, _) in enumerate(self.pieces)}
-        # sentinels occupy the ids above the base vocab, highest sentinel
-        # first does NOT apply here: HF/reference order appends extra ids
-        # after the sp vocab, with extra_id_0 = len(vocab)+num_extra-1... we
-        # keep the simpler ascending layout and expose it via helpers.
+        # sentinels occupy the ids above the base vocab in DESCENDING order:
+        # extra_id_0 is the highest id (reference/HF T5 convention), so
+        # corpora tokenized with a reference tokenizer keep matching ids
         base = len(self.pieces)
         for i, t in enumerate(self.extra_tokens):
-            self.vocab[t] = base + i
+            self.vocab[t] = base + num_extra_ids - 1 - i
         self.inv_vocab = {i: p for p, i in self.vocab.items()}
         self.scores = {p: s for p, s in self.pieces}
         self.pad_token, self.eos_token, self.unk_token = pad_token, eos_token, unk_token

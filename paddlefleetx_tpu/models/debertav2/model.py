@@ -205,15 +205,25 @@ def _heads(x: jax.Array, kernel: jax.Array, bias: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-def _conv_branch(p: Dict[str, jax.Array], emb: jax.Array, first_out: jax.Array, cfg, key, train):
-    """ConvLayer (:381-427): token conv on the embedding output, summed with
-    the first transformer layer's output, then LN."""
+def _conv_branch(
+    p: Dict[str, jax.Array],
+    emb: jax.Array,
+    first_out: jax.Array,
+    attention_mask: jax.Array,
+    cfg,
+    key,
+    train,
+):
+    """ConvLayer (:381-427): token conv on the embedding output (zeroed at
+    pad positions, reference rmask handling), ACT(dropout(conv)) order,
+    summed with the first transformer layer's output, then LN."""
     y = jax.lax.conv_general_dilated(
         emb, p["kernel"],
         window_strides=(1,), padding="SAME",
         dimension_numbers=("NWC", "WIO", "NWC"),
     ) + p["bias"]
-    y = dropout(key, jax.nn.gelu(y, approximate=True), cfg.hidden_dropout_prob, train)
+    y = y * attention_mask[..., None].astype(y.dtype)
+    y = jax.nn.gelu(dropout(key, y, cfg.hidden_dropout_prob, train), approximate=True)
     return layer_norm(first_out + y, p["ln_scale"], p["ln_bias"], cfg.layer_norm_eps)
 
 
@@ -296,7 +306,7 @@ def encode(
         # run first layer alone to mix in the conv branch (reference :497-507)
         first = jax.tree.map(lambda a: a[0], params["layers"])
         (x1, _), _ = jax.lax.scan(block, (x, jnp.int32(0)), jax.tree.map(lambda a: a[None], first), length=1)
-        x1 = _conv_branch(params["conv"], x, x1, cfg, k_conv, train)
+        x1 = _conv_branch(params["conv"], x, x1, attention_mask, cfg, k_conv, train)
         rest = jax.tree.map(lambda a: a[1:], params["layers"])
         (x, _), _ = jax.lax.scan(block, (x1, jnp.int32(1)), rest, length=cfg.num_layers - 1)
     else:

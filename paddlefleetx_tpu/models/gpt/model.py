@@ -344,6 +344,27 @@ def transformer_stack(
     return x, aux
 
 
+def _embed(
+    params: Dict[str, Any],
+    input_ids: jax.Array,
+    position_ids: Optional[jax.Array],
+    cfg: GPTConfig,
+    ctx: Optional[ShardingCtx],
+    key: Optional[jax.Array],
+    train: bool,
+) -> jax.Array:
+    """Word + position embedding with embedding dropout -> [b, s, h]."""
+    dtype = jnp.dtype(cfg.dtype)
+    s = input_ids.shape[1]
+    if position_ids is None:
+        position_ids = jnp.arange(s, dtype=jnp.int32)[None, :]
+    word = params["word"].astype(dtype)
+    pos = params["position"].astype(dtype)
+    x = word[input_ids] + pos[position_ids]
+    x = _constrain(ctx, x, ("batch", "seq", "embed"))
+    return dropout(key, x, cfg.hidden_dropout_prob, train)
+
+
 def forward_hidden(
     params: Dict[str, Any],
     input_ids: jax.Array,
@@ -355,20 +376,10 @@ def forward_hidden(
     train: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Token ids [b, s] -> (final hidden [b, s, h], moe aux loss sum)."""
-    dtype = jnp.dtype(cfg.dtype)
-    b, s = input_ids.shape
-    if position_ids is None:
-        position_ids = jnp.arange(s, dtype=jnp.int32)[None, :]
-
     k_embed, k_layers = (
         jax.random.split(dropout_key) if dropout_key is not None else (None, None)
     )
-
-    word = params["embeddings"]["word"].astype(dtype)
-    pos = params["embeddings"]["position"].astype(dtype)
-    x = word[input_ids] + pos[position_ids]
-    x = _constrain(ctx, x, ("batch", "seq", "embed"))
-    x = dropout(k_embed, x, cfg.hidden_dropout_prob, train)
+    x = _embed(params["embeddings"], input_ids, position_ids, cfg, ctx, k_embed, train)
 
     x, aux = transformer_stack(params["layers"], x, cfg, ctx, k_layers, train)
     x = layer_norm(
@@ -432,6 +443,124 @@ def cross_entropy(
     return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
 
 
+def _pipeline_train_loss(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: GPTConfig,
+    ctx: ShardingCtx,
+    dropout_key: Optional[jax.Array],
+) -> jax.Array:
+    """Training loss under pipeline parallelism via the 1F1B schedule.
+
+    Embedding, per-chunk layer blocks, and the head+CE all run inside the
+    schedule (parallel/pipeline.py); this function just adapts the GPT
+    pieces to the (embed_fn, chunk_fn, head_fn) contract and divides the
+    returned numerator by the global mask sum (reference
+    GPTPretrainingCriterion masked mean, single_model.py:819)."""
+    from paddlefleetx_tpu.parallel.pipeline import (
+        interleave_permutation,
+        pipeline_loss_1f1b,
+    )
+
+    if cfg.num_experts > 1:
+        raise NotImplementedError("MoE with pipeline parallelism unsupported")
+    pcfg = ctx.pipeline
+    S, V = pcfg.num_stages, pcfg.num_virtual_stages
+    C = S * V
+    if cfg.num_layers % C:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by {S} stages x {V} virtual"
+        )
+    pc = cfg.num_layers // C
+
+    k_embed, k_layers = (
+        jax.random.split(dropout_key) if dropout_key is not None else (None, None)
+    )
+
+    # batch leaves enter the custom-vjp pipeline as floats (ids < 2^24 are
+    # exact in f32; zero cotangents) and are cast back inside the fns
+    bsz, seq = batch["tokens"].shape
+    fbatch = {
+        "tokens": batch["tokens"].astype(jnp.float32),
+        "labels": batch["labels"].astype(jnp.float32),
+    }
+    loss_mask = batch.get("loss_mask")
+    fbatch["loss_mask"] = (
+        jnp.ones((bsz, seq), jnp.float32)
+        if loss_mask is None
+        else loss_mask.astype(jnp.float32)
+    )
+    if batch.get("position_ids") is not None:
+        fbatch["position_ids"] = batch["position_ids"].astype(jnp.float32)
+
+    def embed_fn(eparams, mb, mbi):
+        toks = mb["tokens"].astype(jnp.int32)
+        pos_ids = (
+            mb["position_ids"].astype(jnp.int32) if "position_ids" in mb else None
+        )
+        k = jax.random.fold_in(k_embed, mbi) if k_embed is not None else None
+        return _embed(eparams, toks, pos_ids, cfg, ctx, k, True)
+
+    def chunk_fn(chunk_params, x_mb, c, mbi):
+        def sbody(carry, inp):
+            params_l, local_idx = inp
+            # semantic layer index: params are pre-permuted so execution
+            # chunk c holds semantic layers [c*pc, (c+1)*pc) — key folding
+            # matches the single-device scan exactly
+            k = (
+                jax.random.fold_in(jax.random.fold_in(k_layers, c * pc + local_idx), mbi)
+                if k_layers is not None
+                else None
+            )
+            out, _aux = _decoder_layer(params_l, carry, cfg, ctx, k, True)
+            return out, None
+
+        sbody_fn = _layer_remat(cfg, sbody)
+        x_mb, _ = jax.lax.scan(sbody_fn, x_mb, (chunk_params, jnp.arange(pc)))
+        return x_mb
+
+    def head_fn(hparams, y_mb, mb, mbi):
+        y = layer_norm(
+            y_mb, hparams["final_ln"]["scale"], hparams["final_ln"]["bias"],
+            fused=cfg.use_fused_ln,
+        )
+        y = _constrain(ctx, y, ("batch", "seq", "embed"))
+        word = hparams["word"].astype(y.dtype)
+        logits = jnp.einsum("bsh,vh->bsv", y, word)
+        logits = _constrain(ctx, logits, ("batch", "seq", "vocab")).astype(jnp.float32)
+        labels = mb["labels"].astype(jnp.int32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        # one-hot contraction, not take_along_axis: the scatter transpose of
+        # a gather over the model-sharded vocab dim trips an XLA
+        # partial-manual partitioner CHECK; the one-hot contraction's
+        # transpose is a plain (psum-able) broadcast-multiply
+        picked = jnp.sum(
+            logits * jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype), -1
+        )
+        return jnp.sum((lse - picked) * mb["loss_mask"])
+
+    layers_params = params["layers"]
+    if V > 1:
+        # NOTE: this per-step permutation crosses stage-shard boundaries
+        # (one all-to-all of the layer stack each way per step).  Storing
+        # params pre-permuted would amortize it but ties checkpoint layout
+        # to the pipeline config (Megatron's choice); revisit if V>1 runs
+        # become bandwidth-bound.
+        perm = interleave_permutation(cfg.num_layers, S, V)
+        layers_params = jax.tree.map(lambda a: jnp.take(a, perm, axis=0), layers_params)
+
+    eparams = params["embeddings"]
+    hparams = {"final_ln": params["final_ln"], "word": params["embeddings"]["word"]}
+    numer = pipeline_loss_1f1b(
+        (embed_fn, chunk_fn, head_fn),
+        pcfg,
+        ctx.mesh,
+        (eparams, layers_params, hparams),
+        fbatch,
+    )
+    return numer / jnp.maximum(jnp.sum(fbatch["loss_mask"]), 1.0)
+
+
 def loss_fn(
     params: Dict[str, Any],
     batch: Dict[str, jax.Array],
@@ -445,6 +574,13 @@ def loss_fn(
 
     MoE models add the load-balance aux loss scaled by moe_aux_loss_weight
     (reference sharded_moe.py l_aux handling)."""
+    if (
+        train
+        and ctx is not None
+        and ctx.pipeline is not None
+        and ctx.pipeline.num_stages > 1
+    ):
+        return _pipeline_train_loss(params, batch, cfg, ctx, dropout_key)
     hidden, aux = forward_hidden(
         params,
         batch["tokens"],

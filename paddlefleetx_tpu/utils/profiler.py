@@ -31,11 +31,19 @@ class ProfilerHook:
         cfg = cfg or {}
         self.enabled = bool(cfg.get("enable", False))
         sched = cfg.get("scheduler") or [3, 8]
-        if len(sched) != 2 or int(sched[0]) >= int(sched[1]):
-            raise ValueError(
-                f"Profiler.scheduler must be [start_step, stop_step] with "
-                f"start < stop, got {sched}"
-            )
+        try:
+            ok = len(sched) == 2 and int(sched[0]) < int(sched[1])
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            if not self.enabled:
+                # a malformed window must not abort runs that never profile
+                sched = [3, 8]
+            else:
+                raise ValueError(
+                    f"Profiler.scheduler must be [start_step, stop_step] with "
+                    f"start < stop, got {sched}"
+                )
         self.start_step, self.stop_step = int(sched[0]), int(sched[1])
         self.log_dir = os.path.abspath(cfg.get("log_dir", "./profiler_log"))
         self._active = False

@@ -109,6 +109,63 @@ def test_pipeline_more_microbatches(devices8):
     np.testing.assert_allclose(got, ref, rtol=2e-5)
 
 
+@pytest.mark.parametrize("pp,extra,mb,vpp", [
+    (2, {"dp_degree": 4}, 2, 1),
+    (2, {"dp_degree": 4}, 4, 1),          # M > S: steady-state 1F1B
+    (4, {"dp_degree": 2}, 4, 1),
+    (2, {"mp_degree": 2, "dp_degree": 2}, 2, 1),   # TP inside stages
+    (2, {"dp_degree": 4}, 4, 2),          # interleaved virtual stages
+])
+def test_pipeline_1f1b_train_loss_and_grads(devices8, pp, extra, mb, vpp):
+    """Training path: 1F1B schedule (grads computed inside the forward
+    schedule via custom_vjp) matches single-device loss AND grads."""
+    params = gpt.init(TINY, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, TINY.vocab_size)
+    batch = {
+        "tokens": tokens,
+        "labels": jnp.roll(tokens, -1, 1),
+        "loss_mask": jnp.ones((8, 16), jnp.float32),
+    }
+    ref_loss, g_ref = jax.value_and_grad(
+        lambda p: gpt.loss_fn(p, batch, TINY, train=True)
+    )(params)
+
+    mesh, rules, ctx = _ctx(devices8, pp, extra, microbatches=mb)
+    ctx = gpt.ShardingCtx(
+        mesh, rules, pipeline=PipelineConfig(pp, mb, num_virtual_stages=vpp)
+    )
+    shardings = tree_logical_to_sharding(gpt.gpt_logical_axes(TINY), mesh, rules)
+    p_sharded = jax.device_put(params, shardings)
+    with mesh:
+        loss, g = jax.jit(
+            jax.value_and_grad(
+                lambda p, b: gpt.loss_fn(p, b, TINY, ctx=ctx, train=True)
+            )
+        )(p_sharded, batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=5e-4, atol=1e-5)
+
+
+def test_pipeline_1f1b_masked_loss(devices8):
+    """Partial loss_mask: the in-schedule numerator / global denominator
+    decomposition must reproduce the global masked mean."""
+    params = gpt.init(TINY, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, TINY.vocab_size)
+    mask = (jax.random.uniform(jax.random.key(3), (8, 16)) > 0.4).astype(jnp.float32)
+    batch = {"tokens": tokens, "labels": jnp.roll(tokens, -1, 1), "loss_mask": mask}
+    ref = float(gpt.loss_fn(params, batch, TINY, train=True))
+    mesh, rules, ctx = _ctx(devices8, 2, {"dp_degree": 4}, microbatches=4)
+    shardings = tree_logical_to_sharding(gpt.gpt_logical_axes(TINY), mesh, rules)
+    with mesh:
+        got = float(
+            jax.jit(lambda p, b: gpt.loss_fn(p, b, TINY, ctx=ctx, train=True))(
+                jax.device_put(params, shardings), batch
+            )
+        )
+    np.testing.assert_allclose(got, ref, rtol=2e-5)
+
+
 def test_indivisible_layers_raises(devices8):
     cfg = GPTConfig(**{**TINY.__dict__, "num_layers": 3})
     params = gpt.init(cfg, jax.random.key(0))

@@ -39,3 +39,79 @@ def test_merges_applied(tok):
 
 def test_eos(tok):
     assert tok.eos_token_id == tok.encoder["<|endoftext|>"]
+
+
+# ---------------------------------------------------------------------------
+# DebertaV2 sentencepiece-style tokenizer
+# ---------------------------------------------------------------------------
+
+from paddlefleetx_tpu.data.tokenizers.debertav2_tokenizer import (  # noqa: E402
+    DebertaV2Tokenizer,
+)
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "deberta uses disentangled attention",
+    "sentencepiece segments words into pieces",
+]
+
+
+@pytest.fixture
+def dtok():
+    return DebertaV2Tokenizer.from_tiny_corpus(CORPUS)
+
+
+def test_deberta_special_layout(dtok):
+    # [PAD]=0, [CLS]=1, [SEP]=2, [UNK]=3; [MASK] appended at the top
+    assert dtok.pad_id == 0
+    assert dtok.cls_id == 1
+    assert dtok.sep_id == 2
+    assert dtok.vocab["[UNK]"] == 3
+    assert dtok.mask_id == dtok.vocab_size - 1
+
+
+def test_deberta_roundtrip(dtok):
+    for text in CORPUS:
+        enc = dtok.encode(text)
+        assert enc["input_ids"][0] == dtok.cls_id
+        assert enc["input_ids"][-1] == dtok.sep_id
+        assert dtok.decode(enc["input_ids"]) == text
+
+
+def test_deberta_pair_and_padding(dtok):
+    enc = dtok.encode("the quick fox", "the lazy dog", max_length=16, padding=True)
+    ids, types, mask = enc["input_ids"], enc["token_type_ids"], enc["attention_mask"]
+    assert len(ids) == len(types) == len(mask) == 16
+    n_sep = sum(1 for i in ids if i == dtok.sep_id)
+    assert n_sep == 2
+    first_sep = ids.index(dtok.sep_id)
+    assert all(t == 0 for t in types[: first_sep + 1])
+    pad_start = mask.index(0)
+    assert all(t == 1 for t in types[first_sep + 1 : pad_start] if True)
+    assert all(i == dtok.pad_id for i in ids[pad_start:])
+
+
+def test_deberta_truncation(dtok):
+    enc = dtok.encode(
+        "the quick brown fox jumps over the lazy dog", max_length=6
+    )
+    assert len(enc["input_ids"]) == 6
+    assert enc["input_ids"][0] == dtok.cls_id
+    assert enc["input_ids"][-1] == dtok.sep_id
+
+
+def test_deberta_save_load_stable(dtok, tmp_path):
+    p = str(tmp_path / "deberta_vocab.json")
+    dtok.save(p)
+    tok2 = DebertaV2Tokenizer.from_file(p)
+    text = CORPUS[1]
+    assert dtok.encode(text) == tok2.encode(text)
+
+
+def test_t5_sentinel_descending():
+    """extra_id_0 must be the HIGHEST id (reference/HF layout)."""
+    from paddlefleetx_tpu.data.tokenizers.t5_tokenizer import T5Tokenizer
+
+    t = T5Tokenizer.from_tiny_corpus(CORPUS, num_extra_ids=10)
+    assert t.extra_id(0) == t.vocab_size - 1
+    assert t.extra_id(9) == t.vocab_size - 10
