@@ -158,5 +158,6 @@ def _register_family_modules():
     import paddlefleetx_tpu.models.gpt.evaluation  # noqa: F401
     import paddlefleetx_tpu.models.multimodal.module  # noqa: F401
     import paddlefleetx_tpu.models.gpt.finetune  # noqa: F401
+    import paddlefleetx_tpu.models.protein.module  # noqa: F401
     import paddlefleetx_tpu.models.t5.module  # noqa: F401
     import paddlefleetx_tpu.models.vision.module  # noqa: F401

@@ -102,41 +102,14 @@ class DebertaV2Tokenizer:
                 seen.add(w)
         return cls(pieces, **kw)
 
-    # -- unigram segmentation (shared algorithm with t5_tokenizer) ----------
-
-    def _viterbi(self, text: str) -> List[str]:
-        n = len(text)
-        best: List[float] = [0.0] + [-math.inf] * n
-        back: List[int] = [0] * (n + 1)
-        unk_pen = min(self.scores.values(), default=-10.0) - 10.0
-        for end in range(1, n + 1):
-            for start in range(max(0, end - self.max_piece_len), end):
-                piece = text[start:end]
-                score = self.scores.get(piece)
-                if score is None:
-                    if end - start == 1:
-                        score = unk_pen
-                    else:
-                        continue
-                cand = best[start] + score
-                if cand > best[end]:
-                    best[end] = cand
-                    back[end] = start
-        out: List[str] = []
-        end = n
-        while end > 0:
-            start = back[end]
-            out.append(text[start:end])
-            end = start
-        return out[::-1]
+    # -- unigram segmentation (shared core: tokenizers/unigram.py) ----------
 
     def tokenize(self, text: str) -> List[str]:
+        from paddlefleetx_tpu.data.tokenizers.unigram import tokenize_words
+
         if self.do_lower_case:
             text = text.lower()
-        toks: List[str] = []
-        for word in text.strip().split():
-            toks.extend(self._viterbi(SPIECE_UNDERLINE + word))
-        return toks
+        return tokenize_words(text, self.scores, self.max_piece_len)
 
     # -- encode / decode ----------------------------------------------------
 
@@ -182,7 +155,14 @@ class DebertaV2Tokenizer:
                 # truncate the longer sequence first (reference
                 # truncate_sequences 'longest_first', :1195)
                 n_special = 3 if ids_b is not None else 2
-                while len(ids_a) + len(ids_b or []) + n_special > max_length:
+                if max_length < n_special + 1:
+                    raise ValueError(
+                        f"max_length={max_length} cannot fit {n_special} special "
+                        f"tokens plus content"
+                    )
+                while len(ids_a) + len(ids_b or []) + n_special > max_length and (
+                    ids_a or ids_b
+                ):
                     if ids_b and len(ids_b) > len(ids_a):
                         ids_b.pop()
                     else:

@@ -88,38 +88,10 @@ class T5Tokenizer:
 
     # -- core unigram segmentation -----------------------------------------
 
-    def _viterbi(self, text: str) -> List[str]:
-        """Best segmentation of one pre-tokenized chunk (▁-prefixed word)."""
-        n = len(text)
-        best: List[float] = [0.0] + [-math.inf] * n
-        back: List[int] = [0] * (n + 1)
-        unk_pen = min(self.scores.values(), default=-10.0) - 10.0
-        for end in range(1, n + 1):
-            for start in range(max(0, end - self.max_piece_len), end):
-                piece = text[start:end]
-                score = self.scores.get(piece)
-                if score is None:
-                    if end - start == 1:
-                        score = unk_pen  # single-char fallback -> maybe <unk>
-                    else:
-                        continue
-                cand = best[start] + score
-                if cand > best[end]:
-                    best[end] = cand
-                    back[end] = start
-        out: List[str] = []
-        end = n
-        while end > 0:
-            start = back[end]
-            out.append(text[start:end])
-            end = start
-        return out[::-1]
-
     def tokenize(self, text: str) -> List[str]:
-        toks: List[str] = []
-        for word in text.strip().split():
-            toks.extend(self._viterbi(SPIECE_UNDERLINE + word))
-        return toks
+        from paddlefleetx_tpu.data.tokenizers.unigram import tokenize_words
+
+        return tokenize_words(text, self.scores, self.max_piece_len)
 
     # -- encode / decode ----------------------------------------------------
 

@@ -1,0 +1,55 @@
+"""Shared sentencepiece-style unigram segmentation core.
+
+Viterbi best-segmentation over a piece->logprob vocabulary with the "▁"
+whitespace marker — the algorithm both the T5 and DebertaV2 tokenizers
+wrap (the reference vendors two separate sentencepiece-backed stacks,
+t5_tokenizer.py and debertav2_tokenizer.py; the segmentation math is one
+function here).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+SPIECE_UNDERLINE = "▁"
+
+
+def viterbi_segment(
+    text: str, scores: Dict[str, float], max_piece_len: int
+) -> List[str]:
+    """Best segmentation of one pre-tokenized chunk (▁-prefixed word).
+    Unknown single characters get a below-vocab penalty score."""
+    n = len(text)
+    best: List[float] = [0.0] + [-math.inf] * n
+    back: List[int] = [0] * (n + 1)
+    unk_pen = min(scores.values(), default=-10.0) - 10.0
+    for end in range(1, n + 1):
+        for start in range(max(0, end - max_piece_len), end):
+            piece = text[start:end]
+            score = scores.get(piece)
+            if score is None:
+                if end - start == 1:
+                    score = unk_pen  # single-char fallback -> maybe <unk>
+                else:
+                    continue
+            cand = best[start] + score
+            if cand > best[end]:
+                best[end] = cand
+                back[end] = start
+    out: List[str] = []
+    end = n
+    while end > 0:
+        start = back[end]
+        out.append(text[start:end])
+        end = start
+    return out[::-1]
+
+
+def tokenize_words(
+    text: str, scores: Dict[str, float], max_piece_len: int
+) -> List[str]:
+    toks: List[str] = []
+    for word in text.strip().split():
+        toks.extend(viterbi_segment(SPIECE_UNDERLINE + word, scores, max_piece_len))
+    return toks

@@ -1,0 +1,43 @@
+"""ProteinModule: binds the HelixFold model to the engine.
+
+The reference has no engine adapter for protein folding (its
+projects/protein_folding/README.md defers training to the upstream
+HelixFold app); this module completes the path so ``tools/train.py``
+drives folding with DP x DAP layouts like any other family.
+"""
+
+from __future__ import annotations
+
+from paddlefleetx_tpu.core.module import BasicModule, resolve_model_dtype
+from paddlefleetx_tpu.utils.registry import MODULES
+
+
+@MODULES.register("ProteinModule")
+class ProteinModule(BasicModule):
+    def __init__(self, cfg):
+        from paddlefleetx_tpu.models.protein.folding import FoldingConfig
+
+        model_cfg = dict(cfg.Model)
+        model_cfg.pop("module", None)
+        model_cfg.pop("name", None)
+        resolve_model_dtype(cfg, model_cfg)
+        self.config = FoldingConfig.from_config(model_cfg)
+        ds = cfg.get("Data", {}).get("Train", {}).get("dataset", {})
+        self.tokens_per_sample = int(ds.get("num_res", 64))  # ips = residues/s
+
+    def init_params(self, key):
+        from paddlefleetx_tpu.models.protein import folding
+
+        return folding.init(self.config, key)
+
+    def logical_axes(self):
+        from paddlefleetx_tpu.models.protein import folding
+
+        return folding.folding_logical_axes(self.config)
+
+    def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=True):
+        from paddlefleetx_tpu.models.protein import folding
+
+        return folding.loss_fn(
+            params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
+        )

@@ -1,0 +1,112 @@
+"""Compact residue constants for the protein stack.
+
+A dependency-free subset of the reference's residue_constants
+(ppfleetx/models/protein_folding/residue_constants.py, 961 LoC — itself the
+public AlphaFold table set): the 20 restypes, the 37-atom vocabulary,
+per-residue chi-angle atom quadruples, chi masks, and pi-periodic flags.
+Only the tables the framework consumes (torsion extraction, pseudo-beta,
+backbone decoding) are included; the full rigid-group coordinate tables
+are deliberately out of scope (backbone-frame decoding uses ideal ALA
+geometry, see structure.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+restypes = [
+    "A", "R", "N", "D", "C", "Q", "E", "G", "H", "I",
+    "L", "K", "M", "F", "P", "S", "T", "W", "Y", "V",
+]
+restype_order = {r: i for i, r in enumerate(restypes)}
+restype_num = len(restypes)  # 20; 'X' (unknown) = 20, gap = 21
+
+restype_1to3 = {
+    "A": "ALA", "R": "ARG", "N": "ASN", "D": "ASP", "C": "CYS",
+    "Q": "GLN", "E": "GLU", "G": "GLY", "H": "HIS", "I": "ILE",
+    "L": "LEU", "K": "LYS", "M": "MET", "F": "PHE", "P": "PRO",
+    "S": "SER", "T": "THR", "W": "TRP", "Y": "TYR", "V": "VAL",
+}
+
+# the 37 heavy-atom vocabulary (atom37 representation)
+atom_types = [
+    "N", "CA", "C", "CB", "O", "CG", "CG1", "CG2", "OG", "OG1", "SG", "CD",
+    "CD1", "CD2", "ND1", "ND2", "OD1", "OD2", "SD", "CE", "CE1", "CE2",
+    "CE3", "NE", "NE1", "NE2", "OE1", "OE2", "CH2", "NH1", "NH2", "OH",
+    "CZ", "CZ2", "CZ3", "NZ", "OXT",
+]
+atom_order = {a: i for i, a in enumerate(atom_types)}
+atom_type_num = len(atom_types)  # 37
+
+# chi-angle definitions: per residue, up to 4 quadruples of atom names
+chi_angles_atoms = {
+    "ALA": [],
+    "ARG": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD"],
+            ["CB", "CG", "CD", "NE"], ["CG", "CD", "NE", "CZ"]],
+    "ASN": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "OD1"]],
+    "ASP": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "OD1"]],
+    "CYS": [["N", "CA", "CB", "SG"]],
+    "GLN": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD"],
+            ["CB", "CG", "CD", "OE1"]],
+    "GLU": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD"],
+            ["CB", "CG", "CD", "OE1"]],
+    "GLY": [],
+    "HIS": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "ND1"]],
+    "ILE": [["N", "CA", "CB", "CG1"], ["CA", "CB", "CG1", "CD1"]],
+    "LEU": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD1"]],
+    "LYS": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD"],
+            ["CB", "CG", "CD", "CE"], ["CG", "CD", "CE", "NZ"]],
+    "MET": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "SD"],
+            ["CB", "CG", "SD", "CE"]],
+    "PHE": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD1"]],
+    "PRO": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD"]],
+    "SER": [["N", "CA", "CB", "OG"]],
+    "THR": [["N", "CA", "CB", "OG1"]],
+    "TRP": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD1"]],
+    "TYR": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD1"]],
+    "VAL": [["N", "CA", "CB", "CG1"]],
+}
+
+# chi angles that are 180-degree symmetric (pi periodic)
+chi_pi_periodic = {
+    "ASP": [False, True], "GLU": [False, False, True],
+    "PHE": [False, True], "TYR": [False, True],
+}
+
+
+def get_chi_atom_indices() -> np.ndarray:
+    """[21, 4, 4] atom37 indices for each restype's chi quadruples
+    (reference all_atom.py:25-51); unused slots are 0."""
+    out = np.zeros((restype_num + 1, 4, 4), dtype=np.int32)
+    for i, r in enumerate(restypes):
+        for c, quad in enumerate(chi_angles_atoms[restype_1to3[r]]):
+            out[i, c] = [atom_order[a] for a in quad]
+    return out
+
+
+def get_chi_angles_mask() -> np.ndarray:
+    """[21, 4] which chi angles exist per restype."""
+    out = np.zeros((restype_num + 1, 4), dtype=np.float32)
+    for i, r in enumerate(restypes):
+        out[i, : len(chi_angles_atoms[restype_1to3[r]])] = 1.0
+    return out
+
+
+def get_chi_pi_periodic() -> np.ndarray:
+    """[21, 4] chi angles with 180-degree rotational symmetry."""
+    out = np.zeros((restype_num + 1, 4), dtype=np.float32)
+    for i, r in enumerate(restypes):
+        flags = chi_pi_periodic.get(restype_1to3[r], [])
+        for c, f in enumerate(flags):
+            out[i, c] = float(f)
+    return out
+
+
+# ideal backbone-frame local coordinates (ALA rigid-group geometry,
+# angstroms): frame origin at CA, N on one side, C on the x axis
+IDEAL_N = np.array([-0.525, 1.363, 0.000], dtype=np.float32)
+IDEAL_CA = np.array([0.000, 0.000, 0.000], dtype=np.float32)
+IDEAL_C = np.array([1.526, 0.000, 0.000], dtype=np.float32)
+IDEAL_CB = np.array([-0.529, -0.774, -1.205], dtype=np.float32)
+# O sits in the psi rigid group; with psi=0 its backbone-frame position
+IDEAL_O = np.array([2.153, -1.062, 0.000], dtype=np.float32)

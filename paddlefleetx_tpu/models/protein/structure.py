@@ -1,0 +1,391 @@
+"""Structure module: invariant point attention + backbone updates + losses.
+
+The reference repo ships only the geometry utilities for this stage
+(quat_affine.py, r3.py, all_atom.py) — its README defers the actual
+structure module to the upstream HelixFold app.  This module completes the
+stack the TPU-native way (AlphaFold2, Jumper et al. 2021, Suppl. Alg.
+20-23 "StructureModule" / "InvariantPointAttention", Alg. 27 "torsion
+head", Alg. 28 FAPE):
+
+* :func:`invariant_point_attention` — IPA over the single representation
+  with pair bias and SE(3)-invariant point terms (queries/keys/values as
+  3D points carried through each residue's rigid frame).
+* :func:`fold_iteration` — IPA residual + LN + transition + quaternion
+  ``pre_compose`` backbone update (rigid.py), torsion-angle resnet head.
+* :func:`structure_module` — 8 shared-weight iterations from the
+  Evoformer single/pair representations; returns per-iteration backbone
+  frames (for intermediate FAPE supervision), final frames, torsions and
+  decoded backbone atom37 coordinates (N, CA, C, O, CB from ideal local
+  geometry — full sidechain rigid groups documented out of scope).
+* :func:`backbone_fape_loss`, :func:`torsion_angle_loss` — training
+  losses over rigid.frame_aligned_point_error / predicted torsions.
+
+All functions are batched, jit/scan-friendly, and take the standard
+``ShardingCtx`` for mesh execution (the single/pair tracks keep their
+Evoformer shardings; IPA is residue-local + attention so GSPMD handles
+DAP layouts unchanged).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.models.common import (
+    ParamSpec,
+    init_params,
+    normal_init,
+    ones_init,
+    zeros_init,
+)
+from paddlefleetx_tpu.models.gpt.model import ShardingCtx, _constrain, layer_norm
+from paddlefleetx_tpu.models.protein import residue_constants as rc
+from paddlefleetx_tpu.models.protein import rigid
+
+_W = normal_init(0.02)
+
+
+@dataclasses.dataclass(frozen=True)
+class StructureConfig:
+    single_channel: int = 384
+    pair_channel: int = 128
+    num_iterations: int = 8
+    num_heads: int = 12
+    scalar_qk: int = 16
+    scalar_v: int = 16
+    point_qk: int = 4
+    point_v: int = 8
+    num_transition_layers: int = 3
+    torsion_channel: int = 128
+    position_scale: float = 10.0
+    dropout_rate: float = 0.1
+
+    @classmethod
+    def from_config(cls, d: Dict[str, Any]) -> "StructureConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+def _ln(c):
+    return {"scale": ParamSpec((c,), ("embed",), ones_init()),
+            "bias": ParamSpec((c,), ("embed",), zeros_init())}
+
+
+def structure_specs(cfg: StructureConfig) -> Dict[str, Any]:
+    cs, cz, h = cfg.single_channel, cfg.pair_channel, cfg.num_heads
+    ipa = {
+        "q_scalar": ParamSpec((cs, h, cfg.scalar_qk), ("embed", "heads", "kv"), _W),
+        "kv_scalar": ParamSpec((cs, h, cfg.scalar_qk + cfg.scalar_v), ("embed", "heads", "kv"), _W),
+        "q_point": ParamSpec((cs, h, cfg.point_qk, 3), ("embed", "heads", None, None), _W),
+        "kv_point": ParamSpec((cs, h, cfg.point_qk + cfg.point_v, 3), ("embed", "heads", None, None), _W),
+        "pair_bias": ParamSpec((cz, h), ("embed", "heads"), _W),
+        # learned per-head softplus weights for the point term
+        "point_weights": ParamSpec((h,), ("heads",), ones_init()),
+        "out": ParamSpec(
+            (h * (cfg.scalar_v + cfg.point_v * 4 + cz), cs), ("mlp", "embed"), zeros_init()
+        ),
+        "out_b": ParamSpec((cs,), ("embed",), zeros_init()),
+    }
+    transition = {
+        f"fc{i}": ParamSpec((cs, cs), ("embed", "mlp"), _W if i < cfg.num_transition_layers - 1 else zeros_init())
+        for i in range(cfg.num_transition_layers)
+    }
+    transition.update({
+        f"fc{i}_b": ParamSpec((cs,), ("mlp",), zeros_init())
+        for i in range(cfg.num_transition_layers)
+    })
+    ct = cfg.torsion_channel
+    return {
+        "single_ln": _ln(cs),
+        "pair_ln": _ln(cz),
+        "initial_proj": ParamSpec((cs, cs), ("embed", "mlp"), _W),
+        "ipa": ipa,
+        "ipa_ln": _ln(cs),
+        "transition": transition,
+        "transition_ln": _ln(cs),
+        "affine_update": ParamSpec((cs, 6), ("embed", None), zeros_init()),
+        "affine_update_b": ParamSpec((6,), (None,), zeros_init()),
+        "torsion": {
+            "in1": ParamSpec((cs, ct), ("embed", "mlp"), _W),
+            "in2": ParamSpec((cs, ct), ("embed", "mlp"), _W),
+            "res1": ParamSpec((ct, ct), ("embed", "mlp"), _W),
+            "res1_b": ParamSpec((ct,), ("mlp",), zeros_init()),
+            "res2": ParamSpec((ct, ct), ("mlp", "embed"), zeros_init()),
+            "res2_b": ParamSpec((ct,), ("embed",), zeros_init()),
+            "out": ParamSpec((ct, 14), ("embed", None), _W),
+            "out_b": ParamSpec((14,), (None,), zeros_init()),
+        },
+    }
+
+
+def init(cfg: StructureConfig, key: jax.Array) -> Dict[str, Any]:
+    return init_params(key, structure_specs(cfg))
+
+
+# ---------------------------------------------------------------------------
+# IPA
+# ---------------------------------------------------------------------------
+
+
+def invariant_point_attention(
+    p: Dict[str, Any],
+    single: jax.Array,  # [b, R, cs]
+    pair: jax.Array,  # [b, R, R, cz]
+    frames: rigid.Rigid,  # rot [b, R, 3, 3], trans [b, R, 3]
+    mask: jax.Array,  # [b, R]
+    cfg: StructureConfig,
+) -> jax.Array:
+    """Alg. 22: scalar attention + pair bias + SE(3)-invariant point
+    attention; output concatenates scalar values, point values (in the
+    local frame, with norms) and attended pair features."""
+    dtype = single.dtype
+    h, pqk, pv = cfg.num_heads, cfg.point_qk, cfg.point_v
+
+    q_s = jnp.einsum("brc,chd->brhd", single, p["q_scalar"].astype(dtype))
+    kv_s = jnp.einsum("brc,chd->brhd", single, p["kv_scalar"].astype(dtype))
+    k_s, v_s = kv_s[..., : cfg.scalar_qk], kv_s[..., cfg.scalar_qk:]
+
+    q_p_local = jnp.einsum("brc,chpx->brhpx", single, p["q_point"].astype(dtype))
+    kv_p_local = jnp.einsum("brc,chpx->brhpx", single, p["kv_point"].astype(dtype))
+    rot, trans = frames
+    def to_global(pts):
+        return (
+            jnp.einsum("brij,brhpj->brhpi", rot.astype(dtype), pts)
+            + trans.astype(dtype)[:, :, None, None, :]
+        )
+    q_p = to_global(q_p_local)
+    kv_p = to_global(kv_p_local)
+    k_p, v_p = kv_p[..., :pqk, :], kv_p[..., pqk:, :]
+
+    # scalar logits
+    wc = jnp.sqrt(2.0 / (9.0 * pqk))
+    wl = jnp.sqrt(1.0 / 3.0)
+    scalar_logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q_s, k_s, preferred_element_type=jnp.float32
+    ) * (cfg.scalar_qk ** -0.5) * wl
+    # point logits: -gamma * sum_p |q_i - k_j|^2 / 2
+    d2 = jnp.sum(
+        (q_p[:, :, None, :, :, :] - k_p[:, None, :, :, :, :]) ** 2, axis=-1
+    )  # [b, q, k, h, p]
+    gamma = jax.nn.softplus(p["point_weights"]).astype(jnp.float32)
+    point_logits = -0.5 * wc * wl * gamma[None, None, None, :] * jnp.sum(
+        d2.astype(jnp.float32), axis=-1
+    )
+    point_logits = jnp.moveaxis(point_logits, -1, 1)  # [b, h, q, k]
+    pair_logits = jnp.einsum(
+        "bqkc,ch->bhqk", pair.astype(jnp.float32), p["pair_bias"].astype(jnp.float32)
+    ) * wl
+    mask_bias = (1.0 - mask[:, None, None, :].astype(jnp.float32)) * -1e9
+    logits = scalar_logits + point_logits + pair_logits + mask_bias
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)  # [b, h, q, k]
+
+    out_s = jnp.einsum("bhqk,bkhd->bqhd", probs, v_s)
+    out_p_global = jnp.einsum("bhqk,bkhpx->bqhpx", probs, v_p)
+    # back into the query's local frame (invariance)
+    inv_rot = jnp.swapaxes(rot, -1, -2).astype(dtype)
+    out_p = jnp.einsum(
+        "brij,brhpj->brhpi", inv_rot,
+        out_p_global - trans.astype(dtype)[:, :, None, None, :],
+    )
+    out_p_norm = jnp.sqrt(jnp.sum(out_p**2, axis=-1, keepdims=True) + 1e-8)
+    # attended pair features: sum_k a_qk * z[q, k] (Alg. 22 line 11)
+    out_pair = jnp.einsum("bhqk,bqkc->bqhc", probs, pair)
+
+    b, R = single.shape[:2]
+    flat = jnp.concatenate(
+        [
+            out_s.reshape(b, R, -1),
+            out_p.reshape(b, R, -1),
+            out_p_norm.reshape(b, R, -1),
+            out_pair.reshape(b, R, -1),
+        ],
+        axis=-1,
+    )
+    return flat @ p["out"].astype(dtype) + p["out_b"].astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Fold iteration / structure module
+# ---------------------------------------------------------------------------
+
+
+def _transition(p, x, n_layers):
+    for i in range(n_layers):
+        x = x @ p[f"fc{i}"] + p[f"fc{i}_b"]
+        if i < n_layers - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def _torsion_head(p, act, initial_act):
+    """Alg. 27 resnet: 7 torsions as unnormalized (sin, cos)."""
+    x = jax.nn.relu(act) @ p["in1"] + jax.nn.relu(initial_act) @ p["in2"]
+    r = jax.nn.relu(x) @ p["res1"] + p["res1_b"]
+    x = x + (jax.nn.relu(r) @ p["res2"] + p["res2_b"])
+    out = jax.nn.relu(x) @ p["out"] + p["out_b"]
+    return out.reshape(out.shape[:-1] + (7, 2))
+
+
+def fold_iteration(
+    params: Dict[str, Any],
+    act: jax.Array,
+    initial_act: jax.Array,
+    pair: jax.Array,
+    quat: jax.Array,
+    trans: jax.Array,
+    mask: jax.Array,
+    cfg: StructureConfig,
+    key: Optional[jax.Array],
+    train: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One Alg. 20 iteration; returns (act, quat, trans, torsions)."""
+    frames = (rigid.quat_to_rot(quat), trans)
+    act = act + invariant_point_attention(params["ipa"], act, pair, frames, mask, cfg)
+    if train and key is not None and cfg.dropout_rate > 0:
+        keep = 1.0 - cfg.dropout_rate
+        act = jnp.where(
+            jax.random.bernoulli(key, keep, act.shape), act / keep, 0.0
+        ).astype(act.dtype)
+    act = layer_norm(act, params["ipa_ln"]["scale"], params["ipa_ln"]["bias"])
+    act = act + _transition(params["transition"], act, cfg.num_transition_layers)
+    act = layer_norm(
+        act, params["transition_ln"]["scale"], params["transition_ln"]["bias"]
+    )
+    update = act @ params["affine_update"] + params["affine_update_b"]  # [b,R,6]
+    quat, trans = rigid.pre_compose(quat, trans, update)
+    torsions = _torsion_head(params["torsion"], act, initial_act)
+    return act, quat, trans, torsions
+
+
+def backbone_atoms(quat: jax.Array, trans: jax.Array) -> jax.Array:
+    """Decode N/CA/C/CB/O atom positions from backbone frames using ideal
+    local geometry -> [b, R, 5, 3] in atom37 order (N, CA, C, CB, O)."""
+    rot = rigid.quat_to_rot(quat)
+    local = jnp.stack(
+        [
+            jnp.asarray(rc.IDEAL_N),
+            jnp.asarray(rc.IDEAL_CA),
+            jnp.asarray(rc.IDEAL_C),
+            jnp.asarray(rc.IDEAL_CB),
+            jnp.asarray(rc.IDEAL_O),
+        ]
+    )  # [5, 3]
+    return (
+        jnp.einsum("brij,aj->brai", rot, local) + trans[..., None, :]
+    )
+
+
+def structure_module(
+    params: Dict[str, Any],
+    single: jax.Array,  # [b, R, cs] (evoformer single activations)
+    pair: jax.Array,  # [b, R, R, cz]
+    seq_mask: jax.Array,  # [b, R]
+    cfg: StructureConfig,
+    *,
+    ctx: Optional[ShardingCtx] = None,
+    dropout_key: Optional[jax.Array] = None,
+    train: bool = False,
+) -> Dict[str, jax.Array]:
+    """Alg. 20: 8 shared-weight fold iterations from identity frames.
+
+    Returns dict with 'frames' (per-iteration quats/trans for intermediate
+    FAPE), 'final_quat'/'final_trans' (position_scale applied), 'torsions',
+    'backbone_atoms', 'act' (for the pLDDT head)."""
+    single = layer_norm(single, params["single_ln"]["scale"], params["single_ln"]["bias"])
+    pair = layer_norm(pair, params["pair_ln"]["scale"], params["pair_ln"]["bias"])
+    initial_act = single
+    act = single @ params["initial_proj"]
+
+    b, R = single.shape[:2]
+    quat = jnp.broadcast_to(
+        jnp.array([1.0, 0.0, 0.0, 0.0], single.dtype), (b, R, 4)
+    )
+    trans = jnp.zeros((b, R, 3), single.dtype)
+
+    quats, transs, torsions = [], [], None
+    for it in range(cfg.num_iterations):  # shared weights (Alg. 20 line 5)
+        k = (
+            jax.random.fold_in(dropout_key, it)
+            if dropout_key is not None
+            else None
+        )
+        act, quat, trans, torsions = fold_iteration(
+            params, act, initial_act, pair, quat, trans, seq_mask, cfg, k, train
+        )
+        quats.append(quat)
+        transs.append(trans)
+        # stop rotation gradients between iterations (AlphaFold
+        # stop_rot_gradient: stabilizes early training)
+        quat = jax.lax.stop_gradient(quat)
+
+    scale = cfg.position_scale
+    return {
+        "traj_quat": jnp.stack(quats, axis=0),  # [iters, b, R, 4]
+        "traj_trans": jnp.stack(transs, axis=0) * scale,
+        "final_quat": quats[-1],
+        "final_trans": transs[-1] * scale,
+        "torsions": torsions,
+        "backbone_atoms": backbone_atoms(quats[-1], transs[-1] * scale),
+        "act": act,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+
+
+def backbone_fape_loss(
+    traj_quat: jax.Array,  # [iters, b, R, 4]
+    traj_trans: jax.Array,  # [iters, b, R, 3]
+    target_quat: jax.Array,  # [b, R, 4]
+    target_trans: jax.Array,  # [b, R, 3]
+    mask: jax.Array,  # [b, R]
+    length_scale: float = 10.0,
+    clamp_distance: float = 10.0,
+) -> jax.Array:
+    """Averaged-over-iterations backbone FAPE (Alg. 28 applied to CA
+    points viewed from every backbone frame), masked."""
+    t_rot = rigid.quat_to_rot(target_quat)
+
+    def one(args):
+        q, t = args
+        rot = rigid.quat_to_rot(q)
+        # local views: [b, F, P, 3]
+        def local(rot_, tr_):
+            return rigid.rot_mul_vec(
+                jnp.swapaxes(rot_, -1, -2)[..., :, None, :, :],
+                tr_[..., None, :, :] - tr_[..., :, None, :],
+            )
+
+        d = jnp.sqrt(
+            jnp.sum((local(rot, t) - local(t_rot, target_trans)) ** 2, axis=-1)
+            + 1e-8
+        )
+        m2 = mask[..., :, None] * mask[..., None, :]
+        d = jnp.clip(d, 0.0, clamp_distance) * m2
+        return jnp.sum(d) / (length_scale * (jnp.sum(m2) + 1e-8))
+
+    losses = jax.lax.map(one, (traj_quat, traj_trans))
+    return jnp.mean(losses)
+
+
+def torsion_angle_loss(
+    pred: jax.Array,  # [b, R, 7, 2] unnormalized sin/cos
+    target: jax.Array,  # [b, R, 7, 2]
+    alt_target: jax.Array,  # [b, R, 7, 2]
+    mask: jax.Array,  # [b, R, 7]
+) -> jax.Array:
+    """Alg. 27 supervised chi loss: min over the pi-periodic alternative,
+    plus the unit-norm regularizer."""
+    norm = jnp.sqrt(jnp.sum(pred**2, axis=-1, keepdims=True) + 1e-8)
+    pred_unit = pred / norm
+    sq = jnp.sum((pred_unit - target) ** 2, axis=-1)
+    sq_alt = jnp.sum((pred_unit - alt_target) ** 2, axis=-1)
+    chi = jnp.minimum(sq, sq_alt) * mask
+    l_chi = jnp.sum(chi) / (jnp.sum(mask) + 1e-8)
+    l_norm = jnp.sum(jnp.abs(norm[..., 0] - 1.0) * mask) / (jnp.sum(mask) + 1e-8)
+    return l_chi + 0.02 * l_norm
