@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from paddlefleetx_tpu.models.gpt.config import GPTConfig
-from paddlefleetx_tpu.models.gpt.model import layer_norm
+from paddlefleetx_tpu.models.gpt.model import ShardingCtx, _constrain, layer_norm
 from paddlefleetx_tpu.ops.attention import xla_attention
 from paddlefleetx_tpu.ops.sampling import sample_logits
 
@@ -50,10 +50,14 @@ def _layer_with_cache(
     v_cache: jax.Array,
     pos: jax.Array,
     cfg: GPTConfig,
+    ctx: Optional[ShardingCtx] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder layer over x [b, t, h] writing K/V at offset ``pos``.
 
     Attends over cache[:pos+t] (left-padded garbage masked by position).
+    Under TP serving (reference GPTForGenerationHybrid hybrid_model.py:1209)
+    the qkv/cache/attention stay ``heads``-sharded over the model axis and
+    the output projection row-psum is inserted by GSPMD.
     """
     dtype = x.dtype
     b, t, h = x.shape
@@ -63,9 +67,12 @@ def _layer_with_cache(
     qkv = jnp.einsum("bsh,htnd->bstnd", y, p["attn"]["qkv_kernel"].astype(dtype))
     qkv = qkv + p["attn"]["qkv_bias"].astype(dtype)[None, None]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = _constrain(ctx, q, ("batch", None, "heads", "kv"))
 
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    k_cache = _constrain(ctx, k_cache, ("batch", None, "heads", "kv"))
+    v_cache = _constrain(ctx, v_cache, ("batch", None, "heads", "kv"))
 
     # bias: query i (global pos+i) attends keys j <= pos+i, j < pos+t valid
     q_pos = pos + jnp.arange(t)[:, None]
@@ -92,6 +99,7 @@ def forward_cached(
     cache: KVCache,
     pos: jax.Array,
     cfg: GPTConfig,
+    ctx: Optional[ShardingCtx] = None,
 ) -> Tuple[jax.Array, KVCache]:
     """tokens [b, t] at positions [pos, pos+t) -> (logits [b, t, v], cache)."""
     dtype = jnp.dtype(cfg.dtype)
@@ -100,16 +108,17 @@ def forward_cached(
     pe = params["embeddings"]["position"].astype(dtype)
     positions = pos + jnp.arange(t)
     x = word[tokens] + pe[positions][None, :, :]
+    x = _constrain(ctx, x, ("batch", None, "embed"))
 
     def body(x, inp):
         p_l, kc, vc = inp
-        x, kc, vc = _layer_with_cache(p_l, x, kc, vc, pos, cfg)
+        x, kc, vc = _layer_with_cache(p_l, x, kc, vc, pos, cfg, ctx)
         return x, (kc, vc)
 
     x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
     logits = jnp.einsum("bsh,vh->bsv", x, word)
-    return logits, KVCache(ks, vs)
+    return _constrain(ctx, logits, ("batch", None, "vocab")), KVCache(ks, vs)
 
 
 # ---------------------------------------------------------------------------
@@ -139,6 +148,30 @@ def apply_min_length(logits, cur_len, min_len: int, eos_token_id: int):
     )
 
 
+def apply_forced_token(logits, step, force_at_step: int, token_id: int):
+    """Force a specific token at a given decode step (reference
+    ForcedBOSTokenLogitsProcessor / ForcedEOSTokenLogitsProcessor)."""
+    if token_id < 0:
+        return logits
+    forced = jnp.full_like(logits, -1e10).at[..., token_id].set(0.0)
+    return jnp.where(step == force_at_step, forced, logits)
+
+
+def apply_hamming_diversity(logits, current_tokens, group_start: int, penalty: float):
+    """Penalize tokens already chosen by EARLIER beam groups at this step
+    (reference HammingDiversityLogitsProcessor): logits [gb, v];
+    current_tokens [gb] holds this step's choices for groups processed so
+    far (entries >= group_start are not yet decided and are masked off)."""
+    if penalty == 0.0:
+        return logits
+    vocab = logits.shape[-1]
+    decided = jnp.arange(current_tokens.shape[0]) < group_start
+    counts = jnp.zeros((vocab,), logits.dtype).at[current_tokens].add(
+        decided.astype(logits.dtype)
+    )
+    return logits - penalty * counts[None, :]
+
+
 # ---------------------------------------------------------------------------
 # Generation loop
 # ---------------------------------------------------------------------------
@@ -150,13 +183,22 @@ class GenerationConfig:
 
     max_dec_len: int = 64
     min_dec_len: int = 1
-    decode_strategy: str = "sampling"  # sampling | greedy_search
+    decode_strategy: str = "sampling"  # sampling | greedy_search | beam_search
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
     repetition_penalty: float = 1.0
     eos_token_id: int = 50256
     pad_token_id: int = 0
+    # beam search (reference BeamSearchScorer + processor.py)
+    num_beams: int = 4
+    length_penalty: float = 1.0
+    # diverse (group) beam search: HammingDiversityLogitsProcessor
+    num_beam_groups: int = 1
+    diversity_penalty: float = 0.0
+    # ForcedBOS/ForcedEOS processors (-1 = disabled)
+    forced_bos_token_id: int = -1
+    forced_eos_token_id: int = -1
 
 
 def generate(
@@ -165,9 +207,14 @@ def generate(
     cfg: GPTConfig,
     gen: GenerationConfig,
     key: Optional[jax.Array] = None,
+    ctx: Optional[ShardingCtx] = None,
 ) -> jax.Array:
     """input_ids [b, prompt_len] (right-aligned, no padding) ->
-    generated ids [b, max_dec_len] (eos/pad-filled after finish)."""
+    generated ids [b, max_dec_len] (eos/pad-filled after finish).
+
+    Pass ``ctx`` to serve on a mesh: the KV cache and attention stay
+    heads-sharded over the model axis (TP serving parity with the
+    reference's GPTForGenerationHybrid, hybrid_model.py:1209)."""
     if cfg.num_experts > 1:
         raise NotImplementedError("KV-cache generation for MoE models unsupported")
     b, prompt_len = input_ids.shape
@@ -179,6 +226,8 @@ def generate(
         )
     if key is None:
         key = jax.random.key(0)
+    if gen.decode_strategy == "beam_search":
+        return beam_search(params, input_ids, cfg, gen, ctx=ctx)
 
     cache = init_cache(cfg, b, max_len)
     vocab = cfg.vocab_size
@@ -187,7 +236,7 @@ def generate(
     ].add(1)
 
     # prefill: cache K/V for the prompt; its last-row logits seed the loop
-    logits, cache = forward_cached(params, input_ids, cache, jnp.int32(0), cfg)
+    logits, cache = forward_cached(params, input_ids, cache, jnp.int32(0), cfg, ctx)
     last_logits = logits[:, -1, :].astype(jnp.float32)
 
     class Carry(NamedTuple):
@@ -205,6 +254,10 @@ def generate(
         logits = apply_repetition_penalty(
             logits, carry.token_counts, gen.repetition_penalty
         )
+        logits = apply_forced_token(logits, i, 0, gen.forced_bos_token_id)
+        logits = apply_forced_token(
+            logits, i, gen.max_dec_len - 1, gen.forced_eos_token_id
+        )
         key, sub = jax.random.split(carry.key)
         if gen.decode_strategy == "greedy_search":
             nxt = jnp.argmax(logits, axis=-1)
@@ -216,7 +269,7 @@ def generate(
         unfinished = carry.unfinished & (nxt != gen.eos_token_id)
         counts = carry.token_counts.at[jnp.arange(b), nxt].add(1)
         new_logits, cache = forward_cached(
-            params, nxt[:, None], carry.cache, carry.pos, cfg
+            params, nxt[:, None], carry.cache, carry.pos, cfg, ctx
         )
         new_carry = Carry(
             cache=cache,
@@ -238,3 +291,171 @@ def generate(
     )
     carry, tokens = jax.lax.scan(step, carry0, jnp.arange(gen.max_dec_len))
     return tokens.T  # [b, max_dec_len]
+
+
+# ---------------------------------------------------------------------------
+# Beam search (reference single_model.py:1190-1320 beam strategy +
+# BeamSearchScorer; diverse groups via HammingDiversityLogitsProcessor)
+# ---------------------------------------------------------------------------
+
+
+def _length_penalty(length, alpha: float):
+    return jnp.power(length.astype(jnp.float32), alpha)
+
+
+def beam_search(
+    params: Dict[str, Any],
+    input_ids: jax.Array,
+    cfg: GPTConfig,
+    gen: GenerationConfig,
+    ctx: Optional[ShardingCtx] = None,
+) -> jax.Array:
+    """Static-shape beam search: [b, prompt_len] -> [b, max_dec_len].
+
+    K = num_beams alive beams per prompt plus a K-slot finished pool;
+    each step takes the top 2*Kg candidates per beam group (Kg = K /
+    num_beam_groups), routes EOS continuations into the finished pool with
+    length penalty, keeps the best Kg non-EOS continuations alive, and
+    reorders the KV cache by parent beam.  ``diversity_penalty`` applies
+    the Hamming penalty against earlier groups' same-step choices.
+    Repetition penalty is not applied on the beam path (matching the
+    reference beam strategy's processor set)."""
+    b, prompt_len = input_ids.shape
+    K, G = gen.num_beams, gen.num_beam_groups
+    if K % G:
+        raise ValueError(f"num_beams {K} not divisible by num_beam_groups {G}")
+    Kg = K // G
+    vocab = cfg.vocab_size
+    max_len = prompt_len + gen.max_dec_len
+    if max_len > cfg.max_position_embeddings:
+        raise ValueError("prompt + max_dec_len exceeds max_position_embeddings")
+
+    # prefill ONCE per prompt, then repeat the cache/logits K-fold (all
+    # beams share the prompt; re-running the forward K times would be
+    # K x the prefill FLOPs for identical results)
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = forward_cached(params, input_ids, cache, jnp.int32(0), cfg, ctx)
+    cache = KVCache(
+        jnp.repeat(cache.k, K, axis=1), jnp.repeat(cache.v, K, axis=1)
+    )
+    logits0 = jnp.repeat(logits[:, -1, :].astype(jnp.float32), K, axis=0)
+
+    NEG = jnp.float32(-1e9)
+    # only each group's first beam is live at step 0 (avoids duplicates)
+    init_scores = jnp.where(
+        (jnp.arange(K) % Kg) == 0, 0.0, NEG
+    )[None].repeat(b, 0)  # [b, K]
+
+    class Beams(NamedTuple):
+        cache: KVCache
+        logits: jax.Array  # [b*K, v]
+        scores: jax.Array  # [b, K] cumulative alive logprobs
+        seqs: jax.Array  # [b, K, max_dec]
+        fin_scores: jax.Array  # [b, K]
+        fin_seqs: jax.Array  # [b, K, max_dec]
+        pos: jax.Array
+
+    def step(st: Beams, i):
+        logp = jax.nn.log_softmax(st.logits, axis=-1).reshape(b, K, vocab)
+        logp = apply_min_length(
+            logp.reshape(b * K, vocab), jnp.full((b * K,), i),
+            gen.min_dec_len, gen.eos_token_id,
+        ).reshape(b, K, vocab)
+        logp = apply_forced_token(
+            logp.reshape(b * K, vocab), i, 0, gen.forced_bos_token_id
+        ).reshape(b, K, vocab)
+        logp = apply_forced_token(
+            logp.reshape(b * K, vocab), i, gen.max_dec_len - 1,
+            gen.forced_eos_token_id,
+        ).reshape(b, K, vocab)
+
+        new_scores = st.scores
+        fin_scores, fin_seqs = st.fin_scores, st.fin_seqs
+        chosen_tok = jnp.zeros((b, K), jnp.int32)
+        chosen_parent = jnp.zeros((b, K), jnp.int32)
+        step_tokens = jnp.full((b, K), -1, jnp.int32)  # for Hamming penalty
+
+        for g in range(G):  # static, G small
+            sl = slice(g * Kg, (g + 1) * Kg)
+            glogp = logp[:, sl]  # [b, Kg, v]
+            if gen.diversity_penalty > 0.0 and g > 0:
+                glogp = jax.vmap(
+                    lambda lg, cur: apply_hamming_diversity(
+                        lg, cur, g * Kg, gen.diversity_penalty
+                    )
+                )(glogp, step_tokens)
+            cand = (st.scores[:, sl, None] + glogp).reshape(b, Kg * vocab)
+            top_s, top_i = jax.lax.top_k(cand, 2 * Kg)  # [b, 2Kg]
+            tok = top_i % vocab
+            parent = top_i // vocab + g * Kg  # flat beam index
+            is_eos = tok == gen.eos_token_id
+
+            # finished pool: EOS continuations scored with length penalty
+            f_cand = jnp.where(is_eos, top_s / _length_penalty(
+                jnp.full((b, 2 * Kg), i + 1), gen.length_penalty
+            ), NEG)
+            # candidate finished sequences = parent's seq + eos at i
+            parent_seqs = jnp.take_along_axis(
+                st.seqs, parent[..., None], axis=1
+            )  # [b, 2Kg, max_dec]
+            f_seqs = jax.vmap(
+                lambda ps, tk: ps.at[:, i].set(tk)
+            )(parent_seqs, tok)
+            all_f_scores = jnp.concatenate([fin_scores, f_cand], axis=1)
+            all_f_seqs = jnp.concatenate([fin_seqs, f_seqs], axis=1)
+            keep_s, keep_i = jax.lax.top_k(all_f_scores, K)
+            fin_scores = keep_s
+            fin_seqs = jnp.take_along_axis(all_f_seqs, keep_i[..., None], axis=1)
+
+            # alive: best Kg non-EOS continuations
+            alive_s = jnp.where(is_eos, NEG, top_s)
+            a_s, a_i = jax.lax.top_k(alive_s, Kg)  # indices into 2Kg
+            a_tok = jnp.take_along_axis(tok, a_i, axis=1)
+            a_parent = jnp.take_along_axis(parent, a_i, axis=1)
+            new_scores = new_scores.at[:, sl].set(a_s)
+            chosen_tok = chosen_tok.at[:, sl].set(a_tok)
+            chosen_parent = chosen_parent.at[:, sl].set(a_parent)
+            step_tokens = step_tokens.at[:, sl].set(a_tok)
+
+        # reorder sequences/caches by parent beam, then append tokens
+        new_seqs = jnp.take_along_axis(st.seqs, chosen_parent[..., None], axis=1)
+        new_seqs = jax.vmap(lambda s, t: s.at[:, i].set(t))(new_seqs, chosen_tok)
+        flat_parent = (
+            jnp.arange(b)[:, None] * K + chosen_parent
+        ).reshape(-1)  # [b*K]
+        cache = KVCache(
+            jnp.take(st.cache.k, flat_parent, axis=1),
+            jnp.take(st.cache.v, flat_parent, axis=1),
+        )
+        new_logits, cache = forward_cached(
+            params, chosen_tok.reshape(b * K, 1), cache, st.pos, cfg, ctx
+        )
+        return Beams(
+            cache=cache,
+            logits=new_logits[:, -1, :].astype(jnp.float32),
+            scores=new_scores,
+            seqs=new_seqs,
+            fin_scores=fin_scores,
+            fin_seqs=fin_seqs,
+            pos=st.pos + 1,
+        ), None
+
+    st0 = Beams(
+        cache=cache,
+        logits=logits0,
+        scores=init_scores,
+        seqs=jnp.full((b, K, gen.max_dec_len), gen.pad_token_id, jnp.int32),
+        fin_scores=jnp.full((b, K), NEG),
+        fin_seqs=jnp.full((b, K, gen.max_dec_len), gen.pad_token_id, jnp.int32),
+        pos=jnp.int32(prompt_len),
+    )
+    st, _ = jax.lax.scan(step, st0, jnp.arange(gen.max_dec_len))
+
+    # merge still-alive beams (scored at full length) into the pool
+    alive_final = st.scores / _length_penalty(
+        jnp.full((b, K), gen.max_dec_len), gen.length_penalty
+    )
+    all_scores = jnp.concatenate([st.fin_scores, alive_final], axis=1)
+    all_seqs = jnp.concatenate([st.fin_seqs, st.seqs], axis=1)
+    best = jnp.argmax(all_scores, axis=1)
+    return jnp.take_along_axis(all_seqs, best[:, None, None], axis=1)[:, 0]

@@ -6,6 +6,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
+from paddlefleetx_tpu.utils.device import apply_platform_env
+
+apply_platform_env()  # PFX_PLATFORM=cpu etc., before backend init
+
 import jax
 
 from paddlefleetx_tpu.core.module import build_module
@@ -19,9 +23,8 @@ from paddlefleetx_tpu.utils.log import logger
 def main(argv=None):
     args = parse_args(argv)
     cfg = get_config(args.config, overrides=args.override)
-    init_dist_env(cfg)
+    mesh = init_dist_env(cfg)
     module = build_module(cfg)
-    params = module.init_params(get_seed_tracker().params_key())
 
     gen_cfg = cfg.get("Generation", {})
     gen = GenerationConfig(
@@ -34,7 +37,28 @@ def main(argv=None):
         repetition_penalty=float(gen_cfg.get("repetition_penalty", 1.0)),
         eos_token_id=int(gen_cfg.get("eos_token_id", 50256)),
         pad_token_id=int(gen_cfg.get("pad_token_id", 0)),
+        num_beams=int(gen_cfg.get("num_beams", 4)),
+        length_penalty=float(gen_cfg.get("length_penalty", 1.0)),
+        num_beam_groups=int(gen_cfg.get("num_beam_groups", 1)),
+        diversity_penalty=float(gen_cfg.get("diversity_penalty", 0.0)),
+        forced_bos_token_id=int(gen_cfg.get("forced_bos_token_id", -1)),
+        forced_eos_token_id=int(gen_cfg.get("forced_eos_token_id", -1)),
     )
+
+    # mesh serving: params sharded by the logical rules, KV cache
+    # heads-sharded over `model` (TP serving, VERDICT r1 item 5)
+    from paddlefleetx_tpu.models.gpt.model import ShardingCtx
+    from paddlefleetx_tpu.parallel.sharding import (
+        make_rules,
+        tree_logical_to_sharding,
+    )
+
+    rules = make_rules(mesh=mesh)
+    ctx = ShardingCtx(mesh, rules) if mesh.size > 1 else None
+    params = module.init_params(get_seed_tracker().params_key())
+    if ctx is not None:
+        shardings = tree_logical_to_sharding(module.logical_axes(), mesh, rules)
+        params = jax.device_put(params, shardings)
 
     tokenizer_dir = gen_cfg.get("tokenizer_dir")
     prompt_text = gen_cfg.get("prompt", "Hi there")
@@ -47,7 +71,10 @@ def main(argv=None):
         tok = None
         prompt = jax.numpy.asarray([[1, 2, 3, 4]])
 
-    out = generate(params, prompt, module.config, gen, key=jax.random.key(0))
+    with mesh:
+        out = generate(
+            params, prompt, module.config, gen, key=jax.random.key(0), ctx=ctx
+        )
     ids = out[0].tolist()
     logger.info(f"prompt: {prompt_text!r}")
     logger.info(f"generated ids: {ids}")

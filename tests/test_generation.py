@@ -113,3 +113,173 @@ def test_sample_top_p_distribution():
     assert set(vals.tolist()) <= {0, 1}  # nucleus = {0.6, 0.25}
     frac0 = counts[vals.tolist().index(0)] / 2000
     assert abs(frac0 - 0.6 / 0.85) < 0.05
+
+
+# ---------------------------------------------------------------------------
+# Beam search + processors + TP serving
+# ---------------------------------------------------------------------------
+
+
+def test_beam_search_shapes_and_determinism():
+    params = gpt.init(TINY, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, TINY.vocab_size)
+    gen = GenerationConfig(
+        max_dec_len=8, decode_strategy="beam_search", num_beams=4, eos_token_id=96
+    )
+    out1 = generate(params, prompt, TINY, gen)
+    out2 = generate(params, prompt, TINY, gen)
+    assert out1.shape == (2, 8)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_beam1_matches_greedy_prefix():
+    """num_beams=1 beam search follows the same argmax path as greedy while
+    EOS is suppressed (min_dec_len)."""
+    params = gpt.init(TINY, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(2), (2, 6), 0, TINY.vocab_size)
+    n = 8
+    g_greedy = GenerationConfig(
+        max_dec_len=n, min_dec_len=n, decode_strategy="greedy_search",
+        eos_token_id=96,
+    )
+    g_beam = GenerationConfig(
+        max_dec_len=n, min_dec_len=n, decode_strategy="beam_search",
+        num_beams=1, eos_token_id=96,
+    )
+    a = np.asarray(generate(params, prompt, TINY, g_greedy))
+    b = np.asarray(generate(params, prompt, TINY, g_beam))
+    np.testing.assert_array_equal(a[:, : n - 1], b[:, : n - 1])
+
+
+def test_beam_score_improves_on_greedy():
+    """Beam search's chosen sequence log-prob >= the greedy path's.
+
+    NB: beam search does not guarantee this in general (the greedy prefix
+    can be evicted from the top-K mid-decode); the fixed seed/model here is
+    known to keep the property — if a numeric change flips it, check the
+    eviction explanation before suspecting the beam code."""
+    params = gpt.init(TINY, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(3), (1, 6), 0, TINY.vocab_size)
+    n = 6
+
+    def score(seq):
+        """Sum log p of continuation `seq` after `prompt` (teacher forced)."""
+        full = jnp.concatenate([prompt, seq[None]], axis=1)
+        logits = gpt.forward(params, full, TINY, train=False)
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+        cont = lp[0, prompt.shape[1] - 1 :]
+        return float(
+            sum(cont[t, int(seq[t])] for t in range(n))
+        )
+
+    g_greedy = GenerationConfig(
+        max_dec_len=n, min_dec_len=n, decode_strategy="greedy_search", eos_token_id=96
+    )
+    g_beam = GenerationConfig(
+        max_dec_len=n, min_dec_len=n, decode_strategy="beam_search",
+        num_beams=4, eos_token_id=96,
+    )
+    s_greedy = score(np.asarray(generate(params, prompt, TINY, g_greedy))[0])
+    s_beam = score(np.asarray(generate(params, prompt, TINY, g_beam))[0])
+    assert s_beam >= s_greedy - 1e-4
+
+
+def test_forced_bos_eos_tokens():
+    params = gpt.init(TINY, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, TINY.vocab_size)
+    gen = GenerationConfig(
+        max_dec_len=6, decode_strategy="greedy_search", eos_token_id=-1,
+        forced_bos_token_id=11, forced_eos_token_id=13,
+    )
+    out = np.asarray(generate(params, prompt, TINY, gen))
+    np.testing.assert_array_equal(out[:, 0], 11)
+    np.testing.assert_array_equal(out[:, -1], 13)
+
+
+def test_hamming_diversity_penalizes_decided_tokens():
+    """Tokens chosen by earlier groups this step must be penalized out of
+    the argmax for the current group (HammingDiversityLogitsProcessor)."""
+    from paddlefleetx_tpu.models.gpt.generation import apply_hamming_diversity
+
+    vocab = 16
+    logits = jnp.zeros((2, vocab)).at[:, 5].set(1.0).at[:, 7].set(0.9)
+    # groups 0..1 (beams 0,1) already chose token 5 this step; beam 2+ TBD
+    current = jnp.array([5, 5, -1, -1], jnp.int32)
+    out = apply_hamming_diversity(logits, current, group_start=2, penalty=10.0)
+    # token 5 penalized twice -> argmax moves to 7
+    assert int(jnp.argmax(out[0])) == 7
+    # penalty counts only DECIDED beams (indices < group_start)
+    np.testing.assert_allclose(float(logits[0, 5]) - float(out[0, 5]), 20.0)
+    # undecided sentinel (-1) contributes nothing
+    np.testing.assert_allclose(np.asarray(out[:, :vocab - 1][:, 6:]),
+                               np.asarray(logits[:, 6:vocab - 1]), atol=1e-6)
+
+
+def test_diverse_beam_search_runs_e2e():
+    params = gpt.init(TINY, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(5), (1, 4), 0, TINY.vocab_size)
+    n = 4
+    gen = GenerationConfig(
+        max_dec_len=n, min_dec_len=n, decode_strategy="beam_search",
+        num_beams=4, num_beam_groups=4, diversity_penalty=1.5, eos_token_id=96,
+    )
+    out = np.asarray(generate(params, prompt, TINY, gen))
+    assert out.shape == (1, n)
+
+
+TINY_TP = GPTConfig(
+    vocab_size=96,  # divisible by mp=2 (the vocab axis is model-sharded)
+    hidden_size=64,
+    num_layers=2,
+    num_attention_heads=8,
+    max_position_embeddings=64,
+    hidden_dropout_prob=0.0,
+    attention_probs_dropout_prob=0.0,
+    dtype="float32",
+)
+
+
+def test_tp_generation_parity(devices8):
+    """generate() on a dp2 x mp2 mesh (heads-sharded KV cache) must equal
+    the single-device greedy rollout (VERDICT r1 item 5)."""
+    from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh
+    from paddlefleetx_tpu.parallel.sharding import make_rules, tree_logical_to_sharding
+
+    params = gpt.init(TINY_TP, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(6), (2, 8), 0, TINY_TP.vocab_size)
+    gen = GenerationConfig(max_dec_len=8, decode_strategy="greedy_search", eos_token_id=-1)
+    ref = np.asarray(generate(params, prompt, TINY_TP, gen))
+
+    mesh = build_mesh(MeshConfig(dp_degree=4, mp_degree=2), devices8)
+    rules = make_rules(mesh=mesh)
+    ctx = gpt.ShardingCtx(mesh, rules)
+    shardings = tree_logical_to_sharding(gpt.gpt_logical_axes(TINY_TP), mesh, rules)
+    p_sh = jax.device_put(params, shardings)
+    with mesh:
+        got = np.asarray(
+            jax.jit(lambda p, x: generate(p, x, TINY_TP, gen, ctx=ctx))(p_sh, prompt)
+        )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_tp_beam_search_parity(devices8):
+    """Beam search on a TP mesh equals single-device beam search."""
+    from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh
+    from paddlefleetx_tpu.parallel.sharding import make_rules, tree_logical_to_sharding
+
+    params = gpt.init(TINY_TP, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(7), (1, 6), 0, TINY_TP.vocab_size)
+    gen = GenerationConfig(
+        max_dec_len=6, decode_strategy="beam_search", num_beams=4, eos_token_id=96
+    )
+    ref = np.asarray(generate(params, prompt, TINY_TP, gen))
+    mesh = build_mesh(MeshConfig(dp_degree=4, mp_degree=2), devices8)
+    rules = make_rules(mesh=mesh)
+    ctx = gpt.ShardingCtx(mesh, rules)
+    shardings = tree_logical_to_sharding(gpt.gpt_logical_axes(TINY_TP), mesh, rules)
+    p_sh = jax.device_put(params, shardings)
+    with mesh:
+        got = np.asarray(
+            jax.jit(lambda p, x: generate(p, x, TINY_TP, gen, ctx=ctx))(p_sh, prompt)
+        )
+    np.testing.assert_array_equal(got, ref)
