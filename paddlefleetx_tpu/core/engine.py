@@ -69,19 +69,28 @@ jax.tree_util.register_pytree_node(
 )
 
 
-def opt_state_shardings(opt_state_shapes, params, param_shardings, mesh: Mesh):
+def opt_state_shardings(
+    opt_state_shapes, params, moment_shardings, mesh: Mesh, memory_kind=None
+):
     """Sharding tree for an optax state: subtrees structurally identical to
-    the param tree (mu/nu/...) inherit param shardings; everything else
+    the param tree (mu/nu/...) get ``moment_shardings``; everything else
     (step counts, empty states) is replicated.
 
-    This is the ZeRO move (reference group_sharded_parallel 'os_g'): with
-    `fsdp` in the param rules, optimizer moments shard the same way."""
+    This is the ZeRO move (reference group_sharded_parallel 'os_g',
+    eager_engine.py:281-307): the moments shard over `fsdp` from stage 1
+    on, independently of whether the params do (stage 3).  With
+    ``memory_kind='pinned_host'`` the moments live in host memory — the
+    reference's ``offload=True`` option."""
     params_def = jax.tree.structure(params)
     replicated = NamedSharding(mesh, P())
+    if memory_kind is not None:
+        moment_shardings = jax.tree.map(
+            lambda s: s.with_memory_kind(memory_kind), moment_shardings
+        )
 
     def rec(node):
         if jax.tree.structure(node) == params_def:
-            return param_shardings
+            return moment_shardings
         if isinstance(node, tuple) and hasattr(node, "_fields"):  # namedtuple
             return type(node)(*[rec(c) for c in node])
         if isinstance(node, (list, tuple)):
@@ -91,6 +100,18 @@ def opt_state_shardings(opt_state_shapes, params, param_shardings, mesh: Mesh):
         return jax.tree.map(lambda _: replicated, node)
 
     return rec(opt_state_shapes)
+
+
+def _host_offload_supported(mesh: Mesh) -> bool:
+    """Probe whether this backend can COMPILE pinned_host placements over
+    the mesh (having the memory space is not enough: XLA CPU's SPMD
+    partitioner rejects the placement custom-calls that TPU accepts)."""
+    try:
+        host = NamedSharding(mesh, P(), memory_kind="pinned_host")
+        jax.jit(lambda x: x + 1.0, out_shardings=host)(jnp.zeros(()))
+        return True
+    except Exception:
+        return False
 
 
 class Engine:
@@ -112,13 +133,32 @@ class Engine:
         self.global_batch_size = int(cfg.Global.global_batch_size)
 
         dist = cfg.get("Distributed", {})
-        sharding_stage = int(dist.get("sharding", {}).get("sharding_stage", 0))
+        sharding_cfg = dist.get("sharding", {})
+        sharding_degree = int(sharding_cfg.get("sharding_degree", 1))
+        # default stage when a degree is configured but no stage: ZeRO-1
+        self.sharding_stage = int(
+            sharding_cfg.get("sharding_stage", 1 if sharding_degree > 1 else 0)
+        )
+        self.sharding_offload = bool(sharding_cfg.get("offload", False))
+        num_experts = int(
+            getattr(getattr(module, "config", None), "num_experts", 0) or 0
+        )
+        # ZeRO stage semantics (reference group_sharded_parallel
+        # eager_engine.py:281-307): stage 1 = optimizer state sharded,
+        # stage 2 = +gradients (reduce-scatter constraint in the train
+        # step), stage 3 = +parameters.  Param rules use `fsdp` only at
+        # stage 3; the moment rules use it from stage 1 on.
         self.rules = make_rules(
-            fsdp_enabled=sharding_stage >= 2
-            or int(dist.get("sharding", {}).get("sharding_degree", 1)) > 1,
+            fsdp_enabled=self.sharding_stage >= 3,
             sequence_parallel=bool(dist.get("sequence_parallel", False)),
             mesh=mesh,
-            num_experts=int(getattr(getattr(module, "config", None), "num_experts", 0) or 0),
+            num_experts=num_experts,
+        )
+        self.moment_rules = make_rules(
+            fsdp_enabled=self.sharding_stage >= 1,
+            sequence_parallel=bool(dist.get("sequence_parallel", False)),
+            mesh=mesh,
+            num_experts=num_experts,
         )
         pp_degree = int(dist.get("pp_degree", 1))
         pipeline = None
@@ -163,9 +203,30 @@ class Engine:
 
         params_shapes = jax.eval_shape(self.module.init_params, key)
         opt_shapes = jax.eval_shape(self.tx.init, params_shapes)
-        self.opt_shardings = opt_state_shardings(
-            opt_shapes, params_shapes, self.param_shardings, self.mesh
+        moment_shardings = tree_logical_to_sharding(
+            self.module.logical_axes(), self.mesh, self.moment_rules
         )
+        self.offload_active = self.sharding_offload and _host_offload_supported(
+            self.mesh
+        )
+        if self.sharding_offload and not self.offload_active:
+            logger.warning(
+                "sharding.offload requested but this backend cannot compile "
+                "pinned_host placements; optimizer state stays on device"
+            )
+        # device-memory shardings drive compute; the host variants are where
+        # the state LIVES between steps when offload is active
+        self._opt_shardings_device = opt_state_shardings(
+            opt_shapes, params_shapes, moment_shardings, self.mesh, None
+        )
+        self.opt_shardings = (
+            opt_state_shardings(
+                opt_shapes, params_shapes, moment_shardings, self.mesh, "pinned_host"
+            )
+            if self.offload_active
+            else self._opt_shardings_device
+        )
+        self._grad_shardings = moment_shardings if self.sharding_stage >= 2 else None
 
         has_extra = getattr(self.module, "has_extra_state", False)
         if has_extra:
@@ -181,6 +242,8 @@ class Engine:
             out_shardings=TrainState(
                 step=self.replicated,
                 params=self.param_shardings,
+                # host-placed directly when offload is active: materializing
+                # on device first would OOM exactly the models offload serves
                 opt_state=self.opt_shardings,
                 extra=self.extra_shardings,
             ),
@@ -212,6 +275,10 @@ class Engine:
         module, ctx, tx = self.module, self.ctx, self.tx
         accum = self.accumulate_steps
         has_extra = getattr(module, "has_extra_state", False)
+        grad_shardings = self._grad_shardings
+        offload = self.offload_active
+        opt_dev_shardings = self._opt_shardings_device
+        opt_host_shardings = self.opt_shardings
 
         @functools.partial(
             jax.jit,
@@ -261,18 +328,33 @@ class Engine:
                     run_loss, has_aux=True
                 )(state.params, batch, state.extra)
 
+            if grad_shardings is not None:
+                # ZeRO-2: the dp grad-sum lands fsdp-sharded (XLA lowers
+                # the psum + constraint to a reduce-scatter); the sharded
+                # optimizer update then all-gathers only the param updates
+                grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+
             gnorm = optax.global_norm(grads)
             finite = jnp.isfinite(gnorm)
             safe = jax.tree.map(lambda g: jnp.where(finite, g, 0.0), grads)
-            updates, new_opt = tx.update(safe, state.opt_state, state.params)
+            # host offload: stage the moments onto device for the update,
+            # park the new state back in pinned host memory afterwards
+            opt_in = (
+                jax.device_put(state.opt_state, opt_dev_shardings)
+                if offload
+                else state.opt_state
+            )
+            updates, new_opt = tx.update(safe, opt_in, state.params)
             new_params = optax.apply_updates(state.params, updates)
             # skip non-finite steps in lockstep (reference found_inf contract)
             new_params = jax.tree.map(
                 lambda n, o: jnp.where(finite, n, o), new_params, state.params
             )
             new_opt = jax.tree.map(
-                lambda n, o: jnp.where(finite, n, o), new_opt, state.opt_state
+                lambda n, o: jnp.where(finite, n, o), new_opt, opt_in
             )
+            if offload:
+                new_opt = jax.device_put(new_opt, opt_host_shardings)
             # extra (queue/BN/EMA) must revert too: a NaN forward would
             # otherwise poison enqueued keys / running stats permanently
             new_extra = jax.tree.map(

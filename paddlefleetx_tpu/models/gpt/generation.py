@@ -326,9 +326,8 @@ def beam_search(
         raise ValueError(f"num_beams {K} not divisible by num_beam_groups {G}")
     Kg = K // G
     vocab = cfg.vocab_size
+    # length validated by generate() before dispatch
     max_len = prompt_len + gen.max_dec_len
-    if max_len > cfg.max_position_embeddings:
-        raise ValueError("prompt + max_dec_len exceeds max_position_embeddings")
 
     # prefill ONCE per prompt, then repeat the cache/logits K-fold (all
     # beams share the prompt; re-running the forward K times would be

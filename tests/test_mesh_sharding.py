@@ -89,3 +89,127 @@ def test_seed_tracker_streams():
     assert not np.array_equal(
         jax.random.key_data(t.dropout_key(1)), jax.random.key_data(t.dropout_key(2))
     )
+
+
+# ---------------------------------------------------------------------------
+# ZeRO stage semantics (reference group_sharded_parallel, eager_engine.py:
+# 281-307): stage 1 = opt state sharded, 2 = +grads, 3 = +params; offload
+# places optimizer moments in pinned host memory.
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg(stage, offload=False):
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    cfg = AttrDict.from_nested(
+        {
+            "Global": {"global_batch_size": 8, "micro_batch_size": 1, "seed": 7},
+            "Engine": {
+                "max_steps": 1,
+                "eval_freq": 0,
+                "logging_freq": 10**9,
+                "mix_precision": {"enable": False},
+                "save_load": {"save_steps": 0},
+            },
+            "Model": {
+                "module": "GPTModule",
+                "vocab_size": 64,
+                "hidden_size": 32,
+                "num_layers": 2,
+                "num_attention_heads": 4,
+                "max_position_embeddings": 16,
+                "dtype": "float32",
+            },
+            "Distributed": {
+                "dp_degree": 2,
+                "sharding": {
+                    "sharding_degree": 4,
+                    "sharding_stage": stage,
+                    "offload": offload,
+                },
+            },
+            "Optimizer": {
+                "name": "FusedAdamW",
+                "weight_decay": 0.01,
+                "lr": {"name": "Constant", "learning_rate": 1e-4},
+            },
+        }
+    )
+    return process_configs(cfg, num_devices=8)
+
+
+def _make_engine(devices8, stage, offload=False):
+    from paddlefleetx_tpu.core.engine import Engine
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+
+    cfg = _tiny_cfg(stage, offload)
+    mesh = init_dist_env(cfg, devices=devices8)
+    module = build_module(cfg)
+    with mesh:
+        return Engine(cfg, module, mesh)
+
+
+def _specs(tree):
+    return {str(s.spec) for s in jax.tree.leaves(tree)}
+
+
+def test_zero_stage1_opt_only(devices8):
+    eng = _make_engine(devices8, stage=1)
+    # params NOT fsdp-sharded at stage 1
+    assert not any("fsdp" in s for s in _specs(eng.param_shardings))
+    # adam moments ARE
+    assert any("fsdp" in s for s in _specs(eng.opt_shardings))
+    assert eng._grad_shardings is None
+
+
+def test_zero_stage2_grads_sharded(devices8):
+    eng = _make_engine(devices8, stage=2)
+    assert not any("fsdp" in s for s in _specs(eng.param_shardings))
+    assert eng._grad_shardings is not None
+    assert any("fsdp" in s for s in _specs(eng._grad_shardings))
+
+
+def test_zero_stage3_params_sharded(devices8):
+    eng = _make_engine(devices8, stage=3)
+    assert any("fsdp" in s for s in _specs(eng.param_shardings))
+    assert any("fsdp" in s for s in _specs(eng.opt_shardings))
+
+
+def test_zero_offload_host_memory_and_step(devices8):
+    """offload=True: pinned-host moments where the backend can compile the
+    placement (TPU), graceful device fallback where it cannot (XLA CPU's
+    SPMD partitioner rejects placement custom-calls) — either way one real
+    train step must run."""
+    import numpy as np
+
+    eng = _make_engine(devices8, stage=2, offload=True)
+    kinds = {
+        s.memory_kind
+        for s in jax.tree.leaves(eng.opt_shardings)
+        if "fsdp" in str(s.spec)
+    }
+    if eng.offload_active:
+        assert kinds == {"pinned_host"}
+    else:
+        assert "pinned_host" not in kinds  # fell back, documented by warning
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": rng.integers(0, 64, (8, 16)),
+        "labels": rng.integers(0, 64, (8, 16)),
+        "loss_mask": np.ones((8, 16), np.float32),
+    }
+    with eng.mesh:
+        eng.state, metrics = eng._train_step(eng.state, eng._put_batch(batch))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_6_7b_sharding16_config_validates():
+    from paddlefleetx_tpu.utils.config import get_config
+
+    cfg = get_config(
+        "/root/repo/configs/gpt/pretrain_gpt_6.7B_sharding16.yaml",
+        num_devices=16,
+    )
+    assert int(cfg.Distributed.sharding.sharding_degree) == 16
+    assert int(cfg.Distributed.sharding.sharding_stage) == 2
