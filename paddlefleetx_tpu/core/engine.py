@@ -136,10 +136,14 @@ class Engine:
         sharding_cfg = dist.get("sharding", {})
         sharding_degree = int(sharding_cfg.get("sharding_degree", 1))
         # default stage when a degree is configured but no stage: ZeRO-1
+        # (process_dist_config normalizes this for config-file paths; the
+        # fallback here covers hand-built cfg dicts)
         self.sharding_stage = int(
             sharding_cfg.get("sharding_stage", 1 if sharding_degree > 1 else 0)
         )
-        self.sharding_offload = bool(sharding_cfg.get("offload", False))
+        self.sharding_offload = bool(
+            sharding_cfg.get("sharding_offload", sharding_cfg.get("offload", False))
+        )
         num_experts = int(
             getattr(getattr(module, "config", None), "num_experts", 0) or 0
         )

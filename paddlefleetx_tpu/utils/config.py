@@ -153,8 +153,14 @@ def process_dist_config(cfg: AttrDict, num_devices: Optional[int] = None) -> Att
     sharding_cfg = dist.setdefault("sharding", AttrDict())
     sd = int(sharding_cfg.get("sharding_degree", 1) or 1)
     sharding_cfg.sharding_degree = sd
-    sharding_cfg.setdefault("sharding_stage", 0)
-    sharding_cfg.setdefault("sharding_offload", False)
+    # a configured degree without an explicit stage means ZeRO-1 (the
+    # reference requires an explicit stage; stage-0 + degree>1 would be a
+    # silent no-op that loses all memory savings)
+    sharding_cfg.setdefault("sharding_stage", 1 if sd > 1 else 0)
+    # accept both spellings; the engine reads the normalized one
+    sharding_cfg.sharding_offload = bool(
+        sharding_cfg.get("sharding_offload", sharding_cfg.get("offload", False))
+    )
 
     other = mp * pp * sd * sep
     if num_devices % other != 0:

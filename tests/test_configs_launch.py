@@ -1,0 +1,137 @@
+"""Every registered module has >=1 config YAML that tools/train.py can
+drive (VERDICT r1 item 7): cheap validation (config -> process -> module
+build) for all family configs, plus real 2-3 step CLI-equivalent training
+for the synthetic-data families on the 8-device CPU mesh."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ALL_CONFIGS = [
+    # (config path, num_devices)
+    ("configs/gpt/pretrain_gpt_345M_single.yaml", 1),
+    ("configs/gpt/pretrain_gpt_1.3B_mp8.yaml", 8),
+    ("configs/gpt/pretrain_gpt_6.7B_sharding16.yaml", 16),
+    ("configs/gpt/finetune_gpt_345M_glue.yaml", 1),
+    ("configs/ernie/pretrain_ernie_base.yaml", 1),
+    ("configs/t5/pretrain_t5_base.yaml", 1),
+    ("configs/debertav2/pretrain_debertav2_base.yaml", 1),
+    ("configs/imagen/imagen_text2im_64_base.yaml", 1),
+    ("configs/protein/helixfold_initial.yaml", 1),
+    ("configs/protein/helixfold_tiny_smoke.yaml", 1),
+    ("configs/vis/vit/ViT_base_patch16_224_pt_in1k_1n8c_dp.yaml", 8),
+    ("configs/vis/vit/ViT_tiny_ci_synthetic_1n8c_dp.yaml", 8),
+    ("configs/vis/moco/mocov1_pt_in1k_1n8c.yaml", 8),
+    ("configs/vis/moco/mocov2_pt_in1k_1n8c.yaml", 8),
+    ("configs/vis/moco/moco_lincls_in1k_1n8c.yaml", 8),
+    ("configs/vis/resnet/resnet50_in1k_1n8c.yaml", 8),
+    ("configs/multimodal/clip/clip_vitb16_pt_1n8c.yaml", 8),
+]
+
+
+@pytest.mark.parametrize("path,ndev", ALL_CONFIGS)
+def test_config_loads_and_module_builds(path, ndev):
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.utils.config import get_config
+
+    cfg = get_config(os.path.join(REPO, path), num_devices=ndev)
+    module = build_module(cfg)
+    assert hasattr(module, "loss_fn")
+
+
+def _run_train(config, overrides, timeout=540):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    env["PFX_PLATFORM"] = "cpu"
+    cmd = [sys.executable, os.path.join(REPO, "tools", "train.py"), "-c",
+           os.path.join(REPO, config)]
+    for o in overrides:
+        cmd += ["-o", o]
+    out = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "step " in out.stderr or "step " in out.stdout
+
+
+@pytest.mark.slow
+def test_vit_synthetic_trains_via_cli():
+    _run_train("configs/vis/vit/ViT_tiny_ci_synthetic_1n8c_dp.yaml", [])
+
+
+@pytest.mark.slow
+def test_moco_synthetic_trains_via_cli():
+    _run_train(
+        "configs/vis/moco/mocov2_pt_in1k_1n8c.yaml",
+        [
+            "Global.global_batch_size=16", "Global.local_batch_size=2",
+            "Global.micro_batch_size=2",
+            "Engine.max_steps=2", "Engine.logging_freq=1", "Engine.eval_freq=0",
+            "Engine.save_load.save_steps=0", "Engine.mix_precision.enable=False",
+            "Model.K=64", "Model.dim=16", "Model.base_encoder=resnet18",
+            "Data.Train.dataset.name=ContrastiveLearningDataset",
+            "Data.Train.dataset.cls_label_path=null",
+            "Data.Train.dataset.root=null",
+            "Data.Train.dataset.num_samples=32",
+            "Data.Train.dataset.image_size=32",
+        ],
+    )
+
+
+@pytest.mark.slow
+def test_clip_synthetic_trains_via_cli(tmp_path):
+    from paddlefleetx_tpu.data.multimodal_dataset import (
+        write_synthetic_image_text_corpus,
+    )
+    from paddlefleetx_tpu.data.tokenizers.t5_tokenizer import T5Tokenizer
+
+    corpus = write_synthetic_image_text_corpus(
+        str(tmp_path / "corpus.jsonl"), n=16, image_size=32
+    )
+    tok = T5Tokenizer.from_tiny_corpus(["a tiny synthetic image"])
+    tok.save(str(tmp_path / "vocab.json"))
+    _run_train(
+        "configs/multimodal/clip/clip_vitb16_pt_1n8c.yaml",
+        [
+            "Global.global_batch_size=8", "Global.local_batch_size=1",
+            "Global.micro_batch_size=1",
+            "Engine.max_steps=2", "Engine.logging_freq=1", "Engine.eval_freq=0",
+            "Engine.save_load.save_steps=0", "Engine.mix_precision.enable=False",
+            "Model.projection_dim=16", "Model.image_size=32", "Model.patch_size=8",
+            "Model.vision_hidden_size=32", "Model.vision_layers=2",
+            "Model.vision_heads=4", "Model.text_hidden_size=32",
+            "Model.text_layers=2", "Model.text_heads=4", "Model.max_text_len=16",
+            f"Model.vocab_size={max(tok.vocab_size, 64)}",
+            f"Data.Train.dataset.input_path={corpus}",
+            "Data.Train.dataset.image_size=32",
+            "Data.Train.dataset.max_seq_len=16",
+            f"Data.Train.dataset.tokenizer_vocab={tmp_path}/vocab.json",
+        ],
+    )
+
+
+@pytest.mark.slow
+def test_resnet_synthetic_trains_via_cli():
+    _run_train(
+        "configs/vis/resnet/resnet50_in1k_1n8c.yaml",
+        [
+            "Global.global_batch_size=16", "Global.local_batch_size=2",
+            "Global.micro_batch_size=2",
+            "Engine.max_steps=2", "Engine.logging_freq=1", "Engine.eval_freq=0",
+            "Engine.save_load.save_steps=0", "Engine.mix_precision.enable=False",
+            "Model.depth=18", "Model.num_classes=8",
+            "Data.Train.dataset.name=SyntheticClsDataset",
+            "Data.Train.dataset.num_samples=32",
+            "Data.Train.dataset.image_size=32",
+            "Data.Train.dataset.num_classes=8",
+            "Data.Eval.dataset.name=SyntheticClsDataset",
+            "Data.Eval.dataset.num_samples=8",
+            "Data.Eval.dataset.image_size=32",
+            "Data.Eval.dataset.num_classes=8",
+        ],
+    )

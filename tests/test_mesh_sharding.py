@@ -207,8 +207,11 @@ def test_zero_offload_host_memory_and_step(devices8):
 def test_6_7b_sharding16_config_validates():
     from paddlefleetx_tpu.utils.config import get_config
 
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     cfg = get_config(
-        "/root/repo/configs/gpt/pretrain_gpt_6.7B_sharding16.yaml",
+        os.path.join(repo, "configs/gpt/pretrain_gpt_6.7B_sharding16.yaml"),
         num_devices=16,
     )
     assert int(cfg.Distributed.sharding.sharding_degree) == 16
