@@ -1,0 +1,25 @@
+# Developer entry points
+
+.PHONY: test-fast test-std test-all bench
+
+# <5-min gate on a 1-core CPU-mesh box: units + core model/sharding + one
+# pipeline parity case
+FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
+             tests/test_optims.py tests/test_rigid.py tests/test_glue.py \
+             tests/test_lm_eval.py tests/test_configs_launch.py \
+             tests/test_gpt_model.py tests/test_mesh_sharding.py
+
+test-fast:
+	python -m pytest $(FAST_FILES) -q -m "not slow" -x
+	python -m pytest "tests/test_pipeline.py::test_pipeline_1f1b_train_loss_and_grads[2-extra1-4-1]" -q
+
+# standard suite: everything except Pallas interpret-mode / big-compile
+# files (marked slow)
+test-std:
+	python -m pytest tests/ -q -m "not slow"
+
+test-all:
+	python -m pytest tests/ -q
+
+bench:
+	python benchmarks/run_benchmark.py

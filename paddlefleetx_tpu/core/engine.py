@@ -55,9 +55,12 @@ class TrainState:
     # params — the reference carries these as buffers/stop-gradient params,
     # e.g. moco.py:130-159); None for stateless modules
     extra: Any = None
+    # fp16 DynamicLossScaler state {scale, good_steps} (reference
+    # apis/amp.py:193-234); None on the bf16/fp32 paths
+    scaler: Any = None
 
     def tree_flatten(self):
-        return (self.step, self.params, self.opt_state, self.extra), None
+        return (self.step, self.params, self.opt_state, self.extra, self.scaler), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -131,6 +134,23 @@ class Engine:
         self.save_steps = int(eng.get("save_load", {}).get("save_steps", 0) or 0)
         self.output_dir = eng.get("save_load", {}).get("output_dir", "./output")
         self.global_batch_size = int(cfg.Global.global_batch_size)
+
+        # fp16 parity path: dynamic loss scaling (reference DynamicLossScaler
+        # apis/amp.py:193-234).  bf16 (the TPU default) needs no scaler —
+        # same exponent range as fp32.
+        mix = eng.get("mix_precision", {})
+        # enable defaults True to match resolve_model_dtype (core/module.py):
+        # a dtype=float16 config without an explicit enable must get BOTH
+        # fp16 compute and the scaler, never one without the other
+        self.use_loss_scaling = bool(mix.get("enable", True)) and str(
+            mix.get("dtype", "bfloat16")
+        ) in ("float16", "fp16")
+        scale_loss = mix.get("scale_loss", 32768.0)
+        scale_cfg = scale_loss if isinstance(scale_loss, dict) else {"init": scale_loss}
+        self.init_loss_scaling = float(scale_cfg.get("init", 32768.0))
+        self.scale_incr_every = int(scale_cfg.get("incr_every_n_steps", 1000))
+        self.scale_incr_ratio = float(scale_cfg.get("incr_ratio", 2.0))
+        self.scale_decr_ratio = float(scale_cfg.get("decr_ratio", 0.5))
 
         dist = cfg.get("Distributed", {})
         sharding_cfg = dist.get("sharding", {})
@@ -250,6 +270,9 @@ class Engine:
                 # on device first would OOM exactly the models offload serves
                 opt_state=self.opt_shardings,
                 extra=self.extra_shardings,
+                scaler={"scale": self.replicated, "good_steps": self.replicated}
+                if self.use_loss_scaling
+                else None,
             ),
         )
         def make_state(key):
@@ -259,6 +282,12 @@ class Engine:
                 params=params,
                 opt_state=self.tx.init(params),
                 extra=self.module.init_extra(key, params) if has_extra else None,
+                scaler={
+                    "scale": jnp.float32(self.init_loss_scaling),
+                    "good_steps": jnp.int32(0),
+                }
+                if self.use_loss_scaling
+                else None,
             )
 
         t0 = time.time()
@@ -283,6 +312,10 @@ class Engine:
         offload = self.offload_active
         opt_dev_shardings = self._opt_shardings_device
         opt_host_shardings = self.opt_shardings
+        use_scaling = self.use_loss_scaling
+        incr_every = self.scale_incr_every
+        incr_ratio = self.scale_incr_ratio
+        decr_ratio = self.scale_decr_ratio
 
         @functools.partial(
             jax.jit,
@@ -296,15 +329,24 @@ class Engine:
             base_key = get_seed_tracker().key("global")
             step_key = jax.random.fold_in(base_key, state.step)
 
+            # fp16 dynamic loss scaling: multiply the loss by the current
+            # scale before differentiation, unscale the grads after
+            # (reference DynamicLossScaler apis/amp.py:193-234)
+            loss_scale = (
+                state.scaler["scale"] if use_scaling else jnp.float32(1.0)
+            )
+
             def run_loss(p, mb, extra):
                 if has_extra:
-                    return module.loss_fn(
+                    loss, new_extra = module.loss_fn(
                         p, mb, ctx=ctx, extra=extra, dropout_key=step_key, train=True
                     )
-                loss = module.loss_fn(
-                    p, mb, ctx=ctx, dropout_key=step_key, train=True
-                )
-                return loss, None
+                else:
+                    loss = module.loss_fn(
+                        p, mb, ctx=ctx, dropout_key=step_key, train=True
+                    )
+                    new_extra = None
+                return loss * loss_scale, (loss, new_extra)
 
             def micro_batches(b):
                 return jax.tree.map(
@@ -313,7 +355,7 @@ class Engine:
 
             def micro(carry, mb):
                 gacc, lacc, extra = carry
-                (loss, new_extra), grads = jax.value_and_grad(
+                (_, (loss, new_extra)), grads = jax.value_and_grad(
                     run_loss, has_aux=True
                 )(state.params, mb, extra)
                 return (jax.tree.map(jnp.add, gacc, grads), lacc + loss, new_extra), None
@@ -328,9 +370,12 @@ class Engine:
                 grads = jax.tree.map(lambda g: g / accum, gsum)
                 loss = lsum / accum
             else:
-                (loss, new_extra), grads = jax.value_and_grad(
+                (_, (loss, new_extra)), grads = jax.value_and_grad(
                     run_loss, has_aux=True
                 )(state.params, batch, state.extra)
+
+            if use_scaling:
+                grads = jax.tree.map(lambda g: g / loss_scale, grads)
 
             if grad_shardings is not None:
                 # ZeRO-2: the dp grad-sum lands fsdp-sharded (XLA lowers
@@ -364,13 +409,33 @@ class Engine:
             new_extra = jax.tree.map(
                 lambda n, o: jnp.where(finite, n, o), new_extra, state.extra
             )
-            new_state = TrainState(state.step + 1, new_params, new_opt, new_extra)
+            new_scaler = state.scaler
+            if use_scaling:
+                # grow after incr_every consecutive finite steps, shrink on
+                # overflow (reference update :219-234); never below 1.0
+                good = jnp.where(finite, state.scaler["good_steps"] + 1, 0)
+                grow = good >= incr_every
+                scale = jnp.where(
+                    finite,
+                    jnp.where(grow, state.scaler["scale"] * incr_ratio,
+                              state.scaler["scale"]),
+                    jnp.maximum(state.scaler["scale"] * decr_ratio, 1.0),
+                )
+                new_scaler = {
+                    "scale": scale,
+                    "good_steps": jnp.where(grow, 0, good),
+                }
+            new_state = TrainState(
+                state.step + 1, new_params, new_opt, new_extra, new_scaler
+            )
             metrics = {
                 "loss": loss,
                 "grad_norm": gnorm,
                 "lr": self.schedule(state.step),
                 "found_inf": (~finite).astype(jnp.float32),
             }
+            if use_scaling:
+                metrics["loss_scale"] = new_scaler["scale"]
             return new_state, metrics
 
         return train_step
@@ -531,6 +596,9 @@ class Engine:
         ckptr.save(os.path.join(path, "state"), payload, force=True)
         ckptr.wait_until_finished()
         meta = {"step": step, "consumed_samples": self._consumed_samples}
+        if self.state.scaler is not None:
+            meta["loss_scale"] = float(self.state.scaler["scale"])
+            meta["scaler_good_steps"] = int(self.state.scaler["good_steps"])
         with open(os.path.join(path, "meta.json"), "w") as f:
             import json
 
@@ -568,10 +636,17 @@ class Engine:
             meta = json.load(f)
         self._consumed_samples = int(meta.get("consumed_samples", 0))
         self._step = int(meta["step"])
+        scaler = None
+        if self.use_loss_scaling:
+            scaler = {
+                "scale": jnp.float32(meta.get("loss_scale", self.init_loss_scaling)),
+                "good_steps": jnp.int32(meta.get("scaler_good_steps", 0)),
+            }
         self.state = TrainState(
             step=jnp.asarray(meta["step"], jnp.int32),
             params=restored["params"],
             opt_state=restored["opt_state"],
             extra=restored.get("extra"),
+            scaler=scaler,
         )
         logger.info(f"loaded checkpoint: {path} (step {meta['step']})")

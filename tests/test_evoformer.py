@@ -12,6 +12,10 @@ from paddlefleetx_tpu.models.protein.evoformer import EvoformerConfig
 from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh
 from paddlefleetx_tpu.parallel.sharding import make_rules, tree_logical_to_sharding
 
+# Pallas interpret-mode / big-compile file: excluded from the fast
+# subset (pytest -m 'not slow'); run the full suite for release checks
+pytestmark = pytest.mark.slow
+
 TINY = EvoformerConfig(
     msa_channel=16,
     pair_channel=8,

@@ -11,6 +11,10 @@ from paddlefleetx_tpu.models.multimodal.imagen import imagen, unet as unet_lib
 from paddlefleetx_tpu.models.multimodal.imagen.imagen import ImagenConfig
 from paddlefleetx_tpu.models.multimodal.imagen.unet import UnetConfig
 
+# Pallas interpret-mode / big-compile file: excluded from the fast
+# subset (pytest -m 'not slow'); run the full suite for release checks
+pytestmark = pytest.mark.slow
+
 TINY_UNET = dict(
     dim=16, dim_mults=(1, 2), layer_attns=(False, True),
     layer_cross_attns=(False, True), num_resnet_blocks=1,

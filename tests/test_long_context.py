@@ -12,6 +12,10 @@ from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh
 from paddlefleetx_tpu.parallel.ring_attention import ring_attention
 from paddlefleetx_tpu.parallel.sharding import make_rules, tree_logical_to_sharding
 
+# Pallas interpret-mode / big-compile file: excluded from the fast
+# subset (pytest -m 'not slow'); run the full suite for release checks
+pytestmark = pytest.mark.slow
+
 TINY = GPTConfig(
     vocab_size=128,
     hidden_size=64,
