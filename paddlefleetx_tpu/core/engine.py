@@ -139,12 +139,14 @@ class Engine:
         # apis/amp.py:193-234).  bf16 (the TPU default) needs no scaler —
         # same exponent range as fp32.
         mix = eng.get("mix_precision", {})
-        # enable defaults True to match resolve_model_dtype (core/module.py):
-        # a dtype=float16 config without an explicit enable must get BOTH
-        # fp16 compute and the scaler, never one without the other
-        self.use_loss_scaling = bool(mix.get("enable", True)) and str(
-            mix.get("dtype", "bfloat16")
-        ) in ("float16", "fp16")
+        # enable defaults True to match resolve_model_dtype (core/module.py),
+        # and a pinned Model.dtype=float16 counts too: fp16 compute must get
+        # the scaler in every spelling, never one without the other
+        model_dtype = str(getattr(getattr(module, "config", None), "dtype", ""))
+        self.use_loss_scaling = (
+            bool(mix.get("enable", True))
+            and str(mix.get("dtype", "bfloat16")) in ("float16", "fp16")
+        ) or model_dtype in ("float16", "fp16")
         scale_loss = mix.get("scale_loss", 32768.0)
         scale_cfg = scale_loss if isinstance(scale_loss, dict) else {"init": scale_loss}
         self.init_loss_scaling = float(scale_cfg.get("init", 32768.0))

@@ -1,0 +1,96 @@
+"""Pretrained-artifact fetch/cache utilities.
+
+TPU-native counterpart of the reference ``ppfleetx/utils/download.py``
+(cached_path :43, _download with retry :60-120, md5 check :123-150): a
+small, dependency-light cache keyed on the source name with checksum
+validation.  Local paths pass through untouched; URLs download into
+``~/.cache/paddlefleetx_tpu`` with bounded retries and an atomic rename so
+a killed download never leaves a half-written artifact in the cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tempfile
+import urllib.request
+from typing import Optional
+
+from paddlefleetx_tpu.utils.log import logger
+
+DOWNLOAD_RETRY_LIMIT = 3
+DEFAULT_CACHE_DIR = "~/.cache/paddlefleetx_tpu"
+
+
+def is_url(path: str) -> bool:
+    return path.startswith("http://") or path.startswith("https://")
+
+
+def md5file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def check_md5(path: str, md5sum: Optional[str]) -> bool:
+    """True when the file matches the expected digest (or no digest given,
+    reference md5check semantics)."""
+    if md5sum is None:
+        return True
+    ok = md5file(path) == md5sum
+    if not ok:
+        logger.warning(f"md5 mismatch for {path} (expected {md5sum})")
+    return ok
+
+
+def _download(url: str, dst: str, md5sum: Optional[str]) -> str:
+    os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+    last_err: Optional[Exception] = None
+    for attempt in range(1, DOWNLOAD_RETRY_LIMIT + 1):
+        tmp_fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(dst) or ".")
+        os.close(tmp_fd)
+        try:
+            logger.info(f"downloading {url} (attempt {attempt})")
+            with urllib.request.urlopen(url) as r, open(tmp_path, "wb") as f:
+                shutil.copyfileobj(r, f)
+            if not check_md5(tmp_path, md5sum):
+                raise IOError(f"checksum mismatch downloading {url}")
+            os.replace(tmp_path, dst)  # atomic: cache never half-written
+            return dst
+        except Exception as e:  # noqa: BLE001 — retry any transport error
+            last_err = e
+            if os.path.exists(tmp_path):
+                os.remove(tmp_path)
+    raise RuntimeError(
+        f"download of {url} failed after {DOWNLOAD_RETRY_LIMIT} attempts"
+    ) from last_err
+
+
+def cached_path(
+    url_or_path: str,
+    cache_dir: Optional[str] = None,
+    md5sum: Optional[str] = None,
+) -> str:
+    """Resolve a local path or URL to a local file, downloading into the
+    cache when needed (reference cached_path :43-58).  A cached file whose
+    checksum no longer matches is re-fetched."""
+    if not is_url(url_or_path):
+        path = os.path.expanduser(url_or_path)
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        if not check_md5(path, md5sum):
+            raise IOError(f"checksum mismatch for local file {path}")
+        return path
+
+    cache_dir = os.path.expanduser(cache_dir or DEFAULT_CACHE_DIR)
+    fname = os.path.split(url_or_path)[-1]
+    dst = os.path.join(cache_dir, fname)
+    if os.path.exists(dst) and check_md5(dst, md5sum):
+        return dst
+    return _download(url_or_path, dst, md5sum)

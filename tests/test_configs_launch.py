@@ -135,3 +135,77 @@ def test_resnet_synthetic_trains_via_cli():
             "Data.Eval.dataset.num_classes=8",
         ],
     )
+
+
+# ---------------------------------------------------------------------------
+# download utils + no-engine examples
+# ---------------------------------------------------------------------------
+
+
+def test_cached_path_local_and_md5(tmp_path):
+    from paddlefleetx_tpu.utils.download import cached_path, check_md5, md5file
+
+    f = tmp_path / "artifact.bin"
+    f.write_bytes(b"hello weights")
+    p = cached_path(str(f))
+    assert p == str(f)
+    digest = md5file(p)
+    assert check_md5(p, digest)
+    assert not check_md5(p, "0" * 32)
+    with pytest.raises(IOError):
+        cached_path(str(f), md5sum="0" * 32)
+    with pytest.raises(FileNotFoundError):
+        cached_path(str(tmp_path / "missing.bin"))
+
+
+def test_download_retries_and_atomic(tmp_path, monkeypatch):
+    """A flaky 'transport' fails twice then succeeds; the cache file appears
+    atomically with the right contents."""
+    import io
+    import urllib.request
+
+    from paddlefleetx_tpu.utils import download as dl
+
+    calls = {"n": 0}
+
+    def fake_urlopen(url):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise IOError("flaky network")
+
+        class Ctx:
+            def __enter__(self):
+                return io.BytesIO(b"payload")
+
+            def __exit__(self, *a):
+                return False
+
+        return Ctx()
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    out = dl.cached_path(
+        "http://example.invalid/weights.bin", cache_dir=str(tmp_path)
+    )
+    assert open(out, "rb").read() == b"payload"
+    assert calls["n"] == 3
+    # cached: no further transport calls
+    out2 = dl.cached_path(
+        "http://example.invalid/weights.bin", cache_dir=str(tmp_path)
+    )
+    assert out2 == out and calls["n"] == 3
+
+
+@pytest.mark.slow
+def test_no_engine_examples_run():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    env["PFX_PLATFORM"] = "cpu"
+    for script in (
+        "examples/transformer/train_no_engine.py",
+        "examples/transformer/generate_no_engine.py",
+    ):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, script)],
+            capture_output=True, text=True, timeout=420, cwd=REPO, env=env,
+        )
+        assert out.returncode == 0, (script, out.stderr[-1500:])
