@@ -66,15 +66,27 @@ def main(argv=None):
         from paddlefleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
 
         tok = GPTTokenizer.from_pretrained(tokenizer_dir)
-        prompt = jax.numpy.asarray([tok.encode(prompt_text)])
+        ids = tok.encode(prompt_text)
     else:
         tok = None
-        prompt = jax.numpy.asarray([[1, 2, 3, 4]])
+        ids = [1, 2, 3, 4]
 
+    # bucketed serving: pad the prompt to a fixed-width bucket so repeated
+    # calls with different prompt lengths reuse one compiled artifact
+    from paddlefleetx_tpu.models.gpt.generation import pad_prompts
+
+    bucket = int(gen_cfg.get("pad_to_multiple", 32))
+    prompt, prompt_lens = pad_prompts([ids], gen.pad_token_id, multiple=bucket)
+
+    # jitted so GSPMD plans the whole decode once (and eager sharding
+    # constraints never see a sub-divisible batch)
     with mesh:
-        out = generate(
-            params, prompt, module.config, gen, key=jax.random.key(0), ctx=ctx
-        )
+        out = jax.jit(
+            lambda p, x, lens: generate(
+                p, x, module.config, gen, key=jax.random.key(0), ctx=ctx,
+                prompt_lens=lens,
+            )
+        )(params, prompt, prompt_lens)
     ids = out[0].tolist()
     logger.info(f"prompt: {prompt_text!r}")
     logger.info(f"generated ids: {ids}")
