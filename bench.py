@@ -33,6 +33,13 @@ def _backend_alive(timeout_s: int = 150) -> bool:
 
 
 def main():
+    # honor PFX_PLATFORM before ANY backend init (the axon sitecustomize
+    # overrides a bare JAX_PLATFORMS env var) so the probe gate below and
+    # the backend the benchmark actually initializes agree
+    from paddlefleetx_tpu.utils.device import apply_platform_env
+
+    apply_platform_env()
+
     # probe unless explicitly pinned to a non-TPU platform (a pinned
     # PFX_PLATFORM=tpu must still be guarded — it is the hang case)
     platform = os.environ.get("PFX_PLATFORM", "").lower()

@@ -278,8 +278,8 @@ def generate(
         if prompt_lens is not None:
             try:
                 real_bound = int(jax.numpy.max(prompt_lens)) + gen.max_dec_len
-            except jax.errors.TracerArrayConversionError:
-                real_bound = None
+            except jax.errors.ConcretizationTypeError:
+                real_bound = None  # traced lengths: bucket-width bound applies
         if real_bound is None or real_bound > cfg.max_position_embeddings:
             raise ValueError(
                 f"prompt_len {prompt_len} + max_dec_len {gen.max_dec_len} exceeds "
