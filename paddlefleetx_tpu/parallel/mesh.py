@@ -80,10 +80,33 @@ class MeshConfig:
 _GLOBAL_MESH: Optional[Mesh] = None
 
 
+def _dcn_shape(shape: Sequence[int], num_hosts: int) -> Optional[Sequence[int]]:
+    """Factor the host count across the OUTER axes (data, fsdp, stages) so
+    cross-host (DCN) hops carry only dp/fsdp/pp traffic while mp/sep stay
+    on intra-host ICI — the layout the reference achieves by rank order in
+    its HCG topology (comm_groups.py:27-80) and the scaling-book recipe."""
+    dcn = [1, 1, 1, 1, 1]
+    remaining = num_hosts
+    for i in range(3):  # data, fsdp, stages may span hosts
+        if remaining == 1:
+            break
+        take = int(np.gcd(shape[i], remaining))
+        dcn[i] = take
+        remaining //= take
+    return dcn if remaining == 1 else None
+
+
 def build_mesh(
     mesh_cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
 ) -> Mesh:
-    """Build the global 5-axis mesh from parallel degrees."""
+    """Build the global 5-axis mesh from parallel degrees.
+
+    On TPU the device assignment is topology-aware: single-slice meshes go
+    through ``mesh_utils.create_device_mesh`` (ICI-nearest-neighbour
+    placement for the inner axes) and multi-host/multi-slice meshes through
+    ``create_hybrid_device_mesh`` with the host factor on the outer
+    (DCN-tolerant) axes.  Non-TPU backends and odd shapes fall back to
+    plain row-major assignment."""
     if devices is None:
         devices = jax.devices()
     if len(devices) != mesh_cfg.world_size:
@@ -98,6 +121,33 @@ def build_mesh(
         mesh_cfg.sep_degree,
         mesh_cfg.mp_degree,
     )
+    devices = list(devices)
+    if devices and devices[0].platform == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+
+            # DCN granule = slice (create_hybrid_device_mesh's default
+            # grouping); multi-host single-slice pods stay on the pure-ICI
+            # path, which handles them correctly
+            num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+            if num_slices > 1:
+                dcn = _dcn_shape(shape, num_slices)
+                if dcn is not None:
+                    ici = tuple(s // d for s, d in zip(shape, dcn))
+                    arr = mesh_utils.create_hybrid_device_mesh(
+                        ici, dcn, devices=devices
+                    )
+                    return Mesh(arr, MESH_AXES)
+            else:
+                arr = mesh_utils.create_device_mesh(shape, devices=devices)
+                return Mesh(arr, MESH_AXES)
+        except Exception as e:  # topology helper rejected the shape
+            from paddlefleetx_tpu.utils.log import logger
+
+            logger.warning(
+                f"topology-aware mesh placement failed ({e!r}); "
+                "falling back to row-major device assignment"
+            )
     arr = np.asarray(devices, dtype=object).reshape(shape)
     return Mesh(arr, MESH_AXES)
 

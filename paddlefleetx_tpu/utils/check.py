@@ -1,0 +1,40 @@
+"""Environment sanity checks (reference ppfleetx/utils/check.py:27-60:
+check_version gates on a compiled-with-CUDA Paddle; here the gates are the
+JAX version floor and backend availability)."""
+
+from __future__ import annotations
+
+from paddlefleetx_tpu.utils.log import logger
+
+MIN_JAX_VERSION = (0, 4, 30)
+
+
+def check_version() -> None:
+    """Fail fast on a jax too old for shard_map/partial-auto meshes."""
+    import re
+
+    import jax
+
+    ver = tuple(
+        int(re.match(r"\d+", x).group()) if re.match(r"\d+", x) else 0
+        for x in jax.__version__.split(".")[:3]
+    )
+    if ver < MIN_JAX_VERSION:
+        raise RuntimeError(
+            f"paddlefleetx_tpu needs jax >= {'.'.join(map(str, MIN_JAX_VERSION))}, "
+            f"found {jax.__version__}"
+        )
+
+
+def check_device(device: str = "tpu") -> None:
+    """Warn (not fail) when the requested platform is absent: the same
+    program runs on the virtual CPU mesh (reference check_device aborts —
+    here every layout is CPU-runnable by design)."""
+    import jax
+
+    platforms = {d.platform for d in jax.devices()}
+    if device not in platforms:
+        logger.warning(
+            f"requested device '{device}' not present (have {sorted(platforms)}); "
+            "running on the available backend"
+        )

@@ -1,0 +1,34 @@
+"""Archive/file helpers (reference ppfleetx/utils/file.py:26-80:
+unzip/untar/parse_csv used by the pretrained-download path)."""
+
+from __future__ import annotations
+
+import csv
+import os
+import tarfile
+import zipfile
+from typing import Any, Dict, List, Optional
+
+
+def unzip(zip_path: str, out_dir: Optional[str] = None, delete: bool = False) -> str:
+    out_dir = out_dir or os.path.dirname(zip_path)
+    with zipfile.ZipFile(zip_path, "r") as z:
+        z.extractall(out_dir)
+    if delete:
+        os.remove(zip_path)
+    return out_dir
+
+
+def untar(tar_path: str, mode: str = "r:*", out_dir: Optional[str] = None,
+          delete: bool = False) -> str:
+    out_dir = out_dir or os.path.dirname(tar_path)
+    with tarfile.open(tar_path, mode) as t:
+        t.extractall(out_dir, filter="data")  # refuse path-escape members
+    if delete:
+        os.remove(tar_path)
+    return out_dir
+
+
+def parse_csv(path: str, delimiter: str = ",") -> List[Dict[str, Any]]:
+    with open(path, newline="") as f:
+        return list(csv.DictReader(f, delimiter=delimiter))

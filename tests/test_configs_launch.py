@@ -209,3 +209,34 @@ def test_no_engine_examples_run():
             capture_output=True, text=True, timeout=420, cwd=REPO, env=env,
         )
         assert out.returncode == 0, (script, out.stderr[-1500:])
+
+
+def test_file_utils_roundtrip(tmp_path):
+    import tarfile
+    import zipfile
+
+    from paddlefleetx_tpu.utils.file import parse_csv, untar, unzip
+
+    (tmp_path / "a.txt").write_text("hello")
+    zp = str(tmp_path / "arch.zip")
+    with zipfile.ZipFile(zp, "w") as z:
+        z.write(tmp_path / "a.txt", "a.txt")
+    out = unzip(zp, out_dir=str(tmp_path / "unz"))
+    assert (tmp_path / "unz" / "a.txt").read_text() == "hello"
+
+    tp = str(tmp_path / "arch.tar.gz")
+    with tarfile.open(tp, "w:gz") as t:
+        t.add(tmp_path / "a.txt", "a.txt")
+    untar(tp, out_dir=str(tmp_path / "unt"))
+    assert (tmp_path / "unt" / "a.txt").read_text() == "hello"
+
+    (tmp_path / "t.csv").write_text("k,v\nx,1\ny,2\n")
+    rows = parse_csv(str(tmp_path / "t.csv"))
+    assert rows == [{"k": "x", "v": "1"}, {"k": "y", "v": "2"}]
+
+
+def test_check_version_passes_here():
+    from paddlefleetx_tpu.utils.check import check_device, check_version
+
+    check_version()
+    check_device("cpu")

@@ -216,3 +216,17 @@ def test_6_7b_sharding16_config_validates():
     )
     assert int(cfg.Distributed.sharding.sharding_degree) == 16
     assert int(cfg.Distributed.sharding.sharding_stage) == 2
+
+
+def test_dcn_shape_factoring():
+    """Host count lands on the outer (DCN-tolerant) axes only."""
+    from paddlefleetx_tpu.parallel.mesh import _dcn_shape
+
+    # 2 hosts, dp 2: hosts span data
+    assert _dcn_shape((2, 1, 2, 1, 2), 2) == [2, 1, 1, 1, 1]
+    # 4 hosts, dp2 x pp2: data takes 2, stages takes 2
+    assert _dcn_shape((2, 1, 2, 1, 2), 4) == [2, 1, 2, 1, 1]
+    # 4 hosts over dp2 x fsdp2
+    assert _dcn_shape((2, 2, 1, 1, 4), 4) == [2, 2, 1, 1, 1]
+    # impossible: hosts cannot factor into outer axes -> None (fallback)
+    assert _dcn_shape((1, 1, 1, 2, 4), 2) is None
