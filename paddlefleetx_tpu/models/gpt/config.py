@@ -28,6 +28,9 @@ class GPTConfig:
     # matmul outputs by name and recomputes only cheap elementwise ops)
     use_recompute: bool = False
     recompute_granularity: str = "full"
+    # comma-separated checkpoint names kept live under "selective"
+    # (qkv | attn_out | mlp_hidden); empty = the measured-best default
+    recompute_names: str = ""
     # fused LayerNorm Pallas kernel (ops/fused_layernorm.py) instead of the
     # jnp composite (reference consumes paddle fused norm ops, vit.py:23-115)
     use_fused_ln: bool = False
@@ -54,10 +57,28 @@ class GPTConfig:
             raise ValueError("hidden_size must divide num_attention_heads")
         if self.recompute_granularity not in ("full", "selective", "full_attn", "core_attn"):
             raise ValueError(f"bad recompute_granularity {self.recompute_granularity}")
+        raw = self.recompute_names
+        parts = raw if isinstance(raw, (list, tuple)) else str(raw).split(",")
+        names = tuple(str(n).strip() for n in parts if str(n).strip())
+        bad = set(names) - {"qkv", "attn_out", "mlp_hidden"}
+        if bad:
+            raise ValueError(
+                f"bad recompute_names {sorted(bad)}; valid: qkv, attn_out, mlp_hidden"
+            )
+        if names and self.recompute_granularity != "selective":
+            raise ValueError(
+                "recompute_names only applies to recompute_granularity='selective'"
+            )
+        object.__setattr__(self, "recompute_names", ",".join(names))
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
+
+    @property
+    def recompute_name_tuple(self) -> Tuple[str, ...]:
+        """Normalized selective-remat save-set; empty = measured-best default."""
+        return tuple(n for n in self.recompute_names.split(",") if n)
 
     @staticmethod
     def from_config(model_cfg) -> "GPTConfig":

@@ -132,8 +132,8 @@ def test_grad_layout_parity(devices8):
 
 
 def test_selective_remat_parity():
-    """'selective' remat (save qkv/mlp_hidden by name) never changes values —
-    loss and grads match the no-remat graph exactly."""
+    """'selective' remat (named save-set, default qkv+attn_out) never changes
+    values — loss and grads match the no-remat graph exactly."""
     import dataclasses
 
     params = gpt.init(TINY, jax.random.key(0))
