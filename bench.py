@@ -107,8 +107,8 @@ def main():
                 "attn_impl": os.environ.get("BENCH_ATTN", "flash"),
                 # 16GB v5e HBM can't hold the full activation set (37G), but
                 # blanket full-layer remat wastes a whole extra forward;
-                # "selective" saves the named matmul outputs (qkv/mlp_hidden)
-                # and recomputes only cheap elementwise ops
+                # "selective" saves the measured-best named set (qkv +
+                # attn_out + attn_lse) and recomputes the cheap rest
                 "use_recompute": os.environ.get("BENCH_RECOMPUTE", "1") == "1",
                 "recompute_granularity": os.environ.get("BENCH_REMAT", "selective"),
                 "use_fused_ln": os.environ.get("BENCH_FUSED_LN", "1") == "1",

@@ -29,7 +29,7 @@ class GPTConfig:
     use_recompute: bool = False
     recompute_granularity: str = "full"
     # comma-separated checkpoint names kept live under "selective"
-    # (qkv | attn_out | mlp_hidden); empty = the measured-best default
+    # (qkv | attn_out | attn_lse | mlp_hidden); empty = measured-best default
     recompute_names: str = ""
     # fused LayerNorm Pallas kernel (ops/fused_layernorm.py) instead of the
     # jnp composite (reference consumes paddle fused norm ops, vit.py:23-115)
@@ -54,16 +54,17 @@ class GPTConfig:
         if self.ffn_hidden_size is None:
             object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
         if self.hidden_size % self.num_attention_heads:
-            raise ValueError("hidden_size must divide num_attention_heads")
+            raise ValueError("num_attention_heads must divide hidden_size")
         if self.recompute_granularity not in ("full", "selective", "full_attn", "core_attn"):
             raise ValueError(f"bad recompute_granularity {self.recompute_granularity}")
         raw = self.recompute_names
         parts = raw if isinstance(raw, (list, tuple)) else str(raw).split(",")
         names = tuple(str(n).strip() for n in parts if str(n).strip())
-        bad = set(names) - {"qkv", "attn_out", "mlp_hidden"}
+        bad = set(names) - {"qkv", "attn_out", "attn_lse", "mlp_hidden"}
         if bad:
             raise ValueError(
-                f"bad recompute_names {sorted(bad)}; valid: qkv, attn_out, mlp_hidden"
+                f"bad recompute_names {sorted(bad)}; "
+                "valid: qkv, attn_out, attn_lse, mlp_hidden"
             )
         if names and self.recompute_granularity != "selective":
             raise ValueError(

@@ -152,18 +152,18 @@ def _layer_remat(cfg: GPTConfig, fn):
 
     "full" saves only layer-boundary activations (reference recompute
     single_model.py:320-405); "selective" additionally saves a tunable set
-    of named activations (default qkv + attn_out) so the backward pass
-    skips the expensive recomputes — the TPU-native middle ground the
-    reference lacks."""
+    of named activations (default qkv + attn_out + attn_lse) so the
+    backward pass skips the expensive recomputes — the TPU-native middle
+    ground the reference lacks."""
     if not cfg.use_recompute:
         return fn
     if cfg.recompute_granularity == "full":
         return jax.checkpoint(fn)
     if cfg.recompute_granularity == "selective":
         # The save-set trades HBM residency+traffic against recompute FLOPs;
-        # qkv+attn_out measured fastest on v5e (saving mlp_hidden costs 3GB
-        # of HBM round-trips per step for an 0.7ms matmul re-run saved)
-        names = cfg.recompute_name_tuple or ("qkv", "attn_out")
+        # qkv+attn_out+attn_lse measured fastest on v5e (saving mlp_hidden
+        # costs 3GB of HBM round-trips per step for a 0.7ms matmul re-run)
+        names = cfg.recompute_name_tuple or ("qkv", "attn_out", "attn_lse")
         policy = jax.checkpoint_policies.save_only_these_names(*names)
         return jax.checkpoint(fn, policy=policy)
     return fn
@@ -225,7 +225,14 @@ def _attention_block(
     if cfg.use_recompute and cfg.recompute_granularity == "core_attn":
         core = jax.checkpoint(core, static_argnums=())
     out = core(q, k, v, k_attn)  # [b, s, nh, hd]
-    out = checkpoint_name(out, "attn_out")
+    from paddlefleetx_tpu.ops.flash_attention import flash_supported
+
+    if cfg.attn_impl != "flash" or not flash_supported(q.shape[1]):
+        # XLA attention (configured, or flash fell back on an unsupported
+        # seq): save the output by name so selective remat skips the O(s^2)
+        # recompute. The flash kernel instead saves its lse internally
+        # ("attn_lse") and re-runs one cheap fwd kernel in backward.
+        out = checkpoint_name(out, "attn_out")
 
     # row-parallel output projection: contraction over sharded heads -> psum
     out = jnp.einsum("bsnd,ndh->bsh", out, p["out_kernel"].astype(dtype))
