@@ -164,6 +164,11 @@ def _layer_remat(cfg: GPTConfig, fn):
         # qkv+attn_out+attn_lse measured fastest on v5e (saving mlp_hidden
         # costs 3GB of HBM round-trips per step for a 0.7ms matmul re-run)
         names = cfg.recompute_name_tuple or ("qkv", "attn_out", "attn_lse")
+        if cfg.attn_impl == "flash" and "attn_out" in names and "attn_lse" not in names:
+            # on the flash path the attention residual is the kernel's lse,
+            # not the (primal) output — honor the user's "save attention"
+            # intent instead of silently saving nothing
+            names = names + ("attn_lse",)
         policy = jax.checkpoint_policies.save_only_these_names(*names)
         return jax.checkpoint(fn, policy=policy)
     return fn
@@ -225,14 +230,6 @@ def _attention_block(
     if cfg.use_recompute and cfg.recompute_granularity == "core_attn":
         core = jax.checkpoint(core, static_argnums=())
     out = core(q, k, v, k_attn)  # [b, s, nh, hd]
-    from paddlefleetx_tpu.ops.flash_attention import flash_supported
-
-    if cfg.attn_impl != "flash" or not flash_supported(q.shape[1]):
-        # XLA attention (configured, or flash fell back on an unsupported
-        # seq): save the output by name so selective remat skips the O(s^2)
-        # recompute. The flash kernel instead saves its lse internally
-        # ("attn_lse") and re-runs one cheap fwd kernel in backward.
-        out = checkpoint_name(out, "attn_out")
 
     # row-parallel output projection: contraction over sharded heads -> psum
     out = jnp.einsum("bsnd,ndh->bsh", out, p["out_kernel"].astype(dtype))

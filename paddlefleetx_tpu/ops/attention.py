@@ -14,6 +14,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 
 def causal_mask_bias(seq_len: int, dtype) -> jax.Array:
@@ -89,7 +90,7 @@ def attention(
             # reference likewise disables dropout when flash is active,
             # hybrid_model.py:284-301)
             return flash_attention(q, k, v, causal=True)
-    return xla_attention(
+    out = xla_attention(
         q,
         k,
         v,
@@ -100,3 +101,9 @@ def attention(
         train=train,
         scale=scale,
     )
+    # Whenever the XLA path actually runs (configured, or flash fell back),
+    # name the output so selective remat can skip the O(s^2) recompute.
+    # The flash kernel instead names its lse internally ("attn_lse") and
+    # re-runs one cheap fwd kernel in backward. Tagging here (not at call
+    # sites) keeps the which-impl-ran decision in one place.
+    return checkpoint_name(out, "attn_out")

@@ -36,12 +36,15 @@ def _block_sizes(seq: int) -> Tuple[int, int]:
     # and 16.2ms at 128x128 for b16/h16/d64 fwd+bwd): fewer grid programs
     # amortize K/V HBM streaming; beats the stock jax.experimental Pallas
     # flash (26.7ms) and splash (25.8ms) kernels at this shape. Seqs not
-    # divisible by 512 fall back to the largest dividing power-of-two block
-    # so e.g. seq 768 keeps flash support instead of the quadratic XLA path.
+    # divisible by 512 use the largest dividing block so e.g. seq 768 keeps
+    # flash support; small seqs run as one block (pre-existing behavior);
+    # anything else reports unsupported and attention() falls back to XLA.
     for b in (512, 256, 128):
-        if seq % b == 0 or seq <= b:
-            return min(seq, b), min(seq, b)
-    return min(seq, 128), min(seq, 128)
+        if seq % b == 0:
+            return b, b
+    if seq < 256:
+        return seq, seq
+    return 256, 256  # does not divide seq -> flash_supported() False
 
 
 # ---------------------------------------------------------------------------

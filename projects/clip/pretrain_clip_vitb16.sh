@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# CLIP ViT-B/16 contrastive image-text pretrain
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/multimodal/clip/clip_vitb16_pt_1n8c.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# ERNIE-base MLM+NSP pretrain (reference projects/ernie/pretrain_ernie_base.sh)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/ernie/pretrain_ernie_base.yaml "$@"

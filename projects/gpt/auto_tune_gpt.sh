@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Mesh-layout sweep (reference auto-parallel tuner analogue, tools/auto.py --tune)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/auto.py -c configs/gpt/pretrain_gpt_345M_single.yaml --tune "$@"

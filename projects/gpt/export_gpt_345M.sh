@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Export GPT-345M to a StableHLO inference artifact (reference export_gpt_345M_single_card.sh)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/export.py -c configs/gpt/pretrain_gpt_345M_single.yaml "$@"

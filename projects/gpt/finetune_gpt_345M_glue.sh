@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-345M GLUE finetune (reference projects/gpt/finetune_gpt_345M_single_card_glue.sh)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/gpt/finetune_gpt_345M_glue.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Zero-shot text generation demo (reference tasks/gpt/generation.py path)
+set -e
+cd "$(dirname "$0")/../.."
+python tasks/gpt/generation.py -c configs/gpt/pretrain_gpt_345M_single.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Run inference from the exported artifact (reference projects/gpt/inference_gpt_345M_single_card.sh)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/inference.py -c configs/gpt/pretrain_gpt_345M_single.yaml "$@"

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# GPT-1.3B tensor-parallel-8 pretrain (reference pretrain_gpt_1.3B_dp8.sh;
+# TPU layout: model axis 8 over ICI)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/gpt/pretrain_gpt_1.3B_mp8.yaml "$@"

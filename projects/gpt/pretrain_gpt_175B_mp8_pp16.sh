@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# GPT-175B mp8 x pp16 interleaved-1F1B pretrain (reference
+# pretrain_gpt_175B_mp8_pp16.sh); run on every host with PFX_COORDINATOR_ADDRESS set
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/gpt/pretrain_gpt_175B_mp8_pp16.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-345M single-chip pretrain (reference projects/gpt/pretrain_gpt_345M_single_card.sh)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/gpt/pretrain_gpt_345M_single.yaml "$@"

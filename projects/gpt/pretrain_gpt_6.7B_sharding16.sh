@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-6.7B ZeRO-sharding-16 pretrain (reference pretrain_gpt_6.7B_sharding16.sh)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/gpt/pretrain_gpt_6.7B_sharding16.yaml "$@"

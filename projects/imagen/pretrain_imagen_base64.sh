@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Imagen base 64x64 text-to-image diffusion pretrain (reference
+# projects/imagen/run_imagen_text2im_64x64.sh)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/imagen/imagen_text2im_64_base.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Linear-classification probe on a frozen MoCo backbone (reference run_mocov*_lincls_in1k.sh)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/vis/moco/moco_lincls_in1k_1n8c.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# MoCo v1 contrastive pretrain (reference projects/moco/run_mocov1_pretrain_in1k.sh)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/vis/moco/mocov1_pt_in1k_1n8c.yaml "$@"

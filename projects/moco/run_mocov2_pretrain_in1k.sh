@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# MoCo v2 contrastive pretrain (reference projects/moco/run_mocov2_pretrain_in1k.sh)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/vis/moco/mocov2_pt_in1k_1n8c.yaml "$@"

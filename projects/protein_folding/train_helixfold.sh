@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# HelixFold (AlphaFold2-style) initial training with DAP/BP over the sep axis
+# (reference projects/protein_folding/helixfold/README)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/protein/helixfold_initial.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# ResNet50 ImageNet-1k supervised training
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/vis/resnet/resnet50_in1k_1n8c.yaml "$@"

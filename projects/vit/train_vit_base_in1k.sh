@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# ViT-B/16 ImageNet-1k pretrain (reference projects/vit/ViT_base_patch16_224_pt_in1k_1n8c.sh)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/vis/vit/ViT_base_patch16_224_pt_in1k_1n8c_dp.yaml "$@"

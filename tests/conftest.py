@@ -19,6 +19,16 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
+# Persistent compilation cache: XLA-CPU compiles dominate suite wall-clock
+# (a resnet18 engine test spends >70s compiling on one core); cached repeat
+# runs skip them. Keyed by jaxlib version internally, safe to keep around.
+_cache_dir = os.environ.get(
+    "PFX_TEST_CACHE", os.path.join(os.path.dirname(__file__), ".jax_cache")
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 
 @pytest.fixture
 def devices8():

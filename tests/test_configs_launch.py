@@ -17,6 +17,7 @@ ALL_CONFIGS = [
     ("configs/gpt/pretrain_gpt_345M_single.yaml", 1),
     ("configs/gpt/pretrain_gpt_1.3B_mp8.yaml", 8),
     ("configs/gpt/pretrain_gpt_6.7B_sharding16.yaml", 16),
+    ("configs/gpt/pretrain_gpt_175B_mp8_pp16.yaml", 128),
     ("configs/gpt/finetune_gpt_345M_glue.yaml", 1),
     ("configs/ernie/pretrain_ernie_base.yaml", 1),
     ("configs/t5/pretrain_t5_base.yaml", 1),
@@ -32,6 +33,24 @@ ALL_CONFIGS = [
     ("configs/vis/resnet/resnet50_in1k_1n8c.yaml", 8),
     ("configs/multimodal/clip/clip_vitb16_pt_1n8c.yaml", 8),
 ]
+
+
+def test_project_launchers_reference_real_files():
+    """Every projects/*.sh launcher points at a config and tool that exist
+    (reference ships projects/<model>/*.sh wrappers, SURVEY.md §1.1)."""
+    import glob
+    import re
+
+    scripts = glob.glob(os.path.join(REPO, "projects", "*", "*.sh"))
+    assert len(scripts) >= 15
+    for sh in scripts:
+        with open(sh) as f:
+            text = f.read()
+        m = re.search(r"python (\S+)(?:\s+-c\s+(\S+))?", text)
+        assert m, f"{sh}: no python invocation"
+        assert os.path.exists(os.path.join(REPO, m.group(1))), f"{sh}: {m.group(1)}"
+        if m.group(2):
+            assert os.path.exists(os.path.join(REPO, m.group(2))), f"{sh}: {m.group(2)}"
 
 
 @pytest.mark.parametrize("path,ndev", ALL_CONFIGS)

@@ -1,0 +1,26 @@
+"""Auto-parallel export entry point (reference tools/auto_export.py).
+
+In the reference, auto-parallel training produces per-rank static programs
+that need their own export path (`auto_dist{rank}.pdparams`,
+utils/config.py:599-606).  Under pjit/GSPMD there is no separate "auto"
+artifact: the same StableHLO export serves single-device and auto-parallel
+models, with shardings baked in at AOT-compile time by the serving mesh
+(core/inference_engine.py).  This entry point therefore delegates to
+tools/export.py — kept as a distinct CLI so reference launch scripts
+translate 1:1.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from export import main as export_main  # noqa: E402
+
+
+def main(argv=None):
+    return export_main(argv)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:] or None)
