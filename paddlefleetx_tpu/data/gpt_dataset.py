@@ -22,7 +22,12 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from paddlefleetx_tpu.data.indexed import build_doc_idx, build_sample_idx, build_shuffle_idx
+from paddlefleetx_tpu.data.indexed import (
+    build_blending_indices,
+    build_doc_idx,
+    build_sample_idx,
+    build_shuffle_idx,
+)
 from paddlefleetx_tpu.utils.log import logger
 from paddlefleetx_tpu.utils.registry import DATASETS
 
@@ -34,6 +39,14 @@ def _split_docs(num_docs: int, split: Sequence[float]):
     bounds = np.concatenate([[0], np.cumsum(split)])
     edges = (bounds * num_docs).astype(np.int64)
     return [(int(edges[i]), int(edges[i + 1])) for i in range(len(split))]
+
+
+def _mode_doc_range(num_docs: int, split: Sequence[float], mode: str):
+    """Doc range for a mode, falling back to all docs on a degenerate split."""
+    lo, hi = _split_docs(num_docs, split)[GPTDataset.MODES[mode]]
+    if hi <= lo:
+        lo, hi = 0, num_docs
+    return lo, hi
 
 
 @DATASETS.register("GPTDataset")
@@ -60,6 +73,11 @@ class GPTDataset:
             )
             if not files:
                 raise FileNotFoundError(f"no *_ids.npy under {input_dir}")
+            if len(files) > 1:
+                logger.warning(
+                    f"{input_dir} holds {len(files)} corpora; GPTDataset uses "
+                    f"'{files[0]}' only — use BlendedGPTDataset to mix them"
+                )
             data_prefix = os.path.join(input_dir, files[0])
         self.prefix = data_prefix
         self.seq_len = int(max_seq_len)
@@ -70,10 +88,7 @@ class GPTDataset:
         lens = idx["lens"].astype(np.int32)
         self.doc_offsets = np.concatenate([[0], np.cumsum(lens.astype(np.int64))])
 
-        ranges = _split_docs(len(lens), split)
-        lo, hi = ranges[self.MODES[mode]]
-        if hi <= lo:
-            lo, hi = 0, len(lens)  # degenerate split: use everything
+        lo, hi = _mode_doc_range(len(lens), split, mode)
         self.doc_lo = lo
         self.docs = np.arange(lo, hi, dtype=np.int32)
         self.sizes = lens[lo:hi]
@@ -152,6 +167,103 @@ class GPTDataset:
             "loss_mask": np.ones(self.seq_len, dtype=np.float32),
             "position_ids": np.arange(self.seq_len, dtype=np.int64),
         }
+
+
+def _natural_samples(prefix: str, split: Sequence[float], mode: str, seq_len: int) -> int:
+    """One-epoch sample count for a corpus split, from the lens file alone
+    (no index-map build needed; same formula as GPTDataset.__init__)."""
+    lens = np.load(prefix + "_idx.npz")["lens"].astype(np.int64)
+    lo, hi = _mode_doc_range(len(lens), split, mode)
+    toks = int(lens[lo:hi].sum())
+    return max((toks - 1) // seq_len, 1)
+
+
+@DATASETS.register("BlendedGPTDataset")
+class BlendedGPTDataset:
+    """Weighted mixture of GPT corpora (reference multi-dataset blending,
+    fast_index_map_helpers.cpp build_blending_indices :693-697): sample i
+    is drawn from the dataset whose emitted fraction lags its weight most,
+    giving a deterministic interleave that matches the weights exactly in
+    the limit.
+
+    Config: ``data_prefixes`` (list of mmap prefixes) or ``input_dir``
+    (every ``*_ids.npy`` found is a component); optional ``weights``
+    (defaults to size-proportional — equivalent to concatenation odds).
+    """
+
+    def __init__(
+        self,
+        input_dir: str = None,
+        data_prefixes: Optional[Sequence[str]] = None,
+        weights: Optional[Sequence[float]] = None,
+        split: Sequence[float] = (949, 50, 1),
+        max_seq_len: int = 1024,
+        num_samples: int = None,
+        mode: str = "Train",
+        seed: int = 1234,
+        build_cache: bool = True,
+        **_unused,
+    ):
+        if data_prefixes is None:
+            files = sorted(
+                f[: -len("_ids.npy")]
+                for f in os.listdir(input_dir)
+                if f.endswith("_ids.npy")
+            )
+            if not files:
+                raise FileNotFoundError(f"no *_ids.npy under {input_dir}")
+            data_prefixes = [os.path.join(input_dir, f) for f in files]
+        if len(data_prefixes) < 1:
+            raise ValueError("BlendedGPTDataset needs >=1 data_prefixes")
+
+        # natural (one-epoch) sizes are only needed for defaulted weights
+        # or num_samples — skip the N idx-file loads when both are explicit
+        naturals = None
+        if weights is None or num_samples is None:
+            naturals = [
+                _natural_samples(p, split, mode, int(max_seq_len))
+                for p in data_prefixes
+            ]
+        if weights is None:
+            weights = [float(n) for n in naturals]
+        if len(weights) != len(data_prefixes):
+            raise ValueError(
+                f"{len(weights)} weights for {len(data_prefixes)} datasets"
+            )
+        w = np.asarray(weights, dtype=np.float64)
+        if (w <= 0).any():
+            raise ValueError(f"weights must be positive, got {weights}")
+        w = w / w.sum()
+        if num_samples is None:
+            num_samples = int(sum(naturals))
+        self.num_samples = int(num_samples)
+
+        # each component must be able to serve its share (+0.5% slack, the
+        # reference's margin for the greedy interleave running slightly hot)
+        self.children = [
+            GPTDataset(
+                data_prefix=p,
+                split=split,
+                max_seq_len=max_seq_len,
+                num_samples=int(np.ceil(self.num_samples * wi * 1.005)) + 1,
+                mode=mode,
+                seed=seed + 31 * i,
+                build_cache=build_cache,
+            )
+            for i, (p, wi) in enumerate(zip(data_prefixes, w))
+        ]
+        self.ds_index, self.ds_sample = build_blending_indices(w, self.num_samples)
+        logger.info(
+            f"BlendedGPTDataset[{mode}] {len(self.children)} corpora, "
+            f"weights={np.round(w, 4).tolist()}, samples={self.num_samples}"
+        )
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        i = idx % self.num_samples
+        return self.children[int(self.ds_index[i])][int(self.ds_sample[i])]
 
 
 @DATASETS.register("LM_Eval_Dataset")

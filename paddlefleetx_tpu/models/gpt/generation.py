@@ -216,6 +216,13 @@ class GenerationConfig:
     forced_bos_token_id: int = -1
     forced_eos_token_id: int = -1
 
+    def __post_init__(self):
+        if self.decode_strategy not in ("sampling", "greedy_search", "beam_search"):
+            raise ValueError(
+                f"bad decode_strategy {self.decode_strategy!r}; "
+                "valid: sampling, greedy_search, beam_search"
+            )
+
 
 def _left_pad_prefill(prompt_len: int, prompt_lens: Optional[jax.Array]):
     """(pad_len [b], prefill position ids [b, P]) for left-padded buckets;

@@ -107,3 +107,50 @@ def test_lm_eval_overlap():
     assert it1["loss_mask"][:24].sum() == 0
     assert it1["loss_mask"][24:].sum() == 8
     assert it0["loss_mask"].sum() == 32
+
+
+def test_blended_gpt_dataset(tmp_path):
+    """BlendedGPTDataset mixes corpora at the requested weights and every
+    item has the standard GPT sample schema."""
+    from paddlefleetx_tpu.data.gpt_dataset import BlendedGPTDataset
+
+    p1 = write_synthetic_corpus(str(tmp_path / "a"), vocab_size=300, num_docs=12, seed=1)
+    p2 = write_synthetic_corpus(str(tmp_path / "b"), vocab_size=300, num_docs=12, seed=2)
+    ds = BlendedGPTDataset(
+        data_prefixes=[p1, p2],
+        weights=[3, 1],
+        max_seq_len=64,
+        num_samples=200,
+        split=(1, 0, 0),
+    )
+    assert len(ds) == 200
+    counts = np.bincount(ds.ds_index[:200], minlength=2)
+    assert abs(counts[0] - 150) <= 2 and abs(counts[1] - 50) <= 2, counts
+    item = ds[0]
+    assert item["tokens"].shape == (64,) and item["labels"].shape == (64,)
+    # deterministic across constructions
+    ds2 = BlendedGPTDataset(
+        data_prefixes=[p1, p2],
+        weights=[3, 1],
+        max_seq_len=64,
+        num_samples=200,
+        split=(1, 0, 0),
+    )
+    np.testing.assert_array_equal(ds.ds_index, ds2.ds_index)
+    np.testing.assert_array_equal(ds[17]["tokens"], ds2[17]["tokens"])
+
+
+def test_blended_default_weights_from_dir(tmp_path):
+    """input_dir form: every *_ids.npy participates, weights default to
+    size-proportional; GPTDataset warns-and-picks-first for the same dir."""
+    from paddlefleetx_tpu.data.gpt_dataset import BlendedGPTDataset
+
+    write_synthetic_corpus(str(tmp_path / "x"), vocab_size=200, num_docs=6, seed=3)
+    write_synthetic_corpus(str(tmp_path / "y"), vocab_size=200, num_docs=18, seed=4)
+    ds = BlendedGPTDataset(input_dir=str(tmp_path), max_seq_len=32, split=(1, 0, 0))
+    assert len(ds.children) == 2
+    # the bigger corpus dominates proportionally
+    frac_y = (ds.ds_index == 1).mean()
+    assert 0.5 < frac_y < 0.95
+    single = GPTDataset(input_dir=str(tmp_path), max_seq_len=32, split=(1, 0, 0))
+    assert single.prefix.endswith("x")

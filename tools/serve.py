@@ -1,0 +1,130 @@
+"""Generation serving CLI: stdin REPL or a minimal HTTP JSON endpoint.
+
+TPU-native counterpart of the reference's deploy path (InferenceEngine
+multi-rank predictor + projects/gpt/inference scripts): one process per
+host, TP over the serving mesh, bucketed prompts so repeat traffic reuses
+compiled decode artifacts (`core/serving.py`).
+
+Usage:
+  python tools/serve.py -c configs/gpt/pretrain_gpt_345M_single.yaml            # REPL
+  python tools/serve.py -c ... --port 8000                                       # HTTP
+      POST /generate {"prompt": "...", "max_tokens": 64}
+      GET  /healthz
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddlefleetx_tpu.utils.device import apply_platform_env
+
+apply_platform_env()  # PFX_PLATFORM=cpu etc., before backend init
+
+
+def build_server(config: str, overrides):
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.core.serving import GenerationServer
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import get_config
+
+    cfg = get_config(config, overrides=overrides)
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+
+    params = None
+    ckpt_dir = cfg.Engine.save_load.get("ckpt_dir")
+    if ckpt_dir:
+        import orbax.checkpoint as ocp
+
+        restored = ocp.StandardCheckpointer().restore(
+            os.path.join(os.path.abspath(ckpt_dir), "state")
+        )
+        params = restored["params"]
+
+    tok = None
+    tokenizer_dir = cfg.get("Generation", {}).get("tokenizer_dir")
+    if tokenizer_dir:
+        from paddlefleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+        tok = GPTTokenizer.from_pretrained(tokenizer_dir)
+
+    return GenerationServer(cfg, mesh, module, params=params, tokenizer=tok)
+
+
+def serve_http(server, port: int):
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # route through our logger instead
+            pass
+
+        def _json(self, code: int, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._json(200, {"ok": True, **server.stats})
+            else:
+                self._json(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._json(404, {"error": "unknown path"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                max_toks = req.get("max_tokens")
+                if "prompt" in req:
+                    texts = server.generate_text([req["prompt"]], max_dec_len=max_toks)
+                    return self._json(200, {"completion": texts[0]})
+                if "prompt_ids" in req:
+                    ids = server.generate_ids([req["prompt_ids"]], max_dec_len=max_toks)
+                    return self._json(200, {"completion_ids": ids[0]})
+                return self._json(400, {"error": "need prompt or prompt_ids"})
+            except Exception as e:  # noqa: BLE001 — report, keep serving
+                return self._json(500, {"error": str(e)})
+
+    httpd = HTTPServer(("0.0.0.0", port), Handler)
+    print(f"serving on :{port} (POST /generate, GET /healthz)", flush=True)
+    httpd.serve_forever()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-c", "--config", required=True)
+    ap.add_argument("-o", "--override", action="append", default=[])
+    ap.add_argument("--port", type=int, default=0, help="HTTP port (0 = stdin REPL)")
+    ap.add_argument("--no-warmup", action="store_true")
+    args = ap.parse_args(argv)
+
+    server = build_server(args.config, args.override)
+    if not args.no_warmup:
+        server.warmup()
+
+    if args.port:
+        return serve_http(server, args.port)
+
+    # REPL: one prompt per line -> completion (ids mode when no tokenizer)
+    print("prompt> ", end="", flush=True)
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            break
+        if server.tokenizer is not None:
+            print(server.generate_text([line])[0], flush=True)
+        else:
+            ids = [int(t) for t in line.split()]
+            print(" ".join(map(str, server.generate_ids([ids])[0])), flush=True)
+        print("prompt> ", end="", flush=True)
+
+
+if __name__ == "__main__":
+    main()
