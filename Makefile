@@ -7,7 +7,8 @@
 FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_optims.py tests/test_rigid.py tests/test_glue.py \
              tests/test_lm_eval.py tests/test_configs_launch.py \
-             tests/test_gpt_model.py tests/test_mesh_sharding.py
+             tests/test_gpt_model.py tests/test_mesh_sharding.py \
+             tests/test_serving.py
 
 test-fast:
 	python -m pytest $(FAST_FILES) -q -m "not slow" -x

@@ -56,6 +56,7 @@ def _ensure_synthetic_data(case: dict, name: str) -> list:
             os.path.join(data_dir, "corpus"),
             vocab_size=int(spec.get("vocab_size", 50304)),
             num_docs=int(spec.get("num_docs", 64)),
+            mean_len=int(spec.get("mean_len", 600)),
         )
         with open(spec_path, "w") as f:
             f.write(spec_str)

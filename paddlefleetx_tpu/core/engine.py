@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import json
 import os
 import time
 from typing import Any, Dict, Iterable, Optional
@@ -134,6 +135,10 @@ class Engine:
         self.save_steps = int(eng.get("save_load", {}).get("save_steps", 0) or 0)
         self.output_dir = eng.get("save_load", {}).get("output_dir", "./output")
         self.global_batch_size = int(cfg.Global.global_batch_size)
+        # machine-readable metrics stream: one JSON line per logging step
+        # (the TIPC-style harness and dashboards parse this instead of
+        # regexing the console log; "" disables)
+        self.metrics_file = eng.get("metrics_file", "")
 
         # fp16 parity path: dynamic loss scaling (reference DynamicLossScaler
         # apis/amp.py:193-234).  bf16 (the TPU default) needs no scaler —
@@ -496,6 +501,20 @@ class Engine:
     def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
         return jax.tree.map(lambda x: jax.device_put(x, self.batch_spec), batch)
 
+    def _write_metrics(self, record: Dict) -> None:
+        if not self.metrics_file:
+            return
+        if jax.process_index() != 0:
+            # multi-host: one writer, or a shared-storage file double-counts
+            return
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(self.metrics_file)), exist_ok=True)
+            with open(self.metrics_file, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except OSError as e:
+            logger.warning(f"metrics_file write failed (disabling): {e}")
+            self.metrics_file = ""
+
     def fit(self, train_loader: Iterable, eval_loader: Optional[Iterable] = None):
         """Training loop (reference fit/_fit_impl eager_engine.py:422-520)."""
         t_last = time.time()
@@ -536,6 +555,16 @@ class Engine:
                     f"step {step}/{self.max_steps} loss: {float(metrics['loss']):.5f} "
                     f"lr: {float(metrics['lr']):.3e} grad_norm: {float(metrics['grad_norm']):.3f} "
                     f"ips: {ips:,.0f} tokens/s ({ips/self.mesh.size:,.0f}/device)"
+                )
+                self._write_metrics(
+                    {
+                        "step": step,
+                        "loss": float(metrics["loss"]),
+                        "lr": float(metrics["lr"]),
+                        "grad_norm": float(metrics["grad_norm"]),
+                        "ips": round(ips, 1),
+                        "consumed_samples": self._consumed_samples,
+                    }
                 )
                 t_last = time.time()
                 window_tokens = 0

@@ -93,11 +93,11 @@ class GenerationServer:
         """Generate continuations for a batch of token-id prompts."""
         import dataclasses
 
-        gen = self.gen
-        if max_dec_len is not None:
-            gen = dataclasses.replace(gen, max_dec_len=int(max_dec_len))
+        if not prompts or any(len(p) == 0 for p in prompts):
+            raise ValueError("prompts must be a non-empty list of non-empty id lists")
         from paddlefleetx_tpu.parallel.mesh import data_parallel_world
 
+        gen = self.gen
         # the batch dim is sharded over (data, fsdp): pad the request batch
         # to a dp-world multiple (replicas of the last prompt) so any mesh
         # serves any request size; batched traffic rides the data axis
@@ -107,6 +107,26 @@ class GenerationServer:
         while len(batch) % dpw:
             batch.append(batch[-1])
         prompt, prompt_lens = pad_prompts(batch, gen.pad_token_id, multiple=self.bucket)
+
+        # clamp + bucket the decode length: an uncapped client value would
+        # key an unbounded number of jit compiles (and a huge one would try
+        # to allocate a decode buffer that long); the cap is whatever room
+        # the model context leaves after the padded prompt bucket
+        limit = int(self.module.config.max_position_embeddings) - prompt.shape[1]
+        if limit < 1:
+            raise ValueError(
+                f"prompt bucket {prompt.shape[1]} leaves no decode room in "
+                f"context {self.module.config.max_position_embeddings}"
+            )
+        if max_dec_len is None:
+            # configured default: honor it exactly (one compile), just clamp
+            trim = min(gen.max_dec_len, limit)
+            run_len = trim
+        else:
+            trim = max(1, min(int(max_dec_len), limit))
+            run_len = min(-(-trim // 32) * 32, limit)  # 32-bucket the compile key
+        if run_len != gen.max_dec_len:
+            gen = dataclasses.replace(gen, max_dec_len=run_len)
         self._key, k = jax.random.split(self._key)
         t0 = time.time()
         with self.mesh:
@@ -120,7 +140,7 @@ class GenerationServer:
         dt = time.time() - t0
         outs: List[List[int]] = []
         for row in out:
-            ids = row.tolist()
+            ids = row.tolist()[:trim]
             if gen.eos_token_id in ids:
                 ids = ids[: ids.index(gen.eos_token_id)]
             outs.append(ids)

@@ -1,0 +1,24 @@
+"""Small checkpoint helpers shared by the CLI tools.
+
+The full train-state save/load contract lives in the Engine
+(core/engine.py, orbax + meta.json); deploy-side tools only ever need the
+params subtree of a saved state — this is that one snippet, in one place.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+def load_pretrained_params(cfg) -> Optional[Any]:
+    """Params from ``Engine.save_load.ckpt_dir`` (None when unset)."""
+    ckpt_dir = cfg.get("Engine", {}).get("save_load", {}).get("ckpt_dir")
+    if not ckpt_dir:
+        return None
+    import orbax.checkpoint as ocp
+
+    restored = ocp.StandardCheckpointer().restore(
+        os.path.join(os.path.abspath(ckpt_dir), "state")
+    )
+    return restored["params"]

@@ -185,3 +185,22 @@ def test_fp16_overflow_shrinks_scale_and_skips(tmp_path, devices8):
     assert float(engine.state.scaler["scale"]) == 2.0**30
     for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(engine.state.params)):
         np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_metrics_file_stream(tmp_path, devices8):
+    """Engine.metrics_file writes one parseable JSON line per logging step."""
+    import json
+
+    cfg = tiny_cfg(tmp_path)
+    cfg.Engine.metrics_file = str(tmp_path / "metrics.jsonl")
+    cfg.Engine.max_steps = 8
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    loader = build_dataloader(cfg, "Train")
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        engine.fit(loader)
+    lines = [json.loads(x) for x in open(cfg.Engine.metrics_file)]
+    assert len(lines) == 2  # logging_freq=4, max_steps=8
+    assert {"step", "loss", "lr", "grad_norm", "ips", "consumed_samples"} <= set(lines[0])
+    assert lines[-1]["step"] == 8 and np.isfinite(lines[-1]["loss"])

@@ -113,3 +113,23 @@ def test_http_endpoint(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_max_tokens_clamped_and_bucketed(server):
+    """Client max_dec_len is clamped to the model context and bucketed so
+    the jit-cache cardinality stays bounded."""
+    outs = server.generate_ids([[1, 2]], max_dec_len=10**9)
+    assert len(outs[0]) <= server.module.config.max_position_embeddings
+    server.generate_ids([[1, 2]], max_dec_len=3)
+    before = len(server._compiled)
+    server.generate_ids([[1, 2]], max_dec_len=7)   # same 32-bucket: no new compile
+    assert len(server._compiled) == before
+    outs = server.generate_ids([[1, 2]], max_dec_len=3)
+    assert len(outs[0]) <= 3
+
+
+def test_empty_prompt_rejected(server):
+    with pytest.raises(ValueError, match="non-empty"):
+        server.generate_ids([])
+    with pytest.raises(ValueError, match="non-empty"):
+        server.generate_ids([[]])

@@ -26,15 +26,11 @@ def main(argv=None):
     init_dist_env(cfg)
     module = build_module(cfg)
 
-    params = module.init_params(get_seed_tracker().params_key())
-    ckpt_dir = cfg.Engine.save_load.get("ckpt_dir")
-    if ckpt_dir:
-        import orbax.checkpoint as ocp
+    from paddlefleetx_tpu.utils.checkpoint import load_pretrained_params
 
-        restored = ocp.StandardCheckpointer().restore(
-            os.path.join(os.path.abspath(ckpt_dir), "state")
-        )
-        params = restored["params"]
+    params = load_pretrained_params(cfg)
+    if params is None:
+        params = module.init_params(get_seed_tracker().params_key())
 
     from paddlefleetx_tpu.models.gpt import model as gpt
 

@@ -34,15 +34,9 @@ def build_server(config: str, overrides):
     mesh = init_dist_env(cfg)
     module = build_module(cfg)
 
-    params = None
-    ckpt_dir = cfg.Engine.save_load.get("ckpt_dir")
-    if ckpt_dir:
-        import orbax.checkpoint as ocp
+    from paddlefleetx_tpu.utils.checkpoint import load_pretrained_params
 
-        restored = ocp.StandardCheckpointer().restore(
-            os.path.join(os.path.abspath(ckpt_dir), "state")
-        )
-        params = restored["params"]
+    params = load_pretrained_params(cfg)
 
     tok = None
     tokenizer_dir = cfg.get("Generation", {}).get("tokenizer_dir")
@@ -118,11 +112,14 @@ def main(argv=None):
         line = line.strip()
         if not line:
             break
-        if server.tokenizer is not None:
-            print(server.generate_text([line])[0], flush=True)
-        else:
-            ids = [int(t) for t in line.split()]
-            print(" ".join(map(str, server.generate_ids([ids])[0])), flush=True)
+        try:
+            if server.tokenizer is not None:
+                print(server.generate_text([line])[0], flush=True)
+            else:
+                ids = [int(t) for t in line.split()]
+                print(" ".join(map(str, server.generate_ids([ids])[0])), flush=True)
+        except ValueError as e:  # bad ids / empty prompt: report, keep serving
+            print(f"error: {e}", flush=True)
         print("prompt> ", end="", flush=True)
 
 
