@@ -507,10 +507,16 @@ class Engine:
         if jax.process_index() != 0:
             # multi-host: one writer, or a shared-storage file double-counts
             return
+        # fresh runs truncate (a retry would otherwise interleave two step
+        # sequences); checkpoint-resumed runs append to the prior stream
+        mode = getattr(self, "_metrics_mode", None)
+        if mode is None:
+            mode = "a" if getattr(self, "_resumed", False) else "w"
         try:
             os.makedirs(os.path.dirname(os.path.abspath(self.metrics_file)), exist_ok=True)
-            with open(self.metrics_file, "a") as f:
+            with open(self.metrics_file, mode) as f:
                 f.write(json.dumps(record) + "\n")
+            self._metrics_mode = "a"
         except OSError as e:
             logger.warning(f"metrics_file write failed (disabling): {e}")
             self.metrics_file = ""
@@ -667,6 +673,7 @@ class Engine:
             meta = json.load(f)
         self._consumed_samples = int(meta.get("consumed_samples", 0))
         self._step = int(meta["step"])
+        self._resumed = True  # metrics stream appends instead of truncating
         scaler = None
         if self.use_loss_scaling:
             scaler = {

@@ -10,12 +10,18 @@ import subprocess
 import tempfile
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "helpers.cpp")
+_SRCS = [os.path.join(_DIR, "helpers.cpp"), os.path.join(_DIR, "bpe.cpp")]
 _SO = os.path.join(_DIR, "libpfx_helpers.so")
 
 
 def build(force: bool = False) -> str:
-    if force or not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+    # tolerate a partial checkout: the index helpers must keep working even
+    # if an optional source (bpe.cpp) is missing
+    srcs = [s for s in _SRCS if os.path.exists(s)]
+    if not srcs:
+        raise FileNotFoundError(f"no C++ sources found in {_DIR}")
+    src_mtime = max(os.path.getmtime(s) for s in srcs)
+    if force or not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime:
         # build to a temp name then rename: concurrent ranks racing the build
         # each produce a complete .so (reference rank0-builds + others poll;
         # atomic rename is simpler and lock-free)
@@ -23,7 +29,7 @@ def build(force: bool = False) -> str:
         os.close(fd)
         try:
             subprocess.run(
-                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp],
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", *srcs, "-o", tmp],
                 check=True,
                 capture_output=True,
             )
@@ -40,4 +46,19 @@ def build_and_load() -> ctypes.CDLL:
     lib.build_blending_indices.restype = None
     lib.build_mapping.restype = ctypes.c_int64
     lib.build_blocks_mapping.restype = ctypes.c_int64
+    if hasattr(lib, "bpe_new"):  # optional module (bpe.cpp)
+        lib.bpe_new.restype = ctypes.c_void_p
+        lib.bpe_new.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.bpe_free.restype = None
+        lib.bpe_free.argtypes = [ctypes.c_void_p]
+        lib.bpe_encode_word.restype = ctypes.c_int32
+        lib.bpe_encode_word.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
     return lib

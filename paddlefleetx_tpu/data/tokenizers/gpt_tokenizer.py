@@ -45,6 +45,75 @@ def _get_pairs(word: Tuple[str, ...]) -> set:
     return {(word[i], word[i + 1]) for i in range(len(word) - 1)}
 
 
+class _NativeBpe:
+    """ctypes wrapper over data/cpp/bpe.cpp — raw-byte vocab + merge ranks
+    (tokens containing non-byte-mappable chars, i.e. special tokens, are
+    excluded; the caller falls back to Python for them)."""
+
+    def __init__(self, encoder: Dict[str, int], bpe_ranks, byte_decoder):
+        import ctypes
+        import struct
+
+        from paddlefleetx_tpu.data.cpp.build import build_and_load
+
+        self._lib = build_and_load()
+
+        def to_bytes(mapped: str) -> Optional[bytes]:
+            try:
+                return bytes(byte_decoder[c] for c in mapped)
+            except KeyError:
+                return None
+
+        # vocab blob: ids must be the token's real id -> emit a dense list.
+        # Non-mappable tokens (specials) get a placeholder longer than the
+        # 4096-byte word limit, so no queryable symbol can ever collide.
+        placeholder = b"\x00" * 5000
+        n = max(encoder.values()) + 1
+        toks = [placeholder] * n
+        for t, i in encoder.items():
+            raw = to_bytes(t)
+            if raw is not None:
+                toks[i] = raw
+        parts = [struct.pack("<i", n)]
+        parts += [struct.pack("<i", len(t)) + t for t in toks]
+        vocab_blob = b"".join(parts)
+
+        merges = sorted(bpe_ranks.items(), key=lambda kv: kv[1])
+        mparts = [struct.pack("<i", len(merges))]
+        for (a, b), _rank in merges:
+            ra, rb = to_bytes(a), to_bytes(b)
+            if ra is None or rb is None:  # keep rank indices aligned
+                ra, rb = placeholder, placeholder
+            mparts.append(struct.pack("<i", len(ra)) + ra)
+            mparts.append(struct.pack("<i", len(rb)) + rb)
+        merge_blob = b"".join(mparts)
+
+        self._handle = self._lib.bpe_new(
+            vocab_blob, len(vocab_blob), merge_blob, len(merge_blob)
+        )
+        if not self._handle:
+            raise RuntimeError("bpe_new failed")
+        self._ctypes = ctypes
+        self._buf = (ctypes.c_int32 * 4096)()
+
+    def encode_word(self, raw: bytes) -> Optional[List[int]]:
+        if len(raw) > 4096:
+            return None
+        n = self._lib.bpe_encode_word(
+            self._handle, raw, len(raw), self._buf, len(self._buf)
+        )
+        if n < 0:
+            return None
+        return list(self._buf[:n])
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.bpe_free(self._handle)
+        except Exception:
+            pass
+
+
 @TOKENIZERS.register("GPTTokenizer")
 class GPTTokenizer:
     def __init__(self, vocab_file: str, merges_file: str, eos_token: str = "<|endoftext|>"):
@@ -64,6 +133,18 @@ class GPTTokenizer:
         self.eos_token = eos_token
         self.eos_token_id = self.encoder.get(eos_token)
         self.pad_token_id = self.eos_token_id
+        # native merge engine (data/cpp/bpe.cpp): byte-level BPE is
+        # isomorphic under the byte->unicode map, so the C++ side works on
+        # raw bytes; special tokens (not byte-mappable) stay Python-side
+        self._native = None
+        self._id_cache: Dict[bytes, List[int]] = {}
+        try:
+            self._native = _NativeBpe(self.encoder, self.bpe_ranks, self.byte_decoder)
+        except Exception as e:  # no compiler / build failure: pure-Python path
+            from paddlefleetx_tpu.utils.log import logger
+
+            logger.warning(f"native BPE unavailable ({e!r}); using Python merge loop")
+            self._native = None
 
     @property
     def vocab_size(self) -> int:
@@ -107,6 +188,18 @@ class GPTTokenizer:
 
     def encode(self, text: str) -> List[int]:
         ids: List[int] = []
+        if self._native is not None:
+            for tok in re.findall(_WORD_PAT, text):
+                raw = tok.encode("utf-8")
+                got = self._id_cache.get(raw)
+                if got is None:
+                    got = self._native.encode_word(raw)
+                    if got is None:  # symbol outside the byte vocab
+                        mapped = "".join(self.byte_encoder[b] for b in raw)
+                        got = [self.encoder[t] for t in self._bpe(mapped).split(" ")]
+                    self._id_cache[raw] = got
+                ids.extend(got)
+            return ids
         for tok in re.findall(_WORD_PAT, text):
             mapped = "".join(self.byte_encoder[b] for b in tok.encode("utf-8"))
             ids.extend(self.encoder[t] for t in self._bpe(mapped).split(" "))

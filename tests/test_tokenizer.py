@@ -115,3 +115,39 @@ def test_t5_sentinel_descending():
     t = T5Tokenizer.from_tiny_corpus(CORPUS, num_extra_ids=10)
     assert t.extra_id(0) == t.vocab_size - 1
     assert t.extra_id(9) == t.vocab_size - 10
+
+
+def test_native_bpe_matches_python(tok, tmp_path):
+    """The C++ merge engine (data/cpp/bpe.cpp) produces exactly the Python
+    ids on mixed text, including unicode and whitespace runs."""
+    texts = [
+        "hello hello world",
+        "  spaces\tand\nnewlines  ",
+        "unicode: café 你好 \U0001f600!",
+        "numbers 12345 and punct!!! ...",
+        "hellohellohello",
+    ]
+    if tok._native is None:
+        import pytest
+
+        pytest.skip("no native build available")
+    for t in texts:
+        fast = tok.encode(t)
+        # force pure-Python: temporarily drop the native engine
+        native, tok._native = tok._native, None
+        tok._id_cache.clear()
+        slow = tok.encode(t)
+        tok._native = native
+        assert fast == slow, (t, fast, slow)
+        assert tok.decode(fast) == t
+
+
+def test_native_bpe_specials_fall_back(tok):
+    """Special tokens (not byte-mappable) keep working via the Python path."""
+    if tok._native is None:
+        import pytest
+
+        pytest.skip("no native build available")
+    ids = tok.encode("hello")
+    assert tok.decode(ids) == "hello"
+    assert tok.eos_token_id is not None
