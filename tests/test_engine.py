@@ -97,6 +97,8 @@ def test_layout_loss_parity_first_step(tmp_path, devices8):
         "mp8": {"mp_degree": 8},
         "dp2mp4": {"mp_degree": 4},
         "fsdp": {"sharding": {"sharding_degree": 8, "sharding_stage": 2}},
+        "dp2mp2pp2": {"mp_degree": 2, "pp_degree": 2},
+        "dp2mp2sep2": {"mp_degree": 2, "sep_degree": 2},
     }.items():
         cfg = tiny_cfg(tmp_path, **dist)
         losses, _ = _losses_from_run(cfg, steps=2)
