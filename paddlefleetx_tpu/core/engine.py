@@ -636,10 +636,13 @@ class Engine:
         if self.state.scaler is not None:
             meta["loss_scale"] = float(self.state.scaler["scale"])
             meta["scaler_good_steps"] = int(self.state.scaler["good_steps"])
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            import json
-
+        # meta.json is the checkpoint's completeness marker (written last,
+        # checked by latest_checkpoint): write atomically so a crash can
+        # never leave a truncated marker that wedges the restart loop
+        tmp = os.path.join(path, "meta.json.tmp")
+        with open(tmp, "w") as f:
             json.dump(meta, f)
+        os.replace(tmp, os.path.join(path, "meta.json"))
         logger.info(f"saved checkpoint: {path}")
         return path
 

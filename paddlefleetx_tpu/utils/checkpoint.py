@@ -11,6 +11,33 @@ import os
 from typing import Any, Optional
 
 
+def latest_checkpoint(output_dir: str) -> Optional[str]:
+    """Newest ``step_N`` checkpoint dir under ``output_dir`` (None if none).
+
+    Only complete checkpoints count: the Engine writes meta.json last (and
+    atomically), so a dir without a *parseable* meta.json is a crashed save
+    and is skipped — the crash-loop then falls back to the previous one.
+    """
+    import json
+
+    best_step, best = -1, None
+    if not os.path.isdir(output_dir):
+        return None
+    for name in os.listdir(output_dir):
+        if not name.startswith("step_"):
+            continue
+        path = os.path.join(output_dir, name)
+        try:
+            step = int(name[len("step_"):])
+            with open(os.path.join(path, "meta.json")) as f:
+                json.load(f)
+        except (ValueError, OSError, json.JSONDecodeError):
+            continue
+        if step > best_step:
+            best_step, best = step, path
+    return best
+
+
 def load_pretrained_params(cfg) -> Optional[Any]:
     """Params from ``Engine.save_load.ckpt_dir`` (None when unset)."""
     ckpt_dir = cfg.get("Engine", {}).get("save_load", {}).get("ckpt_dir")

@@ -206,3 +206,32 @@ def test_metrics_file_stream(tmp_path, devices8):
     assert len(lines) == 2  # logging_freq=4, max_steps=8
     assert {"step", "loss", "lr", "grad_norm", "ips", "consumed_samples"} <= set(lines[0])
     assert lines[-1]["step"] == 8 and np.isfinite(lines[-1]["loss"])
+
+
+def test_latest_checkpoint_selection(tmp_path):
+    """latest_checkpoint picks the highest complete step dir and skips
+    crash-truncated saves (no meta.json)."""
+    from paddlefleetx_tpu.utils.checkpoint import latest_checkpoint
+
+    assert latest_checkpoint(str(tmp_path / "missing")) is None
+    for step, complete in [(2, True), (10, True), (30, False)]:
+        d = tmp_path / f"step_{step}"
+        d.mkdir()
+        if complete:
+            (d / "meta.json").write_text("{}")
+    (tmp_path / "step_bogus").mkdir()
+    assert latest_checkpoint(str(tmp_path)).endswith("step_10")
+
+
+def test_latest_checkpoint_skips_corrupt_meta(tmp_path):
+    """A crash-truncated meta.json must not wedge the restart loop: the
+    newest PARSEABLE checkpoint wins."""
+    from paddlefleetx_tpu.utils.checkpoint import latest_checkpoint
+
+    good = tmp_path / "step_4"
+    good.mkdir()
+    (good / "meta.json").write_text('{"step": 4}')
+    bad = tmp_path / "step_9"
+    bad.mkdir()
+    (bad / "meta.json").write_text('{"step": 9')  # truncated write
+    assert latest_checkpoint(str(tmp_path)).endswith("step_4")
