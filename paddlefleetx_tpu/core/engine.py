@@ -299,6 +299,39 @@ class Engine:
 
         t0 = time.time()
         state = make_state(key)
+        pretrained = self.cfg.Engine.get("save_load", {}).get("pretrained_params")
+        if pretrained:
+            # params-only warm start (e.g. tools/convert_hf_gpt2.py output):
+            # optimizer state stays fresh, unlike ckpt_dir full-state resume
+            from paddlefleetx_tpu.utils.checkpoint import restore_params
+
+            loaded = restore_params(pretrained)
+            ref, got = jax.tree.structure(state.params), jax.tree.structure(loaded)
+            if ref != got:
+                raise ValueError(
+                    f"pretrained_params tree mismatch: model {ref} vs ckpt {got}"
+                )
+            mismatched = [
+                f"{jax.tree_util.keystr(kp)}: model {t.shape} vs ckpt {np.shape(n)}"
+                for (kp, t), n in zip(
+                    jax.tree_util.tree_leaves_with_path(state.params),
+                    jax.tree.leaves(loaded),
+                )
+                if tuple(t.shape) != tuple(np.shape(n))
+            ]
+            if mismatched:
+                raise ValueError(
+                    "pretrained_params shape mismatch (hint: --pad-vocab-to "
+                    "in tools/convert_hf_gpt2.py must match Model.vocab_size):\n  "
+                    + "\n  ".join(mismatched)
+                )
+            loaded = jax.tree.map(
+                lambda t, n: jax.device_put(np.asarray(n, t.dtype), t.sharding),
+                state.params,
+                loaded,
+            )
+            state = dataclasses.replace(state, params=loaded)
+            logger.info(f"pretrained params loaded from {pretrained}")
         if hasattr(self.module, "post_init_state"):
             # module hook for installing pretrained weights into fresh state
             # (e.g. MOCOClsModule's frozen backbone, moco_module.py:160-180)

@@ -1,0 +1,104 @@
+"""HF GPT-2 checkpoint -> native param tree.
+
+The reference ships pretrained-weight download/convert tooling
+(utils/download.py + per-model checkpoint loaders); the TPU-native
+equivalent imports the ubiquitous HuggingFace GPT-2 format, so a user
+switching frameworks can bring standard weights.  Mapping notes:
+
+- HF ``Conv1D`` weights are already [in, out] — no transpose needed.
+- ``c_attn`` packs q|k|v along the output dim: [h, 3h] reshapes to
+  [h, 3, nh, hd], matching the fused qkv einsum ``bsh,htnd->bstnd``.
+- activations (gelu tanh-approx) and LN eps (1e-5) already agree.
+- the LM head is tied to the word embedding in both implementations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from paddlefleetx_tpu.models.gpt.config import GPTConfig
+
+
+def hf_gpt2_config(hf_cfg, **overrides) -> GPTConfig:
+    """GPTConfig from a transformers GPT2Config."""
+    kw = dict(
+        vocab_size=int(hf_cfg.vocab_size),
+        hidden_size=int(hf_cfg.n_embd),
+        num_layers=int(hf_cfg.n_layer),
+        num_attention_heads=int(hf_cfg.n_head),
+        max_position_embeddings=int(hf_cfg.n_positions),
+    )
+    kw.update(overrides)
+    return GPTConfig(**kw)
+
+
+def convert_hf_gpt2_state_dict(
+    sd: Dict[str, "np.ndarray"], cfg: GPTConfig, pad_vocab_to: Optional[int] = None
+) -> Dict:
+    """torch/HF ``GPT2LMHeadModel.state_dict()`` -> stacked param tree.
+
+    ``sd`` values may be torch tensors or numpy arrays.  ``pad_vocab_to``
+    grows the embedding with zero rows (MXU-friendly multiples of 128); the
+    model config must then use the padded vocab_size.
+    """
+
+    def get(name):
+        v = sd[name]
+        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+
+    h, L = cfg.hidden_size, cfg.num_layers
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+
+    word = get("transformer.wte.weight").astype(np.float32)
+    if pad_vocab_to is not None:
+        if pad_vocab_to < word.shape[0]:
+            raise ValueError(f"pad_vocab_to {pad_vocab_to} < vocab {word.shape[0]}")
+        pad = np.zeros((pad_vocab_to - word.shape[0], h), np.float32)
+        word = np.concatenate([word, pad], axis=0)
+    if word.shape[0] != cfg.vocab_size:
+        raise ValueError(
+            f"config vocab_size {cfg.vocab_size} != embedding rows {word.shape[0]}"
+        )
+
+    def stack(fmt, reshape=None):
+        arrs = []
+        for i in range(L):
+            a = get(fmt.format(i=i)).astype(np.float32)
+            arrs.append(a.reshape(reshape) if reshape is not None else a)
+        return np.stack(arrs)
+
+    params = {
+        "embeddings": {
+            "word": word,
+            "position": get("transformer.wpe.weight").astype(np.float32),
+        },
+        "layers": {
+            "ln_1": {
+                "scale": stack("transformer.h.{i}.ln_1.weight"),
+                "bias": stack("transformer.h.{i}.ln_1.bias"),
+            },
+            "attn": {
+                "qkv_kernel": stack("transformer.h.{i}.attn.c_attn.weight", (h, 3, nh, hd)),
+                "qkv_bias": stack("transformer.h.{i}.attn.c_attn.bias", (3, nh, hd)),
+                "out_kernel": stack("transformer.h.{i}.attn.c_proj.weight", (nh, hd, h)),
+                "out_bias": stack("transformer.h.{i}.attn.c_proj.bias"),
+            },
+            "ln_2": {
+                "scale": stack("transformer.h.{i}.ln_2.weight"),
+                "bias": stack("transformer.h.{i}.ln_2.bias"),
+            },
+            "mlp": {
+                "fc_in_kernel": stack("transformer.h.{i}.mlp.c_fc.weight"),
+                "fc_in_bias": stack("transformer.h.{i}.mlp.c_fc.bias"),
+                "fc_out_kernel": stack("transformer.h.{i}.mlp.c_proj.weight"),
+                "fc_out_bias": stack("transformer.h.{i}.mlp.c_proj.bias"),
+            },
+        },
+        "final_ln": {
+            "scale": get("transformer.ln_f.weight").astype(np.float32),
+            "bias": get("transformer.ln_f.bias").astype(np.float32),
+        },
+    }
+    return params

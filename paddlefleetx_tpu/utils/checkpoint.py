@@ -38,14 +38,35 @@ def latest_checkpoint(output_dir: str) -> Optional[str]:
     return best
 
 
+def restore_params(ckpt_dir: str) -> Any:
+    """Params from either checkpoint layout: a full Engine state dir
+    (``state/`` holding params+opt_state) or a params-only dir
+    (``params/``, e.g. from tools/convert_hf_gpt2.py)."""
+    import orbax.checkpoint as ocp
+
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    if os.path.isdir(os.path.join(ckpt_dir, "params")):
+        return ocp.StandardCheckpointer().restore(os.path.join(ckpt_dir, "params"))
+    # full train-state checkpoint: partially restore ONLY the params subtree
+    # (a standard restore would materialize the optimizer moments — ~2x the
+    # param bytes — on the host just to throw them away)
+    import jax
+
+    path = os.path.join(ckpt_dir, "state")
+    ckptr = ocp.PyTreeCheckpointer()
+    meta = ckptr.metadata(path)
+    tree = getattr(meta, "item_metadata", meta)
+    tree = getattr(tree, "tree", tree)
+    item = {"params": jax.tree.map(lambda _m: 0.0, dict(tree)["params"])}
+    restored = ckptr.restore(
+        path, args=ocp.args.PyTreeRestore(item=item, partial_restore=True)
+    )
+    return restored["params"]
+
+
 def load_pretrained_params(cfg) -> Optional[Any]:
     """Params from ``Engine.save_load.ckpt_dir`` (None when unset)."""
     ckpt_dir = cfg.get("Engine", {}).get("save_load", {}).get("ckpt_dir")
     if not ckpt_dir:
         return None
-    import orbax.checkpoint as ocp
-
-    restored = ocp.StandardCheckpointer().restore(
-        os.path.join(os.path.abspath(ckpt_dir), "state")
-    )
-    return restored["params"]
+    return restore_params(ckpt_dir)

@@ -1,0 +1,119 @@
+"""HF GPT-2 checkpoint import: logits parity against the transformers
+implementation (an external oracle for the whole GPT forward), plus the
+params-only warm-start path through the Engine."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+
+from paddlefleetx_tpu.models.gpt import model as gpt  # noqa: E402
+from paddlefleetx_tpu.models.gpt.convert import (  # noqa: E402
+    convert_hf_gpt2_state_dict,
+    hf_gpt2_config,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    hf_cfg = GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    return GPT2LMHeadModel(hf_cfg).eval()
+
+
+def test_logits_match_transformers(hf_model):
+    cfg = hf_gpt2_config(
+        hf_model.config,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        dtype="float32",
+    )
+    params = convert_hf_gpt2_state_dict(hf_model.state_dict(), cfg)
+    tokens = np.random.default_rng(0).integers(0, 96, (2, 16))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(gpt.forward(params, tokens, cfg, train=False))
+    np.testing.assert_allclose(ours, ref, atol=2e-5, rtol=1e-5)
+
+
+def test_vocab_padding(hf_model):
+    cfg = hf_gpt2_config(
+        hf_model.config, vocab_size=128,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0, dtype="float32",
+    )
+    params = convert_hf_gpt2_state_dict(hf_model.state_dict(), cfg, pad_vocab_to=128)
+    assert params["embeddings"]["word"].shape == (128, 32)
+    # real-token logits unchanged by padding
+    cfg0 = hf_gpt2_config(
+        hf_model.config,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0, dtype="float32",
+    )
+    p0 = convert_hf_gpt2_state_dict(hf_model.state_dict(), cfg0)
+    tokens = np.random.default_rng(1).integers(0, 96, (1, 8))
+    a = np.asarray(gpt.forward(p0, tokens, cfg0, train=False))
+    b = np.asarray(gpt.forward(params, tokens, cfg, train=False))[..., :96]
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_engine_pretrained_warm_start(hf_model, tmp_path, devices8):
+    """Converted checkpoint -> Engine.save_load.pretrained_params: the
+    engine starts from the imported weights on a sharded mesh."""
+    import orbax.checkpoint as ocp
+
+    from paddlefleetx_tpu.core.engine import Engine
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    cfg = hf_gpt2_config(
+        hf_model.config,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0, dtype="float32",
+    )
+    params = convert_hf_gpt2_state_dict(hf_model.state_dict(), cfg)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(str(tmp_path / "conv" / "params"), params, force=True)
+    ckptr.wait_until_finished()
+
+    ecfg = AttrDict.from_nested(
+        {
+            "Global": {"global_batch_size": 8, "seed": 5},
+            "Engine": {
+                "max_steps": 1,
+                "eval_freq": 0,
+                "logging_freq": 100,
+                "mix_precision": {"enable": False},
+                "save_load": {
+                    "save_steps": 0,
+                    "pretrained_params": str(tmp_path / "conv"),
+                },
+            },
+            "Model": {
+                "module": "GPTModule",
+                "vocab_size": 96,
+                "hidden_size": 32,
+                "num_layers": 2,
+                "num_attention_heads": 4,
+                "max_position_embeddings": 32,
+                "hidden_dropout_prob": 0.0,
+                "attention_probs_dropout_prob": 0.0,
+                "dtype": "float32",
+            },
+            "Distributed": {"mp_degree": 2},
+            "Optimizer": {"name": "FusedAdamW", "lr": {"name": "Constant", "learning_rate": 1e-3}},
+        }
+    )
+    ecfg = process_configs(ecfg, num_devices=8)
+    mesh = init_dist_env(ecfg)
+    module = build_module(ecfg)
+    with mesh:
+        engine = Engine(ecfg, module, mesh)
+        got = np.asarray(jax.device_get(engine.state.params["embeddings"]["word"]))
+    np.testing.assert_allclose(got, params["embeddings"]["word"], atol=1e-6)

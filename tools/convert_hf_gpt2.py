@@ -1,0 +1,77 @@
+"""Import a HuggingFace GPT-2 checkpoint into the native format.
+
+Reference parity: utils/download.py + per-model pretrained loaders let
+reference users start from published weights; this tool does the same from
+the ubiquitous HF format (torch runs CPU-only here).  Output layout:
+
+  <out>/params/...        orbax params-only checkpoint
+  <out>/meta.json         {"format": "params-only", "source": ...}
+  <out>/model.yaml        the matching Model config block
+
+Consume it with:
+  Engine.save_load.pretrained_params: <out>     (train/finetune init)
+  Engine.save_load.ckpt_dir: <out>              (serve/export/inference)
+
+Usage:
+  python tools/convert_hf_gpt2.py --model /path/to/hf_gpt2_dir -o out/gpt2
+      [--pad-vocab-to 50304]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddlefleetx_tpu.utils.device import apply_platform_env
+
+apply_platform_env()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True, help="HF model dir (local)")
+    ap.add_argument("-o", "--out", required=True)
+    ap.add_argument("--pad-vocab-to", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    from transformers import GPT2LMHeadModel
+
+    from paddlefleetx_tpu.models.gpt.convert import (
+        convert_hf_gpt2_state_dict,
+        hf_gpt2_config,
+    )
+
+    m = GPT2LMHeadModel.from_pretrained(args.model)
+    cfg = hf_gpt2_config(
+        m.config,
+        **({"vocab_size": args.pad_vocab_to} if args.pad_vocab_to else {}),
+    )
+    params = convert_hf_gpt2_state_dict(
+        m.state_dict(), cfg, pad_vocab_to=args.pad_vocab_to
+    )
+
+    import orbax.checkpoint as ocp
+
+    out = os.path.abspath(args.out)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(out, "params"), params, force=True)
+    ckptr.wait_until_finished()
+    with open(os.path.join(out, "meta.json"), "w") as f:
+        json.dump({"format": "params-only", "source": f"hf-gpt2:{args.model}"}, f)
+    with open(os.path.join(out, "model.yaml"), "w") as f:
+        f.write(
+            "Model:\n"
+            "  module: GPTModule\n"
+            f"  vocab_size: {cfg.vocab_size}\n"
+            f"  hidden_size: {cfg.hidden_size}\n"
+            f"  num_layers: {cfg.num_layers}\n"
+            f"  num_attention_heads: {cfg.num_attention_heads}\n"
+            f"  max_position_embeddings: {cfg.max_position_embeddings}\n"
+        )
+    print(f"converted -> {out}")
+
+
+if __name__ == "__main__":
+    main()
