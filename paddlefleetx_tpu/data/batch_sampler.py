@@ -84,3 +84,58 @@ class DataLoader:
     def __iter__(self):
         for batch_idx in self.sampler:
             yield self.collate_fn([self.dataset[int(i)] for i in batch_idx])
+
+
+class PrefetchLoader:
+    """Background-thread prefetch over any batch iterable (reference
+    paddle.io.DataLoader worker analogue): host batch assembly overlaps the
+    device step instead of serializing after it.  ``depth`` bounds buffered
+    batches (memory = depth x batch bytes)."""
+
+    _DONE = object()
+
+    def __init__(self, loader, depth: int = 2):
+        self.loader = loader
+        self.depth = int(depth)
+
+    def __iter__(self):
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        err: list = []
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for item in self.loader:
+                    if not put(item):
+                        return  # consumer gone: drop buffers, exit thread
+            except BaseException as e:  # surface in consumer thread
+                err.append(e)
+            finally:
+                put(self._DONE)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # early consumer exit (max_steps break, exception, GC): unblock
+            # and terminate the worker so buffers + thread are reclaimed
+            stop.set()

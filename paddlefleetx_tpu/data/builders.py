@@ -41,7 +41,13 @@ def build_dataloader(cfg, mode: str, dataset=None, consumed_samples: int = 0) ->
         seed=get_seed_tracker().data_seed() if _seed_ready() else 1234,
         consumed_samples=consumed_samples,
     )
-    return DataLoader(dataset, sampler, collate_stack)
+    loader = DataLoader(dataset, sampler, collate_stack)
+    prefetch = int(cfg.Data[mode].get("loader", {}).get("prefetch", 0) or 0)
+    if prefetch > 0:
+        from paddlefleetx_tpu.data.batch_sampler import PrefetchLoader
+
+        loader = PrefetchLoader(loader, depth=prefetch)
+    return loader
 
 
 def _seed_ready() -> bool:

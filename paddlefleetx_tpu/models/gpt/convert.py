@@ -22,7 +22,24 @@ from paddlefleetx_tpu.models.gpt.config import GPTConfig
 
 
 def hf_gpt2_config(hf_cfg, **overrides) -> GPTConfig:
-    """GPTConfig from a transformers GPT2Config."""
+    """GPTConfig from a transformers GPT2Config.
+
+    Raises on variants the native model hardcodes differently — a silent
+    convert would produce wrong logits with no error anywhere downstream.
+    """
+    act = getattr(hf_cfg, "activation_function", "gelu_new")
+    if act != "gelu_new":
+        raise ValueError(f"unsupported activation_function {act!r} (need gelu_new)")
+    eps = float(getattr(hf_cfg, "layer_norm_epsilon", 1e-5))
+    if abs(eps - 1e-5) > 1e-12:
+        raise ValueError(f"unsupported layer_norm_epsilon {eps} (model hardcodes 1e-5)")
+    n_inner = getattr(hf_cfg, "n_inner", None)
+    if n_inner is not None and int(n_inner) != 4 * int(hf_cfg.n_embd):
+        raise ValueError(f"unsupported n_inner {n_inner} (need 4*n_embd)")
+    if getattr(hf_cfg, "scale_attn_by_inverse_layer_idx", False):
+        raise ValueError("scale_attn_by_inverse_layer_idx not supported")
+    if getattr(hf_cfg, "reorder_and_upcast_attn", False):
+        raise ValueError("reorder_and_upcast_attn not supported")
     kw = dict(
         vocab_size=int(hf_cfg.vocab_size),
         hidden_size=int(hf_cfg.n_embd),

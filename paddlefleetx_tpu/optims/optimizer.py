@@ -40,8 +40,13 @@ def adamw(
     weight_decay: float = 0.01,
     grad_clip: Optional[float] = None,
     multi_precision: bool = True,
+    moment_dtype: Optional[str] = None,
     **_unused,
 ) -> optax.GradientTransformation:
+    """``moment_dtype: bfloat16`` stores the FIRST moment in bf16 (optax
+    mu_dtype), freeing one param-size fp32 buffer of HBM — the lever that
+    fits 1.3B-class models on a 16GB chip.  The second moment stays fp32
+    (bf16's 8 mantissa bits would visibly distort the adaptive scale)."""
     txs = []
     if grad_clip:
         txs.append(optax.clip_by_global_norm(grad_clip))
@@ -53,6 +58,7 @@ def adamw(
             eps=epsilon,
             weight_decay=weight_decay,
             mask=_no_decay_mask,
+            mu_dtype=moment_dtype or None,
         )
     )
     return optax.chain(*txs)

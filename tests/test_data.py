@@ -154,3 +154,22 @@ def test_blended_default_weights_from_dir(tmp_path):
     assert 0.5 < frac_y < 0.95
     single = GPTDataset(input_dir=str(tmp_path), max_seq_len=32, split=(1, 0, 0))
     assert single.prefix.endswith("x")
+
+
+def test_prefetch_loader_order_and_errors(tmp_path):
+    """PrefetchLoader yields the same batches in order; producer exceptions
+    surface in the consumer."""
+    from paddlefleetx_tpu.data.batch_sampler import PrefetchLoader
+
+    base = [1, 2, 3, 4, 5]
+    assert list(PrefetchLoader(base, depth=2)) == base
+
+    def boom():
+        yield 1
+        raise RuntimeError("producer died")
+
+    out = []
+    with pytest.raises(RuntimeError, match="producer died"):
+        for x in PrefetchLoader(boom(), depth=1):
+            out.append(x)
+    assert out == [1]

@@ -117,3 +117,16 @@ def test_engine_pretrained_warm_start(hf_model, tmp_path, devices8):
         engine = Engine(ecfg, module, mesh)
         got = np.asarray(jax.device_get(engine.state.params["embeddings"]["word"]))
     np.testing.assert_allclose(got, params["embeddings"]["word"], atol=1e-6)
+
+
+def test_unsupported_variants_rejected(hf_model):
+    from transformers import GPT2Config
+
+    bad = GPT2Config(vocab_size=96, n_embd=32, n_layer=2, n_head=4,
+                     activation_function="gelu")
+    with pytest.raises(ValueError, match="activation_function"):
+        hf_gpt2_config(bad)
+    bad = GPT2Config(vocab_size=96, n_embd=32, n_layer=2, n_head=4,
+                     layer_norm_epsilon=1e-6)
+    with pytest.raises(ValueError, match="layer_norm_epsilon"):
+        hf_gpt2_config(bad)

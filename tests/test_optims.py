@@ -77,3 +77,28 @@ def test_grad_clip_applied():
     # tiny clip norm -> tiny effective grads -> update ~ lr * sign only after
     # adam normalization; just check finite + bounded
     assert np.all(np.isfinite(np.asarray(u1["w"])))
+
+
+def test_adamw_bf16_moments():
+    """moment_dtype=bfloat16 stores mu in bf16 and still trains sanely."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddlefleetx_tpu.optims.optimizer import build_optimizer
+
+    cfg = {
+        "name": "FusedAdamW",
+        "weight_decay": 0.0,
+        "moment_dtype": "bfloat16",
+        "lr": {"name": "Constant", "learning_rate": 0.1},
+    }
+    tx, _ = build_optimizer(cfg)
+    params = {"w": jnp.ones((4, 4))}
+    st = tx.init(params)
+    mus = [x for x in jax.tree.leaves(st) if getattr(x, "dtype", None) == jnp.bfloat16]
+    assert mus, "no bf16 moment found in optimizer state"
+    g = {"w": jnp.full((4, 4), 0.5)}
+    upd, st = tx.update(g, st, params)
+    p2 = jax.tree.map(lambda p, u: p + u, params, upd)
+    assert np.all(np.asarray(p2["w"]) < 1.0)
