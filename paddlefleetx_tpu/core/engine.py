@@ -300,12 +300,6 @@ class Engine:
         t0 = time.time()
         state = make_state(key)
         pretrained = self.cfg.Engine.get("save_load", {}).get("pretrained_params")
-        if pretrained and self._will_resume():
-            # a ckpt_dir / auto_resume load is about to replace params
-            # wholesale — don't restore+device_put a multi-GB warm start
-            # just to throw it away on every crash-loop restart
-            logger.info("pretrained_params skipped: resume checkpoint takes over")
-            pretrained = None
         if pretrained:
             # params-only warm start (e.g. tools/convert_hf_gpt2.py output):
             # optimizer state stays fresh, unlike ckpt_dir full-state resume
@@ -539,19 +533,6 @@ class Engine:
     # ------------------------------------------------------------------
     def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
         return jax.tree.map(lambda x: jax.device_put(x, self.batch_spec), batch)
-
-    def _will_resume(self) -> bool:
-        """True when a subsequent engine.load() is going to replace the
-        fresh state (explicit ckpt_dir, or auto_resume with a complete
-        checkpoint already on disk)."""
-        sl = self.cfg.Engine.get("save_load", {})
-        if sl.get("ckpt_dir"):
-            return True
-        if sl.get("auto_resume"):
-            from paddlefleetx_tpu.utils.checkpoint import latest_checkpoint
-
-            return latest_checkpoint(self.output_dir) is not None
-        return False
 
     def _write_metrics(self, record: Dict) -> None:
         if not self.metrics_file:

@@ -1,0 +1,131 @@
+"""HF T5 checkpoint -> native param tree (same role as gpt/convert.py).
+
+Mapping notes:
+- torch ``nn.Linear`` weights are [out, in] — every kernel transposes.
+- q/k/v: [nh*d_kv, d] -> T -> [d, nh, d_kv]; o: [d, nh*d_kv] -> T ->
+  [nh, d_kv, d].
+- relative_attention_bias lives only in block 0 per stack (shared across
+  layers), matching the single ``rel_bias`` [num_buckets, nh] here.
+- T5 attention is unscaled (folded into init) in both implementations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from paddlefleetx_tpu.models.t5.model import T5Config
+
+
+def hf_t5_config(hf_cfg, **overrides) -> T5Config:
+    proj = getattr(hf_cfg, "feed_forward_proj", "relu")
+    if proj not in ("relu", "gated-gelu"):
+        raise ValueError(f"unsupported feed_forward_proj {proj!r}")
+    if abs(float(hf_cfg.layer_norm_epsilon) - 1e-6) > 1e-15:
+        raise ValueError(
+            f"unsupported layer_norm_epsilon {hf_cfg.layer_norm_epsilon} (need 1e-6)"
+        )
+    kw = dict(
+        vocab_size=int(hf_cfg.vocab_size),
+        d_model=int(hf_cfg.d_model),
+        d_kv=int(hf_cfg.d_kv),
+        d_ff=int(hf_cfg.d_ff),
+        num_layers=int(hf_cfg.num_layers),
+        num_decoder_layers=int(hf_cfg.num_decoder_layers),
+        num_heads=int(hf_cfg.num_heads),
+        relative_attention_num_buckets=int(hf_cfg.relative_attention_num_buckets),
+        relative_attention_max_distance=int(
+            getattr(hf_cfg, "relative_attention_max_distance", 128)
+        ),
+        feed_forward_proj=proj,
+        tie_word_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", True)),
+        pad_token_id=int(hf_cfg.pad_token_id),
+        eos_token_id=int(hf_cfg.eos_token_id),
+        decoder_start_token_id=int(getattr(hf_cfg, "decoder_start_token_id", 0)),
+    )
+    kw.update(overrides)
+    return T5Config(**kw)
+
+
+def convert_hf_t5_state_dict(sd: Dict, cfg: T5Config) -> Dict:
+    """torch/HF ``T5ForConditionalGeneration.state_dict()`` -> param tree."""
+
+    def get(name):
+        v = sd[name]
+        return np.asarray(
+            v.detach().cpu().numpy() if hasattr(v, "detach") else v
+        ).astype(np.float32)
+
+    d, nh, kv = cfg.d_model, cfg.num_heads, cfg.d_kv
+
+    def attn(prefix: str) -> Dict[str, np.ndarray]:
+        return {
+            "q_kernel": get(prefix + ".q.weight").T.reshape(d, nh, kv),
+            "k_kernel": get(prefix + ".k.weight").T.reshape(d, nh, kv),
+            "v_kernel": get(prefix + ".v.weight").T.reshape(d, nh, kv),
+            "o_kernel": get(prefix + ".o.weight").T.reshape(nh, kv, d),
+        }
+
+    def ffn(prefix: str) -> Dict[str, np.ndarray]:
+        out = {"wo_kernel": get(prefix + ".wo.weight").T}
+        if cfg.is_gated_act:
+            out["wi_gate_kernel"] = get(prefix + ".wi_0.weight").T
+            out["wi_kernel"] = get(prefix + ".wi_1.weight").T
+        else:
+            out["wi_kernel"] = get(prefix + ".wi.weight").T
+        return out
+
+    enc_layers = []
+    for i in range(cfg.num_layers):
+        b = f"encoder.block.{i}"
+        enc_layers.append(
+            {
+                "attn": attn(f"{b}.layer.0.SelfAttention"),
+                "ln_attn": {"scale": get(f"{b}.layer.0.layer_norm.weight")},
+                "ffn": ffn(f"{b}.layer.1.DenseReluDense"),
+                "ln_ffn": {"scale": get(f"{b}.layer.1.layer_norm.weight")},
+            }
+        )
+    dec_layers = []
+    for i in range(cfg.num_decoder_layers):
+        b = f"decoder.block.{i}"
+        dec_layers.append(
+            {
+                "self_attn": attn(f"{b}.layer.0.SelfAttention"),
+                "ln_self": {"scale": get(f"{b}.layer.0.layer_norm.weight")},
+                "cross_attn": attn(f"{b}.layer.1.EncDecAttention"),
+                "ln_cross": {"scale": get(f"{b}.layer.1.layer_norm.weight")},
+                "ffn": ffn(f"{b}.layer.2.DenseReluDense"),
+                "ln_ffn": {"scale": get(f"{b}.layer.2.layer_norm.weight")},
+            }
+        )
+
+    def nested_stack(layers):
+        out = {}
+        for group, val in layers[0].items():
+            out[group] = {
+                k: np.stack([l[group][k] for l in layers]) for k in val
+            }
+        return out
+
+    params = {
+        "shared_embedding": get("shared.weight"),
+        "encoder": {
+            "layers": nested_stack(enc_layers),
+            "rel_bias": get(
+                "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+            ),
+            "final_ln": {"scale": get("encoder.final_layer_norm.weight")},
+        },
+        "decoder": {
+            "layers": nested_stack(dec_layers),
+            "rel_bias": get(
+                "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+            ),
+            "final_ln": {"scale": get("decoder.final_layer_norm.weight")},
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = get("lm_head.weight").T
+    return params

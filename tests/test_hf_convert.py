@@ -130,3 +130,44 @@ def test_unsupported_variants_rejected(hf_model):
                      layer_norm_epsilon=1e-6)
     with pytest.raises(ValueError, match="layer_norm_epsilon"):
         hf_gpt2_config(bad)
+
+
+# ---------------------------------------------------------------------------
+# T5 (same external-oracle pattern; gated + untied variants)
+# ---------------------------------------------------------------------------
+
+
+def _t5_parity(feed_forward_proj, tie):
+    from transformers import T5Config as HFT5Config, T5ForConditionalGeneration
+
+    from paddlefleetx_tpu.models.t5 import model as t5
+    from paddlefleetx_tpu.models.t5.convert import (
+        convert_hf_t5_state_dict,
+        hf_t5_config,
+    )
+
+    hf_cfg = HFT5Config(
+        vocab_size=96, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_decoder_layers=2, num_heads=4, relative_attention_num_buckets=8,
+        dropout_rate=0.0, feed_forward_proj=feed_forward_proj,
+        tie_word_embeddings=tie, decoder_start_token_id=0,
+    )
+    torch.manual_seed(0)
+    m = T5ForConditionalGeneration(hf_cfg).eval()
+    cfg = hf_t5_config(hf_cfg, dropout_rate=0.0, dtype="float32")
+    params = convert_hf_t5_state_dict(m.state_dict(), cfg)
+    rng = np.random.default_rng(0)
+    inp = rng.integers(3, 96, (2, 10))
+    dec = rng.integers(3, 96, (2, 6))
+    with torch.no_grad():
+        ref = m(input_ids=torch.tensor(inp), decoder_input_ids=torch.tensor(dec)).logits.numpy()
+    ours = np.asarray(t5.forward(params, inp, dec, cfg, train=False))
+    np.testing.assert_allclose(ours, ref, atol=3e-5, rtol=1e-5)
+
+
+def test_t5_logits_match_transformers_gated_tied():
+    _t5_parity("gated-gelu", True)
+
+
+def test_t5_logits_match_transformers_relu_untied():
+    _t5_parity("relu", False)

@@ -1,0 +1,72 @@
+"""Import a HuggingFace T5 checkpoint into the native format.
+
+Same contract as tools/convert_hf_gpt2.py: params-only orbax checkpoint +
+model.yaml, consumable via Engine.save_load.pretrained_params (train) or
+ckpt_dir (export/inference).  Logits parity with transformers is covered
+by tests/test_hf_convert.py.
+
+Usage:
+  python tools/convert_hf_t5.py --model /path/to/hf_t5_dir -o out/t5
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddlefleetx_tpu.utils.device import apply_platform_env
+
+apply_platform_env()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True, help="HF model dir (local)")
+    ap.add_argument("-o", "--out", required=True)
+    args = ap.parse_args(argv)
+
+    from transformers import T5ForConditionalGeneration
+
+    from paddlefleetx_tpu.models.t5.convert import (
+        convert_hf_t5_state_dict,
+        hf_t5_config,
+    )
+
+    m = T5ForConditionalGeneration.from_pretrained(args.model)
+    cfg = hf_t5_config(m.config)
+    params = convert_hf_t5_state_dict(m.state_dict(), cfg)
+
+    import orbax.checkpoint as ocp
+
+    out = os.path.abspath(args.out)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(out, "params"), params, force=True)
+    ckptr.wait_until_finished()
+    with open(os.path.join(out, "meta.json"), "w") as f:
+        json.dump({"format": "params-only", "source": f"hf-t5:{args.model}"}, f)
+    with open(os.path.join(out, "model.yaml"), "w") as f:
+        f.write(
+            "Model:\n"
+            "  module: T5Module\n"
+            f"  vocab_size: {cfg.vocab_size}\n"
+            f"  d_model: {cfg.d_model}\n"
+            f"  d_kv: {cfg.d_kv}\n"
+            f"  d_ff: {cfg.d_ff}\n"
+            f"  num_layers: {cfg.num_layers}\n"
+            f"  num_decoder_layers: {cfg.num_decoder_layers}\n"
+            f"  num_heads: {cfg.num_heads}\n"
+            f"  relative_attention_num_buckets: {cfg.relative_attention_num_buckets}\n"
+            f"  relative_attention_max_distance: {cfg.relative_attention_max_distance}\n"
+            f"  feed_forward_proj: {cfg.feed_forward_proj}\n"
+            f"  tie_word_embeddings: {cfg.tie_word_embeddings}\n"
+            f"  pad_token_id: {cfg.pad_token_id}\n"
+            f"  eos_token_id: {cfg.eos_token_id}\n"
+            f"  decoder_start_token_id: {cfg.decoder_start_token_id}\n"
+        )
+    print(f"converted -> {out}")
+
+
+if __name__ == "__main__":
+    main()

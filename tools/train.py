@@ -26,17 +26,23 @@ def main(argv=None):
     mesh = init_dist_env(cfg)
     module = build_module(cfg)
 
+    ckpt_dir = cfg.Engine.save_load.get("ckpt_dir")
+    if not ckpt_dir and cfg.Engine.save_load.get("auto_resume"):
+        # crash-loop restart contract (reference _load_recovery,
+        # eager_engine.py:244,816-825): newest complete step_N dir wins
+        from paddlefleetx_tpu.utils.checkpoint import latest_checkpoint
+
+        ckpt_dir = latest_checkpoint(cfg.Engine.save_load.get("output_dir", "./output"))
+        if ckpt_dir:
+            logger.info(f"auto_resume: found {ckpt_dir}")
+    if ckpt_dir and cfg.Engine.save_load.get("pretrained_params"):
+        # the resume load replaces params wholesale — skip the (possibly
+        # multi-GB) warm-start restore on every crash-loop restart
+        logger.info("pretrained_params skipped: resume checkpoint takes over")
+        cfg.Engine.save_load.pretrained_params = None
+
     with mesh:
         engine = Engine(cfg, module, mesh)
-        ckpt_dir = cfg.Engine.save_load.get("ckpt_dir")
-        if not ckpt_dir and cfg.Engine.save_load.get("auto_resume"):
-            # crash-loop restart contract (reference _load_recovery,
-            # eager_engine.py:244,816-825): newest complete step_N dir wins
-            from paddlefleetx_tpu.utils.checkpoint import latest_checkpoint
-
-            ckpt_dir = latest_checkpoint(engine.output_dir)
-            if ckpt_dir:
-                logger.info(f"auto_resume: found {ckpt_dir}")
         if ckpt_dir:
             engine.load(ckpt_dir)
         # loaders built after load so the sampler resumes the data order
