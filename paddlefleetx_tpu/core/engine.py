@@ -300,6 +300,13 @@ class Engine:
         t0 = time.time()
         state = make_state(key)
         pretrained = self.cfg.Engine.get("save_load", {}).get("pretrained_params")
+        if pretrained and self.cfg.Engine.get("save_load", {}).get("ckpt_dir"):
+            # every entry point follows Engine() with engine.load(ckpt_dir),
+            # which replaces params wholesale — skip the redundant (possibly
+            # multi-GB) warm-start restore.  auto_resume resolution happens
+            # in tools/train.py, which nulls pretrained_params itself.
+            logger.info("pretrained_params skipped: ckpt_dir load takes over")
+            pretrained = None
         if pretrained:
             # params-only warm start (e.g. tools/convert_hf_gpt2.py output):
             # optimizer state stays fresh, unlike ckpt_dir full-state resume

@@ -1,0 +1,149 @@
+"""HF DebertaV2 checkpoint -> native param tree (same role as gpt/convert.py).
+
+The disentangled-attention encoder is the subtlest mapping; logits parity
+with ``transformers.DebertaV2Model`` (tests/test_hf_convert.py) is the
+oracle.  torch ``nn.Linear`` weights are [out, in] — kernels transpose.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from paddlefleetx_tpu.models.debertav2.model import DebertaV2Config
+
+
+def hf_debertav2_config(hf_cfg, **overrides) -> DebertaV2Config:
+    act = getattr(hf_cfg, "hidden_act", "gelu")
+    if act != "gelu":
+        raise ValueError(f"unsupported hidden_act {act!r}")
+    norm_rel = getattr(hf_cfg, "norm_rel_ebd", "none")
+    if norm_rel != "layer_norm":
+        raise ValueError(f"unsupported norm_rel_ebd {norm_rel!r} (need layer_norm)")
+    if getattr(hf_cfg, "position_biased_input", True):
+        raise ValueError("position_biased_input=True not supported (v2 uses False)")
+    if not getattr(hf_cfg, "share_att_key", False):
+        raise ValueError("share_att_key=False not supported")
+    emb_size = getattr(hf_cfg, "embedding_size", None) or hf_cfg.hidden_size
+    if int(emb_size) != int(hf_cfg.hidden_size):
+        raise ValueError(
+            f"embedding_size {emb_size} != hidden_size (embed_proj not supported)"
+        )
+    if int(getattr(hf_cfg, "conv_kernel_size", 0)) > 0:
+        if getattr(hf_cfg, "conv_act", "tanh") != "gelu":
+            raise ValueError(
+                f"conv_act {getattr(hf_cfg, 'conv_act', 'tanh')!r} unsupported "
+                "(the native ConvLayer applies gelu)"
+            )
+        if int(getattr(hf_cfg, "conv_groups", 1)) != 1:
+            raise ValueError("grouped conv not supported")
+    kw = dict(
+        vocab_size=int(hf_cfg.vocab_size),
+        hidden_size=int(hf_cfg.hidden_size),
+        num_layers=int(hf_cfg.num_hidden_layers),
+        num_attention_heads=int(hf_cfg.num_attention_heads),
+        intermediate_size=int(hf_cfg.intermediate_size),
+        max_position_embeddings=int(hf_cfg.max_position_embeddings),
+        layer_norm_eps=float(hf_cfg.layer_norm_eps),
+        relative_attention=bool(hf_cfg.relative_attention),
+        position_buckets=int(getattr(hf_cfg, "position_buckets", -1)),
+        max_relative_positions=int(getattr(hf_cfg, "max_relative_positions", -1)),
+        pos_att_type=tuple(hf_cfg.pos_att_type or ()),
+        conv_kernel_size=int(getattr(hf_cfg, "conv_kernel_size", 0)),
+        pad_token_id=int(getattr(hf_cfg, "pad_token_id", 0)),
+    )
+    kw.update(overrides)
+    return DebertaV2Config(**kw)
+
+
+def convert_hf_debertav2_state_dict(sd: Dict, cfg: DebertaV2Config) -> Dict:
+    """torch/HF ``DebertaV2Model.state_dict()`` -> stacked param tree."""
+
+    def get(name):
+        v = sd[name]
+        return np.asarray(
+            v.detach().cpu().numpy() if hasattr(v, "detach") else v
+        ).astype(np.float32)
+
+    h, nh, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+    L = cfg.num_layers
+
+    def stack(fmt, reshape=None, transpose=False):
+        arrs = []
+        for i in range(L):
+            a = get(fmt.format(i=i))
+            if transpose:
+                a = a.T
+            arrs.append(a.reshape(reshape) if reshape is not None else a)
+        return np.stack(arrs)
+
+    params = {
+        "embeddings": {
+            "word": get("embeddings.word_embeddings.weight"),
+            "ln_scale": get("embeddings.LayerNorm.weight"),
+            "ln_bias": get("embeddings.LayerNorm.bias"),
+        },
+        "layers": {
+            "attn": {
+                "q_kernel": stack(
+                    "encoder.layer.{i}.attention.self.query_proj.weight",
+                    (h, nh, hd), transpose=True,
+                ),
+                "q_bias": stack(
+                    "encoder.layer.{i}.attention.self.query_proj.bias", (nh, hd)
+                ),
+                "k_kernel": stack(
+                    "encoder.layer.{i}.attention.self.key_proj.weight",
+                    (h, nh, hd), transpose=True,
+                ),
+                "k_bias": stack(
+                    "encoder.layer.{i}.attention.self.key_proj.bias", (nh, hd)
+                ),
+                "v_kernel": stack(
+                    "encoder.layer.{i}.attention.self.value_proj.weight",
+                    (h, nh, hd), transpose=True,
+                ),
+                "v_bias": stack(
+                    "encoder.layer.{i}.attention.self.value_proj.bias", (nh, hd)
+                ),
+                "out_kernel": stack(
+                    "encoder.layer.{i}.attention.output.dense.weight",
+                    (nh, hd, h), transpose=True,
+                ),
+                "out_bias": stack("encoder.layer.{i}.attention.output.dense.bias"),
+            },
+            "ln_attn": {
+                "scale": stack("encoder.layer.{i}.attention.output.LayerNorm.weight"),
+                "bias": stack("encoder.layer.{i}.attention.output.LayerNorm.bias"),
+            },
+            "mlp": {
+                "fc_in_kernel": stack(
+                    "encoder.layer.{i}.intermediate.dense.weight", transpose=True
+                ),
+                "fc_in_bias": stack("encoder.layer.{i}.intermediate.dense.bias"),
+                "fc_out_kernel": stack(
+                    "encoder.layer.{i}.output.dense.weight", transpose=True
+                ),
+                "fc_out_bias": stack("encoder.layer.{i}.output.dense.bias"),
+            },
+            "ln_mlp": {
+                "scale": stack("encoder.layer.{i}.output.LayerNorm.weight"),
+                "bias": stack("encoder.layer.{i}.output.LayerNorm.bias"),
+            },
+        },
+        "rel_embeddings": get("encoder.rel_embeddings.weight"),
+        "rel_ln": {
+            "scale": get("encoder.LayerNorm.weight"),
+            "bias": get("encoder.LayerNorm.bias"),
+        },
+    }
+    if cfg.conv_kernel_size > 0:
+        # HF Conv1d weight [out, in, ks] -> native WIO [ks, in, out]
+        params["conv"] = {
+            "kernel": get("encoder.conv.conv.weight").transpose(2, 1, 0),
+            "bias": get("encoder.conv.conv.bias"),
+            "ln_scale": get("encoder.conv.LayerNorm.weight"),
+            "ln_bias": get("encoder.conv.LayerNorm.bias"),
+        }
+    return params

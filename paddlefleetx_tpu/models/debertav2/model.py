@@ -223,7 +223,9 @@ def _conv_branch(
         dimension_numbers=("NWC", "WIO", "NWC"),
     ) + p["bias"]
     y = y * attention_mask[..., None].astype(y.dtype)
-    y = jax.nn.gelu(dropout(key, y, cfg.hidden_dropout_prob, train), approximate=True)
+    # exact (erf) gelu: both the reference ConvLayer and HF conv_act="gelu"
+    # use the unapproximated form here
+    y = jax.nn.gelu(dropout(key, y, cfg.hidden_dropout_prob, train), approximate=False)
     return layer_norm(first_out + y, p["ln_scale"], p["ln_bias"], cfg.layer_norm_eps)
 
 
@@ -251,6 +253,10 @@ def encode(
     if cfg.position_biased_input:
         x = x + emb["position"][:s][None]
     x = layer_norm(x.astype(dtype), emb["ln_scale"], emb["ln_bias"], cfg.layer_norm_eps)
+    # zero pad rows (reference DebertaV2Embeddings mask multiply): attention
+    # masking alone is not enough once the ConvLayer mixes neighboring
+    # tokens — a pad row's garbage would leak into valid positions
+    x = x * attention_mask[..., None].astype(dtype)
     k_emb = k_stack = k_conv = None
     if dropout_key is not None:
         k_emb, k_stack, k_conv = jax.random.split(dropout_key, 3)

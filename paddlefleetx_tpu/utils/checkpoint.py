@@ -70,3 +70,24 @@ def load_pretrained_params(cfg) -> Optional[Any]:
     if not ckpt_dir:
         return None
     return restore_params(ckpt_dir)
+
+
+def save_params_checkpoint(out_dir: str, params, source: str, model_fields: dict) -> str:
+    """Write the params-only checkpoint contract shared by the HF import
+    tools: ``params/`` (orbax), ``meta.json`` (format+source), and
+    ``model.yaml`` (the matching Model config block)."""
+    import json
+
+    import orbax.checkpoint as ocp
+
+    out = os.path.abspath(out_dir)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(out, "params"), params, force=True)
+    ckptr.wait_until_finished()
+    with open(os.path.join(out, "meta.json"), "w") as f:
+        json.dump({"format": "params-only", "source": source}, f)
+    with open(os.path.join(out, "model.yaml"), "w") as f:
+        f.write("Model:\n")
+        for k, v in model_fields.items():
+            f.write(f"  {k}: {v}\n")
+    return out

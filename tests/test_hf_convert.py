@@ -171,3 +171,99 @@ def test_t5_logits_match_transformers_gated_tied():
 
 def test_t5_logits_match_transformers_relu_untied():
     _t5_parity("relu", False)
+
+
+# ---------------------------------------------------------------------------
+# DebertaV2 (disentangled attention; parity at valid positions — HF applies
+# a q-side pad mask so pad-row outputs differ, and nothing reads them)
+# ---------------------------------------------------------------------------
+
+
+def test_debertav2_hidden_states_match_transformers():
+    from transformers import DebertaV2Config as HFCfg, DebertaV2Model
+
+    from paddlefleetx_tpu.models.debertav2 import model as dv2
+    from paddlefleetx_tpu.models.debertav2.convert import (
+        convert_hf_debertav2_state_dict,
+        hf_debertav2_config,
+    )
+
+    hf = HFCfg(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=64, max_position_embeddings=64, relative_attention=True,
+        position_buckets=8, norm_rel_ebd="layer_norm", pos_att_type=["p2c", "c2p"],
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        share_att_key=True, position_biased_input=False,
+    )
+    torch.manual_seed(0)
+    m = DebertaV2Model(hf).eval()
+    cfg = hf_debertav2_config(
+        hf, hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0, dtype="float32"
+    )
+    params = convert_hf_debertav2_state_dict(m.state_dict(), cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, 96, (2, 12))
+    mask = np.ones((2, 12), np.int64)
+    mask[1, 9:] = 0
+    with torch.no_grad():
+        ref = m(
+            input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask)
+        ).last_hidden_state.numpy()
+    ours = np.asarray(dv2.encode(params, ids, cfg, attention_mask=mask, train=False))
+    np.testing.assert_allclose(ours[mask.astype(bool)], ref[mask.astype(bool)],
+                               atol=3e-5, rtol=1e-5)
+
+
+def test_debertav2_unsupported_variants_rejected():
+    from transformers import DebertaV2Config as HFCfg
+
+    from paddlefleetx_tpu.models.debertav2.convert import hf_debertav2_config
+
+    with pytest.raises(ValueError, match="norm_rel_ebd"):
+        hf_debertav2_config(HFCfg(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                                  num_attention_heads=4, intermediate_size=64,
+                                  norm_rel_ebd="none", position_biased_input=False,
+                                  share_att_key=True))
+    with pytest.raises(ValueError, match="share_att_key"):
+        hf_debertav2_config(HFCfg(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                                  num_attention_heads=4, intermediate_size=64,
+                                  norm_rel_ebd="layer_norm", position_biased_input=False,
+                                  share_att_key=False))
+
+
+def test_debertav2_conv_variant_matches_transformers():
+    """xlarge-style ConvLayer (conv_kernel_size=3): valid-position parity,
+    including the pad-row zeroing that keeps conv from leaking pad garbage."""
+    from transformers import DebertaV2Config as HFCfg, DebertaV2Model
+
+    from paddlefleetx_tpu.models.debertav2 import model as dv2
+    from paddlefleetx_tpu.models.debertav2.convert import (
+        convert_hf_debertav2_state_dict,
+        hf_debertav2_config,
+    )
+
+    hf = HFCfg(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=64, max_position_embeddings=64, relative_attention=True,
+        position_buckets=8, norm_rel_ebd="layer_norm", pos_att_type=["p2c", "c2p"],
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        share_att_key=True, position_biased_input=False,
+        conv_kernel_size=3, conv_act="gelu",
+    )
+    torch.manual_seed(0)
+    m = DebertaV2Model(hf).eval()
+    cfg = hf_debertav2_config(
+        hf, hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0, dtype="float32"
+    )
+    params = convert_hf_debertav2_state_dict(m.state_dict(), cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, 96, (2, 12))
+    mask = np.ones((2, 12), np.int64)
+    mask[1, 9:] = 0
+    with torch.no_grad():
+        ref = m(
+            input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask)
+        ).last_hidden_state.numpy()
+    ours = np.asarray(dv2.encode(params, ids, cfg, attention_mask=mask, train=False))
+    np.testing.assert_allclose(ours[mask.astype(bool)], ref[mask.astype(bool)],
+                               atol=5e-5, rtol=1e-5)

@@ -1,0 +1,65 @@
+"""Import a HuggingFace DebertaV2 checkpoint into the native format.
+
+Same contract as tools/convert_hf_gpt2.py: params-only orbax checkpoint +
+model.yaml.  Hidden-state parity with transformers is covered by
+tests/test_hf_convert.py (valid positions; HF pads differ by design).
+
+Usage:
+  python tools/convert_hf_debertav2.py --model /path/to/hf_deberta -o out/dv2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddlefleetx_tpu.utils.device import apply_platform_env
+
+apply_platform_env()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True, help="HF model dir (local)")
+    ap.add_argument("-o", "--out", required=True)
+    args = ap.parse_args(argv)
+
+    from transformers import DebertaV2Model
+
+    from paddlefleetx_tpu.models.debertav2.convert import (
+        convert_hf_debertav2_state_dict,
+        hf_debertav2_config,
+    )
+
+    m = DebertaV2Model.from_pretrained(args.model)
+    cfg = hf_debertav2_config(m.config)
+    params = convert_hf_debertav2_state_dict(m.state_dict(), cfg)
+
+    from paddlefleetx_tpu.utils.checkpoint import save_params_checkpoint
+
+    out = save_params_checkpoint(
+        args.out,
+        params,
+        f"hf-debertav2:{args.model}",
+        {
+            "module": "DebertaV2Module",
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_attention_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "max_position_embeddings": cfg.max_position_embeddings,
+            "relative_attention": cfg.relative_attention,
+            "position_buckets": cfg.position_buckets,
+            "max_relative_positions": cfg.max_relative_positions,
+            "pos_att_type": list(cfg.pos_att_type),
+            "conv_kernel_size": cfg.conv_kernel_size,
+            "pad_token_id": cfg.pad_token_id,
+        },
+    )
+    print(f"converted -> {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,6 @@ Usage:
 """
 
 import argparse
-import json
 import os
 import sys
 
@@ -52,24 +51,21 @@ def main(argv=None):
         m.state_dict(), cfg, pad_vocab_to=args.pad_vocab_to
     )
 
-    import orbax.checkpoint as ocp
+    from paddlefleetx_tpu.utils.checkpoint import save_params_checkpoint
 
-    out = os.path.abspath(args.out)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(out, "params"), params, force=True)
-    ckptr.wait_until_finished()
-    with open(os.path.join(out, "meta.json"), "w") as f:
-        json.dump({"format": "params-only", "source": f"hf-gpt2:{args.model}"}, f)
-    with open(os.path.join(out, "model.yaml"), "w") as f:
-        f.write(
-            "Model:\n"
-            "  module: GPTModule\n"
-            f"  vocab_size: {cfg.vocab_size}\n"
-            f"  hidden_size: {cfg.hidden_size}\n"
-            f"  num_layers: {cfg.num_layers}\n"
-            f"  num_attention_heads: {cfg.num_attention_heads}\n"
-            f"  max_position_embeddings: {cfg.max_position_embeddings}\n"
-        )
+    out = save_params_checkpoint(
+        args.out,
+        params,
+        f"hf-gpt2:{args.model}",
+        {
+            "module": "GPTModule",
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_attention_heads,
+            "max_position_embeddings": cfg.max_position_embeddings,
+        },
+    )
     print(f"converted -> {out}")
 
 

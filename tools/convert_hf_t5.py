@@ -10,7 +10,6 @@ Usage:
 """
 
 import argparse
-import json
 import os
 import sys
 
@@ -38,33 +37,30 @@ def main(argv=None):
     cfg = hf_t5_config(m.config)
     params = convert_hf_t5_state_dict(m.state_dict(), cfg)
 
-    import orbax.checkpoint as ocp
+    from paddlefleetx_tpu.utils.checkpoint import save_params_checkpoint
 
-    out = os.path.abspath(args.out)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(out, "params"), params, force=True)
-    ckptr.wait_until_finished()
-    with open(os.path.join(out, "meta.json"), "w") as f:
-        json.dump({"format": "params-only", "source": f"hf-t5:{args.model}"}, f)
-    with open(os.path.join(out, "model.yaml"), "w") as f:
-        f.write(
-            "Model:\n"
-            "  module: T5Module\n"
-            f"  vocab_size: {cfg.vocab_size}\n"
-            f"  d_model: {cfg.d_model}\n"
-            f"  d_kv: {cfg.d_kv}\n"
-            f"  d_ff: {cfg.d_ff}\n"
-            f"  num_layers: {cfg.num_layers}\n"
-            f"  num_decoder_layers: {cfg.num_decoder_layers}\n"
-            f"  num_heads: {cfg.num_heads}\n"
-            f"  relative_attention_num_buckets: {cfg.relative_attention_num_buckets}\n"
-            f"  relative_attention_max_distance: {cfg.relative_attention_max_distance}\n"
-            f"  feed_forward_proj: {cfg.feed_forward_proj}\n"
-            f"  tie_word_embeddings: {cfg.tie_word_embeddings}\n"
-            f"  pad_token_id: {cfg.pad_token_id}\n"
-            f"  eos_token_id: {cfg.eos_token_id}\n"
-            f"  decoder_start_token_id: {cfg.decoder_start_token_id}\n"
-        )
+    out = save_params_checkpoint(
+        args.out,
+        params,
+        f"hf-t5:{args.model}",
+        {
+            "module": "T5Module",
+            "vocab_size": cfg.vocab_size,
+            "d_model": cfg.d_model,
+            "d_kv": cfg.d_kv,
+            "d_ff": cfg.d_ff,
+            "num_layers": cfg.num_layers,
+            "num_decoder_layers": cfg.num_decoder_layers,
+            "num_heads": cfg.num_heads,
+            "relative_attention_num_buckets": cfg.relative_attention_num_buckets,
+            "relative_attention_max_distance": cfg.relative_attention_max_distance,
+            "feed_forward_proj": cfg.feed_forward_proj,
+            "tie_word_embeddings": cfg.tie_word_embeddings,
+            "pad_token_id": cfg.pad_token_id,
+            "eos_token_id": cfg.eos_token_id,
+            "decoder_start_token_id": cfg.decoder_start_token_id,
+        },
+    )
     print(f"converted -> {out}")
 
 
