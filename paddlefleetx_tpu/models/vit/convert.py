@@ -1,0 +1,139 @@
+"""HF ViT checkpoint -> native param tree (same role as gpt/convert.py).
+
+Mapping notes:
+- separate HF q/k/v Linears pack into the fused qkv kernel [h, 3, nh, hd]
+  (torch Linear weights are [out, in] — transpose first).
+- the Conv2d patch projection [h, C, ps, ps] becomes the matmul kernel
+  [ps*ps*C, h] matching patchify()'s (ph, pw, C) flatten order.
+- HF uses exact-erf gelu: the emitted config sets gelu_approximate False.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from paddlefleetx_tpu.models.vit.model import ViTConfig
+
+
+def hf_vit_config(hf_cfg, num_classes: int = 0, **overrides) -> ViTConfig:
+    act = getattr(hf_cfg, "hidden_act", "gelu")
+    if act != "gelu":
+        raise ValueError(f"unsupported hidden_act {act!r}")
+    kw = dict(
+        image_size=int(hf_cfg.image_size),
+        patch_size=int(hf_cfg.patch_size),
+        in_channels=int(getattr(hf_cfg, "num_channels", 3)),
+        hidden_size=int(hf_cfg.hidden_size),
+        num_layers=int(hf_cfg.num_hidden_layers),
+        num_attention_heads=int(hf_cfg.num_attention_heads),
+        ffn_hidden_size=int(hf_cfg.intermediate_size),
+        num_classes=int(num_classes),
+        gelu_approximate=False,
+        layer_norm_eps=float(getattr(hf_cfg, "layer_norm_eps", 1e-12)),
+    )
+    kw.update(overrides)
+    return ViTConfig(**kw)
+
+
+def convert_hf_vit_state_dict(sd: Dict, cfg: ViTConfig) -> Dict:
+    """torch/HF ``ViTModel``/``ViTForImageClassification`` state dict ->
+    stacked param tree.  Keys may carry a ``vit.`` prefix (classification
+    models); the classifier head maps when num_classes matches."""
+
+    names = list(sd.keys())
+    prefix = "vit." if any(n.startswith("vit.") for n in names) else ""
+
+    def get(name):
+        v = sd[prefix + name] if prefix + name in sd else sd[name]
+        return np.asarray(
+            v.detach().cpu().numpy() if hasattr(v, "detach") else v
+        ).astype(np.float32)
+
+    h, nh, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+    L, ps, C = cfg.num_layers, cfg.patch_size, cfg.in_channels
+
+    def qkv_stack(kind):
+        ks, bs = [], []
+        for i in range(L):
+            base = f"encoder.layer.{i}.attention.attention.{kind}"
+            ks.append(get(base + ".weight").T.reshape(h, nh, hd))
+            bs.append(get(base + ".bias").reshape(nh, hd))
+        return np.stack(ks), np.stack(bs)
+
+    qk, qb = qkv_stack("query")
+    kk, kb = qkv_stack("key")
+    vk, vb = qkv_stack("value")
+
+    def stack(fmt, reshape=None, transpose=False):
+        arrs = []
+        for i in range(L):
+            a = get(fmt.format(i=i))
+            if transpose:
+                a = a.T
+            arrs.append(a.reshape(reshape) if reshape is not None else a)
+        return np.stack(arrs)
+
+    params = {
+        "cls_token": get("embeddings.cls_token"),
+        "pos_embed": get("embeddings.position_embeddings"),
+        "patch_embed": {
+            # [h, C, ph, pw] -> (ph, pw, C, h) -> [ps*ps*C, h]
+            "kernel": get("embeddings.patch_embeddings.projection.weight")
+            .transpose(2, 3, 1, 0)
+            .reshape(ps * ps * C, h),
+            "bias": get("embeddings.patch_embeddings.projection.bias"),
+        },
+        "layers": {
+            "ln_1": {
+                "scale": stack("encoder.layer.{i}.layernorm_before.weight"),
+                "bias": stack("encoder.layer.{i}.layernorm_before.bias"),
+            },
+            "attn": {
+                "qkv_kernel": np.stack([qk, kk, vk], axis=2),  # [L, h, 3, nh, hd]
+                "qkv_bias": np.stack([qb, kb, vb], axis=1),    # [L, 3, nh, hd]
+                "out_kernel": stack(
+                    "encoder.layer.{i}.attention.output.dense.weight",
+                    (nh, hd, h), transpose=True,
+                ),
+                "out_bias": stack("encoder.layer.{i}.attention.output.dense.bias"),
+            },
+            "ln_2": {
+                "scale": stack("encoder.layer.{i}.layernorm_after.weight"),
+                "bias": stack("encoder.layer.{i}.layernorm_after.bias"),
+            },
+            "mlp": {
+                "fc_in_kernel": stack(
+                    "encoder.layer.{i}.intermediate.dense.weight", transpose=True
+                ),
+                "fc_in_bias": stack("encoder.layer.{i}.intermediate.dense.bias"),
+                "fc_out_kernel": stack(
+                    "encoder.layer.{i}.output.dense.weight", transpose=True
+                ),
+                "fc_out_bias": stack("encoder.layer.{i}.output.dense.bias"),
+            },
+        },
+        "final_ln": {
+            "scale": get("layernorm.weight"),
+            "bias": get("layernorm.bias"),
+        },
+    }
+    if cfg.num_classes:
+        if "classifier.weight" in sd:
+            head_w = get("classifier.weight")
+            if head_w.shape[0] != cfg.num_classes:
+                raise ValueError(
+                    f"checkpoint classifier has {head_w.shape[0]} labels, "
+                    f"config num_classes is {cfg.num_classes}"
+                )
+            params["head"] = {"kernel": head_w.T, "bias": get("classifier.bias")}
+        else:
+            # backbone-only checkpoint converted for finetuning: fresh head
+            # (zeros — the first optimizer steps learn it from the frozen-ish
+            # pretrained features, standard linear-probe init)
+            params["head"] = {
+                "kernel": np.zeros((h, cfg.num_classes), np.float32),
+                "bias": np.zeros((cfg.num_classes,), np.float32),
+            }
+    return params

@@ -49,6 +49,9 @@ class ViTConfig:
     initializer_range: float = 0.02
     use_recompute: bool = False
     attn_impl: str = "xla"  # bidirectional: flash (causal-only) not applicable
+    # tanh-approx gelu is the TPU default; HF ViT checkpoints use exact erf
+    gelu_approximate: bool = True
+    layer_norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     # "token": use cls token; "mean": global average pool (reference global_pool)
     pool: str = "token"
@@ -172,7 +175,7 @@ def _encoder_layer(p, x, cfg: ViTConfig, ctx, key, train):
     )
     dtype = x.dtype
 
-    y = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
+    y = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"], eps=cfg.layer_norm_eps)
     qkv = jnp.einsum("bsh,htnd->bstnd", y, p["attn"]["qkv_kernel"].astype(dtype))
     qkv = qkv + p["attn"]["qkv_bias"].astype(dtype)[None, None]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -185,10 +188,10 @@ def _encoder_layer(p, x, cfg: ViTConfig, ctx, key, train):
     out = out + p["attn"]["out_bias"].astype(dtype)
     x = x + dropout(k_resid, out, cfg.hidden_dropout_prob, train)
 
-    y = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
+    y = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"], eps=cfg.layer_norm_eps)
     mp = p["mlp"]
     y = y @ mp["fc_in_kernel"].astype(dtype) + mp["fc_in_bias"].astype(dtype)
-    y = jax.nn.gelu(y, approximate=True)
+    y = jax.nn.gelu(y, approximate=cfg.gelu_approximate)
     y = y @ mp["fc_out_kernel"].astype(dtype) + mp["fc_out_bias"].astype(dtype)
     x = x + dropout(k_mlp, y, cfg.hidden_dropout_prob, train)
     return _constrain(ctx, x, ("batch", None, "embed"))
@@ -230,7 +233,7 @@ def forward(
     body_fn = jax.checkpoint(body) if cfg.use_recompute else body
     x, _ = jax.lax.scan(body_fn, x, (params["layers"], jnp.arange(cfg.num_layers)))
 
-    x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
+    x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"], eps=cfg.layer_norm_eps)
     feat = x[:, 0] if cfg.pool == "token" else x[:, 1:].mean(axis=1)
     if cfg.representation_size:
         feat = jnp.tanh(

@@ -89,5 +89,12 @@ def save_params_checkpoint(out_dir: str, params, source: str, model_fields: dict
     with open(os.path.join(out, "model.yaml"), "w") as f:
         f.write("Model:\n")
         for k, v in model_fields.items():
+            if isinstance(v, float):
+                # YAML 1.1 reads "1e-12" as a STRING; force a float form
+                text = repr(v)
+                if "e" in text and "." not in text.split("e")[0]:
+                    mant, exp = text.split("e")
+                    text = f"{mant}.0e{exp}"
+                v = text
             f.write(f"  {k}: {v}\n")
     return out

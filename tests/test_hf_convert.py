@@ -267,3 +267,37 @@ def test_debertav2_conv_variant_matches_transformers():
     ours = np.asarray(dv2.encode(params, ids, cfg, attention_mask=mask, train=False))
     np.testing.assert_allclose(ours[mask.astype(bool)], ref[mask.astype(bool)],
                                atol=5e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# ViT (vision family oracle)
+# ---------------------------------------------------------------------------
+
+
+def test_vit_logits_match_transformers():
+    from transformers import ViTConfig as HFVitCfg, ViTForImageClassification
+
+    from paddlefleetx_tpu.models.vit import model as vit
+    from paddlefleetx_tpu.models.vit.convert import (
+        convert_hf_vit_state_dict,
+        hf_vit_config,
+    )
+
+    hf = HFVitCfg(
+        image_size=32, patch_size=8, num_channels=3, hidden_size=24,
+        num_hidden_layers=2, num_attention_heads=2, intermediate_size=48,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0, num_labels=10,
+    )
+    torch.manual_seed(0)
+    m = ViTForImageClassification(hf).eval()
+    cfg = hf_vit_config(
+        hf, num_classes=10, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, dtype="float32",
+    )
+    params = convert_hf_vit_state_dict(m.state_dict(), cfg)
+    rng = np.random.default_rng(0)
+    img = rng.normal(0, 1, (2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = m(pixel_values=torch.tensor(img).permute(0, 3, 1, 2)).logits.numpy()
+    ours = np.asarray(vit.forward(params, img, cfg, train=False))
+    np.testing.assert_allclose(ours, ref, atol=2e-5, rtol=1e-5)

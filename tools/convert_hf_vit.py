@@ -1,0 +1,71 @@
+"""Import a HuggingFace ViT checkpoint into the native format.
+
+Same contract as tools/convert_hf_gpt2.py: params-only orbax checkpoint +
+model.yaml.  Logits parity with transformers is covered by
+tests/test_hf_convert.py.
+
+Usage:
+  python tools/convert_hf_vit.py --model /path/to/hf_vit -o out/vit
+      [--num-classes 1000]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddlefleetx_tpu.utils.device import apply_platform_env
+
+apply_platform_env()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True, help="HF model dir (local)")
+    ap.add_argument("-o", "--out", required=True)
+    ap.add_argument("--num-classes", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from paddlefleetx_tpu.models.vit.convert import (
+        convert_hf_vit_state_dict,
+        hf_vit_config,
+    )
+
+    if args.num_classes > 0:
+        # head-bearing load: AutoModel would strip a trained classifier
+        from transformers import ViTForImageClassification
+
+        m = ViTForImageClassification.from_pretrained(args.model)
+    else:
+        from transformers import AutoModel
+
+        m = AutoModel.from_pretrained(args.model)
+    cfg = hf_vit_config(m.config, num_classes=args.num_classes)
+    params = convert_hf_vit_state_dict(m.state_dict(), cfg)
+
+    from paddlefleetx_tpu.utils.checkpoint import save_params_checkpoint
+
+    out = save_params_checkpoint(
+        args.out,
+        params,
+        f"hf-vit:{args.model}",
+        {
+            "module": "ViTModule",
+            "image_size": cfg.image_size,
+            "patch_size": cfg.patch_size,
+            "in_channels": cfg.in_channels,
+            "num_classes": cfg.num_classes,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_attention_heads,
+            "ffn_hidden_size": cfg.ffn_hidden_size,
+            "gelu_approximate": cfg.gelu_approximate,
+            "layer_norm_eps": cfg.layer_norm_eps,
+        },
+    )
+    print(f"converted -> {out}")
+
+
+if __name__ == "__main__":
+    main()
