@@ -1,0 +1,54 @@
+"""Shared plumbing for the HF checkpoint converters (gpt/t5/debertav2/vit/
+ernie convert.py modules): torch-or-numpy leaf extraction, backbone-prefix
+detection, and per-layer stacking.  One copy — a dtype or safetensors fix
+lands everywhere at once.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+
+def to_numpy(v) -> np.ndarray:
+    """torch tensor or array-like -> fp32 numpy."""
+    return np.asarray(
+        v.detach().cpu().numpy() if hasattr(v, "detach") else v
+    ).astype(np.float32)
+
+
+def detect_prefix(sd: Dict, candidates: Sequence[str]) -> str:
+    """First candidate prefix ('' always matches last) present in the keys —
+    classification/pretraining wrappers nest the backbone under one."""
+    names = list(sd.keys())
+    for p in candidates:
+        if p and any(n.startswith(p) for n in names):
+            return p
+    return ""
+
+
+def make_getter(sd: Dict, prefix: str = "") -> Callable[[str], np.ndarray]:
+    """get(name): prefer the prefixed key, fall back to the bare one."""
+
+    def get(name: str) -> np.ndarray:
+        key = prefix + name if prefix + name in sd else name
+        return to_numpy(sd[key])
+
+    return get
+
+
+def make_stacker(get: Callable[[str], np.ndarray], num_layers: int):
+    """stack(fmt): per-layer tensors -> one leading-L array, with optional
+    torch->native transpose and reshape."""
+
+    def stack(fmt: str, reshape: Optional[tuple] = None, transpose: bool = False):
+        arrs = []
+        for i in range(num_layers):
+            a = get(fmt.format(i=i))
+            if transpose:
+                a = a.T
+            arrs.append(a.reshape(reshape) if reshape is not None else a)
+        return np.stack(arrs)
+
+    return stack

@@ -60,23 +60,14 @@ def hf_debertav2_config(hf_cfg, **overrides) -> DebertaV2Config:
 def convert_hf_debertav2_state_dict(sd: Dict, cfg: DebertaV2Config) -> Dict:
     """torch/HF ``DebertaV2Model.state_dict()`` -> stacked param tree."""
 
-    def get(name):
-        v = sd[name]
-        return np.asarray(
-            v.detach().cpu().numpy() if hasattr(v, "detach") else v
-        ).astype(np.float32)
+    from paddlefleetx_tpu.models.convert_common import make_getter, make_stacker
+
+    get = make_getter(sd)
 
     h, nh, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
     L = cfg.num_layers
 
-    def stack(fmt, reshape=None, transpose=False):
-        arrs = []
-        for i in range(L):
-            a = get(fmt.format(i=i))
-            if transpose:
-                a = a.T
-            arrs.append(a.reshape(reshape) if reshape is not None else a)
-        return np.stack(arrs)
+    stack = make_stacker(get, L)
 
     params = {
         "embeddings": {

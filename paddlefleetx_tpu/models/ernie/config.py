@@ -25,6 +25,8 @@ class ErnieConfig:
     num_classes: int = 2  # sequence-classification head width
     dtype: str = "bfloat16"
     attn_impl: str = "xla"
+    # tanh-approx gelu (TPU default); HF/exact-erf checkpoints set False
+    gelu_approximate: bool = True
     use_recompute: bool = False
     recompute_granularity: str = "full"
     binary_head: bool = True

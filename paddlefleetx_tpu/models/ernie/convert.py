@@ -1,0 +1,148 @@
+"""HF ERNIE checkpoint -> native param tree (same role as gpt/convert.py).
+
+transformers ``ErnieModel`` (the nghuyong ERNIE 1.0/3.0 ports) is the same
+post-LN BERT-style encoder as the reference's paddle ERNIE; torch Linear
+weights are [out, in] — kernels transpose, separate q/k/v pack into the
+fused qkv kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from paddlefleetx_tpu.models.ernie.config import ErnieConfig
+
+
+def hf_ernie_config(hf_cfg, **overrides) -> ErnieConfig:
+    act = getattr(hf_cfg, "hidden_act", "gelu")
+    if act != "gelu":
+        raise ValueError(f"unsupported hidden_act {act!r}")
+    if abs(float(getattr(hf_cfg, "layer_norm_eps", 1e-12)) - 1e-12) > 1e-15:
+        raise ValueError(
+            f"unsupported layer_norm_eps {hf_cfg.layer_norm_eps} (model uses 1e-12)"
+        )
+    if getattr(hf_cfg, "use_task_id", False):
+        raise ValueError("task_type embeddings (use_task_id) not supported")
+    kw = dict(
+        vocab_size=int(hf_cfg.vocab_size),
+        hidden_size=int(hf_cfg.hidden_size),
+        num_layers=int(hf_cfg.num_hidden_layers),
+        num_attention_heads=int(hf_cfg.num_attention_heads),
+        ffn_hidden_size=int(hf_cfg.intermediate_size),
+        max_position_embeddings=int(hf_cfg.max_position_embeddings),
+        type_vocab_size=int(getattr(hf_cfg, "type_vocab_size", 2)),
+        pad_token_id=int(getattr(hf_cfg, "pad_token_id", 0)),
+        gelu_approximate=False,
+    )
+    kw.update(overrides)
+    return ErnieConfig(**kw)
+
+
+def convert_hf_ernie_state_dict(sd: Dict, cfg: ErnieConfig) -> Dict:
+    """torch/HF ``ErnieModel`` / ``ErnieForPreTraining`` state dict ->
+    stacked param tree (``ernie.`` prefixes handled; MLM/NSP heads map
+    when present, otherwise fresh zero heads are emitted)."""
+
+    from paddlefleetx_tpu.models.convert_common import (
+        detect_prefix,
+        make_getter,
+        make_stacker,
+    )
+
+    get = make_getter(sd, detect_prefix(sd, ("ernie.",)))
+
+    h, nh, hd, L = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim, cfg.num_layers
+
+    def qkv_stack(kind):
+        ks, bs = [], []
+        for i in range(L):
+            base = f"encoder.layer.{i}.attention.self.{kind}"
+            ks.append(get(base + ".weight").T.reshape(h, nh, hd))
+            bs.append(get(base + ".bias").reshape(nh, hd))
+        return np.stack(ks), np.stack(bs)
+
+    qk, qb = qkv_stack("query")
+    kk, kb = qkv_stack("key")
+    vk, vb = qkv_stack("value")
+
+    stack = make_stacker(get, L)
+
+    params = {
+        "embeddings": {
+            "word": get("embeddings.word_embeddings.weight"),
+            "position": get("embeddings.position_embeddings.weight"),
+            "token_type": get("embeddings.token_type_embeddings.weight"),
+            "ln": {
+                "scale": get("embeddings.LayerNorm.weight"),
+                "bias": get("embeddings.LayerNorm.bias"),
+            },
+        },
+        "layers": {
+            "attn": {
+                "qkv_kernel": np.stack([qk, kk, vk], axis=2),
+                "qkv_bias": np.stack([qb, kb, vb], axis=1),
+                "out_kernel": stack(
+                    "encoder.layer.{i}.attention.output.dense.weight",
+                    (nh, hd, h), transpose=True,
+                ),
+                "out_bias": stack("encoder.layer.{i}.attention.output.dense.bias"),
+            },
+            "ln_1": {
+                "scale": stack("encoder.layer.{i}.attention.output.LayerNorm.weight"),
+                "bias": stack("encoder.layer.{i}.attention.output.LayerNorm.bias"),
+            },
+            "mlp": {
+                "fc_in_kernel": stack(
+                    "encoder.layer.{i}.intermediate.dense.weight", transpose=True
+                ),
+                "fc_in_bias": stack("encoder.layer.{i}.intermediate.dense.bias"),
+                "fc_out_kernel": stack(
+                    "encoder.layer.{i}.output.dense.weight", transpose=True
+                ),
+                "fc_out_bias": stack("encoder.layer.{i}.output.dense.bias"),
+            },
+            "ln_2": {
+                "scale": stack("encoder.layer.{i}.output.LayerNorm.weight"),
+                "bias": stack("encoder.layer.{i}.output.LayerNorm.bias"),
+            },
+        },
+        "pooler": {
+            "kernel": get("pooler.dense.weight").T,
+            "bias": get("pooler.dense.bias"),
+        },
+    }
+    # pretrain heads (ErnieForPreTraining: cls.predictions / cls.seq_relationship
+    # live at the top level, outside the "ernie." backbone prefix)
+    if "cls.predictions.transform.dense.weight" in sd:
+        params["mlm"] = {
+            "transform_kernel": get("cls.predictions.transform.dense.weight").T,
+            "transform_bias": get("cls.predictions.transform.dense.bias"),
+            "ln": {
+                "scale": get("cls.predictions.transform.LayerNorm.weight"),
+                "bias": get("cls.predictions.transform.LayerNorm.bias"),
+            },
+            "decoder_bias": get("cls.predictions.bias"),
+        }
+        params["nsp"] = {
+            "kernel": get("cls.seq_relationship.weight").T,
+            "bias": get("cls.seq_relationship.bias"),
+        }
+    else:
+        params["mlm"] = {
+            "transform_kernel": np.zeros((h, h), np.float32),
+            "transform_bias": np.zeros((h,), np.float32),
+            "ln": {"scale": np.ones((h,), np.float32), "bias": np.zeros((h,), np.float32)},
+            "decoder_bias": np.zeros((cfg.vocab_size,), np.float32),
+        }
+        params["nsp"] = {
+            "kernel": np.zeros((h, 2), np.float32),
+            "bias": np.zeros((2,), np.float32),
+        }
+    if cfg.num_classes:
+        params["cls_head"] = {
+            "kernel": np.zeros((h, cfg.num_classes), np.float32),
+            "bias": np.zeros((cfg.num_classes,), np.float32),
+        }
+    return params

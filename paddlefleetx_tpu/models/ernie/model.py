@@ -163,7 +163,7 @@ def _encoder_layer(p, x, bias, cfg: ErnieConfig, ctx, key, train):
 
     h = x @ p["mlp"]["fc_in_kernel"].astype(dtype) + p["mlp"]["fc_in_bias"].astype(dtype)
     h = _constrain(ctx, h, ("batch", None, "mlp"))
-    h = jax.nn.gelu(h, approximate=True)
+    h = jax.nn.gelu(h, approximate=cfg.gelu_approximate)
     h = h @ p["mlp"]["fc_out_kernel"].astype(dtype) + p["mlp"]["fc_out_bias"].astype(dtype)
     h = dropout(k_mlp, h, cfg.hidden_dropout_prob, train)
     x = layer_norm(x + h, p["ln_2"]["scale"], p["ln_2"]["bias"], eps=1e-12)
@@ -239,7 +239,7 @@ def pretrain_logits(
     dtype = sequence_output.dtype
     p = params["mlm"]
     h = sequence_output @ p["transform_kernel"].astype(dtype) + p["transform_bias"].astype(dtype)
-    h = jax.nn.gelu(h, approximate=True)
+    h = jax.nn.gelu(h, approximate=cfg.gelu_approximate)
     h = layer_norm(h, p["ln"]["scale"], p["ln"]["bias"], eps=1e-12)
     word = params["embeddings"]["word"].astype(dtype)
     logits = jnp.einsum("bsh,vh->bsv", h, word) + p["decoder_bias"].astype(dtype)

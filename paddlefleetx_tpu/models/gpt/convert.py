@@ -61,9 +61,9 @@ def convert_hf_gpt2_state_dict(
     model config must then use the padded vocab_size.
     """
 
-    def get(name):
-        v = sd[name]
-        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+    from paddlefleetx_tpu.models.convert_common import make_getter, make_stacker
+
+    get = make_getter(sd)
 
     h, L = cfg.hidden_size, cfg.num_layers
     nh, hd = cfg.num_attention_heads, cfg.head_dim
@@ -79,12 +79,7 @@ def convert_hf_gpt2_state_dict(
             f"config vocab_size {cfg.vocab_size} != embedding rows {word.shape[0]}"
         )
 
-    def stack(fmt, reshape=None):
-        arrs = []
-        for i in range(L):
-            a = get(fmt.format(i=i)).astype(np.float32)
-            arrs.append(a.reshape(reshape) if reshape is not None else a)
-        return np.stack(arrs)
+    stack = make_stacker(get, L)
 
     params = {
         "embeddings": {

@@ -51,11 +51,9 @@ def hf_t5_config(hf_cfg, **overrides) -> T5Config:
 def convert_hf_t5_state_dict(sd: Dict, cfg: T5Config) -> Dict:
     """torch/HF ``T5ForConditionalGeneration.state_dict()`` -> param tree."""
 
-    def get(name):
-        v = sd[name]
-        return np.asarray(
-            v.detach().cpu().numpy() if hasattr(v, "detach") else v
-        ).astype(np.float32)
+    from paddlefleetx_tpu.models.convert_common import make_getter
+
+    get = make_getter(sd)
 
     d, nh, kv = cfg.d_model, cfg.num_heads, cfg.d_kv
 

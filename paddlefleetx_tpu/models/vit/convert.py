@@ -42,14 +42,13 @@ def convert_hf_vit_state_dict(sd: Dict, cfg: ViTConfig) -> Dict:
     stacked param tree.  Keys may carry a ``vit.`` prefix (classification
     models); the classifier head maps when num_classes matches."""
 
-    names = list(sd.keys())
-    prefix = "vit." if any(n.startswith("vit.") for n in names) else ""
+    from paddlefleetx_tpu.models.convert_common import (
+        detect_prefix,
+        make_getter,
+        make_stacker,
+    )
 
-    def get(name):
-        v = sd[prefix + name] if prefix + name in sd else sd[name]
-        return np.asarray(
-            v.detach().cpu().numpy() if hasattr(v, "detach") else v
-        ).astype(np.float32)
+    get = make_getter(sd, detect_prefix(sd, ("vit.",)))
 
     h, nh, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
     L, ps, C = cfg.num_layers, cfg.patch_size, cfg.in_channels
@@ -66,14 +65,7 @@ def convert_hf_vit_state_dict(sd: Dict, cfg: ViTConfig) -> Dict:
     kk, kb = qkv_stack("key")
     vk, vb = qkv_stack("value")
 
-    def stack(fmt, reshape=None, transpose=False):
-        arrs = []
-        for i in range(L):
-            a = get(fmt.format(i=i))
-            if transpose:
-                a = a.T
-            arrs.append(a.reshape(reshape) if reshape is not None else a)
-        return np.stack(arrs)
+    stack = make_stacker(get, L)
 
     params = {
         "cls_token": get("embeddings.cls_token"),

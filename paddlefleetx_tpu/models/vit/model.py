@@ -121,11 +121,12 @@ def vit_specs(cfg: ViTConfig) -> Dict[str, Any]:
         "layers": stack_spec_tree(_encoder_layer_specs(cfg), cfg.num_layers),
         "final_ln": {"scale": ParamSpec((h,), ("embed",), ones_init()),
                      "bias": ParamSpec((h,), ("embed",), zeros_init())},
-        "head": {
+    }
+    if cfg.num_classes:
+        specs["head"] = {
             "kernel": ParamSpec((h, cfg.num_classes), ("embed", "vocab"), w),
             "bias": ParamSpec((cfg.num_classes,), ("vocab",), zeros_init()),
-        },
-    }
+        }
     if cfg.representation_size:
         specs["pre_logits"] = {
             "kernel": ParamSpec((h, cfg.representation_size), ("embed", "mlp"), w),
@@ -240,6 +241,8 @@ def forward(
             feat @ params["pre_logits"]["kernel"].astype(dtype)
             + params["pre_logits"]["bias"].astype(dtype)
         )
+    if "head" not in params:  # backbone/feature-extractor mode (num_classes 0)
+        return feat
     logits = feat @ params["head"]["kernel"].astype(dtype) + params["head"]["bias"].astype(dtype)
     return logits
 

@@ -301,3 +301,85 @@ def test_vit_logits_match_transformers():
         ref = m(pixel_values=torch.tensor(img).permute(0, 3, 1, 2)).logits.numpy()
     ours = np.asarray(vit.forward(params, img, cfg, train=False))
     np.testing.assert_allclose(ours, ref, atol=2e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# ERNIE (post-LN encoder family oracle, incl. MLM/NSP pretrain heads)
+# ---------------------------------------------------------------------------
+
+
+def _hf_ernie_cfg():
+    from transformers import ErnieConfig as HFCfg
+
+    return HFCfg(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=64, max_position_embeddings=32, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0, pad_token_id=0,
+    )
+
+
+def test_ernie_hidden_and_pooled_match_transformers():
+    from transformers import ErnieModel
+
+    from paddlefleetx_tpu.models.ernie import model as ernie
+    from paddlefleetx_tpu.models.ernie.convert import (
+        convert_hf_ernie_state_dict,
+        hf_ernie_config,
+    )
+
+    hf = _hf_ernie_cfg()
+    torch.manual_seed(0)
+    m = ErnieModel(hf).eval()
+    cfg = hf_ernie_config(
+        hf, hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0, dtype="float32"
+    )
+    params = convert_hf_ernie_state_dict(m.state_dict(), cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, 96, (2, 12))
+    tt = np.zeros((2, 12), np.int64)
+    mask = np.ones((2, 12), np.int64)
+    mask[1, 9:] = 0
+    with torch.no_grad():
+        out = m(input_ids=torch.tensor(ids), token_type_ids=torch.tensor(tt),
+                attention_mask=torch.tensor(mask))
+    seq, pooled = ernie.encode(
+        params, ids, cfg, token_type_ids=tt, attention_mask=mask, train=False
+    )
+    v = mask.astype(bool)
+    np.testing.assert_allclose(np.asarray(seq)[v], out.last_hidden_state.numpy()[v],
+                               atol=2e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pooled), out.pooler_output.numpy(),
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_ernie_pretrain_heads_match_transformers():
+    from transformers import ErnieForPreTraining
+
+    from paddlefleetx_tpu.models.ernie import model as ernie
+    from paddlefleetx_tpu.models.ernie.convert import (
+        convert_hf_ernie_state_dict,
+        hf_ernie_config,
+    )
+
+    hf = _hf_ernie_cfg()
+    torch.manual_seed(1)
+    m = ErnieForPreTraining(hf).eval()
+    cfg = hf_ernie_config(
+        hf, hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0, dtype="float32"
+    )
+    params = convert_hf_ernie_state_dict(m.state_dict(), cfg)
+    rng = np.random.default_rng(2)
+    ids = rng.integers(3, 96, (2, 12))
+    tt = np.zeros((2, 12), np.int64)
+    mask = np.ones((2, 12), np.int64)
+    with torch.no_grad():
+        out = m(input_ids=torch.tensor(ids), token_type_ids=torch.tensor(tt),
+                attention_mask=torch.tensor(mask))
+    seq, pooled = ernie.encode(
+        params, ids, cfg, token_type_ids=tt, attention_mask=mask, train=False
+    )
+    mlm_logits, nsp_logits = ernie.pretrain_logits(params, seq, pooled, cfg)
+    np.testing.assert_allclose(np.asarray(mlm_logits), out.prediction_logits.numpy(),
+                               atol=3e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(nsp_logits), out.seq_relationship_logits.numpy(),
+                               atol=3e-5, rtol=1e-5)

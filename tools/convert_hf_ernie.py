@@ -1,0 +1,74 @@
+"""Import a HuggingFace ERNIE checkpoint into the native format.
+
+Same contract as tools/convert_hf_gpt2.py: params-only orbax checkpoint +
+model.yaml.  Hidden-state/pooled/MLM/NSP parity with transformers is
+covered by tests/test_hf_convert.py.
+
+Usage:
+  python tools/convert_hf_ernie.py --model /path/to/hf_ernie -o out/ernie
+      [--pretraining]   # load ErnieForPreTraining (maps MLM/NSP heads)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddlefleetx_tpu.utils.device import apply_platform_env
+
+apply_platform_env()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True, help="HF model dir (local)")
+    ap.add_argument("-o", "--out", required=True)
+    ap.add_argument("--pretraining", action="store_true")
+    ap.add_argument(
+        "--num-classes", type=int, default=0,
+        help="emit a fresh zero cls_head this wide (for seq-cls finetuning)",
+    )
+    args = ap.parse_args(argv)
+
+    from paddlefleetx_tpu.models.ernie.convert import (
+        convert_hf_ernie_state_dict,
+        hf_ernie_config,
+    )
+
+    if args.pretraining:
+        from transformers import ErnieForPreTraining
+
+        m = ErnieForPreTraining.from_pretrained(args.model)
+    else:
+        from transformers import ErnieModel
+
+        m = ErnieModel.from_pretrained(args.model)
+    cfg = hf_ernie_config(m.config, num_classes=args.num_classes)
+    params = convert_hf_ernie_state_dict(m.state_dict(), cfg)
+
+    from paddlefleetx_tpu.utils.checkpoint import save_params_checkpoint
+
+    out = save_params_checkpoint(
+        args.out,
+        params,
+        f"hf-ernie:{args.model}",
+        {
+            "module": "ErnieModule",
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_attention_heads,
+            "ffn_hidden_size": cfg.ffn_hidden_size,
+            "max_position_embeddings": cfg.max_position_embeddings,
+            "type_vocab_size": cfg.type_vocab_size,
+            "pad_token_id": cfg.pad_token_id,
+            "num_classes": cfg.num_classes,
+            "gelu_approximate": cfg.gelu_approximate,
+        },
+    )
+    print(f"converted -> {out}")
+
+
+if __name__ == "__main__":
+    main()

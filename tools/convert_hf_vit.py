@@ -36,13 +36,23 @@ def main(argv=None):
         # head-bearing load: AutoModel would strip a trained classifier
         from transformers import ViTForImageClassification
 
-        m = ViTForImageClassification.from_pretrained(args.model)
+        m, info = ViTForImageClassification.from_pretrained(
+            args.model, num_labels=args.num_classes, output_loading_info=True
+        )
+        sd = m.state_dict()
+        if any(k.startswith("classifier") for k in info.get("missing_keys", [])):
+            # the checkpoint had no trained classifier: drop the randomly
+            # initialized one so the converter emits its documented
+            # zero-init linear-probe head instead of random garbage
+            print("note: checkpoint has no trained classifier; emitting a zero head")
+            sd = {k: v for k, v in sd.items() if not k.startswith("classifier")}
     else:
         from transformers import AutoModel
 
         m = AutoModel.from_pretrained(args.model)
+        sd = m.state_dict()
     cfg = hf_vit_config(m.config, num_classes=args.num_classes)
-    params = convert_hf_vit_state_dict(m.state_dict(), cfg)
+    params = convert_hf_vit_state_dict(sd, cfg)
 
     from paddlefleetx_tpu.utils.checkpoint import save_params_checkpoint
 
