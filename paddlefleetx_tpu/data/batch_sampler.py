@@ -37,6 +37,14 @@ class DistributedBatchSampler:
         self.drop_last = drop_last
         self.seed = seed
         self.consumed_samples = int(consumed_samples)
+        if self.drop_last and self.n < self.batch_size:
+            # the epoch loop would otherwise spin forever yielding nothing
+            # (observed as a silent eval hang on a 4-sample eval split)
+            raise ValueError(
+                f"dataset has {self.n} samples < batch_size {self.batch_size} "
+                "with drop_last: no batch can ever be formed — lower the "
+                "batch size (Global.eval_batch_size for eval) or grow the data"
+            )
 
     def __iter__(self) -> Iterator[np.ndarray]:
         epoch = self.consumed_samples // self.n

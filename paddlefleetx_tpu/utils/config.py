@@ -218,6 +218,11 @@ def process_global_configs(cfg: AttrDict) -> AttrDict:
     g.global_batch_size = int(gbs)
     g.local_batch_size = int(lbs)
     g.micro_batch_size = int(mbs)
+    ebs = g.get("eval_batch_size")
+    if ebs is not None and int(ebs) % dp_world != 0:
+        raise ValueError(
+            f"eval_batch_size {ebs} not divisible by dp world {dp_world}"
+        )
     g.setdefault("seed", 1024)
     g.setdefault("device", "tpu")
 

@@ -173,3 +173,14 @@ def test_prefetch_loader_order_and_errors(tmp_path):
         for x in PrefetchLoader(boom(), depth=1):
             out.append(x)
     assert out == [1]
+
+
+def test_sampler_rejects_impossible_batch():
+    """batch_size > dataset with drop_last used to spin forever yielding
+    nothing (silent eval hang); now a pointed construction error."""
+    with pytest.raises(ValueError, match="no batch can ever be formed"):
+        DistributedBatchSampler(dataset_len=4, batch_size=16, drop_last=True)
+    # drop_last=False still allowed: yields the partial tail
+    s = DistributedBatchSampler(dataset_len=4, batch_size=16, drop_last=False)
+    batch = next(iter(s))
+    assert len(batch) == 4
