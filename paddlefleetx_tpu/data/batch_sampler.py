@@ -37,6 +37,8 @@ class DistributedBatchSampler:
         self.drop_last = drop_last
         self.seed = seed
         self.consumed_samples = int(consumed_samples)
+        if self.batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {self.batch_size}")
         if self.drop_last and self.n < self.batch_size:
             # the epoch loop would otherwise spin forever yielding nothing
             # (observed as a silent eval hang on a 4-sample eval split)

@@ -219,9 +219,10 @@ def process_global_configs(cfg: AttrDict) -> AttrDict:
     g.local_batch_size = int(lbs)
     g.micro_batch_size = int(mbs)
     ebs = g.get("eval_batch_size")
-    if ebs is not None and int(ebs) % dp_world != 0:
+    if ebs is not None and (int(ebs) <= 0 or int(ebs) % dp_world != 0):
         raise ValueError(
-            f"eval_batch_size {ebs} not divisible by dp world {dp_world}"
+            f"eval_batch_size {ebs} must be a positive multiple of "
+            f"dp world {dp_world}"
         )
     g.setdefault("seed", 1024)
     g.setdefault("device", "tpu")

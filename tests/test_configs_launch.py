@@ -259,3 +259,44 @@ def test_check_version_passes_here():
 
     check_version()
     check_device("cpu")
+
+
+@pytest.mark.slow
+def test_export_then_inference_cli(tmp_path):
+    """tools/export.py -> tools/inference.py chain on the CPU mesh
+    (reference deploy path: export -> InferenceEngine predict)."""
+    from paddlefleetx_tpu.data.gpt_dataset import write_synthetic_corpus
+
+    data = tmp_path / "data"
+    data.mkdir()
+    write_synthetic_corpus(str(data / "corp"), vocab_size=128, num_docs=16)
+    common = [
+        "Model.num_layers=2", "Model.hidden_size=64",
+        "Model.num_attention_heads=4", "Model.vocab_size=128",
+        "Model.max_position_embeddings=32",
+        "Global.global_batch_size=16", "Global.local_batch_size=2",
+        "Global.micro_batch_size=2",
+        f"Data.Train.dataset.input_dir={data}", "Data.Train.dataset.max_seq_len=32",
+        f"Engine.save_load.output_dir={tmp_path / 'out'}",
+    ]
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    env["PFX_PLATFORM"] = "cpu"
+
+    def run(tool, extra):
+        cmd = [sys.executable, os.path.join(REPO, "tools", tool),
+               "-c", os.path.join(REPO, "configs/gpt/pretrain_gpt_345M_single.yaml")]
+        for o in common + extra:
+            cmd += ["-o", o]
+        out = subprocess.run(cmd, capture_output=True, text=True, timeout=540,
+                             cwd=REPO, env=env)
+        assert out.returncode == 0, (tool, out.stderr[-2000:])
+        return out.stdout + out.stderr
+
+    run("export.py", [])
+    assert (tmp_path / "out" / "inference" / "model.stablehlo").exists()
+    log = run("inference.py", [
+        f"Inference.model_dir={tmp_path / 'out' / 'inference'}",
+        "Inference.max_seq_len=32",
+    ])
+    assert "inference ok" in log
