@@ -51,7 +51,9 @@ def main(argv=None):
             cfg, "Train", consumed_samples=engine._consumed_samples
         )
         eval_loader = (
-            build_dataloader(cfg, "Eval") if "Eval" in cfg.get("Data", {}) else None
+            build_dataloader(cfg, "Eval")
+            if "Eval" in cfg.get("Data", {}) and int(cfg.Engine.get("eval_freq", 0) or 0)
+            else None
         )
         engine.fit(train_loader, eval_loader)
         if cfg.Engine.save_load.get("save_steps"):
