@@ -45,12 +45,14 @@ def main():
     platform = os.environ.get("PFX_PLATFORM", "").lower()
     if platform in ("", "tpu", "axon"):
         alive = False
-        for attempt in range(2):
+        # the axon tunnel has been observed dropping for hours at a time:
+        # be patient (4 probes over ~5 min) before reporting unreachable
+        for attempt in range(4):
             if _backend_alive():
                 alive = True
                 break
-            if attempt == 0:
-                time.sleep(30)
+            if attempt < 3:
+                time.sleep(60)
         if not alive:
             # emit an honest failure line rather than hanging the driver
             print(
