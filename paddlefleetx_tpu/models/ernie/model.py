@@ -261,6 +261,151 @@ def _token_ce(logits: jax.Array, labels: jax.Array, ignore_index: int = -1) -> j
     return jnp.sum(nll) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
 
 
+def _pipeline_pretrain_loss(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: ErnieConfig,
+    ctx: ShardingCtx,
+    dropout_key: Optional[jax.Array],
+) -> jax.Array:
+    """ERNIE pretrain loss under the 1F1B pipeline schedule (reference
+    ErnieForPretrainingPipe, ernie/dygraph/hybrid_model.py:796).
+
+    Unlike causal GPT, every encoder layer needs the padding mask; the
+    schedule threads one activation tensor between stages, so the mask
+    rides along as an extra trailing feature column ([b, s, h+1]) and each
+    stage slices it back off.  Per-microbatch losses are normalized
+    microbatch-locally and averaged — the same semantics as the engine's
+    gradient-accumulation loop."""
+    from paddlefleetx_tpu.parallel.pipeline import (
+        interleave_permutation,
+        pipeline_loss_1f1b,
+    )
+
+    pcfg = ctx.pipeline
+    S, V = pcfg.num_stages, pcfg.num_virtual_stages
+    C = S * V
+    if cfg.num_layers % C:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by {S} stages x {V} virtual"
+        )
+    pc = cfg.num_layers // C
+    dtype = jnp.dtype(cfg.dtype)
+
+    k_embed, k_layers = (
+        jax.random.split(dropout_key) if dropout_key is not None else (None, None)
+    )
+
+    b, s = batch["input_ids"].shape
+    input_ids = batch["input_ids"]
+    attention_mask = batch.get("attention_mask")
+    if attention_mask is None:
+        attention_mask = (input_ids != cfg.pad_token_id).astype(jnp.float32)
+    fbatch = {
+        "input_ids": input_ids.astype(jnp.float32),
+        "token_type_ids": (
+            batch.get("token_type_ids")
+            if batch.get("token_type_ids") is not None
+            else jnp.zeros((b, s), jnp.int32)
+        ).astype(jnp.float32),
+        "attention_mask": attention_mask.astype(jnp.float32),
+        "masked_lm_labels": batch["masked_lm_labels"].astype(jnp.float32),
+    }
+    if "next_sentence_label" in batch:
+        fbatch["next_sentence_label"] = batch["next_sentence_label"].astype(jnp.float32)
+    M = pcfg.num_microbatches
+
+    def embed_fn(eparams, mb, mbi):
+        ids = mb["input_ids"].astype(jnp.int32)
+        tt = mb["token_type_ids"].astype(jnp.int32)
+        pos = jnp.arange(s, dtype=jnp.int32)[None, :]
+        x = (
+            eparams["word"].astype(dtype)[ids]
+            + eparams["position"].astype(dtype)[pos]
+            + eparams["token_type"].astype(dtype)[tt]
+        )
+        x = layer_norm(x, eparams["ln"]["scale"], eparams["ln"]["bias"], eps=1e-12)
+        k = jax.random.fold_in(k_embed, mbi) if k_embed is not None else None
+        x = dropout(k, x, cfg.hidden_dropout_prob, True)
+        return jnp.concatenate([x, mb["attention_mask"].astype(dtype)[..., None]], -1)
+
+    def chunk_fn(chunk_params, xm_mb, c, mbi):
+        x_mb, mask = xm_mb[..., :-1], xm_mb[..., -1]
+        bias = ((1.0 - mask.astype(jnp.float32)) * -1e9)[:, None, None, :]
+
+        def sbody(carry, inp):
+            params_l, local_idx = inp
+            k = (
+                jax.random.fold_in(
+                    jax.random.fold_in(k_layers, c * pc + local_idx), mbi
+                )
+                if k_layers is not None
+                else None
+            )
+            out = _encoder_layer(params_l, carry, bias, cfg, ctx, k, True)
+            return out, None
+
+        # same dispatch as encode(): whole-layer checkpoint only for "full"
+        # (core_attn's inner checkpoint already lives in _encoder_layer)
+        if cfg.use_recompute and cfg.recompute_granularity == "full":
+            sbody = jax.checkpoint(sbody)
+        x_mb, _ = jax.lax.scan(sbody, x_mb, (chunk_params, jnp.arange(pc)))
+        return jnp.concatenate([x_mb, mask[..., None].astype(x_mb.dtype)], -1)
+
+    def head_fn(hparams, ym_mb, mb, mbi):
+        y = ym_mb[..., :-1]
+        pooled = jnp.tanh(
+            y[:, 0] @ hparams["pooler"]["kernel"].astype(y.dtype)
+            + hparams["pooler"]["bias"].astype(y.dtype)
+        )
+        hp = {
+            "mlm": hparams["mlm"],
+            "embeddings": {"word": hparams["word"]},
+        }
+        if "nsp" in hparams:
+            hp["nsp"] = hparams["nsp"]
+        mlm_logits, nsp_logits = pretrain_logits(hp, y, pooled, cfg, ctx)
+        # one-hot contraction, not take_along_axis: the scatter transpose of
+        # a gather over the model-sharded vocab dim trips an XLA
+        # partial-manual partitioner CHECK inside the pipelined shard_map
+        # (same workaround as the GPT 1F1B head)
+        labels_t = mb["masked_lm_labels"].astype(jnp.int32)
+        valid = (labels_t != -1).astype(jnp.float32)
+        safe = jnp.where(labels_t != -1, labels_t, 0)
+        lg = mlm_logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(lg, axis=-1)
+        picked = jnp.sum(lg * jax.nn.one_hot(safe, lg.shape[-1], dtype=lg.dtype), -1)
+        loss = jnp.sum((lse - picked) * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+        if nsp_logits is not None and "next_sentence_label" in mb:
+            nsp = nsp_logits.astype(jnp.float32)
+            labels = mb["next_sentence_label"].astype(jnp.int32).reshape(-1)
+            nsp_nll = jax.nn.logsumexp(nsp, -1) - jnp.take_along_axis(
+                nsp, labels[:, None], axis=-1
+            )[:, 0]
+            loss = loss + nsp_nll.mean()
+        return loss / M
+
+    layers_params = params["layers"]
+    if V > 1:
+        perm = interleave_permutation(cfg.num_layers, S, V)
+        layers_params = jax.tree.map(lambda a: jnp.take(a, perm, axis=0), layers_params)
+
+    hparams = {
+        "pooler": params["pooler"],
+        "mlm": params["mlm"],
+        "word": params["embeddings"]["word"],
+    }
+    if cfg.binary_head and "nsp" in params:
+        hparams["nsp"] = params["nsp"]
+    return pipeline_loss_1f1b(
+        (embed_fn, chunk_fn, head_fn),
+        pcfg,
+        ctx.mesh,
+        (params["embeddings"], layers_params, hparams),
+        fbatch,
+    )
+
+
 def pretrain_loss(
     params: Dict[str, Any],
     batch: Dict[str, jax.Array],
@@ -274,6 +419,13 @@ def pretrain_loss(
     (-1 for unmasked), next_sentence_label [b] (optional).
 
     loss = MLM CE + NSP CE (ErniePretrainingCriterion single_model.py:631-644)."""
+    if (
+        train
+        and ctx is not None
+        and ctx.pipeline is not None
+        and ctx.pipeline.num_stages > 1
+    ):
+        return _pipeline_pretrain_loss(params, batch, cfg, ctx, dropout_key)
     seq_out, pooled = encode(
         params,
         batch["input_ids"],

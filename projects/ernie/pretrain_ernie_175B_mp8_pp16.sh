@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# ERNIE 175B-class mp8 x pp16 1F1B pretrain (reference
+# projects/ernie/pretrain_ernie_base_175B_mp8_pp16.sh); run on every host
+# with PFX_COORDINATOR_ADDRESS set
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/ernie/pretrain_ernie_175B_mp8_pp16.yaml "$@"

@@ -20,6 +20,7 @@ ALL_CONFIGS = [
     ("configs/gpt/pretrain_gpt_175B_mp8_pp16.yaml", 128),
     ("configs/gpt/finetune_gpt_345M_glue.yaml", 1),
     ("configs/ernie/pretrain_ernie_base.yaml", 1),
+    ("configs/ernie/pretrain_ernie_175B_mp8_pp16.yaml", 128),
     ("configs/t5/pretrain_t5_base.yaml", 1),
     ("configs/debertav2/pretrain_debertav2_base.yaml", 1),
     ("configs/imagen/imagen_text2im_64_base.yaml", 1),
