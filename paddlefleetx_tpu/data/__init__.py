@@ -9,6 +9,7 @@ from paddlefleetx_tpu.data import glue_dataset as _glue_dataset  # noqa: F401 (r
 from paddlefleetx_tpu.data import gpt_dataset as _gpt_dataset  # noqa: F401 (registers)
 from paddlefleetx_tpu.data import multimodal_dataset as _multimodal_dataset  # noqa: F401 (registers)
 from paddlefleetx_tpu.data import protein_dataset as _protein_dataset  # noqa: F401 (registers)
+from paddlefleetx_tpu.data import t5_dataset as _t5_dataset  # noqa: F401 (registers)
 from paddlefleetx_tpu.data import vision_dataset as _vision_dataset  # noqa: F401 (registers)
 from paddlefleetx_tpu.data.batch_sampler import (  # noqa: F401
     DataLoader,

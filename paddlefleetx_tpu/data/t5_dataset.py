@@ -1,0 +1,149 @@
+"""T5 span-corruption pretraining dataset.
+
+The reference ships T5 purely as a model library (modeling.py) with no
+pretraining data path; this closes that gap so the T5 family trains
+end-to-end from the CLI.  Windows come from the same mmap corpus format
+(and window machinery) as GPTDataset; each window is corrupted with the
+standard T5 scheme (random_spans_noise_mask, arXiv:1910.10683 §3.1.4 /
+HF FlaxDataCollatorForT5MLM): ~``corruption_rate`` of tokens in spans of
+mean length ``mean_span_len`` are replaced by one sentinel each in the
+input; the target is each sentinel followed by the span it replaced, then
+EOS.  Sentinels occupy the TOP of the vocab descending (extra_id_0 =
+vocab_size-1 — the reference/HF layout the tokenizer also uses).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+from paddlefleetx_tpu.data.gpt_dataset import GPTDataset
+from paddlefleetx_tpu.utils.registry import DATASETS
+
+
+def random_spans_noise_mask(
+    length: int,
+    noise_density: float,
+    mean_span_len: float,
+    rng: np.random.Generator,
+    max_spans: int = 100,
+) -> np.ndarray:
+    """Boolean [length] mask: True = noise (standard T5 partition scheme).
+
+    ``max_spans`` caps the span count at the sentinel budget; the count is
+    also bounded by what the partitions can express (each span needs >= 1
+    noise token, the gaps need num_spans+1 >= 1 non-noise tokens)."""
+    num_noise = int(round(length * noise_density))
+    num_noise = min(max(num_noise, 1), length - 1)
+    num_nonnoise = length - num_noise
+    num_spans = int(round(num_noise / mean_span_len))
+    num_spans = max(min(num_spans, num_noise, num_nonnoise - 1, max_spans), 1)
+
+    def partition(total: int, parts: int) -> np.ndarray:
+        # random composition of `total` into `parts` positive integers
+        cuts = np.sort(rng.choice(total - 1, parts - 1, replace=False)) if parts > 1 else np.array([], np.int64)
+        bounds = np.concatenate([[0], cuts + 1, [total]])
+        return np.diff(bounds)
+
+    noise_spans = partition(num_noise, num_spans)
+    nonnoise_spans = partition(num_nonnoise, num_spans + 1)
+
+    mask = np.zeros(length, bool)
+    pos = nonnoise_spans[0]
+    for i in range(num_spans):
+        mask[pos : pos + noise_spans[i]] = True
+        pos += noise_spans[i] + nonnoise_spans[i + 1]
+    return mask
+
+
+@DATASETS.register("T5PretrainDataset")
+class T5PretrainDataset:
+    """Yields input_ids [max_seq_len] and labels [max_target_len]."""
+
+    def __init__(
+        self,
+        input_dir: str = None,
+        data_prefix: str = None,
+        split: Sequence[float] = (949, 50, 1),
+        max_seq_len: int = 512,
+        max_target_len: int = 128,
+        corruption_rate: float = 0.15,
+        mean_span_len: float = 3.0,
+        vocab_size: int = 32128,
+        num_sentinels: int = 100,
+        pad_token_id: int = 0,
+        eos_token_id: int = 1,
+        num_samples: int = None,
+        mode: str = "Train",
+        seed: int = 1234,
+        build_cache: bool = True,
+        **_unused,
+    ):
+        self.base = GPTDataset(
+            input_dir=input_dir,
+            data_prefix=data_prefix,
+            split=split,
+            max_seq_len=max_seq_len,
+            num_samples=num_samples,
+            mode=mode,
+            seed=seed,
+            build_cache=build_cache,
+        )
+        self.enc_len = int(max_seq_len)
+        self.dec_len = int(max_target_len)
+        self.rate = float(corruption_rate)
+        self.mean_span = float(mean_span_len)
+        self.vocab_size = int(vocab_size)
+        self.num_sentinels = int(num_sentinels)
+        self.pad_id = int(pad_token_id)
+        self.eos_id = int(eos_token_id)
+        self.seed = int(seed)
+        # expected target length must fit: each example carries ~rate*L
+        # noise tokens + one sentinel per span + EOS (rare tails truncate)
+        exp_noise = int(round(self.enc_len * self.rate))
+        exp_spans = max(min(int(round(exp_noise / self.mean_span)), self.num_sentinels), 1)
+        if exp_noise + exp_spans + 1 > self.dec_len:
+            raise ValueError(
+                f"max_target_len {self.dec_len} too small for "
+                f"~{exp_noise} noise tokens + {exp_spans} sentinels + EOS at "
+                f"corruption_rate {self.rate}, mean_span_len {self.mean_span} "
+                f"(need >= {exp_noise + exp_spans + 1})"
+            )
+
+    def __len__(self) -> int:
+        return len(self.base)
+
+    def _sentinel(self, k: int) -> int:
+        return self.vocab_size - 1 - k  # extra_id_k, descending layout
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        tokens = self.base[idx]["tokens"]  # [enc_len] raw window
+        rng = np.random.default_rng((self.seed, idx))
+        mask = random_spans_noise_mask(
+            len(tokens), self.rate, self.mean_span, rng, max_spans=self.num_sentinels
+        )
+
+        inputs, targets = [], []
+        k = 0
+        i = 0
+        L = len(tokens)
+        while i < L:
+            if mask[i]:
+                sent = self._sentinel(k)
+                k += 1
+                inputs.append(sent)
+                targets.append(sent)
+                while i < L and mask[i]:
+                    targets.append(int(tokens[i]))
+                    i += 1
+            else:
+                inputs.append(int(tokens[i]))
+                i += 1
+        targets.append(self.eos_id)
+
+        inp = np.full(self.enc_len, self.pad_id, np.int64)
+        inp[: min(len(inputs), self.enc_len)] = inputs[: self.enc_len]
+        lab = np.full(self.dec_len, self.pad_id, np.int64)
+        lab[: min(len(targets), self.dec_len)] = targets[: self.dec_len]
+        return {"input_ids": inp, "labels": lab}

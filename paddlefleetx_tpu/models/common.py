@@ -113,3 +113,17 @@ def dropout(key: Optional[jax.Array], x: jax.Array, rate: float, train: bool) ->
     keep = 1.0 - rate
     mask = jax.random.bernoulli(key, keep, x.shape)
     return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def one_hot_token_nll(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-token negative log-likelihood, fp32, via a one-hot contraction.
+
+    NOT take_along_axis: the scatter transpose of a gather over a
+    model-sharded vocab dim trips an XLA partial-manual partitioner CHECK
+    inside pipelined shard_maps; the one-hot contraction's transpose is a
+    plain (psum-able) broadcast-multiply.  Used by the GPT and ERNIE 1F1B
+    pipeline heads."""
+    lg = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lg, axis=-1)
+    picked = jnp.sum(lg * jax.nn.one_hot(labels, lg.shape[-1], dtype=lg.dtype), -1)
+    return lse - picked

@@ -365,17 +365,13 @@ def _pipeline_pretrain_loss(
         if "nsp" in hparams:
             hp["nsp"] = hparams["nsp"]
         mlm_logits, nsp_logits = pretrain_logits(hp, y, pooled, cfg, ctx)
-        # one-hot contraction, not take_along_axis: the scatter transpose of
-        # a gather over the model-sharded vocab dim trips an XLA
-        # partial-manual partitioner CHECK inside the pipelined shard_map
-        # (same workaround as the GPT 1F1B head)
+        from paddlefleetx_tpu.models.common import one_hot_token_nll
+
         labels_t = mb["masked_lm_labels"].astype(jnp.int32)
         valid = (labels_t != -1).astype(jnp.float32)
         safe = jnp.where(labels_t != -1, labels_t, 0)
-        lg = mlm_logits.astype(jnp.float32)
-        lse = jax.nn.logsumexp(lg, axis=-1)
-        picked = jnp.sum(lg * jax.nn.one_hot(safe, lg.shape[-1], dtype=lg.dtype), -1)
-        loss = jnp.sum((lse - picked) * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+        nll = one_hot_token_nll(mlm_logits, safe)
+        loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
         if nsp_logits is not None and "next_sentence_label" in mb:
             nsp = nsp_logits.astype(jnp.float32)
             labels = mb["next_sentence_label"].astype(jnp.int32).reshape(-1)

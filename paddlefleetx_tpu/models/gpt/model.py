@@ -539,15 +539,9 @@ def _pipeline_train_loss(
         logits = jnp.einsum("bsh,vh->bsv", y, word)
         logits = _constrain(ctx, logits, ("batch", "seq", "vocab")).astype(jnp.float32)
         labels = mb["labels"].astype(jnp.int32)
-        lse = jax.nn.logsumexp(logits, axis=-1)
-        # one-hot contraction, not take_along_axis: the scatter transpose of
-        # a gather over the model-sharded vocab dim trips an XLA
-        # partial-manual partitioner CHECK; the one-hot contraction's
-        # transpose is a plain (psum-able) broadcast-multiply
-        picked = jnp.sum(
-            logits * jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype), -1
-        )
-        return jnp.sum((lse - picked) * mb["loss_mask"])
+        from paddlefleetx_tpu.models.common import one_hot_token_nll
+
+        return jnp.sum(one_hot_token_nll(logits, labels) * mb["loss_mask"])
 
     layers_params = params["layers"]
     if V > 1:

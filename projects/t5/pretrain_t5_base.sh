@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# T5-base span-corruption pretrain (beyond the reference: it ships T5 as a
+# model library only; here the family trains end-to-end)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/t5/pretrain_t5_base.yaml "$@"

@@ -160,3 +160,86 @@ def test_module_registry():
     params = mod.init_params(jax.random.key(0))
     loss = mod.loss_fn(params, _batch(mod.config), train=False)
     assert np.isfinite(float(loss))
+
+
+def test_t5_pretrain_dataset_span_corruption(tmp_path):
+    """The emitted example matches a manual corruption of the base window
+    with the same rng: sentinels descend from the vocab top, inputs keep
+    nonnoise tokens in order, targets carry the removed spans + EOS."""
+    from paddlefleetx_tpu.data.gpt_dataset import write_synthetic_corpus
+    from paddlefleetx_tpu.data.t5_dataset import (
+        T5PretrainDataset,
+        random_spans_noise_mask,
+    )
+
+    prefix = write_synthetic_corpus(str(tmp_path / "c"), vocab_size=500, num_docs=12)
+    ds = T5PretrainDataset(
+        data_prefix=prefix, max_seq_len=64, max_target_len=64,
+        vocab_size=1000, split=(1, 0, 0), eos_token_id=1, pad_token_id=0, seed=7,
+    )
+    assert len(ds) > 0
+    item = ds[3]
+    assert item["input_ids"].shape == (64,) and item["labels"].shape == (64,)
+    np.testing.assert_array_equal(item["input_ids"], ds[3]["input_ids"])
+
+    tokens = ds.base[3]["tokens"]
+    rng = np.random.default_rng((7, 3))
+    mask = random_spans_noise_mask(len(tokens), 0.15, 3.0, rng)
+    frac = mask.mean()
+    assert 0.05 < frac < 0.3  # ~15% corruption
+
+    exp_inputs, exp_targets, k, i = [], [], 0, 0
+    while i < len(tokens):
+        if mask[i]:
+            exp_inputs.append(999 - k)
+            exp_targets.append(999 - k)
+            k += 1
+            while i < len(tokens) and mask[i]:
+                exp_targets.append(int(tokens[i]))
+                i += 1
+        else:
+            exp_inputs.append(int(tokens[i]))
+            i += 1
+    exp_targets.append(1)
+    np.testing.assert_array_equal(item["input_ids"][: len(exp_inputs)], exp_inputs)
+    np.testing.assert_array_equal(item["labels"][: len(exp_targets)], exp_targets)
+
+
+def test_t5_trains_from_pretrain_dataset(tmp_path, devices8):
+    """End-to-end: T5PretrainDataset -> Engine train step (finite loss)."""
+    from paddlefleetx_tpu.core.engine import Engine
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.data.builders import build_dataloader
+    from paddlefleetx_tpu.data.gpt_dataset import write_synthetic_corpus
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    write_synthetic_corpus(str(data_dir / "c"), vocab_size=200, num_docs=16, mean_len=120)
+    cfg = AttrDict.from_nested(
+        {
+            "Global": {"global_batch_size": 8, "seed": 3},
+            "Engine": {"max_steps": 2, "eval_freq": 0, "logging_freq": 10,
+                       "mix_precision": {"enable": False}, "save_load": {"save_steps": 0}},
+            "Model": {"module": "T5Module", "vocab_size": 256, "d_model": 32,
+                      "d_kv": 8, "d_ff": 64, "num_layers": 2, "num_decoder_layers": 2,
+                      "num_heads": 4, "dropout_rate": 0.0, "dtype": "float32"},
+            "Distributed": {},
+            "Data": {"Train": {"dataset": {"name": "T5PretrainDataset",
+                                           "input_dir": str(data_dir),
+                                           "max_seq_len": 32, "max_target_len": 16,
+                                           "vocab_size": 256, "split": [1, 0, 0]},
+                               "sampler": {"shuffle": True}}},
+            "Optimizer": {"name": "AdamW", "lr": {"name": "Constant", "learning_rate": 1e-3}},
+        }
+    )
+    cfg = process_configs(cfg, num_devices=8)
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    loader = build_dataloader(cfg, "Train")
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        batch = next(iter(loader))
+        engine.state, m = engine._train_step(engine.state, engine._put_batch(batch))
+    assert np.isfinite(float(m["loss"]))
