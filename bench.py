@@ -114,6 +114,10 @@ def main():
                 "use_recompute": os.environ.get("BENCH_RECOMPUTE", "1") == "1",
                 "recompute_granularity": os.environ.get("BENCH_REMAT", "selective"),
                 "use_fused_ln": os.environ.get("BENCH_FUSED_LN", "1") == "1",
+                # streams the vocab through the CE so the fp32 logits buffer
+                # never materializes (ops/chunked_ce.py) — try with bigger
+                # BENCH_BATCH once enabled
+                "use_chunked_ce": os.environ.get("BENCH_CHUNKED_CE", "0") == "1",
             },
             "Distributed": {},
             "Optimizer": {

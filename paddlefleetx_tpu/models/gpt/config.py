@@ -34,6 +34,13 @@ class GPTConfig:
     # fused LayerNorm Pallas kernel (ops/fused_layernorm.py) instead of the
     # jnp composite (reference consumes paddle fused norm ops, vit.py:23-115)
     use_fused_ln: bool = False
+    # chunked softmax-CE (ops/chunked_ce.py): streams the vocab so the
+    # [b,s,V] fp32 logits buffer never materializes — the HBM lever for
+    # bigger per-chip batches.  Ignored under vocab (model-axis) sharding
+    # (the GSPMD path owns that reduction) and under pipeline parallelism
+    # (the 1F1B head computes per-microbatch logits, already 1/M the size).
+    use_chunked_ce: bool = False
+    ce_chunk_size: int = 4096
     # fused qkv projection (reference fuse_attn_qkv, hybrid_model.py:153)
     fuse_attn_qkv: bool = True
     # attention implementation: "xla" (jnp reference) | "flash" (Pallas kernel)

@@ -594,8 +594,22 @@ def loss_fn(
         dropout_key=dropout_key,
         train=train,
     )
-    logits = logits_from_hidden(params, hidden, ctx)
-    loss = cross_entropy(logits, batch["labels"], batch.get("loss_mask"))
+    from paddlefleetx_tpu.parallel.mesh import AXIS_MODEL
+
+    vocab_sharded = ctx is not None and ctx.mesh.shape.get(AXIS_MODEL, 1) > 1
+    if cfg.use_chunked_ce and not vocab_sharded:
+        from paddlefleetx_tpu.ops.chunked_ce import chunked_cross_entropy
+
+        loss = chunked_cross_entropy(
+            hidden,
+            params["embeddings"]["word"],
+            batch["labels"],
+            batch.get("loss_mask"),
+            chunk=cfg.ce_chunk_size,
+        )
+    else:
+        logits = logits_from_hidden(params, hidden, ctx)
+        loss = cross_entropy(logits, batch["labels"], batch.get("loss_mask"))
     if cfg.num_experts > 1:
         loss = loss + cfg.moe_aux_loss_weight * aux
     return loss
