@@ -29,6 +29,10 @@ class T5Config:
     decoder_start_token_id: int = 0
     dtype: str = "bfloat16"
     use_recompute: bool = False
+    # chunked softmax-CE (ops/chunked_ce.py): the [b,s_dec,V] fp32 logits
+    # buffer never materializes; ignored under vocab (model-axis) sharding
+    use_chunked_ce: bool = False
+    ce_chunk_size: int = 4096
 
     @property
     def is_gated_act(self) -> bool:

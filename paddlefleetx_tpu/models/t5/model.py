@@ -411,13 +411,41 @@ def seq2seq_loss(
     dec_in = batch.get("decoder_input_ids")
     if dec_in is None:
         dec_in = shift_right(labels, cfg)
+    mask = jnp.logical_and(labels != cfg.pad_token_id, labels >= 0)
+    safe = jnp.where(mask, labels, 0)
+
+    vocab_sharded = False
+    if ctx is not None:
+        from paddlefleetx_tpu.parallel.mesh import AXIS_MODEL
+
+        vocab_sharded = ctx.mesh.shape.get(AXIS_MODEL, 1) > 1
+    if cfg.use_chunked_ce and not vocab_sharded:
+        from paddlefleetx_tpu.ops.chunked_ce import chunked_cross_entropy
+
+        attention_mask = batch.get("attention_mask")
+        if attention_mask is None:
+            attention_mask = (batch["input_ids"] != cfg.pad_token_id).astype(jnp.int32)
+        ke = kd = None
+        if dropout_key is not None:
+            ke, kd = jax.random.split(dropout_key)
+        enc = encode(params, batch["input_ids"], cfg, attention_mask=attention_mask,
+                     ctx=ctx, dropout_key=ke, train=train)
+        hid = decode(params, dec_in, enc, attention_mask, cfg,
+                     ctx=ctx, dropout_key=kd, train=train)
+        if cfg.tie_word_embeddings:
+            hid = hid * (cfg.d_model ** -0.5)
+            word = params["shared_embedding"]
+        else:
+            word = params["lm_head"].T
+        return chunked_cross_entropy(
+            hid, word, safe, mask.astype(jnp.float32), chunk=cfg.ce_chunk_size
+        )
+
     logits = forward(
         params, batch["input_ids"], dec_in, cfg,
         attention_mask=batch.get("attention_mask"),
         ctx=ctx, dropout_key=dropout_key, train=train,
     ).astype(jnp.float32)
-    mask = jnp.logical_and(labels != cfg.pad_token_id, labels >= 0)
-    safe = jnp.where(mask, labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
     denom = jnp.maximum(mask.sum(), 1)
