@@ -110,6 +110,30 @@ def test_http_endpoint(tmp_path):
         with urllib.request.urlopen(req, timeout=300) as r:
             out = json.load(r)
         assert "completion_ids" in out and len(out["completion_ids"]) <= 4, out
+
+        # batched ids request
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(
+                {"prompts_ids": [[1, 2], [3, 4, 5]], "max_tokens": 4}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            out = json.load(r)
+        assert len(out["completions_ids"]) == 2, out
+
+        # bad request -> 400, server keeps serving
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt_ids": []}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=60)
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
     finally:
         proc.terminate()
         proc.wait(timeout=10)

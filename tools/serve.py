@@ -79,10 +79,18 @@ def serve_http(server, port: int):
                 if "prompt" in req:
                     texts = server.generate_text([req["prompt"]], max_dec_len=max_toks)
                     return self._json(200, {"completion": texts[0]})
+                if "prompts" in req:  # batched: rides the data axis together
+                    texts = server.generate_text(req["prompts"], max_dec_len=max_toks)
+                    return self._json(200, {"completions": texts})
                 if "prompt_ids" in req:
                     ids = server.generate_ids([req["prompt_ids"]], max_dec_len=max_toks)
                     return self._json(200, {"completion_ids": ids[0]})
-                return self._json(400, {"error": "need prompt or prompt_ids"})
+                if "prompts_ids" in req:
+                    ids = server.generate_ids(req["prompts_ids"], max_dec_len=max_toks)
+                    return self._json(200, {"completions_ids": ids})
+                return self._json(400, {"error": "need prompt(s) or prompt(s)_ids"})
+            except ValueError as e:  # bad request (empty prompts, etc.)
+                return self._json(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — report, keep serving
                 return self._json(500, {"error": str(e)})
 
