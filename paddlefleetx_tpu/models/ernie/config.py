@@ -30,6 +30,10 @@ class ErnieConfig:
     use_recompute: bool = False
     recompute_granularity: str = "full"
     binary_head: bool = True
+    # chunked softmax-CE for the MLM loss (ops/chunked_ce.py); ignored
+    # under vocab (model-axis) sharding and in the 1F1B pipeline head
+    use_chunked_ce: bool = False
+    ce_chunk_size: int = 4096
 
     @property
     def head_dim(self) -> int:

@@ -231,6 +231,15 @@ def encode(
     return x, pooled
 
 
+def _mlm_transform(params: Dict[str, Any], sequence_output: jax.Array, cfg: ErnieConfig):
+    """dense + gelu + LN transform before the tied decoder matmul."""
+    dtype = sequence_output.dtype
+    p = params["mlm"]
+    h = sequence_output @ p["transform_kernel"].astype(dtype) + p["transform_bias"].astype(dtype)
+    h = jax.nn.gelu(h, approximate=cfg.gelu_approximate)
+    return layer_norm(h, p["ln"]["scale"], p["ln"]["bias"], eps=1e-12)
+
+
 def pretrain_logits(
     params: Dict[str, Any], sequence_output: jax.Array, pooled: jax.Array, cfg: ErnieConfig,
     ctx: Optional[ShardingCtx] = None,
@@ -238,9 +247,7 @@ def pretrain_logits(
     """-> (mlm logits [b,s,v], nsp logits [b,2] or None)."""
     dtype = sequence_output.dtype
     p = params["mlm"]
-    h = sequence_output @ p["transform_kernel"].astype(dtype) + p["transform_bias"].astype(dtype)
-    h = jax.nn.gelu(h, approximate=cfg.gelu_approximate)
-    h = layer_norm(h, p["ln"]["scale"], p["ln"]["bias"], eps=1e-12)
+    h = _mlm_transform(params, sequence_output, cfg)
     word = params["embeddings"]["word"].astype(dtype)
     logits = jnp.einsum("bsh,vh->bsv", h, word) + p["decoder_bias"].astype(dtype)
     logits = _constrain(ctx, logits, ("batch", "seq", "vocab"))
@@ -432,8 +439,39 @@ def pretrain_loss(
         dropout_key=dropout_key,
         train=train,
     )
-    mlm_logits, nsp_logits = pretrain_logits(params, seq_out, pooled, cfg, ctx)
-    loss = _token_ce(mlm_logits, batch["masked_lm_labels"])
+    vocab_sharded = False
+    if ctx is not None:
+        from paddlefleetx_tpu.parallel.mesh import AXIS_MODEL
+
+        vocab_sharded = ctx.mesh.shape.get(AXIS_MODEL, 1) > 1
+    if cfg.use_chunked_ce and not vocab_sharded:
+        # stream the 40k vocab through the CE (ops/chunked_ce.py); the
+        # decoder bias folds in via a ones-column on hidden / bias-column
+        # on the tied word matrix, so logits match pretrain_logits exactly
+        from paddlefleetx_tpu.ops.chunked_ce import chunked_cross_entropy
+
+        h = _mlm_transform(params, seq_out, cfg)
+        ones = jnp.ones(h.shape[:-1] + (1,), h.dtype)
+        h1 = jnp.concatenate([h, ones], axis=-1)
+        word = params["embeddings"]["word"]
+        w1 = jnp.concatenate(
+            [word, params["mlm"]["decoder_bias"][:, None].astype(word.dtype)], axis=-1
+        )
+        labels_t = batch["masked_lm_labels"]
+        valid = (labels_t != -1).astype(jnp.float32)
+        safe = jnp.where(labels_t != -1, labels_t, 0)
+        loss = chunked_cross_entropy(h1, w1, safe, valid, chunk=cfg.ce_chunk_size)
+        mlm_logits = None
+        _, nsp_logits = (None, None)
+        if cfg.binary_head and "nsp" in params:
+            dtype = seq_out.dtype
+            nsp_logits = (
+                pooled @ params["nsp"]["kernel"].astype(dtype)
+                + params["nsp"]["bias"].astype(dtype)
+            )
+    else:
+        mlm_logits, nsp_logits = pretrain_logits(params, seq_out, pooled, cfg, ctx)
+        loss = _token_ce(mlm_logits, batch["masked_lm_labels"])
     if nsp_logits is not None and "next_sentence_label" in batch:
         nsp = nsp_logits.astype(jnp.float32)
         labels = batch["next_sentence_label"].reshape(-1)
