@@ -52,6 +52,15 @@ class BasicModule:
     def eval_metrics(self, loss: jax.Array) -> Dict[str, jax.Array]:
         return {"loss": loss}
 
+    def export_spec(self):
+        """(fwd, example_args): the inference forward and its example inputs
+        (reference BasicModule.input_spec, basic_module.py:29-86) — consumed
+        by tools/export.py for the StableHLO artifact."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not define export_spec(); "
+            "add one to export this family"
+        )
+
     # tokens per sample for ips reporting (reference language_module.py:100)
     tokens_per_sample: Optional[int] = None
 
@@ -97,6 +106,19 @@ class GPTModule(BasicModule):
             params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
         )
 
+    def export_spec(self):
+        import jax.numpy as jnp
+
+        from paddlefleetx_tpu.models.gpt import model as gpt
+
+        cfg = self.config
+        tokens = jnp.zeros((1, self.tokens_per_sample), jnp.int32)
+
+        def fwd(params, tokens):
+            return gpt.forward(params, tokens, cfg, train=False)
+
+        return fwd, (tokens,)
+
 
 @MODULES.register("GeneralClsModule")
 @MODULES.register("ViTModule")
@@ -137,6 +159,21 @@ class ViTModule(BasicModule):
             train=train,
         )
         return vit.cls_loss(logits, batch["labels"], self.label_smoothing)
+
+    def export_spec(self):
+        import jax.numpy as jnp
+
+        from paddlefleetx_tpu.models import vit
+
+        cfg = self.config
+        images = jnp.zeros(
+            (1, cfg.image_size, cfg.image_size, cfg.in_channels), jnp.float32
+        )
+
+        def fwd(params, images):
+            return vit.forward(params, images, cfg, train=False)
+
+        return fwd, (images,)
 
 
 def build_module(cfg) -> BasicModule:

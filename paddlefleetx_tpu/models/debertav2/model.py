@@ -197,7 +197,9 @@ def debertav2_logical_axes(cfg: DebertaV2Config, head: Optional[str] = None) -> 
 
 
 def _heads(x: jax.Array, kernel: jax.Array, bias: jax.Array) -> jax.Array:
-    return jnp.einsum("...d,dhk->...hk", x, kernel) + bias
+    # params are stored fp32: cast to the activation dtype so a bf16
+    # forward is not silently promoted back to fp32
+    return jnp.einsum("...d,dhk->...hk", x, kernel.astype(x.dtype)) + bias.astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -302,8 +304,12 @@ def encode(
         )
         y = dropout(keys.get("post_attn"), y, cfg.hidden_dropout_prob, train)
         h = layer_norm(h + y, lp["ln_attn"]["scale"], lp["ln_attn"]["bias"], cfg.layer_norm_eps)
-        y = jax.nn.gelu(h @ lp["mlp"]["fc_in_kernel"] + lp["mlp"]["fc_in_bias"], approximate=True)
-        y = y @ lp["mlp"]["fc_out_kernel"] + lp["mlp"]["fc_out_bias"]
+        mp_ = lp["mlp"]
+        y = jax.nn.gelu(
+            h @ mp_["fc_in_kernel"].astype(h.dtype) + mp_["fc_in_bias"].astype(h.dtype),
+            approximate=True,
+        )
+        y = y @ mp_["fc_out_kernel"].astype(h.dtype) + mp_["fc_out_bias"].astype(h.dtype)
         y = dropout(keys.get("post_ffn"), y, cfg.hidden_dropout_prob, train)
         h = layer_norm(h + y, lp["ln_mlp"]["scale"], lp["ln_mlp"]["bias"], cfg.layer_norm_eps)
         return (h, idx + 1), None
@@ -359,7 +365,12 @@ def _disentangled(p, h, rel_q, rel_k, rel_idx, pad_bias, cfg, key, train):
         probs = probs * jax.random.bernoulli(key, keep, probs.shape) / keep
     probs = probs.astype(h.dtype)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-    return jnp.einsum("bqhd,hdm->bqm", out, p["out_kernel"].reshape(nh, hd, -1)) + p["out_bias"]
+    return (
+        jnp.einsum(
+            "bqhd,hdm->bqm", out, p["out_kernel"].reshape(nh, hd, -1).astype(out.dtype)
+        )
+        + p["out_bias"].astype(out.dtype)
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,17 @@ class DebertaV2Module(BasicModule):
             params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
         )
 
+    def export_spec(self):
+        import jax.numpy as jnp
+
+        cfg = self.config
+
+        def fwd(params, input_ids):
+            hidden = deberta.encode(params, input_ids, cfg, train=False)
+            return deberta.mlm_logits(params, hidden, cfg)
+
+        return fwd, (jnp.zeros((1, self.tokens_per_sample), jnp.int32),)
+
 
 @MODULES.register("DebertaV2SeqClsModule")
 class DebertaV2SeqClsModule(DebertaV2Module):
@@ -59,6 +70,16 @@ class DebertaV2SeqClsModule(DebertaV2Module):
             params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
         )
         return deberta.cls_loss(logits, batch["labels"])
+
+    def export_spec(self):
+        import jax.numpy as jnp
+
+        cfg = self.config
+
+        def fwd(params, input_ids):
+            return deberta.cls_forward(params, {"input_ids": input_ids}, cfg, train=False)
+
+        return fwd, (jnp.zeros((1, self.tokens_per_sample), jnp.int32),)
 
     def predict_fn(self, params, batch, *, ctx=None):
         return deberta.cls_forward(params, batch, self.config, ctx=ctx, train=False)

@@ -45,6 +45,17 @@ class ErnieModule(BasicModule):
             params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
         )
 
+    def export_spec(self):
+        import jax.numpy as jnp
+
+        cfg = self.config
+
+        def fwd(params, input_ids):
+            seq_out, pooled = ernie.encode(params, input_ids, cfg, train=False)
+            return ernie.pretrain_logits(params, seq_out, pooled, cfg)[0]
+
+        return fwd, (jnp.zeros((1, self.tokens_per_sample), jnp.int32),)
+
 
 @MODULES.register("ErnieSeqClsModule")
 class ErnieSeqClsModule(ErnieModule):
@@ -59,6 +70,16 @@ class ErnieSeqClsModule(ErnieModule):
             params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
         )
         return ernie.cls_loss(logits, batch["labels"])
+
+    def export_spec(self):
+        import jax.numpy as jnp
+
+        cfg = self.config
+
+        def fwd(params, input_ids):
+            return ernie.cls_forward(params, {"input_ids": input_ids}, cfg, train=False)
+
+        return fwd, (jnp.zeros((1, self.tokens_per_sample), jnp.int32),)
 
     # metric streaming (consumed by Engine.evaluate)
     def predict_fn(self, params, batch, *, ctx=None):

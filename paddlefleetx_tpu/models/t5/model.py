@@ -53,7 +53,9 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
-    return (xf * jax.lax.rsqrt(var + eps)).astype(dtype) * scale
+    # cast the (fp32-stored) scale to the compute dtype: multiplying after
+    # the down-cast would silently promote the whole layer back to fp32
+    return (xf * jax.lax.rsqrt(var + eps)).astype(dtype) * scale.astype(dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -198,8 +200,9 @@ def t5_logical_axes(cfg: T5Config) -> Dict[str, Any]:
 
 
 def _proj(x: jax.Array, kernel: jax.Array) -> jax.Array:
-    """[b,s,d] @ [d,h,k] -> [b,s,h,k]."""
-    return jnp.einsum("bsd,dhk->bshk", x, kernel)
+    """[b,s,d] @ [d,h,k] -> [b,s,h,k] (fp32-stored kernel cast to the
+    activation dtype so bf16 forwards stay bf16)."""
+    return jnp.einsum("bsd,dhk->bshk", x, kernel.astype(x.dtype))
 
 
 def _attn(
@@ -224,16 +227,19 @@ def _attn(
         train=train,
         scale=1.0,
     )
-    return jnp.einsum("bshk,hkd->bsd", out, p["o_kernel"])
+    return jnp.einsum("bshk,hkd->bsd", out, p["o_kernel"].astype(out.dtype))
 
 
 def _ffn(p: Dict[str, jax.Array], x: jax.Array, cfg: T5Config, key, train) -> jax.Array:
+    dt = x.dtype
     if cfg.is_gated_act:
-        h = jax.nn.gelu(x @ p["wi_gate_kernel"], approximate=True) * (x @ p["wi_kernel"])
+        h = jax.nn.gelu(x @ p["wi_gate_kernel"].astype(dt), approximate=True) * (
+            x @ p["wi_kernel"].astype(dt)
+        )
     else:
-        h = jax.nn.relu(x @ p["wi_kernel"])
+        h = jax.nn.relu(x @ p["wi_kernel"].astype(dt))
     h = dropout(key, h, cfg.dropout_rate, train)
-    return h @ p["wo_kernel"]
+    return h @ p["wo_kernel"].astype(dt)
 
 
 def _pad_bias(mask: jax.Array, dtype) -> jax.Array:

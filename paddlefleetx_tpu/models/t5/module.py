@@ -26,9 +26,9 @@ class T5Module(BasicModule):
     def __init__(self, cfg):
         self.config = _config_from(cfg)
         data_cfg = cfg.get("Data", {}).get("Train", {}).get("dataset", {})
-        self.tokens_per_sample = int(
-            data_cfg.get("max_seq_len", 512)
-        ) + int(data_cfg.get("max_target_len", 0))
+        self._enc_len = int(data_cfg.get("max_seq_len", 512))
+        self._dec_len = int(data_cfg.get("max_target_len", 0)) or 128
+        self.tokens_per_sample = self._enc_len + int(data_cfg.get("max_target_len", 0))
 
     def init_params(self, key):
         return t5.init(self.config, key)
@@ -39,4 +39,17 @@ class T5Module(BasicModule):
     def loss_fn(self, params, batch, *, ctx=None, dropout_key=None, train=True):
         return t5.seq2seq_loss(
             params, batch, self.config, ctx=ctx, dropout_key=dropout_key, train=train
+        )
+
+    def export_spec(self):
+        import jax.numpy as jnp
+
+        cfg = self.config
+
+        def fwd(params, input_ids, decoder_input_ids):
+            return t5.forward(params, input_ids, decoder_input_ids, cfg, train=False)
+
+        return fwd, (
+            jnp.zeros((1, self._enc_len), jnp.int32),
+            jnp.zeros((1, self._dec_len), jnp.int32),
         )

@@ -10,7 +10,6 @@ from paddlefleetx_tpu.utils.device import apply_platform_env
 
 apply_platform_env()  # PFX_PLATFORM=cpu etc., before backend init
 
-import jax.numpy as jnp
 
 from paddlefleetx_tpu.core.module import build_module
 from paddlefleetx_tpu.parallel.env import init_dist_env
@@ -32,17 +31,12 @@ def main(argv=None):
     if params is None:
         params = module.init_params(get_seed_tracker().params_key())
 
-    from paddlefleetx_tpu.models.gpt import model as gpt
-
-    mcfg = module.config
-    seq = int(cfg.get("Data", {}).get("Train", {}).get("dataset", {}).get("max_seq_len", mcfg.max_position_embeddings))
-    tokens = jnp.zeros((1, seq), jnp.int32)
-
-    def fwd(params, tokens):
-        return gpt.forward(params, tokens, mcfg, train=False)
+    # family-generic: each module declares its inference forward + example
+    # inputs (reference input_spec contract, basic_module.py:29-86)
+    fwd, example_args = module.export_spec()
 
     out_dir = cfg.Engine.save_load.get("output_dir", "./output")
-    export_inference_model(fwd, (tokens,), params, os.path.join(out_dir, "inference"))
+    export_inference_model(fwd, example_args, params, os.path.join(out_dir, "inference"))
 
 
 if __name__ == "__main__":
