@@ -45,14 +45,21 @@ def main():
     platform = os.environ.get("PFX_PLATFORM", "").lower()
     if platform in ("", "tpu", "axon"):
         alive = False
-        # the axon tunnel has been observed dropping for hours at a time:
-        # be patient (4 probes over ~5 min) before reporting unreachable
-        for attempt in range(4):
+        # The axon tunnel has been observed dropping for minutes-to-hours at
+        # a time, and round 2's driver-captured number was lost to exactly
+        # such an outage.  Re-poll inside a bounded window (default 40 min,
+        # BENCH_PROBE_WINDOW_S to override) before reporting unreachable:
+        # a transient outage inside the driver's run window must not record
+        # 0.0 when patience would have produced a real number.
+        window_s = float(os.environ.get("BENCH_PROBE_WINDOW_S", 2400))
+        deadline = time.time() + window_s
+        while True:
             if _backend_alive():
                 alive = True
                 break
-            if attempt < 3:
-                time.sleep(60)
+            if time.time() >= deadline:
+                break
+            time.sleep(min(60, max(1, deadline - time.time())))
         if not alive:
             # emit an honest failure line rather than hanging the driver
             print(

@@ -39,6 +39,7 @@ from paddlefleetx_tpu.core.module import BasicModule
 from paddlefleetx_tpu.models.gpt.model import ShardingCtx
 from paddlefleetx_tpu.optims.optimizer import build_optimizer
 from paddlefleetx_tpu.parallel.sharding import (
+    drop_small_fsdp,
     logical_to_spec,
     make_rules,
     tree_logical_to_sharding,
@@ -171,6 +172,9 @@ class Engine:
         self.sharding_offload = bool(
             sharding_cfg.get("sharding_offload", sharding_cfg.get("offload", False))
         )
+        # params below this many elements stay whole on the fsdp axis
+        # (see drop_small_fsdp) — configurable for tiny-model tests
+        self.min_shard_size = int(sharding_cfg.get("min_shard_size", 1 << 16))
         num_experts = int(
             getattr(getattr(module, "config", None), "num_experts", 0) or 0
         )
@@ -187,6 +191,17 @@ class Engine:
         )
         self.moment_rules = make_rules(
             fsdp_enabled=self.sharding_stage >= 1,
+            sequence_parallel=bool(dist.get("sequence_parallel", False)),
+            mesh=mesh,
+            num_experts=num_experts,
+        )
+        # Activation constraints NEVER use the fsdp mapping: ZeRO-3 shards
+        # params' `embed` dim over fsdp (gathered at use), but the residual
+        # stream stays batch-sharded — constraining activations' hidden dim
+        # to fsdp would fight the (data,fsdp)-sharded batch inputs and trips
+        # XLA's "involuntary full rematerialization" resharding path.
+        self.act_rules = make_rules(
+            fsdp_enabled=False,
             sequence_parallel=bool(dist.get("sequence_parallel", False)),
             mesh=mesh,
             num_experts=num_experts,
@@ -208,7 +223,7 @@ class Engine:
                     dist.get("pipeline", {}).get("virtual_pp_degree", 1)
                 ),
             )
-        self.ctx = ShardingCtx(mesh, self.rules, pipeline=pipeline)
+        self.ctx = ShardingCtx(mesh, self.act_rules, pipeline=pipeline)
 
         # token/sample-counted schedules (use_increments) are scaled inside
         # build_optimizer so optax's per-step count yields the right lr
@@ -237,6 +252,13 @@ class Engine:
         moment_shardings = tree_logical_to_sharding(
             self.module.logical_axes(), self.mesh, self.moment_rules
         )
+        if self.sharding_stage >= 1:
+            self.param_shardings = drop_small_fsdp(
+                self.param_shardings, params_shapes, self.min_shard_size
+            )
+            moment_shardings = drop_small_fsdp(
+                moment_shardings, params_shapes, self.min_shard_size
+            )
         self.offload_active = self.sharding_offload and _host_offload_supported(
             self.mesh
         )
@@ -265,6 +287,16 @@ class Engine:
             self.extra_shardings = tree_logical_to_sharding(
                 extra_logical, self.mesh, self.rules
             )
+            if self.sharding_stage >= 1:
+                # same small-param exemption as params/moments: extra state
+                # (momentum encoders, queues, running stats) holds LN-sized
+                # vectors with the same pathological-reshard backward
+                extra_shapes = jax.eval_shape(
+                    self.module.init_extra, key, params_shapes
+                )
+                self.extra_shardings = drop_small_fsdp(
+                    self.extra_shardings, extra_shapes, self.min_shard_size
+                )
         else:
             self.extra_shardings = None
 

@@ -103,8 +103,16 @@ class GenerationServer:
         # serves any request size; batched traffic rides the data axis
         n_req = len(prompts)
         dpw = data_parallel_world(self.mesh)
+        # bucket the batch dim like the decode length: pad to the next power
+        # of two (then up to a dp-world multiple) so varied client batch
+        # sizes reuse a small log-bounded set of compiled artifacts instead
+        # of keying a fresh multi-second XLA compile per distinct size
+        target = 1
+        while target < n_req:
+            target *= 2
+        target = -(-target // dpw) * dpw
         batch = list(prompts)
-        while len(batch) % dpw:
+        while len(batch) < target:
             batch.append(batch[-1])
         prompt, prompt_lens = pad_prompts(batch, gen.pad_token_id, multiple=self.bucket)
 

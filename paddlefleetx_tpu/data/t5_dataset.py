@@ -19,6 +19,7 @@ from typing import Dict, Sequence
 import numpy as np
 
 from paddlefleetx_tpu.data.gpt_dataset import GPTDataset
+from paddlefleetx_tpu.utils.log import logger
 from paddlefleetx_tpu.utils.registry import DATASETS
 
 
@@ -99,6 +100,7 @@ class T5PretrainDataset:
         self.pad_id = int(pad_token_id)
         self.eos_id = int(eos_token_id)
         self.seed = int(seed)
+        self.truncation_count = 0
         # expected target length must fit: each example carries ~rate*L
         # noise tokens + one sentinel per span + EOS (rare tails truncate)
         exp_noise = int(round(self.enc_len * self.rate))
@@ -119,31 +121,48 @@ class T5PretrainDataset:
 
     def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
         tokens = self.base[idx]["tokens"]  # [enc_len] raw window
-        rng = np.random.default_rng((self.seed, idx))
-        mask = random_spans_noise_mask(
-            len(tokens), self.rate, self.mean_span, rng, max_spans=self.num_sentinels
-        )
-
-        inputs, targets = [], []
-        k = 0
-        i = 0
         L = len(tokens)
-        while i < L:
-            if mask[i]:
-                sent = self._sentinel(k)
-                k += 1
-                inputs.append(sent)
-                targets.append(sent)
-                while i < L and mask[i]:
-                    targets.append(int(tokens[i]))
+        # per-sample span draws can exceed dec_len even when the expected
+        # length fits (constructor check): re-draw the noise mask a few
+        # times rather than silently dropping EOS and mid-span tokens
+        for attempt in range(4):
+            rng = np.random.default_rng((self.seed, idx, attempt))
+            mask = random_spans_noise_mask(
+                L, self.rate, self.mean_span, rng, max_spans=self.num_sentinels
+            )
+
+            inputs, targets = [], []
+            k = 0
+            i = 0
+            while i < L:
+                if mask[i]:
+                    sent = self._sentinel(k)
+                    k += 1
+                    inputs.append(sent)
+                    targets.append(sent)
+                    while i < L and mask[i]:
+                        targets.append(int(tokens[i]))
+                        i += 1
+                else:
+                    inputs.append(int(tokens[i]))
                     i += 1
-            else:
-                inputs.append(int(tokens[i]))
-                i += 1
-        targets.append(self.eos_id)
+            targets.append(self.eos_id)
+            if len(targets) <= self.dec_len:
+                break
+        else:
+            # pathological window: truncate but keep the EOS the decoder
+            # trains to emit, and count it so the anomaly is observable
+            targets = targets[: self.dec_len - 1] + [self.eos_id]
+            self.truncation_count += 1
+            if self.truncation_count in (1, 100, 10000):
+                logger.warning(
+                    f"t5 span-corruption target overflowed max_target_len "
+                    f"{self.dec_len} after 4 redraws (sample {idx}; "
+                    f"{self.truncation_count} total) — truncated, EOS kept"
+                )
 
         inp = np.full(self.enc_len, self.pad_id, np.int64)
         inp[: min(len(inputs), self.enc_len)] = inputs[: self.enc_len]
         lab = np.full(self.dec_len, self.pad_id, np.int64)
-        lab[: min(len(targets), self.dec_len)] = targets[: self.dec_len]
+        lab[: len(targets)] = targets
         return {"input_ids": inp, "labels": lab}

@@ -21,6 +21,10 @@ _WORD_PAT = re.compile(
     r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
 )
 
+# word-level memoization caps: natural-language traffic saturates well under
+# this (Zipf), while adversarial/high-entropy input stays memory-bounded
+_ENCODE_CACHE_MAX = 1 << 18
+
 
 @functools.lru_cache()
 def bytes_to_unicode() -> Dict[int, str]:
@@ -183,6 +187,8 @@ class GPTTokenizer:
                 break
             pairs = _get_pairs(word)
         out = " ".join(word)
+        if len(self.cache) >= _ENCODE_CACHE_MAX:
+            self.cache.pop(next(iter(self.cache)))
         self.cache[token] = out
         return out
 
@@ -197,6 +203,11 @@ class GPTTokenizer:
                     if got is None:  # symbol outside the byte vocab
                         mapped = "".join(self.byte_encoder[b] for b in raw)
                         got = [self.encoder[t] for t in self._bpe(mapped).split(" ")]
+                    # bounded FIFO eviction: encode() sits on the serving
+                    # path, and high-entropy client text would otherwise
+                    # grow the cache without limit over a long-lived server
+                    if len(self._id_cache) >= _ENCODE_CACHE_MAX:
+                        self._id_cache.pop(next(iter(self._id_cache)))
                     self._id_cache[raw] = got
                 ids.extend(got)
             return ids

@@ -225,6 +225,58 @@ class ImageFolder(GeneralClsDataset):
         self._visits = {}
 
 
+@DATASETS.register("CIFAR10")
+class CIFAR10:
+    """CIFAR-10 from the standard python pickle batches (reference
+    vision_dataset.py:302).  Expects ``data_batch_{1..5}`` / ``test_batch``
+    under ``root`` (the reference auto-downloads; this environment has no
+    egress, so a missing root raises with the expected layout spelled out).
+    Images are decoded once into memory as [32, 32, 3] uint8."""
+
+    def __init__(self, root: str, mode: str = "train", transform_ops=None,
+                 seed: int = 1024, **_unused):
+        import pickle
+
+        self.train = mode.lower() == "train"
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"CIFAR10 mode must be train|test, got {mode!r}")
+        names = (
+            [f"data_batch_{i}" for i in range(1, 6)] if self.train else ["test_batch"]
+        )
+        images, labels = [], []
+        for name in names:
+            path = os.path.join(root, name)
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"{path} not found; CIFAR10 expects the extracted "
+                    "cifar-10-batches-py layout (data_batch_1..5, test_batch)"
+                )
+            with open(path, "rb") as f:
+                batch = pickle.load(f, encoding="bytes")
+            data = np.asarray(batch[b"data"], np.uint8)
+            images.append(data.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+            labels.extend(batch[b"labels"])
+        self.images = np.concatenate(images, axis=0)
+        self.labels = np.asarray(labels, np.int64)
+        self.transform = build_transforms(transform_ops)
+        self.seed = int(seed)
+        self._visits: Dict[int, int] = {}
+
+    def __len__(self):
+        return len(self.images)
+
+    @property
+    def class_num(self):
+        return int(len(np.unique(self.labels)))
+
+    def __getitem__(self, idx: int):
+        visit = self._visits.get(idx, 0)
+        self._visits[idx] = visit + 1
+        rng = np.random.default_rng((self.seed, idx, visit))
+        img = self.transform(self.images[idx], rng, self.train)
+        return {"images": img, "labels": self.labels[idx]}
+
+
 @DATASETS.register("ContrastiveLearningDataset")
 @DATASETS.register("ContrativeLearningDataset")  # reference spelling (:29)
 class ContrastiveLearningDataset:

@@ -132,10 +132,10 @@ def debertav2_specs(cfg: DebertaV2Config) -> Dict[str, Any]:
     }
     if cfg.position_biased_input:
         specs["embeddings"]["position"] = ParamSpec(
-            (cfg.max_position_embeddings, h), (None, "embed"), w
+            (cfg.max_position_embeddings, h), ("table", "embed"), w
         )
     if cfg.relative_attention:
-        specs["rel_embeddings"] = ParamSpec((cfg.pos_ebd_size * 2, h), (None, "embed"), w)
+        specs["rel_embeddings"] = ParamSpec((cfg.pos_ebd_size * 2, h), ("table", "embed"), w)
         specs["rel_ln"] = {
             "scale": ParamSpec((h,), ("embed",), ones_init()),
             "bias": ParamSpec((h,), ("embed",), zeros_init()),

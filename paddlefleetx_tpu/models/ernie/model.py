@@ -77,8 +77,8 @@ def ernie_specs(cfg: ErnieConfig) -> Dict[str, Any]:
     specs: Dict[str, Any] = {
         "embeddings": {
             "word": ParamSpec((cfg.vocab_size, h), ("vocab", "embed"), w),
-            "position": ParamSpec((cfg.max_position_embeddings, h), (None, "embed"), w),
-            "token_type": ParamSpec((cfg.type_vocab_size, h), (None, "embed"), w),
+            "position": ParamSpec((cfg.max_position_embeddings, h), ("table", "embed"), w),
+            "token_type": ParamSpec((cfg.type_vocab_size, h), ("table", "embed"), w),
             "ln": {
                 "scale": ParamSpec((h,), ("embed",), ones_init()),
                 "bias": ParamSpec((h,), ("embed",), zeros_init()),

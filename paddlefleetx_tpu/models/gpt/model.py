@@ -108,7 +108,7 @@ def gpt_specs(cfg: GPTConfig) -> Dict[str, Any]:
         "embeddings": {
             "word": ParamSpec((cfg.vocab_size, cfg.hidden_size), ("vocab", "embed"), w),
             "position": ParamSpec(
-                (cfg.max_position_embeddings, cfg.hidden_size), (None, "embed"), w
+                (cfg.max_position_embeddings, cfg.hidden_size), ("table", "embed"), w
             ),
         },
         "layers": stack_spec_tree(_layer_specs(cfg), cfg.num_layers),

@@ -165,7 +165,7 @@ def t5_specs(cfg: T5Config) -> Dict[str, Any]:
     d, f = cfg.d_model, cfg.initializer_factor
     rel = lambda: ParamSpec(
         (cfg.relative_attention_num_buckets, cfg.num_heads),
-        (None, "heads"),
+        ("table", "heads"),
         normal_init(f * d ** -0.5),
     )
     specs: Dict[str, Any] = {

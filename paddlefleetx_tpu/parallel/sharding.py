@@ -56,6 +56,7 @@ BASE_RULES: Tuple[Tuple[str, Any], ...] = (
     ("heads", (AXIS_MODEL, AXIS_SEP)),
     ("kv", None),
     ("vocab", AXIS_MODEL),
+    ("table", None),
     ("layers", AXIS_STAGES),
     ("expert", (AXIS_DATA, AXIS_FSDP, AXIS_SEP)),
 )
@@ -81,6 +82,15 @@ def make_rules(
     rules = dict(BASE_RULES)
     if fsdp_enabled:
         rules["embed"] = AXIS_FSDP
+        # lookup tables (word/position/type embeddings) fsdp-shard their
+        # TABLE dim, not the feature dim: their backward is a scatter-add
+        # from batch-sharded [b,s,h], and a feature-dim-sharded target
+        # forces the SPMD partitioner into replicate-then-repartition.
+        # Megatron shards embeddings along vocab for the same reason.
+        # (logical_to_spec dedups: "embed" then yields fsdp to the table
+        # dim on these params and leaves the feature dim whole)
+        rules["vocab"] = (AXIS_MODEL, AXIS_FSDP)
+        rules["table"] = AXIS_FSDP
     if sequence_parallel:
         rules["seq"] = (AXIS_SEP, AXIS_MODEL)
     if mesh is not None and num_experts > 1:
@@ -132,6 +142,36 @@ def tree_logical_to_sharding(
         is_leaf=lambda x: isinstance(x, tuple)
         and all(a is None or isinstance(a, str) for a in x),
     )
+
+
+def drop_small_fsdp(shardings: Any, shapes: Any, min_size: int = 1 << 16) -> Any:
+    """Replicate (over `fsdp`) params smaller than ``min_size`` elements.
+
+    Standard FSDP practice (the reference's group_sharded wrap keeps tiny
+    tensors whole for the same reason): fsdp-sharding a LayerNorm-sized
+    vector saves no memory worth having, and the fsdp-sharded *gradient*
+    target forces the SPMD partitioner to reshard batch-sharded backward
+    reductions hidden-dim-wise — an involuntary-full-rematerialization
+    (replicate-then-repartition) on every layer.  ``shardings`` and
+    ``shapes`` are matching pytrees (NamedSharding leaves / ShapeDtypeStruct
+    leaves)."""
+    import numpy as np
+
+    def fix(sh, shape):
+        if not isinstance(sh, NamedSharding):
+            return sh
+        if int(np.prod(shape.shape)) >= int(min_size):
+            return sh
+        spec = []
+        changed = False
+        for entry in sh.spec:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            kept = tuple(a for a in axes if a != AXIS_FSDP)
+            changed = changed or (len(kept) != len(axes))
+            spec.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        return NamedSharding(sh.mesh, P(*spec)) if changed else sh
+
+    return jax.tree.map(fix, shardings, shapes)
 
 
 def with_logical_constraint(x: jax.Array, logical_axes, rules, mesh: Mesh):

@@ -126,6 +126,9 @@ def _tiny_cfg(stage, offload=False):
                     "sharding_degree": 4,
                     "sharding_stage": stage,
                     "offload": offload,
+                    # tiny model: keep matmul kernels above the whole-param
+                    # threshold so ZeRO semantics are actually exercised
+                    "min_shard_size": 1024,
                 },
             },
             "Optimizer": {
@@ -174,6 +177,38 @@ def test_zero_stage3_params_sharded(devices8):
     eng = _make_engine(devices8, stage=3)
     assert any("fsdp" in s for s in _specs(eng.param_shardings))
     assert any("fsdp" in s for s in _specs(eng.opt_shardings))
+    # lookup tables fsdp-shard their TABLE dim, never the feature dim: a
+    # feature-dim target would force replicate-then-repartition of the
+    # batch-sharded scatter-add in their backward (Megatron vocab sharding)
+    emb = eng.param_shardings["embeddings"]
+    assert "fsdp" in str(emb["word"].spec[0]) and emb["word"].spec[1] is None
+    # position table ([16,32] = 512 elems) is below min_shard_size: whole
+    assert emb["position"].spec == P(None, None) or emb["position"].spec == P()
+    # sub-threshold params (LayerNorm vectors) stay whole on the fsdp axis
+    ln = eng.param_shardings["final_ln"]["scale"]
+    assert "fsdp" not in str(ln.spec)
+
+
+def test_drop_small_fsdp_threshold():
+    from jax.sharding import Mesh
+    from paddlefleetx_tpu.parallel.sharding import drop_small_fsdp
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "fsdp"))
+    shardings = {
+        "big": NamedSharding(mesh, P("fsdp", None)),
+        "small": NamedSharding(mesh, P("fsdp", None)),
+        "mixed": NamedSharding(mesh, P(("data", "fsdp"), None)),
+    }
+    shapes = {
+        "big": jax.ShapeDtypeStruct((1024, 64), jnp.float32),
+        "small": jax.ShapeDtypeStruct((8, 8), jnp.float32),
+        "mixed": jax.ShapeDtypeStruct((4, 4), jnp.float32),
+    }
+    out = drop_small_fsdp(shardings, shapes, min_size=1024)
+    assert out["big"].spec == P("fsdp", None)  # above threshold: untouched
+    assert out["small"].spec == P(None, None)
+    assert out["mixed"].spec == P("data", None)  # fsdp removed, data kept
 
 
 def test_zero_offload_host_memory_and_step(devices8):

@@ -211,3 +211,42 @@ def test_contrastive_dataset_two_views():
     assert item["img_k"].shape == (16, 16, 3)
     # independent augmentation draws differ
     assert not np.allclose(item["img_q"], item["img_k"])
+
+
+def test_cifar10_dataset(tmp_path):
+    """CIFAR10 loads the standard pickle-batch layout (reference
+    vision_dataset.py:302): train = data_batch_1..5, test = test_batch."""
+    import pickle
+
+    from paddlefleetx_tpu.data.vision_dataset import CIFAR10
+
+    rng = np.random.default_rng(0)
+    n = 4
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        batch = {
+            b"data": rng.integers(0, 256, (n, 3 * 32 * 32), dtype=np.uint8),
+            b"labels": list(rng.integers(0, 10, n)),
+        }
+        with open(tmp_path / name, "wb") as f:
+            pickle.dump(batch, f)
+
+    train = CIFAR10(str(tmp_path), mode="train")
+    test = CIFAR10(
+        str(tmp_path),
+        mode="test",
+        transform_ops=[{"NormalizeImage": {}}],
+    )
+    assert len(train) == 5 * n and len(test) == n
+    item = train[0]
+    assert item["images"].shape == (32, 32, 3)
+    assert item["labels"].dtype == np.int64
+    # normalized test images are float and roughly centered
+    assert test[0]["images"].dtype == np.float32
+    assert train.class_num <= 10
+
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        CIFAR10(str(tmp_path / "missing"), mode="test")
+    with pytest.raises(ValueError):
+        CIFAR10(str(tmp_path), mode="val")

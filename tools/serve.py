@@ -48,7 +48,7 @@ def build_server(config: str, overrides):
     return GenerationServer(cfg, mesh, module, params=params, tokenizer=tok)
 
 
-def serve_http(server, port: int):
+def serve_http(server, port: int, host: str = "127.0.0.1"):
     from http.server import BaseHTTPRequestHandler, HTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -94,8 +94,8 @@ def serve_http(server, port: int):
             except Exception as e:  # noqa: BLE001 — report, keep serving
                 return self._json(500, {"error": str(e)})
 
-    httpd = HTTPServer(("0.0.0.0", port), Handler)
-    print(f"serving on :{port} (POST /generate, GET /healthz)", flush=True)
+    httpd = HTTPServer((host, port), Handler)
+    print(f"serving on {host}:{port} (POST /generate, GET /healthz)", flush=True)
     httpd.serve_forever()
 
 
@@ -104,6 +104,10 @@ def main(argv=None):
     ap.add_argument("-c", "--config", required=True)
     ap.add_argument("-o", "--override", action="append", default=[])
     ap.add_argument("--port", type=int, default=0, help="HTTP port (0 = stdin REPL)")
+    # loopback by default: the endpoint is unauthenticated, so exposing it
+    # on all interfaces must be an explicit operator decision
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address (use 0.0.0.0 to expose externally)")
     ap.add_argument("--no-warmup", action="store_true")
     args = ap.parse_args(argv)
 
@@ -112,7 +116,7 @@ def main(argv=None):
         server.warmup()
 
     if args.port:
-        return serve_http(server, args.port)
+        return serve_http(server, args.port, args.host)
 
     # REPL: one prompt per line -> completion (ids mode when no tokenizer)
     print("prompt> ", end="", flush=True)
