@@ -1,5 +1,6 @@
 """Profiler integration (config-gated, reference eager_engine.py:250-272,
-419-420, 866-925: paddle.profiler scheduler window + chrome-trace export).
+419-420, 866-925: paddle.profiler scheduler window + chrome-trace export +
+sorted Device/Kernel/Operator/Memory summary tables on finish).
 
 TPU-native: ``jax.profiler`` writes an XPlane/TensorBoard trace for the
 configured step window.  Config block::
@@ -8,10 +9,15 @@ configured step window.  Config block::
       enable: True
       scheduler: [3, 8]     # [start_step, stop_step)
       log_dir: ./profiler_log
+      summary: True         # emit sorted op/memory summaries on close
+      summary_top: 20       # rows in the printed op table
 
-View with TensorBoard's profile plugin (or xprof).  Per-step op/memory
-summary views come from the trace viewer instead of the reference's
-printed tables.
+On trace close the hook additionally converts the captured XPlane into
+the reference's printed summary views (eager_engine.py:866-925):
+``summary_ops.txt`` (per-HLO-op total/self time, sorted), the raw
+``hlo_stats.json``, and ``summary_memory.txt`` (live device memory stats
+when the backend exposes them).  Conversion uses the xprof toolchain when
+importable and degrades to trace-only with a warning otherwise.
 """
 
 from __future__ import annotations
@@ -46,7 +52,10 @@ class ProfilerHook:
                 )
         self.start_step, self.stop_step = int(sched[0]), int(sched[1])
         self.log_dir = os.path.abspath(cfg.get("log_dir", "./profiler_log"))
+        self.summary = bool(cfg.get("summary", True))
+        self.summary_top = int(cfg.get("summary_top", 20))
         self._active = False
+        self._pending_summary = False
 
     def step(self, step: int) -> None:
         """Call once per training step with the 1-based step counter."""
@@ -60,9 +69,149 @@ class ProfilerHook:
         elif self._active and step >= self.stop_step:
             jax.profiler.stop_trace()
             self._active = False
+            # summaries lazily import the xprof/TF toolchain and parse the
+            # whole trace — deferred to close() so the remaining training
+            # steps (whose throughput is being measured) are not stalled
+            self._pending_summary = True
             logger.info(f"profiler: trace written to {self.log_dir} (view with TensorBoard)")
 
     def close(self) -> None:
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+            self._pending_summary = True
+        if getattr(self, "_pending_summary", False):
+            self._pending_summary = False
+            self._write_summary()
+
+    # -- summary views (reference eager_engine.py:866-925) -----------------
+
+    def _write_summary(self) -> None:
+        if not self.summary:
+            return
+        try:
+            self._write_op_summary()
+        except Exception as e:  # noqa: BLE001 — summaries must never kill a run
+            logger.warning(f"profiler: op summary unavailable ({e!r})")
+        try:
+            self._write_memory_summary()
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"profiler: memory summary unavailable ({e!r})")
+
+    def _newest_xplanes(self):
+        import glob
+
+        runs = sorted(glob.glob(os.path.join(self.log_dir, "plugins", "profile", "*")))
+        if not runs:
+            raise FileNotFoundError(f"no profile runs under {self.log_dir}")
+        planes = sorted(glob.glob(os.path.join(runs[-1], "*.xplane.pb")))
+        if not planes:
+            raise FileNotFoundError(f"no xplane.pb under {runs[-1]}")
+        return planes
+
+    def _hlo_stats_rows(self):
+        """Per-HLO-op rows from xprof's hlo_stats tool (populated on real
+        accelerator traces; CPU traces carry no device-op events)."""
+        import json
+
+        from xprof.convert import raw_to_tool_data  # lazy: pulls in TF
+
+        planes = self._newest_xplanes()
+        data, _ = raw_to_tool_data.xspace_to_tool_data(planes, "hlo_stats", {})
+        if isinstance(data, bytes):
+            data = data.decode()
+        with open(os.path.join(self.log_dir, "hlo_stats.json"), "w") as f:
+            f.write(data)
+
+        table = json.loads(data)
+        cols = [c["id"] for c in table["cols"]]
+        idx = {name: cols.index(name) for name in
+               ("category", "hlo_op_name", "occurrences", "total_time",
+                "total_self_time")}
+        rows = []
+        for row in table.get("rows", []):
+            vals = [cell.get("v") if isinstance(cell, dict) else cell for cell in row["c"]]
+            rows.append({
+                "op": vals[idx["hlo_op_name"]],
+                "category": vals[idx["category"]],
+                "occurrences": int(vals[idx["occurrences"]] or 0),
+                "total_us": float(vals[idx["total_time"]] or 0.0),
+                "self_us": float(vals[idx["total_self_time"]] or 0.0),
+            })
+        return rows
+
+    def _trace_event_rows(self):
+        """Fallback aggregation over the chrome-trace events: op name ->
+        occurrences + summed duration.  Available on every backend."""
+        import glob
+        import gzip
+        import json
+
+        runs = sorted(glob.glob(os.path.join(self.log_dir, "plugins", "profile", "*")))
+        traces = sorted(glob.glob(os.path.join(runs[-1], "*.trace.json.gz")))
+        if not traces:
+            raise FileNotFoundError(f"no trace.json.gz under {runs[-1]}")
+        agg: Dict[str, list] = {}
+        with gzip.open(traces[-1], "rt") as f:
+            events = json.load(f).get("traceEvents", [])
+        for e in events:
+            if e.get("ph") != "X" or "dur" not in e:
+                continue
+            entry = agg.setdefault(e.get("name", "?"), [0, 0.0])
+            entry[0] += 1
+            entry[1] += float(e["dur"])
+        return [
+            {"op": name, "category": "trace", "occurrences": n,
+             "total_us": dur, "self_us": dur}
+            for name, (n, dur) in agg.items()
+        ]
+
+    def _write_op_summary(self) -> None:
+        try:
+            rows = self._hlo_stats_rows()
+            source = "hlo_stats"
+        except Exception as e:  # noqa: BLE001 — xprof missing / schema drift
+            logger.warning(f"profiler: hlo_stats unavailable ({e!r}); using trace events")
+            rows = []
+        if not rows:
+            rows = self._trace_event_rows()
+            source = "trace events (backend emits no per-HLO device stats)"
+        rows.sort(key=lambda r: -r["self_us"])
+        total_self = sum(r["self_us"] for r in rows) or 1.0
+
+        lines = [
+            f"{'op':<56} {'category':<18} {'#':>6} "
+            f"{'total us':>12} {'self us':>12} {'self %':>7}"
+        ]
+        for r in rows[: self.summary_top]:
+            lines.append(
+                f"{str(r['op'])[:56]:<56} {str(r['category'])[:18]:<18} "
+                f"{r['occurrences']:>6} {r['total_us']:>12.1f} "
+                f"{r['self_us']:>12.1f} {100.0 * r['self_us'] / total_self:>7.2f}"
+            )
+        report = "\n".join(lines)
+        path = os.path.join(self.log_dir, "summary_ops.txt")
+        with open(path, "w") as f:
+            f.write(f"source: {source}\n" + report + "\n")
+        logger.info(
+            f"profiler: op summary (top {min(self.summary_top, len(rows))} of "
+            f"{len(rows)} by self time, {source}) -> {path}\n{report}"
+        )
+
+    def _write_memory_summary(self) -> None:
+        lines = []
+        for dev in jax.local_devices():
+            stats = dev.memory_stats()
+            if not stats:
+                continue
+            lines.append(f"{dev}:")
+            for key in sorted(stats):
+                lines.append(f"  {key:<32} {stats[key]}")
+        path = os.path.join(self.log_dir, "summary_memory.txt")
+        with open(path, "w") as f:
+            if lines:
+                f.write("\n".join(lines) + "\n")
+            else:
+                f.write("backend exposes no memory_stats(); see the trace's "
+                        "memory_profile tool instead\n")
+        logger.info(f"profiler: memory summary -> {path}")

@@ -219,3 +219,81 @@ def test_pipeline_pretrain_parity(devices8):
         )(sharded, dev_batch)
     for leaf in jax.tree.leaves(g):
         assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_raw_text_to_pretrain_step_e2e(tmp_path):
+    """Raw jsonl -> tools/preprocess_data.py --tokenizer ernie (sentence
+    splitting + wordpiece) -> ErnieDataset -> finite pretrain loss: the
+    reference's full ERNIE preprocessing chain
+    (data_tools/ernie/preprocess/create_pretraining_data.py) end to end."""
+    import json
+
+    import tools.preprocess_data as pp
+    from paddlefleetx_tpu.data.tokenizers.ernie_tokenizer import ErnieTokenizer
+
+    docs = [
+        "The quick brown fox jumps over the lazy dog. A second sentence here! "
+        "And a third one follows? Finally the fourth sentence ends.",
+        "Training data pipelines need tests. Sentence splitting must work. "
+        "Wordpiece ids go into the stream. Mapping builds pairs.",
+        "Short doc one sentence only.",
+        "Alpha beta gamma delta. Epsilon zeta eta theta. Iota kappa lambda mu.",
+    ] * 4
+    tok = ErnieTokenizer.from_tiny_corpus(docs)
+    vocab_file = str(tmp_path / "vocab.txt")
+    tok.save(vocab_file)
+    corpus = tmp_path / "raw.jsonl"
+    with open(corpus, "w") as f:
+        for d in docs:
+            f.write(json.dumps({"text": d}) + "\n")
+        f.write("\n")  # blank + textless lines are skipped
+        f.write(json.dumps({"meta": "no text"}) + "\n")
+
+    prefix = str(tmp_path / "ernie_corpus")
+    pp.main([
+        "--input", str(corpus), "--output_prefix", prefix,
+        "--tokenizer", "ernie", "--vocab_file", vocab_file,
+    ])
+
+    idx = np.load(prefix + "_idx.npz")
+    assert idx["doc_sent_counts"].sum() == len(idx["sent_lens"])
+    assert idx["doc_sent_counts"].shape[0] == len(docs)  # empty lines dropped
+    assert (idx["sent_lens"] > 0).all()
+    # 4-sentence docs actually got split
+    assert idx["doc_sent_counts"].max() >= 4
+
+    ds = ErnieDataset(
+        input_dir=prefix,
+        max_seq_len=64,
+        vocab_size=tok.vocab_size,
+        cls_id=tok.cls_token_id,
+        sep_id=tok.sep_token_id,
+        mask_id=tok.mask_token_id,
+        pad_id=tok.pad_token_id,
+        seed=11,
+    )
+    assert len(ds) > 0
+    item = ds[0]
+    assert item["input_ids"][0] == tok.cls_token_id
+    # round-trip: live unmasked ids decode back into vocab words
+    live = int(item["attention_mask"].sum())
+    assert (item["input_ids"][:live] < tok.vocab_size).all()
+
+    # the preprocessed corpus trains: one pretrain loss on a real batch
+    cfg = ErnieConfig(
+        vocab_size=max(128, tok.vocab_size),
+        hidden_size=32,
+        num_layers=2,
+        num_attention_heads=4,
+        ffn_hidden_size=64,
+        max_position_embeddings=64,
+        dtype="float32",
+    )
+    params = ernie.init(cfg, jax.random.key(0))
+    batch = {
+        k: jnp.asarray(np.stack([ds[i][k] for i in range(min(4, len(ds)))]))
+        for k in ("input_ids", "token_type_ids", "attention_mask",
+                  "masked_lm_labels", "next_sentence_label")
+    }
+    loss = ernie.pretrain_loss(params, batch, cfg)
+    assert np.isfinite(float(loss))

@@ -36,9 +36,15 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 IPS_RE = re.compile(r"ips: ([\d,]+) tokens/s")
 
 
-def enumerate_layouts(n_devices: int, max_candidates: int = 8):
+def enumerate_layouts(n_devices: int, max_candidates: int = 12):
     """Divisor factorizations n = dp * mp * pp (sharding folded into dp
-    slot as a variant); smallest-mp-first so cheap layouts run first."""
+    slot as a variant); smallest-mp-first so cheap layouts run first.
+
+    Beyond pure layout, the grammar covers the execution knobs the
+    reference tuner sweeps (auto Strategy tuning blocks, reference
+    utils/config.py:515-590) and that docs/performance_tuning.md measures
+    as dominant: recompute granularity, gradient accumulation, and
+    precision mode — attached as variants of the leading layout."""
     outs = []
     for mp in [d for d in (1, 2, 4, 8) if n_devices % d == 0]:
         rest = n_devices // mp
@@ -47,6 +53,17 @@ def enumerate_layouts(n_devices: int, max_candidates: int = 8):
             outs.append({"dp": dp, "mp": mp, "pp": pp})
             if dp > 1 and pp == 1:
                 outs.append({"dp": 1, "mp": mp, "pp": 1, "sharding": dp})
+    # non-layout knobs on the first (cheapest) layout: recompute trades
+    # HBM for FLOPs, accumulate trades HBM for step latency, amp halves
+    # the matmul cost — these frequently beat a layout change
+    if outs:
+        base = outs[0]
+        outs[1:1] = [
+            dict(base, recompute="selective"),
+            dict(base, recompute="full"),
+            dict(base, accumulate=2),
+            dict(base, amp="bf16"),
+        ]
     seen, uniq = set(), []
     for c in outs:
         key = tuple(sorted(c.items()))
@@ -59,20 +76,39 @@ def enumerate_layouts(n_devices: int, max_candidates: int = 8):
 def overrides_for(c: dict, global_batch: int) -> list:
     dp_world = c.get("dp", 1) * c.get("sharding", 1)
     local = max(global_batch // dp_world, 1)
+    accum = max(int(c.get("accumulate", 1)), 1)
+    micro = max(local // accum, 1)
     ov = [
         f"Distributed.dp_degree={c.get('dp', 1)}",
         f"Distributed.mp_degree={c.get('mp', 1)}",
         f"Distributed.pp_degree={c.get('pp', 1)}",
         f"Global.local_batch_size={local}",
-        f"Global.micro_batch_size={local}",
+        f"Global.micro_batch_size={micro}",
     ]
     if c.get("sharding"):
         ov += [
             f"Distributed.sharding.sharding_degree={c['sharding']}",
-            "Distributed.sharding.sharding_stage=2",
+            f"Distributed.sharding.sharding_stage={int(c.get('sharding_stage', 2))}",
         ]
     if c.get("sep"):
         ov.append(f"Distributed.sep_degree={c['sep']}")
+    if c.get("recompute") is not None:
+        if c["recompute"] in (False, "none", "off"):
+            ov.append("Model.use_recompute=False")
+        else:
+            ov += [
+                "Model.use_recompute=True",
+                f"Model.recompute_granularity={c['recompute']}",
+            ]
+    if c.get("amp") is not None:
+        if c["amp"] in (False, "fp32", "off"):
+            ov.append("Engine.mix_precision.enable=False")
+        else:
+            dtype = {"bf16": "bfloat16", "fp16": "float16"}.get(c["amp"], c["amp"])
+            ov += [
+                "Engine.mix_precision.enable=True",
+                f"Engine.mix_precision.dtype={dtype}",
+            ]
     return ov
 
 

@@ -6,12 +6,22 @@ tokenize (multiprocess) -> append eos per doc -> <prefix>_ids.npy (token
 stream) + <prefix>_idx.npz (per-doc lengths), consumed by GPTDataset
 (gpt_dataset.py:95-116 in the reference; data/gpt_dataset.py here).
 
-Tokenizers: gpt (byte-level BPE; needs --vocab_file/--merges_file) or
-t5 (unigram; needs --vocab_file json).
+Tokenizers: gpt (byte-level BPE; needs --vocab_file/--merges_file),
+t5 (unigram; needs --vocab_file json), or ernie (wordpiece; needs
+--vocab_file txt).
+
+The ernie path splits each document into sentences (the reference's
+--split_sentences mode, data_tools/ernie/preprocess/create_pretraining_data.py:
+226-259: NLTK punkt / newline splitter; here a punctuation-rule splitter
+covering Latin and CJK enders) and writes the sentence-indexed corpus
+ErnieDataset consumes: <prefix>_ids.npy + <prefix>_idx.npz with
+``sent_lens`` and ``doc_sent_counts``.
 
 Usage:
   python tools/preprocess_data.py --input corpus.jsonl --output_prefix data/corpus \
       --tokenizer gpt --vocab_file vocab.json --merges_file merges.txt [--workers 8]
+  python tools/preprocess_data.py --input corpus.jsonl --output_prefix data/ernie \
+      --tokenizer ernie --vocab_file vocab.txt
 """
 
 import argparse
@@ -22,9 +32,35 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import re
+
 import numpy as np
 
 _TOK = None
+
+# sentence enders: Latin .!? (not mid-number dots) and CJK 。！？；…
+_SENT_END = re.compile(r"([.!?;]+[\s\"')\]]*\s+|[。！？；…]+[”’）》]*)")
+
+
+def split_sentences(text: str):
+    """Punctuation-rule sentence splitter (both scripts), newline-aware."""
+    sents = []
+    for block in text.splitlines():
+        block = block.strip()
+        if not block:
+            continue
+        # split() alternates text / captured ender: accumulate, flush after
+        # each ender so it stays attached to its sentence
+        cur = ""
+        for i, piece in enumerate(_SENT_END.split(block)):
+            cur += piece
+            if i % 2:
+                if cur.strip():
+                    sents.append(cur.strip())
+                cur = ""
+        if cur.strip():
+            sents.append(cur.strip())
+    return sents
 
 
 def _init_worker(kind, vocab_file, merges_file):
@@ -34,6 +70,10 @@ def _init_worker(kind, vocab_file, merges_file):
 
         _TOK = GPTTokenizer(vocab_file, merges_file)
         _TOK._eos = _TOK.eos_token_id
+    elif kind == "ernie":
+        from paddlefleetx_tpu.data.tokenizers.ernie_tokenizer import ErnieTokenizer
+
+        _TOK = ErnieTokenizer.from_file(vocab_file)
     else:
         from paddlefleetx_tpu.data.tokenizers.t5_tokenizer import T5Tokenizer
 
@@ -54,17 +94,37 @@ def _encode(line):
     return ids
 
 
+def _encode_ernie(line):
+    """One document -> list of per-sentence id lists (no special tokens:
+    ErnieDataset adds [CLS]/[SEP] when building sentence-pair samples)."""
+    line = line.strip()
+    if not line:
+        return None
+    text = json.loads(line).get("text", "")
+    if not text:
+        return None
+    sents = []
+    for sent in split_sentences(text):
+        ids = _TOK.convert_tokens_to_ids(_TOK.tokenize(sent))
+        if ids:
+            sents.append(ids)
+    return sents or None
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--input", required=True, help="jsonl with {'text': ...}")
     ap.add_argument("--output_prefix", required=True)
-    ap.add_argument("--tokenizer", choices=["gpt", "t5"], default="gpt")
+    ap.add_argument("--tokenizer", choices=["gpt", "t5", "ernie"], default="gpt")
     ap.add_argument("--vocab_file", required=True)
     ap.add_argument("--merges_file", default=None)
     ap.add_argument("--workers", type=int, default=1)
     args = ap.parse_args(argv)
 
     init_args = (args.tokenizer, args.vocab_file, args.merges_file)
+
+    if args.tokenizer == "ernie":
+        return _main_ernie(args, init_args)
 
     # stream line -> tokens -> compact uint32 chunks (never hold the whole
     # corpus as Python lists: ~4 bytes/token peak instead of ~36)
@@ -100,6 +160,53 @@ def main(argv=None):
     np.savez(args.output_prefix + "_idx.npz", lens=lens)
     print(
         f"packed {len(lens)} docs, {stream.size} tokens ({dtype.__name__}) -> "
+        f"{args.output_prefix}_ids.npy / _idx.npz"
+    )
+
+
+def _main_ernie(args, init_args):
+    """Sentence-indexed corpus for ErnieDataset (reference
+    create_pretraining_data.py --split_sentences output shape)."""
+
+    def doc_sents():
+        with open(args.input) as f:
+            if args.workers > 1:
+                with mp.Pool(
+                    args.workers, initializer=_init_worker, initargs=init_args
+                ) as pool:
+                    yield from pool.imap(_encode_ernie, f, chunksize=64)
+            else:
+                _init_worker(*init_args)
+                for line in f:
+                    yield _encode_ernie(line)
+
+    chunks, sent_lens, doc_sent_counts, max_id = [], [], [], 0
+    for sents in doc_sents():
+        if not sents:
+            continue
+        for ids in sents:
+            arr = np.asarray(ids, np.uint32)
+            chunks.append(arr)
+            sent_lens.append(len(arr))
+            max_id = max(max_id, int(arr.max()))
+        doc_sent_counts.append(len(sents))
+    if not chunks:
+        print("no documents with text found — nothing written", file=sys.stderr)
+        sys.exit(1)
+
+    dtype = np.uint16 if max_id < 2**16 else np.uint32
+    stream = np.concatenate(chunks).astype(dtype)
+
+    os.makedirs(os.path.dirname(os.path.abspath(args.output_prefix)) or ".", exist_ok=True)
+    np.save(args.output_prefix + "_ids.npy", stream)
+    np.savez(
+        args.output_prefix + "_idx.npz",
+        sent_lens=np.asarray(sent_lens, np.int32),
+        doc_sent_counts=np.asarray(doc_sent_counts, np.int32),
+    )
+    print(
+        f"packed {len(doc_sent_counts)} docs / {len(sent_lens)} sentences, "
+        f"{stream.size} tokens ({dtype.__name__}) -> "
         f"{args.output_prefix}_ids.npy / _idx.npz"
     )
 
