@@ -1,0 +1,236 @@
+"""Real-chip benchmarks beyond the bench.py headline: the BASELINE.md
+north-star configs that fit ONE chip.
+
+Cases (per-chip baselines from the reference's published numbers):
+  gpt1p3b    GPT-1.3B pretrain, seq 1024      — ref ~11,500 tok/s/V100-32G
+             (projects/gpt/docs/hybrid_parallel.md:100-109, fp16+dp8+recompute)
+  vit_b16    ViT-B/16 224 ImageNet pretrain   — ref 7350/16 = 459 img/s/A100
+             (projects/vit/README.md:84, A100*N2C16)
+  vit_l16    ViT-L/16 384 finetune shape      — ref 519/16 = 32.4 img/s/A100
+             (projects/vit/README.md:86)
+
+GPT-6.7B (mp2 pp4 sharding16) does NOT fit one 16 GB chip in any precision
+(13.4 GB params + 26.8 GB adam moments at bf16/fp32 mix); recorded as
+infeasible-single-chip in BENCH_NOTE.md rather than benchmarked dishonestly.
+
+Each case prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}
+and appends it to benchmarks/results_extra.jsonl.  Usage:
+
+  python benchmarks/bench_extra.py [--cases gpt1p3b,vit_b16,vit_l16]
+      [--steps N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _gpt_cfg(n_dev: int, steps: int):
+    """GPT-1.3B (reference pretrain_gpt_1.3B_dp8.yaml model shape: hidden
+    2048, 24 layers, 16 heads) on one chip: bf16 compute, bf16 first
+    moment, selective remat, chunked CE — the levers that fit 1.3B params
+    + moments + activations in 16 GB HBM."""
+    batch = int(os.environ.get("BENCH_1P3B_BATCH", 4)) * n_dev
+    seq = int(os.environ.get("BENCH_1P3B_SEQ", 1024))
+    return {
+        "Global": {
+            "global_batch_size": batch,
+            "micro_batch_size": batch // n_dev,
+            "seed": 1024,
+            "prng_impl": "rbg",
+        },
+        "Engine": {
+            "max_steps": steps,
+            "eval_freq": 0,
+            "logging_freq": 10**9,
+            "mix_precision": {"enable": True, "dtype": "bfloat16"},
+            "save_load": {"save_steps": 0},
+        },
+        "Model": {
+            "module": "GPTModule",
+            # BENCH_1P3B_* shrink knobs exist for CI smoke only; the real
+            # case is the reference 1.3B shape (pretrain_gpt_1.3B_dp8.yaml)
+            "vocab_size": int(os.environ.get("BENCH_1P3B_VOCAB", 50304)),
+            "hidden_size": int(os.environ.get("BENCH_1P3B_HIDDEN", 2048)),
+            "num_layers": int(os.environ.get("BENCH_1P3B_LAYERS", 24)),
+            "num_attention_heads": 16,
+            "max_position_embeddings": seq,
+            "hidden_dropout_prob": 0.1,
+            "attention_probs_dropout_prob": 0.1,
+            "attn_impl": "flash",
+            "use_recompute": True,
+            "recompute_granularity": "selective",
+            "use_fused_ln": True,
+            "use_chunked_ce": True,
+        },
+        "Distributed": {},
+        "Optimizer": {
+            "name": "FusedAdamW",
+            "weight_decay": 0.01,
+            "beta1": 0.9,
+            "beta2": 0.95,
+            # bf16 first moment halves the largest optimizer buffer
+            # (optims/optimizer.py:46 moment_dtype -> optax mu_dtype)
+            "moment_dtype": "bfloat16",
+            "lr": {"name": "Constant", "learning_rate": 1e-4},
+            "grad_clip": {"name": "ClipGradByGlobalNorm", "clip_norm": 1.0},
+        },
+    }, batch, seq
+
+
+def _vit_cfg(n_dev: int, steps: int, large: bool):
+    """ViT-B/16 224 pretrain / ViT-L/16 384 finetune shapes (reference
+    configs/vis/vit/ViT_{base,large}_patch16_*.yaml)."""
+    if large:
+        image, hidden, layers, heads = 384, 1024, 24, 16
+        batch = int(os.environ.get("BENCH_VITL_BATCH", 32)) * n_dev
+    else:
+        image, hidden, layers, heads = 224, 768, 12, 12
+        batch = int(os.environ.get("BENCH_VITB_BATCH", 128)) * n_dev
+    layers = int(os.environ.get("BENCH_VIT_LAYERS", layers))  # CI shrink knob
+    return {
+        "Global": {
+            "global_batch_size": batch,
+            "micro_batch_size": batch // n_dev,
+            "seed": 1024,
+            "prng_impl": "rbg",
+        },
+        "Engine": {
+            "max_steps": steps,
+            "eval_freq": 0,
+            "logging_freq": 10**9,
+            "mix_precision": {"enable": True, "dtype": "bfloat16"},
+            "save_load": {"save_steps": 0},
+        },
+        "Model": {
+            "module": "ViTModule",
+            "image_size": image,
+            "patch_size": 16,
+            "num_classes": 1000,
+            "hidden_size": hidden,
+            "num_layers": layers,
+            "num_attention_heads": heads,
+            "hidden_dropout_prob": 0.1,
+        },
+        "Distributed": {},
+        "Optimizer": {
+            "name": "AdamW",
+            "weight_decay": 0.3,
+            "lr": {"name": "Constant", "learning_rate": 3e-4},
+            "grad_clip": {"name": "ClipGradByGlobalNorm", "clip_norm": 1.0},
+        },
+    }, batch, image
+
+
+CASES = {
+    "gpt1p3b": {"baseline": 11500.0, "unit": "tokens/s/chip"},
+    "vit_b16": {"baseline": 459.0, "unit": "images/s/chip"},
+    "vit_l16": {"baseline": 32.4, "unit": "images/s/chip"},
+}
+
+
+def run_case(name: str, steps: int) -> dict:
+    import jax
+    import numpy as np
+
+    from paddlefleetx_tpu.core.engine import Engine
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    n_dev = jax.device_count()
+    if name == "gpt1p3b":
+        raw, batch, seq = _gpt_cfg(n_dev, steps)
+    else:
+        raw, batch, seq = _vit_cfg(n_dev, steps, large=name == "vit_l16")
+
+    cfg = process_configs(AttrDict.from_nested(raw), num_devices=n_dev)
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+
+    rng = np.random.default_rng(0)
+    if name == "gpt1p3b":
+        host_batch = {
+            "tokens": rng.integers(0, 50304, (batch, seq)).astype(np.int64),
+            "labels": rng.integers(0, 50304, (batch, seq)).astype(np.int64),
+            "loss_mask": np.ones((batch, seq), np.float32),
+            "position_ids": np.tile(np.arange(seq), (batch, 1)),
+        }
+        per_step = batch * seq  # tokens
+    else:
+        host_batch = {
+            "images": rng.normal(0, 1, (batch, seq, seq, 3)).astype(np.float32),
+            "labels": rng.integers(0, 1000, (batch,)).astype(np.int64),
+        }
+        per_step = batch  # images
+
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        dev_batch = engine._put_batch(host_batch)
+        for _ in range(3):
+            engine.state, m = engine._train_step(engine.state, dev_batch)
+        float(m["loss"])  # drain the warmup chain (see bench.py)
+        t0 = time.time()
+        for _ in range(steps):
+            engine.state, m = engine._train_step(engine.state, dev_batch)
+        final_loss = float(m["loss"])
+        dt = time.time() - t0
+
+    meta = CASES[name]
+    if not np.isfinite(final_loss):
+        return {"metric": f"{name}_throughput_per_chip", "value": 0.0,
+                "unit": f"{meta['unit']} (non-finite loss)", "vs_baseline": 0.0}
+    rate = per_step * steps / dt / n_dev
+    return {
+        "metric": f"{name}_throughput_per_chip",
+        "value": round(rate, 1),
+        "unit": meta["unit"],
+        "vs_baseline": round(rate / meta["baseline"], 3),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cases", default="gpt1p3b,vit_b16,vit_l16")
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    from paddlefleetx_tpu.utils.device import apply_platform_env
+
+    apply_platform_env()
+
+    # same hang guard as bench.py: probe the backend in a subprocess first
+    from bench import _backend_alive
+
+    platform = os.environ.get("PFX_PLATFORM", "").lower()
+    if platform in ("", "tpu", "axon") and not _backend_alive():
+        print(json.dumps({"metric": "bench_extra", "value": 0.0,
+                          "unit": "tpu backend unreachable", "vs_baseline": 0.0}))
+        return
+
+    out_path = os.path.join(ROOT, "benchmarks", "results_extra.jsonl")
+    for name in args.cases.split(","):
+        name = name.strip()
+        if name not in CASES:
+            print(f"unknown case {name!r}; have {sorted(CASES)}", file=sys.stderr)
+            continue
+        try:
+            row = run_case(name, args.steps)
+        except Exception as e:  # noqa: BLE001 — e.g. RESOURCE_EXHAUSTED on a
+            # memory-tight case must not abort the remaining cases
+            row = {"metric": f"{name}_throughput_per_chip", "value": 0.0,
+                   "unit": f"{CASES[name]['unit']} ({type(e).__name__})",
+                   "vs_baseline": 0.0}
+        line = json.dumps(row)
+        print(line, flush=True)
+        with open(out_path, "a") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -98,15 +98,21 @@ class ProfilerHook:
         except Exception as e:  # noqa: BLE001
             logger.warning(f"profiler: memory summary unavailable ({e!r})")
 
-    def _newest_xplanes(self):
+    def _newest_run_dir(self) -> str:
         import glob
 
         runs = sorted(glob.glob(os.path.join(self.log_dir, "plugins", "profile", "*")))
         if not runs:
             raise FileNotFoundError(f"no profile runs under {self.log_dir}")
-        planes = sorted(glob.glob(os.path.join(runs[-1], "*.xplane.pb")))
+        return runs[-1]
+
+    def _newest_xplanes(self):
+        import glob
+
+        run = self._newest_run_dir()
+        planes = sorted(glob.glob(os.path.join(run, "*.xplane.pb")))
         if not planes:
-            raise FileNotFoundError(f"no xplane.pb under {runs[-1]}")
+            raise FileNotFoundError(f"no xplane.pb under {run}")
         return planes
 
     def _hlo_stats_rows(self):
@@ -147,10 +153,10 @@ class ProfilerHook:
         import gzip
         import json
 
-        runs = sorted(glob.glob(os.path.join(self.log_dir, "plugins", "profile", "*")))
-        traces = sorted(glob.glob(os.path.join(runs[-1], "*.trace.json.gz")))
+        run = self._newest_run_dir()
+        traces = sorted(glob.glob(os.path.join(run, "*.trace.json.gz")))
         if not traces:
-            raise FileNotFoundError(f"no trace.json.gz under {runs[-1]}")
+            raise FileNotFoundError(f"no trace.json.gz under {run}")
         agg: Dict[str, list] = {}
         with gzip.open(traces[-1], "rt") as f:
             events = json.load(f).get("traceEvents", [])

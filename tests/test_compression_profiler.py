@@ -107,9 +107,13 @@ def test_profiler_summary_views(tmp_path):
     rows = text.splitlines()[2:]
     times = [float(r.split()[-2]) for r in rows]
     assert times == sorted(times, reverse=True)
-    # raw per-HLO table is exported alongside (rows populate on real
-    # accelerator traces; CPU traces fall back to trace-event aggregation)
-    assert os.path.exists(os.path.join(log_dir, "hlo_stats.json"))
+    # raw per-HLO table is exported alongside when the xprof toolchain is
+    # importable (rows populate on real accelerator traces; without xprof
+    # the hook degrades to trace-event aggregation only)
+    import importlib.util
+
+    if importlib.util.find_spec("xprof") is not None:
+        assert os.path.exists(os.path.join(log_dir, "hlo_stats.json"))
     assert os.path.exists(os.path.join(log_dir, "summary_memory.txt"))
 
     # summaries are config-gated off

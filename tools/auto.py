@@ -77,6 +77,12 @@ def overrides_for(c: dict, global_batch: int) -> list:
     dp_world = c.get("dp", 1) * c.get("sharding", 1)
     local = max(global_batch // dp_world, 1)
     accum = max(int(c.get("accumulate", 1)), 1)
+    if local % accum:
+        # a non-dividing factor would either fail config validation or run
+        # a different accumulation than the row reports — reject up front
+        raise ValueError(
+            f"accumulate={accum} does not divide local batch {local}"
+        )
     micro = max(local // accum, 1)
     ov = [
         f"Distributed.dp_degree={c.get('dp', 1)}",
@@ -113,8 +119,12 @@ def overrides_for(c: dict, global_batch: int) -> list:
 
 
 def run_candidate(config: str, base_overrides: list, cand: dict, tune_steps: int, global_batch: int):
+    try:
+        cand_overrides = overrides_for(cand, global_batch)
+    except ValueError as e:
+        return {"layout": cand, "ok": False, "ips": None, "error": str(e)}
     cmd = [sys.executable, os.path.join(ROOT, "tools", "train.py"), "-c", config]
-    for o in base_overrides + overrides_for(cand, global_batch) + [
+    for o in base_overrides + cand_overrides + [
         f"Engine.max_steps={tune_steps}",
         "Engine.logging_freq=2",
         "Engine.eval_freq=0",
