@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: test-fast test-std test-all bench
+.PHONY: test-fast test-mid test-std test-all bench
 
 # <5-min gate on a 1-core CPU-mesh box: units + core model/sharding + one
 # pipeline parity case
@@ -13,6 +13,17 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
 test-fast:
 	python -m pytest $(FAST_FILES) -q -m "not slow" -x
 	python -m pytest "tests/test_pipeline.py::test_pipeline_1f1b_train_loss_and_grads[2-extra1-4-1]" -q
+
+# mid tier: fast gate + the per-family model/engine suites, still skipping
+# the heaviest compile files — the iteration loop for model-family work
+# (~4 min warm on 1 core; cold compiles land in tests/.jax_cache, so the
+# first run of any tier pays ~3x once)
+MID_EXTRA = tests/test_engine.py tests/test_generation.py tests/test_moe.py \
+            tests/test_ernie.py tests/test_t5.py tests/test_vit.py \
+            tests/test_vision.py tests/test_auto_tune.py tests/test_check.py \
+            tests/test_compression_profiler.py tests/test_hf_convert.py
+test-mid:
+	python -m pytest $(FAST_FILES) $(MID_EXTRA) -q -m "not slow" -x
 
 # standard suite: everything except Pallas interpret-mode / big-compile
 # files (marked slow)

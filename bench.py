@@ -32,6 +32,23 @@ def _backend_alive(timeout_s: int = 150) -> bool:
         return False
 
 
+def wait_for_backend() -> bool:
+    """Re-poll the TPU backend inside a bounded window (default 40 min,
+    BENCH_PROBE_WINDOW_S to override).  The axon tunnel has been observed
+    dropping for minutes-to-hours at a time, and round 2's driver-captured
+    number was lost to exactly such an outage — a transient outage inside
+    the driver's run window must not record 0.0 when patience would have
+    produced a real number."""
+    window_s = float(os.environ.get("BENCH_PROBE_WINDOW_S", 2400))
+    deadline = time.time() + window_s
+    while True:
+        if _backend_alive():
+            return True
+        if time.time() >= deadline:
+            return False
+        time.sleep(min(60, max(1, deadline - time.time())))
+
+
 def main():
     # honor PFX_PLATFORM before ANY backend init (the axon sitecustomize
     # overrides a bare JAX_PLATFORMS env var) so the probe gate below and
@@ -44,23 +61,7 @@ def main():
     # PFX_PLATFORM=tpu must still be guarded — it is the hang case)
     platform = os.environ.get("PFX_PLATFORM", "").lower()
     if platform in ("", "tpu", "axon"):
-        alive = False
-        # The axon tunnel has been observed dropping for minutes-to-hours at
-        # a time, and round 2's driver-captured number was lost to exactly
-        # such an outage.  Re-poll inside a bounded window (default 40 min,
-        # BENCH_PROBE_WINDOW_S to override) before reporting unreachable:
-        # a transient outage inside the driver's run window must not record
-        # 0.0 when patience would have produced a real number.
-        window_s = float(os.environ.get("BENCH_PROBE_WINDOW_S", 2400))
-        deadline = time.time() + window_s
-        while True:
-            if _backend_alive():
-                alive = True
-                break
-            if time.time() >= deadline:
-                break
-            time.sleep(min(60, max(1, deadline - time.time())))
-        if not alive:
+        if not wait_for_backend():
             # emit an honest failure line rather than hanging the driver
             print(
                 json.dumps(

@@ -155,9 +155,10 @@ def run_case(name: str, steps: int) -> dict:
 
     rng = np.random.default_rng(0)
     if name == "gpt1p3b":
+        vocab = int(cfg.Model.vocab_size)
         host_batch = {
-            "tokens": rng.integers(0, 50304, (batch, seq)).astype(np.int64),
-            "labels": rng.integers(0, 50304, (batch, seq)).astype(np.int64),
+            "tokens": rng.integers(0, vocab, (batch, seq)).astype(np.int64),
+            "labels": rng.integers(0, vocab, (batch, seq)).astype(np.int64),
             "loss_mask": np.ones((batch, seq), np.float32),
             "position_ids": np.tile(np.arange(seq), (batch, 1)),
         }
@@ -204,11 +205,11 @@ def main(argv=None):
 
     apply_platform_env()
 
-    # same hang guard as bench.py: probe the backend in a subprocess first
-    from bench import _backend_alive
+    # same hang guard + bounded re-poll window as bench.py
+    from bench import wait_for_backend
 
     platform = os.environ.get("PFX_PLATFORM", "").lower()
-    if platform in ("", "tpu", "axon") and not _backend_alive():
+    if platform in ("", "tpu", "axon") and not wait_for_backend():
         print(json.dumps({"metric": "bench_extra", "value": 0.0,
                           "unit": "tpu backend unreachable", "vs_baseline": 0.0}))
         return

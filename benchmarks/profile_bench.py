@@ -28,10 +28,10 @@ def main(argv=None):
 
     apply_platform_env()
 
-    from bench import _backend_alive
+    from bench import wait_for_backend
 
     platform = os.environ.get("PFX_PLATFORM", "").lower()
-    if platform in ("", "tpu", "axon") and not _backend_alive():
+    if platform in ("", "tpu", "axon") and not wait_for_backend():
         print("tpu backend unreachable", file=sys.stderr)
         sys.exit(1)
 

@@ -133,6 +133,9 @@ class Engine:
         self.eval_iters = int(eng.get("eval_iters", 10))
         self.logging_freq = int(eng.get("logging_freq", 10))
         self.accumulate_steps = int(eng.get("accumulate_steps", 1))
+        # cross-host replica verification cadence (reference `check` fused
+        # comm group, comm_groups.py:64; parallel/check.py) — 0 disables
+        self.consistency_check_freq = int(eng.get("consistency_check_freq", 0) or 0)
         self.save_steps = int(eng.get("save_load", {}).get("save_steps", 0) or 0)
         self.output_dir = eng.get("save_load", {}).get("output_dir", "./output")
         self.global_batch_size = int(cfg.Global.global_batch_size)
@@ -644,6 +647,14 @@ class Engine:
                         "consumed_samples": self._consumed_samples,
                     }
                 )
+                t_last = time.time()
+                window_tokens = 0
+
+            if self.consistency_check_freq and step % self.consistency_check_freq == 0:
+                from paddlefleetx_tpu.parallel.check import check_replica_consistency
+
+                fp = check_replica_consistency(self.state.params)
+                logger.info(f"consistency check OK @ step {step}: params fp {fp:#010x}")
                 t_last = time.time()
                 window_tokens = 0
 
