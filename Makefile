@@ -24,6 +24,7 @@ MID_EXTRA = tests/test_engine.py tests/test_generation.py tests/test_moe.py \
             tests/test_compression_profiler.py tests/test_hf_convert.py
 test-mid:
 	python -m pytest $(FAST_FILES) $(MID_EXTRA) -q -m "not slow" -x
+	python -m pytest "tests/test_pipeline.py::test_pipeline_1f1b_train_loss_and_grads[2-extra1-4-1]" -q
 
 # standard suite: everything except Pallas interpret-mode / big-compile
 # files (marked slow)

@@ -96,6 +96,59 @@ class DataLoader:
             yield self.collate_fn([self.dataset[int(i)] for i in batch_idx])
 
 
+class WorkerLoader:
+    """Worker-process loader: the reference paddle.io.DataLoader
+    ``num_workers`` analogue for decode-heavy datasets (image resize /
+    augmentation dominate host time for the vision families).
+
+    Workers use the ``spawn`` start method: the training process has live
+    XLA/jax threads, and forking a threaded process can deadlock the
+    child.  The dataset is pickled once into each worker at pool start
+    (datasets and their transform pipelines are plain picklable objects),
+    after which only indices and samples cross the pipe.  Worker startup
+    costs a fresh interpreter (plus whatever sitecustomize preloads —
+    the axon image preloads jax, ~3 s/worker); the pool lives for the
+    whole epoch-looping iteration, so this is paid once per fit, not per
+    batch.  Sample RNG streams stay deterministic per (seed, idx, visit)
+    — but visit counters live per worker, so augmentation draws across
+    epochs differ from the single-process order (same guarantee the
+    reference's worker processes give).
+    """
+
+    def __init__(self, dataset, sampler: DistributedBatchSampler,
+                 collate_fn=collate_stack, num_workers: int = 2):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.collate_fn = collate_fn
+        self.num_workers = max(1, int(num_workers))
+
+    def __iter__(self):
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        with ctx.Pool(
+            self.num_workers, initializer=_worker_init, initargs=(self.dataset,)
+        ) as pool:
+            for batch_idx in self.sampler:
+                items = pool.map(
+                    _worker_get, [int(i) for i in batch_idx],
+                    chunksize=max(1, len(batch_idx) // self.num_workers),
+                )
+                yield self.collate_fn(items)
+
+
+_WORKER_DATASET = None
+
+
+def _worker_init(dataset):
+    global _WORKER_DATASET
+    _WORKER_DATASET = dataset
+
+
+def _worker_get(idx: int):
+    return _WORKER_DATASET[idx]
+
+
 class PrefetchLoader:
     """Background-thread prefetch over any batch iterable (reference
     paddle.io.DataLoader worker analogue): host batch assembly overlaps the

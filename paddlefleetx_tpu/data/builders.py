@@ -41,8 +41,15 @@ def build_dataloader(cfg, mode: str, dataset=None, consumed_samples: int = 0) ->
         seed=get_seed_tracker().data_seed() if _seed_ready() else 1234,
         consumed_samples=consumed_samples,
     )
-    loader = DataLoader(dataset, sampler, collate_stack)
-    prefetch = int(cfg.Data[mode].get("loader", {}).get("prefetch", 0) or 0)
+    loader_cfg = cfg.Data[mode].get("loader", {})
+    num_workers = int(loader_cfg.get("num_workers", 0) or 0)
+    if num_workers > 0:
+        from paddlefleetx_tpu.data.batch_sampler import WorkerLoader
+
+        loader = WorkerLoader(dataset, sampler, collate_stack, num_workers)
+    else:
+        loader = DataLoader(dataset, sampler, collate_stack)
+    prefetch = int(loader_cfg.get("prefetch", 0) or 0)
     if prefetch > 0:
         from paddlefleetx_tpu.data.batch_sampler import PrefetchLoader
 

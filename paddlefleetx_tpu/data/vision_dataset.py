@@ -66,16 +66,24 @@ def _random_crop(img: np.ndarray, size: int, rng: np.random.Generator) -> np.nda
 def build_transforms(ops: Optional[Sequence[Dict]]):
     """Compose a transform pipeline from config (reference transform_ops
     yaml lists: RandCropImage/RandFlipImage/ResizeImage/CropImage/
-    NormalizeImage...).  Each op: {Name: {kwargs}}.  Returns
-    fn(img, rng, train) -> img float32."""
+    NormalizeImage...).  Each op: {Name: {kwargs}}.  Returns a picklable
+    callable (img, rng, train) -> img float32 — picklable so datasets can
+    cross into spawn-started loader worker processes (batch_sampler.
+    WorkerLoader)."""
     specs = []
     for op in ops or []:
         (name, kwargs), = op.items() if isinstance(op, dict) else [(op, {})]
         specs.append((name, dict(kwargs or {})))
+    return _TransformPipeline(specs)
 
-    def apply(img: np.ndarray, rng: np.random.Generator, train: bool) -> np.ndarray:
+
+class _TransformPipeline:
+    def __init__(self, specs):
+        self.specs = specs
+
+    def __call__(self, img: np.ndarray, rng: np.random.Generator, train: bool) -> np.ndarray:
         normalized = False
-        for name, kw in specs:
+        for name, kw in self.specs:
             if name in ("ResizeImage", "Resize"):
                 if "resize_short" in kw:
                     img = _resize(img, int(kw["resize_short"]))
@@ -105,8 +113,6 @@ def build_transforms(ops: Optional[Sequence[Dict]]):
         if not normalized:
             img = normalize(img)
         return np.ascontiguousarray(img, np.float32)
-
-    return apply
 
 
 @DATASETS.register("GeneralClsDataset")

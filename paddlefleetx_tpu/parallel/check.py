@@ -26,15 +26,32 @@ import numpy as np
 from paddlefleetx_tpu.utils.log import logger
 
 # Knuth multiplicative hash constant; uint32 arithmetic wraps (defined
-# behavior in XLA), giving a cheap order-sensitive rolling hash
+# behavior in XLA)
 _MULT = np.uint32(2654435761)
 
 _UINT_FOR_SIZE = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
 
 
+def _fmix32(h: jax.Array) -> jax.Array:
+    """murmur3 finalizer: nonlinear per-element mixing so the commutative
+    sum below cannot be fooled by compensating bit changes (a plain sum of
+    raw bits lets +d on one element cancel -d on another)."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
 def _leaf_fingerprint(x: jax.Array) -> jax.Array:
-    """Order-insensitive bitwise sum of one leaf as uint32 (a sum is used
-    so the reduction is layout/sharding independent)."""
+    """Position-sensitive bitwise hash of one leaf as uint32.
+
+    Each element's bit pattern is murmur-mixed and weighted by a hash of
+    its logical index, then summed.  The sum makes the reduction
+    layout/sharding independent (element i keeps logical index i under
+    any GSPMD partitioning); the index weight makes transposed values
+    fingerprint differently (a misordered restore is exactly the
+    divergence the check exists to catch)."""
     if x.dtype == jnp.bool_:
         bits = x.astype(jnp.uint32)
     else:
@@ -42,10 +59,13 @@ def _leaf_fingerprint(x: jax.Array) -> jax.Array:
             x = jnp.stack([jnp.real(x), jnp.imag(x)])
         bits = jax.lax.bitcast_convert_type(x, _UINT_FOR_SIZE[x.dtype.itemsize])
     if bits.dtype == jnp.uint64:
-        # fold the high word in before the uint32 reduce — truncation alone
+        # fold the high word in before the uint32 mix — truncation alone
         # would blind the check to divergence confined to the top 32 bits
         bits = (bits ^ (bits >> 32)).astype(jnp.uint32)
-    return jnp.sum(bits.astype(jnp.uint32) * _MULT)
+    bits = bits.astype(jnp.uint32).reshape(-1)
+    idx = jax.lax.iota(jnp.uint32, bits.shape[0])
+    weight = _fmix32(idx * _MULT + jnp.uint32(1))
+    return jnp.sum(_fmix32(bits) * weight)
 
 
 def tree_fingerprint(tree: Any) -> jax.Array:

@@ -141,3 +141,15 @@ class _NoProfiler:
 
     def close(self):
         pass
+
+
+def test_fingerprint_detects_transposition():
+    """Swapping two values (same multiset of bit patterns — e.g. a
+    misordered checkpoint restore) must change the fingerprint: the
+    per-element index weight breaks sum commutativity."""
+    a = _tree(0)
+    w = np.asarray(a["w"]).copy()
+    w[[0, 1]] = w[[1, 0]]
+    assert not np.array_equal(w, np.asarray(a["w"]))
+    b = dict(a, w=jnp.asarray(w))
+    assert int(tree_fingerprint(a)) != int(tree_fingerprint(b))

@@ -184,3 +184,65 @@ def test_sampler_rejects_impossible_batch():
     s = DistributedBatchSampler(dataset_len=4, batch_size=16, drop_last=False)
     batch = next(iter(s))
     assert len(batch) == 4
+
+
+class _DetDataset:
+    """Module-level so it pickles into spawn-started workers."""
+
+    def __len__(self):
+        return 12
+
+    def __getitem__(self, i):
+        return {"x": np.full((3,), i, np.int64), "y": np.int64(i * i)}
+
+
+def test_worker_loader_matches_inline(tmp_path):
+    """WorkerLoader (spawn worker processes, the reference num_workers
+    analogue) yields the same batches as the inline DataLoader for a
+    deterministic dataset."""
+    from paddlefleetx_tpu.data.batch_sampler import WorkerLoader
+
+    import itertools
+
+    ds = _DetDataset()
+    # samplers loop epochs forever: take one epoch's worth of batches
+    ref = list(itertools.islice(iter(DataLoader(ds, DistributedBatchSampler(len(ds), 4))), 3))
+    got = list(
+        itertools.islice(
+            iter(WorkerLoader(ds, DistributedBatchSampler(len(ds), 4), num_workers=2)), 3
+        )
+    )
+    assert len(got) == len(ref) == 3
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(a["x"], b["x"])
+        np.testing.assert_array_equal(a["y"], b["y"])
+
+
+def test_build_dataloader_num_workers(tmp_path):
+    """Data.<mode>.loader.num_workers routes through WorkerLoader."""
+    from paddlefleetx_tpu.data.batch_sampler import WorkerLoader
+    from paddlefleetx_tpu.data.builders import build_dataloader
+    from paddlefleetx_tpu.utils.config import AttrDict
+
+    cfg = AttrDict.from_nested(
+        {
+            "Global": {"global_batch_size": 4},
+            "Engine": {"max_steps": 2},
+            "Data": {
+                "Train": {
+                    "dataset": {
+                        "name": "SyntheticClsDataset",
+                        "num_samples": 8,
+                        "image_size": 8,
+                        "num_classes": 2,
+                    },
+                    "loader": {"num_workers": 2},
+                    "sampler": {"shuffle": False},
+                }
+            },
+        }
+    )
+    loader = build_dataloader(cfg, "Train")
+    assert isinstance(loader, WorkerLoader)
+    batch = next(iter(loader))
+    assert batch["images"].shape == (4, 8, 8, 3)
