@@ -117,10 +117,26 @@ class WorkerLoader:
 
     def __init__(self, dataset, sampler: DistributedBatchSampler,
                  collate_fn=collate_stack, num_workers: int = 2):
+        import inspect
+
         self.dataset = dataset
         self.sampler = sampler
         self.collate_fn = collate_fn
         self.num_workers = max(1, int(num_workers))
+        # augmenting datasets key their RNG on (seed, idx, visit); the
+        # visit counter must live HERE in the parent — per-worker counters
+        # would make draws depend on which worker happened to serve a
+        # sample (nondeterministic run-to-run, and epoch 2 frequently
+        # replays epoch 1's draw when the sample lands on a fresh worker)
+        self._visit_aware = "visit" in inspect.signature(
+            dataset.__getitem__
+        ).parameters
+        self._visits: dict = {}
+
+    def _visit(self, idx: int) -> int:
+        v = self._visits.get(idx, 0)
+        self._visits[idx] = v + 1
+        return v
 
     def __iter__(self):
         import multiprocessing as mp
@@ -130,10 +146,17 @@ class WorkerLoader:
             self.num_workers, initializer=_worker_init, initargs=(self.dataset,)
         ) as pool:
             for batch_idx in self.sampler:
-                items = pool.map(
-                    _worker_get, [int(i) for i in batch_idx],
-                    chunksize=max(1, len(batch_idx) // self.num_workers),
-                )
+                if self._visit_aware:
+                    work = [(int(i), self._visit(int(i))) for i in batch_idx]
+                    items = pool.starmap(
+                        _worker_get_visit, work,
+                        chunksize=max(1, len(work) // self.num_workers),
+                    )
+                else:
+                    items = pool.map(
+                        _worker_get, [int(i) for i in batch_idx],
+                        chunksize=max(1, len(batch_idx) // self.num_workers),
+                    )
                 yield self.collate_fn(items)
 
 
@@ -147,6 +170,10 @@ def _worker_init(dataset):
 
 def _worker_get(idx: int):
     return _WORKER_DATASET[idx]
+
+
+def _worker_get_visit(idx: int, visit: int):
+    return _WORKER_DATASET.__getitem__(idx, visit)
 
 
 class PrefetchLoader:

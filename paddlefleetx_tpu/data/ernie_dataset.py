@@ -93,13 +93,15 @@ class ErnieDataset:
         a, b = self.sent_offsets[s], self.sent_offsets[s + 1]
         return np.asarray(self.tokens[a:b], dtype=np.int64)
 
-    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+    def __getitem__(self, idx: int, visit: Optional[int] = None) -> Dict[str, np.ndarray]:
         row = self.samples[idx % self._epoch_len]
         sent_begin, sent_end, target_len = int(row[0]), int(row[1]), int(row[2])
         # fresh masking each epoch (visit counter), deterministic per visit
-        # (the reference re-masks per epoch the same way, via epoch seeds)
-        visit = self._visits.get(idx, 0)
-        self._visits[idx] = visit + 1
+        # (the reference re-masks per epoch the same way, via epoch seeds);
+        # loader workers pass the visit explicitly
+        if visit is None:
+            visit = self._visits.get(idx, 0)
+            self._visits[idx] = visit + 1
         rng = np.random.default_rng((self.seed, idx, visit))
         sents = [self._sentence(s) for s in range(sent_begin, sent_end)]
 

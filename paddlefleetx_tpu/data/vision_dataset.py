@@ -155,14 +155,16 @@ class GeneralClsDataset:
 
         return np.asarray(Image.open(full).convert("RGB"))
 
-    def __getitem__(self, idx: int):
+    def __getitem__(self, idx: int, visit: Optional[int] = None):
         path, label = self.samples[idx]
         img = self._load(path)
         # per-(seed, idx, visit) stream: reproducible under shuffling, yet a
         # fresh augmentation draw each epoch (visit = how many times this
-        # sample has been served)
-        visit = self._visits.get(idx, 0)
-        self._visits[idx] = visit + 1
+        # sample has been served); loader workers pass the visit explicitly
+        # so draws stay deterministic across worker scheduling
+        if visit is None:
+            visit = self._visits.get(idx, 0)
+            self._visits[idx] = visit + 1
         rng = np.random.default_rng((self.seed, idx, visit))
         img = self.transform(img, rng, self.train)
         return {"images": img, "labels": np.int64(label)}
@@ -275,9 +277,10 @@ class CIFAR10:
     def class_num(self):
         return int(len(np.unique(self.labels)))
 
-    def __getitem__(self, idx: int):
-        visit = self._visits.get(idx, 0)
-        self._visits[idx] = visit + 1
+    def __getitem__(self, idx: int, visit: Optional[int] = None):
+        if visit is None:
+            visit = self._visits.get(idx, 0)
+            self._visits[idx] = visit + 1
         rng = np.random.default_rng((self.seed, idx, visit))
         img = self.transform(self.images[idx], rng, self.train)
         return {"images": img, "labels": self.labels[idx]}
@@ -318,9 +321,10 @@ class ContrastiveLearningDataset:
             img = img[:, ::-1]
         return img + rng.normal(0, 0.05, img.shape).astype(np.float32)
 
-    def __getitem__(self, idx: int):
-        visit = self._visits.get(idx, 0)
-        self._visits[idx] = visit + 1
+    def __getitem__(self, idx: int, visit: Optional[int] = None):
+        if visit is None:
+            visit = self._visits.get(idx, 0)
+            self._visits[idx] = visit + 1
         img = self.base[idx]["images"]  # load once, augment twice
         q = self._augment(img, np.random.default_rng((self.seed, idx, visit, 0)))
         k = self._augment(img, np.random.default_rng((self.seed, idx, visit, 1)))

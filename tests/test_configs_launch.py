@@ -301,3 +301,52 @@ def test_export_then_inference_cli(tmp_path):
         "Inference.max_seq_len=32",
     ])
     assert "inference ok" in log
+
+
+@pytest.mark.slow
+def test_gpt_task_clis(tmp_path):
+    """tasks/gpt/{generation,inference}.py run end-to-end on the tiny
+    config (reference tasks/gpt parity: no-engine generation demo +
+    engine-mode inference demo)."""
+    cfg = tmp_path / "tiny.yaml"
+    cfg.write_text(
+        """Global:
+  global_batch_size: 8
+  seed: 3
+Engine:
+  mix_precision:
+    enable: False
+  save_load:
+    save_steps: 0
+Model:
+  module: GPTModule
+  vocab_size: 96
+  hidden_size: 32
+  num_layers: 2
+  num_attention_heads: 4
+  max_position_embeddings: 128
+  dtype: float32
+Distributed: {}
+Optimizer:
+  name: FusedAdamW
+  lr:
+    name: Constant
+    learning_rate: 0.001
+Generation:
+  max_dec_len: 8
+  decode_strategy: greedy_search
+  pad_to_multiple: 16
+  eos_token_id: 95
+  pad_token_id: 0
+"""
+    )
+    env = dict(os.environ)
+    env["PFX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    for script in ("tasks/gpt/generation.py", "tasks/gpt/inference.py"):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, script), "-c", str(cfg)],
+            capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+        )
+        assert out.returncode == 0, (script, out.stderr[-2000:])
+        assert "enerat" in out.stdout + out.stderr, script  # Generated/generation
