@@ -138,6 +138,13 @@ class Engine:
         self.consistency_check_freq = int(eng.get("consistency_check_freq", 0) or 0)
         self.save_steps = int(eng.get("save_load", {}).get("save_steps", 0) or 0)
         self.output_dir = eng.get("save_load", {}).get("output_dir", "./output")
+        # async_save: array write proceeds in background (orbax async) and
+        # meta.json — the completeness marker — lands only once the write
+        # is durable, so resume never sees a half-written checkpoint
+        self.async_save = bool(eng.get("save_load", {}).get("async_save", False))
+        self._async_ckptr = None
+        self._save_thread = None
+        self._save_error = None
         self.global_batch_size = int(cfg.Global.global_batch_size)
         # machine-readable metrics stream: one JSON line per logging step
         # (the TIPC-style harness and dashboards parse this instead of
@@ -615,6 +622,9 @@ class Engine:
         finally:
             # flush an in-flight trace even when a step raises
             profiler.close()
+            # a checkpoint still writing in background must become durable
+            # before fit returns (callers may exit the process right after)
+            self.wait_for_save()
 
     def _fit_loop(self, train_loader, eval_iter, tokens_per_sample, profiler, t_last, window_tokens):
         for batch in train_loader:
@@ -704,21 +714,7 @@ class Engine:
 
     # ------------------------------------------------------------------
     # Checkpoint (reference save/load eager_engine.py:717-825 + apis/io.py)
-    def save(self, path: Optional[str] = None):
-        import orbax.checkpoint as ocp
-
-        step = int(self.state.step)
-        path = os.path.abspath(path or os.path.join(self.output_dir, f"step_{step}"))
-        ckptr = ocp.StandardCheckpointer()
-        payload = {"params": self.state.params, "opt_state": self.state.opt_state}
-        if self.state.extra is not None:
-            payload["extra"] = self.state.extra
-        ckptr.save(os.path.join(path, "state"), payload, force=True)
-        ckptr.wait_until_finished()
-        meta = {"step": step, "consumed_samples": self._consumed_samples}
-        if self.state.scaler is not None:
-            meta["loss_scale"] = float(self.state.scaler["scale"])
-            meta["scaler_good_steps"] = int(self.state.scaler["good_steps"])
+    def _write_meta(self, path: str, meta: Dict[str, Any]) -> None:
         # meta.json is the checkpoint's completeness marker (written last,
         # checked by latest_checkpoint): write atomically so a crash can
         # never leave a truncated marker that wedges the restart loop
@@ -726,14 +722,80 @@ class Engine:
         with open(tmp, "w") as f:
             json.dump(meta, f)
         os.replace(tmp, os.path.join(path, "meta.json"))
+
+    def wait_for_save(self) -> None:
+        """Join an in-flight async save (no-op when none is pending).
+        Re-raises any error the background write hit — a swallowed storage
+        failure would let training run for hours believing checkpoints
+        exist."""
+        t = self._save_thread
+        if t is not None:
+            t.join()
+            self._save_thread = None
+            err = self._save_error
+            self._save_error = None
+            if err is not None:
+                raise err
+
+    def save(self, path: Optional[str] = None):
+        import orbax.checkpoint as ocp
+
+        step = int(self.state.step)
+        path = os.path.abspath(path or os.path.join(self.output_dir, f"step_{step}"))
+        payload = {"params": self.state.params, "opt_state": self.state.opt_state}
+        if self.state.extra is not None:
+            payload["extra"] = self.state.extra
+        meta = {"step": step, "consumed_samples": self._consumed_samples}
+        if self.state.scaler is not None:
+            meta["loss_scale"] = float(self.state.scaler["scale"])
+            meta["scaler_good_steps"] = int(self.state.scaler["good_steps"])
+
+        if self.async_save:
+            # one in-flight save at a time: a second save against the same
+            # checkpointer must wait for the first write to finish anyway
+            self.wait_for_save()
+            if self._async_ckptr is None:
+                self._async_ckptr = ocp.AsyncCheckpointer(
+                    ocp.StandardCheckpointHandler()
+                )
+            # returns once arrays are snapshotted to host — the training
+            # loop may donate the live buffers immediately after; the
+            # directory write continues in background
+            self._async_ckptr.save(
+                os.path.join(path, "state"),
+                args=ocp.args.StandardSave(payload),
+                force=True,
+            )
+
+            def finish(ckptr=self._async_ckptr, path=path, meta=meta):
+                try:
+                    ckptr.wait_until_finished()
+                    self._write_meta(path, meta)
+                    logger.info(f"saved checkpoint (async): {path}")
+                except BaseException as e:  # noqa: BLE001 — surfaced by
+                    # wait_for_save; meta.json is never written, so resume
+                    # correctly skips the incomplete directory
+                    self._save_error = e
+
+            import threading
+
+            # non-daemon: a final save() right before process exit must not
+            # be killed mid-write (interpreter joins non-daemon threads)
+            self._save_thread = threading.Thread(target=finish, daemon=False)
+            self._save_thread.start()
+            return path
+
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(path, "state"), payload, force=True)
+        ckptr.wait_until_finished()
+        self._write_meta(path, meta)
         logger.info(f"saved checkpoint: {path}")
         return path
 
     def load(self, path: str):
-        import json
-
         import orbax.checkpoint as ocp
 
+        self.wait_for_save()  # never restore over a half-written save
         path = os.path.abspath(path)
         ckptr = ocp.StandardCheckpointer()
         target = {
