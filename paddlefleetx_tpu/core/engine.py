@@ -145,6 +145,13 @@ class Engine:
         self._async_ckptr = None
         self._save_thread = None
         self._save_error = None
+        # QAT (reference Compress.Quantization, compression_helper.py:19-79):
+        # fake-quantized weights in the forward, fp32 masters updated
+        from paddlefleetx_tpu.utils.compression import build_qat_transform
+
+        self.qat_transform = build_qat_transform(cfg.get("Compress"))
+        if self.qat_transform is not None:
+            logger.info("QAT enabled: int8 fake-quant weights in fwd/eval")
         self.global_batch_size = int(cfg.Global.global_batch_size)
         # machine-readable metrics stream: one JSON line per logging step
         # (the TIPC-style harness and dashboards parse this instead of
@@ -405,6 +412,7 @@ class Engine:
         incr_every = self.scale_incr_every
         incr_ratio = self.scale_incr_ratio
         decr_ratio = self.scale_decr_ratio
+        qat = self.qat_transform
 
         @functools.partial(
             jax.jit,
@@ -426,6 +434,10 @@ class Engine:
             )
 
             def run_loss(p, mb, extra):
+                if qat is not None:
+                    # QAT: quantized weights in the forward, straight-through
+                    # grads update the fp32 masters (utils/compression.py)
+                    p = qat(p)
                 if has_extra:
                     loss, new_extra = module.loss_fn(
                         p, mb, ctx=ctx, extra=extra, dropout_key=step_key, train=True
@@ -534,9 +546,13 @@ class Engine:
         call would retrace every eval round)."""
         if getattr(self, "_predict_step", None) is None:
             module, ctx = self.module, self.ctx
+            qat = self.qat_transform
 
             def predict(state, batch):
-                return module.predict_fn(state.params, batch, ctx=ctx)
+                # metrics must measure the same quantized weights the eval
+                # loss and the exported model use
+                p = qat(state.params) if qat is not None else state.params
+                return module.predict_fn(p, batch, ctx=ctx)
 
             self._predict_step = jax.jit(
                 predict,
@@ -549,6 +565,7 @@ class Engine:
         module, ctx = self.module, self.ctx
 
         has_extra = getattr(module, "has_extra_state", False)
+        qat = self.qat_transform
 
         @functools.partial(
             jax.jit,
@@ -563,9 +580,11 @@ class Engine:
             ekey = jax.random.fold_in(
                 jax.random.fold_in(get_seed_tracker().key("global"), state.step), eval_it
             )
+            # eval sees the same quantized weights training optimizes for
+            p = qat(state.params) if qat is not None else state.params
             if has_extra:
                 loss, _ = module.loss_fn(
-                    state.params,
+                    p,
                     batch,
                     ctx=ctx,
                     extra=state.extra,
@@ -574,7 +593,7 @@ class Engine:
                 )
                 return loss
             return module.loss_fn(
-                state.params, batch, ctx=ctx, dropout_key=ekey, train=False
+                p, batch, ctx=ctx, dropout_key=ekey, train=False
             )
 
         return eval_step

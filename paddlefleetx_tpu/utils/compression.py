@@ -162,6 +162,49 @@ def _fq_bwd(_, g):
 fake_quant.defvjp(_fq_fwd, _fq_bwd)
 
 
+def build_qat_transform(compress_cfg) -> Optional[Callable[[Any], Any]]:
+    """Config-gated QAT param transform (reference ``Compress.Quantization``
+    blocks, e.g. qat_gpt_345M_mp8.yaml:37-52 — PaddleSlim's graph rewrite
+    becomes a pytree transform applied to params inside the loss).
+
+    Returns None when QAT is disabled; otherwise a function mapping the
+    param tree to one with matmul weights fake-quantized in the forward
+    (straight-through gradients, so the optimizer still updates the
+    full-precision master weights — the definition of QAT).
+
+    Config keys honored: ``enable``, ``weight_bits`` (must be 8),
+    ``freeze_embedding`` (skip embedding tables, default True),
+    ``skip_tensors`` (path-substring excludes, the reference
+    ``skip_tensor_map`` analogue)."""
+    if not compress_cfg:
+        return None
+    q = compress_cfg.get("Quantization", {})
+    if not q or not bool(q.get("enable", False)):
+        return None
+    bits = int(q.get("weight_bits", 8))
+    if bits != 8:
+        raise ValueError(f"QAT supports weight_bits=8, got {bits}")
+    freeze_embedding = bool(q.get("freeze_embedding", True))
+    skip = tuple(q.get("skip_tensors", []) or [])
+
+    def transform(params: Any) -> Any:
+        def fq(path, x):
+            if not _is_weight(x):
+                return x
+            name = jax.tree_util.keystr(path)
+            if freeze_embedding and any(
+                k in name for k in ("embedding", "word", "position", "token_type")
+            ):
+                return x
+            if any(s in name for s in skip):
+                return x
+            return fake_quant(x)
+
+        return jax.tree_util.tree_map_with_path(fq, params)
+
+    return transform
+
+
 def quantize_tree_for_export(params: Any) -> Dict[str, Any]:
     """Package for the export path: {'q': int8 tree, 'scales': tree}."""
     q, s = quantize_params(params)

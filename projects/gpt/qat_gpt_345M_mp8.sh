@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Quant-aware training for GPT-345M over mp8 (reference projects/gpt/qat_gpt_345M_mp8.sh)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/gpt/qat_gpt_345M_mp8.yaml "$@"

@@ -163,3 +163,93 @@ def test_moe_grad_clip_parity(devices8):
     np.testing.assert_allclose(
         np.asarray(out["experts"]), np.asarray(ref_clipped["experts"]), rtol=1e-5
     )
+
+
+def test_build_qat_transform_rules():
+    from paddlefleetx_tpu.utils.compression import build_qat_transform, fake_quant
+
+    assert build_qat_transform(None) is None
+    assert build_qat_transform({"Quantization": {"enable": False}}) is None
+    with pytest.raises(ValueError, match="weight_bits"):
+        build_qat_transform({"Quantization": {"enable": True, "weight_bits": 4}})
+
+    t = build_qat_transform(
+        {"Quantization": {"enable": True, "skip_tensors": ["head"]}}
+    )
+    rng = np.random.default_rng(0)
+    params = {
+        "embeddings": {"word": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)},
+        "mlp": {"kernel": jnp.asarray(rng.normal(size=(8, 8)), jnp.float32),
+                "bias": jnp.asarray(rng.normal(size=(8,)), jnp.float32)},
+        "head": {"kernel": jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)},
+    }
+    out = t(params)
+    # embeddings frozen, skip list honored, biases (ndim<2) untouched
+    np.testing.assert_array_equal(out["embeddings"]["word"], params["embeddings"]["word"])
+    np.testing.assert_array_equal(out["head"]["kernel"], params["head"]["kernel"])
+    np.testing.assert_array_equal(out["mlp"]["bias"], params["mlp"]["bias"])
+    # matmul kernel IS quantized, to exactly fake_quant's value
+    assert not np.array_equal(out["mlp"]["kernel"], params["mlp"]["kernel"])
+    np.testing.assert_array_equal(out["mlp"]["kernel"], fake_quant(params["mlp"]["kernel"]))
+    # straight-through: grads flow unchanged through the transform
+    g = jax.grad(lambda p: t(p)["mlp"]["kernel"].sum())(params)
+    np.testing.assert_allclose(np.asarray(g["mlp"]["kernel"]), 1.0)
+
+
+def test_qat_engine_train_step(devices8):
+    """Compress.Quantization.enable wires QAT into the train step: loss
+    differs from the fp32 engine (quantized forward) and stays finite."""
+    from paddlefleetx_tpu.core.engine import Engine
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    def run(compress):
+        cfg = AttrDict.from_nested(
+            {
+                "Global": {"global_batch_size": 8, "micro_batch_size": 1, "seed": 7},
+                "Engine": {
+                    "max_steps": 1,
+                    "eval_freq": 0,
+                    "logging_freq": 10**9,
+                    "mix_precision": {"enable": False},
+                    "save_load": {"save_steps": 0},
+                },
+                "Model": {
+                    "module": "GPTModule",
+                    "vocab_size": 64,
+                    "hidden_size": 32,
+                    "num_layers": 2,
+                    "num_attention_heads": 4,
+                    "max_position_embeddings": 16,
+                    "hidden_dropout_prob": 0.0,
+                    "attention_probs_dropout_prob": 0.0,
+                    "dtype": "float32",
+                },
+                "Distributed": {"mp_degree": 2},
+                "Optimizer": {
+                    "name": "FusedAdamW",
+                    "lr": {"name": "Constant", "learning_rate": 1e-4},
+                },
+                **({"Compress": compress} if compress else {}),
+            }
+        )
+        cfg = process_configs(cfg, num_devices=8)
+        mesh = init_dist_env(cfg, devices=jax.devices()[:8])
+        module = build_module(cfg)
+        rng = np.random.default_rng(0)
+        batch = {
+            "tokens": rng.integers(0, 64, (8, 16)).astype(np.int64),
+            "labels": rng.integers(0, 64, (8, 16)).astype(np.int64),
+            "loss_mask": np.ones((8, 16), np.float32),
+            "position_ids": np.tile(np.arange(16), (8, 1)),
+        }
+        with mesh:
+            eng = Engine(cfg, module, mesh)
+            eng.state, m = eng._train_step(eng.state, eng._put_batch(batch))
+            return float(m["loss"])
+
+    ref = run(None)
+    qat = run({"Quantization": {"enable": True}})
+    assert np.isfinite(qat)
+    assert qat != ref  # the quantized forward really was different

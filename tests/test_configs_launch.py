@@ -19,6 +19,7 @@ ALL_CONFIGS = [
     ("configs/gpt/pretrain_gpt_6.7B_sharding16.yaml", 16),
     ("configs/gpt/pretrain_gpt_175B_mp8_pp16.yaml", 128),
     ("configs/gpt/finetune_gpt_345M_glue.yaml", 1),
+    ("configs/gpt/qat_gpt_345M_mp8.yaml", 8),
     ("configs/ernie/pretrain_ernie_base.yaml", 1),
     ("configs/ernie/pretrain_ernie_175B_mp8_pp16.yaml", 128),
     ("configs/t5/pretrain_t5_base.yaml", 1),
