@@ -434,10 +434,6 @@ class Engine:
             )
 
             def run_loss(p, mb, extra):
-                if qat is not None:
-                    # QAT: quantized weights in the forward, straight-through
-                    # grads update the fp32 masters (utils/compression.py)
-                    p = qat(p)
                 if has_extra:
                     loss, new_extra = module.loss_fn(
                         p, mb, ctx=ctx, extra=extra, dropout_key=step_key, train=True
@@ -454,11 +450,17 @@ class Engine:
                     lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]), b
                 )
 
+            # QAT: quantize ONCE per step, outside the microbatch scan —
+            # fake_quant's straight-through VJP makes d/d(quantized) equal
+            # d/d(master), so differentiating from the quantized tree gives
+            # the master-weight grads without re-quantizing per microbatch
+            fwd_params = qat(state.params) if qat is not None else state.params
+
             def micro(carry, mb):
                 gacc, lacc, extra = carry
                 (_, (loss, new_extra)), grads = jax.value_and_grad(
                     run_loss, has_aux=True
-                )(state.params, mb, extra)
+                )(fwd_params, mb, extra)
                 return (jax.tree.map(jnp.add, gacc, grads), lacc + loss, new_extra), None
 
             zeros = jax.tree.map(jnp.zeros_like, state.params)
@@ -473,7 +475,7 @@ class Engine:
             else:
                 (_, (loss, new_extra)), grads = jax.value_and_grad(
                     run_loss, has_aux=True
-                )(state.params, batch, state.extra)
+                )(fwd_params, batch, state.extra)
 
             if use_scaling:
                 grads = jax.tree.map(lambda g: g / loss_scale, grads)

@@ -45,6 +45,9 @@ class GPTConfig:
     fuse_attn_qkv: bool = True
     # attention implementation: "xla" (jnp reference) | "flash" (Pallas kernel)
     attn_impl: str = "xla"
+    # ring attention inner K-block (attn_impl="ring"): bounds the per-ring-
+    # step score buffer to [s_local, ring_chunk_k]; 0 = unchunked
+    ring_chunk_k: int = 1024
     # Megatron sequence parallelism: activations sharded on seq over `model`
     sequence_parallel: bool = False
     # compute dtype for activations (params/optimizer stay fp32)

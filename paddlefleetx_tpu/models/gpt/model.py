@@ -199,14 +199,16 @@ def _attention_block(
         from paddlefleetx_tpu.parallel.ring_attention import ring_attention
 
         q = _constrain(ctx, q, ("batch", "seq", "heads", "kv"))
-        ring = ring_attention
+        chunk_k = int(getattr(cfg, "ring_chunk_k", 1024)) or None
         if cfg.use_recompute and cfg.recompute_granularity == "core_attn":
             ring = jax.checkpoint(
-                lambda q, k, v, mesh=ctx.mesh: ring_attention(q, k, v, mesh, causal=True)
+                lambda q, k, v, mesh=ctx.mesh: ring_attention(
+                    q, k, v, mesh, causal=True, chunk_k=chunk_k
+                )
             )
             out = ring(q, k, v)
         else:
-            out = ring(q, k, v, ctx.mesh, causal=True)
+            out = ring_attention(q, k, v, ctx.mesh, causal=True, chunk_k=chunk_k)
         out = checkpoint_name(out, "attn_out")
         out = jnp.einsum("bsnd,ndh->bsh", out, p["out_kernel"].astype(dtype))
         out = out + p["out_bias"].astype(dtype)

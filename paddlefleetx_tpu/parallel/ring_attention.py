@@ -31,20 +31,15 @@ from paddlefleetx_tpu.parallel.mesh import AXIS_SEP
 NEG_INF = -1e30
 
 
-def _ring_body(q, kv, step, *, ring_size, seq_local, causal, scale):
-    """One ring step: partial attention of local q vs the currently-held
-    K/V chunk.  q: [b, sl, n, d]; returns running (m, l, acc) update."""
-    k_c, v_c, m, l, acc, src = kv
-    # scores in fp32
+def _softmax_update(q, k_c, v_c, m, l, acc, q_pos, k_pos, causal, scale):
+    """Online-softmax update of (m, l, acc) with one K/V block.
+    q: [b, sq, n, d]; k_c/v_c: [b, sk, n, d]; positions are GLOBAL token
+    indices ([sq,1] / [1,sk]) for the causal mask."""
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k_c, preferred_element_type=jnp.float32
     ) * scale
     if causal:
-        my = jax.lax.axis_index(AXIS_SEP)
-        q_pos = my * seq_local + jnp.arange(seq_local)[:, None]
-        k_pos = src * seq_local + jnp.arange(seq_local)[None, :]
         s = jnp.where((k_pos <= q_pos)[None, None], s, NEG_INF)
-
     m_new = jnp.maximum(m, s.max(axis=-1))
     p = jnp.exp(s - m_new[..., None])
     alpha = jnp.exp(m - m_new)
@@ -52,12 +47,55 @@ def _ring_body(q, kv, step, *, ring_size, seq_local, causal, scale):
     acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
         "bhqk,bkhd->bqhd", p, v_c, preferred_element_type=jnp.float32
     )
+    return m_new, l_new, acc_new
+
+
+def _ring_body(q, kv, step, *, ring_size, seq_local, causal, scale, chunk_k):
+    """One ring step: partial attention of local q vs the currently-held
+    K/V chunk.  q: [b, sl, n, d]; returns running (m, l, acc) update.
+
+    ``chunk_k`` bounds the score buffer: the held K/V shard is processed in
+    [sl, chunk_k] blocks under an inner ``lax.scan`` with rematerialised
+    bodies, so peak memory is O(sl * chunk_k) instead of O(sl**2) — the
+    flash-attention trade (recompute probabilities in the backward) in
+    plain XLA einsums, which is what keeps very long local shards
+    trainable."""
+    k_c, v_c, m, l, acc, src = kv
+    my = jax.lax.axis_index(AXIS_SEP)
+    q_pos = my * seq_local + jnp.arange(seq_local)[:, None]
+
+    if chunk_k is None or chunk_k >= seq_local:
+        k_pos = src * seq_local + jnp.arange(seq_local)[None, :]
+        m, l, acc = _softmax_update(
+            q, k_c, v_c, m, l, acc, q_pos, k_pos, causal, scale
+        )
+    else:
+        assert seq_local % chunk_k == 0, (seq_local, chunk_k)
+        n_chunks = seq_local // chunk_k
+        b, _, n, d = k_c.shape
+        k_r = k_c.reshape(b, n_chunks, chunk_k, n, d).transpose(1, 0, 2, 3, 4)
+        v_r = v_c.reshape(b, n_chunks, chunk_k, n, d).transpose(1, 0, 2, 3, 4)
+
+        @jax.checkpoint
+        def chunk_step(carry, args):
+            m, l, acc = carry
+            k_ch, v_ch, off = args
+            k_pos = src * seq_local + off * chunk_k + jnp.arange(chunk_k)[None, :]
+            m, l, acc = _softmax_update(
+                q, k_ch, v_ch, m, l, acc, q_pos, k_pos, causal, scale
+            )
+            return (m, l, acc), None
+
+        (m, l, acc), _ = jax.lax.scan(
+            chunk_step, (m, l, acc), (k_r, v_r, jnp.arange(n_chunks))
+        )
+
     # rotate K/V to the next rank; track which global chunk we now hold
     perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
     k_c = jax.lax.ppermute(k_c, AXIS_SEP, perm)
     v_c = jax.lax.ppermute(v_c, AXIS_SEP, perm)
     src = jax.lax.ppermute(src, AXIS_SEP, perm)
-    return (k_c, v_c, m_new, l_new, acc_new, src)
+    return (k_c, v_c, m, l, acc, src)
 
 
 def ring_attention(
@@ -67,8 +105,13 @@ def ring_attention(
     mesh,
     *,
     causal: bool = True,
+    chunk_k: Optional[int] = 1024,
 ) -> jax.Array:
-    """q,k,v: [b, s, n, d] with s sharded over ``sep``.  Output same spec."""
+    """q,k,v: [b, s, n, d] with s sharded over ``sep``.  Output same spec.
+
+    ``chunk_k``: inner K-block size bounding the per-ring-step score
+    buffer to [s_local, chunk_k] (None = unchunked).  Shards shorter than
+    the chunk (or not dividing it) run unchunked."""
     ring = mesh.shape[AXIS_SEP]
     if ring == 1:
         from paddlefleetx_tpu.ops.attention import xla_attention
@@ -76,6 +119,11 @@ def ring_attention(
         return xla_attention(q, k, v, causal=causal)
     d = q.shape[-1]
     scale = 1.0 / (d**0.5)
+    seq_local = q.shape[1] // ring
+    # falsy = unchunked (the config layer documents 0 that way); shards
+    # shorter than / not dividing the chunk also run unchunked
+    if not chunk_k or seq_local <= chunk_k or seq_local % chunk_k:
+        chunk_k = None
 
     def local_fn(q, k, v):
         b, sl, n, _ = q.shape
@@ -85,7 +133,8 @@ def ring_attention(
         src0 = jax.lax.axis_index(AXIS_SEP)
 
         body = functools.partial(
-            _ring_body, q, ring_size=ring, seq_local=sl, causal=causal, scale=scale
+            _ring_body, q, ring_size=ring, seq_local=sl, causal=causal,
+            scale=scale, chunk_k=chunk_k,
         )
 
         def scan_step(carry, _):
