@@ -351,3 +351,74 @@ Generation:
         )
         assert out.returncode == 0, (script, out.stderr[-2000:])
         assert "enerat" in out.stdout + out.stderr, script  # Generated/generation
+
+
+@pytest.mark.slow
+def test_crash_and_auto_resume_e2e(tmp_path):
+    """Fault injection through the real CLI (SURVEY §5.3: recovery =
+    checkpoint/resume): SIGKILL tools/train.py mid-run after a checkpoint
+    lands, relaunch with auto_resume — training continues from the newest
+    complete step dir and finishes."""
+    import signal
+    import time as _time
+
+    from paddlefleetx_tpu.data.gpt_dataset import write_synthetic_corpus
+
+    data = tmp_path / "data"
+    data.mkdir()
+    write_synthetic_corpus(str(data / "corp"), vocab_size=128, num_docs=16)
+    out = tmp_path / "out"
+    common = [
+        "Model.num_layers=2", "Model.hidden_size=32",
+        "Model.num_attention_heads=4", "Model.vocab_size=128",
+        "Model.max_position_embeddings=32",
+        "Global.global_batch_size=8", "Global.local_batch_size=8",
+        "Global.micro_batch_size=8",
+        "Engine.max_steps=8", "Engine.logging_freq=1", "Engine.eval_freq=0",
+        "Engine.mix_precision.enable=False",
+        "Engine.save_load.save_steps=2",
+        "Engine.save_load.auto_resume=True",
+        f"Engine.save_load.output_dir={out}",
+        f"Data.Train.dataset.input_dir={data}", "Data.Train.dataset.max_seq_len=32",
+    ]
+    env = dict(os.environ)
+    env["PFX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    cmd = [sys.executable, os.path.join(REPO, "tools", "train.py"), "-c",
+           os.path.join(REPO, "configs/gpt/pretrain_gpt_345M_single.yaml")]
+    for o in common:
+        cmd += ["-o", o]
+
+    # run 1: kill -9 once the first checkpoint is complete
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = _time.time() + 300
+    try:
+        while _time.time() < deadline:
+            if (out / "step_2" / "meta.json").exists():
+                break
+            if proc.poll() is not None:
+                raise AssertionError(f"train exited early rc={proc.returncode}")
+            _time.sleep(0.5)
+        else:
+            raise AssertionError("no checkpoint appeared before the deadline")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        # the kill must interrupt a LIVE run: if all 8 steps already
+        # finished, run 2 would resume at step_8, train zero steps, and
+        # this test would pass without exercising the crash path
+        assert not (out / "step_8" / "meta.json").exists(), (
+            "run 1 completed before the kill — crash path not exercised; "
+            "slow the run down (more steps or a bigger model)"
+        )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # run 2: auto-resume from the newest complete checkpoint, finish
+    run2 = subprocess.run(cmd, capture_output=True, text=True, timeout=540,
+                          cwd=REPO, env=env)
+    assert run2.returncode == 0, run2.stderr[-2000:]
+    log = run2.stdout + run2.stderr
+    assert "auto_resume: found" in log
+    assert (out / "step_8" / "meta.json").exists(), os.listdir(out)
