@@ -32,6 +32,17 @@ def _backend_alive(timeout_s: int = 150) -> bool:
         return False
 
 
+def model_flops_per_token(hidden: int, layers: int, vocab: int, seq: int) -> float:
+    """Model FLOPs per token, fwd + 2x bwd (standard MFU convention, no
+    remat extra; causal attention counted at half the score matrix).
+    Shared by bench.py and benchmarks/bench_extra.py so the two MFU
+    numbers stay comparable."""
+    h, L, v = int(hidden), int(layers), int(vocab)
+    ffn = 4 * h
+    per = L * (2 * h * 3 * h + 2 * seq * h + 2 * h * h + 4 * h * ffn) + 2 * h * v
+    return per * 3.0
+
+
 def wait_for_backend() -> bool:
     """Re-poll the TPU backend inside a bounded window (default 40 min,
     BENCH_PROBE_WINDOW_S to override).  The axon tunnel has been observed
@@ -184,13 +195,10 @@ def main():
 
     tokens_per_s = batch * seq * steps / dt
 
-    # MFU: model FLOPs (fwd+bwd, no remat extra — standard convention),
-    # causal attention counted at half the full score matrix
     mc = cfg.Model
-    h, L, v = int(mc.hidden_size), int(mc.num_layers), int(mc.vocab_size)
-    ffn = 4 * h
-    flops_tok = L * (2 * h * 3 * h + 2 * seq * h + 2 * h * h + 4 * h * ffn) + 2 * h * v
-    flops_tok *= 3  # fwd + 2x bwd
+    flops_tok = model_flops_per_token(
+        mc.hidden_size, mc.num_layers, mc.vocab_size, seq
+    )
     peak = float(os.environ.get("BENCH_PEAK_TFLOPS", 197)) * 1e12  # v5e bf16
     mfu = tokens_per_s / n_dev * flops_tok / peak
 

@@ -187,12 +187,22 @@ def run_case(name: str, steps: int) -> dict:
         return {"metric": f"{name}_throughput_per_chip", "value": 0.0,
                 "unit": f"{meta['unit']} (non-finite loss)", "vs_baseline": 0.0}
     rate = per_step * steps / dt / n_dev
-    return {
+    row = {
         "metric": f"{name}_throughput_per_chip",
         "value": round(rate, 1),
         "unit": meta["unit"],
         "vs_baseline": round(rate / meta["baseline"], 3),
     }
+    if name == "gpt1p3b":
+        from bench import model_flops_per_token
+
+        mc = cfg.Model
+        flops_tok = model_flops_per_token(
+            mc.hidden_size, mc.num_layers, mc.vocab_size, seq
+        )
+        peak = float(os.environ.get("BENCH_PEAK_TFLOPS", 197)) * 1e12
+        row["mfu"] = round(rate * flops_tok / peak, 4)
+    return row
 
 
 def main(argv=None):

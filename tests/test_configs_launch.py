@@ -374,7 +374,7 @@ def test_crash_and_auto_resume_e2e(tmp_path):
         "Model.max_position_embeddings=32",
         "Global.global_batch_size=8", "Global.local_batch_size=8",
         "Global.micro_batch_size=8",
-        "Engine.max_steps=8", "Engine.logging_freq=1", "Engine.eval_freq=0",
+        "Engine.max_steps=16", "Engine.logging_freq=1", "Engine.eval_freq=0",
         "Engine.mix_precision.enable=False",
         "Engine.save_load.save_steps=2",
         "Engine.save_load.auto_resume=True",
@@ -399,15 +399,17 @@ def test_crash_and_auto_resume_e2e(tmp_path):
                 break
             if proc.poll() is not None:
                 raise AssertionError(f"train exited early rc={proc.returncode}")
-            _time.sleep(0.5)
+            # tight poll: the kill must land well before the remaining 14
+            # steps (+7 checkpoint saves) finish
+            _time.sleep(0.05)
         else:
             raise AssertionError("no checkpoint appeared before the deadline")
         proc.send_signal(signal.SIGKILL)
         proc.wait(timeout=30)
-        # the kill must interrupt a LIVE run: if all 8 steps already
-        # finished, run 2 would resume at step_8, train zero steps, and
+        # the kill must interrupt a LIVE run: if all 16 steps already
+        # finished, run 2 would resume at step_16, train zero steps, and
         # this test would pass without exercising the crash path
-        assert not (out / "step_8" / "meta.json").exists(), (
+        assert not (out / "step_16" / "meta.json").exists(), (
             "run 1 completed before the kill — crash path not exercised; "
             "slow the run down (more steps or a bigger model)"
         )
@@ -421,4 +423,4 @@ def test_crash_and_auto_resume_e2e(tmp_path):
     assert run2.returncode == 0, run2.stderr[-2000:]
     log = run2.stdout + run2.stderr
     assert "auto_resume: found" in log
-    assert (out / "step_8" / "meta.json").exists(), os.listdir(out)
+    assert (out / "step_16" / "meta.json").exists(), os.listdir(out)

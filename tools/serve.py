@@ -49,7 +49,13 @@ def build_server(config: str, overrides):
 
 
 def serve_http(server, port: int, host: str = "127.0.0.1"):
-    from http.server import BaseHTTPRequestHandler, HTTPServer
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    # generation mutates server state (RNG key split, stats) and shares one
+    # compiled artifact cache — serialize it; the threading server still
+    # keeps /healthz responsive while a long generation runs
+    gen_lock = threading.Lock()
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # route through our logger instead
@@ -76,25 +82,32 @@ def serve_http(server, port: int, host: str = "127.0.0.1"):
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
                 max_toks = req.get("max_tokens")
-                if "prompt" in req:
-                    texts = server.generate_text([req["prompt"]], max_dec_len=max_toks)
-                    return self._json(200, {"completion": texts[0]})
-                if "prompts" in req:  # batched: rides the data axis together
-                    texts = server.generate_text(req["prompts"], max_dec_len=max_toks)
-                    return self._json(200, {"completions": texts})
-                if "prompt_ids" in req:
-                    ids = server.generate_ids([req["prompt_ids"]], max_dec_len=max_toks)
-                    return self._json(200, {"completion_ids": ids[0]})
-                if "prompts_ids" in req:
-                    ids = server.generate_ids(req["prompts_ids"], max_dec_len=max_toks)
-                    return self._json(200, {"completions_ids": ids})
-                return self._json(400, {"error": "need prompt(s) or prompt(s)_ids"})
+                # generate under the lock, respond AFTER releasing it: a
+                # slow client blocked in the socket write must not stall
+                # other requests behind a held lock
+                payload = None
+                with gen_lock:
+                    if "prompt" in req:
+                        texts = server.generate_text([req["prompt"]], max_dec_len=max_toks)
+                        payload = {"completion": texts[0]}
+                    elif "prompts" in req:  # batched: rides the data axis together
+                        texts = server.generate_text(req["prompts"], max_dec_len=max_toks)
+                        payload = {"completions": texts}
+                    elif "prompt_ids" in req:
+                        ids = server.generate_ids([req["prompt_ids"]], max_dec_len=max_toks)
+                        payload = {"completion_ids": ids[0]}
+                    elif "prompts_ids" in req:
+                        ids = server.generate_ids(req["prompts_ids"], max_dec_len=max_toks)
+                        payload = {"completions_ids": ids}
+                if payload is None:
+                    return self._json(400, {"error": "need prompt(s) or prompt(s)_ids"})
+                return self._json(200, payload)
             except ValueError as e:  # bad request (empty prompts, etc.)
                 return self._json(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — report, keep serving
                 return self._json(500, {"error": str(e)})
 
-    httpd = HTTPServer((host, port), Handler)
+    httpd = ThreadingHTTPServer((host, port), Handler)
     print(f"serving on {host}:{port} (POST /generate, GET /healthz)", flush=True)
     httpd.serve_forever()
 
