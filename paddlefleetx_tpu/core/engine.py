@@ -223,7 +223,27 @@ class Engine:
             mesh=mesh,
             num_experts=num_experts,
         )
+        # balanced causal context parallelism: feed sequences in the zigzag
+        # block order (parallel/ring_attention.zigzag_permutation) so ring
+        # attention's causal masking wastes the same work on every device
+        self.sep_zigzag = bool(dist.get("sep_zigzag", False)) and (
+            mesh.shape.get("sep", 1) > 1
+        )
+        self._zigzag_perm = None
         pp_degree = int(dist.get("pp_degree", 1))
+        if self.sep_zigzag:
+            # only ring attention masks by explicit positions; any other
+            # attention would silently attend across the permuted order
+            attn_impl = str(getattr(getattr(module, "config", None), "attn_impl", ""))
+            if attn_impl != "ring":
+                raise ValueError(
+                    f"sep_zigzag requires Model.attn_impl=ring, got {attn_impl!r}"
+                )
+            if pp_degree > 1:
+                raise NotImplementedError(
+                    "sep_zigzag under pipeline parallelism is not wired "
+                    "(the 1F1B path does not thread attn_positions)"
+                )
         pipeline = None
         if pp_degree > 1:
             from paddlefleetx_tpu.parallel.pipeline import PipelineConfig
@@ -601,7 +621,49 @@ class Engine:
         return eval_step
 
     # ------------------------------------------------------------------
+    # sequence-dim keys reordered under the zigzag context-parallel layout
+    _SEQ_KEYS = ("tokens", "labels", "loss_mask", "position_ids", "input_ids")
+
     def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        if self.sep_zigzag:
+            seq = next(
+                (v.shape[1] for k, v in batch.items()
+                 if k in self._SEQ_KEYS and getattr(v, "ndim", 0) >= 2),
+                None,
+            )
+            if seq is not None:
+                if self._zigzag_perm is None or len(self._zigzag_perm) != seq:
+                    from paddlefleetx_tpu.parallel.ring_attention import (
+                        zigzag_permutation,
+                    )
+
+                    self._zigzag_perm = np.asarray(
+                        zigzag_permutation(seq, self.mesh.shape["sep"])
+                    )
+                perm = self._zigzag_perm
+                batch = {
+                    k: (v[:, perm] if k in self._SEQ_KEYS and getattr(v, "ndim", 0) >= 2 else v)
+                    for k, v in batch.items()
+                }
+                if batch.get("position_ids") is None:
+                    # loaders that omit position_ids would otherwise embed
+                    # (and mask) in permuted index order
+                    b = batch["tokens"].shape[0]
+                    batch["position_ids"] = np.tile(perm, (b, 1))
+                if self.ctx.attn_positions is None or len(
+                    np.asarray(self.ctx.attn_positions)
+                ) != seq:
+                    # the positions ride the sharding ctx as a CONSTANT:
+                    # ring attention masks by TRUE token order.  One-time
+                    # retrace of the jitted steps when the seq is first seen.
+                    import dataclasses as _dc
+
+                    self.ctx = _dc.replace(
+                        self.ctx, attn_positions=jnp.asarray(perm, jnp.int32)
+                    )
+                    self._train_step = self._build_train_step()
+                    self._eval_step = self._build_eval_step()
+                    self._predict_step = None
         return jax.tree.map(lambda x: jax.device_put(x, self.batch_spec), batch)
 
     def _write_metrics(self, record: Dict) -> None:

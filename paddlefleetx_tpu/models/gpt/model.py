@@ -54,6 +54,10 @@ class ShardingCtx:
     mesh: Any
     rules: Tuple[Tuple[str, Any], ...]
     pipeline: Any = None  # Optional[PipelineConfig]
+    # global token positions of the (possibly permuted) sequence, [s];
+    # consumed by ring attention so balanced layouts (zigzag_permutation)
+    # mask causally by TRUE token order.  None = contiguous arange.
+    attn_positions: Any = None
 
     def constrain(self, x: jax.Array, logical: Tuple[Optional[str], ...]) -> jax.Array:
         from paddlefleetx_tpu.parallel.sharding import with_logical_constraint
@@ -200,15 +204,18 @@ def _attention_block(
 
         q = _constrain(ctx, q, ("batch", "seq", "heads", "kv"))
         chunk_k = int(getattr(cfg, "ring_chunk_k", 1024)) or None
+        pos = ctx.attn_positions
         if cfg.use_recompute and cfg.recompute_granularity == "core_attn":
             ring = jax.checkpoint(
                 lambda q, k, v, mesh=ctx.mesh: ring_attention(
-                    q, k, v, mesh, causal=True, chunk_k=chunk_k
+                    q, k, v, mesh, causal=True, chunk_k=chunk_k, positions=pos
                 )
             )
             out = ring(q, k, v)
         else:
-            out = ring_attention(q, k, v, ctx.mesh, causal=True, chunk_k=chunk_k)
+            out = ring_attention(
+                q, k, v, ctx.mesh, causal=True, chunk_k=chunk_k, positions=pos
+            )
         out = checkpoint_name(out, "attn_out")
         out = jnp.einsum("bsnd,ndh->bsh", out, p["out_kernel"].astype(dtype))
         out = out + p["out_bias"].astype(dtype)

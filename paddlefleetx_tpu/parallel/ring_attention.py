@@ -31,6 +31,30 @@ from paddlefleetx_tpu.parallel.mesh import AXIS_SEP
 NEG_INF = -1e30
 
 
+def zigzag_permutation(seq_len: int, ring: int):
+    """Balanced causal context-parallel layout (the zigzag/striped CP used
+    by Megatron/llama3-scale training): split the sequence into 2*ring
+    blocks and give device i blocks (i, 2*ring-1-i), so every device owns
+    an early AND a late block and causal masking wastes the same ~half of
+    the score blocks everywhere — with contiguous sharding device 0 is
+    almost fully masked (idle) while device ring-1 does full work.
+
+    Returns ``perm`` (int32 [seq_len]): feed ``tokens[:, perm]`` and pass
+    ``positions=perm`` to :func:`ring_attention`; per-token outputs/losses
+    are order-invariant, or invert with ``jnp.argsort(perm)``."""
+    import numpy as np
+
+    if seq_len % (2 * ring):
+        raise ValueError(f"seq_len {seq_len} must divide 2*ring = {2 * ring}")
+    block = seq_len // (2 * ring)
+    idx = np.arange(seq_len).reshape(2 * ring, block)
+    order = []
+    for i in range(ring):
+        order.append(idx[i])
+        order.append(idx[2 * ring - 1 - i])
+    return jnp.asarray(np.concatenate(order), jnp.int32)
+
+
 def _softmax_update(q, k_c, v_c, m, l, acc, q_pos, k_pos, causal, scale):
     """Online-softmax update of (m, l, acc) with one K/V block.
     q: [b, sq, n, d]; k_c/v_c: [b, sk, n, d]; positions are GLOBAL token
@@ -50,9 +74,14 @@ def _softmax_update(q, k_c, v_c, m, l, acc, q_pos, k_pos, causal, scale):
     return m_new, l_new, acc_new
 
 
-def _ring_body(q, kv, step, *, ring_size, seq_local, causal, scale, chunk_k):
+def _ring_body(q, q_pos, kv, step, *, ring_size, seq_local, causal, scale, chunk_k):
     """One ring step: partial attention of local q vs the currently-held
     K/V chunk.  q: [b, sl, n, d]; returns running (m, l, acc) update.
+
+    Positions are explicit arrays (global token indices) carried alongside
+    K/V around the ring — the causal mask never assumes the shard holds a
+    contiguous block, which is what lets zigzag layouts balance causal
+    work across the ring.
 
     ``chunk_k`` bounds the score buffer: the held K/V shard is processed in
     [sl, chunk_k] blocks under an inner ``lax.scan`` with rematerialised
@@ -60,14 +89,12 @@ def _ring_body(q, kv, step, *, ring_size, seq_local, causal, scale, chunk_k):
     flash-attention trade (recompute probabilities in the backward) in
     plain XLA einsums, which is what keeps very long local shards
     trainable."""
-    k_c, v_c, m, l, acc, src = kv
-    my = jax.lax.axis_index(AXIS_SEP)
-    q_pos = my * seq_local + jnp.arange(seq_local)[:, None]
+    k_c, v_c, k_pos_c, m, l, acc = kv
+    q_pos2 = q_pos[:, None]
 
     if chunk_k is None or chunk_k >= seq_local:
-        k_pos = src * seq_local + jnp.arange(seq_local)[None, :]
         m, l, acc = _softmax_update(
-            q, k_c, v_c, m, l, acc, q_pos, k_pos, causal, scale
+            q, k_c, v_c, m, l, acc, q_pos2, k_pos_c[None, :], causal, scale
         )
     else:
         assert seq_local % chunk_k == 0, (seq_local, chunk_k)
@@ -75,27 +102,27 @@ def _ring_body(q, kv, step, *, ring_size, seq_local, causal, scale, chunk_k):
         b, _, n, d = k_c.shape
         k_r = k_c.reshape(b, n_chunks, chunk_k, n, d).transpose(1, 0, 2, 3, 4)
         v_r = v_c.reshape(b, n_chunks, chunk_k, n, d).transpose(1, 0, 2, 3, 4)
+        kp_r = k_pos_c.reshape(n_chunks, chunk_k)
 
         @jax.checkpoint
         def chunk_step(carry, args):
             m, l, acc = carry
-            k_ch, v_ch, off = args
-            k_pos = src * seq_local + off * chunk_k + jnp.arange(chunk_k)[None, :]
+            k_ch, v_ch, kp_ch = args
             m, l, acc = _softmax_update(
-                q, k_ch, v_ch, m, l, acc, q_pos, k_pos, causal, scale
+                q, k_ch, v_ch, m, l, acc, q_pos2, kp_ch[None, :], causal, scale
             )
             return (m, l, acc), None
 
         (m, l, acc), _ = jax.lax.scan(
-            chunk_step, (m, l, acc), (k_r, v_r, jnp.arange(n_chunks))
+            chunk_step, (m, l, acc), (k_r, v_r, kp_r)
         )
 
-    # rotate K/V to the next rank; track which global chunk we now hold
+    # rotate K/V (and their positions) to the next rank
     perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
     k_c = jax.lax.ppermute(k_c, AXIS_SEP, perm)
     v_c = jax.lax.ppermute(v_c, AXIS_SEP, perm)
-    src = jax.lax.ppermute(src, AXIS_SEP, perm)
-    return (k_c, v_c, m, l, acc, src)
+    k_pos_c = jax.lax.ppermute(k_pos_c, AXIS_SEP, perm)
+    return (k_c, v_c, k_pos_c, m, l, acc)
 
 
 def ring_attention(
@@ -106,12 +133,18 @@ def ring_attention(
     *,
     causal: bool = True,
     chunk_k: Optional[int] = 1024,
+    positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """q,k,v: [b, s, n, d] with s sharded over ``sep``.  Output same spec.
 
     ``chunk_k``: inner K-block size bounding the per-ring-step score
     buffer to [s_local, chunk_k] (None = unchunked).  Shards shorter than
-    the chunk (or not dividing it) run unchunked."""
+    the chunk (or not dividing it) run unchunked.
+
+    ``positions``: [s] global token index of each row (sep-sharded with
+    the sequence); defaults to arange — pass the permuted positions when
+    the sequence is fed in a balanced layout (``zigzag_permutation``) so
+    the causal mask follows the true token order."""
     ring = mesh.shape[AXIS_SEP]
     if ring == 1:
         from paddlefleetx_tpu.ops.attention import xla_attention
@@ -124,24 +157,25 @@ def ring_attention(
     # shorter than / not dividing the chunk also run unchunked
     if not chunk_k or seq_local <= chunk_k or seq_local % chunk_k:
         chunk_k = None
+    if positions is None:
+        positions = jnp.arange(q.shape[1], dtype=jnp.int32)
 
-    def local_fn(q, k, v):
+    def local_fn(q, k, v, pos):
         b, sl, n, _ = q.shape
         m0 = jnp.full((b, n, sl), NEG_INF, jnp.float32)
         l0 = jnp.zeros((b, n, sl), jnp.float32)
         acc0 = jnp.zeros((b, sl, n, d), jnp.float32)
-        src0 = jax.lax.axis_index(AXIS_SEP)
 
         body = functools.partial(
-            _ring_body, q, ring_size=ring, seq_local=sl, causal=causal,
+            _ring_body, q, pos, ring_size=ring, seq_local=sl, causal=causal,
             scale=scale, chunk_k=chunk_k,
         )
 
         def scan_step(carry, _):
             return body(carry, None), None
 
-        (k_f, v_f, m, l, acc, _), _ = jax.lax.scan(
-            scan_step, (k, v, m0, l0, acc0, src0), None, length=ring
+        (k_f, v_f, _, m, l, acc), _ = jax.lax.scan(
+            scan_step, (k, v, pos, m0, l0, acc0), None, length=ring
         )
         l_safe = jnp.maximum(l, 1e-30)
         out = acc / l_safe.transpose(0, 2, 1)[..., None]
@@ -150,8 +184,8 @@ def ring_attention(
     return jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(P(None, AXIS_SEP), P(None, AXIS_SEP), P(None, AXIS_SEP)),
+        in_specs=(P(None, AXIS_SEP), P(None, AXIS_SEP), P(None, AXIS_SEP), P(AXIS_SEP)),
         out_specs=P(None, AXIS_SEP),
         axis_names={AXIS_SEP},
         check_vma=False,
-    )(q, k, v)
+    )(q, k, v, positions)
