@@ -230,6 +230,8 @@ class Engine:
             mesh.shape.get("sep", 1) > 1
         )
         self._zigzag_perm = None
+        self._zigzag_inv = None
+        self._zigzag_seq = None
         pp_degree = int(dist.get("pp_degree", 1))
         if self.sep_zigzag:
             # only ring attention masks by explicit positions; any other
@@ -640,24 +642,33 @@ class Engine:
                     self._zigzag_perm = np.asarray(
                         zigzag_permutation(seq, self.mesh.shape["sep"])
                     )
+                    self._zigzag_inv = np.argsort(self._zigzag_perm)
                 perm = self._zigzag_perm
+                inv = self._zigzag_inv
                 batch = {
                     k: (v[:, perm] if k in self._SEQ_KEYS and getattr(v, "ndim", 0) >= 2 else v)
                     for k, v in batch.items()
                 }
+                # per-sample indices INTO the sequence must follow the
+                # token they point at (e.g. finetune cls_position)
+                for key in ("cls_position",):
+                    if batch.get(key) is not None:
+                        batch[key] = inv[np.asarray(batch[key])]
                 if batch.get("position_ids") is None:
                     # loaders that omit position_ids would otherwise embed
                     # (and mask) in permuted index order
-                    b = batch["tokens"].shape[0]
+                    b = next(
+                        v.shape[0] for k, v in batch.items()
+                        if k in self._SEQ_KEYS and getattr(v, "ndim", 0) >= 2
+                    )
                     batch["position_ids"] = np.tile(perm, (b, 1))
-                if self.ctx.attn_positions is None or len(
-                    np.asarray(self.ctx.attn_positions)
-                ) != seq:
+                if self._zigzag_seq != seq:
                     # the positions ride the sharding ctx as a CONSTANT:
                     # ring attention masks by TRUE token order.  One-time
                     # retrace of the jitted steps when the seq is first seen.
                     import dataclasses as _dc
 
+                    self._zigzag_seq = seq
                     self.ctx = _dc.replace(
                         self.ctx, attn_positions=jnp.asarray(perm, jnp.int32)
                     )
@@ -771,11 +782,8 @@ class Engine:
         # predictions into a host-side metric accumulator (reference
         # GPTFinetuneModule validation_step, language_module.py:370-420)
         metric = None
-        predict = None
         if hasattr(self.module, "build_metric") and hasattr(self.module, "predict_fn"):
             metric = self.module.build_metric()
-            if metric is not None:
-                predict = self._get_predict_step()
         it = iter(loader)
         for i, batch in enumerate(it):
             if i >= iters:
@@ -783,6 +791,10 @@ class Engine:
             dev_batch = self._put_batch(batch)
             losses.append(float(self._eval_step(self.state, dev_batch, jnp.int32(i))))
             if metric is not None:
+                # fetched per-iteration: _put_batch may retrace the steps
+                # (zigzag positions install) and a stale closure would
+                # predict with the wrong causal mask
+                predict = self._get_predict_step()
                 preds = np.asarray(jax.device_get(predict(self.state, dev_batch)))
                 metric.update(preds, np.asarray(batch["labels"]))
         avg = float(np.mean(losses)) if losses else float("nan")

@@ -45,7 +45,9 @@ def zigzag_permutation(seq_len: int, ring: int):
     import numpy as np
 
     if seq_len % (2 * ring):
-        raise ValueError(f"seq_len {seq_len} must divide 2*ring = {2 * ring}")
+        raise ValueError(
+            f"seq_len {seq_len} must be divisible by 2*ring = {2 * ring}"
+        )
     block = seq_len // (2 * ring)
     idx = np.arange(seq_len).reshape(2 * ring, block)
     order = []
@@ -149,7 +151,14 @@ def ring_attention(
     if ring == 1:
         from paddlefleetx_tpu.ops.attention import xla_attention
 
-        return xla_attention(q, k, v, causal=causal)
+        if positions is None or not causal:
+            return xla_attention(q, k, v, causal=causal)
+        # permuted feed on a 1-device ring: honor the positions via an
+        # explicit bias mask (silently masking by storage order would
+        # return wrong values for zigzag-ordered inputs)
+        allowed = positions[None, :] <= positions[:, None]  # [s, s]
+        bias = jnp.where(allowed, 0.0, NEG_INF)[None, None].astype(jnp.float32)
+        return xla_attention(q, k, v, causal=False, bias=bias)
     d = q.shape[-1]
     scale = 1.0 / (d**0.5)
     seq_local = q.shape[1] // ring

@@ -109,3 +109,10 @@ Tuning:
     assert results[0]["layout"]["recompute"] == "selective"
     assert results[0]["layout"]["amp"] == "bf16"
     assert results[1]["layout"]["accumulate"] == 2
+
+
+def test_overrides_for_attn_knobs():
+    ov = overrides_for({"sep": 2, "attn": "ring", "zigzag": True}, global_batch=8)
+    assert "Model.attn_impl=ring" in ov
+    assert "Distributed.sep_zigzag=True" in ov
+    assert "Distributed.sep_degree=2" in ov

@@ -173,7 +173,7 @@ def test_zigzag_permutation_structure():
     assert sorted(perm.tolist()) == list(range(16))
     import pytest as _pytest
 
-    with _pytest.raises(ValueError, match="must divide"):
+    with _pytest.raises(ValueError, match="divisible by"):
         zigzag_permutation(10, 4)
 
 

@@ -98,6 +98,11 @@ def overrides_for(c: dict, global_batch: int) -> list:
         ]
     if c.get("sep"):
         ov.append(f"Distributed.sep_degree={c['sep']}")
+    if c.get("attn") is not None:
+        # flash vs ring(+zigzag) is the lever long-context configs sweep
+        ov.append(f"Model.attn_impl={c['attn']}")
+    if c.get("zigzag") is not None:
+        ov.append(f"Distributed.sep_zigzag={bool(c['zigzag'])}")
     if c.get("recompute") is not None:
         if c["recompute"] in (False, "none", "off"):
             ov.append("Model.use_recompute=False")
