@@ -221,12 +221,14 @@ def test_no_engine_examples_run():
     env = dict(os.environ)
     env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     env["PFX_PLATFORM"] = "cpu"
-    for script in (
-        "examples/transformer/train_no_engine.py",
-        "examples/transformer/generate_no_engine.py",
+    for script, extra in (
+        ("examples/transformer/train_no_engine.py", []),
+        ("examples/transformer/generate_no_engine.py", []),
+        ("examples/transformer/long_context_ring.py",
+         ["--seq", "512", "--steps", "1", "--hidden", "64"]),
     ):
         out = subprocess.run(
-            [sys.executable, os.path.join(REPO, script)],
+            [sys.executable, os.path.join(REPO, script)] + extra,
             capture_output=True, text=True, timeout=420, cwd=REPO, env=env,
         )
         assert out.returncode == 0, (script, out.stderr[-1500:])
