@@ -3,24 +3,35 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline: reference PaddleFleetX GPT-345M single-card pretrain ~16,260
 tokens/s on 1x V100-32G (BASELINE.md / projects/gpt/docs/single_card.md:40-49).
+
+Contract hardening (round 4): the benchmark itself runs in a CHILD process;
+the parent is pure Python (no jax import), so it stays responsive to the
+driver's SIGTERM no matter what the axon tunnel does, and it ALWAYS emits
+the one JSON line — the child's real number, or an honest value:0.0 — before
+exiting.  Round 3's BENCH was rc=124 with no output because the in-process
+probe window (40 min) overran the driver's capture timeout.
 """
 
 import json
 import os
+import signal
+import subprocess
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_TOKENS_PER_S = 16260.0
+METRIC = "gpt345m_pretrain_throughput_per_chip"
 
 
-def _backend_alive(timeout_s: int = 150) -> bool:
+def _backend_alive(timeout_s: float = None) -> bool:
     """Probe jax backend init in a subprocess: the axon TPU tunnel can hang
     indefinitely when the chip is unreachable, and merely importing-and-
     calling jax.devices() in-process would wedge the whole benchmark."""
-    import subprocess
-
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 90))
     try:
         r = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
@@ -44,23 +55,119 @@ def model_flops_per_token(hidden: int, layers: int, vocab: int, seq: int) -> flo
 
 
 def wait_for_backend() -> bool:
-    """Re-poll the TPU backend inside a bounded window (default 40 min,
-    BENCH_PROBE_WINDOW_S to override).  The axon tunnel has been observed
-    dropping for minutes-to-hours at a time, and round 2's driver-captured
-    number was lost to exactly such an outage — a transient outage inside
-    the driver's run window must not record 0.0 when patience would have
-    produced a real number."""
-    window_s = float(os.environ.get("BENCH_PROBE_WINDOW_S", 2400))
+    """Re-poll the TPU backend inside a bounded window.  Default is 120 s:
+    short enough to stay well inside the driver's capture budget (round 3
+    lost the whole artifact to a 40-min window), long enough to ride out a
+    brief tunnel blip.  Set BENCH_PROBE_WINDOW_S higher for patient manual
+    runs when the tunnel is flapping."""
+    window_s = float(os.environ.get("BENCH_PROBE_WINDOW_S", 120))
     deadline = time.time() + window_s
     while True:
         if _backend_alive():
             return True
         if time.time() >= deadline:
             return False
-        time.sleep(min(60, max(1, deadline - time.time())))
+        time.sleep(min(30, max(1, deadline - time.time())))
 
 
-def main():
+def _honest_row(reason: str) -> dict:
+    return {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": f"tokens/s/chip ({reason})",
+        "vs_baseline": 0.0,
+    }
+
+
+# ----------------------------------------------------------------------
+# Parent harness: spawn the child benchmark, relay its JSON lines, and
+# guarantee the expected metric rows come out even on SIGTERM / deadline.
+# Shared by bench.py and benchmarks/bench_extra.py (which imports it).
+def run_child_with_honest_fallback(child_argv, deadline_s, emit_missing) -> int:
+    """Run `child_argv`, relaying its stdout.  `emit_missing(seen, reason)`
+    is called with the set of metric names the child DID print whenever the
+    run ends abnormally (signal, deadline, bad exit, no output) and must
+    print honest fallback rows for everything still missing.  The parent
+    never imports jax, so it stays responsive to the driver's SIGTERM no
+    matter what the axon tunnel does."""
+    seen: set = set()
+
+    child = subprocess.Popen(child_argv, stdout=subprocess.PIPE, text=True)
+
+    def _reader():
+        # relay the child's stdout as it streams; remember metric rows
+        for line in child.stdout:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+                if isinstance(row, dict) and "metric" in row:
+                    seen.add(row["metric"])
+            except ValueError:
+                pass
+            print(line, flush=True)
+
+    t = threading.Thread(target=_reader, daemon=True)
+    t.start()
+
+    def _quiesce():
+        # emission is about to start: a late follow-up signal (driver
+        # kill-then-escalate) must not re-enter the handler and print
+        # duplicate fallback rows
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+
+    def _bail(reason: str) -> int:
+        _quiesce()
+        try:
+            child.kill()
+        except OSError:
+            pass
+        # drain the pipe BEFORE deciding what's missing: the child may have
+        # printed its real row in the same instant — emitting a fallback on
+        # top would break the one-line-per-metric contract
+        t.join(timeout=10)
+        emit_missing(seen, reason)
+        return 0
+
+    def _on_term(signum, frame):
+        # the driver's clock ran out: emit the honest line(s) NOW and exit 0
+        # so the capture parses (a propagated kill would record rc!=0,
+        # parsed:null — round 3's failure mode)
+        _bail(f"killed by signal {signum} before completion")
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
+    start = time.time()
+    while True:
+        rc = child.poll()
+        if rc is not None:
+            _quiesce()
+            t.join(timeout=10)
+            emit_missing(seen, f"child exited rc={rc} with no JSON")
+            return 0
+        if time.time() - start > deadline_s:
+            return _bail(f"self-deadline {deadline_s:.0f}s exceeded")
+        time.sleep(0.5)
+
+
+def _parent() -> int:
+    def emit_missing(seen, reason):
+        if METRIC not in seen:
+            print(json.dumps(_honest_row(reason)), flush=True)
+
+    return run_child_with_honest_fallback(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        float(os.environ.get("BENCH_DEADLINE_S", 600)),
+        emit_missing,
+    )
+
+
+# ----------------------------------------------------------------------
+def _child() -> None:
     # honor PFX_PLATFORM before ANY backend init (the axon sitecustomize
     # overrides a bare JAX_PLATFORMS env var) so the probe gate below and
     # the backend the benchmark actually initializes agree
@@ -74,16 +181,7 @@ def main():
     if platform in ("", "tpu", "axon"):
         if not wait_for_backend():
             # emit an honest failure line rather than hanging the driver
-            print(
-                json.dumps(
-                    {
-                        "metric": "gpt345m_pretrain_throughput_per_chip",
-                        "value": 0.0,
-                        "unit": "tokens/s/chip (tpu backend unreachable)",
-                        "vs_baseline": 0.0,
-                    }
-                )
-            )
+            print(json.dumps(_honest_row("tpu backend unreachable")), flush=True)
             return
 
     import jax
@@ -118,10 +216,12 @@ def main():
             },
             "Model": {
                 "module": "GPTModule",
-                "vocab_size": 50304,
-                "hidden_size": 1024,
-                "num_layers": 24,
-                "num_attention_heads": 16,
+                # BENCH_* shrink knobs are for CI smoke of the bench
+                # contract only; the real case is the reference 345M shape
+                "vocab_size": int(os.environ.get("BENCH_VOCAB", 50304)),
+                "hidden_size": int(os.environ.get("BENCH_HIDDEN", 1024)),
+                "num_layers": int(os.environ.get("BENCH_LAYERS", 24)),
+                "num_attention_heads": int(os.environ.get("BENCH_HEADS", 16)),
                 "max_position_embeddings": seq,
                 "hidden_dropout_prob": float(os.environ.get("BENCH_DROPOUT", 0.1)),
                 "attention_probs_dropout_prob": float(os.environ.get("BENCH_DROPOUT", 0.1)),
@@ -154,9 +254,10 @@ def main():
     module = build_module(cfg)
 
     rng = np.random.default_rng(0)
+    vocab = int(cfg.Model.vocab_size)
     host_batch = {
-        "tokens": rng.integers(0, 50304, (batch, seq)).astype(np.int64),
-        "labels": rng.integers(0, 50304, (batch, seq)).astype(np.int64),
+        "tokens": rng.integers(0, vocab, (batch, seq)).astype(np.int64),
+        "labels": rng.integers(0, vocab, (batch, seq)).astype(np.int64),
         "loss_mask": np.ones((batch, seq), np.float32),
         "position_ids": np.tile(np.arange(seq), (batch, 1)),
     }
@@ -166,11 +267,11 @@ def main():
         dev_batch = engine._put_batch(host_batch)
         # warmup (compile)
         for _ in range(3):
-            engine.state, m = engine._train_step(engine.state, dev_batch)
+            engine.state, m = engine.train_step(engine.state, dev_batch)
         float(m["loss"])  # host fetch: drains the warmup chain (see below)
         t0 = time.time()
         for _ in range(steps):
-            engine.state, m = engine._train_step(engine.state, dev_batch)
+            engine.state, m = engine.train_step(engine.state, dev_batch)
         # force a device->host fetch of the final loss: on the axon remote
         # runtime block_until_ready alone has been observed returning while
         # the donated-state chain is still in flight (timing would then
@@ -181,16 +282,7 @@ def main():
     if not np.isfinite(final_loss):
         # same honest-failure contract as the unreachable-backend path:
         # always ONE parseable JSON line, never a traceback
-        print(
-            json.dumps(
-                {
-                    "metric": "gpt345m_pretrain_throughput_per_chip",
-                    "value": 0.0,
-                    "unit": f"tokens/s/chip (non-finite bench loss {final_loss})",
-                    "vs_baseline": 0.0,
-                }
-            )
-        )
+        print(json.dumps(_honest_row(f"non-finite bench loss {final_loss}")), flush=True)
         return
 
     tokens_per_s = batch * seq * steps / dt
@@ -205,14 +297,22 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "gpt345m_pretrain_throughput_per_chip",
+                "metric": METRIC,
                 "value": round(tokens_per_s / n_dev, 1),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(tokens_per_s / n_dev / BASELINE_TOKENS_PER_S, 3),
                 "mfu": round(mfu, 4),
             }
-        )
+        ),
+        flush=True,
     )
+
+
+def main():
+    if "--child" in sys.argv:
+        _child()
+        return
+    sys.exit(_parent())
 
 
 if __name__ == "__main__":

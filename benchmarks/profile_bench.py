@@ -115,7 +115,7 @@ def main(argv=None):
         engine = Engine(cfg, module, mesh)
         dev_batch = engine._put_batch(host_batch)
         for step in range(1, 5 + args.steps):
-            engine.state, m = engine._train_step(engine.state, dev_batch)
+            engine.state, m = engine.train_step(engine.state, dev_batch)
             float(m["loss"])  # keep each step synchronous inside the trace
             hook.step(step)
     hook.close()

@@ -279,8 +279,37 @@ class Engine:
         self._consumed_samples = 0
         self._step = 0  # host mirror of state.step (avoids device sync in fit)
         self.state = self._init_state()
-        self._train_step = self._build_train_step()
-        self._eval_step = self._build_eval_step()
+        # install zigzag positions EAGERLY for the configured sequence
+        # length: a caller that resolves the step attribute before placing
+        # the first batch must not run a positions-less (wrong-mask) graph
+        zig_seq = int(
+            getattr(getattr(module, "config", None), "max_position_embeddings", 0) or 0
+        )
+        # the config seq can be zigzag-incompatible (not divisible by
+        # 2*sep) while the loader's actual batches are padded to a length
+        # that is — fall back to the lazy per-batch install for those
+        if self.sep_zigzag and zig_seq > 0 and zig_seq % (
+            2 * self.mesh.shape["sep"]
+        ) == 0:
+            self._install_zigzag(zig_seq)  # builds the steps itself
+        else:
+            self._train_step = self._build_train_step()
+            self._eval_step = self._build_eval_step()
+
+    # ------------------------------------------------------------------
+    def train_step(self, state, dev_batch):
+        """Run one jitted train step on an already-placed batch.
+
+        Always dispatches to the CURRENT compiled step: `_put_batch` may
+        rebuild the jitted steps (first-seen zigzag sequence length), so
+        callers must not hold `_train_step` across a `_put_batch` call —
+        this indirection makes that mistake impossible.
+        """
+        return self._train_step(state, dev_batch)
+
+    def eval_step(self, state, dev_batch, it):
+        """Dispatcher for the current jitted eval step (see train_step)."""
+        return self._eval_step(state, dev_batch, it)
 
     # ------------------------------------------------------------------
     def _init_state(self) -> TrainState:
@@ -626,6 +655,30 @@ class Engine:
     # sequence-dim keys reordered under the zigzag context-parallel layout
     _SEQ_KEYS = ("tokens", "labels", "loss_mask", "position_ids", "input_ids")
 
+    def _install_zigzag(self, seq: int) -> None:
+        """Install the zigzag permutation + attn_positions for sequence
+        length `seq` and rebuild the jitted steps against it.
+
+        The positions ride the sharding ctx as a CONSTANT: ring attention
+        masks by TRUE token order.  Called eagerly at init (config seq) and
+        again from _put_batch only if a different seq shows up.
+        """
+        import dataclasses as _dc
+
+        from paddlefleetx_tpu.parallel.ring_attention import zigzag_permutation
+
+        self._zigzag_perm = np.asarray(
+            zigzag_permutation(seq, self.mesh.shape["sep"])
+        )
+        self._zigzag_inv = np.argsort(self._zigzag_perm)
+        self._zigzag_seq = seq
+        self.ctx = _dc.replace(
+            self.ctx, attn_positions=jnp.asarray(self._zigzag_perm, jnp.int32)
+        )
+        self._train_step = self._build_train_step()
+        self._eval_step = self._build_eval_step()
+        self._predict_step = None
+
     def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
         if self.sep_zigzag:
             seq = next(
@@ -634,15 +687,8 @@ class Engine:
                 None,
             )
             if seq is not None:
-                if self._zigzag_perm is None or len(self._zigzag_perm) != seq:
-                    from paddlefleetx_tpu.parallel.ring_attention import (
-                        zigzag_permutation,
-                    )
-
-                    self._zigzag_perm = np.asarray(
-                        zigzag_permutation(seq, self.mesh.shape["sep"])
-                    )
-                    self._zigzag_inv = np.argsort(self._zigzag_perm)
+                if self._zigzag_seq != seq:
+                    self._install_zigzag(seq)
                 perm = self._zigzag_perm
                 inv = self._zigzag_inv
                 batch = {
@@ -662,19 +708,6 @@ class Engine:
                         if k in self._SEQ_KEYS and getattr(v, "ndim", 0) >= 2
                     )
                     batch["position_ids"] = np.tile(perm, (b, 1))
-                if self._zigzag_seq != seq:
-                    # the positions ride the sharding ctx as a CONSTANT:
-                    # ring attention masks by TRUE token order.  One-time
-                    # retrace of the jitted steps when the seq is first seen.
-                    import dataclasses as _dc
-
-                    self._zigzag_seq = seq
-                    self.ctx = _dc.replace(
-                        self.ctx, attn_positions=jnp.asarray(perm, jnp.int32)
-                    )
-                    self._train_step = self._build_train_step()
-                    self._eval_step = self._build_eval_step()
-                    self._predict_step = None
         return jax.tree.map(lambda x: jax.device_put(x, self.batch_spec), batch)
 
     def _write_metrics(self, record: Dict) -> None:

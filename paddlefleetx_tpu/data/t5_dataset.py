@@ -126,7 +126,11 @@ class T5PretrainDataset:
         # length fits (constructor check): re-draw the noise mask a few
         # times rather than silently dropping EOS and mid-span tokens
         for attempt in range(4):
-            rng = np.random.default_rng((self.seed, idx, attempt))
+            # attempt 0 keeps the historical (seed, idx) key so mid-epoch
+            # resumes from pre-redraw-loop checkpoints see the identical
+            # data stream; only actual redraws mix in the attempt term
+            key = (self.seed, idx) if attempt == 0 else (self.seed, idx, attempt)
+            rng = np.random.default_rng(key)
             mask = random_spans_noise_mask(
                 L, self.rate, self.mean_span, rng, max_spans=self.num_sentinels
             )

@@ -246,7 +246,8 @@ def test_qat_engine_train_step(devices8):
         }
         with mesh:
             eng = Engine(cfg, module, mesh)
-            eng.state, m = eng._train_step(eng.state, eng._put_batch(batch))
+            dev = eng._put_batch(batch)
+            eng.state, m = eng.train_step(eng.state, dev)
             return float(m["loss"])
 
     ref = run(None)

@@ -76,7 +76,8 @@ def _losses_from_run(cfg, steps=12):
         it = iter(loader)
         for _ in range(steps):
             batch = next(it)
-            engine.state, m = engine._train_step(engine.state, engine._put_batch(batch))
+            dev = engine._put_batch(batch)
+            engine.state, m = engine.train_step(engine.state, dev)
             losses.append(float(m["loss"]))
     return losses, engine
 
@@ -182,7 +183,8 @@ def test_fp16_overflow_shrinks_scale_and_skips(tmp_path, devices8):
         engine = Engine(cfg, module, mesh)
         p0 = jax.tree.map(lambda x: np.asarray(x), engine.state.params)
         batch = next(iter(loader))
-        engine.state, m = engine._train_step(engine.state, engine._put_batch(batch))
+        dev = engine._put_batch(batch)
+        engine.state, m = engine.train_step(engine.state, dev)
     assert float(m["found_inf"]) == 1.0
     assert float(engine.state.scaler["scale"]) == 2.0**30
     for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(engine.state.params)):

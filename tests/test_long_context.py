@@ -221,7 +221,8 @@ def test_engine_zigzag_loss_parity(devices8, tmp_path):
         }
         with mesh:
             eng = Engine(cfg, module, mesh)
-            eng.state, m = eng._train_step(eng.state, eng._put_batch(batch))
+            dev = eng._put_batch(batch)
+            eng.state, m = eng.train_step(eng.state, dev)
             return float(m["loss"])
 
     ref = run(False)

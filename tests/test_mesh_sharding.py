@@ -235,7 +235,8 @@ def test_zero_offload_host_memory_and_step(devices8):
         "loss_mask": np.ones((8, 16), np.float32),
     }
     with eng.mesh:
-        eng.state, metrics = eng._train_step(eng.state, eng._put_batch(batch))
+        dev = eng._put_batch(batch)
+        eng.state, metrics = eng.train_step(eng.state, dev)
     assert np.isfinite(float(metrics["loss"]))
 
 

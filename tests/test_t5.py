@@ -241,5 +241,6 @@ def test_t5_trains_from_pretrain_dataset(tmp_path, devices8):
     with mesh:
         engine = Engine(cfg, module, mesh)
         batch = next(iter(loader))
-        engine.state, m = engine._train_step(engine.state, engine._put_batch(batch))
+        dev = engine._put_batch(batch)
+        engine.state, m = engine.train_step(engine.state, dev)
     assert np.isfinite(float(m["loss"]))

@@ -197,7 +197,7 @@ def test_moco_engine_end_to_end(tmp_path):
         dev = engine._put_batch(batch)
         s0 = engine.state
         assert s0.extra is not None
-        engine.state, m = engine._train_step(engine.state, dev)
+        engine.state, m = engine.train_step(engine.state, dev)
         assert np.isfinite(float(m["loss"]))
         assert int(engine.state.extra["ptr"]) == 8
 
