@@ -1,6 +1,11 @@
 # Developer entry points
 
-.PHONY: test-fast test-mid test-std test-all bench
+.PHONY: lint test-fast test-mid test-std test-all bench
+
+# stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
+# bare except, eval/exec, tabs, trailing whitespace, mutable defaults
+lint:
+	python tools/lint.py
 
 # <5-min gate on a 1-core CPU-mesh box: units + core model/sharding + one
 # pipeline parity case
@@ -8,8 +13,9 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_optims.py tests/test_rigid.py tests/test_glue.py \
              tests/test_lm_eval.py tests/test_configs_launch.py \
              tests/test_gpt_model.py tests/test_mesh_sharding.py \
-             tests/test_serving.py tests/test_chunked_ce.py
+             tests/test_serving.py tests/test_chunked_ce.py tests/test_lint.py
 
+# lint runs inside the gate via tests/test_lint.py::test_repo_is_clean
 test-fast:
 	python -m pytest $(FAST_FILES) -q -m "not slow" -x
 	python -m pytest "tests/test_pipeline.py::test_pipeline_1f1b_train_loss_and_grads[2-extra1-4-1]" -q
@@ -21,7 +27,8 @@ test-fast:
 MID_EXTRA = tests/test_engine.py tests/test_generation.py tests/test_moe.py \
             tests/test_ernie.py tests/test_t5.py tests/test_vit.py \
             tests/test_vision.py tests/test_auto_tune.py tests/test_check.py \
-            tests/test_compression_profiler.py tests/test_hf_convert.py
+            tests/test_compression_profiler.py tests/test_hf_convert.py \
+            tests/test_long_context.py
 test-mid:
 	python -m pytest $(FAST_FILES) $(MID_EXTRA) -q -m "not slow" -x
 	python -m pytest "tests/test_pipeline.py::test_pipeline_1f1b_train_loss_and_grads[2-extra1-4-1]" -q

@@ -20,13 +20,11 @@ to TensorRT.  Here:
 from __future__ import annotations
 
 import dataclasses
-import os
 import time
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from paddlefleetx_tpu.utils.log import logger
 

@@ -9,7 +9,7 @@ so the protocol is data-flow only — no training_step/backward hooks.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Dict, Optional
 
 import jax
 

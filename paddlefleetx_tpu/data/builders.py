@@ -2,7 +2,6 @@
 
 from __future__ import annotations
 
-from typing import Optional
 
 from paddlefleetx_tpu.data.batch_sampler import DataLoader, DistributedBatchSampler, collate_stack
 from paddlefleetx_tpu.parallel.seed import get_seed_tracker

@@ -14,7 +14,6 @@ behavior — O(tokens) two-pointer walks.
 from __future__ import annotations
 
 import ctypes
-import os
 from typing import Optional, Tuple
 
 import numpy as np

@@ -8,7 +8,7 @@ batches.  Transforms are name-dispatched from config ``transform_ops`` lists
 from __future__ import annotations
 
 import os
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 

@@ -9,7 +9,6 @@ from __future__ import annotations
 
 from typing import Dict
 
-import numpy as np
 
 from paddlefleetx_tpu.models.debertav2.model import DebertaV2Config
 

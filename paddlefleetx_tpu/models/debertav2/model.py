@@ -24,7 +24,7 @@ the shared rel-position embedding (+ its LayerNorm) lives at top level.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp

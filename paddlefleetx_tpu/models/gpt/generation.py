@@ -14,7 +14,6 @@ with ``dynamic_update_slice``; prefill packs the prompt in one forward.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax

@@ -23,7 +23,6 @@ full_attn / core_attn (reference single_model.py:320-405) map to
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax

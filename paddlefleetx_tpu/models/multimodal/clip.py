@@ -27,7 +27,6 @@ import jax.numpy as jnp
 
 from paddlefleetx_tpu.models.common import (
     ParamSpec,
-    dropout,
     init_params,
     logical_axes,
     normal_init,

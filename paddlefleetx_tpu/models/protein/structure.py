@@ -41,7 +41,7 @@ from paddlefleetx_tpu.models.common import (
     ones_init,
     zeros_init,
 )
-from paddlefleetx_tpu.models.gpt.model import ShardingCtx, _constrain, layer_norm
+from paddlefleetx_tpu.models.gpt.model import ShardingCtx, layer_norm
 from paddlefleetx_tpu.models.protein import residue_constants as rc
 from paddlefleetx_tpu.models.protein import rigid
 

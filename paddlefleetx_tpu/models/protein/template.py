@@ -31,7 +31,6 @@ from paddlefleetx_tpu.models.common import (
     ParamSpec,
     init_params,
     normal_init,
-    ones_init,
     stack_spec_tree,
     zeros_init,
 )
@@ -39,7 +38,6 @@ from paddlefleetx_tpu.models.gpt.model import ShardingCtx, _constrain, layer_nor
 from paddlefleetx_tpu.models.protein import rigid
 from paddlefleetx_tpu.models.protein.evoformer import (
     _attn_specs,
-    _gated_attention,
     _ln,
     _transition,
     _transition_specs,

@@ -26,7 +26,7 @@ Layers are stacked on a leading ``layers`` axis and run with ``lax.scan``.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +39,6 @@ from paddlefleetx_tpu.models.common import (
     normal_init,
     ones_init,
     stack_spec_tree,
-    zeros_init,
 )
 from paddlefleetx_tpu.models.gpt.model import ShardingCtx, _constrain
 from paddlefleetx_tpu.models.t5.config import T5Config

@@ -15,7 +15,7 @@ batch over data axes), so all parallel layouts apply unchanged.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,7 @@ the reduction — callers gate on that (see gpt/model.py loss_fn).
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,7 @@ the last dim; used as a drop-in for models' ``layer_norm(x + y, ...)``.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

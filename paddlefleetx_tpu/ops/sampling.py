@@ -14,7 +14,6 @@ needed — all branch-free under jit.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -9,7 +9,6 @@ schedule argument; the engine chooses the counter.
 
 from __future__ import annotations
 
-import math
 from typing import Callable, Optional
 
 import jax.numpy as jnp

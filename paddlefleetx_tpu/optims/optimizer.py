@@ -14,10 +14,9 @@ rules in parallel.sharding decide the partitioning.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Optional
 
 import jax
-import jax.numpy as jnp
 import optax
 
 from paddlefleetx_tpu.optims.lr_scheduler import Schedule, build_lr_scheduler

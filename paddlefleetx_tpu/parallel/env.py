@@ -10,7 +10,6 @@ seed the PRNG streams.
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 import jax
 

@@ -4,7 +4,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from paddlefleetx_tpu.parallel.check import (
     check_replica_consistency,

@@ -4,7 +4,6 @@ overfit, dp sharding."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from paddlefleetx_tpu.models.multimodal import clip
 from paddlefleetx_tpu.models.multimodal.clip import CLIPConfig

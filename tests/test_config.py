@@ -1,6 +1,5 @@
 """Config system tests (behavioral parity with reference utils/config.py)."""
 
-import os
 import textwrap
 
 import pytest

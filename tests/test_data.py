@@ -4,11 +4,13 @@ sampler resume."""
 import numpy as np
 import pytest
 
-from paddlefleetx_tpu.data.batch_sampler import DistributedBatchSampler, DataLoader, collate_stack
+from paddlefleetx_tpu.data.batch_sampler import (
+    DistributedBatchSampler,
+    DataLoader,
+)
 from paddlefleetx_tpu.data.gpt_dataset import GPTDataset, LMEvalDataset, write_synthetic_corpus
 from paddlefleetx_tpu.data.indexed import (
     build_blending_indices,
-    build_doc_idx,
     build_sample_idx,
     build_shuffle_idx,
 )

@@ -8,7 +8,6 @@ import os
 
 import jax
 import numpy as np
-import pytest
 
 from paddlefleetx_tpu.core.engine import Engine
 from paddlefleetx_tpu.core.module import build_module

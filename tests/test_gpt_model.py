@@ -6,7 +6,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from paddlefleetx_tpu.models.common import count_params
 from paddlefleetx_tpu.models.gpt import model as gpt
 from paddlefleetx_tpu.models.gpt.config import GPTConfig, preset
 from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh

@@ -1,0 +1,72 @@
+"""tools/lint.py self-test (the reference's codestyle stack ships its own
+docstring-checker unit test, /root/reference/codestyle/test_docstring_checker.py
+— same idea here)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from lint import check_file  # noqa: E402
+
+
+def _lint_src(tmp_path, src):
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    return {code for _, _, code, _ in check_file(str(p))}
+
+
+def test_detects_unused_import(tmp_path):
+    assert "E2" in _lint_src(tmp_path, "import os\nimport sys\n\nprint(sys.argv)\n")
+
+
+def test_used_dotted_and_aliased_imports_ok(tmp_path):
+    src = (
+        "import jax.numpy as jnp\n"
+        "from typing import Optional\n\n"
+        "def f(x: Optional[int]):\n    return jnp.sin(x)\n"
+    )
+    assert _lint_src(tmp_path, src) == set()
+
+
+def test_string_annotation_counts_as_use(tmp_path):
+    src = (
+        "from typing import Mapping\n\n"
+        'def f(x: "Mapping[str, int]"):\n    return x\n'
+    )
+    assert _lint_src(tmp_path, src) == set()
+
+
+def test_detects_bare_except_eval_tab_trailing_ws_mutable_default(tmp_path):
+    src = (
+        "def f(x=[]):\n"
+        "\ttry:\n"
+        "\t\treturn eval('x')   \n"
+        "\texcept:\n"
+        "\t\tpass\n"
+    )
+    codes = _lint_src(tmp_path, src)
+    assert {"E3", "E4", "E5", "E7", "E8"} <= codes
+
+
+def test_noqa_suppresses(tmp_path):
+    assert _lint_src(tmp_path, "import os  # noqa\n") == set()
+
+
+def test_syntax_error_reported(tmp_path):
+    assert "E1" in _lint_src(tmp_path, "def broken(:\n")
+
+
+def test_repo_is_clean():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout[-2000:]
+
+
+def test_docstring_mention_does_not_mask_unused_import(tmp_path):
+    src = '"""Helpers for os-level work."""\nimport os\n\nprint(1)\n'
+    assert "E2" in _lint_src(tmp_path, src)

@@ -4,8 +4,6 @@ import jax
 import numpy as np
 
 from paddlefleetx_tpu.data.gpt_dataset import LambadaEvalDataset, LMEvalDataset
-from paddlefleetx_tpu.models.gpt import model as gpt
-from paddlefleetx_tpu.models.gpt.config import GPTConfig
 from paddlefleetx_tpu.models.gpt.evaluation import GPTEvalModule, LMEvalMetric
 from paddlefleetx_tpu.utils.config import AttrDict
 

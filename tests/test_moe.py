@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from paddlefleetx_tpu.models.gpt import model as gpt
 from paddlefleetx_tpu.models.gpt.config import GPTConfig

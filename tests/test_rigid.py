@@ -1,6 +1,5 @@
 """Rigid-body geometry tests: quaternion round-trips, rigid algebra, FAPE."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -4,8 +4,6 @@ tokenizer round-trip."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from paddlefleetx_tpu.data.tokenizers.t5_tokenizer import T5Tokenizer
 from paddlefleetx_tpu.models.gpt.model import ShardingCtx

@@ -19,7 +19,6 @@ per layout) and writes auto_tune_results.json next to the config output.
 """
 
 import argparse
-import itertools
 import json
 import os
 import re

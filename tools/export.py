@@ -16,7 +16,6 @@ from paddlefleetx_tpu.parallel.env import init_dist_env
 from paddlefleetx_tpu.parallel.seed import get_seed_tracker
 from paddlefleetx_tpu.utils.config import get_config, parse_args
 from paddlefleetx_tpu.utils.export import export_inference_model
-from paddlefleetx_tpu.utils.log import logger
 
 
 def main(argv=None):

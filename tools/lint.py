@@ -1,0 +1,207 @@
+"""Repo lint gate — stdlib-only (no ruff/flake8 in the image).
+
+The reference enforces code style via a pre-commit stack (pylint, cpplint,
+clang-format, a docstring checker: /root/reference/codestyle/); this is the
+TPU repo's equivalent, an AST + text checker covering the failure modes that
+actually bite:
+
+  E1  syntax error (file does not parse)
+  E2  unused import (module scope; __init__.py re-export files exempt)
+  E3  bare `except:`
+  E4  tab characters in indentation
+  E5  trailing whitespace
+  E6  missing newline at end of file
+  E7  `eval(` / `exec(` call (the reference's name-dispatch-by-eval is a
+      design smell SURVEY.md §5.6 explicitly replaces with typed registries)
+  E8  mutable default argument (def f(x=[]) / {} / set())
+
+Suppress a finding with `# noqa` on the offending line.
+Usage: python tools/lint.py [paths...]   (default: the whole repo)
+"""
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_DIRS = [
+    "paddlefleetx_tpu", "tools", "tests", "benchmarks", "examples", "tasks",
+]
+DEFAULT_FILES = ["bench.py", "__graft_entry__.py"]
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d not in
+                           ("__pycache__", ".jax_cache", "build", ".git")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+class ImportVisitor(ast.NodeVisitor):
+    """Collect module-scope imported names and every name USED anywhere."""
+
+    def __init__(self):
+        self.imports = {}  # name -> (lineno, shown)
+        self.used = set()
+        self._depth = 0
+
+    def visit_Import(self, node):
+        if self._depth == 0:
+            for a in node.names:
+                name = a.asname or a.name.split(".")[0]
+                self.imports[name] = (node.lineno, a.asname or a.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if self._depth == 0 and node.module != "__future__":
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                name = a.asname or a.name
+                self.imports[name] = (node.lineno, name)
+        self.generic_visit(node)
+
+    def _scoped(self, node):
+        self._depth += 1
+        self.generic_visit(node)
+        self._depth -= 1
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = _scoped
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        # mark the root of dotted access (jax.numpy -> jax)
+        n = node
+        while isinstance(n, ast.Attribute):
+            n = n.value
+        if isinstance(n, ast.Name):
+            self.used.add(n.id)
+        self.generic_visit(node)
+
+
+def check_file(path):
+    findings = []
+    with open(path, "rb") as f:
+        raw = f.read()
+    try:
+        text = raw.decode("utf-8")
+    except UnicodeDecodeError as e:
+        return [(path, 1, "E1", f"not utf-8: {e}")]
+
+    lines = text.split("\n")
+    noqa = {i + 1 for i, ln in enumerate(lines) if "# noqa" in ln}
+
+    def add(lineno, code, msg):
+        if lineno not in noqa:
+            findings.append((path, lineno, code, msg))
+
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 1, "E1", f"syntax error: {e.msg}")]
+
+    # E2 unused imports (skip __init__.py: re-exports are the point)
+    if os.path.basename(path) != "__init__.py":
+        v = ImportVisitor()
+        v.visit(tree)
+        # names referenced inside string ANNOTATIONS and __all__ only —
+        # harvesting every string constant would let a docstring mentioning
+        # "os" mask a genuinely unused `import os`
+        import re as _re
+
+        def _id_words(s):
+            return _re.findall(r"[A-Za-z_][A-Za-z0-9_]*", s[:2000])
+
+        string_refs = set()
+        ann_roots = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                for a in (
+                    args.args + args.posonlyargs + args.kwonlyargs
+                    + ([args.vararg] if args.vararg else [])
+                    + ([args.kwarg] if args.kwarg else [])
+                ):
+                    if a.annotation is not None:
+                        ann_roots.append(a.annotation)
+                if node.returns is not None:
+                    ann_roots.append(node.returns)
+            elif isinstance(node, ast.AnnAssign):
+                ann_roots.append(node.annotation)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "__all__":
+                        ann_roots.append(node.value)
+        for root in ann_roots:
+            for node in ast.walk(root):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    string_refs.update(_id_words(node.value))
+        for name, (lineno, shown) in v.imports.items():
+            if name not in v.used and name not in string_refs:
+                add(lineno, "E2", f"unused import '{shown}'")
+
+    for node in ast.walk(tree):
+        # E3 bare except
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            add(node.lineno, "E3", "bare 'except:' (catch a class)")
+        # E7 eval/exec
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("eval", "exec")
+        ):
+            add(node.lineno, "E7", f"'{node.func.id}()' call (use a typed registry)")
+        # E8 mutable default args
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    add(d.lineno, "E8", "mutable default argument")
+
+    # text-level checks
+    for i, ln in enumerate(lines, 1):
+        stripped_nl = ln.rstrip("\r")
+        indent = stripped_nl[: len(stripped_nl) - len(stripped_nl.lstrip())]
+        if "\t" in indent:
+            add(i, "E4", "tab in indentation")
+        if stripped_nl != stripped_nl.rstrip() and stripped_nl.strip():
+            add(i, "E5", "trailing whitespace")
+    if text and not text.endswith("\n"):
+        add(len(lines), "E6", "missing newline at end of file")
+
+    return findings
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    paths = argv or (
+        [os.path.join(REPO, d) for d in DEFAULT_DIRS]
+        + [os.path.join(REPO, f) for f in DEFAULT_FILES]
+    )
+    all_findings = []
+    n_files = 0
+    for path in iter_py_files(paths):
+        n_files += 1
+        all_findings.extend(check_file(path))
+    for path, lineno, code, msg in sorted(all_findings):
+        rel = os.path.relpath(path, REPO)
+        print(f"{rel}:{lineno}: {code} {msg}")
+    if all_findings:
+        print(f"\n{len(all_findings)} finding(s) in {n_files} files")
+        return 1
+    print(f"lint clean: {n_files} files")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

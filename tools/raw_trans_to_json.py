@@ -13,7 +13,6 @@ import argparse
 import glob
 import json
 import os
-import sys
 
 
 def iter_docs(path: str, per_line: bool):
