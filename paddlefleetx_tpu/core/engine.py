@@ -845,7 +845,12 @@ class Engine:
     def _write_meta(self, path: str, meta: Dict[str, Any]) -> None:
         # meta.json is the checkpoint's completeness marker (written last,
         # checked by latest_checkpoint): write atomically so a crash can
-        # never leave a truncated marker that wedges the restart loop
+        # never leave a truncated marker that wedges the restart loop.
+        # Multi-host: one writer — concurrent os.replace from N processes
+        # on shared storage is a needless race (reference: only dp_rank0
+        # saves, apis/io.py:28-151)
+        if jax.process_index() != 0:
+            return
         tmp = os.path.join(path, "meta.json.tmp")
         with open(tmp, "w") as f:
             json.dump(meta, f)

@@ -25,8 +25,11 @@ def init_dist_env(cfg, devices=None) -> jax.sharding.Mesh:
     initialize`` is invoked when a coordinator address is configured
     (the ``paddle.distributed.launch --master`` analogue).
     """
+    # _dist_initialized inspects the coordination client without touching
+    # the backend: jax.process_count() here would initialise XLA and make
+    # the subsequent initialize() call an error
     coord = os.environ.get("PFX_COORDINATOR_ADDRESS")
-    if coord and jax.process_count() == 1 and not _dist_initialized():
+    if coord and not _dist_initialized():
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=int(os.environ["PFX_NUM_PROCESSES"]),

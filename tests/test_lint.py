@@ -70,3 +70,9 @@ def test_repo_is_clean():
 def test_docstring_mention_does_not_mask_unused_import(tmp_path):
     src = '"""Helpers for os-level work."""\nimport os\n\nprint(1)\n'
     assert "E2" in _lint_src(tmp_path, src)
+
+
+def test_mutable_default_call_and_lambda(tmp_path):
+    assert "E8" in _lint_src(tmp_path, "def f(x=set()):\n    return x\n")
+    assert "E8" in _lint_src(tmp_path, "g = lambda x=[]: x\n")
+    assert "E8" in _lint_src(tmp_path, "def f(x=dict(a=1)):\n    return x\n")

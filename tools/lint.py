@@ -160,12 +160,17 @@ def check_file(path):
             and node.func.id in ("eval", "exec")
         ):
             add(node.lineno, "E7", f"'{node.func.id}()' call (use a typed registry)")
-        # E8 mutable default args
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        # E8 mutable default args (literals and bare set()/dict()/list() calls)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
             for d in list(node.args.defaults) + [
                 d for d in node.args.kw_defaults if d is not None
             ]:
-                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                mutable = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id in ("set", "dict", "list")
+                )
+                if mutable:
                     add(d.lineno, "E8", "mutable default argument")
 
     # text-level checks
