@@ -241,11 +241,11 @@ class Engine:
                 raise ValueError(
                     f"sep_zigzag requires Model.attn_impl=ring, got {attn_impl!r}"
                 )
-            if pp_degree > 1:
-                raise NotImplementedError(
-                    "sep_zigzag under pipeline parallelism is not wired "
-                    "(the 1F1B path does not thread attn_positions)"
-                )
+            # pp composes: ctx.attn_positions rides into the 1F1B chunk
+            # fns as a stage-replicated constant, and ring attention's
+            # inner shard_map nests against the ambient abstract mesh
+            # (parallel/ring_attention.py) — parity-tested pp2 x sep2 in
+            # tests/test_long_context.py
         pipeline = None
         if pp_degree > 1:
             from paddlefleetx_tpu.parallel.pipeline import PipelineConfig

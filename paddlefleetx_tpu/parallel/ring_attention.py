@@ -190,9 +190,17 @@ def ring_attention(
         out = acc / l_safe.transpose(0, 2, 1)[..., None]
         return out.astype(q.dtype)
 
+    # nested-map support (ring inside the 1F1B pipeline's stages-manual
+    # shard_map): the inner map must be built against the AMBIENT abstract
+    # mesh — passing the concrete Mesh from inside a manual context trips
+    # a context-mesh mismatch in jax 0.9
+    from jax.sharding import get_abstract_mesh
+
+    amesh = get_abstract_mesh()
+    inner_mesh = amesh if AXIS_SEP in amesh.axis_names else mesh
     return jax.shard_map(
         local_fn,
-        mesh=mesh,
+        mesh=inner_mesh,
         in_specs=(P(None, AXIS_SEP), P(None, AXIS_SEP), P(None, AXIS_SEP), P(AXIS_SEP)),
         out_specs=P(None, AXIS_SEP),
         axis_names={AXIS_SEP},
