@@ -1,0 +1,76 @@
+"""Golden-log docs stay honest: execute the cheap walkthroughs' commands
+verbatim and diff the step-loss lines against the doc's expected block
+(the reference's runnable-docs-as-tests pattern, SURVEY §4.4).
+
+Only the fast cases run here (ViT synthetic ~40 s, ERNIE base ~90 s); the
+345M/1.3B/sep4096 walkthroughs use the same machinery but cost minutes—
+their logs were captured the same way and drift would show up in the
+cheaper cases first (shared engine/logging/config stack).
+"""
+
+import os
+import re
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STEP_RE = re.compile(r"step \d+/\d+ loss: [\d.]+ lr: [\d.e+-]+ grad_norm: [\d.]+")
+
+
+def _doc_blocks(path):
+    """(bash_blocks, expected_step_lines) from a walkthrough doc.
+
+    Only bash blocks BEFORE the expected-output block are executed — the
+    sections after it point at real-chip/real-data launches."""
+    with open(path) as f:
+        text = f.read()
+    # tokenize every fenced block in document order: (language, body)
+    blocks = [
+        (m.group(1), m.group(2))
+        for m in re.finditer(r"```(\w*)\n(.*?)\n```", text, re.S)
+    ]
+    # bash blocks BEFORE the first expected-output block are the commands;
+    # the first non-bash block containing step lines is the golden log.
+    # Later (real-chip) sections may show their own sample logs, which a
+    # CPU run can never reproduce — never read past the first log block.
+    bash, expected = [], []
+    for lang, body in blocks:
+        if lang == "bash":
+            bash.append(body)
+        else:
+            expected = STEP_RE.findall(body)
+            if expected:
+                break
+    return bash, expected
+
+
+def _run_doc(path, timeout):
+    bash, expected = _doc_blocks(path)
+    assert bash and expected, path
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    log = ""
+    for block in bash:
+        out = subprocess.run(
+            ["bash", "-e", "-c", block], capture_output=True, text=True,
+            cwd=REPO, env=env, timeout=timeout,
+        )
+        assert out.returncode == 0, (path, block, out.stderr[-2000:])
+        log += out.stdout + out.stderr
+    got = STEP_RE.findall(log)
+    assert got == expected, (
+        f"{path}: doc log lines are stale.\nexpected: {expected}\ngot:      {got}"
+    )
+
+
+@pytest.mark.parametrize(
+    "doc,timeout",
+    [
+        ("projects/vit/docs/synthetic_ci.md", 600),
+        ("projects/ernie/docs/pretrain_base.md", 900),
+    ],
+)
+def test_doc_walkthrough_matches_fresh_run(doc, timeout):
+    _run_doc(os.path.join(REPO, doc), timeout)
