@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Full measurement suite for the moment the axon TPU tunnel comes up.
+#
+# The round-3 verdict's three chip-gated items in one command: the headline
+# bench (always-emit contract), the MFU-push knob sweep, the extra
+# north-star cases (GPT-1.3B / ViT-B / ViT-L), and the profiler op table.
+# Every piece carries its own deadline and emits honest rows on failure,
+# so a tunnel that drops mid-suite still leaves a usable record.
+#
+# Usage: bash benchmarks/chip_day.sh        (run when a probe succeeds)
+# The TPU watcher can invoke it automatically on tunnel recovery.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/chip_day
+TS=$(date -u +%Y%m%dT%H%M%S)
+LOG=benchmarks/chip_day/run_${TS}.log
+{
+  echo "== chip_day $TS =="
+  echo "== 1/4 bench.py (headline, default knobs) =="
+  BENCH_DEADLINE_S=600 python bench.py
+  echo "== 2/4 sweep_bench (all combos) =="
+  python benchmarks/sweep_bench.py --combos default --steps 10
+  echo "== 3/4 bench_extra (1.3B / ViT-B / ViT-L) =="
+  BENCH_EXTRA_DEADLINE_S=1800 python benchmarks/bench_extra.py
+  echo "== 4/4 profile_bench (op table -> benchmarks/chip_day/profile_$TS) =="
+  timeout 1200 python benchmarks/profile_bench.py \
+    --log_dir "benchmarks/chip_day/profile_${TS}" || echo "profile rc=$?"
+  echo "== chip_day done =="
+} 2>&1 | tee "$LOG"

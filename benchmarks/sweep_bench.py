@@ -63,7 +63,9 @@ def run_combo(name: str, env_over: dict, steps: int, deadline_s: float) -> dict:
         # a child wedged in native code past its own deadline machinery:
         # record the honest row and keep sweeping — one wedged run must
         # not eat the tunnel-up window
-        row.update({"wall_s": round(time.time() - t0, 1), "value": 0.0,
+        row.update({"wall_s": round(time.time() - t0, 1),
+                    "metric": "gpt345m_pretrain_throughput_per_chip",
+                    "value": 0.0,
                     "unit": "tokens/s/chip (combo wedged past hard timeout)",
                     "vs_baseline": 0.0})
         return row
@@ -76,7 +78,8 @@ def run_combo(name: str, env_over: dict, steps: int, deadline_s: float) -> dict:
         if isinstance(parsed, dict) and "metric" in parsed:
             row.update(parsed)
     if "value" not in row:
-        row.update({"value": 0.0, "unit": f"no JSON (rc={out.returncode})",
+        row.update({"metric": "gpt345m_pretrain_throughput_per_chip",
+                    "value": 0.0, "unit": f"no JSON (rc={out.returncode})",
                     "vs_baseline": 0.0})
     return row
 

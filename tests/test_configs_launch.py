@@ -80,11 +80,6 @@ def _run_train(config, overrides, timeout=540):
 
 
 @pytest.mark.slow
-def test_vit_synthetic_trains_via_cli():
-    _run_train("configs/vis/vit/ViT_tiny_ci_synthetic_1n8c_dp.yaml", [])
-
-
-@pytest.mark.slow
 def test_moco_synthetic_trains_via_cli():
     _run_train(
         "configs/vis/moco/mocov2_pt_in1k_1n8c.yaml",

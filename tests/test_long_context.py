@@ -230,87 +230,37 @@ def test_engine_zigzag_loss_parity(devices8, tmp_path):
     np.testing.assert_allclose(zz, ref, rtol=2e-4)
 
 
-def test_engine_zigzag_pp_loss_parity(devices8):
+def test_engine_zigzag_pp_loss_parity():
     """sep_zigzag composes with pipeline parallelism: ctx.attn_positions
     rides into the 1F1B chunk fns as a stage-replicated constant and ring
     attention nests its sep shard_map inside the stages-manual map.  The
     175B-class layout (VERDICT r3 item 6): pp2 x sep2 x dp2, interleaved
     virtual stages.
 
-    NB: runs with the persistent compilation cache DISABLED — the nested
-    (stages-manual ⊃ sep) shard_map executable fails XLA's persistent-cache
-    serialization round-trip on CPU: the first run passes and writes cache
-    entries, and any warm rerun SIGABRTs the process deserializing them
-    (verified on jax 0.9/CPU).  Compile-every-time costs ~30s; a crashed
-    suite costs the whole gate."""
-    import dataclasses
+    Subprocess-isolated (tests/zigzag_pp_worker.py): the nested
+    (stages-manual over sep) shard_map executable is fragile in a
+    long-lived CPU test process -- it fails the persistent-cache
+    serialization round-trip AND has aborted in XLA CPU runtime deep into
+    a full-suite process even cache-disabled (test-std, 2026-07-30); a
+    fresh process runs it reliably."""
+    import json
+    import os
+    import subprocess
+    import sys
 
-    from paddlefleetx_tpu.core.engine import Engine
-    from paddlefleetx_tpu.core.module import build_module
-    from paddlefleetx_tpu.parallel.env import init_dist_env
-    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
-
-    prev_cache = jax.config.jax_enable_compilation_cache
-    jax.config.update("jax_enable_compilation_cache", False)
-
-    def run(zigzag, sabotage=False):
-        cfg = AttrDict.from_nested(
-            {
-                "Global": {"global_batch_size": 8, "micro_batch_size": 4, "seed": 7},
-                "Engine": {
-                    "max_steps": 1, "eval_freq": 0, "logging_freq": 10**9,
-                    "mix_precision": {"enable": False},
-                    "save_load": {"save_steps": 0},
-                },
-                "Model": {
-                    "module": "GPTModule",
-                    "vocab_size": 64, "hidden_size": 32, "num_layers": 4,
-                    "num_attention_heads": 4, "max_position_embeddings": 32,
-                    "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
-                    "attn_impl": "ring", "dtype": "float32",
-                },
-                "Distributed": {
-                    "dp_degree": 2, "pp_degree": 2, "sep_degree": 2,
-                    "sep_zigzag": zigzag,
-                    "pipeline": {"micro_batches": 2, "virtual_pp_degree": 2},
-                },
-                "Optimizer": {"name": "FusedAdamW",
-                              "lr": {"name": "Constant", "learning_rate": 1e-4}},
-            }
-        )
-        cfg = process_configs(cfg, num_devices=8)
-        mesh = init_dist_env(cfg, devices=jax.devices()[:8])
-        module = build_module(cfg)
-        rng = np.random.default_rng(0)
-        batch = {
-            "tokens": rng.integers(0, 64, (8, 32)).astype(np.int64),
-            "labels": rng.integers(0, 64, (8, 32)).astype(np.int64),
-            "loss_mask": np.ones((8, 32), np.float32),
-            "position_ids": np.tile(np.arange(32), (8, 1)),
-        }
-        with mesh:
-            eng = Engine(cfg, module, mesh)
-            if zigzag:
-                # eager install must have fired with a non-identity perm
-                assert eng._zigzag_perm is not None
-                assert not np.array_equal(eng._zigzag_perm, np.arange(32))
-            if sabotage:
-                # negative control: what a stale positions-less graph would
-                # compute — causal mask by storage order on permuted data
-                eng.ctx = dataclasses.replace(eng.ctx, attn_positions=None)
-                eng._train_step = eng._build_train_step()
-            dev = eng._put_batch(batch)
-            eng.state, m = eng.train_step(eng.state, dev)
-            return float(m["loss"])
-
-    try:
-        ref = run(False)
-        zz = run(True)
-        bad = run(True, sabotage=True)
-    finally:
-        jax.config.update("jax_enable_compilation_cache", prev_cache)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = ""
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tests", "zigzag_pp_worker.py")],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=540,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    losses = json.loads(out.stdout.strip().splitlines()[-1])
+    ref, zz, bad = losses["ref"], losses["zz"], losses["bad"]
     # correct positions: parity up to permuted-reduction rounding
     np.testing.assert_allclose(zz, ref, atol=2e-5, rtol=0)
-    # wrong (storage-order) masking must NOT be parity — guards against the
-    # positions constant silently dropping out of the pipeline path
+    # wrong (storage-order) masking must NOT be parity -- guards against
+    # the positions constant silently dropping out of the pipeline path
     assert abs(bad - ref) > 2e-5, (bad, ref)
