@@ -134,6 +134,56 @@ def main() -> None:
         fp2 = check_replica_consistency(engine.state.params, name="restored")
         assert fp2 == fp, (hex(fp2), hex(fp))
 
+    # ---- phase 2: ring attention + zigzag with the sep axis SPANNING the
+    # process boundary (sep8 over 2x4 devices: K/V ppermute hops cross
+    # hosts every ring step — the multi-host long-context path)
+    cfg2 = AttrDict.from_nested(
+        {
+            "Global": {"global_batch_size": 2, "micro_batch_size": 2, "seed": 7},
+            "Engine": {
+                "max_steps": 1,
+                "eval_freq": 0,
+                "logging_freq": 10**9,
+                "mix_precision": {"enable": False},
+                "save_load": {"save_steps": 0},
+            },
+            "Model": {
+                "module": "GPTModule",
+                "vocab_size": 64,
+                "hidden_size": 32,
+                "num_layers": 2,
+                "num_attention_heads": 8,
+                "max_position_embeddings": 64,
+                "hidden_dropout_prob": 0.0,
+                "attention_probs_dropout_prob": 0.0,
+                "attn_impl": "ring",
+                "dtype": "float32",
+            },
+            "Distributed": {"dp_degree": 1, "sep_degree": 8, "sep_zigzag": True},
+            "Optimizer": {
+                "name": "FusedAdamW",
+                "lr": {"name": "Constant", "learning_rate": 1e-4},
+            },
+        }
+    )
+    cfg2 = process_configs(cfg2, num_devices=8)
+    mesh2 = init_dist_env(cfg2)
+    module2 = build_module(cfg2)
+    batch2 = {
+        "tokens": rng.integers(0, 64, (2, 64)).astype(np.int64),
+        "labels": rng.integers(0, 64, (2, 64)).astype(np.int64),
+        "loss_mask": np.ones((2, 64), np.float32),
+        "position_ids": np.tile(np.arange(64), (2, 1)),
+    }
+    with mesh2:
+        engine2 = Engine(cfg2, module2, mesh2)
+        dev2 = engine2._put_batch(batch2)
+        engine2.state, m2 = engine2.train_step(engine2.state, dev2)
+        loss2 = float(m2["loss"])
+        assert np.isfinite(loss2), loss2
+        fp3 = check_replica_consistency(engine2.state.params, name="ring_zz")
+    print(f"worker {proc_id}: ring_zz loss {loss2:.5f} fp {fp3:#010x}", flush=True)
+
     print(f"DIST_WORKER_OK {proc_id}", flush=True)
 
 
