@@ -121,6 +121,9 @@ def build_optimizer(cfg, count_scale: int = 1) -> tuple[optax.GradientTransforma
     else:
         schedule = base_schedule
     clip_cfg = cfg.pop("grad_clip", None) or {}
+    if isinstance(clip_cfg, (int, float)):
+        # shorthand: `grad_clip: 1.0` == global-norm clip at that norm
+        clip_cfg = {"name": "ClipGradByGlobalNorm", "clip_norm": float(clip_cfg)}
     clip_norm = clip_cfg.get("clip_norm") if clip_cfg.get("name") != "None" else None
     tx = OPTIMIZERS.get(name)(schedule=schedule, grad_clip=clip_norm, **cfg)
     return tx, schedule

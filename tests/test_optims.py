@@ -102,3 +102,23 @@ def test_adamw_bf16_moments():
     upd, st = tx.update(g, st, params)
     p2 = jax.tree.map(lambda p, u: p + u, params, upd)
     assert np.all(np.asarray(p2["w"]) < 1.0)
+
+
+def test_grad_clip_scalar_shorthand():
+    """`grad_clip: 1.0` (T5 base yaml form) == ClipGradByGlobalNorm."""
+    from paddlefleetx_tpu.optims.optimizer import build_optimizer
+    from paddlefleetx_tpu.utils.config import AttrDict
+
+    tx, _ = build_optimizer(AttrDict.from_nested({
+        "name": "AdamW",
+        "lr": {"name": "Constant", "learning_rate": 1e-3},
+        "grad_clip": 1.0,
+    }))
+    import jax.numpy as jnp
+
+    params = {"w": jnp.full((4,), 100.0)}
+    state = tx.init(params)
+    grads = {"w": jnp.full((4,), 100.0)}  # norm 200 >> 1 -> clipped
+    updates, _ = tx.update(grads, state, params)
+    # with clipping active the update magnitude is bounded by lr
+    assert float(jnp.abs(updates["w"]).max()) <= 1.1e-3
