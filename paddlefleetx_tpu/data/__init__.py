@@ -7,6 +7,7 @@ the reference's eval()-based name dispatch, data/__init__.py:69-119).
 from paddlefleetx_tpu.data import ernie_dataset as _ernie_dataset  # noqa: F401 (registers)
 from paddlefleetx_tpu.data import glue_dataset as _glue_dataset  # noqa: F401 (registers)
 from paddlefleetx_tpu.data import gpt_dataset as _gpt_dataset  # noqa: F401 (registers)
+from paddlefleetx_tpu.data import mlm_dataset as _mlm_dataset  # noqa: F401 (registers)
 from paddlefleetx_tpu.data import multimodal_dataset as _multimodal_dataset  # noqa: F401 (registers)
 from paddlefleetx_tpu.data import protein_dataset as _protein_dataset  # noqa: F401 (registers)
 from paddlefleetx_tpu.data import t5_dataset as _t5_dataset  # noqa: F401 (registers)

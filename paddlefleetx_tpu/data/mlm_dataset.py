@@ -1,0 +1,104 @@
+"""Generic masked-LM dataset over the Megatron-style mmap corpus.
+
+BERT-style dynamic masking for encoder pretraining (DebertaV2): sample
+fixed-length windows from the token stream and mask `mask_prob` of the
+positions with the standard 80/10/10 [MASK]/random/keep split.  Emits the
+{input_ids, labels, attention_mask} contract of
+``models/debertav2/model.py::mlm_loss`` (labels == -1 ignored).
+
+The reference ships DebertaV2 as modeling-only (consumed as an Imagen text
+encoder, SURVEY §2.3); this dataset is what makes the repo's
+``configs/debertav2/pretrain_debertav2_base.yaml`` genuinely trainable
+end-to-end rather than a modeling stub.
+
+Corpus format: ``<prefix>_ids.npy`` + ``<prefix>_idx.npz`` — the same
+files GPTDataset mmaps (``write_synthetic_corpus`` generates them).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+from paddlefleetx_tpu.utils.registry import DATASETS
+
+
+@DATASETS.register("MaskedLmDataset")
+class MaskedLmDataset:
+    def __init__(
+        self,
+        input_dir: str,
+        max_seq_len: int = 512,
+        vocab_size: int = 128100,
+        mask_prob: float = 0.15,
+        mask_token_id: int = 128000,
+        seed: int = 1234,
+        num_samples: int = 0,
+        mode: str = "Train",
+        split=(949, 50, 1),
+        **_unused,
+    ):
+        prefix = input_dir
+        if not os.path.exists(prefix + "_ids.npy"):
+            hits = sorted(glob.glob(os.path.join(input_dir, "*_ids.npy")))
+            if not hits:
+                raise FileNotFoundError(
+                    f"no <prefix>_ids.npy under {input_dir!r} "
+                    "(write_synthetic_corpus / preprocess_data format)"
+                )
+            prefix = hits[0][: -len("_ids.npy")]
+        self.tokens = np.load(prefix + "_ids.npy", mmap_mode="r")
+        self.seq_len = int(max_seq_len)
+        self.vocab_size = int(vocab_size)
+        self.mask_prob = float(mask_prob)
+        self.mask_id = int(mask_token_id)
+        self.seed = int(seed)
+        total = max(len(self.tokens) // self.seq_len, 1)
+        # mode-disjoint window ranges (GPTDataset's (949, 50, 1) split
+        # semantics): eval must never score windows the model trains on
+        w = np.asarray(split, np.float64)
+        bounds = np.concatenate([[0.0], np.cumsum(w / w.sum())])
+        i = {"Train": 0, "Eval": 1, "Test": 2}.get(mode, 0)
+        self._win0 = int(round(bounds[i] * total))
+        n_windows = max(int(round(bounds[i + 1] * total)) - self._win0, 1)
+        # epoch-loop past the range end like GPTDataset (train wants
+        # max_steps * batch samples; windows repeat deterministically)
+        self._len = int(num_samples) if num_samples else n_windows
+        self._n_windows = n_windows
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, idx: int) -> dict:
+        w = self._win0 + idx % self._n_windows
+        start = w * self.seq_len
+        ids = np.asarray(self.tokens[start:start + self.seq_len], dtype=np.int64)
+        if ids.size and int(ids.max()) >= self.vocab_size:
+            # a corpus tokenized with a larger vocab than the config
+            # declares must fail loudly, not silently scramble token ids
+            raise ValueError(
+                f"corpus token id {int(ids.max())} >= configured "
+                f"vocab_size {self.vocab_size} (wrong corpus or config?)"
+            )
+        pad = self.seq_len - len(ids)
+        if pad:
+            ids = np.concatenate([ids, np.zeros(pad, np.int64)])
+        attn = np.ones(self.seq_len, np.float32)
+        if pad:
+            attn[-pad:] = 0.0
+
+        rng = np.random.default_rng((self.seed, idx))
+        labels = np.full(self.seq_len, -1, np.int64)
+        input_ids = ids.copy()
+        maskable = attn > 0
+        draw = rng.random(self.seq_len)
+        chosen = maskable & (draw < self.mask_prob)
+        labels[chosen] = ids[chosen]
+        # 80% -> [MASK], 10% -> random token, 10% -> keep original
+        action = rng.random(self.seq_len)
+        input_ids[chosen & (action < 0.8)] = self.mask_id
+        rand = chosen & (action >= 0.8) & (action < 0.9)
+        input_ids[rand] = rng.integers(0, self.vocab_size, int(rand.sum()))
+        return {"input_ids": input_ids, "labels": labels, "attention_mask": attn}

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# DebertaV2-base MLM pretrain (see projects/debertav2/docs/pretrain_base.md)
+set -e
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/debertav2/pretrain_debertav2_base.yaml "$@"

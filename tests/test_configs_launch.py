@@ -64,6 +64,19 @@ def test_config_loads_and_module_builds(path, ndev):
     assert hasattr(module, "loss_fn")
 
 
+@pytest.mark.parametrize("path,ndev", ALL_CONFIGS)
+def test_config_optimizer_builds(path, ndev):
+    """build_optimizer accepts every shipped Optimizer block — catches
+    config-schema drift the module-build smoke can't (the T5 scalar
+    grad_clip crash lived here undetected until round 4)."""
+    from paddlefleetx_tpu.optims.optimizer import build_optimizer
+    from paddlefleetx_tpu.utils.config import get_config
+
+    cfg = get_config(os.path.join(REPO, path), num_devices=ndev)
+    tx, schedule = build_optimizer(cfg.Optimizer)
+    assert tx is not None and callable(schedule)
+
+
 def _run_train(config, overrides, timeout=540):
     env = dict(os.environ)
     env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"

@@ -284,3 +284,54 @@ def test_worker_loader_visit_determinism(tmp_path):
     np.testing.assert_array_equal(run1[1]["images"], run2[1]["images"])
     # epoch 2 re-augments (fresh visit)
     assert not np.array_equal(run1[0]["images"], run1[1]["images"])
+
+
+def test_masked_lm_dataset(tmp_path):
+    """MaskedLmDataset: 80/10/10 dynamic masking over the mmap corpus,
+    deterministic per (seed, idx), labels only at masked positions."""
+    import numpy as np
+
+    from paddlefleetx_tpu.data.gpt_dataset import write_synthetic_corpus
+    from paddlefleetx_tpu.data.mlm_dataset import MaskedLmDataset
+
+    write_synthetic_corpus(str(tmp_path / "c"), vocab_size=500, num_docs=8)
+    ds = MaskedLmDataset(
+        str(tmp_path), max_seq_len=64, vocab_size=500, mask_token_id=499,
+        num_samples=32,
+    )
+    assert len(ds) == 32
+    s = ds[3]
+    assert s["input_ids"].shape == (64,) and s["labels"].shape == (64,)
+    masked = s["labels"] >= 0
+    # ~15% masked, all labels in-vocab, unmasked positions untouched
+    assert 1 <= masked.sum() <= 32
+    assert (s["labels"][masked] < 500).all()
+    orig = ds[3]
+    np.testing.assert_array_equal(orig["input_ids"], s["input_ids"])  # deterministic
+    # at least the 80% bucket has [MASK] tokens when enough are chosen
+    if masked.sum() >= 8:
+        assert (s["input_ids"][masked] == 499).sum() >= 1
+    # a different index draws a different mask
+    assert not np.array_equal(ds[4]["labels"], s["labels"])
+
+
+def test_masked_lm_dataset_mode_split_and_vocab_guard(tmp_path):
+    import numpy as np
+    import pytest as _pytest
+
+    from paddlefleetx_tpu.data.gpt_dataset import write_synthetic_corpus
+    from paddlefleetx_tpu.data.mlm_dataset import MaskedLmDataset
+
+    write_synthetic_corpus(str(tmp_path / "c"), vocab_size=500, num_docs=32,
+                           mean_len=400)
+    train = MaskedLmDataset(str(tmp_path), max_seq_len=32, vocab_size=500,
+                            mask_token_id=499, mode="Train", split=(8, 2, 0))
+    ev = MaskedLmDataset(str(tmp_path), max_seq_len=32, vocab_size=500,
+                         mask_token_id=499, mode="Eval", split=(8, 2, 0))
+    # disjoint window ranges: eval windows start after every train window
+    assert ev._win0 >= train._win0 + train._n_windows
+    # out-of-vocab corpus fails loudly instead of silently wrapping ids
+    small = MaskedLmDataset(str(tmp_path), max_seq_len=32, vocab_size=100,
+                            mask_token_id=99)
+    with _pytest.raises(ValueError, match="vocab_size"):
+        small[0]
