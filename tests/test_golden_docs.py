@@ -2,12 +2,12 @@
 verbatim and diff the step-loss lines against the doc's expected block
 (the reference's runnable-docs-as-tests pattern, SURVEY §4.4).
 
-Only the fast cases run here (ViT synthetic ~40 s, ERNIE base ~90 s, T5
-base ~150 s, DebertaV2 base ~65 s, HelixFold tiny ~110 s); the
-345M/1.3B/sep4096/MoCo walkthroughs use the same machinery but cost many
-minutes or duplicate an existing CLI gate — their logs were captured the
-same way and drift would show up in the cheaper cases first (shared
-engine/logging/config stack).
+Only the fast cases run here (ViT ~40 s, ERNIE ~90 s, T5 ~150 s,
+DebertaV2 ~65 s, HelixFold tiny ~110 s, Imagen smoke ~95 s, CLIP smoke
+~40 s); the 345M/1.3B/sep4096/MoCo walkthroughs use the same machinery
+but cost many minutes or duplicate an existing CLI gate — their logs were
+captured the same way and drift would show up in the cheaper cases first
+(shared engine/logging/config stack).
 """
 
 import os
@@ -75,6 +75,8 @@ def _run_doc(path, timeout):
         ("projects/t5/docs/pretrain_base.md", 900),
         ("projects/debertav2/docs/pretrain_base.md", 900),
         ("projects/protein_folding/docs/tiny_smoke.md", 900),
+        ("projects/imagen/docs/text2im_smoke.md", 900),
+        ("projects/clip/docs/synthetic_smoke.md", 900),
     ],
 )
 def test_doc_walkthrough_matches_fresh_run(doc, timeout):
