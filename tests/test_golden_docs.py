@@ -77,6 +77,7 @@ def _run_doc(path, timeout):
         ("projects/protein_folding/docs/tiny_smoke.md", 900),
         ("projects/imagen/docs/text2im_smoke.md", 900),
         ("projects/clip/docs/synthetic_smoke.md", 900),
+        ("projects/gpt/docs/finetune_glue.md", 900),
     ],
 )
 def test_doc_walkthrough_matches_fresh_run(doc, timeout):
