@@ -82,3 +82,24 @@ def _run_doc(path, timeout):
 )
 def test_doc_walkthrough_matches_fresh_run(doc, timeout):
     _run_doc(os.path.join(REPO, doc), timeout)
+
+
+def test_generation_doc_matches_fresh_run():
+    """The generation walkthrough's sampled ids are seed-deterministic;
+    a drifted sampler/processor stack changes them."""
+    doc = os.path.join(REPO, "projects", "gpt", "docs", "generation.md")
+    with open(doc) as f:
+        text = f.read()
+    m = re.search(r"generated ids: (\[[^\]]*\])", text)
+    assert m, doc
+    bash = re.findall(r"```bash\n(.*?)```", text, re.S)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        ["bash", "-e", "-c", bash[0]], capture_output=True, text=True,
+        cwd=REPO, env=env, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    got = re.search(r"generated ids: (\[[^\]]*\])", out.stdout + out.stderr)
+    assert got, (out.stdout + out.stderr)[-1500:]
+    assert got.group(1) == m.group(1), (got.group(1), m.group(1))
