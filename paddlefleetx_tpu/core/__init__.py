@@ -1,0 +1,1 @@
+"""Engine layer: Engine/InferenceEngine/serving + BasicModule protocol."""

@@ -1,0 +1,1 @@
+"""Model families + BasicModule adapters (reference ppfleetx/models)."""

@@ -1,1 +1,3 @@
+"""DebertaV2 encoder (disentangled attention) family."""
+
 from paddlefleetx_tpu.models.debertav2.config import DebertaV2Config  # noqa: F401

@@ -1,0 +1,1 @@
+"""GPT decoder-only family: model, generation, MoE, finetune, eval."""

@@ -1,0 +1,1 @@
+"""Multimodal families: Imagen text-to-image, CLIP dual encoder."""

@@ -1,3 +1,5 @@
+"""Imagen: NHWC UNets + continuous-time diffusion + text encoders."""
+
 from paddlefleetx_tpu.models.multimodal.imagen.imagen import (  # noqa: F401
     ImagenConfig,
     UnetConfig,

@@ -1,0 +1,1 @@
+"""Protein folding (HelixFold/Evoformer) model family."""

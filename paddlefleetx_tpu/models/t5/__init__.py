@@ -1,1 +1,3 @@
+"""T5 encoder-decoder family (span-corruption pretrain)."""
+
 from paddlefleetx_tpu.models.t5.config import T5Config  # noqa: F401

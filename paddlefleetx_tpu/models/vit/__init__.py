@@ -1,3 +1,5 @@
+"""Vision Transformer family."""
+
 from paddlefleetx_tpu.models.vit.model import (  # noqa: F401
     PRESETS,
     ViTConfig,

@@ -1,0 +1,1 @@
+"""Custom TPU ops: Pallas flash attention, fused LayerNorm, chunked CE, top-p sampling."""

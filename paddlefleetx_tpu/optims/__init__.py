@@ -1,0 +1,1 @@
+"""Optimizers, LR schedules, grad clipping (reference ppfleetx/optims)."""

@@ -1,0 +1,1 @@
+"""Config system, logging, registries, export, compression, profiler."""

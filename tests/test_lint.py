@@ -76,3 +76,23 @@ def test_mutable_default_call_and_lambda(tmp_path):
     assert "E8" in _lint_src(tmp_path, "def f(x=set()):\n    return x\n")
     assert "E8" in _lint_src(tmp_path, "g = lambda x=[]: x\n")
     assert "E8" in _lint_src(tmp_path, "def f(x=dict(a=1)):\n    return x\n")
+
+
+def test_missing_module_docstring_in_package(tmp_path):
+    import os as _os
+
+    from lint import REPO as _REPO
+
+    pkg = _os.path.join(_REPO, "paddlefleetx_tpu")
+    p = _os.path.join(pkg, "_lint_selftest_tmp.py")
+    with open(p, "w") as f:
+        f.write("x = 1\n")
+    try:
+        codes = {c for _, _, c, _ in check_file(p)}
+    finally:
+        _os.remove(p)
+    assert "E9" in codes
+    # non-package files are exempt
+    q = tmp_path / "m.py"
+    q.write_text("x = 1\n")
+    assert "E9" not in {c for _, _, c, _ in check_file(str(q))}

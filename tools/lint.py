@@ -14,6 +14,8 @@ actually bite:
   E7  `eval(` / `exec(` call (the reference's name-dispatch-by-eval is a
       design smell SURVEY.md §5.6 explicitly replaces with typed registries)
   E8  mutable default argument (def f(x=[]) / {} / set())
+  E9  missing module docstring (package code under paddlefleetx_tpu/ only —
+      the reference's docstring-checker analogue, codestyle/ SURVEY §4.3)
 
 Suppress a finding with `# noqa` on the offending line.
 Usage: python tools/lint.py [paths...]   (default: the whole repo)
@@ -108,6 +110,11 @@ def check_file(path):
         tree = ast.parse(text, filename=path)
     except SyntaxError as e:
         return [(path, e.lineno or 1, "E1", f"syntax error: {e.msg}")]
+
+    # E9: package modules document themselves (tests/tools/benches exempt)
+    rel = os.path.relpath(path, REPO)
+    if rel.startswith("paddlefleetx_tpu") and ast.get_docstring(tree) is None:
+        add(1, "E9", "missing module docstring")
 
     # E2 unused imports (skip __init__.py: re-exports are the point)
     if os.path.basename(path) != "__init__.py":
