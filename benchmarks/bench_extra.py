@@ -25,6 +25,7 @@ import json
 import os
 import sys
 import time
+import traceback
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
@@ -64,11 +65,22 @@ def _gpt_cfg(n_dev: int, steps: int):
             "attention_probs_dropout_prob": 0.1,
             "attn_impl": "flash",
             "use_recompute": True,
-            "recompute_granularity": "selective",
+            "recompute_granularity": os.environ.get("BENCH_1P3B_REMAT", "selective"),
             "use_fused_ln": True,
             "use_chunked_ce": True,
         },
-        "Distributed": {},
+        # fp32 masters (5.2G) + bf16 mu (2.6G) + fp32 nu (5.2G) alone are
+        # 13G of the chip's 15.75G HBM; grads + activations push the step
+        # past 21G (measured OOM).  Parking the moments in pinned host
+        # memory (the reference's sharding offload=True,
+        # pretrain_gpt_1.3B_single_card_glm.yaml analogue) frees 7.8G on
+        # device at the price of a per-step host round-trip.
+        "Distributed": {
+            "sharding": {
+                "sharding_offload":
+                    os.environ.get("BENCH_1P3B_OFFLOAD", "1") == "1",
+            },
+        },
         "Optimizer": {
             "name": "FusedAdamW",
             "weight_decay": 0.01,
@@ -116,6 +128,10 @@ def _vit_cfg(n_dev: int, steps: int, large: bool):
             "num_layers": layers,
             "num_attention_heads": heads,
             "hidden_dropout_prob": 0.1,
+            # without remat the 12-layer scan stashes every block activation
+            # (443M apiece at b128) and the step OOMs; one extra forward is
+            # far cheaper than spilling (measured: OOM -> fits)
+            "use_recompute": os.environ.get("BENCH_VIT_REMAT", "1") == "1",
         },
         "Distributed": {},
         "Optimizer": {
@@ -286,6 +302,7 @@ def _child(argv) -> None:
             row = run_case(name, args.steps)
         except Exception as e:  # noqa: BLE001 — e.g. RESOURCE_EXHAUSTED on a
             # memory-tight case must not abort the remaining cases
+            traceback.print_exc(file=sys.stderr)
             row = {"metric": f"{name}_throughput_per_chip", "value": 0.0,
                    "unit": f"{CASES[name]['unit']} ({type(e).__name__})",
                    "vs_baseline": 0.0}

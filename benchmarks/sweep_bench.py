@@ -59,7 +59,7 @@ def run_combo(name: str, env_over: dict, steps: int, deadline_s: float) -> dict:
             env=env, capture_output=True, text=True,
             timeout=deadline_s + 120,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as te:
         # a child wedged in native code past its own deadline machinery:
         # record the honest row and keep sweeping — one wedged run must
         # not eat the tunnel-up window
@@ -68,6 +68,11 @@ def run_combo(name: str, env_over: dict, steps: int, deadline_s: float) -> dict:
                     "value": 0.0,
                     "unit": "tokens/s/chip (combo wedged past hard timeout)",
                     "vs_baseline": 0.0})
+        if te.stderr:
+            stderr = te.stderr
+            if isinstance(stderr, bytes):
+                stderr = stderr.decode("utf-8", "replace")
+            row["stderr_tail"] = stderr[-800:]
         return row
     row["wall_s"] = round(time.time() - t0, 1)
     for line in out.stdout.splitlines():
@@ -81,6 +86,11 @@ def run_combo(name: str, env_over: dict, steps: int, deadline_s: float) -> dict:
         row.update({"metric": "gpt345m_pretrain_throughput_per_chip",
                     "value": 0.0, "unit": f"no JSON (rc={out.returncode})",
                     "vs_baseline": 0.0})
+    if row.get("value") == 0.0 and out.stderr:
+        # a dead combo's cause (e.g. the OOM allocator report) must survive
+        # into the sweep record — round 4's no-remat rows died with nothing
+        # but an rc
+        row["stderr_tail"] = out.stderr[-800:]
     return row
 
 

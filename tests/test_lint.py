@@ -78,19 +78,18 @@ def test_mutable_default_call_and_lambda(tmp_path):
     assert "E8" in _lint_src(tmp_path, "def f(x=dict(a=1)):\n    return x\n")
 
 
-def test_missing_module_docstring_in_package(tmp_path):
-    import os as _os
+def test_missing_module_docstring_in_package(tmp_path, monkeypatch):
+    # hermetic: point lint.REPO at tmp_path instead of writing a temp
+    # module into the real package (which races test_repo_is_clean and
+    # leaks the file into the source tree on a hard kill)
+    import lint as _lint
 
-    from lint import REPO as _REPO
-
-    pkg = _os.path.join(_REPO, "paddlefleetx_tpu")
-    p = _os.path.join(pkg, "_lint_selftest_tmp.py")
-    with open(p, "w") as f:
-        f.write("x = 1\n")
-    try:
-        codes = {c for _, _, c, _ in check_file(p)}
-    finally:
-        _os.remove(p)
+    pkg = tmp_path / "paddlefleetx_tpu"
+    pkg.mkdir()
+    p = pkg / "mod.py"
+    p.write_text("x = 1\n")
+    monkeypatch.setattr(_lint, "REPO", str(tmp_path))
+    codes = {c for _, _, c, _ in check_file(str(p))}
     assert "E9" in codes
     # non-package files are exempt
     q = tmp_path / "m.py"
