@@ -49,7 +49,15 @@ def _gpt_cfg(n_dev: int, steps: int):
             "max_steps": steps,
             "eval_freq": 0,
             "logging_freq": 10**9,
-            "mix_precision": {"enable": True, "dtype": "bfloat16"},
+            "mix_precision": {
+                "enable": True,
+                "dtype": "bfloat16",
+                # bf16 grads (main_grad off) halve the 4.1G of fp32 grad
+                # accumulators — measured necessary to fit AdamW-complete
+                # 1.3B on one 15.75G chip (03:18Z window: b2+full-remat+
+                # offload still OOM'd by 853M with fp32 grads)
+                "main_grad": os.environ.get("BENCH_1P3B_MAIN_GRAD", "0") == "1",
+            },
             "save_load": {"save_steps": 0},
         },
         "Model": {
@@ -71,18 +79,22 @@ def _gpt_cfg(n_dev: int, steps: int):
         },
         # fp32 masters (5.2G) + bf16 mu (2.6G) + fp32 nu (5.2G) alone are
         # 13G of the chip's 15.75G HBM; grads + activations push the step
-        # past 21G (measured OOM).  Parking the moments in pinned host
-        # memory (the reference's sharding offload=True,
-        # pretrain_gpt_1.3B_single_card_glm.yaml analogue) frees 7.8G on
-        # device at the price of a per-step host round-trip.
+        # past 21G (measured OOM).  Host offload of the moments does NOT
+        # save the day either: the monolithic device_put stages every
+        # stacked nu leaf on-device at once (measured 03:24Z window: 4.1G
+        # of copy-start temps, still 1.19G over).  What fits is the
+        # reference's OTHER knob: multi_precision=False — bf16 params, no
+        # fp32 masters, moments in bf16 — ~10.4G peak including grads.
         "Distributed": {
             "sharding": {
                 "sharding_offload":
-                    os.environ.get("BENCH_1P3B_OFFLOAD", "1") == "1",
+                    os.environ.get("BENCH_1P3B_OFFLOAD", "0") == "1",
             },
         },
         "Optimizer": {
             "name": "FusedAdamW",
+            "multi_precision":
+                os.environ.get("BENCH_1P3B_MULTI_PRECISION", "0") == "1",
             "weight_decay": 0.01,
             "beta1": 0.9,
             "beta2": 0.95,

@@ -37,7 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddlefleetx_tpu.core.module import BasicModule
 from paddlefleetx_tpu.models.gpt.model import ShardingCtx
-from paddlefleetx_tpu.optims.optimizer import build_optimizer
+from paddlefleetx_tpu.optims.optimizer import build_optimizer, global_norm_f32
 from paddlefleetx_tpu.parallel.sharding import (
     drop_small_fsdp,
     logical_to_spec,
@@ -105,6 +105,16 @@ def opt_state_shardings(
         return jax.tree.map(lambda _: replicated, node)
 
     return rec(opt_state_shapes)
+
+
+def _cast_fp32_leaves(tree: Any, dtype) -> Any:
+    """Cast fp32 leaves to `dtype`, passing every other dtype through —
+    the one rule behind both low-precision param storage
+    (multi_precision=False) and low-precision grads (main_grad=False);
+    keep the two paths on this single definition so they cannot diverge."""
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if x.dtype == jnp.float32 else x, tree
+    )
 
 
 def _host_offload_supported(mesh: Mesh) -> bool:
@@ -176,6 +186,38 @@ class Engine:
         self.scale_incr_every = int(scale_cfg.get("incr_every_n_steps", 1000))
         self.scale_incr_ratio = float(scale_cfg.get("incr_ratio", 2.0))
         self.scale_decr_ratio = float(scale_cfg.get("decr_ratio", 0.5))
+        # main_grad=False (reference AMP O2 without main-grad, apis/amp.py):
+        # differentiate w.r.t. the compute-dtype cast of the params, so the
+        # gradient tree — and its per-microbatch accumulators — lives in
+        # bf16/fp16 instead of fp32.  Halves grad HBM (the lever that fits
+        # GPT-1.3B + AdamW on one 16G chip); costs grad-accumulation
+        # precision, so it defaults to True (fp32 main grads) like the
+        # reference.  The optimizer update still runs on fp32 masters; the
+        # global-norm clip upcasts inside its reduction (optims/optimizer.py
+        # global_norm_f32) so clipping stays exact.
+        self.main_grad = bool(mix.get("main_grad", True))
+        self.compute_dtype = model_dtype or str(mix.get("dtype", "bfloat16"))
+        if not self.main_grad:
+            logger.info(
+                "AMP main_grad=False: %s gradients", self.compute_dtype
+            )
+        # Optimizer.multi_precision=False (reference FusedAdamW
+        # multi_precision flag, optims/optimizer.py:31-56): NO fp32 master
+        # weights — params live in the compute dtype and the Adam moments
+        # follow it.  Frees 3 param-size fp32 buffers (masters + nu), the
+        # difference between GPT-1.3B fitting one 16G chip and not; costs
+        # update precision (bf16 weight updates round away ~1e-3-relative
+        # deltas), so it defaults to True like the reference.
+        self.multi_precision = bool(
+            cfg.get("Optimizer", {}).get("multi_precision", True)
+        )
+        self._param_cast = None
+        if not self.multi_precision and self.compute_dtype not in ("", "float32"):
+            self._param_cast = jnp.dtype(self.compute_dtype)
+            logger.info(
+                "multi_precision=False: %s params, no fp32 masters",
+                self.compute_dtype,
+            )
 
         dist = cfg.get("Distributed", {})
         sharding_cfg = dist.get("sharding", {})
@@ -384,6 +426,10 @@ class Engine:
         )
         def make_state(key):
             params = self.module.init_params(key)
+            if self._param_cast is not None:
+                # multi_precision=False: params (and the optax moments
+                # init'd from them) live in the compute dtype
+                params = _cast_fp32_leaves(params, self._param_cast)
             return TrainState(
                 step=jnp.zeros((), jnp.int32),
                 params=params,
@@ -464,6 +510,7 @@ class Engine:
         incr_ratio = self.scale_incr_ratio
         decr_ratio = self.scale_decr_ratio
         qat = self.qat_transform
+        grad_dtype = None if self.main_grad else jnp.dtype(self.compute_dtype)
 
         @functools.partial(
             jax.jit,
@@ -506,6 +553,12 @@ class Engine:
             # d/d(master), so differentiating from the quantized tree gives
             # the master-weight grads without re-quantizing per microbatch
             fwd_params = qat(state.params) if qat is not None else state.params
+            if grad_dtype is not None:
+                # main_grad=False: differentiate w.r.t. the compute-dtype
+                # cast, so grads (and the scan accumulator below) are bf16.
+                # The model's per-use .astype(dtype) then no-ops; non-fp32
+                # leaves (int tables, already-low-precision) pass through.
+                fwd_params = _cast_fp32_leaves(fwd_params, grad_dtype)
 
             def micro(carry, mb):
                 gacc, lacc, extra = carry
@@ -514,7 +567,7 @@ class Engine:
                 )(fwd_params, mb, extra)
                 return (jax.tree.map(jnp.add, gacc, grads), lacc + loss, new_extra), None
 
-            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            zeros = jax.tree.map(jnp.zeros_like, fwd_params)
             if accum > 1:
                 (gsum, lsum, new_extra), _ = jax.lax.scan(
                     micro,
@@ -529,7 +582,15 @@ class Engine:
                 )(fwd_params, batch, state.extra)
 
             if use_scaling:
-                grads = jax.tree.map(lambda g: g / loss_scale, grads)
+                # unscale in fp32 and STAY fp32: casting back to fp16 would
+                # flush exactly the small gradients loss scaling exists to
+                # keep representable (they were only representable scaled).
+                # main_grad=False still bought fp16 accumulators inside the
+                # microbatch scan, where grads are scaled; from the unscale
+                # boundary on, the clip/Adam path is fp32 anyway.
+                grads = jax.tree.map(
+                    lambda g: g.astype(jnp.float32) / loss_scale, grads
+                )
 
             if grad_shardings is not None:
                 # ZeRO-2: the dp grad-sum lands fsdp-sharded (XLA lowers
@@ -537,7 +598,7 @@ class Engine:
                 # optimizer update then all-gathers only the param updates
                 grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
 
-            gnorm = optax.global_norm(grads)
+            gnorm = global_norm_f32(grads)
             finite = jnp.isfinite(gnorm)
             safe = jax.tree.map(lambda g: jnp.where(finite, g, 0.0), grads)
             # host offload: stage the moments onto device for the update,

@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from paddlefleetx_tpu.optims.lr_scheduler import Schedule, build_lr_scheduler
@@ -27,6 +28,40 @@ def _no_decay_mask(params: Any) -> Any:
     """True where weight decay applies: skip 1-D params (biases, LN scales)
     — same partition the reference computes by name suffix."""
     return jax.tree.map(lambda p: p.ndim > 1, params)
+
+
+def global_norm_f32(tree: Any):
+    """Global L2 norm with the sum-of-squares accumulated in fp32.
+
+    optax.global_norm reduces each leaf in its own dtype; with bf16 grads
+    (``mix_precision.main_grad: False``) an 8-mantissa-bit running sum over
+    1e8+ elements is garbage.  The convert sits inside the reduction, so
+    XLA fuses it — no fp32 copy of any leaf is materialized."""
+    leaves = [x for x in jax.tree.leaves(tree) if x is not None]
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    )
+
+
+def clip_by_global_norm_f32(clip_norm: float) -> optax.GradientTransformation:
+    """Drop-in for optax.clip_by_global_norm with the norm in fp32 (exact
+    for fp32 grads, *correct* for bf16 grads; reference ClipGradByGlobalNorm
+    always computed the norm on fp32 main grads so never hit this)."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        g_norm = global_norm_f32(updates)
+        scale = jnp.minimum(1.0, clip_norm / jnp.maximum(g_norm, 1e-16))
+        updates = jax.tree.map(
+            lambda u: (u.astype(jnp.float32) * scale).astype(u.dtype), updates
+        )
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 @OPTIMIZERS.register("AdamW")
@@ -48,7 +83,7 @@ def adamw(
     (bf16's 8 mantissa bits would visibly distort the adaptive scale)."""
     txs = []
     if grad_clip:
-        txs.append(optax.clip_by_global_norm(grad_clip))
+        txs.append(clip_by_global_norm_f32(grad_clip))
     txs.append(
         optax.adamw(
             learning_rate=schedule,
@@ -74,7 +109,7 @@ def adam(
 ) -> optax.GradientTransformation:
     txs = []
     if grad_clip:
-        txs.append(optax.clip_by_global_norm(grad_clip))
+        txs.append(clip_by_global_norm_f32(grad_clip))
     txs.append(optax.adam(learning_rate=schedule, b1=beta1, b2=beta2, eps=epsilon))
     return optax.chain(*txs)
 
@@ -89,7 +124,7 @@ def momentum(
 ) -> optax.GradientTransformation:
     txs = []
     if grad_clip:
-        txs.append(optax.clip_by_global_norm(grad_clip))
+        txs.append(clip_by_global_norm_f32(grad_clip))
     if weight_decay:
         txs.append(optax.add_decayed_weights(weight_decay, mask=_no_decay_mask))
     txs.append(optax.sgd(learning_rate=schedule, momentum=momentum))
