@@ -237,6 +237,7 @@ def _child() -> None:
                 # never materializes (ops/chunked_ce.py) — try with bigger
                 # BENCH_BATCH once enabled
                 "use_chunked_ce": os.environ.get("BENCH_CHUNKED_CE", "0") == "1",
+                "scan_unroll": int(os.environ.get("BENCH_SCAN_UNROLL", 1)),
             },
             "Distributed": {},
             "Optimizer": {

@@ -78,6 +78,7 @@ def main(argv=None):
                 "recompute_granularity": os.environ.get("BENCH_REMAT", "selective"),
                 "use_fused_ln": os.environ.get("BENCH_FUSED_LN", "1") == "1",
                 "use_chunked_ce": os.environ.get("BENCH_CHUNKED_CE", "0") == "1",
+                "scan_unroll": int(os.environ.get("BENCH_SCAN_UNROLL", 1)),
             },
             "Distributed": {},
             "Optimizer": {

@@ -213,6 +213,16 @@ class Engine:
         )
         self._param_cast = None
         if not self.multi_precision and self.compute_dtype not in ("", "float32"):
+            if self.compute_dtype in ("float16", "fp16"):
+                # fp16 moments are unusable: typical g^2 ~1e-8 sits below
+                # fp16's subnormal floor (6e-8), so nu flushes to zero and
+                # the update explodes.  bf16 has the fp32 exponent range
+                # and is the measured-safe pairing.
+                raise ValueError(
+                    "Optimizer.multi_precision=False requires bfloat16 "
+                    "compute (fp16 Adam moments underflow); use "
+                    "mix_precision.dtype=bfloat16 or multi_precision=True"
+                )
             self._param_cast = jnp.dtype(self.compute_dtype)
             logger.info(
                 "multi_precision=False: %s params, no fp32 masters",

@@ -357,7 +357,10 @@ def transformer_stack(
     body_fn = _layer_remat(cfg, body)
 
     (x, aux), _ = jax.lax.scan(
-        body_fn, (x, jnp.zeros((), jnp.float32)), (layers_params, jnp.arange(cfg.num_layers))
+        body_fn,
+        (x, jnp.zeros((), jnp.float32)),
+        (layers_params, jnp.arange(cfg.num_layers)),
+        unroll=cfg.scan_unroll,
     )
     return x, aux
 

@@ -79,8 +79,12 @@ def adamw(
 ) -> optax.GradientTransformation:
     """``moment_dtype: bfloat16`` stores the FIRST moment in bf16 (optax
     mu_dtype), freeing one param-size fp32 buffer of HBM — the lever that
-    fits 1.3B-class models on a 16GB chip.  The second moment stays fp32
-    (bf16's 8 mantissa bits would visibly distort the adaptive scale)."""
+    fits 1.3B-class models on a 16GB chip.  With fp32 masters
+    (multi_precision=True, the default) the second moment stays fp32;
+    under ``Optimizer.multi_precision: False`` optax inits both moments
+    from the bf16 params, so nu is bf16 too — that full-bf16 trade is the
+    1.3B single-chip recipe (BENCH_NOTE.md) and is engine-gated to
+    bfloat16 compute (fp16 nu would underflow)."""
     txs = []
     if grad_clip:
         txs.append(clip_by_global_norm_f32(grad_clip))

@@ -66,3 +66,40 @@ def test_bf16_runs():
     out = flash_attention(q, q, q)
     assert out.dtype == jnp.bfloat16
     assert np.all(np.isfinite(np.asarray(out, np.float32)))
+
+
+def test_bf16_accuracy_vs_f32_reference():
+    """The kernels keep MXU dots in the input dtype (bf16 on the model
+    path) with fp32 accumulation; bf16 outputs must still track the fp32
+    XLA reference to bf16 resolution (~3 decimal digits)."""
+    b, s, n, d = 2, 256, 2, 64
+    key = jax.random.key(3)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, s, n, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, n, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, n, d), jnp.float32)
+    ct = jax.random.normal(kg, (b, s, n, d), jnp.float32)
+
+    ref = xla_attention(q, k, v, causal=True)
+    got = flash_attention(
+        q.astype(jnp.bfloat16), k.astype(jnp.bfloat16), v.astype(jnp.bfloat16)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref), rtol=0.0, atol=0.05
+    )
+
+    def loss_flash_bf16(q, k, v):
+        out = flash_attention(
+            q.astype(jnp.bfloat16), k.astype(jnp.bfloat16), v.astype(jnp.bfloat16)
+        )
+        return jnp.sum(out.astype(jnp.float32) * ct)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, causal=True) * ct)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_flash_bf16, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gr, gf):
+        np.testing.assert_allclose(
+            np.asarray(b_, np.float32), np.asarray(a), rtol=0.0, atol=0.35
+        )
