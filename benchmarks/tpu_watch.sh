@@ -17,9 +17,9 @@ PROBE_EVERY_S=${PROBE_EVERY_S:-120}
 TASKS=("$@")
 if [ $# -eq 0 ]; then TASKS=(gpt1p3b profile); fi
 for t in "${TASKS[@]}"; do
-  case "$t" in gpt1p3b|profile|headline) ;; *)
+  case "$t" in gpt1p3b|profile|headline|fusedbwd|blocks) ;; *)
     # a typo must not burn a scarce tunnel-up window on a no-op
-    echo "unknown task '$t' (have: gpt1p3b profile headline)" >&2; exit 2 ;;
+    echo "unknown task '$t' (have: gpt1p3b profile headline fusedbwd blocks)" >&2; exit 2 ;;
   esac
 done
 LOG=benchmarks/tpu_watch.log
@@ -47,6 +47,18 @@ run_task() {
       ;;
     headline)
       BENCH_DEADLINE_S=600 timeout 700 python bench.py
+      ;;
+    fusedbwd)
+      # A/B the fused single-kernel flash backward vs the split default
+      PFX_FLASH_BWD=fused BENCH_DEADLINE_S=600 timeout 700 python bench.py
+      ;;
+    blocks)
+      # block-size sweep at the bf16-dot balance (256 also covers the
+      # fused bwd's bigger VMEM footprint if 512 spills)
+      for bs in 256 1024; do
+        echo "== PFX_FLASH_BLOCK=$bs =="
+        PFX_FLASH_BLOCK=$bs BENCH_DEADLINE_S=400 timeout 500 python bench.py
+      done
       ;;
   esac
 }

@@ -91,9 +91,18 @@ def _nll_bwd(chunk, res, g):
         hit = (local >= 0) & (local < chunk)
         one = jax.nn.one_hot(jnp.where(hit, local, 0), chunk, dtype=p.dtype)
         dlogits = (p - jnp.where(hit[:, None], one, 0.0)) * gf[:, None]
-        # fp32 carry: a bf16 accumulator would compound rounding per chunk
-        dh = dh + dlogits @ w_c.astype(jnp.float32)
-        dw_c = (dlogits.T @ hidden2d.astype(jnp.float32)).astype(word.dtype)
+        # dlogits drops to the activation dtype for the two big matmuls
+        # (bf16 MXU at full rate, fp32 quarters it — same finding as the
+        # flash kernels); the dh CARRY stays fp32 so per-chunk rounding
+        # does not compound across the vocab scan
+        dlo = dlogits.astype(hidden2d.dtype)
+        dh = dh + jax.lax.dot(
+            dlo, w_c.astype(hidden2d.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        dw_c = jax.lax.dot(
+            dlo.T, hidden2d, preferred_element_type=jnp.float32
+        ).astype(word.dtype)
         return dh, dw_c
 
     offs = jnp.arange(wc.shape[0], dtype=jnp.int32) * chunk

@@ -17,6 +17,7 @@ exact) so the full test suite exercises the same code path on the CPU mesh.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -39,6 +40,18 @@ def _block_sizes(seq: int) -> Tuple[int, int]:
     # divisible by 512 use the largest dividing block so e.g. seq 768 keeps
     # flash support; small seqs run as one block (pre-existing behavior);
     # anything else reports unsupported and attention() falls back to XLA.
+    # PFX_FLASH_BLOCK overrides for chip sweeps (the bf16-dot change moves
+    # the compute/stream balance, so the optimum may shift).  A non-dividing
+    # override fails LOUDLY: silently falling back would burn a scarce
+    # tunnel-up benchmark window on mislabeled default-block data.
+    force = int(os.environ.get("PFX_FLASH_BLOCK") or 0)
+    if force:
+        if seq % force:
+            raise ValueError(
+                f"PFX_FLASH_BLOCK={force} does not divide seq {seq}; "
+                "unset it or pick a divisor"
+            )
+        return force, force
     for b in (512, 256, 128):
         if seq % b == 0:
             return b, b
@@ -133,6 +146,16 @@ def _flash_fwd(q, k, v, scale):
 
 # ---------------------------------------------------------------------------
 # Backward
+#
+# Two schedules, selected by PFX_FLASH_BWD (read at trace time):
+#   split (default): FlashAttention-2 style — a dq kernel swept over kv
+#     blocks and a dk/dv kernel swept over q blocks.  Each (i, j) tile
+#     computes s = q@k^T and p = exp(s - lse) TWICE (once per kernel).
+#   fused: one kernel, grid over kv blocks; each tile computes s/p once
+#     and emits the dv/dk contributions AND accumulates the dq rows
+#     in-place.  TPU Pallas grids execute sequentially, so the dq output
+#     block (the full [seq, d] row slab, revisited by every j) is
+#     accumulated correctly in VMEM and flushed when the bh row changes.
 # ---------------------------------------------------------------------------
 
 
@@ -217,6 +240,98 @@ def _dkv_kernel(
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
+    *, scale, block_q, block_k, seq
+):
+    kj = pl.program_id(1)
+    k = k_ref[0]  # [bk, d] native dtype; dots accumulate fp32
+    v = v_ref[0]
+    d = k.shape[-1]
+
+    # dq is the full [seq, d] row slab, revisited by every kv-block
+    # program of this bh row: zero it once, at the first kv block.  The
+    # slab is fp32 (out_shape below) so the cross-block read-modify-write
+    # accumulation rounds once at the end, not once per kv block — same
+    # fp32-carry rule as the split _dq_kernel and chunked_ce's dh.
+    @pl.when(kj == 0)
+    def _zero_dq():
+        dq_ref[0] = jnp.zeros((seq, d), dq_ref.dtype)
+
+    col_ids = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * block_q, block_q), :]
+        do = do_ref[0, pl.dslice(i * block_q, block_q), :]
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q), 0]
+        delta = delta_ref[0, pl.dslice(i * block_q, block_q), 0]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        row_ids = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        p = jnp.where(col_ids <= row_ids, jnp.exp(s - lse[:, None]), 0.0)
+        p_lo = p.astype(do.dtype)
+        dv_new = dv + jax.lax.dot_general(
+            p_lo, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dov = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dov - delta[:, None]) * scale).astype(q.dtype)
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dq_tile = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        sl = pl.dslice(i * block_q, block_q)
+        dq_ref[0, sl, :] = dq_ref[0, sl, :] + dq_tile  # fp32 slab
+        return dk_new, dv_new
+
+    first_q = (kj * block_k) // block_q
+    num_q = seq // block_q
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first_q, num_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused(q, k, v, do, lse, delta, scale, block_q, block_k):
+    bh, seq, d = q.shape
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            seq=seq,
+        ),
+        grid=(bh, seq // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, seq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, seq, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, seq, 1), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, seq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            # dq fp32: accumulated in-place across kv-block grid steps
+            jax.ShapeDtypeStruct(q.shape, jnp.float32),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq.astype(q.dtype), dk, dv
+
+
 def _flash_bwd(scale, res, g):
     q, k, v, out, lse = res
     do = g
@@ -224,6 +339,13 @@ def _flash_bwd(scale, res, g):
     block_q, block_k = _block_sizes(seq)
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[..., None]  # [bh, s, 1]
+
+    bwd_mode = os.environ.get("PFX_FLASH_BWD", "split")
+    if bwd_mode not in ("split", "fused"):
+        # a typo must not silently A/B split-vs-split on a chip window
+        raise ValueError(f"PFX_FLASH_BWD={bwd_mode!r}; valid: split, fused")
+    if bwd_mode == "fused":
+        return _flash_bwd_fused(q, k, v, do, lse, delta, scale, block_q, block_k)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, block_q=block_q, block_k=block_k),

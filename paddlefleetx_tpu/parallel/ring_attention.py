@@ -70,8 +70,13 @@ def _softmax_update(q, k_c, v_c, m, l, acc, q_pos, k_pos, causal, scale):
     p = jnp.exp(s - m_new[..., None])
     alpha = jnp.exp(m - m_new)
     l_new = l * alpha + p.sum(axis=-1)
+    # p in the V dtype: a bf16 p x bf16 v einsum runs the MXU at full
+    # rate (fp32 operands quarter it — same finding as the flash kernels,
+    # docs/performance_tuning.md op table); accumulation stays fp32 via
+    # preferred_element_type.  No-op for fp32 inputs.
     acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v_c, preferred_element_type=jnp.float32
+        "bhqk,bkhd->bqhd", p.astype(v_c.dtype), v_c,
+        preferred_element_type=jnp.float32,
     )
     return m_new, l_new, acc_new
 

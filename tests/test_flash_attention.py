@@ -68,6 +68,38 @@ def test_bf16_runs():
     assert np.all(np.isfinite(np.asarray(out, np.float32)))
 
 
+def test_fused_bwd_matches_split(monkeypatch):
+    """PFX_FLASH_BWD=fused (single-kernel dq+dk+dv) must reproduce the
+    split two-kernel backward exactly up to f32 accumulation order.
+
+    Block 64 at seq 256 gives 4 kv blocks, so the fused kernel's core
+    mechanism — the dq slab zeroed at kj==0 and read-modify-written
+    across kv-block grid steps — is actually exercised (a single-block
+    grid would pass even with broken cross-block accumulation)."""
+    monkeypatch.setenv("PFX_FLASH_BLOCK", "64")
+    b, s, n, d = 1, 256, 2, 32
+    key = jax.random.key(4)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, s, n, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, n, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, n, d), jnp.float32)
+    ct = jax.random.normal(kg, (b, s, n, d), jnp.float32)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) * ct)
+
+    monkeypatch.setenv("PFX_FLASH_BWD", "split")
+    g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("PFX_FLASH_BWD", "fused")
+    jax.clear_caches()  # the env knob is read at trace time
+    g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    jax.clear_caches()
+    for a, b_ in zip(g_split, g_fused):
+        np.testing.assert_allclose(
+            np.asarray(b_), np.asarray(a), rtol=1e-5, atol=1e-5
+        )
+
+
 def test_bf16_accuracy_vs_f32_reference():
     """The kernels keep MXU dots in the input dtype (bf16 on the model
     path) with fp32 accumulation; bf16 outputs must still track the fp32
