@@ -10,7 +10,8 @@
 # noticing the recovery.
 #
 # Usage: bash benchmarks/tpu_watch.sh [task ...]
-#   task: gpt1p3b | profile | headline  (default: gpt1p3b profile)
+#   task: gpt1p3b | profile | headline | fusedbwd | blocks
+#   (default: gpt1p3b profile)
 set -u
 cd "$(dirname "$0")/.."
 PROBE_EVERY_S=${PROBE_EVERY_S:-120}
@@ -53,11 +54,14 @@ run_task() {
       PFX_FLASH_BWD=fused BENCH_DEADLINE_S=600 timeout 700 python bench.py
       ;;
     blocks)
-      # block-size sweep at the bf16-dot balance (256 also covers the
-      # fused bwd's bigger VMEM footprint if 512 spills)
-      for bs in 256 1024; do
-        echo "== PFX_FLASH_BLOCK=$bs =="
-        PFX_FLASH_BLOCK=$bs BENCH_DEADLINE_S=400 timeout 500 python bench.py
+      # block-size sweep at the bf16-dot balance, for BOTH backward
+      # schedules (fused at 256 answers whether a smaller block rescues
+      # the fused kernel from a 512 VMEM spill)
+      for combo in "256 split" "1024 split" "256 fused"; do
+        set -- $combo
+        echo "== PFX_FLASH_BLOCK=$1 PFX_FLASH_BWD=$2 =="
+        PFX_FLASH_BLOCK=$1 PFX_FLASH_BWD=$2 BENCH_DEADLINE_S=400 \
+          timeout 500 python bench.py
       done
       ;;
   esac

@@ -205,28 +205,20 @@ def _dkv_kernel(
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.dslice(i * block_q, block_q), :]
-        do = do_ref[0, pl.dslice(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.dslice(i * block_q, block_q), 0]
-        delta = delta_ref[0, pl.dslice(i * block_q, block_q), 0]
-        s = scale * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        sl = pl.dslice(i * block_q, block_q)
+        q = q_ref[0, sl, :]
+        do = do_ref[0, sl, :]
+        lse = lse_ref[0, sl, 0]
+        delta = delta_ref[0, sl, 0]
         row_ids = i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
-        p = jnp.where(col_ids <= row_ids, jnp.exp(s - lse[:, None]), 0.0)
-        p_lo = p.astype(do.dtype)
+        p_lo, ds = _bwd_tile(q, k, v, do, lse, delta, row_ids, col_ids, scale)
         dv_new = dv + jax.lax.dot_general(
             p_lo, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        dov = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dov - delta[:, None]) * scale
         dk_new = dk + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         return dk_new, dv_new
 
@@ -238,6 +230,23 @@ def _dkv_kernel(
     dk, dv = jax.lax.fori_loop(first_q, num_q, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_tile(q, k, v, do, lse, delta, row_ids, col_ids, scale):
+    """Shared per-(q-block, kv-block) backward tile math: recompute the
+    masked softmax block from the saved lse and form ds.  Used by BOTH the
+    split _dkv_kernel and the fused kernel so the mask/scaling can never
+    diverge between schedules.  Returns (p_lo, ds) in the input dtype;
+    dots accumulate fp32."""
+    s = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    p = jnp.where(col_ids <= row_ids, jnp.exp(s - lse[:, None]), 0.0)
+    dov = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = (p * (dov - delta[:, None]) * scale).astype(q.dtype)
+    return p.astype(do.dtype), ds
 
 
 def _bwd_fused_kernel(
@@ -262,32 +271,24 @@ def _bwd_fused_kernel(
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.dslice(i * block_q, block_q), :]
-        do = do_ref[0, pl.dslice(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.dslice(i * block_q, block_q), 0]
-        delta = delta_ref[0, pl.dslice(i * block_q, block_q), 0]
-        s = scale * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        sl = pl.dslice(i * block_q, block_q)
+        q = q_ref[0, sl, :]
+        do = do_ref[0, sl, :]
+        lse = lse_ref[0, sl, 0]
+        delta = delta_ref[0, sl, 0]
         row_ids = i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
-        p = jnp.where(col_ids <= row_ids, jnp.exp(s - lse[:, None]), 0.0)
-        p_lo = p.astype(do.dtype)
+        p_lo, ds = _bwd_tile(q, k, v, do, lse, delta, row_ids, col_ids, scale)
         dv_new = dv + jax.lax.dot_general(
             p_lo, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        dov = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = (p * (dov - delta[:, None]) * scale).astype(q.dtype)
         dk_new = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dq_tile = jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        sl = pl.dslice(i * block_q, block_q)
         dq_ref[0, sl, :] = dq_ref[0, sl, :] + dq_tile  # fp32 slab
         return dk_new, dv_new
 
