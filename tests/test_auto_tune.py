@@ -22,6 +22,9 @@ def test_enumerate_layouts_covers_non_layout_knobs():
     assert any(c.get("recompute") == "full" for c in cands)
     assert any(c.get("accumulate") == 2 for c in cands)
     assert any(c.get("amp") == "bf16" for c in cands)
+    # precision-memory knobs (the 1.3B-fit levers)
+    assert any(c.get("main_grad") is False for c in cands)
+    assert any(c.get("multi_precision") is False for c in cands)
     # single device still tunes execution knobs
     assert len(enumerate_layouts(1)) >= 5
 
@@ -41,6 +44,13 @@ def test_overrides_for_execution_knobs():
     ov = overrides_for({"recompute": "none", "amp": "fp32"}, global_batch=8)
     assert "Model.use_recompute=False" in ov
     assert "Engine.mix_precision.enable=False" in ov
+    # precision-memory knobs
+    ov = overrides_for(
+        {"amp": "bf16", "main_grad": False, "multi_precision": False},
+        global_batch=8,
+    )
+    assert "Engine.mix_precision.main_grad=False" in ov
+    assert "Optimizer.multi_precision=False" in ov
 
 
 @pytest.mark.slow

@@ -62,6 +62,12 @@ def enumerate_layouts(n_devices: int, max_candidates: int = 12):
             dict(base, recompute="full"),
             dict(base, accumulate=2),
             dict(base, amp="bf16"),
+            # bf16 grads: frees one param-size fp32 buffer per microbatch
+            # accumulator (engine main_grad, measured 1.3B-fit lever)
+            dict(base, amp="bf16", main_grad=False),
+            # no fp32 masters at all: THE memory knob for models that
+            # otherwise do not fit the chip (bf16 params + moments)
+            dict(base, amp="bf16", main_grad=False, multi_precision=False),
         ]
     seen, uniq = set(), []
     for c in outs:
@@ -69,7 +75,17 @@ def enumerate_layouts(n_devices: int, max_candidates: int = 12):
         if key not in seen:
             seen.add(key)
             uniq.append(c)
-    return uniq[:max_candidates]
+    # the knob variants (6) must not crowd layout factorizations out of
+    # the cap — and a truncated grid must say so, not silently report a
+    # "best" from an incomplete sweep
+    limit = max_candidates + 6
+    if len(uniq) > limit:
+        print(
+            f"tuner grid truncated: {len(uniq)} candidates -> {limit} "
+            "(raise max_candidates to sweep all)",
+            file=sys.stderr,
+        )
+    return uniq[:limit]
 
 
 def overrides_for(c: dict, global_batch: int) -> list:
@@ -119,6 +135,10 @@ def overrides_for(c: dict, global_batch: int) -> list:
                 "Engine.mix_precision.enable=True",
                 f"Engine.mix_precision.dtype={dtype}",
             ]
+    if c.get("main_grad") is not None:
+        ov.append(f"Engine.mix_precision.main_grad={bool(c['main_grad'])}")
+    if c.get("multi_precision") is not None:
+        ov.append(f"Optimizer.multi_precision={bool(c['multi_precision'])}")
     return ov
 
 
