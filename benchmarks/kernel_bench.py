@@ -1,0 +1,109 @@
+"""Flash-attention kernel microbench: fwd and fwd+bwd wall time at the
+headline bench shape, across backward schedule x block size combos.
+
+Much cheaper per data point than a full bench.py run (~20 s vs ~3 min),
+so a short tunnel window can answer the kernel questions (does the
+bf16-dot change deliver? fused vs split? block optimum?) before the
+end-to-end re-measures.  One JSON row per combo to stdout and
+benchmarks/kernel_results.jsonl.
+
+  python benchmarks/kernel_bench.py [--bh 256] [--seq 1024] [--d 64]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bh", type=int, default=256)  # b16 x h16
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--d", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args(argv)
+
+    from paddlefleetx_tpu.utils.device import apply_platform_env
+
+    apply_platform_env()
+    from bench import wait_for_backend
+
+    platform = os.environ.get("PFX_PLATFORM", "").lower()
+    if platform in ("", "tpu", "axon") and not wait_for_backend():
+        print("tpu backend unreachable", file=sys.stderr)
+        sys.exit(1)
+
+    import jax
+    import jax.numpy as jnp
+
+    b, n = 16, args.bh // 16
+    shape = (b, args.seq, n, args.d)
+    dt = jnp.dtype(args.dtype)
+    kq, kk, kv, kg = jax.random.split(jax.random.key(0), 4)
+    q = jax.random.normal(kq, shape, jnp.float32).astype(dt)
+    k = jax.random.normal(kk, shape, jnp.float32).astype(dt)
+    v = jax.random.normal(kv, shape, jnp.float32).astype(dt)
+    ct = jax.random.normal(kg, shape, jnp.float32).astype(dt)
+
+    # attention FLOPs at this shape (fwd): 2 matmuls x 2*b*n*s^2*d, causal
+    # halves the useful work but the kernels still run the masked tiles'
+    # dots, so report dense FLOPs for the occupancy view
+    flops_fwd = 2 * 2 * b * n * args.seq * args.seq * args.d
+
+    def timed(fn, *xs):
+        jax.block_until_ready(fn(*xs))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn(*xs)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / args.iters
+
+    rows = []
+    for bwd_mode in ("split", "fused"):
+        for block in (256, 512):
+            if args.seq % block:
+                continue
+            os.environ["PFX_FLASH_BWD"] = bwd_mode
+            os.environ["PFX_FLASH_BLOCK"] = str(block)
+            jax.clear_caches()  # env knobs are read at trace time
+            from paddlefleetx_tpu.ops.flash_attention import flash_attention
+
+            fwd = jax.jit(lambda a, b_, c: flash_attention(a, b_, c))
+
+            def loss(a, b_, c):
+                return jnp.sum(
+                    flash_attention(a, b_, c).astype(jnp.float32)
+                    * ct.astype(jnp.float32)
+                )
+
+            grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            try:
+                t_fwd = timed(fwd, q, k, v)
+                t_all = timed(grad, q, k, v)
+            except Exception as e:  # noqa: BLE001 - report the combo, keep sweeping
+                rows.append({"bwd": bwd_mode, "block": block,
+                             "error": str(e)[:200]})
+                print(json.dumps(rows[-1]))
+                continue
+            row = {
+                "bwd": bwd_mode, "block": block, "dtype": args.dtype,
+                "fwd_ms": round(t_fwd * 1e3, 2),
+                "fwd_bwd_ms": round(t_all * 1e3, 2),
+                "fwd_tflops": round(flops_fwd / t_fwd / 1e12, 1),
+            }
+            rows.append(row)
+            print(json.dumps(row))
+
+    with open(os.path.join(ROOT, "benchmarks", "kernel_results.jsonl"), "a") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()
