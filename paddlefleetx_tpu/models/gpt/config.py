@@ -45,6 +45,12 @@ class GPTConfig:
     fuse_attn_qkv: bool = True
     # attention implementation: "xla" (jnp reference) | "flash" (Pallas kernel)
     attn_impl: str = "xla"
+    # flash kernel tile size (0 = auto: PFX_FLASH_BLOCK env, else the
+    # measured-best ladder in ops/flash_attention._block_sizes)
+    flash_block: int = 0
+    # flash backward schedule: "" = auto (PFX_FLASH_BWD env, else "split");
+    # "fused" = single-kernel dq+dk+dv (computes each softmax tile once)
+    flash_bwd: str = ""
     # unroll factor for the scan over layers (lax.scan unroll=N): trades
     # compile time + code size for removing the scan-boundary stacking
     # copies the profiler shows at ~4% of step time (chip_day op table).
@@ -89,6 +95,10 @@ class GPTConfig:
             raise ValueError(
                 f"scan_unroll {self.scan_unroll} must be >=1 and divide "
                 f"num_layers {self.num_layers}"
+            )
+        if self.flash_bwd not in ("", "split", "fused"):
+            raise ValueError(
+                f"flash_bwd {self.flash_bwd!r}; valid: '' (auto), split, fused"
             )
         object.__setattr__(self, "recompute_names", ",".join(names))
 

@@ -233,6 +233,8 @@ def _attention_block(
             dropout_key=dk,
             dropout_rate=cfg.attention_probs_dropout_prob,
             train=train,
+            flash_block=cfg.flash_block,
+            flash_bwd=cfg.flash_bwd,
         )
 
     if cfg.use_recompute and cfg.recompute_granularity == "core_attn":

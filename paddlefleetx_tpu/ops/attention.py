@@ -72,12 +72,17 @@ def attention(
     dropout_rate: float = 0.0,
     train: bool = False,
     scale: Optional[float] = None,
+    flash_block: int = 0,
+    flash_bwd: str = "",
 ) -> jax.Array:
-    """Dispatching attention entry point used by all models."""
+    """Dispatching attention entry point used by all models.
+
+    ``flash_block`` / ``flash_bwd`` pass through to the Pallas kernels
+    (0/"" = auto); surfaced as ``Model.flash_block`` / ``Model.flash_bwd``."""
     if impl == "flash" and bias is None and causal and scale is None:
         from paddlefleetx_tpu.ops.flash_attention import flash_attention, flash_supported
 
-        if not flash_supported(q.shape[1]):
+        if not flash_supported(q.shape[1], flash_block):
             # odd sequence lengths fall back to the XLA path (one warning)
             import warnings
 
@@ -89,7 +94,9 @@ def attention(
             # NB: attention-prob dropout is skipped on the flash path (the
             # reference likewise disables dropout when flash is active,
             # hybrid_model.py:284-301)
-            return flash_attention(q, k, v, causal=True)
+            return flash_attention(
+                q, k, v, causal=True, block=flash_block, bwd_schedule=flash_bwd
+            )
     out = xla_attention(
         q,
         k,

@@ -32,7 +32,7 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _block_sizes(seq: int) -> Tuple[int, int]:
+def _block_sizes(seq: int, block: int = 0) -> Tuple[int, int]:
     # 512x512 measured best on v5e at seq 1024 (8.7ms vs 10.8ms at 256x256
     # and 16.2ms at 128x128 for b16/h16/d64 fwd+bwd): fewer grid programs
     # amortize K/V HBM streaming; beats the stock jax.experimental Pallas
@@ -40,16 +40,18 @@ def _block_sizes(seq: int) -> Tuple[int, int]:
     # divisible by 512 use the largest dividing block so e.g. seq 768 keeps
     # flash support; small seqs run as one block (pre-existing behavior);
     # anything else reports unsupported and attention() falls back to XLA.
-    # PFX_FLASH_BLOCK overrides for chip sweeps (the bf16-dot change moves
-    # the compute/stream balance, so the optimum may shift).  A non-dividing
-    # override fails LOUDLY: silently falling back would burn a scarce
-    # tunnel-up benchmark window on mislabeled default-block data.
-    force = int(os.environ.get("PFX_FLASH_BLOCK") or 0)
+    # Model.flash_block (the ``block`` arg) or PFX_FLASH_BLOCK override the
+    # ladder for chip sweeps (the bf16-dot change moves the compute/stream
+    # balance, so the optimum may shift).  An invalid override fails LOUDLY
+    # in BOTH spellings: silently falling back (to the ladder or the XLA
+    # path) would burn a scarce tunnel-up benchmark window on mislabeled
+    # data blamed on the wrong knob.
+    force = int(block) or int(os.environ.get("PFX_FLASH_BLOCK") or 0)
     if force:
-        if seq % force:
+        if force < 0 or seq % force:
             raise ValueError(
-                f"PFX_FLASH_BLOCK={force} does not divide seq {seq}; "
-                "unset it or pick a divisor"
+                f"flash block {force} must be a positive divisor of seq "
+                f"{seq} (Model.flash_block / PFX_FLASH_BLOCK)"
             )
         return force, force
     for b in (512, 256, 128):
@@ -115,9 +117,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q, block_k)
     lse_ref[0, :, 0] = m + jnp.log(l_safe)
 
 
-def _flash_fwd(q, k, v, scale):
+def _flash_fwd(q, k, v, scale, block):
     bh, seq, d = q.shape
-    block_q, block_k = _block_sizes(seq)
+    block_q = block_k = block
     grid = (bh, seq // block_q)
 
     kernel = functools.partial(
@@ -333,18 +335,14 @@ def _flash_bwd_fused(q, k, v, do, lse, delta, scale, block_q, block_k):
     return dq.astype(q.dtype), dk, dv
 
 
-def _flash_bwd(scale, res, g):
+def _flash_bwd(scale, block, bwd_mode, res, g):
     q, k, v, out, lse = res
     do = g
     bh, seq, d = q.shape
-    block_q, block_k = _block_sizes(seq)
+    block_q = block_k = block
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[..., None]  # [bh, s, 1]
 
-    bwd_mode = os.environ.get("PFX_FLASH_BWD", "split")
-    if bwd_mode not in ("split", "fused"):
-        # a typo must not silently A/B split-vs-split on a chip window
-        raise ValueError(f"PFX_FLASH_BWD={bwd_mode!r}; valid: split, fused")
     if bwd_mode == "fused":
         return _flash_bwd_fused(q, k, v, do, lse, delta, scale, block_q, block_k)
 
@@ -396,14 +394,14 @@ def _flash_bwd(scale, res, g):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash_bhsd(q, k, v, scale):
-    out, _ = _flash_fwd(q, k, v, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, scale, block, bwd_mode):
+    out, _ = _flash_fwd(q, k, v, scale, block)
     return out
 
 
-def _flash_bhsd_fwd(q, k, v, scale):
-    out, lse = _flash_fwd(q, k, v, scale)
+def _flash_bhsd_fwd(q, k, v, scale, block, bwd_mode):
+    out, lse = _flash_fwd(q, k, v, scale, block)
     # Name lse so selective-remat policies can keep it: without a saved lse
     # the backward pass must re-run the forward kernel a SECOND time just to
     # regenerate it (observed as rematted_computation in traces). The out
@@ -417,27 +415,52 @@ def _flash_bhsd_fwd(q, k, v, scale):
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bwd)
 
 
-def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True):
-    """q,k,v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim]."""
+def _resolve_bwd_schedule(bwd_schedule) -> str:
+    mode = bwd_schedule or os.environ.get("PFX_FLASH_BWD", "split")
+    if mode not in ("split", "fused"):
+        # a typo must not silently A/B split-vs-split on a chip window
+        raise ValueError(f"flash bwd schedule {mode!r}; valid: split, fused")
+    return mode
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block: int = 0,
+    bwd_schedule: str = "",
+):
+    """q,k,v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim].
+
+    ``block`` (0 = auto: PFX_FLASH_BLOCK env, else the measured-best
+    ladder) and ``bwd_schedule`` ("" = auto: PFX_FLASH_BWD env, else
+    "split") surface as ``Model.flash_block`` / ``Model.flash_bwd`` —
+    product knobs, not just bench sweeps."""
     if not causal:
         raise NotImplementedError("only causal flash attention")
     b, s, n, d = q.shape
-    bq, bk = _block_sizes(s)
+    bq, bk = _block_sizes(s, block)
     if s % bq or s % bk:
         raise ValueError(
             f"flash_attention needs seq divisible by block size {bq}, got {s}; "
             "pad the sequence or use attn_impl='xla'"
         )
     scale = float(1.0 / (d**0.5))
+    mode = _resolve_bwd_schedule(bwd_schedule)
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * n, s, d)
 
-    out = _flash_bhsd(to_bh(q), to_bh(k), to_bh(v), scale)
+    out = _flash_bhsd(to_bh(q), to_bh(k), to_bh(v), scale, bq, mode)
     return out.reshape(b, n, s, d).transpose(0, 2, 1, 3)
 
 
-def flash_supported(seq: int) -> bool:
-    """True when the kernel's block tiling divides ``seq`` (dispatch helper)."""
-    bq, bk = _block_sizes(seq)
+def flash_supported(seq: int, block: int = 0) -> bool:
+    """True when the kernel's block tiling divides ``seq`` (dispatch helper).
+
+    With an explicit ``block`` this raises (loudly) on invalid values
+    rather than reporting unsupported — see _block_sizes."""
+    bq, bk = _block_sizes(seq, block)
     return seq % bq == 0 and seq % bk == 0
