@@ -33,36 +33,57 @@ IPS_RE = re.compile(r"ips: ([\d,]+) tokens/s \(([\d,]+)/device\)")
 LOSS_RE = re.compile(r"step \d+/\d+ loss: ([\d.]+)")
 
 
-def _ensure_synthetic_data(case: dict, name: str) -> list:
-    """Generate a tiny mmap corpus for the case (reference run_benchmark.sh
-    points cases at pre-staged data; we self-provision)."""
-    spec = case.get("synthetic_gpt_data")
-    if not spec:
-        return []
+def _provision(name: str, spec: dict, writer, writer_kwargs: dict,
+               marker_file: str, returns_prefix: bool):
+    """Cache-keyed synthetic corpus generation shared by all dataset
+    families: regenerate when the case spec changes, not on mere
+    existence.  Returns the value to point input_dir at (the corpus
+    prefix or its directory, per the dataset's convention)."""
     data_dir = os.path.join("/tmp", "pfx_bench_data", name)
-    # cache keyed on the spec, not mere existence: an edited case regenerates
+    prefix = os.path.join(data_dir, "corpus")
     spec_path = os.path.join(data_dir, "spec.json")
     spec_str = json.dumps(spec, sort_keys=True)
     stale = True
     if os.path.exists(spec_path):
         with open(spec_path) as f:
             stale = f.read() != spec_str
-    if stale or not os.path.exists(os.path.join(data_dir, "corpus_ids.npy")):
+    if stale or not os.path.exists(os.path.join(data_dir, marker_file)):
         os.makedirs(data_dir, exist_ok=True)
-        sys.path.insert(0, ROOT)
-        from paddlefleetx_tpu.data.gpt_dataset import write_synthetic_corpus
-
-        write_synthetic_corpus(
-            os.path.join(data_dir, "corpus"),
-            vocab_size=int(spec.get("vocab_size", 50304)),
-            num_docs=int(spec.get("num_docs", 64)),
-            mean_len=int(spec.get("mean_len", 600)),
-        )
+        writer(prefix, **writer_kwargs)
         with open(spec_path, "w") as f:
             f.write(spec_str)
+    return prefix if returns_prefix else data_dir
+
+
+def _ensure_synthetic_data(case: dict, name: str) -> list:
+    """Generate a tiny corpus for the case (reference run_benchmark.sh
+    points cases at pre-staged data; we self-provision).  Every knob in
+    the case spec is forwarded to the writer — an unknown knob fails
+    loudly rather than silently regenerating identical data."""
+    sys.path.insert(0, ROOT)  # before the writer imports below
+    espec = case.get("synthetic_ernie_data")
+    if espec:
+        from paddlefleetx_tpu.data.ernie_dataset import (
+            write_synthetic_sentence_corpus,
+        )
+
+        target = _provision(
+            name, espec, write_synthetic_sentence_corpus, dict(espec),
+            marker_file="corpus_ids.npy", returns_prefix=True,
+        )
+    else:
+        spec = case.get("synthetic_gpt_data")
+        if not spec:
+            return []
+        from paddlefleetx_tpu.data.gpt_dataset import write_synthetic_corpus
+
+        target = _provision(
+            name, spec, write_synthetic_corpus, dict(spec),
+            marker_file="corpus_ids.npy", returns_prefix=False,
+        )
     return [
-        f"Data.Train.dataset.input_dir={data_dir}",
-        f"Data.Eval.dataset.input_dir={data_dir}",
+        f"Data.Train.dataset.input_dir={target}",
+        f"Data.Eval.dataset.input_dir={target}",
     ]
 
 
