@@ -16,13 +16,15 @@ TS=$(date -u +%Y%m%dT%H%M%S)
 LOG=benchmarks/chip_day/run_${TS}.log
 {
   echo "== chip_day $TS =="
-  echo "== 1/4 bench.py (headline, default knobs) =="
+  echo "== 1/5 kernel_bench (flash fwd/bwd, split x fused x blocks) =="
+  timeout 600 python benchmarks/kernel_bench.py || echo "kernels rc=$?"
+  echo "== 2/5 bench.py (headline, default knobs) =="
   BENCH_DEADLINE_S=600 python bench.py
-  echo "== 2/4 sweep_bench (all combos) =="
+  echo "== 3/5 sweep_bench (all combos) =="
   python benchmarks/sweep_bench.py --combos default --steps 10
-  echo "== 3/4 bench_extra (1.3B / ViT-B / ViT-L) =="
+  echo "== 4/5 bench_extra (1.3B / ViT-B / ViT-L) =="
   BENCH_EXTRA_DEADLINE_S=1800 python benchmarks/bench_extra.py
-  echo "== 4/4 profile_bench (op table -> benchmarks/chip_day/profile_$TS) =="
+  echo "== 5/5 profile_bench (op table -> benchmarks/chip_day/profile_$TS) =="
   timeout 1200 python benchmarks/profile_bench.py \
     --log_dir "benchmarks/chip_day/profile_${TS}" || echo "profile rc=$?"
   echo "== chip_day done =="
