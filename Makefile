@@ -32,6 +32,10 @@ MID_EXTRA = tests/test_engine.py tests/test_generation.py tests/test_moe.py \
 test-mid:
 	python -m pytest $(FAST_FILES) $(MID_EXTRA) -q -m "not slow" -x
 	python -m pytest "tests/test_pipeline.py::test_pipeline_1f1b_train_loss_and_grads[2-extra1-4-1]" -q
+	# flash kernel parity (split/fused schedules, bf16 accuracy, config
+	# plumb) is a default-gate safety net despite the file's slow mark
+	# (~25s warm in interpret mode)
+	python -m pytest tests/test_flash_attention.py -q
 
 # standard suite: everything except Pallas interpret-mode / big-compile
 # files (marked slow)
