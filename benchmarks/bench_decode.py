@@ -1,0 +1,93 @@
+"""Decode-throughput bench: greedy KV-cache generation on GPT-345M.
+
+The training-side throughput record is deep (headline, sweep, 1.3B,
+ViT); this measures the INFERENCE side of the stack — the static
+lax.scan decode loop with a donated KV cache that also backs serving
+(`core/serving.py`).  No reference machine-readable baseline exists for
+decode, so the row reports absolute tokens/s (vs_baseline null) — an
+evidence artifact, not a comparison.
+
+One JSON row to stdout and benchmarks/results_decode.jsonl:
+  {"metric": "gpt345m_greedy_decode", "value": tok/s, ...}
+
+  python benchmarks/bench_decode.py [--batch 8] [--prompt 128] [--dec 128]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt", type=int, default=128)
+    ap.add_argument("--dec", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--hidden", type=int, default=int(os.environ.get("BENCH_DEC_HIDDEN", 1024)))
+    ap.add_argument("--layers", type=int, default=int(os.environ.get("BENCH_DEC_LAYERS", 24)))
+    args = ap.parse_args(argv)
+
+    from paddlefleetx_tpu.utils.device import apply_platform_env
+
+    apply_platform_env()
+    from bench import wait_for_backend
+
+    platform = os.environ.get("PFX_PLATFORM", "").lower()
+    row = {"metric": "gpt345m_greedy_decode", "value": 0.0,
+           "unit": "new tokens/s/chip", "vs_baseline": None}
+    if platform in ("", "tpu", "axon") and not wait_for_backend():
+        row["unit"] += " (tpu backend unreachable)"
+        print(json.dumps(row))
+        sys.exit(0)
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddlefleetx_tpu.models.gpt import model as gpt
+    from paddlefleetx_tpu.models.gpt.config import GPTConfig
+    from paddlefleetx_tpu.models.gpt.generation import GenerationConfig, generate
+
+    cfg = GPTConfig(
+        vocab_size=50304, hidden_size=args.hidden, num_layers=args.layers,
+        num_attention_heads=16,
+        max_position_embeddings=args.prompt + args.dec,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        dtype="bfloat16",
+    )
+    gen = GenerationConfig(decode_strategy="greedy_search", max_dec_len=args.dec)
+    params = gpt.init(cfg, jax.random.key(0))
+    prompts = jax.random.randint(
+        jax.random.key(1), (args.batch, args.prompt), 0, cfg.vocab_size
+    )
+
+    fn = jax.jit(lambda p, ids: generate(p, ids, cfg, gen))
+    try:
+        jax.block_until_ready(fn(params, prompts))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn(params, prompts)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.iters
+    except Exception as e:  # noqa: BLE001 - a crash must still emit the row
+        row["unit"] += f" ({type(e).__name__})"
+        print(json.dumps(row))
+        sys.exit(0)
+
+    row["value"] = round(args.batch * args.dec / dt, 1)
+    row["batch"] = args.batch
+    row["prompt_len"] = args.prompt
+    row["dec_len"] = args.dec
+    row["per_token_ms"] = round(dt / args.dec * 1e3, 2)
+    print(json.dumps(row))
+    with open(os.path.join(ROOT, "benchmarks", "results_decode.jsonl"), "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+if __name__ == "__main__":
+    main()

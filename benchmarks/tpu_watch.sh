@@ -10,7 +10,7 @@
 # noticing the recovery.
 #
 # Usage: bash benchmarks/tpu_watch.sh [task ...]
-#   task: gpt1p3b | profile | headline | fusedbwd | blocks | kernels
+#   task: gpt1p3b | profile | headline | fusedbwd | blocks | kernels | decode
 #   (default: gpt1p3b profile)
 set -u
 cd "$(dirname "$0")/.."
@@ -18,9 +18,9 @@ PROBE_EVERY_S=${PROBE_EVERY_S:-120}
 TASKS=("$@")
 if [ $# -eq 0 ]; then TASKS=(gpt1p3b profile); fi
 for t in "${TASKS[@]}"; do
-  case "$t" in gpt1p3b|profile|headline|fusedbwd|blocks|kernels) ;; *)
+  case "$t" in gpt1p3b|profile|headline|fusedbwd|blocks|kernels|decode) ;; *)
     # a typo must not burn a scarce tunnel-up window on a no-op
-    echo "unknown task '$t' (have: gpt1p3b profile headline fusedbwd blocks kernels)" >&2; exit 2 ;;
+    echo "unknown task '$t' (have: gpt1p3b profile headline fusedbwd blocks kernels decode)" >&2; exit 2 ;;
   esac
 done
 LOG=benchmarks/tpu_watch.log
@@ -52,6 +52,10 @@ run_task() {
     fusedbwd)
       # A/B the fused single-kernel flash backward vs the split default
       PFX_FLASH_BWD=fused BENCH_DEADLINE_S=600 timeout 700 python bench.py
+      ;;
+    decode)
+      # inference-side evidence: greedy KV-cache decode tokens/s
+      timeout 600 python benchmarks/bench_decode.py || echo "decode rc=$?"
       ;;
     kernels)
       # ~20s/datapoint kernel microbench: answers bf16-dot delivery,
