@@ -359,6 +359,15 @@ def forward(
 ) -> jax.Array:
     """Predict noise eps for x_t.  Returns [b, H, W, C]."""
     dtype = jnp.dtype(cfg.dtype)
+    if dtype != jnp.float32:
+        # AMP contract (core/engine.py fwd_params comment): the MODEL casts
+        # fp32 params to the compute dtype per use, so fp32 masters stay on
+        # the optimizer side and main_grad=False's pre-cast no-ops here.
+        # One tree-cast at entry covers every conv/attn weight below;
+        # group_norm still computes its statistics in fp32 regardless.
+        params = jax.tree.map(
+            lambda w: w.astype(dtype) if w.dtype == jnp.float32 else w, params
+        )
     b = x.shape[0]
     x = x.astype(dtype)
     if cfg.lowres_cond:

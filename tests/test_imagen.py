@@ -150,6 +150,26 @@ def test_p_losses_and_grad_step():
     assert np.mean(losses[-3:]) < np.mean(losses[:3])
 
 
+def test_p_losses_bf16_compute():
+    """AMP path: fp32 master params + bfloat16 compute dtype.  The unet
+    casts its fp32 params per use (unet.py forward entry), so the conv
+    lhs/rhs dtypes agree — regression for the bench_extra imagen case,
+    which trains under Engine mix_precision bf16."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, dtype="bfloat16")
+    params = imagen.init(TINY, jax.random.key(3))  # fp32 masters
+    loss = imagen.p_losses(params, _batch(), cfg, jax.random.key(0), train=True)
+    assert np.isfinite(float(loss))
+    g = jax.grad(
+        lambda p: imagen.p_losses(p, _batch(), cfg, jax.random.key(0), train=True)
+    )(params)
+    # grads arrive in the master dtype (fp32) and are finite
+    leaves = jax.tree.leaves(g)
+    assert all(x.dtype == jnp.float32 for x in leaves)
+    assert all(bool(jnp.isfinite(x).all()) for x in leaves)
+
+
 def test_sr_unet_p_losses():
     params = imagen.init(TINY_SR, jax.random.key(4))
     loss = imagen.p_losses(params, _batch(), TINY_SR, jax.random.key(0), train=True)
