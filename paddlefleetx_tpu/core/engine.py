@@ -196,6 +196,34 @@ class Engine:
         # global-norm clip upcasts inside its reduction (optims/optimizer.py
         # global_norm_f32) so clipping stays exact.
         self.main_grad = bool(mix.get("main_grad", True))
+        if not bool(mix.get("enable", True)):
+            if not self.main_grad and "main_grad" in mix:
+                # contradictory: main_grad=False is an AMP knob (it casts
+                # fwd params/grads to the compute dtype); with AMP off it
+                # would silently bf16-cast a nominally-fp32 run
+                raise ValueError(
+                    "mix_precision.main_grad=False requires "
+                    "mix_precision.enable=True (main_grad only controls "
+                    "the AMP gradient dtype)"
+                )
+            self.main_grad = True
+        if (
+            bool(mix.get("enable", True))
+            and "dtype" in mix
+            and model_dtype
+            and model_dtype != str(mix["dtype"])
+        ):
+            # a pinned Model.dtype silently overrides the AMP dtype
+            # (compute_dtype = model_dtype first), which turns an
+            # explicitly-requested mix_precision.dtype into a mislabeled
+            # run — r4's ZeRO-3 dryrun logged "main_grad=False: float32
+            # gradients" for exactly this; fail loudly in every spelling
+            raise ValueError(
+                f"Model.dtype={model_dtype} contradicts "
+                f"mix_precision.dtype={mix['dtype']}: pin one or make them "
+                "agree (the model dtype wins, so the AMP request would be "
+                "silently ignored)"
+            )
         self.compute_dtype = model_dtype or str(mix.get("dtype", "bfloat16"))
         if not self.main_grad:
             logger.info(

@@ -46,18 +46,36 @@ def _block_sizes(seq: int, block: int = 0) -> Tuple[int, int]:
     # in BOTH spellings: silently falling back (to the ladder or the XLA
     # path) would burn a scarce tunnel-up benchmark window on mislabeled
     # data blamed on the wrong knob.
-    force = int(block) or int(os.environ.get("PFX_FLASH_BLOCK") or 0)
+    env = os.environ.get("PFX_FLASH_BLOCK") or "0"
+    try:
+        env_block = int(env)
+    except ValueError:
+        raise ValueError(
+            f"PFX_FLASH_BLOCK={env!r} is not an integer; pass a positive "
+            f"divisor of seq (e.g. 256) or unset it"
+        ) from None
+    force = int(block) or env_block
     if force:
         if force < 0 or seq % force:
             raise ValueError(
                 f"flash block {force} must be a positive divisor of seq "
                 f"{seq} (Model.flash_block / PFX_FLASH_BLOCK)"
             )
+        if force % 8:
+            # sublane alignment: a non-multiple-of-8 tile would surface as
+            # an opaque Mosaic lowering error deep in the compile
+            raise ValueError(
+                f"flash block {force} must be a multiple of 8 (TPU "
+                f"sublane tiling; Model.flash_block / PFX_FLASH_BLOCK)"
+            )
         return force, force
     for b in (512, 256, 128):
         if seq % b == 0:
             return b, b
-    if seq < 256:
+    if seq < 256 and seq % 8 == 0:
+        # single-block path needs sublane alignment too: a non-multiple-
+        # of-8 seq would die in Mosaic lowering, so it falls through to
+        # the unsupported return below and attention() uses XLA instead
         return seq, seq
     return 256, 256  # does not divide seq -> flash_supported() False
 

@@ -128,6 +128,23 @@ def test_main_grad_off_bf16_grads_train(tmp_path, devices8):
     assert np.mean(runs[False][-3:]) < np.mean(runs[False][:3]) - 0.1
 
 
+def test_main_grad_off_requires_amp(tmp_path, devices8):
+    """mix_precision.enable=False + main_grad=False is contradictory
+    (main_grad only controls the AMP gradient dtype): the engine raises
+    instead of silently bf16-casting a nominally-fp32 run (advisor r4)."""
+    import pytest
+
+    cfg = tiny_cfg(tmp_path)
+    cfg.Engine.mix_precision = AttrDict.from_nested(
+        {"enable": False, "main_grad": False}
+    )
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    with pytest.raises(ValueError, match="main_grad"):
+        with mesh:
+            Engine(cfg, module, mesh)
+
+
 def test_multi_precision_off_bf16_params_train(tmp_path, devices8):
     """Optimizer.multi_precision=False (reference FusedAdamW flag): bf16
     params, no fp32 masters, moments follow — trains, and checkpoint

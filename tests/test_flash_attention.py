@@ -100,6 +100,62 @@ def test_fused_bwd_matches_split(monkeypatch):
         )
 
 
+def test_fused_bwd_matches_split_bf16(monkeypatch):
+    """Same fused-vs-split parity in bfloat16 — the dtype the model path
+    actually runs.  With fp32 inputs the kernel-internal bf16 downcasts
+    (p_lo/ds in _bwd_tile) are no-ops, so only a bf16 run can catch a
+    dtype-handling divergence between the two backward schedules."""
+    monkeypatch.setenv("PFX_FLASH_BLOCK", "64")
+    b, s, n, d = 1, 256, 2, 32
+    key = jax.random.key(6)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, s, n, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, s, n, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, s, n, d), jnp.bfloat16)
+    ct = jax.random.normal(kg, (b, s, n, d), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True).astype(jnp.float32)
+            * ct.astype(jnp.float32)
+        )
+
+    monkeypatch.setenv("PFX_FLASH_BWD", "split")
+    jax.clear_caches()  # the env knob is read at trace time
+    g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("PFX_FLASH_BWD", "fused")
+    jax.clear_caches()
+    g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    jax.clear_caches()
+    for a, b_ in zip(g_split, g_fused):
+        # bf16 grads: both schedules accumulate in f32 but round per-tile,
+        # so allow bf16-epsilon-scale slack (2^-8 relative)
+        np.testing.assert_allclose(
+            np.asarray(b_, np.float32), np.asarray(a, np.float32),
+            rtol=2e-2, atol=2e-2
+        )
+
+
+def test_flash_block_env_validation(monkeypatch):
+    """Invalid PFX_FLASH_BLOCK values fail loudly with labeled errors, not
+    an int() ValueError or an opaque Mosaic compile error (advisor r4)."""
+    import pytest
+
+    from paddlefleetx_tpu.ops.flash_attention import _block_sizes
+
+    monkeypatch.setenv("PFX_FLASH_BLOCK", "banana")
+    with pytest.raises(ValueError, match="PFX_FLASH_BLOCK"):
+        _block_sizes(256)
+    monkeypatch.setenv("PFX_FLASH_BLOCK", "4")  # divides 256, not mult of 8
+    with pytest.raises(ValueError, match="multiple of 8"):
+        _block_sizes(256)
+    monkeypatch.setenv("PFX_FLASH_BLOCK", "96")  # mult of 8, no divisor
+    with pytest.raises(ValueError, match="divisor"):
+        _block_sizes(256)
+    monkeypatch.setenv("PFX_FLASH_BLOCK", "64")
+    assert _block_sizes(256) == (64, 64)
+
+
 def test_config_knobs_reach_kernel():
     """Model.flash_block / Model.flash_bwd thread through the GPT model to
     the kernel (loss parity with the defaults proves the plumbed kernel

@@ -75,17 +75,18 @@ def enumerate_layouts(n_devices: int, max_candidates: int = 12):
         if key not in seen:
             seen.add(key)
             uniq.append(c)
-    # the knob variants (6) must not crowd layout factorizations out of
-    # the cap — and a truncated grid must say so, not silently report a
-    # "best" from an incomplete sweep
-    limit = max_candidates + 6
-    if len(uniq) > limit:
+    # the cap is authoritative: callers bound sweep wall-time by it, so
+    # the knob variants spend slots WITHIN max_candidates (they sit right
+    # after the lead layout, so they survive truncation and tail layout
+    # factorizations drop first) — and a truncated grid must say so, not
+    # silently report a "best" from an incomplete sweep
+    if len(uniq) > max_candidates:
         print(
-            f"tuner grid truncated: {len(uniq)} candidates -> {limit} "
-            "(raise max_candidates to sweep all)",
+            f"tuner grid truncated: {len(uniq)} candidates -> "
+            f"{max_candidates} (raise max_candidates to sweep all)",
             file=sys.stderr,
         )
-    return uniq[:limit]
+    return uniq[:max_candidates]
 
 
 def overrides_for(c: dict, global_batch: int) -> list:
