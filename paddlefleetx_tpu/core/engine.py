@@ -133,7 +133,16 @@ class Engine:
     """Train/eval engine over one mesh (reference EagerEngine + AutoEngine
     collapse into this: pjit IS the auto-parallel path)."""
 
-    def __init__(self, cfg, module: BasicModule, mesh: Mesh, mode: str = "train"):
+    def __init__(self, cfg, module: BasicModule, mesh: Mesh, mode: str = "train",
+                 abstract_init: bool = False):
+        """abstract_init=True builds the engine WITHOUT materializing any
+        state: params/opt-state become ShapeDtypeStructs carrying their
+        shardings, and only ``memory_report`` (AOT compile + per-device
+        memory analysis) is usable.  This is the fit-check path for
+        layouts larger than the local machine — e.g. validating the
+        reference's 6.7B recipe (projects/gpt/docs/hybrid_parallel.md:
+        47-54) against a per-chip HBM budget on a virtual mesh."""
+        self.abstract_init = abstract_init
         self.cfg = cfg
         self.module = module
         self.mesh = mesh
@@ -392,6 +401,66 @@ class Engine:
         return self._eval_step(state, dev_batch, it)
 
     # ------------------------------------------------------------------
+    def memory_report(self, batch_shapes: Dict[str, Any]) -> Dict[str, int]:
+        """AOT-compile the train step and return PER-DEVICE memory stats.
+
+        ``batch_shapes`` maps batch names to (shape, dtype) pairs (or any
+        objects with .shape/.dtype).  Works with ``abstract_init=True`` to
+        fit-check layouts bigger than this machine: XLA's SPMD program is
+        identical on every device, so the compiled executable's memory
+        analysis IS the per-device HBM budget (reference counterpart: the
+        published 6.7B recipe sizing, projects/gpt/docs/
+        hybrid_parallel.md:47-54, which is validated only by running it)."""
+        import numpy as _np
+
+        def _abs(v):
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                shape, dtype = v.shape, v.dtype
+            else:
+                shape, dtype = v
+            return jax.ShapeDtypeStruct(
+                tuple(shape), jnp.dtype(dtype), sharding=self.batch_spec
+            )
+
+        batch_abs = {k: _abs(v) for k, v in batch_shapes.items()}
+        compiled = self._train_step.lower(self.state, batch_abs).compile()
+        ma = compiled.memory_analysis()
+        required = ("argument_size_in_bytes", "output_size_in_bytes",
+                    "temp_size_in_bytes", "alias_size_in_bytes")
+        if ma is None or not all(hasattr(ma, n) for n in required):
+            # memory_analysis() is backend-dependent and may return None:
+            # a silent 0-byte peak would report every layout as fitting
+            # every budget — the exact wrong answer for this tool
+            raise RuntimeError(
+                "compiled.memory_analysis() unavailable on this backend; "
+                "memory_report cannot produce a trustworthy byte budget"
+            )
+        stats = {n: int(getattr(ma, n)) for n in required}
+        if hasattr(ma, "generated_code_size_in_bytes"):
+            stats["generated_code_size_in_bytes"] = int(
+                ma.generated_code_size_in_bytes
+            )
+
+        def shard_bytes(tree):
+            total = 0
+            for leaf in jax.tree.leaves(tree):
+                shape = leaf.sharding.shard_shape(leaf.shape)
+                total += int(_np.prod(shape, dtype=_np.int64)) * leaf.dtype.itemsize
+            return total
+
+        stats["params_bytes_per_device"] = shard_bytes(self.state.params)
+        stats["opt_state_bytes_per_device"] = shard_bytes(self.state.opt_state)
+        # donated state aliases its output; peak live ~= args + out - alias
+        # + temps (XLA's own accounting, conservative for CPU/TPU alike)
+        stats["peak_bytes_per_device_est"] = (
+            stats.get("argument_size_in_bytes", 0)
+            + stats.get("output_size_in_bytes", 0)
+            - stats.get("alias_size_in_bytes", 0)
+            + stats.get("temp_size_in_bytes", 0)
+        )
+        return stats
+
+    # ------------------------------------------------------------------
     def _init_state(self) -> TrainState:
         key = get_seed_tracker().params_key()
 
@@ -480,6 +549,21 @@ class Engine:
                 if self.use_loss_scaling
                 else None,
             )
+
+        if self.abstract_init:
+            # fit-check path: the state is its shapes + shardings, nothing
+            # is allocated (make_state.eval_shape reuses the jit's
+            # out_shardings, so the abstract tree matches the real one
+            # leaf-for-leaf, pinned-host placements included)
+            shapes = make_state.eval_shape(key)
+            n_params = sum(
+                x.size for x in jax.tree.leaves(shapes.params)
+            )
+            logger.info(
+                f"abstract init: {n_params/1e6:.1f}M params (no allocation) "
+                f"over {self.mesh.size} devices"
+            )
+            return shapes
 
         t0 = time.time()
         state = make_state(key)
@@ -829,8 +913,18 @@ class Engine:
             logger.warning(f"metrics_file write failed (disabling): {e}")
             self.metrics_file = ""
 
+    def _require_concrete(self, op: str) -> None:
+        if self.abstract_init:
+            raise RuntimeError(
+                f"Engine was built with abstract_init=True (fit-check "
+                f"mode): state holds shapes, not arrays, so {op} is "
+                "unavailable — only memory_report() works; rebuild the "
+                "Engine without abstract_init to train"
+            )
+
     def fit(self, train_loader: Iterable, eval_loader: Optional[Iterable] = None):
         """Training loop (reference fit/_fit_impl eager_engine.py:422-520)."""
+        self._require_concrete("fit")
         t_last = time.time()
         window_tokens = 0
         eval_iter = iter(eval_loader) if eval_loader is not None else None
@@ -907,6 +1001,7 @@ class Engine:
         return self.state
 
     def evaluate(self, loader: Iterable, iters: Optional[int] = None) -> float:
+        self._require_concrete("evaluate")
         # loaders iterate forever (epoch-looping sampler): always bound
         iters = iters if iters is not None else self.eval_iters
         losses = []
@@ -970,6 +1065,7 @@ class Engine:
                 raise err
 
     def save(self, path: Optional[str] = None):
+        self._require_concrete("save")
         import orbax.checkpoint as ocp
 
         step = int(self.state.step)
@@ -1025,6 +1121,7 @@ class Engine:
         return path
 
     def load(self, path: str):
+        self._require_concrete("load")
         import orbax.checkpoint as ocp
 
         self.wait_for_save()  # never restore over a half-written save

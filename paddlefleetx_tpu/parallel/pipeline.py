@@ -176,7 +176,11 @@ def pipelined_stack(
 
 
 def _tree_axpy(acc, new, w):
-    return jax.tree.map(lambda a, g: a + w * g, acc, new)
+    # cast back to the accumulator dtype: w is fp32 (a liveness mask), so
+    # the product would silently promote a bf16 grad accumulator to fp32
+    # and break the scan carry's dtype invariant under multi_precision=
+    # False / main_grad=False (bf16 params or grads)
+    return jax.tree.map(lambda a, g: a + (w * g).astype(a.dtype), acc, new)
 
 
 def _run_1f1b(fns, pcfg: PipelineConfig, mesh, params, batch):
@@ -280,7 +284,8 @@ def _run_1f1b(fns, pcfg: PipelineConfig, mesh, params, batch):
                 ge = _tree_axpy(ge, gep, w)
                 gh = _tree_axpy(gh, ghp, w)
                 gl = jax.tree.map(
-                    lambda a, g, _v=v: a.at[_v].add(w * g), gl, glv
+                    lambda a, g, _v=v: a.at[_v].add((w * g).astype(a.dtype)),
+                    gl, glv,
                 )
                 numer = numer + jnp.where(is_last & b_live, nr, 0.0).astype(jnp.float32)
                 gxs.append(jnp.where(b_live, gx, jnp.zeros_like(gx)))
@@ -370,7 +375,10 @@ def _1f1b_fwd(fns, pcfg, mesh, params, batch):
 
 def _1f1b_bwd(fns, pcfg, mesh, res, gbar):
     grads, bzeros = res
-    return jax.tree.map(lambda g: gbar * g, grads), bzeros
+    # gbar is an fp32 scalar (numer is fp32); keep cotangents in the param
+    # dtype so bf16-param runs (multi_precision=False) get bf16 grads that
+    # match the engine's bf16 accumulator carry instead of promoting
+    return jax.tree.map(lambda g: (gbar * g).astype(g.dtype), grads), bzeros
 
 
 pipeline_loss_1f1b.defvjp(_1f1b_fwd, _1f1b_bwd)

@@ -128,6 +128,34 @@ def test_main_grad_off_bf16_grads_train(tmp_path, devices8):
     assert np.mean(runs[False][-3:]) < np.mean(runs[False][:3]) - 0.1
 
 
+def test_abstract_init_memory_report(tmp_path, devices8):
+    """Engine(abstract_init=True): no state is allocated (leaves are
+    ShapeDtypeStructs) and memory_report returns per-device byte stats
+    from the AOT-compiled train step — the 6.7B fit-check path
+    (benchmarks/fit_6p7b.py) at tiny dims."""
+    import numpy as np_
+
+    cfg = tiny_cfg(tmp_path)
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    with mesh:
+        engine = Engine(cfg, module, mesh, abstract_init=True)
+        assert all(
+            isinstance(x, jax.ShapeDtypeStruct)
+            for x in jax.tree.leaves(engine.state.params)
+        )
+        seq = int(cfg.Model.max_position_embeddings)
+        b = int(cfg.Global.global_batch_size)
+        stats = engine.memory_report({
+            "tokens": ((b, seq), np_.int32),
+            "labels": ((b, seq), np_.int32),
+            "loss_mask": ((b, seq), np_.float32),
+            "position_ids": ((b, seq), np_.int32),
+        })
+    assert stats["params_bytes_per_device"] > 0
+    assert stats["peak_bytes_per_device_est"] >= stats["params_bytes_per_device"]
+
+
 def test_main_grad_off_requires_amp(tmp_path, devices8):
     """mix_precision.enable=False + main_grad=False is contradictory
     (main_grad only controls the AMP gradient dtype): the engine raises

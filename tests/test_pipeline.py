@@ -147,6 +147,41 @@ def test_pipeline_1f1b_train_loss_and_grads(devices8, pp, extra, mb, vpp):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=5e-4, atol=1e-5)
 
 
+def test_pipeline_1f1b_bf16_params_grads(devices8):
+    """bf16 params (multi_precision=False pairing): the 1F1B schedule must
+    return bf16 cotangents matching the param dtype — the fp32 liveness
+    mask and fp32 gbar scalar would otherwise promote the scan's grad
+    carry and kill the compile (found by the 6.7B fit check, r5)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, dtype="bfloat16")
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16), gpt.init(TINY, jax.random.key(0))
+    )
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, cfg.vocab_size)
+    batch = {
+        "tokens": tokens,
+        "labels": jnp.roll(tokens, -1, 1),
+        "loss_mask": jnp.ones((8, 16), jnp.float32),
+    }
+    ref_loss = gpt.loss_fn(params, batch, cfg, train=True)
+
+    mesh, rules, ctx = _ctx(devices8, 2, {"dp_degree": 4}, microbatches=2)
+    shardings = tree_logical_to_sharding(gpt.gpt_logical_axes(cfg), mesh, rules)
+    p_sharded = jax.device_put(params, shardings)
+    with mesh:
+        loss, g = jax.jit(
+            jax.value_and_grad(
+                lambda p, b: gpt.loss_fn(p, b, cfg, ctx=ctx, train=True)
+            )
+        )(p_sharded, batch)
+    # bf16 fwd: schedules agree to bf16 tolerance
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-2)
+    for leaf in jax.tree.leaves(g):
+        assert leaf.dtype == jnp.bfloat16
+        assert bool(jnp.isfinite(leaf.astype(jnp.float32)).all())
+
+
 def test_pipeline_1f1b_masked_loss(devices8):
     """Partial loss_mask: the in-schedule numerator / global denominator
     decomposition must reproduce the global masked mean."""
