@@ -338,6 +338,8 @@ def write_synthetic_corpus(
     prefix: str, vocab_size: int = 50304, num_docs: int = 64, mean_len: int = 600, seed: int = 0
 ) -> str:
     """Generate a tiny corpus in the mmap format (for tests and benches)."""
+    parent = os.path.dirname(os.path.abspath(prefix))
+    os.makedirs(parent, exist_ok=True)
     rng = np.random.default_rng(seed)
     lens = rng.integers(mean_len // 2, mean_len * 2, num_docs).astype(np.int32)
     # Zipf-ish unigram distribution: gives the model learnable structure
