@@ -54,6 +54,24 @@ def model_flops_per_token(hidden: int, layers: int, vocab: int, seq: int) -> flo
     return per * 3.0
 
 
+def host_fence(out):
+    """Wait for ALL device work behind ``out`` by fetching ONE element.
+
+    The axon runtime's ``jax.block_until_ready`` has been observed
+    returning while device work is still pending (see the loss host-fetch
+    in _child below; the 2026-07-31 19:00Z decode rows showing 19M-160M
+    "tok/s" were this exact artifact) — a device->host copy is the only
+    fence that cannot lie.  The one-element slice depends on the full
+    output buffer, so the 2-4 byte transfer completes only after the
+    whole computation; shared by bench_decode.py and kernel_bench.py so
+    there is exactly one audited fence implementation."""
+    import jax
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return np.asarray(leaf.ravel()[:1])
+
+
 def wait_for_backend() -> bool:
     """Re-poll the TPU backend inside a bounded window.  Default is 120 s:
     short enough to stay well inside the driver's capture budget (round 3
@@ -238,6 +256,15 @@ def _child() -> None:
                 # BENCH_BATCH once enabled
                 "use_chunked_ce": os.environ.get("BENCH_CHUNKED_CE", "0") == "1",
                 "scan_unroll": int(os.environ.get("BENCH_SCAN_UNROLL", 1)),
+                # measured on-chip 2026-07-31 via the end-to-end headline
+                # A/B (the trustworthy loss-host-fetch timing): 34,940
+                # tok/s with fused/512 vs 33,757 with the old split/256 —
+                # +3.5%.  Fall back to the auto block ladder when 512
+                # does not divide the (override) seq, so shrink-knob CI
+                # smokes and odd seqs keep flash support.
+                "flash_block": int(os.environ.get(
+                    "BENCH_FLASH_BLOCK", 512 if seq % 512 == 0 else 0)),
+                "flash_bwd": os.environ.get("BENCH_FLASH_BWD", "fused"),
             },
             "Distributed": {},
             "Optimizer": {

@@ -105,12 +105,17 @@ def run_decode_case(name: str, args, params_cache: dict) -> dict:
     )
     key = jax.random.key(2)
 
+    from bench import host_fence
+
     fn = jax.jit(lambda p, ids, k: generate(p, ids, cfg, gen, key=k))
-    jax.block_until_ready(fn(params, prompts, key))  # compile + warm
+    # one-element host fetch per iteration (bench.host_fence): the axon
+    # runtime's block_until_ready has been observed returning while
+    # device work is still pending — the 2026-07-31 19:00Z rows showing
+    # 19M-160M "tok/s" were pure dispatch cost.
+    host_fence(fn(params, prompts, key))  # compile + warm
     t0 = time.perf_counter()
     for _ in range(args.iters):
-        out = fn(params, prompts, key)
-    jax.block_until_ready(out)
+        host_fence(fn(params, prompts, key))
     dt = (time.perf_counter() - t0) / args.iters
 
     return {
@@ -119,6 +124,7 @@ def run_decode_case(name: str, args, params_cache: dict) -> dict:
         "batch": batch, "prompt_len": args.prompt, "dec_len": args.dec,
         "strategy": strategy,
         "per_token_ms": round(dt / args.dec * 1e3, 3),
+        "platform": jax.default_backend(),
     }
 
 
@@ -186,6 +192,7 @@ def run_serving_case(args) -> dict:
         "request_sizes": sizes, "prompt_len": args.prompt, "dec_len": args.dec,
         "delivered_tokens_per_s": round(delivered / dt / n_dev, 1),
         "strategy": "sampling(top_p=0.9)",
+        "platform": jax.default_backend(),
     }
 
 

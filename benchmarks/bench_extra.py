@@ -40,7 +40,9 @@ def _gpt_cfg(n_dev: int, steps: int):
     2048, 24 layers, 16 heads) on one chip: bf16 compute, bf16 first
     moment, selective remat, chunked CE — the levers that fit 1.3B params
     + moments + activations in 16 GB HBM."""
-    batch = int(os.environ.get("BENCH_1P3B_BATCH", 4)) * n_dev
+    # b8 is the measured sweet spot (18:57Z on-chip: b8 14,024 tok/s /
+    # 58.1% MFU vs b4 13,445; b12 OOMs; b8+full-remat 13,511)
+    batch = int(os.environ.get("BENCH_1P3B_BATCH", 8)) * n_dev
     seq = int(os.environ.get("BENCH_1P3B_SEQ", 1024))
     return {
         "Global": {
@@ -80,6 +82,12 @@ def _gpt_cfg(n_dev: int, steps: int):
             "recompute_granularity": os.environ.get("BENCH_1P3B_REMAT", "selective"),
             "use_fused_ln": True,
             "use_chunked_ce": True,
+            # fused/512 measured end-to-end on-chip 18:57Z: 14,024 tok/s
+            # at b8 vs 13,480 with split/256 (results_extra.jsonl); auto
+            # ladder when 512 does not divide a shrink-knob seq
+            "flash_block": int(os.environ.get(
+                "BENCH_1P3B_FLASH_BLOCK", 512 if seq % 512 == 0 else 0)),
+            "flash_bwd": os.environ.get("BENCH_1P3B_FLASH_BWD", "fused"),
         },
         # fp32 masters (5.2G) + bf16 mu (2.6G) + fp32 nu (5.2G) alone are
         # 13G of the chip's 15.75G HBM; grads + activations push the step

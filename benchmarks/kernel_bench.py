@@ -56,12 +56,13 @@ def main(argv=None):
     # dots, so report dense FLOPs for the occupancy view
     flops_fwd = 2 * 2 * b * n * args.seq * args.seq * args.d
 
+    from bench import host_fence
+
     def timed(fn, *xs):
-        jax.block_until_ready(fn(*xs))  # compile + warm
+        host_fence(fn(*xs))  # compile + warm
         t0 = time.perf_counter()
         for _ in range(args.iters):
-            out = fn(*xs)
-        jax.block_until_ready(out)
+            host_fence(fn(*xs))
         return (time.perf_counter() - t0) / args.iters
 
     rows = []
@@ -96,6 +97,8 @@ def main(argv=None):
                 "fwd_ms": round(t_fwd * 1e3, 2),
                 "fwd_bwd_ms": round(t_all * 1e3, 2),
                 "fwd_tflops": round(flops_fwd / t_fwd / 1e12, 1),
+                # CPU-interpret smoke rows must never read as chip evidence
+                "platform": jax.default_backend(),
             }
             rows.append(row)
             print(json.dumps(row))

@@ -41,7 +41,8 @@ run_task() {
     gpt1p3b)
       # b8 + selective remat + multi_precision=False (bf16 params/moments,
       # bench_extra defaults): the measured-best 1.3B single-chip layout —
-      # 13,480 tok/s, 56% MFU, 03:32Z window.  Offloaded fp32-master
+      # 14,024 tok/s, 58.1% MFU with the fused flash backward (18:57Z
+      # window; b12 OOMs, full-remat 13,511).  Offloaded fp32-master
       # layouts never fit (the monolithic device_put stages all nu leaves
       # at once; measured 1.19G over even with bf16 grads).
       BENCH_1P3B_BATCH=8 BENCH_EXTRA_DEADLINE_S=900 \
