@@ -1,0 +1,52 @@
+"""Unit tests for the shared bench helpers in bench.py.
+
+host_fence is the single audited timing fence for every benchmark
+(BENCH_NOTE.md round 5: jax.block_until_ready has been observed
+returning while device work is still pending under the axon runtime,
+so all timed loops fence with a device->host fetch instead).
+"""
+
+import sys
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import host_fence, model_flops_per_token  # noqa: E402
+
+
+def test_host_fence_returns_one_element():
+    out = jax.jit(lambda x: x * 2)(jnp.arange(12.0).reshape(3, 4))
+    got = host_fence(out)
+    assert isinstance(got, np.ndarray)
+    assert got.size == 1
+    assert got[0] == 0.0
+
+
+def test_host_fence_pytree():
+    # benches fence jit outputs that are dicts/tuples of arrays; the fence
+    # fetches from the first leaf regardless of structure
+    out = jax.jit(lambda x: {"loss": x.sum(), "ids": x.astype(jnp.int32)})(
+        jnp.ones((2, 3))
+    )
+    got = host_fence(out)
+    assert got.size == 1
+
+
+def test_host_fence_completes_computation():
+    # assert on the fence's OWN return value: it must have fetched the
+    # computed buffer (a no-op fence cannot produce the right number)
+    x = jnp.full((64, 64), 3.0)
+    out = jax.jit(lambda a: a @ a)(x)
+    np.testing.assert_allclose(host_fence(out)[0], 3.0 * 3.0 * 64)
+
+
+def test_model_flops_per_token_scales_with_depth():
+    one = model_flops_per_token(1024, 24, 50304, 1024)
+    two = model_flops_per_token(1024, 48, 50304, 1024)
+    # doubling layers should roughly double per-token FLOPs (the embedding
+    # head term is shared, so strictly less than 2x)
+    assert one < two < 2 * one
