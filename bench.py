@@ -330,6 +330,8 @@ def _child() -> None:
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(tokens_per_s / n_dev / BASELINE_TOKENS_PER_S, 3),
                 "mfu": round(mfu, 4),
+                # CPU smoke rows must never read as chip evidence
+                "platform": jax.default_backend(),
             }
         ),
         flush=True,

@@ -370,6 +370,8 @@ def run_case(name: str, steps: int) -> dict:
         "unit": meta["unit"],
         "vs_baseline": (round(rate / meta["baseline"], 3)
                         if meta["baseline"] else None),
+        # CPU smoke rows must never read as chip evidence
+        "platform": jax.default_backend(),
     }
     if meta.get("note"):
         row["note"] = meta["note"]

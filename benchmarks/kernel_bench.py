@@ -89,7 +89,8 @@ def main(argv=None):
                 t_all = timed(grad, q, k, v)
             except Exception as e:  # noqa: BLE001 - report the combo, keep sweeping
                 rows.append({"bwd": bwd_mode, "block": block,
-                             "error": str(e)[:200]})
+                             "error": str(e)[:200],
+                             "platform": jax.default_backend()})
                 print(json.dumps(rows[-1]))
                 continue
             row = {

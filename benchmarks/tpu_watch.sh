@@ -14,7 +14,7 @@
 # consumed — round 4 lost eight gpt1p3b attempts to exactly that.
 #
 # Usage: bash benchmarks/tpu_watch.sh [task ...]
-#   task: gpt1p3b | tune1p3b | profile | headline | fusedbwd | blocks |
+#   task: gpt1p3b | tune1p3b | profile | headline | fusedbwd | sweep2 |
 #         kernels | decode | extra
 #   (default: kernels headline)
 set -u
@@ -23,9 +23,9 @@ PROBE_EVERY_S=${PROBE_EVERY_S:-120}
 TASKS=("$@")
 if [ $# -eq 0 ]; then TASKS=(kernels headline); fi
 for t in "${TASKS[@]}"; do
-  case "$t" in gpt1p3b|tune1p3b|profile|headline|fusedbwd|blocks|kernels|decode|extra) ;; *)
+  case "$t" in gpt1p3b|tune1p3b|profile|headline|fusedbwd|sweep2|kernels|decode|extra) ;; *)
     # a typo must not burn a scarce tunnel-up window on a no-op
-    echo "unknown task '$t' (have: gpt1p3b tune1p3b profile headline fusedbwd blocks kernels decode extra)" >&2; exit 2 ;;
+    echo "unknown task '$t' (have: gpt1p3b tune1p3b profile headline fusedbwd sweep2 kernels decode extra)" >&2; exit 2 ;;
   esac
 done
 LOG=benchmarks/tpu_watch.log
@@ -88,15 +88,15 @@ run_task() {
       # fused-vs-split, and block optimum before the full re-measures
       timeout 600 python benchmarks/kernel_bench.py
       ;;
-    blocks)
-      # block-size sweep at the bf16-dot balance, for BOTH backward
-      # schedules (fused at 256 answers whether a smaller block rescues
-      # the fused kernel from a 512 VMEM spill)
-      for combo in "256 split" "1024 split" "256 fused"; do
-        set -- $combo
-        echo "== PFX_FLASH_BLOCK=$1 PFX_FLASH_BWD=$2 =="
-        PFX_FLASH_BLOCK=$1 PFX_FLASH_BWD=$2 BENCH_DEADLINE_S=400 \
-          timeout 500 python bench.py
+    sweep2)
+      # knob sweep on TOP of the fused/512 defaults (the 18:43Z window
+      # made them the bench baseline): does the batch/unroll optimum
+      # shift now that the flash pair is ~30% faster?
+      for combo in "BENCH_BATCH=24" "BENCH_BATCH=32" \
+                   "BENCH_SCAN_UNROLL=2 BENCH_BATCH=8" \
+                   "BENCH_FLASH_BLOCK=256"; do
+        echo "== headline sweep: $combo =="
+        env $combo BENCH_DEADLINE_S=400 timeout 500 python bench.py
       done
       ;;
   esac
