@@ -35,15 +35,14 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 
-def _gpt_cfg(n_dev: int, steps: int):
-    """GPT-1.3B (reference pretrain_gpt_1.3B_dp8.yaml model shape: hidden
-    2048, 24 layers, 16 heads) on one chip: bf16 compute, bf16 first
-    moment, selective remat, chunked CE — the levers that fit 1.3B params
-    + moments + activations in 16 GB HBM."""
-    # b8 is the measured sweet spot (18:57Z on-chip: b8 14,024 tok/s /
-    # 58.1% MFU vs b4 13,445; b12 OOMs; b8+full-remat 13,511)
-    batch = int(os.environ.get("BENCH_1P3B_BATCH", 8)) * n_dev
-    seq = int(os.environ.get("BENCH_1P3B_SEQ", 1024))
+def _gpt_base_cfg(env: str, n_dev: int, steps: int, *, batch: int, seq: int,
+                  hidden: int, layers: int):
+    """Shared GPT bench config frame: bf16 compute, selective remat,
+    chunked CE, flash fused/512 (auto ladder when 512 does not divide a
+    shrink-knob seq).  ``env`` is the BENCH_<env>_* knob prefix; cases
+    layer their memory levers on top of the returned dict."""
+    batch = int(os.environ.get(f"BENCH_{env}_BATCH", batch)) * n_dev
+    seq = int(os.environ.get(f"BENCH_{env}_SEQ", seq))
     return {
         "Global": {
             "global_batch_size": batch,
@@ -55,68 +54,78 @@ def _gpt_cfg(n_dev: int, steps: int):
             "max_steps": steps,
             "eval_freq": 0,
             "logging_freq": 10**9,
-            "mix_precision": {
-                "enable": True,
-                "dtype": "bfloat16",
-                # bf16 grads (main_grad off) halve the 4.1G of fp32 grad
-                # accumulators — measured necessary to fit AdamW-complete
-                # 1.3B on one 15.75G chip (03:18Z window: b2+full-remat+
-                # offload still OOM'd by 853M with fp32 grads)
-                "main_grad": os.environ.get("BENCH_1P3B_MAIN_GRAD", "0") == "1",
-            },
+            "mix_precision": {"enable": True, "dtype": "bfloat16"},
             "save_load": {"save_steps": 0},
         },
         "Model": {
             "module": "GPTModule",
-            # BENCH_1P3B_* shrink knobs exist for CI smoke only; the real
-            # case is the reference 1.3B shape (pretrain_gpt_1.3B_dp8.yaml)
-            "vocab_size": int(os.environ.get("BENCH_1P3B_VOCAB", 50304)),
-            "hidden_size": int(os.environ.get("BENCH_1P3B_HIDDEN", 2048)),
-            "num_layers": int(os.environ.get("BENCH_1P3B_LAYERS", 24)),
+            # BENCH_<env>_* shrink knobs exist for CI smoke only
+            "vocab_size": int(os.environ.get(f"BENCH_{env}_VOCAB", 50304)),
+            "hidden_size": int(os.environ.get(f"BENCH_{env}_HIDDEN", hidden)),
+            "num_layers": int(os.environ.get(f"BENCH_{env}_LAYERS", layers)),
             "num_attention_heads": 16,
             "max_position_embeddings": seq,
             "hidden_dropout_prob": 0.1,
             "attention_probs_dropout_prob": 0.1,
             "attn_impl": "flash",
             "use_recompute": True,
-            "recompute_granularity": os.environ.get("BENCH_1P3B_REMAT", "selective"),
+            "recompute_granularity":
+                os.environ.get(f"BENCH_{env}_REMAT", "selective"),
             "use_fused_ln": True,
             "use_chunked_ce": True,
-            # fused/512 measured end-to-end on-chip 18:57Z: 14,024 tok/s
-            # at b8 vs 13,480 with split/256 (results_extra.jsonl); auto
-            # ladder when 512 does not divide a shrink-knob seq
+            # fused/512 measured end-to-end on-chip 18:57Z: 1.3B 14,024
+            # tok/s at b8 vs 13,480 with split/256 (results_extra.jsonl)
             "flash_block": int(os.environ.get(
-                "BENCH_1P3B_FLASH_BLOCK", 512 if seq % 512 == 0 else 0)),
-            "flash_bwd": os.environ.get("BENCH_1P3B_FLASH_BWD", "fused"),
+                f"BENCH_{env}_FLASH_BLOCK", 512 if seq % 512 == 0 else 0)),
+            "flash_bwd": os.environ.get(f"BENCH_{env}_FLASH_BWD", "fused"),
         },
-        # fp32 masters (5.2G) + bf16 mu (2.6G) + fp32 nu (5.2G) alone are
-        # 13G of the chip's 15.75G HBM; grads + activations push the step
-        # past 21G (measured OOM).  Host offload of the moments does NOT
-        # save the day either: the monolithic device_put stages every
-        # stacked nu leaf on-device at once (measured 03:24Z window: 4.1G
-        # of copy-start temps, still 1.19G over).  What fits is the
-        # reference's OTHER knob: multi_precision=False — bf16 params, no
-        # fp32 masters, moments in bf16 — ~10.4G peak including grads.
-        "Distributed": {
-            "sharding": {
-                "sharding_offload":
-                    os.environ.get("BENCH_1P3B_OFFLOAD", "0") == "1",
-            },
-        },
+        "Distributed": {},
         "Optimizer": {
             "name": "FusedAdamW",
-            "multi_precision":
-                os.environ.get("BENCH_1P3B_MULTI_PRECISION", "0") == "1",
             "weight_decay": 0.01,
             "beta1": 0.9,
             "beta2": 0.95,
-            # bf16 first moment halves the largest optimizer buffer
-            # (optims/optimizer.py:46 moment_dtype -> optax mu_dtype)
-            "moment_dtype": "bfloat16",
             "lr": {"name": "Constant", "learning_rate": 1e-4},
             "grad_clip": {"name": "ClipGradByGlobalNorm", "clip_norm": 1.0},
         },
     }, batch, seq
+
+
+def _gpt_cfg(n_dev: int, steps: int):
+    """GPT-1.3B (reference pretrain_gpt_1.3B_dp8.yaml model shape: hidden
+    2048, 24 layers, 16 heads) on one chip: the memory levers that fit
+    1.3B params + moments + activations in 16 GB HBM layered on the
+    shared frame."""
+    # b8 is the measured sweet spot (18:57Z on-chip: b8 14,024 tok/s /
+    # 58.1% MFU vs b4 13,445; b12 OOMs; b8+full-remat 13,511)
+    raw, batch, seq = _gpt_base_cfg(
+        "1P3B", n_dev, steps, batch=8, seq=1024, hidden=2048, layers=24)
+    # bf16 grads (main_grad off) halve the 4.1G of fp32 grad
+    # accumulators — measured necessary to fit AdamW-complete 1.3B on one
+    # 15.75G chip (03:18Z window: b2+full-remat+offload still OOM'd by
+    # 853M with fp32 grads)
+    raw["Engine"]["mix_precision"]["main_grad"] = (
+        os.environ.get("BENCH_1P3B_MAIN_GRAD", "0") == "1")
+    # fp32 masters (5.2G) + bf16 mu (2.6G) + fp32 nu (5.2G) alone are
+    # 13G of the chip's 15.75G HBM; grads + activations push the step
+    # past 21G (measured OOM).  Host offload of the moments does NOT
+    # save the day either: the monolithic device_put stages every
+    # stacked nu leaf on-device at once (measured 03:24Z window: 4.1G
+    # of copy-start temps, still 1.19G over).  What fits is the
+    # reference's OTHER knob: multi_precision=False — bf16 params, no
+    # fp32 masters, moments in bf16 — ~10.4G peak including grads.
+    raw["Distributed"] = {
+        "sharding": {
+            "sharding_offload":
+                os.environ.get("BENCH_1P3B_OFFLOAD", "0") == "1",
+        },
+    }
+    raw["Optimizer"]["multi_precision"] = (
+        os.environ.get("BENCH_1P3B_MULTI_PRECISION", "0") == "1")
+    # bf16 first moment halves the largest optimizer buffer
+    # (optims/optimizer.py:46 moment_dtype -> optax mu_dtype)
+    raw["Optimizer"]["moment_dtype"] = "bfloat16"
+    return raw, batch, seq
 
 
 def _vit_cfg(n_dev: int, steps: int, large: bool):
@@ -167,8 +176,23 @@ def _vit_cfg(n_dev: int, steps: int, large: bool):
     }, batch, image
 
 
+def _gpt4k_cfg(n_dev: int, steps: int):
+    """GPT-345M at seq 4096 (4x the headline): long-context single-chip
+    evidence — flash fused/512 at 4096 rows, selective remat, chunked CE
+    (the fp32 logits buffer at 4096x50304 would be 3.3 GB at b4).  The
+    reference documents seq-1024 configs only, so the row reports an
+    absolute rate (vs_baseline null) with the headline config cited."""
+    return _gpt_base_cfg(
+        "4K", n_dev, steps, batch=4, seq=4096, hidden=1024, layers=24)
+
+
 CASES = {
     "gpt1p3b": {"baseline": 11500.0, "unit": "tokens/s/chip"},
+    "gpt_seq4096": {
+        "baseline": None, "unit": "tokens/s/chip",
+        "note": "no published reference number at seq 4096 (reference GPT "
+                "docs are seq-1024); shape = headline 345M at 4x sequence",
+    },
     "vit_b16": {"baseline": 459.0, "unit": "images/s/chip"},
     "vit_l16": {"baseline": 32.4, "unit": "images/s/chip"},
     # the reference publishes NO throughput number for these two families
@@ -296,6 +320,8 @@ def run_case(name: str, steps: int) -> dict:
     n_dev = jax.device_count()
     if name == "gpt1p3b":
         raw, batch, seq = _gpt_cfg(n_dev, steps)
+    elif name == "gpt_seq4096":
+        raw, batch, seq = _gpt4k_cfg(n_dev, steps)
     elif name == "ernie_base":
         raw, batch, seq = _ernie_cfg(n_dev, steps)
     elif name == "imagen_base64":
@@ -308,7 +334,7 @@ def run_case(name: str, steps: int) -> dict:
     module = build_module(cfg)
 
     rng = np.random.default_rng(0)
-    if name == "gpt1p3b":
+    if name in ("gpt1p3b", "gpt_seq4096"):
         vocab = int(cfg.Model.vocab_size)
         host_batch = {
             "tokens": rng.integers(0, vocab, (batch, seq)).astype(np.int64),
@@ -362,7 +388,8 @@ def run_case(name: str, steps: int) -> dict:
     if not np.isfinite(final_loss):
         return {"metric": f"{name}_throughput_per_chip", "value": 0.0,
                 "unit": f"{meta['unit']} (non-finite loss)",
-                "vs_baseline": 0.0 if meta["baseline"] else None}
+                "vs_baseline": 0.0 if meta["baseline"] else None,
+                "platform": jax.default_backend()}
     rate = per_step * steps / dt / n_dev
     row = {
         "metric": f"{name}_throughput_per_chip",
@@ -375,7 +402,7 @@ def run_case(name: str, steps: int) -> dict:
     }
     if meta.get("note"):
         row["note"] = meta["note"]
-    if name == "gpt1p3b":
+    if name in ("gpt1p3b", "gpt_seq4096"):
         from bench import model_flops_per_token
 
         mc = cfg.Model
@@ -475,9 +502,12 @@ def _child(argv) -> None:
         except Exception as e:  # noqa: BLE001 — e.g. RESOURCE_EXHAUSTED on a
             # memory-tight case must not abort the remaining cases
             traceback.print_exc(file=sys.stderr)
+            import jax
+
             row = {"metric": f"{name}_throughput_per_chip", "value": 0.0,
                    "unit": f"{CASES[name]['unit']} ({type(e).__name__})",
-                   "vs_baseline": _zero_vsb(name)}
+                   "vs_baseline": _zero_vsb(name),
+                   "platform": jax.default_backend()}
         _emit(row)
 
 

@@ -14,7 +14,7 @@
 # consumed — round 4 lost eight gpt1p3b attempts to exactly that.
 #
 # Usage: bash benchmarks/tpu_watch.sh [task ...]
-#   task: gpt1p3b | tune1p3b | profile | headline | fusedbwd | sweep2 |
+#   task: gpt1p3b | tune1p3b | profile | headline | fusedbwd | sweep2 | longseq |
 #         kernels | decode | extra
 #   (default: kernels headline)
 set -u
@@ -23,9 +23,9 @@ PROBE_EVERY_S=${PROBE_EVERY_S:-120}
 TASKS=("$@")
 if [ $# -eq 0 ]; then TASKS=(kernels headline); fi
 for t in "${TASKS[@]}"; do
-  case "$t" in gpt1p3b|tune1p3b|profile|headline|fusedbwd|sweep2|kernels|decode|extra) ;; *)
+  case "$t" in gpt1p3b|tune1p3b|profile|headline|fusedbwd|sweep2|longseq|kernels|decode|extra) ;; *)
     # a typo must not burn a scarce tunnel-up window on a no-op
-    echo "unknown task '$t' (have: gpt1p3b tune1p3b profile headline fusedbwd sweep2 kernels decode extra)" >&2; exit 2 ;;
+    echo "unknown task '$t' (have: gpt1p3b tune1p3b profile headline fusedbwd sweep2 longseq kernels decode extra)" >&2; exit 2 ;;
   esac
 done
 LOG=benchmarks/tpu_watch.log
@@ -66,6 +66,12 @@ run_task() {
       BENCH_EXTRA_DEADLINE_S=1200 timeout 1300 \
         python benchmarks/bench_extra.py --cases ernie_base,imagen_base64 --steps 8
       ;;
+    longseq)
+      # 345M at seq 4096: long-context single-chip evidence (flash
+      # fused/512 at 4096 rows + chunked CE)
+      BENCH_EXTRA_DEADLINE_S=900 timeout 1000 \
+        python benchmarks/bench_extra.py --cases gpt_seq4096 --steps 8
+      ;;
     profile)
       timeout 900 python benchmarks/profile_bench.py \
         --log_dir benchmarks/chip_day/profile_watch || echo "profile rc=$?"
@@ -92,7 +98,11 @@ run_task() {
       # knob sweep on TOP of the fused/512 defaults (the 18:43Z window
       # made them the bench baseline): does the batch/unroll optimum
       # shift now that the flash pair is ~30% faster?
-      for combo in "BENCH_BATCH=24" "BENCH_BATCH=32" \
+      # bigger batches need chunked CE (the fp32 logits buffer is
+      # batch*1024*50304*4B — 6.6G at b32; bench.py:255 'try with bigger
+      # BENCH_BATCH once enabled')
+      for combo in "BENCH_BATCH=24 BENCH_CHUNKED_CE=1" \
+                   "BENCH_BATCH=32 BENCH_CHUNKED_CE=1" \
                    "BENCH_SCAN_UNROLL=2 BENCH_BATCH=8" \
                    "BENCH_FLASH_BLOCK=256"; do
         echo "== headline sweep: $combo =="
