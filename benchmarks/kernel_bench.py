@@ -66,12 +66,17 @@ def main(argv=None):
         return (time.perf_counter() - t0) / args.iters
 
     rows = []
+    # (block_q, block_k): symmetric points plus asymmetric K/V blocks — a
+    # bigger K block amortizes HBM streaming without growing the q tile
+    combos = list(dict.fromkeys(
+        [(256, 256), (512, 512), (512, args.seq), (256, args.seq)]))
     for bwd_mode in ("split", "fused"):
-        for block in (256, 512):
-            if args.seq % block:
+        for block, block_k in combos:
+            if args.seq % block or args.seq % block_k:
                 continue
             os.environ["PFX_FLASH_BWD"] = bwd_mode
             os.environ["PFX_FLASH_BLOCK"] = str(block)
+            os.environ["PFX_FLASH_BLOCK_K"] = str(block_k)
             jax.clear_caches()  # env knobs are read at trace time
             from paddlefleetx_tpu.ops.flash_attention import flash_attention
 
@@ -89,12 +94,14 @@ def main(argv=None):
                 t_all = timed(grad, q, k, v)
             except Exception as e:  # noqa: BLE001 - report the combo, keep sweeping
                 rows.append({"bwd": bwd_mode, "block": block,
+                             "block_k": block_k,
                              "error": str(e)[:200],
                              "platform": jax.default_backend()})
                 print(json.dumps(rows[-1]))
                 continue
             row = {
-                "bwd": bwd_mode, "block": block, "dtype": args.dtype,
+                "bwd": bwd_mode, "block": block, "block_k": block_k,
+                "dtype": args.dtype,
                 "fwd_ms": round(t_fwd * 1e3, 2),
                 "fwd_bwd_ms": round(t_all * 1e3, 2),
                 "fwd_tflops": round(flops_fwd / t_fwd / 1e12, 1),

@@ -104,7 +104,8 @@ run_task() {
       for combo in "BENCH_BATCH=24 BENCH_CHUNKED_CE=1" \
                    "BENCH_BATCH=32 BENCH_CHUNKED_CE=1" \
                    "BENCH_SCAN_UNROLL=2 BENCH_BATCH=8" \
-                   "BENCH_FLASH_BLOCK=256"; do
+                   "BENCH_FLASH_BLOCK=256" \
+                   "PFX_FLASH_BLOCK_K=1024"; do
         echo "== headline sweep: $combo =="
         env $combo BENCH_DEADLINE_S=400 timeout 500 python bench.py
       done

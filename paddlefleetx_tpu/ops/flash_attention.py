@@ -46,38 +46,62 @@ def _block_sizes(seq: int, block: int = 0) -> Tuple[int, int]:
     # in BOTH spellings: silently falling back (to the ladder or the XLA
     # path) would burn a scarce tunnel-up benchmark window on mislabeled
     # data blamed on the wrong knob.
-    env = os.environ.get("PFX_FLASH_BLOCK") or "0"
-    try:
-        env_block = int(env)
-    except ValueError:
-        raise ValueError(
-            f"PFX_FLASH_BLOCK={env!r} is not an integer; pass a positive "
-            f"divisor of seq (e.g. 256) or unset it"
-        ) from None
-    force = int(block) or env_block
+    force = int(block) or _parse_block_env("PFX_FLASH_BLOCK")
     if force:
-        if force < 0 or seq % force:
-            raise ValueError(
-                f"flash block {force} must be a positive divisor of seq "
-                f"{seq} (Model.flash_block / PFX_FLASH_BLOCK)"
-            )
-        if force % 8:
-            # sublane alignment: a non-multiple-of-8 tile would surface as
-            # an opaque Mosaic lowering error deep in the compile
-            raise ValueError(
-                f"flash block {force} must be a multiple of 8 (TPU "
-                f"sublane tiling; Model.flash_block / PFX_FLASH_BLOCK)"
-            )
-        return force, force
+        _check_block(force, seq, "Model.flash_block / PFX_FLASH_BLOCK")
+        return force, _block_k_override(seq, force)
     for b in (512, 256, 128):
         if seq % b == 0:
-            return b, b
+            return b, _block_k_override(seq, b)
     if seq < 256 and seq % 8 == 0:
         # single-block path needs sublane alignment too: a non-multiple-
         # of-8 seq would die in Mosaic lowering, so it falls through to
         # the unsupported return below and attention() uses XLA instead
-        return seq, seq
+        return seq, _block_k_override(seq, seq)
     return 256, 256  # does not divide seq -> flash_supported() False
+
+
+def _parse_block_env(name: str) -> int:
+    env = os.environ.get(name) or "0"
+    try:
+        return int(env)
+    except ValueError:
+        raise ValueError(
+            f"{name}={env!r} is not an integer; pass a positive divisor "
+            f"of seq (e.g. 256) or unset it"
+        ) from None
+
+
+def _check_block(val: int, seq: int, label: str) -> None:
+    if val < 0 or seq % val:
+        raise ValueError(
+            f"flash block {val} must be a positive divisor of seq "
+            f"{seq} ({label})"
+        )
+    if val % 8:
+        # sublane alignment: a non-multiple-of-8 tile would surface as
+        # an opaque Mosaic lowering error deep in the compile
+        raise ValueError(
+            f"flash block {val} must be a multiple of 8 (TPU "
+            f"sublane tiling; {label})"
+        )
+
+
+def _block_k_override(seq: int, default_bk: int) -> int:
+    """PFX_FLASH_BLOCK_K: sweep knob for an asymmetric K/V block.
+
+    The kernels are already parameterized by block_q/block_k separately
+    (causal bounds use ceil/floor divisions that hold for bq != bk); a
+    larger K block amortizes K/V HBM streaming without growing the q
+    tile's VMEM accumulator.  Same loud-failure contract as the q block
+    (shared _check_block): an invalid override must not silently
+    mislabel a chip sweep — including on the small-seq single-block
+    path, where a stale exported override would otherwise be dropped."""
+    bk = _parse_block_env("PFX_FLASH_BLOCK_K")
+    if not bk:
+        return default_bk
+    _check_block(bk, seq, "block_k; PFX_FLASH_BLOCK_K")
+    return bk
 
 
 # ---------------------------------------------------------------------------
@@ -137,7 +161,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q, block_k)
 
 def _flash_fwd(q, k, v, scale, block):
     bh, seq, d = q.shape
-    block_q = block_k = block
+    block_q, block_k = block  # static (bq, bk) tuple
     grid = (bh, seq // block_q)
 
     kernel = functools.partial(
@@ -357,7 +381,7 @@ def _flash_bwd(scale, block, bwd_mode, res, g):
     q, k, v, out, lse = res
     do = g
     bh, seq, d = q.shape
-    block_q = block_k = block
+    block_q, block_k = block  # static (bq, bk) tuple
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[..., None]  # [bh, s, 1]
 
@@ -471,7 +495,7 @@ def flash_attention(
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * n, s, d)
 
-    out = _flash_bhsd(to_bh(q), to_bh(k), to_bh(v), scale, bq, mode)
+    out = _flash_bhsd(to_bh(q), to_bh(k), to_bh(v), scale, (bq, bk), mode)
     return out.reshape(b, n, s, d).transpose(0, 2, 1, 3)
 
 

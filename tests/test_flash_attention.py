@@ -143,6 +143,7 @@ def test_flash_block_env_validation(monkeypatch):
 
     from paddlefleetx_tpu.ops.flash_attention import _block_sizes
 
+    monkeypatch.delenv("PFX_FLASH_BLOCK_K", raising=False)
     monkeypatch.setenv("PFX_FLASH_BLOCK", "banana")
     with pytest.raises(ValueError, match="PFX_FLASH_BLOCK"):
         _block_sizes(256)
@@ -154,6 +155,56 @@ def test_flash_block_env_validation(monkeypatch):
         _block_sizes(256)
     monkeypatch.setenv("PFX_FLASH_BLOCK", "64")
     assert _block_sizes(256) == (64, 64)
+    # asymmetric K/V block: same loud-failure contract, bk-only override
+    monkeypatch.setenv("PFX_FLASH_BLOCK_K", "banana")
+    with pytest.raises(ValueError, match="PFX_FLASH_BLOCK_K"):
+        _block_sizes(256)
+    monkeypatch.setenv("PFX_FLASH_BLOCK_K", "96")
+    with pytest.raises(ValueError, match="block_k"):
+        _block_sizes(256)
+    monkeypatch.setenv("PFX_FLASH_BLOCK_K", "128")
+    assert _block_sizes(256) == (64, 128)
+
+
+def test_asymmetric_block_k_matches_reference(monkeypatch):
+    """bq != bk (PFX_FLASH_BLOCK_K) must produce the same attention output
+    as the symmetric kernel — the causal bounds inside the kernels use
+    ceil/floor divisions that have to hold for unequal blocks."""
+    import jax
+
+    from paddlefleetx_tpu.ops.flash_attention import flash_attention
+
+    b, s, n, d = 2, 256, 2, 64
+    kq, kk, kv = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(kq, (b, s, n, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, n, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, n, d), jnp.float32)
+
+    monkeypatch.delenv("PFX_FLASH_BLOCK_K", raising=False)
+    ref = np.asarray(flash_attention(q, k, v, block=64))
+    jax.clear_caches()  # env knob is read at trace time
+    monkeypatch.setenv("PFX_FLASH_BLOCK_K", "128")
+    asym = np.asarray(flash_attention(q, k, v, block=64))
+    jax.clear_caches()
+    np.testing.assert_allclose(asym, ref, rtol=1e-5, atol=1e-5)
+
+    # gradients too: both backward schedules consume block_k
+    def loss(mode):
+        monkeypatch.setenv("PFX_FLASH_BWD", mode)
+        jax.clear_caches()
+        out = jax.grad(
+            lambda qq: flash_attention(qq, k, v, block=64).astype(jnp.float32).sum()
+        )(q)
+        return np.asarray(out)
+
+    monkeypatch.setenv("PFX_FLASH_BLOCK_K", "")
+    g_sym = loss("split")
+    monkeypatch.setenv("PFX_FLASH_BLOCK_K", "128")
+    g_asym_split = loss("split")
+    g_asym_fused = loss("fused")
+    jax.clear_caches()
+    np.testing.assert_allclose(g_asym_split, g_sym, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(g_asym_fused, g_sym, rtol=1e-5, atol=1e-5)
 
 
 def test_config_knobs_reach_kernel():
