@@ -49,14 +49,12 @@ run_task() {
         timeout 1000 python benchmarks/bench_extra.py --cases gpt1p3b --steps 8
       ;;
     tune1p3b)
-      # VERDICT r4 #7: push 1.3B past 13,480 — fused backward and a
-      # flash_block sweep at h=2048 (the block optimum was tuned at
-      # h=1024; the 2048-head geometry may prefer a different tile)
-      for combo in "0 fused" "256 split" "512 split"; do
-        set -- $combo
-        echo "== 1.3B PFX_FLASH_BLOCK=$1 PFX_FLASH_BWD=$2 =="
-        PFX_FLASH_BLOCK=$1 PFX_FLASH_BWD=$2 BENCH_1P3B_BATCH=8 \
-          BENCH_EXTRA_DEADLINE_S=700 \
+      # push 1.3B past 14,024 (the fused/512 b8 default): asymmetric K
+      # block and the smaller q tile are the unprobed points at h=2048
+      for combo in "PFX_FLASH_BLOCK_K=1024" \
+                   "BENCH_1P3B_FLASH_BLOCK=256"; do
+        echo "== 1.3B sweep: $combo =="
+        env $combo BENCH_1P3B_BATCH=8 BENCH_EXTRA_DEADLINE_S=700 \
           timeout 800 python benchmarks/bench_extra.py --cases gpt1p3b --steps 8
       done
       ;;

@@ -1,0 +1,78 @@
+"""Bench contract tests: bench.py is the driver's ONLY interface to this
+repo's performance story, so its two promises get a pytest lock:
+
+  1. happy path — exactly one parseable JSON line on stdout with the
+     required keys (metric/value/unit/vs_baseline) and the platform tag;
+  2. deadline path — a child that cannot finish inside BENCH_DEADLINE_S
+     still yields rc=0 and an honest value-0.0 row (the round-3 failure
+     mode was rc=124 with NO output, which scored as a broken bench).
+
+Both run the real parent/child split as a subprocess pinned to CPU via
+PFX_PLATFORM (the conftest's in-process jax config does not reach a
+subprocess) at shrink shapes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SHRINK = {
+    "PFX_PLATFORM": "cpu",
+    "BENCH_VOCAB": "256",
+    "BENCH_HIDDEN": "64",
+    "BENCH_LAYERS": "2",
+    "BENCH_HEADS": "4",
+    "BENCH_SEQ": "128",
+    "BENCH_BATCH": "2",
+    "BENCH_STEPS": "2",
+}
+
+
+def _run_bench(extra_env, timeout):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(SHRINK)
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=timeout,
+    )
+
+
+def _json_lines(stdout):
+    rows = []
+    for line in stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            rows.append(json.loads(line))
+    return rows
+
+
+@pytest.mark.slow
+def test_bench_happy_path_contract():
+    out = _run_bench({"BENCH_DEADLINE_S": "240"}, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = _json_lines(out.stdout)
+    assert len(rows) == 1, out.stdout
+    row = rows[0]
+    assert set(row) >= {"metric", "value", "unit", "vs_baseline"}
+    assert row["metric"] == "gpt345m_pretrain_throughput_per_chip"
+    assert row["value"] > 0
+    assert row["platform"] == "cpu"
+
+
+@pytest.mark.slow
+def test_bench_deadline_emits_honest_zero():
+    # a 1-second deadline cannot fit the compile: the parent must still
+    # exit 0 with one honest 0.0 row, never rc=124/no-output
+    out = _run_bench({"BENCH_DEADLINE_S": "1"}, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = _json_lines(out.stdout)
+    assert len(rows) == 1, out.stdout
+    assert rows[0]["value"] == 0.0
+    assert "deadline" in rows[0]["unit"], rows[0]
