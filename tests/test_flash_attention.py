@@ -1,5 +1,7 @@
 """Flash-attention kernel vs XLA reference (interpret mode on CPU)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -220,7 +222,14 @@ def test_config_knobs_reach_kernel():
     for name, kw in {
         "default": {},
         "block64_fused": {"flash_block": 64, "flash_bwd": "fused"},
+        # asymmetric K block (PFX_FLASH_BLOCK_K) through the model path:
+        # config bq=64 + env bk=128 must hit the same loss
+        "block64_bk128": {"flash_block": 64, "_env_bk": "128"},
     }.items():
+        env_bk = kw.pop("_env_bk", None)
+        if env_bk is not None:
+            os.environ["PFX_FLASH_BLOCK_K"] = env_bk
+            jax.clear_caches()  # env knob is read at trace time
         cfg = GPTConfig(
             vocab_size=64, hidden_size=32, num_layers=2,
             num_attention_heads=4, max_position_embeddings=256,
@@ -233,8 +242,14 @@ def test_config_knobs_reach_kernel():
         )(params)
         assert np.isfinite(float(loss))
         losses[name] = float(loss)
+        if env_bk is not None:
+            del os.environ["PFX_FLASH_BLOCK_K"]
+            jax.clear_caches()
     np.testing.assert_allclose(
         losses["block64_fused"], losses["default"], rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        losses["block64_bk128"], losses["default"], rtol=1e-5
     )
     with pytest.raises(ValueError, match="flash_bwd"):
         GPTConfig(num_layers=2, flash_bwd="fuse")
