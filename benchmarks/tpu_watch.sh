@@ -112,6 +112,7 @@ run_task() {
 }
 
 echo "== tpu_watch start $(date -u +%FT%TZ) tasks: ${TASKS[*]} ==" >>"$LOG"
+LAST_BEAT=$SECONDS
 while [ ${#TASKS[@]} -gt 0 ]; do
   if probe; then
     task="${TASKS[0]}"
@@ -130,6 +131,13 @@ while [ ${#TASKS[@]} -gt 0 ]; do
       TASKS=("${TASKS[@]}" "$base!")
     fi
   else
+    # hourly still-down heartbeat (wall-clock based): the outage-duration
+    # claims in BENCH_NOTE.md lean on the watcher having actually probed
+    # the whole time — make that auditable
+    if [ $((SECONDS - LAST_BEAT)) -ge 3600 ]; then
+      echo "== tunnel still down $(date -u +%FT%TZ) (queue: ${TASKS[*]}) ==" >>"$LOG"
+      LAST_BEAT=$SECONDS
+    fi
     sleep "$PROBE_EVERY_S"
   fi
 done
