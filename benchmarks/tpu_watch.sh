@@ -115,6 +115,9 @@ echo "== tpu_watch start $(date -u +%FT%TZ) tasks: ${TASKS[*]} ==" >>"$LOG"
 LAST_BEAT=$SECONDS
 while [ ${#TASKS[@]} -gt 0 ]; do
   if probe; then
+    # reset the still-down clock: a long task window must not make the
+    # first failed probe after it look like an hour-old outage
+    LAST_BEAT=$SECONDS
     task="${TASKS[0]}"
     base="${task%\!}"
     echo "== tunnel UP $(date -u +%FT%TZ); running $base ==" >>"$LOG"
