@@ -13,7 +13,8 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_optims.py tests/test_rigid.py tests/test_glue.py \
              tests/test_lm_eval.py tests/test_configs_launch.py \
              tests/test_gpt_model.py tests/test_mesh_sharding.py \
-             tests/test_serving.py tests/test_chunked_ce.py tests/test_lint.py
+             tests/test_serving.py tests/test_chunked_ce.py tests/test_lint.py \
+             tests/test_bench_helpers.py tests/test_bench_cases.py
 
 # lint runs inside the gate via tests/test_lint.py::test_repo_is_clean
 test-fast:
