@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # Full measurement suite for the moment the axon TPU tunnel comes up.
 #
-# The round-3 verdict's three chip-gated items in one command: the headline
-# bench (always-emit contract), the MFU-push knob sweep, the extra
-# north-star cases (GPT-1.3B / ViT-B / ViT-L), and the profiler op table.
+# One command for a LONG window (the 2-min-probe watcher handles short
+# ones): kernel microbench, headline, knob sweep, every bench_extra case
+# (GPT-1.3B / ViT / ERNIE / Imagen / seq-4096), decode grid + serving,
+# and the profiler op table.
 # Every piece carries its own deadline and emits honest rows on failure,
 # so a tunnel that drops mid-suite still leaves a usable record.
 #
@@ -16,15 +17,19 @@ TS=$(date -u +%Y%m%dT%H%M%S)
 LOG=benchmarks/chip_day/run_${TS}.log
 {
   echo "== chip_day $TS =="
-  echo "== 1/5 kernel_bench (flash fwd/bwd, split x fused x blocks) =="
+  echo "== 1/6 kernel_bench (flash fwd/bwd, split x fused x blocks) =="
   timeout 600 python benchmarks/kernel_bench.py || echo "kernels rc=$?"
-  echo "== 2/5 bench.py (headline, default knobs) =="
+  echo "== 2/6 bench.py (headline, default knobs) =="
   BENCH_DEADLINE_S=600 python bench.py
-  echo "== 3/5 sweep_bench (all combos) =="
+  echo "== 3/6 sweep_bench (all combos) =="
   python benchmarks/sweep_bench.py --combos default --steps 10
-  echo "== 4/5 bench_extra (1.3B / ViT-B / ViT-L) =="
-  BENCH_EXTRA_DEADLINE_S=1800 python benchmarks/bench_extra.py
-  echo "== 5/5 profile_bench (op table -> benchmarks/chip_day/profile_$TS) =="
+  echo "== 4/6 bench_extra (1.3B / ViT-B / ViT-L / ERNIE / Imagen / seq4096) =="
+  BENCH_EXTRA_DEADLINE_S=2400 python benchmarks/bench_extra.py \
+    --cases gpt1p3b,vit_b16,vit_l16,ernie_base,imagen_base64,gpt_seq4096
+  echo "== 5/6 bench_decode (b8/b32 x greedy/top-p + bucketed serving) =="
+  BENCH_DECODE_DEADLINE_S=1200 timeout 1300 python benchmarks/bench_decode.py \
+    || echo "decode rc=$?"
+  echo "== 6/6 profile_bench (op table -> benchmarks/chip_day/profile_$TS) =="
   timeout 1200 python benchmarks/profile_bench.py \
     --log_dir "benchmarks/chip_day/profile_${TS}" || echo "profile rc=$?"
   echo "== chip_day done =="
