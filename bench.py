@@ -12,6 +12,7 @@ exiting.  Round 3's BENCH was rc=124 with no output because the in-process
 probe window (40 min) overran the driver's capture timeout.
 """
 
+import contextlib
 import json
 import os
 import signal
@@ -70,6 +71,32 @@ def host_fence(out):
 
     leaf = jax.tree_util.tree_leaves(out)[0]
     return np.asarray(leaf.ravel()[:1])
+
+
+@contextlib.contextmanager
+def knob_env(knobs):
+    """Context manager: set trace-time env knobs (PFX_FLASH_*/PFX_DECODE_*)
+    for a bench section, clearing jax's trace caches on BOTH edges, and
+    restore the prior values (pop if previously unset) on exit — even on
+    error.  The single audited copy of the save/mutate/restore hygiene
+    (ADVICE r5: a sweep that leaves its last combo exported poisons any
+    in-process caller that traces afterwards); child-process only, like
+    host_fence — the parent never imports jax (jax is imported lazily in
+    the generator body, which only runs when a child enters the cm)."""
+    import jax
+
+    saved = {k: os.environ.get(k) for k in knobs}
+    try:
+        os.environ.update({k: str(v) for k, v in knobs.items()})
+        jax.clear_caches()  # env knobs are read at trace time
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        jax.clear_caches()
 
 
 def wait_for_backend() -> bool:

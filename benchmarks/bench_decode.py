@@ -4,9 +4,12 @@ The training side has deep throughput evidence (headline, sweep, 1.3B,
 ViT); this measures the INFERENCE side of the stack at realistic shapes:
 
   decode cases   batch {8, 32} x prompt 128 x dec_len 256, greedy AND
-                 top-p sampling (the `ops/sampling.py` fused sort +
-                 inverse-CDF draw that replaces the reference's CUDA
-                 topp_sampling kernel, ppfleetx/ops/topp_sampling.cu:377)
+                 top-p sampling (the `ops/sampling.py` top-k-prefilter
+                 nucleus sampler that replaces the reference's CUDA
+                 topp_sampling kernel, ppfleetx/ops/topp_sampling.cu:377);
+                 `*_legacy` variants re-trace with PFX_DECODE_ATTN=dense +
+                 PFX_DECODE_SCAN=1 (pre-overhaul attend-over-the-whole-
+                 cache scan) so every window emits an A/B row pair
   serving case   `core.serving.GenerationServer` bucketed-batch traffic
                  (mixed request sizes riding the power-of-two batch
                  buckets), i.e. the deploy path the reference serves via
@@ -36,17 +39,37 @@ import traceback
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-OUT_PATH = os.path.join(ROOT, "benchmarks", "results_decode.jsonl")
+# PFX_DECODE_RESULTS: contract tests / smoke runs point this at a tmp
+# file so CPU rows don't accumulate in the tracked evidence artifact
+OUT_PATH = os.environ.get(
+    "PFX_DECODE_RESULTS", os.path.join(ROOT, "benchmarks", "results_decode.jsonl")
+)
 
-# case -> (batch, decode_strategy).  top_p 0.9 matches the reference's
-# default nucleus setting (projects/gpt/docs generation configs).
+# case -> (batch, decode_strategy, legacy).  top_p 0.9 matches the
+# reference's default nucleus setting (projects/gpt/docs generation
+# configs).  ``*_legacy`` cases re-run the same shape with
+# PFX_DECODE_ATTN=dense + PFX_DECODE_SCAN=1 (the attend-over-the-whole-
+# cache scan path from before the decode overhaul), so every window
+# produces an A/B row pair without code changes.
 CASES = {
-    "b8_greedy": (8, "greedy_search"),
-    "b8_topp": (8, "sampling"),
-    "b32_greedy": (32, "greedy_search"),
-    "b32_topp": (32, "sampling"),
-    "serving": (None, None),  # GenerationServer bucketed-batch traffic
+    "b8_greedy": (8, "greedy_search", False),
+    "b8_greedy_legacy": (8, "greedy_search", True),
+    "b8_topp": (8, "sampling", False),
+    "b8_topp_legacy": (8, "sampling", True),
+    "b32_greedy": (32, "greedy_search", False),
+    "b32_greedy_legacy": (32, "greedy_search", True),
+    "b32_topp": (32, "sampling", False),
+    "b32_topp_legacy": (32, "sampling", True),
+    "serving": (None, None, False),  # GenerationServer bucketed-batch traffic
 }
+
+# env spellings of the two decode paths (read at trace time).  BOTH are
+# pinned explicitly around each case — a baseline row must measure the
+# overhauled path even if the caller's shell has PFX_DECODE_ATTN=dense
+# left over from an A/B session, or the evidence artifact silently
+# mislabels (the exact failure the loud-knob convention exists to stop).
+_LEGACY_ENV = {"PFX_DECODE_ATTN": "dense", "PFX_DECODE_SCAN": "1"}
+_OVERHAUL_ENV = {"PFX_DECODE_ATTN": "blocked", "PFX_DECODE_SCAN": "0"}
 
 
 def _emit(row: dict) -> None:
@@ -90,7 +113,7 @@ def run_decode_case(name: str, args, params_cache: dict) -> dict:
     from paddlefleetx_tpu.models.gpt import model as gpt
     from paddlefleetx_tpu.models.gpt.generation import GenerationConfig, generate
 
-    batch, strategy = CASES[name]
+    batch, strategy, legacy = CASES[name]
     cfg = _gpt_cfg(args)
     gen = GenerationConfig(
         decode_strategy=strategy, max_dec_len=args.dec,
@@ -105,24 +128,26 @@ def run_decode_case(name: str, args, params_cache: dict) -> dict:
     )
     key = jax.random.key(2)
 
-    from bench import host_fence
+    from bench import host_fence, knob_env
 
-    fn = jax.jit(lambda p, ids, k: generate(p, ids, cfg, gen, key=k))
-    # one-element host fetch per iteration (bench.host_fence): the axon
-    # runtime's block_until_ready has been observed returning while
-    # device work is still pending — the 2026-07-31 19:00Z rows showing
-    # 19M-160M "tok/s" were pure dispatch cost.
-    host_fence(fn(params, prompts, key))  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        host_fence(fn(params, prompts, key))
-    dt = (time.perf_counter() - t0) / args.iters
+    with knob_env(_LEGACY_ENV if legacy else _OVERHAUL_ENV):
+        fn = jax.jit(lambda p, ids, k: generate(p, ids, cfg, gen, key=k))
+        # one-element host fetch per iteration (bench.host_fence): the axon
+        # runtime's block_until_ready has been observed returning while
+        # device work is still pending — the 2026-07-31 19:00Z rows showing
+        # 19M-160M "tok/s" were pure dispatch cost.
+        host_fence(fn(params, prompts, key))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            host_fence(fn(params, prompts, key))
+        dt = (time.perf_counter() - t0) / args.iters
 
     return {
         "metric": _metric(name), "value": round(batch * args.dec / dt, 1),
         "unit": "new tokens/s/chip", "vs_baseline": None,
         "batch": batch, "prompt_len": args.prompt, "dec_len": args.dec,
         "strategy": strategy,
+        "decode_path": "legacy(dense+scan)" if legacy else "overhauled",
         "per_token_ms": round(dt / args.dec * 1e3, 3),
         "platform": jax.default_backend(),
     }
@@ -172,18 +197,23 @@ def run_serving_case(args) -> dict:
         [rng.integers(1, 50304, args.prompt).tolist() for _ in range(n)]
         for n in sizes
     ]
-    for req in reqs[:2]:  # compile both buckets outside the timed window
-        server.generate_ids(req)
-    t0 = time.perf_counter()
-    delivered = 0
-    for req in reqs:
-        outs = server.generate_ids(req)
-        delivered += sum(len(o) for o in outs)
-    dt = time.perf_counter() - t0
-    # the decode scan is static-length: the chip computes batch*dec_len new
-    # tokens per request regardless of eos trimming, so report computed
-    # tokens/s as the throughput value and delivered tokens/s alongside;
-    # normalized per chip like bench_extra (the dp mesh spreads the batch)
+    from bench import knob_env
+
+    with knob_env(_OVERHAUL_ENV):  # row is labeled "overhauled": pin it
+        for req in reqs[:2]:  # compile both buckets outside the timed window
+            server.generate_ids(req)
+        t0 = time.perf_counter()
+        delivered = 0
+        for req in reqs:
+            outs = server.generate_ids(req)
+            delivered += sum(len(o) for o in outs)
+        dt = time.perf_counter() - t0
+    # the decode loop is bounded at batch*dec_len new tokens per request
+    # (the while_loop can exit earlier once every row emits EOS, but with
+    # random weights EOS is a ~1/vocab draw, so the bound is what runs);
+    # report computed tokens/s as the throughput value and delivered
+    # tokens/s alongside; normalized per chip like bench_extra (the dp
+    # mesh spreads the batch)
     n_dev = jax.device_count()
     computed = sum(sizes) * args.dec
     return {
@@ -192,6 +222,8 @@ def run_serving_case(args) -> dict:
         "request_sizes": sizes, "prompt_len": args.prompt, "dec_len": args.dec,
         "delivered_tokens_per_s": round(delivered / dt / n_dev, 1),
         "strategy": "sampling(top_p=0.9)",
+        "decode_path": "overhauled",
+        "jit_traces": server.stats.get("traces"),
         "platform": jax.default_backend(),
     }
 
@@ -259,7 +291,11 @@ def _child(argv) -> None:
 
 def _argparser():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--cases", default="b8_greedy,b8_topp,b32_greedy,b32_topp,serving")
+    ap.add_argument(
+        "--cases",
+        default="b8_greedy,b8_greedy_legacy,b8_topp,b8_topp_legacy,"
+                "b32_greedy,b32_greedy_legacy,b32_topp,b32_topp_legacy,serving",
+    )
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--dec", type=int, default=256)
     ap.add_argument("--iters", type=int, default=3)

@@ -70,46 +70,50 @@ def main(argv=None):
     # bigger K block amortizes HBM streaming without growing the q tile
     combos = list(dict.fromkeys(
         [(256, 256), (512, 512), (512, args.seq), (256, args.seq)]))
+    from bench import knob_env
+
     for bwd_mode in ("split", "fused"):
         for block, block_k in combos:
             if args.seq % block or args.seq % block_k:
                 continue
-            os.environ["PFX_FLASH_BWD"] = bwd_mode
-            os.environ["PFX_FLASH_BLOCK"] = str(block)
-            os.environ["PFX_FLASH_BLOCK_K"] = str(block_k)
-            jax.clear_caches()  # env knobs are read at trace time
-            from paddlefleetx_tpu.ops.flash_attention import flash_attention
+            # knob_env restores the pre-combo values (pop if unset) even on
+            # error: the last combo's knobs must not leak out of main() and
+            # poison an in-process caller that traces flash attention later
+            with knob_env({"PFX_FLASH_BWD": bwd_mode,
+                           "PFX_FLASH_BLOCK": block,
+                           "PFX_FLASH_BLOCK_K": block_k}):
+                from paddlefleetx_tpu.ops.flash_attention import flash_attention
 
-            fwd = jax.jit(lambda a, b_, c: flash_attention(a, b_, c))
+                fwd = jax.jit(lambda a, b_, c: flash_attention(a, b_, c))
 
-            def loss(a, b_, c):
-                return jnp.sum(
-                    flash_attention(a, b_, c).astype(jnp.float32)
-                    * ct.astype(jnp.float32)
-                )
+                def loss(a, b_, c):
+                    return jnp.sum(
+                        flash_attention(a, b_, c).astype(jnp.float32)
+                        * ct.astype(jnp.float32)
+                    )
 
-            grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-            try:
-                t_fwd = timed(fwd, q, k, v)
-                t_all = timed(grad, q, k, v)
-            except Exception as e:  # noqa: BLE001 - report the combo, keep sweeping
-                rows.append({"bwd": bwd_mode, "block": block,
-                             "block_k": block_k,
-                             "error": str(e)[:200],
-                             "platform": jax.default_backend()})
-                print(json.dumps(rows[-1]))
-                continue
-            row = {
-                "bwd": bwd_mode, "block": block, "block_k": block_k,
-                "dtype": args.dtype,
-                "fwd_ms": round(t_fwd * 1e3, 2),
-                "fwd_bwd_ms": round(t_all * 1e3, 2),
-                "fwd_tflops": round(flops_fwd / t_fwd / 1e12, 1),
-                # CPU-interpret smoke rows must never read as chip evidence
-                "platform": jax.default_backend(),
-            }
-            rows.append(row)
-            print(json.dumps(row))
+                grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                try:
+                    t_fwd = timed(fwd, q, k, v)
+                    t_all = timed(grad, q, k, v)
+                except Exception as e:  # noqa: BLE001 - report the combo, keep sweeping
+                    rows.append({"bwd": bwd_mode, "block": block,
+                                 "block_k": block_k,
+                                 "error": str(e)[:200],
+                                 "platform": jax.default_backend()})
+                    print(json.dumps(rows[-1]))
+                    continue
+                row = {
+                    "bwd": bwd_mode, "block": block, "block_k": block_k,
+                    "dtype": args.dtype,
+                    "fwd_ms": round(t_fwd * 1e3, 2),
+                    "fwd_bwd_ms": round(t_all * 1e3, 2),
+                    "fwd_tflops": round(flops_fwd / t_fwd / 1e12, 1),
+                    # CPU-interpret smoke rows must never read as chip evidence
+                    "platform": jax.default_backend(),
+                }
+                rows.append(row)
+                print(json.dumps(row))
 
     with open(os.path.join(ROOT, "benchmarks", "kernel_results.jsonl"), "a") as f:
         for r in rows:

@@ -35,10 +35,22 @@ class CompileConfig:
 
     No param-donation knob: donating weight buffers into a jit that is
     called repeatedly deletes them after the first call — a server must
-    keep its params alive."""
+    keep its params alive.  ``donate_args`` donates POSITIONAL call
+    arguments instead (indices into ``predict(*args)``, params excluded):
+    the decode path passes its preallocated KV-cache pair here so the
+    per-step ``dynamic_update_slice`` updates in place rather than
+    copying the [layers, b, heads, max_len, head_dim] buffers every call.
+    A donated argument is CONSUMED — the caller must hand the engine a
+    fresh buffer each ``predict`` (see docs/decode_path.md)."""
 
     precision: str = "bf16"  # fp32 | bf16 | int8 (weight-only quant)
     xla_options: Optional[Dict[str, Any]] = None
+    donate_args: Tuple[int, ...] = ()
+
+    def __post_init__(self):
+        self.donate_args = tuple(int(i) for i in self.donate_args)
+        if any(i < 0 for i in self.donate_args):
+            raise ValueError(f"donate_args {self.donate_args} must be >= 0")
 
     @classmethod
     def from_config(cls, d) -> "CompileConfig":
@@ -74,7 +86,18 @@ class InferenceEngine:
         self.params = params
         jit_kwargs: Dict[str, Any] = {}
         if mesh is not None and batch_spec is not None:
-            jit_kwargs["in_shardings"] = (param_shardings, batch_spec)
+            # batch_spec: one sharding for a single-batch-arg fn, or a
+            # tuple with one entry per predict(*args) argument (required
+            # when extra args — e.g. a donated KV cache — ride along,
+            # otherwise the in_shardings structure mismatches the call)
+            specs = batch_spec if isinstance(batch_spec, tuple) else (batch_spec,)
+            jit_kwargs["in_shardings"] = (param_shardings, *specs)
+        if self.compile_cfg.donate_args:
+            # shift by one: params is argument 0 of the jitted fn and is
+            # never donated (the server keeps it alive across calls)
+            jit_kwargs["donate_argnums"] = tuple(
+                i + 1 for i in self.compile_cfg.donate_args
+            )
         self._fn = jax.jit(fn, **jit_kwargs)
         self._compiled = False
 
@@ -136,11 +159,39 @@ class InferenceEngine:
             logger.info(f"inference: first call (incl. compile) {time.time()-t0:.2f}s")
         return out
 
+    def _call_args(self, args: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        """Copy donated arguments so a repeated call does not hand the jit
+        an already-consumed buffer — mirrors the per-request allocation a
+        real caller pays for a donated KV cache."""
+        if not self.compile_cfg.donate_args:
+            return args
+        donated = set(self.compile_cfg.donate_args)
+        return tuple(
+            jax.tree.map(jnp.copy, a) if i in donated else a
+            for i, a in enumerate(args)
+        )
+
     def benchmark(self, *args: Any, iters: int = 10) -> Dict[str, float]:
-        self.predict(*args)  # warmup/compile
-        t0 = time.time()
-        for _ in range(iters):
-            out = self._fn(self.params, *args)
-        jax.block_until_ready(out)
-        dt = (time.time() - t0) / iters
+        self.predict(*self._call_args(args))  # warmup/compile
+        if not self.compile_cfg.donate_args:
+            t0 = time.time()
+            for _ in range(iters):
+                out = self._fn(self.params, *args)
+            jax.block_until_ready(out)
+            dt = (time.time() - t0) / iters
+        else:
+            # donated buffers need a fresh copy per call, but the copy must
+            # stay OUTSIDE the timed region: production (GenerationServer)
+            # re-donates the returned cache with zero copies, so timing the
+            # copy would charge the benchmark a cost the serving path never
+            # pays — time each call individually instead
+            total = 0.0
+            for _ in range(iters):
+                call_args = self._call_args(args)
+                jax.block_until_ready(call_args)
+                t0 = time.time()
+                out = self._fn(self.params, *call_args)
+                jax.block_until_ready(out)
+                total += time.time() - t0
+            dt = total / iters
         return {"latency_ms": dt * 1e3, "qps": 1.0 / dt}

@@ -5,15 +5,22 @@ logits processors, per-token sample loop with incremental KV-cache decode)
 and ``processor.py`` (LogitsProcessorList etc.).
 
 TPU-native shape discipline: the reference's dynamic Python while-loop
-becomes a static ``lax.scan`` over ``max_dec_len`` slots with an
-``unfinished`` flag (padded static shapes; XLA traces one step).  The KV
-cache is a preallocated [layers, b, max_len, heads, head_dim] pair updated
-with ``dynamic_update_slice``; prefill packs the prompt in one forward.
+becomes a bounded ``lax.while_loop`` over ``max_dec_len`` slots with an
+``unfinished`` flag (padded static shapes; XLA traces one step) that exits
+as soon as every row has emitted EOS; ``PFX_DECODE_SCAN=1`` restores the
+fixed-trip ``lax.scan`` (trace-shape debugging; beam search keeps scan).
+The KV cache is a preallocated [layers, b, heads, max_len, head_dim] pair
+(heads-major so the flash-decode kernel's block tiling keeps (seq, dim)
+minor — ``ops/decode_attention.py``) updated with ``dynamic_update_slice``;
+prefill packs the prompt in one forward.  The decode step attends only
+over cache blocks ``< ceil((pos+t)/block)``, not the whole buffer; set
+PFX_DECODE_ATTN=dense for the legacy attend-over-everything path.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -21,18 +28,22 @@ import jax.numpy as jnp
 
 from paddlefleetx_tpu.models.gpt.config import GPTConfig
 from paddlefleetx_tpu.models.gpt.model import ShardingCtx, _constrain, layer_norm
-from paddlefleetx_tpu.ops.attention import xla_attention
+from paddlefleetx_tpu.ops.decode_attention import (
+    decode_attention,
+    decode_attn_mode,
+    dense_cache_attention,
+)
 from paddlefleetx_tpu.ops.sampling import sample_logits
 
 
 class KVCache(NamedTuple):
-    k: jax.Array  # [layers, b, max_len, heads, head_dim]
+    k: jax.Array  # [layers, b, heads, max_len, head_dim]
     v: jax.Array
 
 
 def init_cache(cfg: GPTConfig, batch: int, max_len: int, dtype=None) -> KVCache:
     dtype = dtype or jnp.dtype(cfg.dtype)
-    shape = (cfg.num_layers, batch, max_len, cfg.num_attention_heads, cfg.head_dim)
+    shape = (cfg.num_layers, batch, cfg.num_attention_heads, max_len, cfg.head_dim)
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
 
@@ -54,14 +65,19 @@ def _layer_with_cache(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder layer over x [b, t, h] writing K/V at offset ``pos``.
 
-    Attends over cache[:pos+t] (left-padded garbage masked by position).
+    Attends over cache[:pos+t] via the length-aware blocked kernel
+    (``ops/decode_attention``): only cache blocks up to ceil((pos+t)/block)
+    are visited, with the causal + ``kv_valid_from`` left-pad masks folded
+    into per-block masking.  PFX_DECODE_ATTN=dense restores the legacy
+    materialized-bias attend-over-the-whole-buffer path (A/B benching).
     Under TP serving (reference GPTForGenerationHybrid hybrid_model.py:1209)
     the qkv/cache/attention stay ``heads``-sharded over the model axis and
-    the output projection row-psum is inserted by GSPMD.
+    the output projection row-psum is inserted by GSPMD; the sharded path
+    uses the lax spelling of the blocked loop (GSPMD partitions it freely,
+    a pallas_call would need shard_map).
     """
     dtype = x.dtype
     b, t, h = x.shape
-    max_len = k_cache.shape[1]
 
     y = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
     qkv = jnp.einsum("bsh,htnd->bstnd", y, p["attn"]["qkv_kernel"].astype(dtype))
@@ -69,23 +85,26 @@ def _layer_with_cache(
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     q = _constrain(ctx, q, ("batch", None, "heads", "kv"))
 
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-    k_cache = _constrain(ctx, k_cache, ("batch", None, "heads", "kv"))
-    v_cache = _constrain(ctx, v_cache, ("batch", None, "heads", "kv"))
+    # cache layout [b, heads, max_len, head_dim]: transpose the (small)
+    # step chunk, never the cache
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.transpose(0, 2, 1, 3), (0, 0, pos, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.transpose(0, 2, 1, 3), (0, 0, pos, 0)
+    )
+    k_cache = _constrain(ctx, k_cache, ("batch", "heads", None, "kv"))
+    v_cache = _constrain(ctx, v_cache, ("batch", "heads", None, "kv"))
 
-    # bias: query i (global pos+i) attends keys j <= pos+i, j < pos+t valid
-    q_pos = pos + jnp.arange(t)[:, None]
-    k_pos = jnp.arange(max_len)[None, :]
-    bias = jnp.where(k_pos <= q_pos, 0.0, -1e9)[None, None, :, :]  # [1,1,t,max]
-    if kv_valid_from is not None:
-        # left-padded serving buckets: keys before each row's first real
-        # token are masked out for every query
-        bias = bias + jnp.where(
-            k_pos >= kv_valid_from[:, None], 0.0, -1e9
-        )[:, None, None, :]
-
-    attn_out = xla_attention(q, k_cache, v_cache, causal=False, bias=bias)
+    if decode_attn_mode() == "dense":
+        attn_out = dense_cache_attention(
+            q, k_cache, v_cache, pos, kv_valid_from=kv_valid_from
+        )
+    else:
+        attn_out = decode_attention(
+            q, k_cache, v_cache, pos, kv_valid_from=kv_valid_from,
+            impl="lax" if ctx is not None else "auto",
+        )
     attn_out = jnp.einsum(
         "bsnd,ndh->bsh", attn_out, p["attn"]["out_kernel"].astype(dtype)
     ) + p["attn"]["out_bias"].astype(dtype)
@@ -223,6 +242,17 @@ class GenerationConfig:
             )
 
 
+def decode_loop_mode() -> str:
+    """PFX_DECODE_SCAN: "1" restores the fixed-trip ``lax.scan`` decode
+    loop (trace-shape debugging; also what beam search always uses), "0"/
+    unset selects the early-exit ``lax.while_loop``.  Loud parse — a typo
+    must not silently A/B while-vs-while on a chip window."""
+    env = os.environ.get("PFX_DECODE_SCAN") or "0"
+    if env not in ("0", "1"):
+        raise ValueError(f"PFX_DECODE_SCAN={env!r}; valid: 0, 1")
+    return "scan" if env == "1" else "while"
+
+
 def _left_pad_prefill(prompt_len: int, prompt_lens: Optional[jax.Array]):
     """(pad_len [b], prefill position ids [b, P]) for left-padded buckets;
     (None, None) on the unpadded path."""
@@ -259,6 +289,8 @@ def generate(
     key: Optional[jax.Array] = None,
     ctx: Optional[ShardingCtx] = None,
     prompt_lens: Optional[jax.Array] = None,
+    cache: Optional[KVCache] = None,
+    return_cache: bool = False,
 ) -> jax.Array:
     """input_ids [b, prompt_len] -> generated ids [b, max_dec_len]
     (eos/pad-filled after finish).
@@ -271,7 +303,23 @@ def generate(
 
     Pass ``ctx`` to serve on a mesh: the KV cache and attention stay
     heads-sharded over the model axis (TP serving parity with the
-    reference's GPTForGenerationHybrid, hybrid_model.py:1209)."""
+    reference's GPTForGenerationHybrid, hybrid_model.py:1209).
+
+    ``cache``: optionally pass a preallocated ``init_cache(cfg, b,
+    prompt_len + max_dec_len)`` buffer instead of allocating inside the
+    trace — a caller jitting generate can then DONATE it
+    (``donate_argnums``) so the per-step ``dynamic_update_slice`` writes
+    in place instead of copying the pair each step.  A donated cache is
+    CONSUMED: the caller must not touch it after the call.  Donation only
+    aliases an input to an OUTPUT buffer, so pair it with
+    ``return_cache=True`` — the returned final cache occupies the donated
+    buffer and can be donated straight back on the next same-shape call
+    (``core/serving.py`` keeps a per-bucket pool doing exactly that;
+    stale tail slots are safe because the blocked kernel never visits
+    blocks beyond ``pos + t``).
+
+    ``return_cache``: return ``(tokens, final KVCache)`` instead of
+    tokens (sampling/greedy only)."""
     if cfg.num_experts > 1:
         raise NotImplementedError("KV-cache generation for MoE models unsupported")
     b, prompt_len = input_ids.shape
@@ -294,10 +342,23 @@ def generate(
     if key is None:
         key = jax.random.key(0)
     if gen.decode_strategy == "beam_search":
+        if cache is not None or return_cache:
+            raise ValueError(
+                "cache donation/return is not supported for beam_search (the "
+                "beam loop reorders the cache by parent each step)"
+            )
         return beam_search(params, input_ids, cfg, gen, ctx=ctx, prompt_lens=prompt_lens)
 
     pad_len, prefill_pos_ids = _left_pad_prefill(prompt_len, prompt_lens)
-    cache = init_cache(cfg, b, max_len)
+    if cache is None:
+        cache = init_cache(cfg, b, max_len)
+    else:
+        want = (cfg.num_layers, b, cfg.num_attention_heads, max_len, cfg.head_dim)
+        if cache.k.shape != want:
+            raise ValueError(
+                f"provided cache shape {cache.k.shape} != required {want} "
+                f"(prompt {prompt_len} + max_dec_len {gen.max_dec_len})"
+            )
     vocab = cfg.vocab_size
     valid = (
         jnp.ones((b, prompt_len), jnp.int32)
@@ -369,8 +430,34 @@ def generate(
         token_counts=token_counts0,
         key=key,
     )
-    carry, tokens = jax.lax.scan(step, carry0, jnp.arange(gen.max_dec_len))
-    return tokens.T  # [b, max_dec_len]
+    if decode_loop_mode() == "scan":
+        carry, tokens = jax.lax.scan(step, carry0, jnp.arange(gen.max_dec_len))
+        tokens = tokens.T  # [b, max_dec_len]
+        return (tokens, carry.cache) if return_cache else tokens
+
+    # early-exit while_loop: the scan runs all max_dec_len steps even after
+    # every row emitted EOS (each a full forward over the batch); the while
+    # loop stops as soon as nothing is unfinished.  Token-for-token parity
+    # with the scan: the buffer starts pad-filled, and the scan likewise
+    # emits pad for every step after all rows finish (nxt is forced to
+    # pad_token_id once unfinished is False), so skipped slots are
+    # identical — asserted by tests/test_generation.py.
+    tokens0 = jnp.full((b, gen.max_dec_len), gen.pad_token_id, jnp.int32)
+
+    def loop_cond(st):
+        carry, i, _ = st
+        return (i < gen.max_dec_len) & jnp.any(carry.unfinished)
+
+    def loop_body(st):
+        carry, i, tokens = st
+        new_carry, nxt = step(carry, i)
+        tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None], (0, i))
+        return new_carry, i + 1, tokens
+
+    carry, _, tokens = jax.lax.while_loop(
+        loop_cond, loop_body, (carry0, jnp.int32(0), tokens0)
+    )
+    return (tokens, carry.cache) if return_cache else tokens  # [b, max_dec_len]
 
 
 # ---------------------------------------------------------------------------

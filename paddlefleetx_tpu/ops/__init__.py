@@ -1,1 +1,2 @@
-"""Custom TPU ops: Pallas flash attention, fused LayerNorm, chunked CE, top-p sampling."""
+"""Custom TPU ops: Pallas flash attention, flash-decode (blocked KV-cache)
+attention, fused LayerNorm, chunked CE, top-k-prefiltered top-p sampling."""

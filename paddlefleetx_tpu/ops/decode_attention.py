@@ -1,0 +1,334 @@
+"""Flash-decode attention: length-aware blocked KV-cache attention.
+
+TPU-native replacement for the decode step's attend-over-everything
+``xla_attention(q, k_cache, v_cache, bias=[.,1,t,max_len])``: the cache is
+preallocated at ``prompt_len + max_dec_len``, but at step ``pos`` only the
+first ``pos + t`` slots hold real keys.  The dense path pays FLOPs and HBM
+reads for the whole buffer every token; this op visits only the cache
+blocks ``< ceil((pos + t) / block)`` and folds the causal + left-pad
+(``kv_valid_from``) masks into per-block masking, so per-token cost scales
+with the tokens generated so far instead of the preallocated maximum.
+
+Online-softmax accumulation across blocks (same residual trick as the
+flash forward in ``ops/flash_attention.py``): running max ``m``, running
+denominator ``l``, fp32 accumulator rescaled by ``exp(m - m_new)`` per
+block — bitwise layout-independent of how many blocks are visited.
+
+Two implementations behind one entry point:
+
+  - ``pallas``: one grid program per (batch, head); the kernel fori-loops
+    over visited blocks with a runtime trip count read from a scalar
+    input.  Runs on TPU; interpret mode elsewhere (tests force it).
+    KNOWN LIMIT: like the flash fwd kernel, the BlockSpec streams the
+    full [max_len, d] cache row into VMEM per program, so the length
+    scaling applies to FLOPs but NOT to the HBM reads — converting the
+    kv fetch to scalar-prefetch-clamped per-block DMA (paged-attention
+    style) is the chip-window follow-up; note the partial last block
+    must keep the in-kernel dslice clamp, since a grid-blocked tail
+    would matmul against out-of-bounds padding (0 * NaN poisons the
+    accumulator even under the mask).  Until then the first chip A/B
+    should also compare PFX-forced lax-vs-pallas: the lax spelling's
+    ``dynamic_slice`` IS length-scaled in traffic too.
+  - ``lax``: the same blocked loop as ``lax.fori_loop`` +
+    ``dynamic_slice`` — CPU fallback and the path used under GSPMD
+    sharding (a pallas_call inside a partitioned jit would need
+    shard_map; XLA partitions the lax loop for free).
+
+Cache layout is [batch, heads, max_len, head_dim] (heads-major) so the
+Pallas block tiling keeps (seq, head_dim) as the minor dims — see
+``models/gpt/generation.KVCache``.
+
+Env knobs (PFX_FLASH_* loud-parse convention — an invalid value raises
+instead of silently mislabeling a chip sweep):
+
+  PFX_DECODE_BLOCK  kv block size (default 256; positive multiple of 8)
+  PFX_DECODE_ATTN   "blocked" (default) | "dense" — generation-layer
+                    dispatch, read at trace time; "dense" restores the
+                    attend-over-everything path for A/B benching
+
+Inference-only: the blocked loop has a data-dependent trip count (a
+``while_loop`` under the hood), so it is not reverse-differentiable.
+Training attention stays on ``ops/attention.py`` / ``ops/flash_attention``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+_DEFAULT_BLOCK = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _parse_int_env(name: str) -> int:
+    env = os.environ.get(name) or "0"
+    try:
+        return int(env)
+    except ValueError:
+        raise ValueError(
+            f"{name}={env!r} is not an integer; pass a positive multiple "
+            f"of 8 (e.g. 256) or unset it"
+        ) from None
+
+
+def decode_block(max_len: int, block: int = 0) -> int:
+    """Resolve the kv block size: explicit ``block`` arg, else
+    PFX_DECODE_BLOCK, else {_DEFAULT_BLOCK}; clamped to ``max_len``.
+
+    Unlike the flash block, the decode block need NOT divide the cache
+    length — the last block is handled by a clamped start + dedup mask —
+    but it must be a positive multiple of 8 (TPU sublane tiling), and an
+    invalid override fails loudly in both spellings.  When the CLAMP
+    breaks alignment (a cache shorter than the requested block and not
+    itself a multiple of 8, e.g. max_len 20) the block rounds DOWN to the
+    nearest multiple of 8 so the Pallas tiling invariant survives; only a
+    cache shorter than 8 slots yields a sub-8 block, and
+    :func:`decode_attention` routes that degenerate case to the lax
+    spelling (Mosaic could not tile it)."""
+    force = int(block) or _parse_int_env("PFX_DECODE_BLOCK")
+    if force:
+        if force < 0 or force % 8:
+            raise ValueError(
+                f"decode block {force} must be a positive multiple of 8 "
+                "(block arg / PFX_DECODE_BLOCK)"
+            )
+    else:
+        force = _DEFAULT_BLOCK
+    clamped = min(force, max_len)
+    if clamped % 8 and clamped > 8:
+        clamped -= clamped % 8
+    return clamped
+
+
+def decode_attn_mode() -> str:
+    """PFX_DECODE_ATTN dispatch read by the generation layer at trace
+    time: "blocked" (this op) or "dense" (the legacy attend-over-the-
+    whole-cache path, kept for A/B rows)."""
+    mode = os.environ.get("PFX_DECODE_ATTN") or "blocked"
+    if mode not in ("blocked", "dense"):
+        raise ValueError(
+            f"PFX_DECODE_ATTN={mode!r}; valid: blocked, dense"
+        )
+    return mode
+
+
+def blocks_visited(limit, block: int, max_len: int):
+    """Number of kv blocks the kernel visits for keys [0, limit).
+
+    ``limit`` may be traced (pos + t inside the decode loop); the result
+    bounds the fori_loop trip count.  Exposed for tests asserting the
+    decode step no longer touches cache blocks beyond ``pos + t``."""
+    total = -(-max_len // block)
+    return jnp.minimum((limit + block - 1) // block, total)
+
+
+# ---------------------------------------------------------------------------
+# lax fallback (CPU + GSPMD path)
+# ---------------------------------------------------------------------------
+
+
+def _decode_lax(q_t, k_cache, v_cache, limit, valid_from, block, scale):
+    """q_t [b, n, t, d]; caches [b, n, L, d]; limit = pos + t (traced ok).
+
+    Returns [b, n, t, d] fp32-accumulated attention over keys [vf, limit).
+    """
+    b, n, t, d = q_t.shape
+    max_len = k_cache.shape[2]
+    q_pos = limit - t + jnp.arange(t)  # global position of each query row
+
+    m0 = jnp.full((b, n, t), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n, t), jnp.float32)
+    acc0 = jnp.zeros((b, n, t, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        # the last block would overrun the cache; clamp the start and mask
+        # the overlap (col < j*block was handled by the previous block)
+        start = jnp.maximum(jnp.minimum(j * block, max_len - block), 0)
+        k = jax.lax.dynamic_slice_in_dim(k_cache, start, block, axis=2)
+        v = jax.lax.dynamic_slice_in_dim(v_cache, start, block, axis=2)
+        s = scale * jnp.einsum(
+            "bntd,bnkd->bntk", q_t, k, preferred_element_type=jnp.float32
+        )  # [b, n, t, block]
+        col = start + jnp.arange(block)  # [block]
+        mask = (col[None, :] <= q_pos[:, None]) & (col[None, :] >= j * block)
+        mask = mask[None, None]  # [1, 1, t, block]
+        if valid_from is not None:
+            mask = mask & (
+                col[None, None, None, :] >= valid_from[:, None, None, None]
+            )
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bntk,bnkd->bntd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    nvisit = blocks_visited(limit, block, max_len)
+    m, l, acc = jax.lax.fori_loop(0, nvisit, body, (m0, l0, acc0))
+    # fully-masked query rows (left-pad positions) get 0, not NaN: they
+    # feed nothing downstream (only the last, always-real row is sampled)
+    return acc / jnp.maximum(l, 1e-30)[..., None]
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel (TPU; interpret mode in tests)
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    q_ref, k_ref, v_ref, limit_ref, vf_ref, o_ref, *, scale, block, max_len, t
+):
+    q = q_ref[0, 0]  # [t, d], native dtype; dots accumulate fp32
+    d = q.shape[-1]
+    limit = limit_ref[0, 0]
+    vf = vf_ref[0, 0, 0]
+    row_pos = (limit - t) + jax.lax.broadcasted_iota(jnp.int32, (t, block), 0)
+
+    m0 = jnp.full((t,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((t,), jnp.float32)
+    acc0 = jnp.zeros((t, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        start = jnp.maximum(jnp.minimum(j * block, max_len - block), 0)
+        k = k_ref[0, 0, pl.dslice(start, block), :]
+        v = v_ref[0, 0, pl.dslice(start, block), :]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [t, block]
+        col = start + jax.lax.broadcasted_iota(jnp.int32, (t, block), 1)
+        mask = (col <= row_pos) & (col >= j * block) & (col >= vf)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    nvisit = blocks_visited(limit, block, max_len)
+    m, l, acc = jax.lax.fori_loop(0, nvisit, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _decode_pallas(q_t, k_cache, v_cache, limit, valid_from, block, scale):
+    b, n, t, d = q_t.shape
+    max_len = k_cache.shape[2]
+    limit_arr = jnp.full((1, 1), limit, jnp.int32)
+    vf_arr = (
+        jnp.zeros((b, 1, 1), jnp.int32)
+        if valid_from is None
+        else valid_from.astype(jnp.int32).reshape(b, 1, 1)
+    )
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, block=block, max_len=max_len, t=t
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, n),
+        in_specs=[
+            pl.BlockSpec((1, 1, t, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, max_len, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, max_len, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, 1, 1), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, t, d), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, n, t, d), jnp.float32),
+        interpret=_interpret(),
+    )(q_t, k_cache, v_cache, limit_arr, vf_arr)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Public entry
+# ---------------------------------------------------------------------------
+
+
+def decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    pos: jax.Array,
+    *,
+    kv_valid_from: Optional[jax.Array] = None,
+    block: int = 0,
+    impl: str = "auto",
+) -> jax.Array:
+    """Blocked KV-cache attention over keys [0, pos + t).
+
+    q [b, t, n, d] at global positions [pos, pos+t); k_cache/v_cache
+    [b, n, max_len, d] with real keys through pos+t (the current chunk
+    already written).  ``kv_valid_from`` [b] masks keys before a row's
+    first real token (left-padded serving buckets).  Returns [b, t, n, d].
+
+    ``impl``: "auto" (pallas on TPU, lax elsewhere) | "pallas" | "lax".
+    """
+    if impl not in ("auto", "pallas", "lax"):
+        raise ValueError(f"decode_attention impl {impl!r}; valid: auto, pallas, lax")
+    b, t, n, d = q.shape
+    max_len = k_cache.shape[2]
+    bs = decode_block(max_len, block)
+    scale = float(1.0 / (d**0.5))
+    limit = pos + t
+    q_t = q.transpose(0, 2, 1, 3)  # [b, n, t, d]
+    # a sub-8 block only happens for a degenerate cache shorter than 8
+    # slots (decode_block rounds down otherwise): Mosaic cannot sublane-
+    # tile it, so route to the lax spelling
+    use_pallas = impl == "pallas" or (impl == "auto" and not _interpret())
+    if use_pallas and bs % 8 == 0:
+        out = _decode_pallas(q_t, k_cache, v_cache, limit, kv_valid_from, bs, scale)
+    else:
+        out = _decode_lax(q_t, k_cache, v_cache, limit, kv_valid_from, bs, scale)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def dense_cache_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    pos: jax.Array,
+    *,
+    kv_valid_from: Optional[jax.Array] = None,
+) -> jax.Array:
+    """The legacy decode attention: attend over the ENTIRE preallocated
+    cache with a materialized [., 1, t, max_len] additive bias (what
+    ``_layer_with_cache`` did via ``xla_attention`` before the blocked
+    kernel).  Kept verbatim-in-semantics for PFX_DECODE_ATTN=dense A/B
+    benchmark rows; same [b, n, L, d] cache layout, no extra transposes,
+    so a legacy row measures the old math, not a layout penalty."""
+    b, t, n, d = q.shape
+    max_len = k_cache.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    q_pos = pos + jnp.arange(t)[:, None]
+    k_pos = jnp.arange(max_len)[None, :]
+    bias = jnp.where(k_pos <= q_pos, 0.0, -1e9)[None, None, :, :]  # [1,1,t,L]
+    if kv_valid_from is not None:
+        bias = bias + jnp.where(
+            k_pos >= kv_valid_from[:, None], 0.0, -1e9
+        )[:, None, None, :]
+    scores = jnp.einsum(
+        "btnd,bnkd->bntk", q, k_cache, preferred_element_type=jnp.float32
+    ) * scale
+    scores = scores + bias.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bntk,bnkd->bntd", probs, v_cache)
+    return out.transpose(0, 2, 1, 3)

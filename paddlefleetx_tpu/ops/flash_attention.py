@@ -58,6 +58,15 @@ def _block_sizes(seq: int, block: int = 0) -> Tuple[int, int]:
         # of-8 seq would die in Mosaic lowering, so it falls through to
         # the unsupported return below and attention() uses XLA instead
         return seq, _block_k_override(seq, seq)
+    # unsupported-seq fallback: still parse + validate a set block_k
+    # override FIRST so a set-but-invalid PFX_FLASH_BLOCK_K fails loudly
+    # on this path too (a seq that misses the ladder, e.g. 1000, must not
+    # silently drop the knob and mislabel a sweep); a VALID override is
+    # then ignored along with the rest of the ladder — the XLA fallback
+    # has no blocks to apply it to
+    bk = _parse_block_env("PFX_FLASH_BLOCK_K")
+    if bk:
+        _check_block(bk, seq, "block_k; PFX_FLASH_BLOCK_K")
     return 256, 256  # does not divide seq -> flash_supported() False
 
 

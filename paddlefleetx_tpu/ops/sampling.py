@@ -5,15 +5,19 @@ kernel (``ppfleetx/ops/topp_sampling.cu``: per-batch top-k beam pass + cub
 segmented radix sort + prefix-scan threshold cut) and the Python
 ``TopKProcess``/``TopPProcess`` (single_model.py:1237-1257, processor.py).
 
-On TPU the sort + scan route maps directly onto XLA's highly tuned
-``sort``/``cumsum``; the reference's beam-search shortcut (skip the sort
-when a prefix of top-k tokens already covers p) is kept as a fast path via
-``jax.lax.top_k`` over a fixed beam, falling back to the full sort only when
-needed — all branch-free under jit.
+On TPU the full sort + scan route maps directly onto XLA's highly tuned
+``sort``/``cumsum``; the reference kernel's beam shortcut (skip the sort
+when a prefix of top-k tokens already covers p) is the DEFAULT fast path:
+``lax.top_k`` over a fixed candidate count (64, the CUDA kernel's max
+beam), exact whenever every row's nucleus fits the candidates, with a
+``lax.cond``-guarded fallback to the full sort when one overflows — see
+:func:`sample_top_p_topk`.  PFX_TOPP_K overrides the candidate count
+(0 disables the fast path); invalid values fail loudly at trace time.
 """
 
 from __future__ import annotations
 
+import os
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +78,63 @@ def sample_top_p(
     return jnp.take_along_axis(order, idx_sorted[:, None], axis=-1)[:, 0]
 
 
+def _parse_prefilter_env() -> int:
+    env = os.environ.get("PFX_TOPP_K") or ""
+    if not env:
+        return -1
+    try:
+        val = int(env)
+    except ValueError:
+        raise ValueError(
+            f"PFX_TOPP_K={env!r} is not an integer; pass a positive "
+            "candidate count (e.g. 64), 0 to disable the fast path, or "
+            "unset it"
+        ) from None
+    if val < 0:
+        raise ValueError(f"PFX_TOPP_K={val} must be >= 0")
+    return val
+
+
+def sample_top_p_topk(
+    key: jax.Array,
+    probs: jax.Array,
+    top_p: jax.Array,
+    k: int = 64,
+) -> jax.Array:
+    """Nucleus sample with a top-k prefilter (the ``topp_sampling.cu``
+    contract: a fixed top-k beam pass first, the expensive full sort only
+    when the beam does not cover p).
+
+    ``lax.top_k(probs, k)`` returns the k best already sorted descending,
+    so when the whole batch's top-k mass covers its ``top_p`` the nucleus
+    lives entirely inside the k candidates: truncate/renormalize those,
+    inverse-CDF draw, and map the drawn index back through the top-k
+    indices — EXACT against :func:`sample_top_p` (same nucleus, same
+    uniform draw, same prefix sums) while sorting k instead of the 50k
+    vocab.  Rows are batched under jit, so the guard is all-rows-covered;
+    any overflow row (``cum_k < p``) routes the WHOLE batch to the full
+    sort via ``lax.cond`` (one runtime branch, both traced)."""
+    b, v = probs.shape
+    k = min(int(k), v)
+    top_probs, top_idx = jax.lax.top_k(probs, k)  # sorted descending
+    cum = jnp.cumsum(top_probs, axis=-1)
+
+    def fast(_):
+        in_nucleus = cum - top_probs < top_p[:, None]
+        in_nucleus = in_nucleus.at[:, 0].set(True)  # always keep argmax
+        trunc = jnp.where(in_nucleus, top_probs, 0.0)
+        total = trunc.sum(axis=-1, keepdims=True)
+        u = jax.random.uniform(key, (b, 1)) * total
+        sel = jnp.argmax(jnp.cumsum(trunc, axis=-1) >= u, axis=-1)
+        return jnp.take_along_axis(top_idx, sel[:, None], axis=-1)[:, 0]
+
+    def slow(_):
+        return sample_top_p(key, probs, top_p)
+
+    covered = jnp.all(cum[:, -1] >= top_p)
+    return jax.lax.cond(covered, fast, slow, operand=None)
+
+
 def sample_logits(
     key: jax.Array,
     logits: jax.Array,
@@ -81,14 +142,26 @@ def sample_logits(
     temperature: float = 1.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    top_p_prefilter_k: int = 64,
 ) -> jax.Array:
     """Reference sampling pipeline (single_model.py:1237-1257):
-    temperature -> top-k -> top-p -> categorical."""
+    temperature -> top-k -> top-p -> categorical.
+
+    The top-p stage goes through the top-k-prefilter fast path
+    (:func:`sample_top_p_topk`, ``top_p_prefilter_k`` candidates —
+    PFX_TOPP_K overrides, 0 disables) so the per-step cost is a top-k
+    over the vocab instead of a full argsort+cumsum; the full sort runs
+    only when some row's nucleus overflows the prefilter."""
     if temperature != 1.0:
         logits = logits / temperature
     if top_k > 0:
         logits = top_k_filter(logits, top_k)
     if top_p < 1.0:
         probs = jax.nn.softmax(logits, axis=-1)
-        return sample_top_p(key, probs, jnp.full((logits.shape[0],), top_p))
+        top_ps = jnp.full((logits.shape[0],), top_p)
+        env_k = _parse_prefilter_env()
+        k = top_p_prefilter_k if env_k < 0 else env_k
+        if k <= 0:
+            return sample_top_p(key, probs, top_ps)
+        return sample_top_p_topk(key, probs, top_ps, k=k)
     return jax.random.categorical(key, logits, axis=-1)

@@ -174,6 +174,24 @@ def drop_small_fsdp(shardings: Any, shapes: Any, min_size: int = 1 << 16) -> Any
     return jax.tree.map(fix, shardings, shapes)
 
 
+def _ambient_abstract_mesh():
+    """The active abstract mesh, or None when there is none.
+
+    ``jax.sharding.get_abstract_mesh`` is only re-exported on jax >= 0.5;
+    older jaxlibs keep it under ``jax._src.mesh`` (and return an empty
+    placeholder instead of a real mesh when no context is active), so
+    normalize both spellings here instead of crashing every TP
+    constraint on the public-attribute lookup."""
+    getter = getattr(jax.sharding, "get_abstract_mesh", None)
+    if getter is None:
+        try:
+            from jax._src.mesh import get_abstract_mesh as getter
+        except ImportError:  # pragma: no cover — future jax w/o either
+            return None
+    mesh = getter()
+    return mesh if getattr(mesh, "axis_names", None) else None
+
+
 def with_logical_constraint(x: jax.Array, logical_axes, rules, mesh: Mesh):
     """`lax.with_sharding_constraint` via logical names (activation sharding).
 
@@ -181,7 +199,6 @@ def with_logical_constraint(x: jax.Array, logical_axes, rules, mesh: Mesh):
     where some axes are Manual) the bare PartitionSpec form must be used —
     a NamedSharding would pin the all-Auto outer mesh and mismatch."""
     spec = logical_to_spec(logical_axes, rules)
-    abstract = jax.sharding.get_abstract_mesh()
-    if abstract is not None and abstract.axis_names:
+    if _ambient_abstract_mesh() is not None:
         return jax.lax.with_sharding_constraint(x, spec)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -29,6 +29,15 @@ jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
+# Subprocess-based tests (golden-doc walkthroughs, config launches, bench
+# contracts, distributed workers) each boot a fresh python that never sees
+# the jax.config lines above — export the same cache dir through the
+# environment so their XLA compiles hit the shared persistent cache too.
+# setdefault: an explicit caller override always wins.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 
 @pytest.fixture
 def devices8():

@@ -76,3 +76,63 @@ def test_bench_deadline_emits_honest_zero():
     assert len(rows) == 1, out.stdout
     assert rows[0]["value"] == 0.0
     assert "deadline" in rows[0]["unit"], rows[0]
+
+
+# ---------------------------------------------------------------------------
+# Decode bench (benchmarks/bench_decode.py): same parent/child honest-zero
+# contract, exercised at the CPU tiny case pinned in
+# benchmarks/cases/decode_tiny_cpu.json so the chip-day smoke case and the
+# pytest lock can never drift apart.
+# ---------------------------------------------------------------------------
+
+DECODE_CASE = os.path.join(REPO, "benchmarks", "cases", "decode_tiny_cpu.json")
+
+
+def _decode_case():
+    with open(DECODE_CASE) as f:
+        return json.load(f)
+
+
+def _run_bench_decode(extra_env, timeout, tmp_path):
+    case = _decode_case()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(case["env"])
+    # keep CPU contract rows out of the tracked results_decode.jsonl
+    env["PFX_DECODE_RESULTS"] = str(tmp_path / "results_decode.jsonl")
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "bench_decode.py"),
+         *case["args"]],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=timeout,
+    ), case
+
+
+@pytest.mark.slow
+def test_bench_decode_happy_path_contract(tmp_path):
+    out, case = _run_bench_decode(
+        {"BENCH_DECODE_DEADLINE_S": "400"}, timeout=460, tmp_path=tmp_path
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = {r["metric"]: r for r in _json_lines(out.stdout)}
+    assert set(rows) == set(case["expect_metrics"]), out.stdout
+    for row in rows.values():
+        assert set(row) >= {"metric", "value", "unit", "vs_baseline"}
+        assert row["value"] > 0
+        assert row["platform"] == "cpu"
+    # the A/B pair: one overhauled row, one legacy row, same shape keys
+    paths = {r["decode_path"] for r in rows.values()}
+    assert paths == {"overhauled", "legacy(dense+scan)"}, rows
+
+
+@pytest.mark.slow
+def test_bench_decode_deadline_emits_honest_zero(tmp_path):
+    out, case = _run_bench_decode(
+        {"BENCH_DECODE_DEADLINE_S": "1"}, timeout=120, tmp_path=tmp_path
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = _json_lines(out.stdout)
+    assert {r["metric"] for r in rows} == set(case["expect_metrics"]), out.stdout
+    for row in rows:
+        assert row["value"] == 0.0
+        assert "deadline" in row["unit"] or "did not" in row["unit"], row

@@ -1,7 +1,5 @@
 """Flash-attention kernel vs XLA reference (interpret mode on CPU)."""
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -209,7 +207,7 @@ def test_asymmetric_block_k_matches_reference(monkeypatch):
     np.testing.assert_allclose(g_asym_fused, g_sym, rtol=1e-5, atol=1e-5)
 
 
-def test_config_knobs_reach_kernel():
+def test_config_knobs_reach_kernel(monkeypatch):
     """Model.flash_block / Model.flash_bwd thread through the GPT model to
     the kernel (loss parity with the defaults proves the plumbed kernel
     actually ran with valid parameters)."""
@@ -228,7 +226,9 @@ def test_config_knobs_reach_kernel():
     }.items():
         env_bk = kw.pop("_env_bk", None)
         if env_bk is not None:
-            os.environ["PFX_FLASH_BLOCK_K"] = env_bk
+            # monkeypatch (not raw os.environ): a mid-loop assert must not
+            # leak PFX_FLASH_BLOCK_K into later tests in this process
+            monkeypatch.setenv("PFX_FLASH_BLOCK_K", env_bk)
             jax.clear_caches()  # env knob is read at trace time
         cfg = GPTConfig(
             vocab_size=64, hidden_size=32, num_layers=2,
@@ -243,7 +243,7 @@ def test_config_knobs_reach_kernel():
         assert np.isfinite(float(loss))
         losses[name] = float(loss)
         if env_bk is not None:
-            del os.environ["PFX_FLASH_BLOCK_K"]
+            monkeypatch.delenv("PFX_FLASH_BLOCK_K")
             jax.clear_caches()
     np.testing.assert_allclose(
         losses["block64_fused"], losses["default"], rtol=1e-5
@@ -290,3 +290,23 @@ def test_bf16_accuracy_vs_f32_reference():
         np.testing.assert_allclose(
             np.asarray(b_, np.float32), np.asarray(a), rtol=0.0, atol=0.35
         )
+
+
+def test_block_k_override_loud_on_unsupported_seq(monkeypatch):
+    """ADVICE r5: a set-but-invalid PFX_FLASH_BLOCK_K must fail loudly on
+    EVERY path, including the unsupported-seq fallback (e.g. seq=1000
+    misses the ladder) — not be silently dropped with the ladder."""
+    from paddlefleetx_tpu.ops.flash_attention import _block_sizes, flash_supported
+
+    monkeypatch.setenv("PFX_FLASH_BLOCK_K", "not-an-int")
+    with pytest.raises(ValueError, match="PFX_FLASH_BLOCK_K"):
+        _block_sizes(1000)
+    monkeypatch.setenv("PFX_FLASH_BLOCK_K", "256")  # does not divide 1000
+    with pytest.raises(ValueError, match="divisor"):
+        flash_supported(1000)
+    # a VALID override on an unsupported seq is ignored with the rest of
+    # the ladder (the XLA fallback has no blocks to apply it to)
+    monkeypatch.setenv("PFX_FLASH_BLOCK_K", "8")  # divides 1000, mult of 8
+    assert not flash_supported(1000)
+    monkeypatch.delenv("PFX_FLASH_BLOCK_K")
+    assert not flash_supported(1000)

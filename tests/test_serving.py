@@ -157,3 +157,56 @@ def test_empty_prompt_rejected(server):
         server.generate_ids([])
     with pytest.raises(ValueError, match="non-empty"):
         server.generate_ids([[]])
+
+
+def test_mixed_traffic_never_retraces_a_seen_bucket(server):
+    """The per-(bucket_b, bucket_len, GenerationConfig) jit memo: repeated
+    mixed-size traffic must stop tracing once each bucket has been seen —
+    stats["traces"] counts trace-time entries of the decode fn."""
+    reqs = [
+        [[1, 2, 3]],                      # batch bucket 1, prompt bucket 16
+        [[4, 5], [6, 7, 8], [9, 1]],      # batch bucket 4 (padded)
+        [list(range(1, 20))],             # prompt bucket 32
+    ]
+    for r in reqs:  # populate every bucket
+        server.generate_ids(r)
+    seen = server.stats["traces"]
+    assert seen >= len(reqs) - 1  # at least one trace per distinct bucket
+    for _ in range(3):  # repeat traffic: NO new traces allowed
+        for r in reqs:
+            server.generate_ids(r)
+    assert server.stats["traces"] == seen
+
+
+def test_decode_cache_is_donated(server):
+    """The jitted decode consumes the per-request KV cache buffer: the
+    compiled fn reports the cache args as donated (in-place update, no
+    per-step copy of the [layers,b,heads,max_len,dim] pair)."""
+    server.generate_ids([[1, 2, 3]])
+    gen_key = next(iter(server._compiled))
+    fn = server._compiled[gen_key]
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from paddlefleetx_tpu.models.gpt.generation import init_cache
+
+    cfg = server.module.config
+    prompt = _jnp.zeros((gen_key[1], gen_key[2]), _jnp.int32)
+    lens = _jnp.ones((gen_key[1],), _jnp.int32)
+    cache = init_cache(cfg, gen_key[1], gen_key[2] + gen_key[0].max_dec_len)
+    lowered = fn.lower(
+        server.params, prompt, lens, _jax.random.key(0), cache
+    )
+    donated = lowered.args_info  # pytree of ArgInfo with .donated
+    flags = [a.donated for a in _jax.tree.leaves(donated)]
+    assert sum(flags) == 2, flags  # exactly the cache k/v pair
+
+
+def test_cache_pool_is_lru_bounded(server):
+    """Each pooled cache pins a device k/v pair; mixed traffic across
+    many buckets must not retain more than Generation.cache_pool_size
+    pairs (LRU eviction, default 4)."""
+    for dec in (3, 2, 1):  # distinct gen configs -> distinct bucket keys
+        for prompt in ([[1, 2]], [[1, 2], [3, 4], [5, 6]]):
+            server.generate_ids(prompt, max_dec_len=dec)
+    assert len(server._cache_pool) <= server._cache_pool_size
