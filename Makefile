@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all bench
+.PHONY: lint test-fast test-mid test-std test-all test-fault bench
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -45,6 +45,11 @@ test-std:
 
 test-all:
 	python -m pytest tests/ -q
+
+# fault-tolerance drills: PFX_FAULT crash-resume parity through the real
+# CLI + the resilience/checkpoint-integrity units (docs/fault_tolerance.md)
+test-fault:
+	python -m pytest tests/test_fault_tolerance.py tests/test_fault_injection.py -q
 
 bench:
 	python benchmarks/run_benchmark.py

@@ -164,6 +164,30 @@ class Engine:
         self._async_ckptr = None
         self._save_thread = None
         self._save_error = None
+        self._atexit_registered = False
+        # retention GC: keep only the newest N complete checkpoints
+        # (0 = keep everything); the last verified-good one — the anomaly
+        # rollback target — is never deleted regardless of age
+        self.keep_last_n = int(eng.get("save_load", {}).get("keep_last_n", 0) or 0)
+        self._last_good_ckpt: Optional[str] = None
+        # preemption contract (utils/resilience.py): SIGTERM/SIGINT during
+        # fit finishes the in-flight step, saves with a `preempted` marker,
+        # and fit returns with this flag set so the launcher exits 0
+        self.preempted = False
+        # exit_after_save (tools/train.py --exit-after-save): stop cleanly
+        # right after the next periodic checkpoint completes — bounds a
+        # preemptible-slice run to checkpoint-aligned work units
+        self.exit_after_save = bool(eng.get("exit_after_save", False))
+        # anomaly guard budgets (Engine.resilience block): past them the
+        # engine rolls back to the last checkpoint instead of skipping or
+        # diverging forever; see utils/resilience.AnomalyGuard
+        res = eng.get("resilience", {}) or {}
+        self.res_enable = bool(res.get("enable", True))
+        self.res_max_skip_streak = int(res.get("max_skip_streak", 10))
+        self.res_spike_zscore = float(res.get("loss_spike_zscore", 0.0))
+        self.res_spike_streak = int(res.get("loss_spike_streak", 5))
+        self.res_loss_window = int(res.get("loss_window", 64))
+        self.res_max_rollbacks = int(res.get("max_rollbacks", 2))
         # QAT (reference Compress.Quantization, compression_helper.py:19-79):
         # fake-quantized weights in the forward, fp32 masters updated
         from paddlefleetx_tpu.utils.compression import build_qat_transform
@@ -600,8 +624,11 @@ class Engine:
                     "in tools/convert_hf_gpt2.py must match Model.vocab_size):\n  "
                     + "\n  ".join(mismatched)
                 )
+            # .copy(): device_put of a host numpy array can be zero-copy on
+            # CPU; these params are later DONATED by the train step, so they
+            # must live in XLA-owned buffers (same hazard as load(), below)
             loaded = jax.tree.map(
-                lambda t, n: jax.device_put(np.asarray(n, t.dtype), t.sharding),
+                lambda t, n: jax.device_put(np.asarray(n, t.dtype), t.sharding).copy(),
                 state.params,
                 loaded,
             )
@@ -923,7 +950,12 @@ class Engine:
             )
 
     def fit(self, train_loader: Iterable, eval_loader: Optional[Iterable] = None):
-        """Training loop (reference fit/_fit_impl eager_engine.py:422-520)."""
+        """Training loop (reference fit/_fit_impl eager_engine.py:422-520).
+
+        Preemption-aware: SIGTERM/SIGINT finishes the in-flight step, joins
+        any async save, writes a final checkpoint with a ``preempted``
+        marker, and returns with ``self.preempted`` set — the launcher
+        (tools/train.py) then exits 0 so a relaunch auto-resumes."""
         self._require_concrete("fit")
         t_last = time.time()
         window_tokens = 0
@@ -933,25 +965,156 @@ class Engine:
         # config-gated trace window (reference Profiler block,
         # eager_engine.py:250-272 + profiler.step :419)
         from paddlefleetx_tpu.utils.profiler import ProfilerHook
+        from paddlefleetx_tpu.utils.resilience import PreemptionGuard
 
         profiler = ProfilerHook(self.cfg.get("Profiler"))
+        self.preempted = False
+        preempt = PreemptionGuard().install()
         try:
             return self._fit_loop(
-                train_loader, eval_iter, tokens_per_sample, profiler, t_last, window_tokens
+                train_loader, eval_iter, tokens_per_sample, profiler, t_last,
+                window_tokens, preempt
             )
         finally:
+            preempt.uninstall()
             # flush an in-flight trace even when a step raises
             profiler.close()
             # a checkpoint still writing in background must become durable
             # before fit returns (callers may exit the process right after)
             self.wait_for_save()
 
-    def _fit_loop(self, train_loader, eval_iter, tokens_per_sample, profiler, t_last, window_tokens):
+    def _build_anomaly_guard(self):
+        from paddlefleetx_tpu.utils.resilience import AnomalyGuard
+
+        if not self.res_enable or (
+            self.res_max_skip_streak <= 0 and self.res_spike_zscore <= 0
+        ):
+            return None
+        return AnomalyGuard(
+            max_skip_streak=self.res_max_skip_streak,
+            spike_zscore=self.res_spike_zscore,
+            spike_streak=self.res_spike_streak,
+            window=self.res_loss_window,
+        )
+
+    def _rollback(self, step: int, reason: str, rollbacks: int) -> None:
+        """Anomaly response: restore params+opt-state from the last good
+        checkpoint and let the loop re-enter from there.  Bounded: past
+        ``resilience.max_rollbacks`` (or with no checkpoint to return to)
+        the run fails loudly instead of thrashing."""
+        # an async save may be seconds from durable: join it first so its
+        # checkpoint counts as the rollback target (the finisher thread is
+        # what records _last_good_ckpt)
+        self.wait_for_save()
+        if self._last_good_ckpt is None:
+            raise RuntimeError(
+                f"anomaly budget exceeded at step {step} ({reason}) and no "
+                "checkpoint exists to roll back to — enable periodic saves "
+                "(Engine.save_load.save_steps) or disable the guard "
+                "(Engine.resilience.enable=False)"
+            )
+        if rollbacks >= self.res_max_rollbacks:
+            raise RuntimeError(
+                f"anomaly budget exceeded at step {step} ({reason}) after "
+                f"{rollbacks} rollback(s) — max_rollbacks="
+                f"{self.res_max_rollbacks} exhausted; the run is not "
+                "recovering, stopping instead of thrashing"
+            )
+        logger.error(
+            f"ANOMALY at step {step}: {reason}; rolling back to "
+            f"{self._last_good_ckpt} (rollback {rollbacks + 1}/"
+            f"{self.res_max_rollbacks})"
+        )
+        self._write_metrics(
+            {
+                "event": "rollback",
+                "step": step,
+                "reason": reason,
+                "ckpt": self._last_good_ckpt,
+                "rollback_index": rollbacks + 1,
+            }
+        )
+        # the LIVE data-stream position: every step served so far plus the
+        # just-dispatched (discarded) batch.  load() resets the counter to
+        # the checkpoint's value, but the loader does NOT rewind — leaving
+        # the stale count would make the next save record a consumed_samples
+        # behind the true stream, and a later crash+auto_resume would then
+        # re-serve batches, breaking the resume-parity contract.
+        live_consumed = self._consumed_samples + self.global_batch_size
+        self.load(self._last_good_ckpt)
+        self._consumed_samples = live_consumed
+
+    def _preempt_save(self, step: int, cause: str) -> None:
+        """Final checkpoint on the clean-exit path (signal or
+        exit_after_save): join any in-flight async write first so the two
+        saves can't interleave, then save with the ``preempted`` marker.
+
+        When the periodic save already wrote this exact step (signal
+        landing on a save boundary), only the meta marker is re-stamped —
+        re-writing multi-GB arrays inside the preemption grace window for
+        a flag would be the worst possible use of that window."""
+        logger.warning(
+            f"{cause} at step {step}: writing final checkpoint, then "
+            "exiting cleanly for auto-resume"
+        )
+        self.wait_for_save()
+        expected = os.path.abspath(os.path.join(self.output_dir, f"step_{step}"))
+        if self._last_good_ckpt == expected:
+            try:
+                with open(os.path.join(expected, "meta.json")) as f:
+                    meta = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                meta = {"step": step, "consumed_samples": self._consumed_samples}
+            meta["preempted"] = True
+            self._write_meta(expected, meta)
+            path = expected
+            logger.info(f"preempt marker stamped on existing {expected}")
+        else:
+            path = self.save(preempted=True)
+            self.wait_for_save()
+        self._write_metrics(
+            {"event": "preempt_save", "step": step, "cause": cause, "ckpt": path}
+        )
+        self.preempted = True
+
+    def _fit_loop(self, train_loader, eval_iter, tokens_per_sample, profiler,
+                  t_last, window_tokens, preempt=None):
+        from paddlefleetx_tpu.utils import resilience
+
+        guard = self._build_anomaly_guard()
+        # metrics of the previous step, observed AFTER the next step has
+        # been dispatched: step N-1 necessarily finished before step N
+        # runs on device, so the fetch resolves while step N computes and
+        # the guard never idles the device (async dispatch stays ahead)
+        prev_metrics = None
+        rollbacks = 0
         for batch in train_loader:
             if self._step >= self.max_steps:
                 break
+            if resilience.maybe_fire("nan_grads", self._step + 1):
+                batch = resilience.poison_batch(batch)
             dev_batch = self._put_batch(batch)
             self.state, metrics = self._train_step(self.state, dev_batch)
+            if guard is not None and prev_metrics is not None:
+                pm = jax.device_get(prev_metrics)
+                reason = guard.observe(
+                    float(pm["loss"]), float(pm["found_inf"]) > 0
+                )
+                if reason is not None:
+                    # the step just dispatched is discarded along with the
+                    # anomalous state: load() replaces self.state and
+                    # restores the step/consumed counters from the meta.
+                    # The data stream does NOT rewind — same contract as a
+                    # process restart mid-epoch.
+                    self._rollback(self._step, reason, rollbacks)
+                    rollbacks += 1
+                    guard.reset()
+                    prev_metrics = None
+                    continue
+            if guard is not None:
+                prev_metrics = {
+                    "loss": metrics["loss"], "found_inf": metrics["found_inf"]
+                }
             self._consumed_samples += self.global_batch_size
             window_tokens += self.global_batch_size * tokens_per_sample
             self._step += 1
@@ -995,8 +1158,36 @@ class Engine:
 
             if self.save_steps and step % self.save_steps == 0:
                 self.save()
+                # a save landing while the guard sees a healthy stream is
+                # proof of recovery: the budget guards against rollback
+                # THRASH, not against independent anomalies days apart in
+                # a long run.  The streak check matters — saves fire on
+                # skipped steps too, and resetting mid-streak would let a
+                # persistent anomaly roll back forever.
+                if guard is None or (
+                    guard.skip_streak == 0 and guard.spike_streak == 0
+                ):
+                    rollbacks = 0
                 t_last = time.time()
                 window_tokens = 0
+                if self.exit_after_save:
+                    # checkpoint-aligned clean exit: the save above is
+                    # durable once wait_for_save joins (fit's finally);
+                    # reuse the preempted flag so the launcher exits 0
+                    logger.info(
+                        f"exit_after_save: checkpoint at step {step} "
+                        "complete, exiting cleanly"
+                    )
+                    self.wait_for_save()
+                    self.preempted = True
+                    break
+
+            # fault injection: deliver a real SIGTERM to this process so
+            # the handler path itself is what the test exercises
+            sig_fired = resilience.maybe_fire("sigterm", step)
+            if (preempt is not None and preempt.requested) or sig_fired:
+                self._preempt_save(step, "preemption signal")
+                break
 
         return self.state
 
@@ -1064,9 +1255,52 @@ class Engine:
             if err is not None:
                 raise err
 
-    def save(self, path: Optional[str] = None):
+    def _finish_save(self, path: str, step: int) -> None:
+        """Post-save bookkeeping shared by the sync and async paths: record
+        the rollback target, run the fault-injection bit-rot hook, then the
+        retention GC (which never deletes the recorded last-good dir).
+
+        Known limit: "good" here means "saved and durable", not "loss
+        verified healthy" — a save landing within the spike detector's
+        observation window of a finite divergence can record diverging
+        state as the rollback target; max_rollbacks then stops the thrash
+        and older checkpoints stay on disk for a manual resume
+        (docs/fault_tolerance.md)."""
+        from paddlefleetx_tpu.utils import resilience
+
+        self._last_good_ckpt = path
+        resilience.maybe_fire("ckpt_truncate", step, path=path)
+        if self.keep_last_n and jax.process_index() == 0:
+            from paddlefleetx_tpu.utils.checkpoint import gc_checkpoints
+
+            try:
+                gc_checkpoints(
+                    self.output_dir, self.keep_last_n, protect=self._last_good_ckpt
+                )
+            except OSError as e:
+                # GC is best-effort housekeeping: a failed delete must not
+                # take down the save (the checkpoint itself is durable)
+                logger.warning(f"checkpoint retention GC failed: {e}")
+
+    def _atexit_join(self) -> None:
+        """Interpreter-exit safety net (registered once, first async save):
+        a SIGTERM-driven sys.exit while ``_save_thread`` is in flight must
+        not strand a meta-less directory — join the write so it either
+        completes (meta.json lands) or its error is logged.  Errors are
+        logged, not raised: atexit swallows exceptions anyway."""
+        try:
+            self.wait_for_save()
+        except BaseException as e:  # noqa: BLE001 — last-chance reporting
+            logger.error(f"async checkpoint write failed during exit: {e}")
+
+    def save(self, path: Optional[str] = None, preempted: bool = False):
+        """Checkpoint the full train state.  ``preempted=True`` stamps the
+        meta (written by the preemption path) so operators and tooling can
+        distinguish a scheduled save from a SIGTERM final save."""
         self._require_concrete("save")
         import orbax.checkpoint as ocp
+
+        from paddlefleetx_tpu.utils import resilience
 
         step = int(self.state.step)
         path = os.path.abspath(path or os.path.join(self.output_dir, f"step_{step}"))
@@ -1074,6 +1308,8 @@ class Engine:
         if self.state.extra is not None:
             payload["extra"] = self.state.extra
         meta = {"step": step, "consumed_samples": self._consumed_samples}
+        if preempted:
+            meta["preempted"] = True
         if self.state.scaler is not None:
             meta["loss_scale"] = float(self.state.scaler["scale"])
             meta["scaler_good_steps"] = int(self.state.scaler["good_steps"])
@@ -1086,6 +1322,26 @@ class Engine:
                 self._async_ckptr = ocp.AsyncCheckpointer(
                     ocp.StandardCheckpointHandler()
                 )
+            if not self._atexit_registered:
+                # interpreter exit (sys.exit, end of main) must join the
+                # background write: without this a clean exit right after
+                # save() could leave a forever-incomplete directory when
+                # the finisher thread loses the shutdown race.  Registered
+                # over a weakref so atexit does not pin the Engine (and
+                # its params/opt-state trees) for the process lifetime in
+                # multi-Engine processes (test suites, notebooks).
+                import atexit
+                import weakref
+
+                ref = weakref.ref(self)
+
+                def _join_at_exit(ref=ref):
+                    eng = ref()
+                    if eng is not None:
+                        eng._atexit_join()
+
+                atexit.register(_join_at_exit)
+                self._atexit_registered = True
             # returns once arrays are snapshotted to host — the training
             # loop may donate the live buffers immediately after; the
             # directory write continues in background
@@ -1095,11 +1351,13 @@ class Engine:
                 force=True,
             )
 
-            def finish(ckptr=self._async_ckptr, path=path, meta=meta):
+            def finish(ckptr=self._async_ckptr, path=path, meta=meta, step=step):
                 try:
                     ckptr.wait_until_finished()
+                    resilience.maybe_fire("save_crash", step)
                     self._write_meta(path, meta)
                     logger.info(f"saved checkpoint (async): {path}")
+                    self._finish_save(path, step)
                 except BaseException as e:  # noqa: BLE001 — surfaced by
                     # wait_for_save; meta.json is never written, so resume
                     # correctly skips the incomplete directory
@@ -1113,16 +1371,26 @@ class Engine:
             self._save_thread.start()
             return path
 
+        from paddlefleetx_tpu.utils.resilience import retry
+
         ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.join(path, "state"), payload, force=True)
-        ckptr.wait_until_finished()
+
+        def write():
+            ckptr.save(os.path.join(path, "state"), payload, force=True)
+            ckptr.wait_until_finished()
+
+        retry(write, desc=f"checkpoint save {path}")
+        resilience.maybe_fire("save_crash", step)
         self._write_meta(path, meta)
         logger.info(f"saved checkpoint: {path}")
+        self._finish_save(path, step)
         return path
 
     def load(self, path: str):
         self._require_concrete("load")
         import orbax.checkpoint as ocp
+
+        from paddlefleetx_tpu.utils.resilience import retry
 
         self.wait_for_save()  # never restore over a half-written save
         path = os.path.abspath(path)
@@ -1145,7 +1413,23 @@ class Engine:
                 self.state.extra,
                 self.extra_shardings,
             )
-        restored = ckptr.restore(os.path.join(path, "state"), target)
+        # transient-storage retry only: corruption raises ValueError from
+        # the tensorstore layer and propagates immediately so the caller
+        # (checkpoint.resume_with_fallback) can quarantine + fall back
+        restored = retry(
+            lambda: ckptr.restore(os.path.join(path, "state"), target),
+            desc=f"checkpoint restore {path}",
+        )
+        # Deep-copy into XLA-owned buffers.  Orbax/tensorstore-born arrays
+        # can be zero-copy views of host memory the restore pipeline still
+        # owns; the train step DONATES its state (donate_argnums=0), and
+        # donating such a view corrupts the first post-resume update
+        # (non-finite params, occasionally a shutdown segfault) once the
+        # persistent compile cache makes the executable available before
+        # the restore buffers settle.  Found by the crash-resume parity
+        # tests (tests/test_fault_injection.py); the copy is one-time load
+        # cost and makes every restored leaf donation-safe.
+        restored = jax.tree.map(lambda x: x.copy(), restored)
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
         self._consumed_samples = int(meta.get("consumed_samples", 0))
@@ -1164,4 +1448,7 @@ class Engine:
             extra=restored.get("extra"),
             scaler=scaler,
         )
+        # a checkpoint that restored IS verified-good: it becomes the
+        # anomaly-rollback target until the next successful save
+        self._last_good_ckpt = path
         logger.info(f"loaded checkpoint: {path} (step {meta['step']})")

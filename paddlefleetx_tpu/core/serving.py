@@ -86,8 +86,12 @@ class GenerationServer:
 
         self._cache_pool: "OrderedDict" = OrderedDict()
         self._cache_pool_size = int(gen_cfg.get("cache_pool_size", 4))
+        # last_latency_s: wall-clock of the most recent generate_ids call —
+        # /healthz surfaces it so operators see a slow/regressed decode
+        # without scraping logs (tools/serve.py)
         self.stats: Dict[str, float] = {
             "requests": 0, "tokens_out": 0, "time_s": 0.0, "traces": 0,
+            "last_latency_s": 0.0,
         }
 
     def _decode_fn(self, gen: GenerationConfig, batch: int, bucket_len: int):
@@ -211,6 +215,7 @@ class GenerationServer:
         self.stats["requests"] += 1
         self.stats["tokens_out"] += sum(len(o) for o in outs)
         self.stats["time_s"] += dt
+        self.stats["last_latency_s"] = round(dt, 4)
         return outs
 
     def generate_text(self, prompts: Sequence[str], max_dec_len: Optional[int] = None):

@@ -1,30 +1,47 @@
-"""Small checkpoint helpers shared by the CLI tools.
+"""Checkpoint helpers: discovery, integrity validation, quarantine,
+retention GC, and params-only restore.
 
 The full train-state save/load contract lives in the Engine
-(core/engine.py, orbax + meta.json); deploy-side tools only ever need the
-params subtree of a saved state — this is that one snippet, in one place.
+(core/engine.py, orbax + meta.json); this module owns everything AROUND a
+saved directory: deciding whether it is restorable, picking the newest
+good one for auto-resume (quarantining corrupt ones so the crash-loop
+falls back instead of wedging), and bounding how many the run keeps.
+
+Checkpoint validity has two tiers:
+
+  - **structural** (`validate_checkpoint`, cheap, no orbax import): a
+    parseable ``meta.json`` (written last + atomically by the Engine, so
+    it marks write-completeness) AND an orbax payload dir (``state/`` or
+    ``params/``) holding ``_METADATA`` plus non-empty array data.  Catches
+    crashed saves, half-synced dirs, and stray ``meta.json``-only stubs.
+  - **restorability**: only an actual orbax restore proves the bytes are
+    sound.  Bit-rot inside an array file passes the structural check; the
+    Engine's load (and `restore_params`) quarantine on restore failure so
+    the next resume attempt falls back to the previous good directory.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+import shutil
+from typing import Any, List, Optional, Tuple
+
+from paddlefleetx_tpu.utils.log import logger
+
+CORRUPT_SUFFIX = ".corrupt"
 
 
-def latest_checkpoint(output_dir: str) -> Optional[str]:
-    """Newest ``step_N`` checkpoint dir under ``output_dir`` (None if none).
-
-    Only complete checkpoints count: the Engine writes meta.json last (and
-    atomically), so a dir without a *parseable* meta.json is a crashed save
-    and is skipped — the crash-loop then falls back to the previous one.
-    """
-    import json
-
-    best_step, best = -1, None
+def _step_dirs(output_dir: str) -> List[Tuple[int, str]]:
+    """(step, path) for every ``step_N`` dir with a PARSEABLE meta.json,
+    newest first.  Dirs without a parseable meta are crashed/in-flight
+    saves: skipped here (never quarantined — an async save from a live
+    process legitimately has no meta yet)."""
+    found: List[Tuple[int, str]] = []
     if not os.path.isdir(output_dir):
-        return None
+        return found
     for name in os.listdir(output_dir):
-        if not name.startswith("step_"):
+        if not name.startswith("step_") or name.endswith(CORRUPT_SUFFIX):
             continue
         path = os.path.join(output_dir, name)
         try:
@@ -33,35 +50,282 @@ def latest_checkpoint(output_dir: str) -> Optional[str]:
                 json.load(f)
         except (ValueError, OSError, json.JSONDecodeError):
             continue
-        if step > best_step:
-            best_step, best = step, path
-    return best
+        found.append((step, path))
+    found.sort(reverse=True)
+    return found
+
+
+def validate_checkpoint(path: str) -> Optional[str]:
+    """Structural integrity check; returns None when OK, else the reason.
+
+    Validates beyond meta.json: the orbax payload dir must exist, carry
+    its ``_METADATA`` tree descriptor, and hold non-empty array data —
+    a meta.json-only stub (half-synced restore source, crashed post-save
+    cleanup) must not be selected for resume."""
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return f"meta.json missing/unparseable ({e})"
+    payload = None
+    for sub in ("state", "params"):
+        if os.path.isdir(os.path.join(path, sub)):
+            payload = sub
+            break
+    if payload is None:
+        return "no state/ or params/ payload dir"
+    root = os.path.join(path, payload)
+    if not os.path.exists(os.path.join(root, "_METADATA")):
+        return f"{payload}/_METADATA missing (interrupted array write)"
+    # array dirs present: the ocdbt layout stores chunk data under d/
+    # (consolidated) and/or ocdbt.process_*/d/ (per-process); a payload
+    # with tree metadata but no chunk bytes is a half-synced stub
+    import glob
+
+    data_files = glob.glob(os.path.join(root, "d", "*")) + glob.glob(
+        os.path.join(root, "ocdbt.process_*", "d", "*")
+    )
+    if not any(os.path.getsize(f) > 0 for f in data_files):
+        return f"{payload}/ holds no array data"
+    return None
+
+
+def quarantine_checkpoint(path: str) -> str:
+    """Rename a corrupt checkpoint dir to ``<path>.corrupt`` (``.corrupt.N``
+    when colliding) so resume cannot pick it again; returns the new path.
+    Loud by design: a quarantine should never scroll past unnoticed.
+
+    Race-tolerant for multi-host resume over shared storage: when another
+    process already renamed (or removed) the dir, the rename's
+    FileNotFoundError is absorbed — the goal (that path no longer selects)
+    is achieved either way, and crashing the loser host would recreate the
+    crash-loop this module exists to prevent."""
+    path = os.path.abspath(path.rstrip("/"))
+    dst = path + CORRUPT_SUFFIX
+    n = 1
+    while os.path.exists(dst):
+        dst = f"{path}{CORRUPT_SUFFIX}.{n}"
+        n += 1
+    try:
+        os.rename(path, dst)
+    except FileNotFoundError:
+        logger.warning(
+            f"quarantine of {path}: already renamed/removed by another "
+            "process; continuing"
+        )
+        return dst
+    logger.error(
+        f"QUARANTINED corrupt checkpoint: {path} -> {dst} "
+        "(inspect or delete manually; resume falls back to the previous "
+        "good checkpoint)"
+    )
+    return dst
+
+
+class QuarantineBudget:
+    """Shared cap on how many directories one logical resume attempt may
+    quarantine, across BOTH the structural walk (latest_checkpoint) and
+    the restore-failure path (resume_with_fallback) — without a shared
+    counter, alternating structural/bit-rot failures could multiply the
+    two bounds and still eat the history."""
+
+    def __init__(self, remaining: int) -> None:
+        self.remaining = int(remaining)
+
+    def spend(self, path: str, reason: str, output_dir: str) -> None:
+        """Quarantine ``path`` if budget remains, else raise the systemic
+        error."""
+        if self.remaining <= 0:
+            raise RuntimeError(
+                f"quarantine budget exhausted under {output_dir} and {path} "
+                f"failed too ({reason}) — this is systemic (storage, "
+                "config/topology mismatch), not per-checkpoint corruption; "
+                "refusing to quarantine further"
+            )
+        quarantine_checkpoint(path)
+        self.remaining -= 1
+
+
+def latest_checkpoint(
+    output_dir: str,
+    validate: bool = True,
+    quarantine: bool = True,
+    max_quarantines: int = 3,
+    budget: Optional[QuarantineBudget] = None,
+) -> Optional[str]:
+    """Newest restorable ``step_N`` checkpoint dir (None if none).
+
+    Only complete checkpoints count: the Engine writes meta.json last (and
+    atomically), so a dir without a parseable meta.json is a crashed save
+    and is skipped.  With ``validate`` (the default), each candidate must
+    also pass the structural check; a newest-but-broken checkpoint is
+    quarantined (renamed ``*.corrupt``) when ``quarantine`` is set, and
+    selection falls back to the next older one.
+
+    Quarantines are bounded by ``max_quarantines`` per call (or by a
+    caller-shared ``budget``): more broken-looking dirs in a row than
+    that means the problem is systemic (a storage mount showing
+    half-synced dirs, a layout change breaking the validator) — renaming
+    the entire history over it would destroy good checkpoints, so the
+    walk stops with a loud error instead."""
+    budget = budget if budget is not None else QuarantineBudget(max_quarantines)
+    for _step, path in _step_dirs(output_dir):
+        if not validate:
+            return path
+        reason = validate_checkpoint(path)
+        if reason is None:
+            return path
+        logger.error(f"checkpoint {path} failed validation: {reason}")
+        if quarantine:
+            budget.spend(path, reason, output_dir)
+    return None
+
+
+def gc_checkpoints(
+    output_dir: str, keep_last_n: int, protect: Optional[str] = None
+) -> List[str]:
+    """Retention GC: delete all but the newest ``keep_last_n`` complete
+    ``step_N`` dirs.  ``protect`` (the last verified-good checkpoint — the
+    rollback target) is NEVER deleted regardless of age.  Structurally
+    invalid dirs don't count toward the keep quota (keeping N corrupt dirs
+    while deleting the good one would defeat the fallback); they are left
+    in place for `latest_checkpoint` to quarantine.  Returns the removed
+    paths."""
+    if keep_last_n <= 0:
+        return []
+    protect_abs = os.path.abspath(protect) if protect else None
+    kept = 0
+    removed: List[str] = []
+    for _step, path in _step_dirs(output_dir):
+        if validate_checkpoint(path) is not None:
+            continue
+        if kept < keep_last_n or os.path.abspath(path) == protect_abs:
+            kept += 1
+            continue
+        shutil.rmtree(path)
+        removed.append(path)
+        logger.info(f"retention GC (keep_last_n={keep_last_n}): removed {path}")
+    return removed
+
+
+# Substrings of the ValueError messages tensorstore/zarr/orbax raise for
+# BAD BYTES (observed: "DATA_LOSS: ... Error decoding local file ...
+# manifest", "OUT_OF_RANGE: ... Error reading ... in OCDBT database").
+# Only these quarantine a directory: a ValueError can equally mean a
+# config/topology mismatch (shape/sharding/tree vs the restore target),
+# which condemns EVERY checkpoint and must propagate instead of renaming
+# good multi-GB artifacts over a config typo.
+_CORRUPTION_MARKERS = (
+    "DATA_LOSS", "OUT_OF_RANGE", "Error decoding", "Error reading",
+    "Error opening", "manifest", "ocdbt", "zarr", "checksum",
+)
+
+
+def is_corruption_error(e: BaseException) -> bool:
+    """True when a restore failure indicates bad bytes in THIS directory
+    (quarantine-worthy), as opposed to a systemic problem — retry-exhausted
+    transient I/O (RuntimeError), OOM, orbax API drift, or a restore-target
+    mismatch — that is no evidence against the checkpoint itself.
+    json.JSONDecodeError (rotten meta.json) is a ValueError subclass."""
+    if isinstance(e, json.JSONDecodeError):
+        return True
+    if not isinstance(e, ValueError):
+        return False
+    msg = str(e)
+    return any(marker in msg for marker in _CORRUPTION_MARKERS)
+
+
+def resume_with_fallback(
+    engine, output_dir: str, max_quarantines: int = 3
+) -> Optional[str]:
+    """auto_resume: load the newest valid checkpoint into ``engine``,
+    quarantining any whose RESTORE fails with a corruption error (bit-rot
+    passes the structural check) and falling back to the next older one.
+    Returns the path that loaded, or None when no checkpoint exists.
+
+    Two guards bound the blast radius so a systemic failure can never eat
+    the whole checkpoint history: only corruption-class errors
+    (``is_corruption_error``) quarantine — a storage outage that survives
+    the retry budget, or a config/topology mismatch that breaks EVERY
+    dir, re-raises on the spot — and at most ``max_quarantines``
+    directories are quarantined per resume attempt, SHARED between the
+    structural walk and restore failures via one QuarantineBudget (more
+    corrupt-in-a-row than that means the problem is not the
+    checkpoints)."""
+    budget = QuarantineBudget(max_quarantines)
+    while True:
+        path = latest_checkpoint(output_dir, budget=budget)
+        if path is None:
+            return None
+        logger.info(f"auto_resume: found {path}")
+        try:
+            engine.load(path)
+            return path
+        except Exception as e:  # noqa: BLE001 — classified right below
+            if not is_corruption_error(e):
+                raise
+            logger.error(
+                f"auto_resume: checkpoint {path} failed to load ({e}); "
+                "quarantining and falling back"
+            )
+            budget.spend(path, str(e), output_dir)
 
 
 def restore_params(ckpt_dir: str) -> Any:
     """Params from either checkpoint layout: a full Engine state dir
     (``state/`` holding params+opt_state) or a params-only dir
-    (``params/``, e.g. from tools/convert_hf_gpt2.py)."""
+    (``params/``, e.g. from tools/convert_hf_gpt2.py).
+
+    Transient I/O errors are retried (PFX_RETRY_* knobs); a restore that
+    still fails quarantines the directory and raises an actionable error
+    naming the quarantined path."""
     import orbax.checkpoint as ocp
 
-    ckpt_dir = os.path.abspath(ckpt_dir)
-    if os.path.isdir(os.path.join(ckpt_dir, "params")):
-        return ocp.StandardCheckpointer().restore(os.path.join(ckpt_dir, "params"))
-    # full train-state checkpoint: partially restore ONLY the params subtree
-    # (a standard restore would materialize the optimizer moments — ~2x the
-    # param bytes — on the host just to throw them away)
-    import jax
+    from paddlefleetx_tpu.utils.resilience import retry
 
-    path = os.path.join(ckpt_dir, "state")
-    ckptr = ocp.PyTreeCheckpointer()
-    meta = ckptr.metadata(path)
-    tree = getattr(meta, "item_metadata", meta)
-    tree = getattr(tree, "tree", tree)
-    item = {"params": jax.tree.map(lambda _m: 0.0, dict(tree)["params"])}
-    restored = ckptr.restore(
-        path, args=ocp.args.PyTreeRestore(item=item, partial_restore=True)
-    )
-    return restored["params"]
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    try:
+        if os.path.isdir(os.path.join(ckpt_dir, "params")):
+            return retry(
+                lambda: ocp.StandardCheckpointer().restore(
+                    os.path.join(ckpt_dir, "params")
+                ),
+                desc=f"params restore {ckpt_dir}",
+            )
+        # full train-state checkpoint: partially restore ONLY the params
+        # subtree (a standard restore would materialize the optimizer
+        # moments — ~2x the param bytes — on the host just to throw away)
+        import jax
+
+        path = os.path.join(ckpt_dir, "state")
+        ckptr = ocp.PyTreeCheckpointer()
+        meta = ckptr.metadata(path)
+        tree = getattr(meta, "item_metadata", meta)
+        tree = getattr(tree, "tree", tree)
+        item = {"params": jax.tree.map(lambda _m: 0.0, dict(tree)["params"])}
+        restored = retry(
+            lambda: ckptr.restore(
+                path,
+                args=ocp.args.PyTreeRestore(item=item, partial_restore=True),
+            ),
+            desc=f"params restore {ckpt_dir}",
+        )
+        return restored["params"]
+    except Exception as e:  # noqa: BLE001 — classified right below
+        # only corruption-class failures condemn the directory; an
+        # exhausted transient retry (RuntimeError), OOM, a restore-target
+        # mismatch, or orbax API drift propagates untouched — renaming a
+        # good multi-GB artifact over a code bug would be worse than the
+        # corruption it guards
+        if not is_corruption_error(e) or not os.path.isdir(ckpt_dir):
+            raise
+        quarantined = quarantine_checkpoint(ckpt_dir)
+        raise RuntimeError(
+            f"checkpoint {ckpt_dir} failed to restore and was quarantined "
+            f"to {quarantined}: {e}.  Re-fetch the artifact, or (for "
+            "training resume) rely on auto_resume falling back to the "
+            "previous good step_N directory."
+        ) from e
 
 
 def load_pretrained_params(cfg) -> Optional[Any]:
@@ -76,8 +340,6 @@ def save_params_checkpoint(out_dir: str, params, source: str, model_fields: dict
     """Write the params-only checkpoint contract shared by the HF import
     tools: ``params/`` (orbax), ``meta.json`` (format+source), and
     ``model.yaml`` (the matching Model config block)."""
-    import json
-
     import orbax.checkpoint as ocp
 
     out = os.path.abspath(out_dir)

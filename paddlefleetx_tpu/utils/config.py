@@ -251,6 +251,19 @@ def process_engine_config(cfg: AttrDict) -> AttrDict:
     save.setdefault("save_epoch", 1)
     save.setdefault("output_dir", "./output")
     save.setdefault("ckpt_dir", None)
+    save.setdefault("auto_resume", False)
+    # retention GC: newest N complete checkpoints kept (0 = keep all); the
+    # last verified-good one is never deleted (docs/fault_tolerance.md)
+    save.setdefault("keep_last_n", 0)
+    # anomaly guard budgets (core/engine.py + utils/resilience.py): past
+    # them the engine rolls back to the last checkpoint
+    res = eng.setdefault("resilience", AttrDict())
+    res.setdefault("enable", True)
+    res.setdefault("max_skip_streak", 10)
+    res.setdefault("loss_spike_zscore", 0.0)  # 0 disables spike detection
+    res.setdefault("loss_spike_streak", 5)
+    res.setdefault("loss_window", 64)
+    res.setdefault("max_rollbacks", 2)
     return cfg
 
 
@@ -281,5 +294,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         action="append",
         default=[],
         help="override config option key.sub=value (repeatable)",
+    )
+    parser.add_argument(
+        "--exit-after-save",
+        action="store_true",
+        help="stop cleanly (exit 0) right after the next periodic "
+        "checkpoint completes — checkpoint-aligned work units for "
+        "preemptible slices (docs/fault_tolerance.md)",
     )
     return parser.parse_args(argv)

@@ -50,26 +50,42 @@ def check_md5(path: str, md5sum: Optional[str]) -> bool:
 
 
 def _download(url: str, dst: str, md5sum: Optional[str]) -> str:
+    """Fetch ``url`` to ``dst`` atomically with bounded retries (the shared
+    utils/resilience.retry helper: PFX_RETRY_* knobs apply; default
+    attempts come from DOWNLOAD_RETRY_LIMIT for reference parity)."""
+    from paddlefleetx_tpu.utils.resilience import _env_int, retry
+
     os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
-    last_err: Optional[Exception] = None
-    for attempt in range(1, DOWNLOAD_RETRY_LIMIT + 1):
+
+    def fetch():
         tmp_fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(dst) or ".")
         os.close(tmp_fd)
         try:
-            logger.info(f"downloading {url} (attempt {attempt})")
+            logger.info(f"downloading {url}")
             with urllib.request.urlopen(url) as r, open(tmp_path, "wb") as f:
                 shutil.copyfileobj(r, f)
             if not check_md5(tmp_path, md5sum):
+                # a checksum mismatch IS retryable here: the mirror may
+                # have served a truncated body this attempt
                 raise IOError(f"checksum mismatch downloading {url}")
             os.replace(tmp_path, dst)  # atomic: cache never half-written
             return dst
-        except Exception as e:  # noqa: BLE001 — retry any transport error
-            last_err = e
+        finally:
             if os.path.exists(tmp_path):
                 os.remove(tmp_path)
-    raise RuntimeError(
-        f"download of {url} failed after {DOWNLOAD_RETRY_LIMIT} attempts"
-    ) from last_err
+
+    import http.client
+
+    return retry(
+        fetch,
+        attempts=_env_int("PFX_RETRY_ATTEMPTS", DOWNLOAD_RETRY_LIMIT, minimum=1),
+        # urllib transport errors are URLError/HTTPError (OSError
+        # subclasses), but a connection dropped MID-BODY surfaces from
+        # copyfileobj as http.client.IncompleteRead — an HTTPException,
+        # NOT an OSError — and must stay retryable too
+        retryable=(OSError, http.client.HTTPException),
+        desc=f"download {url}",
+    )
 
 
 def cached_path(

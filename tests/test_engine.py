@@ -313,19 +313,38 @@ def test_metrics_file_stream(tmp_path, devices8):
     assert lines[-1]["step"] == 8 and np.isfinite(lines[-1]["loss"])
 
 
+def _fake_ckpt(root, step, payload="state", meta=True, metadata=True, data=True):
+    """A structurally valid step dir (meta marker + orbax-shaped payload)
+    without paying for a real orbax save — see checkpoint.validate_checkpoint.
+    The knockout flags build each flavor of broken dir (shared with
+    tests/test_fault_tolerance.py)."""
+    d = root / f"step_{step}"
+    d.mkdir()
+    if meta:
+        (d / "meta.json").write_text('{"step": %d}' % step)
+    if payload:
+        (d / payload / "d").mkdir(parents=True)
+        if metadata:
+            (d / payload / "_METADATA").write_text("{}")
+        if data:
+            (d / payload / "d" / "data0").write_bytes(b"\x01" * 32)
+    return d
+
+
 def test_latest_checkpoint_selection(tmp_path):
     """latest_checkpoint picks the highest complete step dir and skips
     crash-truncated saves (no meta.json)."""
     from paddlefleetx_tpu.utils.checkpoint import latest_checkpoint
 
     assert latest_checkpoint(str(tmp_path / "missing")) is None
-    for step, complete in [(2, True), (10, True), (30, False)]:
-        d = tmp_path / f"step_{step}"
-        d.mkdir()
-        if complete:
-            (d / "meta.json").write_text("{}")
+    for step in (2, 10):
+        _fake_ckpt(tmp_path, step)
+    (tmp_path / "step_30").mkdir()  # crashed save: no meta.json
     (tmp_path / "step_bogus").mkdir()
     assert latest_checkpoint(str(tmp_path)).endswith("step_10")
+    # the in-flight/crashed dir is left alone (an async save from a live
+    # process has no meta yet; only meta-complete-but-broken is quarantined)
+    assert (tmp_path / "step_30").is_dir()
 
 
 def test_latest_checkpoint_skips_corrupt_meta(tmp_path):
@@ -333,9 +352,7 @@ def test_latest_checkpoint_skips_corrupt_meta(tmp_path):
     newest PARSEABLE checkpoint wins."""
     from paddlefleetx_tpu.utils.checkpoint import latest_checkpoint
 
-    good = tmp_path / "step_4"
-    good.mkdir()
-    (good / "meta.json").write_text('{"step": 4}')
+    _fake_ckpt(tmp_path, 4)
     bad = tmp_path / "step_9"
     bad.mkdir()
     (bad / "meta.json").write_text('{"step": 9')  # truncated write

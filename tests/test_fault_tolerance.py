@@ -1,0 +1,461 @@
+"""Fault-tolerance subsystem units + in-process engine drills: retry,
+fault-spec parsing, checkpoint validation/quarantine/retention, anomaly
+guard, preemption handling, exit-after-save, and the async-save atexit
+join.  Cross-process crash-resume parity lives in test_fault_injection.py.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.utils import resilience as R
+from paddlefleetx_tpu.utils.checkpoint import (
+    gc_checkpoints,
+    latest_checkpoint,
+    quarantine_checkpoint,
+    restore_params,
+    validate_checkpoint,
+)
+
+from test_engine import (  # noqa: F401 — shared tiny GPT cfg + fake-ckpt builder
+    _fake_ckpt,
+    _losses_from_run,
+    tiny_cfg,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    R.reset_fault_state()
+    yield
+    R.reset_fault_state()
+
+
+# ---------------------------------------------------------------------------
+# retry + env knobs
+# ---------------------------------------------------------------------------
+
+
+def test_retry_backoff_and_success():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("flake")
+        return "ok"
+
+    out = R.retry(
+        flaky, attempts=4, backoff=0.1, jitter=0.0, sleep=sleeps.append
+    )
+    assert out == "ok" and calls["n"] == 3
+    assert sleeps == [pytest.approx(0.1), pytest.approx(0.2)]  # exponential
+
+
+def test_retry_exhaustion_wraps_with_context():
+    with pytest.raises(RuntimeError, match="orbax write: failed after 2"):
+        R.retry(
+            lambda: (_ for _ in ()).throw(OSError("disk")),
+            attempts=2, backoff=0.0, jitter=0.0, desc="orbax write",
+            sleep=lambda _s: None,
+        )
+
+
+def test_retry_non_retryable_propagates_immediately():
+    calls = {"n": 0}
+
+    def corrupt():
+        calls["n"] += 1
+        raise ValueError("bit rot")
+
+    with pytest.raises(ValueError, match="bit rot"):
+        R.retry(corrupt, attempts=5, backoff=0.0, jitter=0.0)
+    assert calls["n"] == 1  # corruption must not be re-read 5 times
+
+
+def test_retry_env_knobs_loud_parse(monkeypatch):
+    monkeypatch.setenv("PFX_RETRY_ATTEMPTS", "2")
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError("x")
+
+    with pytest.raises(RuntimeError):
+        R.retry(always, backoff=0.0, jitter=0.0, sleep=lambda _s: None)
+    assert calls["n"] == 2  # env knob reached the helper
+
+    monkeypatch.setenv("PFX_RETRY_ATTEMPTS", "lots")
+    with pytest.raises(ValueError, match="PFX_RETRY_ATTEMPTS"):
+        R.retry(always)
+    monkeypatch.setenv("PFX_RETRY_ATTEMPTS", "0")
+    with pytest.raises(ValueError, match=">= 1"):
+        R.retry(always)
+    monkeypatch.delenv("PFX_RETRY_ATTEMPTS")
+    monkeypatch.setenv("PFX_RETRY_BACKOFF", "fast")
+    with pytest.raises(ValueError, match="PFX_RETRY_BACKOFF"):
+        R.retry(always)
+
+
+# ---------------------------------------------------------------------------
+# fault-injection spec
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_parse(monkeypatch):
+    monkeypatch.delenv("PFX_FAULT", raising=False)
+    assert R.fault_spec() is None
+    monkeypatch.setenv("PFX_FAULT", "sigterm:7")
+    assert R.fault_spec() == ("sigterm", 7, 1)
+    monkeypatch.setenv("PFX_FAULT", "nan_grads:5:3")
+    assert R.fault_spec() == ("nan_grads", 5, 3)
+    for bad in ("typo_site:1", "sigterm", "sigterm:x", "sigterm:1:0", "a:b:c:d"):
+        monkeypatch.setenv("PFX_FAULT", bad)
+        with pytest.raises(ValueError, match="PFX_FAULT"):
+            R.fault_spec()
+
+
+def test_maybe_fire_counts_and_threshold(monkeypatch):
+    monkeypatch.setenv("PFX_FAULT", "nan_grads:5:2")
+    assert not R.maybe_fire("nan_grads", 4)   # before the step threshold
+    assert not R.maybe_fire("sigterm", 9)     # wrong site never fires
+    assert R.maybe_fire("nan_grads", 5)
+    assert R.maybe_fire("nan_grads", 6)
+    assert not R.maybe_fire("nan_grads", 7)   # count exhausted
+    R.reset_fault_state()
+    assert R.maybe_fire("nan_grads", 8)       # fresh process semantics
+
+
+def test_poison_batch():
+    batch = {
+        "tokens": np.ones((2, 4), np.int32),
+        "loss_mask": np.ones((2, 4), np.float32),
+    }
+    out = R.poison_batch(batch)
+    assert np.isnan(out["loss_mask"]).all()
+    assert out["tokens"].dtype == np.int32  # int leaves untouched
+    with pytest.raises(ValueError, match="float batch leaf"):
+        R.poison_batch({"tokens": np.ones((2,), np.int32)})
+
+
+# ---------------------------------------------------------------------------
+# anomaly guard
+# ---------------------------------------------------------------------------
+
+
+def test_anomaly_guard_skip_streak_budget():
+    g = R.AnomalyGuard(max_skip_streak=3)
+    assert g.observe(2.0, False) is None
+    for _ in range(2):
+        assert g.observe(float("nan"), True) is None
+    reason = g.observe(float("nan"), True)
+    assert reason and "3 consecutive" in reason
+    g.reset()
+    assert g.observe(float("nan"), True) is None  # streak forgotten
+    # a finite step in between resets the streak
+    g2 = R.AnomalyGuard(max_skip_streak=2)
+    assert g2.observe(1.0, True) is None
+    assert g2.observe(1.0, False) is None
+    assert g2.observe(1.0, True) is None  # streak back to 1: no trip
+
+
+def test_anomaly_guard_loss_spike_zscore():
+    g = R.AnomalyGuard(
+        max_skip_streak=0, spike_zscore=4.0, spike_streak=2,
+        window=32, min_window=8,
+    )
+    for i in range(12):  # establish a tight baseline around 2.0
+        assert g.observe(2.0 + 0.01 * (i % 3), False) is None
+    assert g.observe(9.0, False) is None        # first spike: streak 1
+    reason = g.observe(9.0, False)              # second consecutive: trip
+    assert reason and "spike" in reason
+    # spiking losses stayed out of the window: baseline mean is still ~2
+    assert float(np.mean(g.losses)) < 2.1
+    # disabled detectors never trip
+    g_off = R.AnomalyGuard(max_skip_streak=0, spike_zscore=0.0)
+    for _ in range(50):
+        assert g_off.observe(1e9, False) is None
+        assert g_off.observe(float("nan"), True) is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint validation / quarantine / retention GC
+# ---------------------------------------------------------------------------
+
+
+def test_validate_checkpoint_reasons(tmp_path):
+    ok = _fake_ckpt(tmp_path, 1)
+    assert validate_checkpoint(str(ok)) is None
+    assert "meta.json" in validate_checkpoint(str(_fake_ckpt(tmp_path, 2, meta=False)))
+    assert "payload" in validate_checkpoint(str(_fake_ckpt(tmp_path, 3, payload=None)))
+    assert "_METADATA" in validate_checkpoint(
+        str(_fake_ckpt(tmp_path, 4, metadata=False))
+    )
+    assert "no array data" in validate_checkpoint(
+        str(_fake_ckpt(tmp_path, 5, data=False, metadata=True))
+    )
+    # params-only layout (HF convert output) validates too
+    assert validate_checkpoint(str(_fake_ckpt(tmp_path, 6, payload="params"))) is None
+
+
+def test_latest_checkpoint_quarantine_and_fallback_order(tmp_path):
+    """The newest structurally-broken checkpoint is quarantined (renamed
+    *.corrupt) and selection falls back to the previous good one — over
+    empty dirs, meta-only stubs, and non-checkpoint noise."""
+    assert latest_checkpoint(str(tmp_path)) is None  # empty output dir
+    (tmp_path / "noise").mkdir()
+    (tmp_path / "step_nan").mkdir()
+    _fake_ckpt(tmp_path, 2)
+    _fake_ckpt(tmp_path, 4)
+    stub = _fake_ckpt(tmp_path, 9, payload=None)  # meta-only partial
+    best = latest_checkpoint(str(tmp_path))
+    assert best is not None and best.endswith("step_4")
+    assert not stub.exists() and (tmp_path / "step_9.corrupt").is_dir()
+    # validate=False restores the raw newest-complete-meta behavior
+    _fake_ckpt(tmp_path, 11, payload=None)
+    raw = latest_checkpoint(str(tmp_path), validate=False)
+    assert raw is not None and raw.endswith("step_11")
+    # quarantine=False reports the fallback without renaming
+    assert latest_checkpoint(str(tmp_path), quarantine=False).endswith("step_4")
+    assert (tmp_path / "step_11").is_dir()
+
+
+def test_quarantine_name_collisions(tmp_path):
+    a = _fake_ckpt(tmp_path, 7)
+    first = quarantine_checkpoint(str(a))
+    assert first.endswith("step_7.corrupt")
+    b = _fake_ckpt(tmp_path, 7)
+    second = quarantine_checkpoint(str(b))
+    assert second.endswith("step_7.corrupt.1")
+
+
+def test_gc_checkpoints_keep_last_n_never_deletes_last_good(tmp_path):
+    for s in (1, 2, 3, 4, 5):
+        _fake_ckpt(tmp_path, s)
+    protect = str(tmp_path / "step_1")  # oldest, but it is the last GOOD one
+    removed = gc_checkpoints(str(tmp_path), keep_last_n=2, protect=protect)
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == ["step_1", "step_4", "step_5"], (left, removed)
+    # keep_last_n=0 disables GC entirely
+    assert gc_checkpoints(str(tmp_path), keep_last_n=0) == []
+    # broken dirs don't count toward the quota and are not deleted here
+    _fake_ckpt(tmp_path, 9, payload=None)
+    gc_checkpoints(str(tmp_path), keep_last_n=2, protect=None)
+    assert (tmp_path / "step_9").is_dir()
+
+
+def test_resume_with_fallback_bounds_quarantines(tmp_path):
+    """Only corruption-class load failures quarantine, and at most
+    max_quarantines dirs per resume attempt — a systemic failure (storage
+    outage, config mismatch breaking EVERY restore) must not eat the
+    whole checkpoint history."""
+    from paddlefleetx_tpu.utils.checkpoint import resume_with_fallback
+
+    class CorruptEveryTime:
+        def load(self, path):
+            raise ValueError("DATA_LOSS: rotten bytes")
+
+    for s in range(1, 6):
+        _fake_ckpt(tmp_path, s)
+    with pytest.raises(RuntimeError, match="systemic"):
+        resume_with_fallback(CorruptEveryTime(), str(tmp_path), max_quarantines=2)
+    corrupt = sorted(p.name for p in tmp_path.iterdir() if ".corrupt" in p.name)
+    assert len(corrupt) == 2, corrupt  # bounded: 3 good dirs survive
+
+    # NON-corruption failures propagate untouched and quarantine nothing:
+    # an exhausted transient retry, and a restore-target mismatch whose
+    # ValueError lacks the tensorstore corruption markers (config typo —
+    # it would condemn EVERY dir, not this one)
+    class OutageEveryTime:
+        def load(self, path):
+            raise RuntimeError("restore: failed after 3 attempt(s)")
+
+    class MismatchEveryTime:
+        def load(self, path):
+            raise ValueError("user tree and restore target have different structures")
+
+    before = sorted(p.name for p in tmp_path.iterdir())
+    with pytest.raises(RuntimeError, match="failed after"):
+        resume_with_fallback(OutageEveryTime(), str(tmp_path))
+    with pytest.raises(ValueError, match="different structures"):
+        resume_with_fallback(MismatchEveryTime(), str(tmp_path))
+    assert sorted(p.name for p in tmp_path.iterdir()) == before
+
+    # and a load that succeeds returns the newest good path
+    class FineEngine:
+        def load(self, path):
+            self.loaded = path
+
+    eng = FineEngine()
+    got = resume_with_fallback(eng, str(tmp_path))
+    assert got is not None and got.endswith("step_3") and eng.loaded == got
+
+
+def test_restore_params_truncated_quarantines_with_actionable_error(tmp_path):
+    """restore_params on a bit-rotted array file raises an error naming the
+    quarantined path (satellite: utils/checkpoint.py coverage)."""
+    from paddlefleetx_tpu.utils.checkpoint import save_params_checkpoint
+
+    out = save_params_checkpoint(
+        str(tmp_path / "ck"),
+        {"w": np.ones((8, 8), np.float32)},
+        source="unit-test",
+        model_fields={"vocab_size": 8},
+    )
+    assert restore_params(out)["w"].shape == (8, 8)  # sane before rot
+    R.truncate_checkpoint_payload(out)
+    with pytest.raises(RuntimeError, match=r"quarantined") as ei:
+        restore_params(out)
+    assert ".corrupt" in str(ei.value)
+    assert os.path.isdir(out + ".corrupt")
+    assert not os.path.isdir(out)
+
+
+# ---------------------------------------------------------------------------
+# engine drills (8-device CPU mesh, tiny GPT from test_engine.tiny_cfg)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_preemption_sigterm_saves_marker(tmp_path, devices8, monkeypatch):
+    """Injected SIGTERM after step 2: the loop finishes the in-flight step,
+    writes a final checkpoint with the `preempted` marker, and fit returns
+    with engine.preempted set (the launcher then exits 0)."""
+    from paddlefleetx_tpu.core.engine import Engine
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.data.builders import build_dataloader
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+
+    monkeypatch.setenv("PFX_FAULT", "sigterm:2")
+    cfg = tiny_cfg(tmp_path)
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    loader = build_dataloader(cfg, "Train")
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        state = engine.fit(loader)
+    assert engine.preempted
+    assert int(state.step) == 2  # stopped right after the in-flight step
+    ckpt = os.path.join(cfg.Engine.save_load.output_dir, "step_2")
+    meta = json.load(open(os.path.join(ckpt, "meta.json")))
+    assert meta.get("preempted") is True and meta["step"] == 2
+
+
+def test_engine_exit_after_save(tmp_path, devices8):
+    """exit_after_save: clean stop right after the first periodic save."""
+    from paddlefleetx_tpu.core.engine import Engine
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.data.builders import build_dataloader
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+
+    cfg = tiny_cfg(tmp_path)
+    cfg.Engine.save_load.save_steps = 3
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    loader = build_dataloader(cfg, "Train")
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        engine.exit_after_save = True
+        state = engine.fit(loader)
+    assert engine.preempted and int(state.step) == 3
+    assert os.path.exists(
+        os.path.join(cfg.Engine.save_load.output_dir, "step_3", "meta.json")
+    )
+
+
+def test_engine_anomaly_rollback_reenters_loop(tmp_path, devices8, monkeypatch):
+    """A NaN streak past the skip budget rolls params+opt-state back to the
+    last checkpoint, emits a structured rollback event, and training
+    re-enters the loop and completes."""
+    from paddlefleetx_tpu.core.engine import Engine
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.data.builders import build_dataloader
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+
+    monkeypatch.setenv("PFX_FAULT", "nan_grads:5:3")
+    cfg = tiny_cfg(tmp_path)
+    cfg.Engine.max_steps = 10
+    cfg.Engine.logging_freq = 1
+    cfg.Engine.save_load.save_steps = 4
+    cfg.Engine.metrics_file = str(tmp_path / "metrics.jsonl")
+    cfg.Engine.resilience = {"max_skip_streak": 3, "max_rollbacks": 1}
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    loader = build_dataloader(cfg, "Train")
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        state = engine.fit(loader)
+    assert int(state.step) == 10  # rolled back, then finished the run
+    lines = [json.loads(x) for x in open(cfg.Engine.metrics_file)]
+    events = [l for l in lines if l.get("event") == "rollback"]
+    assert len(events) == 1, lines
+    assert events[0]["ckpt"].endswith("step_4")
+    assert "consecutive non-finite" in events[0]["reason"]
+    # post-rollback steps are healthy again
+    steps = [l for l in lines if "loss" in l]
+    assert np.isfinite(steps[-1]["loss"])
+
+
+def test_engine_anomaly_without_checkpoint_fails_loudly(
+    tmp_path, devices8, monkeypatch
+):
+    """Budget exceeded with nothing to roll back to: a loud RuntimeError,
+    not an infinite skip loop."""
+    from paddlefleetx_tpu.core.engine import Engine
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.data.builders import build_dataloader
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+
+    monkeypatch.setenv("PFX_FAULT", "nan_grads:1:8")
+    cfg = tiny_cfg(tmp_path)
+    cfg.Engine.resilience = {"max_skip_streak": 2, "max_rollbacks": 1}
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    loader = build_dataloader(cfg, "Train")
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        with pytest.raises(RuntimeError, match="anomaly budget"):
+            engine.fit(loader)
+
+
+def test_engine_keep_last_n_retention(tmp_path, devices8):
+    """save_load.keep_last_n bounds the checkpoint footprint during fit."""
+    from paddlefleetx_tpu.core.engine import Engine
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.data.builders import build_dataloader
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+
+    cfg = tiny_cfg(tmp_path)
+    cfg.Engine.max_steps = 5
+    cfg.Engine.save_load.save_steps = 1
+    cfg.Engine.save_load.keep_last_n = 2
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    loader = build_dataloader(cfg, "Train")
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        engine.fit(loader)
+    out = cfg.Engine.save_load.output_dir
+    left = sorted(n for n in os.listdir(out) if n.startswith("step_"))
+    assert left == ["step_4", "step_5"], left
+
+
+def test_async_save_atexit_join_registered(tmp_path, devices8):
+    """The first async save registers the interpreter-exit join so a
+    started save either completes or is cleanly absent (satellite bugfix:
+    SIGTERM/exit while _save_thread is in flight)."""
+    from paddlefleetx_tpu.utils.config import AttrDict
+
+    cfg = tiny_cfg(tmp_path)
+    cfg.Engine.save_load = AttrDict.from_nested(
+        {"save_steps": 0, "output_dir": str(tmp_path / "out"), "async_save": True}
+    )
+    _losses, engine = _losses_from_run(cfg, steps=1)
+    assert not engine._atexit_registered
+    path = engine.save(str(tmp_path / "ackpt"))
+    assert engine._atexit_registered
+    engine._atexit_join()  # what atexit will run: joins + surfaces durably
+    assert os.path.exists(os.path.join(path, "meta.json"))
+    assert engine._save_thread is None

@@ -101,6 +101,8 @@ def test_http_endpoint(tmp_path):
                     raise AssertionError(f"server died: {proc.stdout.read()[-2000:]}")
                 time.sleep(2)
         assert isinstance(last, dict) and last.get("ok"), last
+        # operability fields: queue depth + latency + retrace counter
+        assert {"in_flight", "last_latency_s", "traces"} <= set(last), last
 
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}/generate",
@@ -200,6 +202,31 @@ def test_decode_cache_is_donated(server):
     donated = lowered.args_info  # pytree of ArgInfo with .donated
     flags = [a.donated for a in _jax.tree.leaves(donated)]
     assert sum(flags) == 2, flags  # exactly the cache k/v pair
+
+
+def test_stats_expose_last_latency_and_traces(server):
+    """/healthz operability fields: last-request latency and the retrace
+    counter ride server.stats (tools/serve.py spreads them into the
+    health payload)."""
+    server.generate_ids([[1, 2, 3]])
+    assert server.stats["last_latency_s"] > 0
+    assert server.stats["traces"] >= 1
+    assert {"requests", "tokens_out", "time_s"} <= set(server.stats)
+
+
+def test_clamp_max_tokens():
+    """Per-request max_tokens clamp (tools/serve.py): cap wins over both a
+    huge client value and an over-cap configured default; floor at 1."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from serve import clamp_max_tokens
+
+    assert clamp_max_tokens(None, 64, 0) == 64       # no cap: default
+    assert clamp_max_tokens(10**9, 64, 128) == 128   # cap beats client
+    assert clamp_max_tokens(None, 512, 128) == 128   # cap beats default
+    assert clamp_max_tokens(16, 64, 128) == 16       # sane value untouched
+    assert clamp_max_tokens(0, 64, 128) == 1         # floored
+    with pytest.raises((ValueError, TypeError)):
+        clamp_max_tokens("lots", 64, 128)
 
 
 def test_cache_pool_is_lru_bounded(server):

@@ -48,7 +48,20 @@ def build_server(config: str, overrides):
     return GenerationServer(cfg, mesh, module, params=params, tokenizer=tok)
 
 
-def serve_http(server, port: int, host: str = "127.0.0.1"):
+def clamp_max_tokens(requested, default: int, cap: int) -> int:
+    """Resolve a request's max_tokens: the configured default when the
+    client sent none, clamped to ``cap`` (> 0) either way, floored at 1.
+    A huge client value must not key an enormous decode buffer/compile or
+    hold the generation lock for minutes (Generation.max_tokens_cap /
+    --max-tokens-cap)."""
+    val = default if requested is None else int(requested)
+    if cap > 0:
+        val = min(val, cap)
+    return max(1, val)
+
+
+def serve_http(server, port: int, host: str = "127.0.0.1",
+               gen_timeout_s: float = 120.0, max_tokens_cap: int = 0):
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -56,37 +69,65 @@ def serve_http(server, port: int, host: str = "127.0.0.1"):
     # compiled artifact cache — serialize it; the threading server still
     # keeps /healthz responsive while a long generation runs
     gen_lock = threading.Lock()
+    # in-flight /generate requests (queued + running); /healthz surfaces it
+    # so an operator can tell "busy" from "wedged" at a glance.  Handler
+    # threads run concurrently, so the +=/-= pair needs its own lock or
+    # lost updates would drift the gauge permanently.
+    in_flight = {"n": 0}
+    in_flight_lock = threading.Lock()
+    cap = max_tokens_cap or int(
+        server.cfg.get("Generation", {}).get("max_tokens_cap", 0) or 0
+    )
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # route through our logger instead
             pass
 
-        def _json(self, code: int, obj):
+        def _json(self, code: int, obj, headers=None):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._json(200, {"ok": True, **server.stats})
+                # stats include last_latency_s + traces (retrace counter)
+                self._json(
+                    200, {"ok": True, "in_flight": in_flight["n"], **server.stats}
+                )
             else:
                 self._json(404, {"error": "unknown path"})
 
         def do_POST(self):
             if self.path != "/generate":
                 return self._json(404, {"error": "unknown path"})
+            with in_flight_lock:
+                in_flight["n"] += 1
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
-                max_toks = req.get("max_tokens")
+                max_toks = clamp_max_tokens(
+                    req.get("max_tokens"), server.gen.max_dec_len, cap
+                )
+                # bounded wait for the generation lock: a request stuck
+                # behind a wedged/slow generation gets an honest 503 (with
+                # Retry-After) instead of hanging its connection forever
+                if not gen_lock.acquire(timeout=gen_timeout_s):
+                    return self._json(
+                        503,
+                        {"error": f"generation busy for {gen_timeout_s:.0f}s; "
+                                  "retry later"},
+                        headers={"Retry-After": str(max(1, int(gen_timeout_s)))},
+                    )
                 # generate under the lock, respond AFTER releasing it: a
                 # slow client blocked in the socket write must not stall
                 # other requests behind a held lock
                 payload = None
-                with gen_lock:
+                try:
                     if "prompt" in req:
                         texts = server.generate_text([req["prompt"]], max_dec_len=max_toks)
                         payload = {"completion": texts[0]}
@@ -99,6 +140,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1"):
                     elif "prompts_ids" in req:
                         ids = server.generate_ids(req["prompts_ids"], max_dec_len=max_toks)
                         payload = {"completions_ids": ids}
+                finally:
+                    gen_lock.release()
                 if payload is None:
                     return self._json(400, {"error": "need prompt(s) or prompt(s)_ids"})
                 return self._json(200, payload)
@@ -106,6 +149,9 @@ def serve_http(server, port: int, host: str = "127.0.0.1"):
                 return self._json(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — report, keep serving
                 return self._json(500, {"error": str(e)})
+            finally:
+                with in_flight_lock:
+                    in_flight["n"] -= 1
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     print(f"serving on {host}:{port} (POST /generate, GET /healthz)", flush=True)
@@ -122,6 +168,13 @@ def main(argv=None):
     ap.add_argument("--host", default="127.0.0.1",
                     help="bind address (use 0.0.0.0 to expose externally)")
     ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--gen-timeout", type=float, default=120.0,
+                    help="seconds a /generate request waits for the "
+                    "generation lock before returning HTTP 503")
+    ap.add_argument("--max-tokens-cap", type=int, default=0,
+                    help="hard per-request max_tokens ceiling (0 = use "
+                    "Generation.max_tokens_cap from the config, which "
+                    "defaults to uncapped-within-context)")
     args = ap.parse_args(argv)
 
     server = build_server(args.config, args.override)
@@ -129,7 +182,9 @@ def main(argv=None):
         server.warmup()
 
     if args.port:
-        return serve_http(server, args.port, args.host)
+        return serve_http(server, args.port, args.host,
+                          gen_timeout_s=args.gen_timeout,
+                          max_tokens_cap=args.max_tokens_cap)
 
     # REPL: one prompt per line -> completion (ids mode when no tokenizer)
     print("prompt> ", end="", flush=True)

@@ -1,5 +1,10 @@
 """Training entry point (reference tools/train.py:44-73):
-config -> dist init -> build module -> dataloaders -> engine.fit."""
+config -> dist init -> build module -> dataloaders -> engine.fit.
+
+Crash-loop contract: relaunching the same command auto-resumes from the
+newest restorable checkpoint (corrupt ones are quarantined and skipped —
+docs/fault_tolerance.md).  A SIGTERM mid-run checkpoints and exits 0;
+``--exit-after-save`` bounds the run to one checkpoint interval."""
 
 import os
 import sys
@@ -26,16 +31,24 @@ def main(argv=None):
     mesh = init_dist_env(cfg)
     module = build_module(cfg)
 
-    ckpt_dir = cfg.Engine.save_load.get("ckpt_dir")
-    if not ckpt_dir and cfg.Engine.save_load.get("auto_resume"):
-        # crash-loop restart contract (reference _load_recovery,
-        # eager_engine.py:244,816-825): newest complete step_N dir wins
-        from paddlefleetx_tpu.utils.checkpoint import latest_checkpoint
+    from paddlefleetx_tpu.utils.checkpoint import (
+        latest_checkpoint,
+        resume_with_fallback,
+    )
 
-        ckpt_dir = latest_checkpoint(cfg.Engine.save_load.get("output_dir", "./output"))
-        if ckpt_dir:
-            logger.info(f"auto_resume: found {ckpt_dir}")
-    if ckpt_dir and cfg.Engine.save_load.get("pretrained_params"):
+    output_dir = cfg.Engine.save_load.get("output_dir", "./output")
+    ckpt_dir = cfg.Engine.save_load.get("ckpt_dir")
+    auto_resume = not ckpt_dir and bool(cfg.Engine.save_load.get("auto_resume"))
+    if auto_resume:
+        # crash-loop restart contract (reference _load_recovery,
+        # eager_engine.py:244,816-825): newest restorable step_N dir wins.
+        # This peek only decides whether pretrained warm-start applies, so
+        # it must be side-effect free (quarantine=False); the real resolve
+        # below quarantines as needed.
+        resuming = latest_checkpoint(output_dir, quarantine=False) is not None
+    else:
+        resuming = bool(ckpt_dir)
+    if resuming and cfg.Engine.save_load.get("pretrained_params"):
         # the resume load replaces params wholesale — skip the (possibly
         # multi-GB) warm-start restore on every crash-loop restart
         logger.info("pretrained_params skipped: resume checkpoint takes over")
@@ -43,8 +56,23 @@ def main(argv=None):
 
     with mesh:
         engine = Engine(cfg, module, mesh)
+        if getattr(args, "exit_after_save", False):
+            engine.exit_after_save = True
         if ckpt_dir:
             engine.load(ckpt_dir)
+        elif auto_resume:
+            loaded = resume_with_fallback(engine, output_dir)
+            if loaded is None and resuming:
+                # the peek promised a resume (and may have skipped the
+                # pretrained warm start on its word): silently training
+                # from scratch would be the worst outcome — stop loudly
+                raise RuntimeError(
+                    f"auto_resume: checkpoints exist under {output_dir} "
+                    "but none restored (see QUARANTINED logs); refusing "
+                    "to silently restart from scratch — inspect/remove "
+                    "the *.corrupt dirs, or disable auto_resume to "
+                    "intentionally start over"
+                )
         # loaders built after load so the sampler resumes the data order
         # from the checkpoint's consumed_samples
         train_loader = build_dataloader(
@@ -56,6 +84,11 @@ def main(argv=None):
             else None
         )
         engine.fit(train_loader, eval_loader)
+        if engine.preempted:
+            # final checkpoint already written (preemption / exit_after_save
+            # path); exit 0 so the orchestrator relaunches with auto_resume
+            logger.info("clean early exit: final checkpoint saved; exiting 0")
+            return
         if cfg.Engine.save_load.get("save_steps"):
             engine.save()
 
