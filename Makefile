@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault bench
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill bench
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -13,7 +13,8 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_optims.py tests/test_rigid.py tests/test_glue.py \
              tests/test_lm_eval.py tests/test_configs_launch.py \
              tests/test_gpt_model.py tests/test_mesh_sharding.py \
-             tests/test_serving.py tests/test_chunked_ce.py tests/test_lint.py \
+             tests/test_serving.py tests/test_request_queue.py \
+             tests/test_chunked_ce.py tests/test_lint.py \
              tests/test_bench_helpers.py tests/test_bench_cases.py
 
 # lint runs inside the gate via tests/test_lint.py::test_repo_is_clean
@@ -50,6 +51,12 @@ test-all:
 # CLI + the resilience/checkpoint-integrity units (docs/fault_tolerance.md)
 test-fault:
 	python -m pytest tests/test_fault_tolerance.py tests/test_fault_injection.py -q
+
+# serving robustness drills: request-queue units + subprocess traffic
+# drills (flood / SIGTERM drain / gen_crash / gen_hang watchdog) through
+# the real tools/serve.py CLI (docs/serving.md runbook)
+test-serve-drill:
+	python -m pytest tests/test_request_queue.py tests/test_serve_drills.py -q
 
 bench:
 	python benchmarks/run_benchmark.py

@@ -1,0 +1,365 @@
+"""Admission-controlled serving request queue with deadlines, load
+shedding, coalescing, and graceful drain.
+
+`tools/serve.py` used to serialize every request behind one
+``threading.Lock``: under a burst each queued request redundantly ran its
+own decode, expired clients were still served after they had gone away,
+and SIGTERM killed in-flight generations mid-decode.  This module is the
+serving-side counterpart of the PR 2 training preemption contract
+(`utils/resilience.py`), in the spirit of Clipper's deadline-aware
+admission control (Crankshaw et al., NSDI 2017) and Orca's batched
+iteration scheduling (Yu et al., OSDI 2022), adapted to the
+bucketed-compile serving model of `core/serving.py`:
+
+  - **bounded admission**: ``submit`` rejects when the queue is full
+    (`QueueFull` -> HTTP 429 + Retry-After) or draining (`QueueClosed`
+    -> HTTP 503), so backpressure reaches clients instead of piling up
+    threads behind a lock.
+  - **deadlines**: each request may carry an absolute deadline; the
+    scheduler sheds expired entries (`DeadlineExceeded` -> HTTP 503)
+    *before* spending a decode on them, and a waiter that times out can
+    `try_remove` its entry so an abandoned request never wastes work.
+  - **coalescing**: one scheduler thread drains the queue and merges
+    compatible waiting requests (equal ``coalesce_key``) into a single
+    batched runner call.  The key is computed by the caller from the
+    same prompt-length/decode-length bucketing that `core/serving.py`
+    uses for its jit memo, so a coalesced batch lands on an
+    already-compiled artifact (power-of-two batch buckets) instead of
+    keying a fresh trace — and greedy outputs stay token-identical to
+    serving the requests sequentially (rows are independent across the
+    batch dim).
+  - **graceful drain**: ``close`` stops admission while the scheduler
+    finishes every already-admitted request; ``join`` waits for the
+    drain so a SIGTERM handler can answer all admitted work and exit 0.
+
+The queue is transport-agnostic: entries carry opaque prompt payloads
+and a ``runner(prompts, max_new_tokens) -> rows`` callable does the
+actual generation.  All coordination is plain ``threading`` — one
+scheduler thread, condition-variable wakeups, no polling while idle.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
+
+from paddlefleetx_tpu.utils.log import logger
+
+
+class QueueFull(RuntimeError):
+    """Admission rejected: the bounded queue is at capacity (HTTP 429)."""
+
+
+class QueueClosed(RuntimeError):
+    """Admission rejected: the queue is draining/shut down (HTTP 503)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request expired before a decode was spent on it (HTTP 503)."""
+
+
+class RequestFuture:
+    """Minimal one-shot future: the handler thread blocks on ``result``
+    while the scheduler thread resolves it exactly once."""
+
+    __slots__ = ("_event", "_value", "_exc")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._value: Any = None
+        self._exc: Optional[BaseException] = None
+
+    def set_result(self, value: Any) -> None:
+        self._value = value
+        self._event.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """Wait for resolution; raises ``TimeoutError`` if the future is
+        still pending after ``timeout`` (the entry may still be queued —
+        pair with ``RequestQueue.try_remove`` to shed it)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("request still pending")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+@dataclass
+class _Entry:
+    """One admitted client request (possibly carrying several prompts —
+    a client-side batch stays atomic through coalescing)."""
+
+    prompts: List[Any]
+    max_new_tokens: int
+    coalesce_key: Optional[Hashable]
+    deadline: Optional[float]  # absolute time.monotonic(), None = no deadline
+    future: RequestFuture
+    enqueued_at: float
+
+
+class RequestQueue:
+    """Bounded admission queue + single scheduler thread.
+
+    ``runner(prompts, max_new_tokens)`` must return one output row per
+    prompt (row order matches prompt order); the scheduler splits rows
+    back per entry and trims each row to that entry's own
+    ``max_new_tokens`` (a coalesced batch runs at the batch max).
+
+    Coalescing pulls *later* same-key entries forward to join the oldest
+    entry's batch; entries with different keys keep their relative FIFO
+    order.  ``coalesce_key=None`` opts an entry out entirely.
+    """
+
+    def __init__(
+        self,
+        runner: Callable[[List[Any], int], Sequence[Any]],
+        *,
+        max_depth: int = 64,
+        max_coalesce: int = 8,
+        name: str = "serve",
+    ) -> None:
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        if max_coalesce < 1:
+            raise ValueError(f"max_coalesce must be >= 1, got {max_coalesce}")
+        self._runner = runner
+        self.max_depth = int(max_depth)
+        self.max_coalesce = int(max_coalesce)
+        self.name = name
+        self._entries: deque = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        self._busy_since: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self.stats: Dict[str, int] = {
+            "submitted": 0,
+            "completed": 0,
+            "batches": 0,
+            "coalesced_batches": 0,
+            "coalesced_requests": 0,
+            "shed_deadline": 0,
+            "rejected_full": 0,
+            "rejected_closed": 0,
+            "gen_errors": 0,
+        }
+
+    # -- admission ------------------------------------------------------
+    def submit(
+        self,
+        prompts: Sequence[Any],
+        max_new_tokens: int,
+        *,
+        coalesce_key: Optional[Hashable] = None,
+        deadline_s: Optional[float] = None,
+    ) -> RequestFuture:
+        """Admit a request; returns its future.  Raises ``QueueClosed``
+        when draining and ``QueueFull`` at capacity — admission control
+        happens HERE, synchronously, so the transport layer can turn the
+        rejection into 503/429 without tying up a worker."""
+        if not prompts:
+            raise ValueError("prompts must be non-empty")
+        entry = _Entry(
+            prompts=list(prompts),
+            max_new_tokens=int(max_new_tokens),
+            coalesce_key=coalesce_key,
+            deadline=(time.monotonic() + float(deadline_s))
+            if deadline_s is not None else None,
+            future=RequestFuture(),
+            enqueued_at=time.monotonic(),
+        )
+        with self._wake:
+            if self._closed:
+                self.stats["rejected_closed"] += 1
+                raise QueueClosed(f"{self.name} queue is draining")
+            if len(self._entries) >= self.max_depth:
+                self.stats["rejected_full"] += 1
+                raise QueueFull(
+                    f"{self.name} queue full ({self.max_depth} waiting)"
+                )
+            self._entries.append(entry)
+            self.stats["submitted"] += 1
+            self._wake.notify_all()
+        return entry.future
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def busy_seconds(self) -> float:
+        """How long the current runner call has been executing (0 when
+        idle) — the serve-layer watchdog's wedged-generation probe."""
+        with self._lock:
+            if self._busy_since is None:
+                return 0.0
+            return time.monotonic() - self._busy_since
+
+    def try_remove(self, future: RequestFuture) -> bool:
+        """Shed a still-queued entry (handler-side deadline timeout): if
+        the entry has not been picked up yet, remove it, resolve its
+        future with ``DeadlineExceeded``, count the shed, and return
+        True.  Returns False when the entry is already running/resolved
+        (the scheduler will resolve it normally)."""
+        with self._wake:
+            for e in self._entries:
+                if e.future is future:
+                    self._entries.remove(e)
+                    self.stats["shed_deadline"] += 1
+                    e.future.set_exception(
+                        DeadlineExceeded("deadline exceeded while queued")
+                    )
+                    return True
+        return False
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "RequestQueue":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name=f"{self.name}-scheduler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Stop admitting; already-admitted entries still run (drain)."""
+        with self._wake:
+            self._closed = True
+            self._wake.notify_all()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the drain to finish (queue empty, runner idle,
+        scheduler exited).  Returns False on timeout — e.g. a wedged
+        generation; the caller escalates (force-quit)."""
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None) -> bool:
+        """Close + (optionally) flush waiting entries with QueueClosed
+        + join.  ``drain=False`` answers queued-but-unstarted requests
+        with an error instead of running them."""
+        self.close()
+        if not drain:
+            with self._wake:
+                while self._entries:
+                    e = self._entries.popleft()
+                    e.future.set_exception(
+                        QueueClosed(f"{self.name} queue shut down")
+                    )
+                self._wake.notify_all()
+        return self.join(timeout)
+
+    # -- scheduler ------------------------------------------------------
+    def _shed_locked(self, entry: _Entry) -> None:
+        self.stats["shed_deadline"] += 1
+        waited = time.monotonic() - entry.enqueued_at
+        logger.warning(
+            f"{self.name}: shed expired request after {waited:.2f}s queued "
+            f"({len(entry.prompts)} prompt(s))"
+        )
+        entry.future.set_exception(
+            DeadlineExceeded(f"deadline exceeded after {waited:.2f}s queued")
+        )
+
+    def _take_batch_locked(self) -> Optional[List[_Entry]]:
+        """Pop the oldest live entry plus every compatible waiting entry
+        (same coalesce_key, combined prompt count <= max_coalesce).
+        Expired entries found along the way are shed.  Returns None when
+        the queue is empty."""
+        now = time.monotonic()
+        while self._entries:
+            head = self._entries.popleft()
+            if head.deadline is not None and now > head.deadline:
+                self._shed_locked(head)
+                continue
+            batch = [head]
+            n = len(head.prompts)
+            if head.coalesce_key is not None and self.max_coalesce > n:
+                keep: List[_Entry] = []
+                for e in self._entries:
+                    if e.deadline is not None and now > e.deadline:
+                        self._shed_locked(e)
+                    elif (
+                        e.coalesce_key == head.coalesce_key
+                        and n + len(e.prompts) <= self.max_coalesce
+                    ):
+                        batch.append(e)
+                        n += len(e.prompts)
+                    else:
+                        keep.append(e)
+                self._entries = deque(keep)
+            return batch
+        return None
+
+    def _run(self) -> None:
+        while True:
+            with self._wake:
+                batch = self._take_batch_locked()
+                while batch is None:
+                    if self._closed:
+                        return  # drained: admission closed + queue empty
+                    self._wake.wait()
+                    batch = self._take_batch_locked()
+                self._busy_since = time.monotonic()
+            try:
+                self._run_batch(batch)
+            finally:
+                with self._lock:
+                    self._busy_since = None
+
+    def _run_batch(self, batch: List[_Entry]) -> None:
+        prompts = [p for e in batch for p in e.prompts]
+        max_new = max(e.max_new_tokens for e in batch)
+        self.stats["batches"] += 1
+        if len(batch) > 1:
+            self.stats["coalesced_batches"] += 1
+            self.stats["coalesced_requests"] += len(batch)
+            logger.info(
+                f"{self.name}: coalesced {len(batch)} requests "
+                f"({len(prompts)} prompts) into one batch"
+            )
+        try:
+            rows = self._runner(prompts, max_new)
+        except BaseException as exc:  # noqa: BLE001 — fan the error out
+            # every coalesced client gets the error; the scheduler
+            # itself survives and keeps draining the queue
+            self.stats["gen_errors"] += 1
+            for e in batch:
+                e.future.set_exception(exc)
+            logger.warning(
+                f"{self.name}: generation failed for a batch of "
+                f"{len(batch)} request(s): {type(exc).__name__}: {exc}"
+            )
+            return
+        rows = list(rows)
+        if len(rows) != len(prompts):
+            exc = RuntimeError(
+                f"runner returned {len(rows)} rows for {len(prompts)} prompts"
+            )
+            self.stats["gen_errors"] += 1
+            for e in batch:
+                e.future.set_exception(exc)
+            return
+        i = 0
+        for e in batch:
+            out = rows[i:i + len(e.prompts)]
+            i += len(e.prompts)
+            # a coalesced batch decodes to the batch max; honor each
+            # request's own cap (greedy prefixes are step-identical)
+            out = [
+                r[: e.max_new_tokens] if len(r) > e.max_new_tokens else r
+                for r in out
+            ]
+            e.future.set_result(out)
+            self.stats["completed"] += 1

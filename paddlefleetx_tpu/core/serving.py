@@ -19,11 +19,33 @@ import numpy as np
 
 from paddlefleetx_tpu.models.gpt.generation import (
     GenerationConfig,
+    bucket_len,
     generate,
     init_cache,
     pad_prompts,
 )
 from paddlefleetx_tpu.utils.log import logger
+from paddlefleetx_tpu.utils.resilience import maybe_fire
+
+
+def plan_decode(padded_len: int, max_toks: int, *, context: int):
+    """THE decode-length clamp for an explicit client ``max_tokens``:
+    (trim, run) where ``trim`` is the per-request output cap (context
+    room respected, floored at 1) and ``run`` is the 32-bucketed decode
+    length that keys the compile.  Single-sourced on purpose —
+    ``generate_ids`` clamps with it and the serve-layer coalesce key
+    (tools/serve.py ``plan_request``) predicts with it, so "equal keys
+    pad identically whether served together or apart" can never drift.
+    Raises ValueError when the padded prompt leaves no decode room."""
+    limit = int(context) - int(padded_len)
+    if limit < 1:
+        raise ValueError(
+            f"prompt bucket {padded_len} leaves no decode room in "
+            f"context {context}"
+        )
+    trim = max(1, min(int(max_toks), limit))
+    run = min(-(-trim // 32) * 32, limit)
+    return trim, run
 
 
 class GenerationServer:
@@ -89,9 +111,12 @@ class GenerationServer:
         # last_latency_s: wall-clock of the most recent generate_ids call —
         # /healthz surfaces it so operators see a slow/regressed decode
         # without scraping logs (tools/serve.py)
+        # gen_errors / last_error: structured generation-failure stats —
+        # /healthz spreads server.stats, so an operator sees a failing
+        # decode (and its class) without scraping logs
         self.stats: Dict[str, float] = {
             "requests": 0, "tokens_out": 0, "time_s": 0.0, "traces": 0,
-            "last_latency_s": 0.0,
+            "last_latency_s": 0.0, "gen_errors": 0, "last_error": "",
         }
 
     def _decode_fn(self, gen: GenerationConfig, batch: int, bucket_len: int):
@@ -166,14 +191,18 @@ class GenerationServer:
             trim = min(gen.max_dec_len, limit)
             run_len = trim
         else:
-            trim = max(1, min(int(max_dec_len), limit))
-            run_len = min(-(-trim // 32) * 32, limit)  # 32-bucket the compile key
+            # shared clamp: the serve-layer coalesce key predicts this
+            trim, run_len = plan_decode(
+                int(prompt.shape[1]), max_dec_len,
+                context=int(self.module.config.max_position_embeddings),
+            )
         if run_len != gen.max_dec_len:
             gen = dataclasses.replace(gen, max_dec_len=run_len)
         self._key, k = jax.random.split(self._key)
         t0 = time.time()
         beam = gen.decode_strategy == "beam_search"
         bucket_key = (gen, int(prompt.shape[0]), int(prompt.shape[1]))
+        req_idx = int(self.stats["requests"]) + 1
         with self.mesh:
             # donated cache per request: first hit of a bucket allocates a
             # zeros pair, every later request re-donates the FINAL cache
@@ -191,13 +220,29 @@ class GenerationServer:
                         self.module.config, prompt.shape[0],
                         prompt.shape[1] + gen.max_dec_len,
                     )
-            out = self._decode_fn(gen, prompt.shape[0], prompt.shape[1])(
-                self.params,
-                jax.numpy.asarray(prompt),
-                jax.numpy.asarray(prompt_lens),
-                k,
-                cache,
-            )
+            try:
+                # serving fault sites (tests/test_serve_drills.py): both
+                # fire after the cache pop so an injected failure lands on
+                # the same path as a real mid-decode one
+                maybe_fire("gen_crash", req_idx)
+                maybe_fire("gen_hang", req_idx)
+                out = self._decode_fn(gen, prompt.shape[0], prompt.shape[1])(
+                    self.params,
+                    jax.numpy.asarray(prompt),
+                    jax.numpy.asarray(prompt_lens),
+                    k,
+                    cache,
+                )
+            except BaseException as exc:
+                # the popped pair was already fed to a donating jit call
+                # (or is about to be abandoned): it may be
+                # donation-invalidated, so DROP it — never return a
+                # possibly-deleted buffer to the pool, and never leave
+                # the bucket pointing at one.  The next same-bucket
+                # request re-allocates a fresh zeros pair.
+                self.stats["gen_errors"] += 1
+                self.stats["last_error"] = f"{type(exc).__name__}: {exc}"
+                raise
             if not beam:
                 out, final_cache = out
                 self._cache_pool[bucket_key] = final_cache
@@ -225,10 +270,62 @@ class GenerationServer:
         outs = self.generate_ids(ids, max_dec_len=max_dec_len)
         return [self.tokenizer.decode(o) for o in outs]
 
-    def warmup(self, prompt_len: int = 8) -> float:
-        """Compile the decode for the first bucket; returns seconds taken."""
-        t0 = time.time()
-        self.generate_ids([[1] * prompt_len])
-        dt = time.time() - t0
-        logger.info(f"serving warmup (bucket {self.bucket}): {dt:.1f}s")
-        return dt
+    def warmup(
+        self,
+        prompt_lens: "Sequence[int] | int" = (8,),
+        batch_sizes: Sequence[int] = (1,),
+    ) -> Dict[str, float]:
+        """Compile the decode for a list of prompt-length buckets
+        (`--warmup-buckets` in tools/serve.py), optionally crossed with
+        batch-size buckets (`--warmup-batches` — the coalescing scheduler
+        makes power-of-two batch buckets a hot compile key too); returns
+        and records per-bucket compile seconds in ``stats["warmup_s"]``.
+
+        Fails LOUDLY: every bucket is validated up front (positive,
+        leaves decode room in the context) and a failing bucket raises
+        naming what did and did not warm — a silently half-warmed server
+        would pay a surprise multi-second compile on its first live
+        request.
+        """
+        if isinstance(prompt_lens, int):  # old warmup(prompt_len=8) shape
+            prompt_lens = (prompt_lens,)
+        lens = [int(n) for n in prompt_lens]
+        batches = [int(b) for b in batch_sizes]
+        if not lens or not batches:
+            raise ValueError("warmup needs >= 1 prompt-length and batch bucket")
+        ctx = int(self.module.config.max_position_embeddings)
+        for n in lens:
+            padded = bucket_len(n, self.bucket)
+            if n < 1 or padded >= ctx:
+                raise ValueError(
+                    f"warmup bucket {n} invalid: padded prompt {padded} "
+                    f"leaves no decode room in context {ctx}"
+                )
+        for b in batches:
+            if b < 1:
+                raise ValueError(f"warmup batch size {b} must be >= 1")
+        per: Dict[str, float] = {}
+        for n in lens:
+            for b in batches:
+                key = f"{n}" if b == 1 else f"{n}x{b}"
+                t0 = time.time()
+                try:
+                    # int max_dec_len: land on the 32-bucketed compile key
+                    # live traffic hits (a client always sends/clamps to
+                    # an explicit max_tokens in tools/serve.py)
+                    self.generate_ids(
+                        [[1] * n] * b, max_dec_len=self.gen.max_dec_len
+                    )
+                except Exception as exc:
+                    raise RuntimeError(
+                        f"warmup failed at bucket {key} (warmed so far: "
+                        f"{sorted(per) or 'none'}): "
+                        f"{type(exc).__name__}: {exc}"
+                    ) from exc
+                per[key] = round(time.time() - t0, 2)
+                logger.info(
+                    f"serving warmup: prompt bucket {n} batch {b} "
+                    f"(pad multiple {self.bucket}) compiled in {per[key]:.1f}s"
+                )
+        self.stats["warmup_s"] = dict(per)
+        return per

@@ -35,6 +35,19 @@ subprocess crash-resume tests:
   ``nan_grads:K:N``    poison the batch with NaNs for N steps starting
                        at step K (drives the anomaly-rollback path)
 
+Serving sites (step counts are *generation request* indices — warmup
+generations count; `core/serving.py` fires them, the `tools/serve.py`
+traffic drills in tests/test_serve_drills.py assert the behavior):
+
+  ``gen_crash:K``      raise RuntimeError inside generation request K
+                       (after the donated KV cache was popped from the
+                       pool — exercises the error path that must not
+                       poison the pool; HTTP surface: one 500, server
+                       keeps serving)
+  ``gen_hang:K``       sleep PFX_FAULT_HANG_S (default 3600) seconds
+                       inside generation request K — a wedged decode;
+                       the serve watchdog flips /healthz to degraded
+
 All env knobs follow the repo's loud-parse convention (PFX_FLASH_*,
 ops/flash_attention.py): a set-but-invalid value raises at first use
 instead of silently running with a default.
@@ -158,7 +171,10 @@ def retry(
 # fault injection harness
 # ---------------------------------------------------------------------------
 
-FAULT_SITES = ("sigterm", "save_crash", "ckpt_truncate", "nan_grads")
+FAULT_SITES = (
+    "sigterm", "save_crash", "ckpt_truncate", "nan_grads",
+    "gen_crash", "gen_hang",
+)
 
 # fires-per-site for THIS process; a relaunched run starts clean, which is
 # exactly what the crash-resume tests need (inject once, resume clean)
@@ -229,6 +245,12 @@ def maybe_fire(site: str, step: int, path: Optional[str] = None) -> bool:
         if not path:
             raise ValueError("ckpt_truncate injection needs the ckpt path")
         truncate_checkpoint_payload(path)
+    elif site == "gen_crash":
+        raise RuntimeError(
+            f"PFX_FAULT: injected gen_crash at request {step}"
+        )
+    elif site == "gen_hang":
+        time.sleep(_env_float("PFX_FAULT_HANG_S", 3600.0))
     return True
 
 

@@ -1,0 +1,259 @@
+"""RequestQueue unit tests (core/request_queue.py): admission control,
+deadlines, coalescing, drain — all with a fake runner, no jax involved.
+The end-to-end traffic drills live in tests/test_serve_drills.py."""
+
+import threading
+import time
+
+import pytest
+
+from paddlefleetx_tpu.core.request_queue import (
+    DeadlineExceeded,
+    QueueClosed,
+    QueueFull,
+    RequestQueue,
+)
+
+
+def echo_runner(prompts, max_new):
+    """Rows echo their prompt plus the batch decode cap."""
+    return [list(p) + [max_new] for p in prompts]
+
+
+def test_submit_result_roundtrip():
+    q = RequestQueue(echo_runner, max_depth=4).start()
+    fut = q.submit([[1, 2]], 8, coalesce_key=("k",))
+    assert fut.result(timeout=5) == [[1, 2, 8]]
+    assert q.stats["submitted"] == 1 and q.stats["completed"] == 1
+    assert q.depth() == 0
+    q.shutdown(timeout=5)
+
+
+def test_queue_full_rejection():
+    """Admission is bounded: requests beyond max_depth are rejected
+    synchronously with QueueFull (HTTP 429), not parked."""
+    release = threading.Event()
+
+    def slow_runner(prompts, max_new):
+        release.wait(10)
+        return [list(p) for p in prompts]
+
+    q = RequestQueue(slow_runner, max_depth=2, max_coalesce=1).start()
+    first = q.submit([[0]], 4)  # scheduler picks this up
+    time.sleep(0.05)  # let it leave the queue and block in the runner
+    futs = [q.submit([[i]], 4) for i in range(2)]  # fills the queue
+    with pytest.raises(QueueFull):
+        q.submit([[9]], 4)
+    assert q.stats["rejected_full"] == 1
+    release.set()
+    for f in [first] + futs:
+        f.result(timeout=5)
+    assert q.shutdown(timeout=5)
+
+
+def test_deadline_shed_before_decode():
+    """An entry whose deadline passes while queued is shed with
+    DeadlineExceeded and never reaches the runner."""
+    release = threading.Event()
+    served = []
+
+    def slow_runner(prompts, max_new):
+        release.wait(10)
+        served.extend(prompts)
+        return [list(p) for p in prompts]
+
+    q = RequestQueue(slow_runner, max_depth=8, max_coalesce=1).start()
+    a = q.submit([[1]], 4)
+    time.sleep(0.05)  # a is now running (blocked)
+    b = q.submit([[2]], 4, deadline_s=0.01)
+    time.sleep(0.1)  # b expires while a occupies the scheduler
+    release.set()
+    assert a.result(timeout=5) == [[1]]
+    with pytest.raises(DeadlineExceeded):
+        b.result(timeout=5)
+    assert q.stats["shed_deadline"] == 1
+    assert [2] not in served  # no decode wasted on the expired entry
+    q.shutdown(timeout=5)
+
+
+def test_try_remove_sheds_queued_entry_only():
+    release = threading.Event()
+
+    def slow_runner(prompts, max_new):
+        release.wait(10)
+        return [list(p) for p in prompts]
+
+    q = RequestQueue(slow_runner, max_depth=8, max_coalesce=1).start()
+    a = q.submit([[1]], 4)
+    time.sleep(0.05)
+    b = q.submit([[2]], 4)
+    assert q.try_remove(b) is True  # still queued: shed
+    assert q.try_remove(a) is False  # already running: scheduler resolves
+    with pytest.raises(DeadlineExceeded):
+        b.result(timeout=5)
+    release.set()
+    assert a.result(timeout=5) == [[1]]
+    q.shutdown(timeout=5)
+
+
+def test_coalescing_groups_by_key_and_splits_results():
+    """Same-key waiting requests merge into one runner call (batch sizes
+    recorded); results split back per entry; different keys never mix."""
+    batches = []
+
+    def recording_runner(prompts, max_new):
+        # rows decode to the BATCH cap, like a real coalesced generation
+        batches.append(len(prompts))
+        return [[p[0]] * max_new for p in prompts]
+
+    q = RequestQueue(recording_runner, max_depth=16, max_coalesce=4)
+    f1 = q.submit([[1]], 3, coalesce_key=("a",))
+    f2 = q.submit([[2]], 7, coalesce_key=("a",))
+    f3 = q.submit([[3]], 7, coalesce_key=("b",))  # different bucket
+    f4 = q.submit([[4]], 7, coalesce_key=("a",))
+    q.start()  # everything queued first: one scan coalesces a-keys
+    # batch cap honored, per-entry trim honored: f1 asked for 3 tokens
+    # but the coalesced batch decodes to max_new=7 — its row is trimmed
+    assert f1.result(timeout=5) == [[1] * 3]
+    assert f2.result(timeout=5) == [[2] * 7]
+    assert f3.result(timeout=5) == [[3] * 7]
+    assert f4.result(timeout=5) == [[4] * 7]
+    assert sorted(batches) == [1, 3]  # [a,a,a] coalesced, [b] alone
+    assert q.stats["coalesced_batches"] == 1
+    assert q.stats["coalesced_requests"] == 3
+    q.shutdown(timeout=5)
+
+
+def test_max_coalesce_caps_batch_and_none_opts_out():
+    batches = []
+
+    def recording_runner(prompts, max_new):
+        batches.append(len(prompts))
+        return [list(p) for p in prompts]
+
+    q = RequestQueue(recording_runner, max_depth=16, max_coalesce=2)
+    futs = [q.submit([[i]], 4, coalesce_key=("k",)) for i in range(5)]
+    solo = q.submit([[9]], 4, coalesce_key=None)  # opted out
+    q.start()
+    for f in futs + [solo]:
+        f.result(timeout=5)
+    assert max(batches) <= 2
+    assert batches.count(1) >= 1  # the opted-out entry ran alone
+    q.shutdown(timeout=5)
+
+
+def test_client_batch_stays_atomic_through_coalescing():
+    """A multi-prompt client request coalesces as a unit and its rows
+    come back together, in order."""
+    q = RequestQueue(echo_runner, max_depth=8, max_coalesce=4)
+    pair = q.submit([[1], [2]], 5, coalesce_key=("k",))
+    one = q.submit([[3]], 5, coalesce_key=("k",))
+    q.start()
+    assert pair.result(timeout=5) == [[1, 5], [2, 5]]
+    assert one.result(timeout=5) == [[3, 5]]
+    assert q.stats["coalesced_requests"] == 2
+    q.shutdown(timeout=5)
+
+
+def test_runner_error_fans_out_and_queue_survives():
+    """A generation failure resolves every coalesced future with the
+    error; the scheduler thread survives and serves the next request."""
+    calls = {"n": 0}
+
+    def flaky_runner(prompts, max_new):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected decode failure")
+        return [list(p) for p in prompts]
+
+    q = RequestQueue(flaky_runner, max_depth=8, max_coalesce=4)
+    f1 = q.submit([[1]], 4, coalesce_key=("k",))
+    f2 = q.submit([[2]], 4, coalesce_key=("k",))
+    q.start()
+    for f in (f1, f2):
+        with pytest.raises(RuntimeError, match="injected"):
+            f.result(timeout=5)
+    assert q.stats["gen_errors"] == 1
+    f3 = q.submit([[3]], 4)
+    assert f3.result(timeout=5) == [[3]]
+    q.shutdown(timeout=5)
+
+
+def test_close_drains_admitted_work_then_rejects():
+    """The graceful-drain contract: close() stops admission immediately,
+    already-admitted entries still complete, join() observes the drain."""
+    release = threading.Event()
+
+    def gated_runner(prompts, max_new):
+        release.wait(10)
+        return [list(p) for p in prompts]
+
+    q = RequestQueue(gated_runner, max_depth=8, max_coalesce=1).start()
+    futs = [q.submit([[i]], 4) for i in range(3)]
+    q.close()
+    with pytest.raises(QueueClosed):
+        q.submit([[9]], 4)
+    assert q.stats["rejected_closed"] == 1
+    assert not q.join(timeout=0.1)  # still draining (runner gated)
+    release.set()
+    assert q.join(timeout=5)  # drained: queue empty, scheduler exited
+    for f in futs:
+        assert f.result(timeout=1)  # every admitted request was answered
+
+
+def test_forced_shutdown_flushes_waiting_entries():
+    release = threading.Event()
+
+    def gated_runner(prompts, max_new):
+        release.wait(10)
+        return [list(p) for p in prompts]
+
+    q = RequestQueue(gated_runner, max_depth=8, max_coalesce=1).start()
+    running = q.submit([[1]], 4)
+    time.sleep(0.05)
+    waiting = q.submit([[2]], 4)
+    t = threading.Thread(target=q.shutdown,
+                         kwargs={"drain": False, "timeout": 5})
+    t.start()
+    with pytest.raises(QueueClosed):
+        waiting.result(timeout=5)  # flushed, not run
+    release.set()
+    t.join(timeout=5)
+    assert running.result(timeout=5) == [[1]]  # in-flight still finishes
+
+
+def test_busy_seconds_tracks_inflight_generation():
+    release = threading.Event()
+
+    def gated_runner(prompts, max_new):
+        release.wait(10)
+        return [list(p) for p in prompts]
+
+    q = RequestQueue(gated_runner, max_depth=4).start()
+    assert q.busy_seconds() == 0.0
+    fut = q.submit([[1]], 4)
+    time.sleep(0.2)
+    assert q.busy_seconds() >= 0.1  # the watchdog's wedged-decode probe
+    release.set()
+    fut.result(timeout=5)
+    time.sleep(0.05)
+    assert q.busy_seconds() == 0.0
+    q.shutdown(timeout=5)
+
+
+def test_runner_row_count_mismatch_is_an_error():
+    q = RequestQueue(lambda prompts, max_new: [], max_depth=4).start()
+    fut = q.submit([[1]], 4)
+    with pytest.raises(RuntimeError, match="0 rows for 1 prompts"):
+        fut.result(timeout=5)
+    q.shutdown(timeout=5)
+
+
+def test_invalid_construction_and_submit():
+    with pytest.raises(ValueError, match="max_depth"):
+        RequestQueue(echo_runner, max_depth=0)
+    with pytest.raises(ValueError, match="max_coalesce"):
+        RequestQueue(echo_runner, max_coalesce=0)
+    q = RequestQueue(echo_runner)
+    with pytest.raises(ValueError, match="non-empty"):
+        q.submit([], 4)

@@ -1,0 +1,351 @@
+"""Serving traffic drills through the real CLI (`make test-serve-drill`):
+a tiny CPU server is flooded, drained, and fault-injected, and the
+admission-controlled pipeline (core/request_queue.py wired into
+tools/serve.py) must keep every contract:
+
+  flood       under a concurrent burst with a full queue, every request
+              gets exactly one of {200, 429, 503} within its deadline +
+              scheduling slack — no hung connections
+  drain       SIGTERM mid-traffic: /healthz reports draining, every
+              admitted request is answered, the process exits 0
+  gen_crash   an injected generation crash returns 500 (structured
+              gen_error stats on /healthz) while the server keeps serving
+  gen_hang    a wedged decode: the watchdog flips /healthz to degraded,
+              queued requests shed honestly, a second SIGTERM force-quits
+
+Follows tests/test_fault_injection.py conventions: `fault`-marked,
+subprocess-driven, one synthetic tiny-GPT config, persistent XLA compile
+cache shared through the environment (tests/conftest.py)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = {
+    "Global": {"global_batch_size": 8, "seed": 11},
+    "Engine": {"mix_precision": {"enable": False},
+               "save_load": {"save_steps": 0}},
+    "Model": {
+        "module": "GPTModule",
+        "vocab_size": 96,
+        "hidden_size": 32,
+        "num_layers": 2,
+        "num_attention_heads": 4,
+        "max_position_embeddings": 64,
+        "dtype": "float32",
+    },
+    "Optimizer": {"name": "FusedAdamW",
+                  "lr": {"name": "Constant", "learning_rate": 1e-3}},
+    "Generation": {"max_dec_len": 8, "decode_strategy": "greedy_search",
+                   "pad_to_multiple": 8, "eos_token_id": 95,
+                   "pad_token_id": 0},
+}
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _post(port, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _healthz(port, timeout=10):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=timeout
+    ) as r:
+        return json.load(r)
+
+
+def _start_server(tmp_path, *, deadline=45.0, depth=32, coalesce=2,
+                  watchdog=300.0, shed_slack=3.0, warmup_batches="1",
+                  extra_env=None):
+    """Boot tools/serve.py on the tiny config; wait until /healthz is up
+    (warmup compiles ride the persistent XLA cache).  Returns (proc, port).
+
+    ``warmup_batches`` is pinned to "1" by default so warmup issues
+    exactly ONE generation request — the `gen_crash:<n>`/`gen_hang:<n>`
+    sites count generation requests, and the drills rely on "warmup is
+    request 1, first traffic is request 2"."""
+    cfg_path = tmp_path / "tiny_serve.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    port = _free_port()
+    env = dict(os.environ)
+    env["PFX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("PFX_FAULT", None)
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "-c", str(cfg_path), "--port", str(port),
+         "--queue-depth", str(depth), "--max-coalesce", str(coalesce),
+         "--deadline", str(deadline), "--shed-slack", str(shed_slack),
+         "--watchdog", str(watchdog), "--warmup-buckets", "4",
+         "--warmup-batches", warmup_batches],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    deadline_t = time.time() + 300
+    while time.time() < deadline_t:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"server died at boot: {proc.stdout.read()[-3000:]}"
+            )
+        try:
+            h = _healthz(port, timeout=5)
+            if h.get("ok"):
+                return proc, port
+        except Exception:
+            time.sleep(0.5)
+    proc.kill()
+    raise AssertionError("server never became healthy")
+
+
+def _finish(proc, timeout=30):
+    """Terminate (graceful first) and return the full captured log."""
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+    return proc.stdout.read()
+
+
+def test_flood_every_request_answered_or_honestly_shed(tmp_path):
+    """Concurrent flood against a depth-3 queue: exactly one response per
+    request, each in {200, 429, 503}, each within deadline + slack; the
+    bounded queue really rejected (429 seen), and /healthz accounting
+    (rejects, latency reservoir, drained queue) adds up."""
+    deadline = 45.0
+    proc, port = _start_server(tmp_path, deadline=deadline, depth=3,
+                               coalesce=2, shed_slack=3.0,
+                               warmup_batches="1,2")
+    try:
+        n = 12
+        results = [None] * n
+
+        def worker(i):
+            t0 = time.monotonic()
+            code, body = _post(
+                port,
+                {"prompt_ids": [1, 2, 3], "max_tokens": 4,
+                 "deadline_s": deadline},
+                timeout=deadline + 20,
+            )
+            results[i] = (code, time.monotonic() - t0)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=deadline + 30)
+            assert not t.is_alive(), "hung connection in the flood"
+        assert all(r is not None for r in results), results
+        codes = [c for c, _ in results]
+        assert all(c in (200, 429, 503) for c in codes), codes
+        assert codes.count(200) >= 1, codes  # traffic was actually served
+        assert 429 in codes, codes  # bounded admission really rejected
+        slack = 15.0  # scheduling slack + HTTP overhead
+        assert all(dt <= deadline + slack for _, dt in results), results
+
+        h = _healthz(port)
+        assert h["queue"]["rejected_full"] >= 1, h
+        assert h["counters"].get("http_200", 0) >= 1, h
+        assert h["counters"].get("http_429", 0) >= 1, h
+        assert h["state"] == "ok" and h["queue_depth"] == 0, h
+        assert h["latency_p50_s"] > 0 and h["latency_p99_s"] > 0, h
+        # coalescing engaged under the burst (same-bucket prompts)
+        assert h["queue"]["coalesced_requests"] >= 2, h
+
+        # one request cannot smuggle an unbounded batch past admission:
+        # a 100-prompt entry would occupy one queue slot yet key a giant
+        # padded-batch compile on the single scheduler thread
+        code, resp = _post(
+            port,
+            {"prompts_ids": [[1, 2]] * 100, "max_tokens": 4},
+            timeout=30,
+        )
+        assert code == 400 and "too many prompts" in resp["error"], (code, resp)
+    finally:
+        log = _finish(proc)
+    assert "Traceback" not in log, log[-3000:]
+
+
+def test_sigterm_mid_traffic_drains_and_exits_zero(tmp_path):
+    """SIGTERM with a queued backlog: admission closes (/healthz reports
+    draining), every admitted request is answered, exit code 0."""
+    proc, port = _start_server(tmp_path, deadline=90.0, depth=32,
+                               coalesce=2)
+    try:
+        n = 10
+        results = [None] * n
+
+        def worker(i):
+            results[i] = _post(
+                port,
+                {"prompt_ids": [2, 3, 4], "max_tokens": 8,
+                 "deadline_s": 90},
+                timeout=120,
+            )
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)  # let the burst be admitted
+        proc.send_signal(signal.SIGTERM)
+
+        # the server must report draining while the backlog finishes
+        saw_draining = False
+        t_end = time.time() + 30
+        while time.time() < t_end and proc.poll() is None:
+            try:
+                h = _healthz(port, timeout=5)
+            except Exception:
+                break  # drain finished and the listener went away
+            if h.get("state") == "draining":
+                saw_draining = True
+                assert h.get("ok"), h  # draining is healthy, not degraded
+                break
+            time.sleep(0.02)
+        assert saw_draining, "healthz never reported draining"
+
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "request hung through the drain"
+        codes = [c for c, _ in results]
+        # admitted -> 200; a straggler that raced the close -> 503; but
+        # traffic this early is admitted, so most must be real answers
+        assert all(c in (200, 503) for c in codes), codes
+        assert codes.count(200) >= n - 2, codes
+        rc = proc.wait(timeout=120)
+        assert rc == 0, rc
+    finally:
+        log = _finish(proc)
+    assert "draining" in log and "drained cleanly" in log, log[-3000:]
+    assert "Traceback" not in log, log[-3000:]
+
+
+def test_gen_crash_returns_500_server_keeps_serving(tmp_path):
+    """PFX_FAULT=gen_crash:2 (warmup is request 1): the first traffic
+    request gets a 500 with the injected error, the cache pool is not
+    poisoned, and the server keeps serving token-identical answers."""
+    proc, port = _start_server(
+        tmp_path, extra_env={"PFX_FAULT": "gen_crash:2"}
+    )
+    try:
+        body = {"prompt_ids": [1, 2, 3], "max_tokens": 4, "deadline_s": 60}
+        code, resp = _post(port, body, timeout=90)
+        assert code == 500 and "gen_crash" in resp["error"], (code, resp)
+
+        code2, resp2 = _post(port, body, timeout=90)
+        assert code2 == 200, (code2, resp2)
+        code3, resp3 = _post(port, body, timeout=90)
+        assert code3 == 200, (code3, resp3)
+        # greedy determinism across the crash: the recycled pool entry
+        # was dropped, not donation-poisoned
+        assert resp2["completion_ids"] == resp3["completion_ids"]
+
+        h = _healthz(port)
+        assert h["gen_errors"] == 1, h
+        assert "gen_crash" in h["last_error"], h
+        assert h["counters"].get("http_500", 0) == 1, h
+        assert h["counters"].get("http_200", 0) >= 2, h
+        assert h["state"] == "ok", h
+    finally:
+        log = _finish(proc)
+    assert "Traceback" not in log, log[-3000:]
+
+
+def test_gen_hang_watchdog_degrades_sheds_and_force_quits(tmp_path):
+    """PFX_FAULT=gen_hang:2 wedges the scheduler: the hanging client is
+    shed at its deadline (no hung connection), the watchdog flips
+    /healthz to degraded, a queued request sheds before any decode, and
+    SIGTERM escalation (drain, then force-quit) works."""
+    proc, port = _start_server(
+        tmp_path, watchdog=2.0, shed_slack=2.0,
+        extra_env={"PFX_FAULT": "gen_hang:2", "PFX_FAULT_HANG_S": "600"},
+    )
+    try:
+        t0 = time.monotonic()
+        code, resp = _post(
+            port,
+            {"prompt_ids": [1, 2, 3], "max_tokens": 4, "deadline_s": 3},
+            timeout=60,
+        )
+        # wedged decode: honest 503 at deadline + slack, not a hang
+        assert code == 503, (code, resp)
+        assert time.monotonic() - t0 < 20
+
+        degraded = False
+        t_end = time.time() + 20
+        while time.time() < t_end:
+            h = _healthz(port)
+            if not h.get("ok") and h.get("state") == "degraded":
+                degraded = True
+                break
+            time.sleep(0.25)
+        assert degraded, h
+        assert h["busy_s"] > 2, h  # the wedge is visible
+
+        # a request queued behind the wedge is shed without a decode
+        code2, _ = _post(
+            port,
+            {"prompt_ids": [4, 5, 6], "max_tokens": 4, "deadline_s": 1},
+            timeout=30,
+        )
+        assert code2 == 503
+        assert _healthz(port)["queue"]["shed_deadline"] >= 1
+
+        # graceful drain can never finish (scheduler wedged): first
+        # signal drains, second force-quits — the PR 2 escalation
+        # contract.  The second signal here is SIGINT, the harder case:
+        # its default action raises KeyboardInterrupt in serve_forever,
+        # which must NOT fall through to server_close's join of
+        # non-daemon handler threads (that would hold the process for up
+        # to max_deadline + slack behind the wedged decode).
+        proc.send_signal(signal.SIGTERM)
+        t_end = time.time() + 15
+        draining = False
+        while time.time() < t_end:
+            h = _healthz(port)
+            if h.get("state") == "draining":
+                draining = True
+                break
+            time.sleep(0.1)
+        assert draining, h
+        t0 = time.monotonic()
+        proc.send_signal(signal.SIGINT)
+        rc = proc.wait(timeout=30)
+        assert rc == 130, rc  # force-quit exit, not a clean drain
+        assert time.monotonic() - t0 < 15  # immediate, no thread joins
+    finally:
+        _finish(proc)

@@ -229,6 +229,100 @@ def test_clamp_max_tokens():
         clamp_max_tokens("lots", 64, 128)
 
 
+def test_coalescing_parity_with_sequential(server):
+    """The acceptance drill, in-process: N single-prompt greedy requests
+    coalesced into one batched decode are token-for-token identical to
+    serving them sequentially, and repeated coalesced traffic adds ZERO
+    retraces (the batch rides the existing power-of-two bucketing)."""
+    from paddlefleetx_tpu.core.request_queue import RequestQueue
+
+    prompts = [[7, 8, 9], [1, 2], [3, 4, 5, 6], [2, 9]]
+    seq = [server.generate_ids([p], max_dec_len=6)[0] for p in prompts]
+
+    def runner(ps, mx):
+        return server.generate_ids(ps, max_dec_len=mx)
+
+    q = RequestQueue(runner, max_depth=8, max_coalesce=4)
+    futs = [q.submit([p], 6, coalesce_key=("parity",)) for p in prompts]
+    q.start()  # submitted first: one scan coalesces all four
+    got = [f.result(timeout=300)[0] for f in futs]
+    assert got == seq
+    assert q.stats["coalesced_batches"] == 1
+    assert q.stats["coalesced_requests"] == len(prompts)
+    q.shutdown(timeout=10)
+
+    # repeat coalesced traffic: no new traces — the coalesced batch hits
+    # an already-compiled (bucket_b, bucket_len) artifact
+    before = server.stats["traces"]
+    q2 = RequestQueue(runner, max_depth=8, max_coalesce=4)
+    futs = [q2.submit([p], 6, coalesce_key=("parity",)) for p in prompts]
+    q2.start()
+    got2 = [f.result(timeout=300)[0] for f in futs]
+    assert got2 == seq
+    assert server.stats["traces"] == before
+    q2.shutdown(timeout=10)
+
+
+def test_warmup_buckets_and_stats(server):
+    """warmup accepts a list of prompt-length buckets, reports per-bucket
+    compile seconds in stats, and validates loudly up front."""
+    per = server.warmup([4, 20])
+    assert set(per) == {"4", "20"}
+    assert server.stats["warmup_s"] == per
+    assert all(v >= 0 for v in per.values())
+    assert "4" in server.warmup(4)  # old warmup(prompt_len) shape
+    with pytest.raises(ValueError, match="decode room"):
+        server.warmup([10**6])
+    with pytest.raises(ValueError, match="batch size"):
+        server.warmup([4], batch_sizes=[0])
+    with pytest.raises(ValueError, match=">= 1"):
+        server.warmup([])
+
+
+def test_warmup_fails_loudly_not_half_warmed(server, monkeypatch):
+    """A bucket that cannot compile raises naming what did and did not
+    warm, instead of leaving a silently half-warmed server."""
+    from paddlefleetx_tpu.utils import resilience
+
+    resilience.reset_fault_state()
+    monkeypatch.setenv(
+        "PFX_FAULT", f"gen_crash:{int(server.stats['requests']) + 1}"
+    )
+    with pytest.raises(RuntimeError, match="warmup failed at bucket"):
+        server.warmup([4])
+    monkeypatch.delenv("PFX_FAULT")
+    resilience.reset_fault_state()
+    server.warmup([4])  # recovers cleanly
+
+
+def test_gen_error_does_not_poison_cache_pool(server, monkeypatch):
+    """A generation failure after the donated cache was popped must drop
+    the (possibly donation-invalidated) pair — not return it to the pool
+    — and record structured gen_error stats for /healthz."""
+    from paddlefleetx_tpu.utils import resilience
+
+    prompt = [[5, 6, 7]]
+    before_rows = server.generate_ids(prompt, max_dec_len=5)
+    bucket_key = next(reversed(server._cache_pool))  # MRU = this bucket
+    errs0 = server.stats["gen_errors"]
+
+    resilience.reset_fault_state()
+    monkeypatch.setenv(
+        "PFX_FAULT", f"gen_crash:{int(server.stats['requests']) + 1}"
+    )
+    with pytest.raises(RuntimeError, match="injected gen_crash"):
+        server.generate_ids(prompt, max_dec_len=5)
+    monkeypatch.delenv("PFX_FAULT")
+    resilience.reset_fault_state()
+
+    assert server.stats["gen_errors"] == errs0 + 1
+    assert "gen_crash" in server.stats["last_error"]
+    # the bucket was dropped, not left pointing at a donated pair
+    assert bucket_key not in server._cache_pool
+    # and the pool recovers: same bucket serves again, token-identical
+    assert server.generate_ids(prompt, max_dec_len=5) == before_rows
+
+
 def test_cache_pool_is_lru_bounded(server):
     """Each pooled cache pins a device k/v pair; mixed traffic across
     many buckets must not retain more than Generation.cache_pool_size

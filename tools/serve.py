@@ -5,17 +5,28 @@ multi-rank predictor + projects/gpt/inference scripts): one process per
 host, TP over the serving mesh, bucketed prompts so repeat traffic reuses
 compiled decode artifacts (`core/serving.py`).
 
+The HTTP path runs on an admission-controlled request queue
+(`core/request_queue.py`): bounded depth (full -> 429 + Retry-After),
+per-request deadlines (expired -> 503 before a decode is wasted), a
+single scheduler thread that coalesces compatible waiting requests into
+one batched decode riding the existing compile buckets, SIGTERM/SIGINT
+graceful drain (stop admitting -> answer all admitted work -> exit 0;
+second signal force-quits), and a wedged-generation watchdog that flips
+`/healthz` to degraded.  Operations runbook: docs/serving.md.
+
 Usage:
   python tools/serve.py -c configs/gpt/pretrain_gpt_345M_single.yaml            # REPL
   python tools/serve.py -c ... --port 8000                                       # HTTP
-      POST /generate {"prompt": "...", "max_tokens": 64}
+      POST /generate {"prompt": "...", "max_tokens": 64, "deadline_s": 30}
       GET  /healthz
 """
 
 import argparse
 import json
+import math
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -52,7 +63,7 @@ def clamp_max_tokens(requested, default: int, cap: int) -> int:
     """Resolve a request's max_tokens: the configured default when the
     client sent none, clamped to ``cap`` (> 0) either way, floored at 1.
     A huge client value must not key an enormous decode buffer/compile or
-    hold the generation lock for minutes (Generation.max_tokens_cap /
+    occupy the scheduler for minutes (Generation.max_tokens_cap /
     --max-tokens-cap)."""
     val = default if requested is None else int(requested)
     if cap > 0:
@@ -60,47 +71,170 @@ def clamp_max_tokens(requested, default: int, cap: int) -> int:
     return max(1, val)
 
 
-def serve_http(server, port: int, host: str = "127.0.0.1",
-               gen_timeout_s: float = 120.0, max_tokens_cap: int = 0):
+def plan_request(prompts_ids, max_toks: int, *, bucket: int, context: int):
+    """Predict `GenerationServer.generate_ids` bucketing for one request:
+    returns (trim, coalesce_key) where ``trim`` is the request's own
+    decode cap after context clamping and ``coalesce_key`` is
+    (prompt-length bucket, 32-bucketed decode length) — two requests with
+    equal keys pad identically whether served together or apart, so
+    coalescing them reuses an already-compiled artifact and (greedy)
+    stays token-identical to sequential serving.  Built on the SAME
+    helpers generate_ids pads/clamps with (`bucket_len`, `plan_decode`),
+    so the prediction cannot drift from the padding.  Raises ValueError
+    when the padded prompt leaves no decode room (HTTP 400, before
+    admission)."""
+    from paddlefleetx_tpu.core.serving import plan_decode
+    from paddlefleetx_tpu.models.gpt.generation import bucket_len
+
+    pbucket = bucket_len(max(len(p) for p in prompts_ids), bucket)
+    trim, run = plan_decode(pbucket, max_toks, context=context)
+    return trim, (pbucket, run)
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list (0 when empty);
+    stdlib-only so /healthz never imports numpy on the hot path."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return round(sorted_vals[idx], 4)
+
+
+def serve_http(server, port: int, host: str = "127.0.0.1", *,
+               queue_depth: int = 64, max_coalesce: int = 8,
+               default_deadline_s: float = 120.0, max_deadline_s: float = 600.0,
+               shed_slack_s: float = 2.0,
+               watchdog_s: float = 300.0, max_tokens_cap: int = 0):
+    import collections
+    import signal
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-    # generation mutates server state (RNG key split, stats) and shares one
-    # compiled artifact cache — serialize it; the threading server still
-    # keeps /healthz responsive while a long generation runs
-    gen_lock = threading.Lock()
-    # in-flight /generate requests (queued + running); /healthz surfaces it
-    # so an operator can tell "busy" from "wedged" at a glance.  Handler
-    # threads run concurrently, so the +=/-= pair needs its own lock or
-    # lost updates would drift the gauge permanently.
-    in_flight = {"n": 0}
-    in_flight_lock = threading.Lock()
+    from paddlefleetx_tpu.core.request_queue import (
+        DeadlineExceeded,
+        QueueClosed,
+        QueueFull,
+        RequestQueue,
+    )
+
     cap = max_tokens_cap or int(
         server.cfg.get("Generation", {}).get("max_tokens_cap", 0) or 0
     )
+    context = int(server.module.config.max_position_embeddings)
+    bucket = server.bucket
+
+    # the scheduler thread is the ONLY caller of generate_ids once
+    # traffic starts: generation mutates server state (RNG key split,
+    # stats, cache pool) and shares one compiled-artifact cache, so the
+    # queue replaces the old global gen_lock outright
+    queue = RequestQueue(
+        lambda prompts, max_new: server.generate_ids(
+            prompts, max_dec_len=max_new
+        ),
+        max_depth=queue_depth, max_coalesce=max_coalesce, name="serve",
+    )
+
+    # in-flight /generate requests (admission + wait + response write);
+    # /healthz surfaces it so an operator tells "busy" from "wedged"
+    in_flight = {"n": 0}
+    in_flight_lock = threading.Lock()
+    # health state flags + HTTP outcome counters + latency reservoir
+    flags = {"draining": False, "degraded": False}
+    counters = collections.Counter()
+    counters_lock = threading.Lock()
+    latencies = collections.deque(maxlen=256)
+    stop_event = threading.Event()
 
     class Handler(BaseHTTPRequestHandler):
+        timeout = 120  # a silent client can't pin a handler thread forever
+
         def log_message(self, *a):  # route through our logger instead
             pass
 
         def _json(self, code: int, obj, headers=None):
-            body = json.dumps(obj).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            for k, v in (headers or {}).items():
-                self.send_header(k, v)
-            self.end_headers()
-            self.wfile.write(body)
+            # disconnect-tolerant: a client that hung up while we write
+            # (including on an error path) is counted as client_gone —
+            # never a stack trace, never a skewed http_* counter
+            try:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError, TimeoutError):
+                # TimeoutError: the handler socket timeout fired while a
+                # stalled client refused our bytes — same client_gone class
+                with counters_lock:
+                    counters["client_gone"] += 1
+            else:
+                with counters_lock:
+                    counters[f"http_{code}"] += 1
 
         def do_GET(self):
             if self.path == "/healthz":
-                # stats include last_latency_s + traces (retrace counter)
-                self._json(
-                    200, {"ok": True, "in_flight": in_flight["n"], **server.stats}
-                )
+                state = ("draining" if flags["draining"]
+                         else "degraded" if flags["degraded"] else "ok")
+                with counters_lock:
+                    counts = dict(counters)
+                    lat = sorted(latencies)
+                self._json(200, {
+                    "ok": not flags["degraded"],
+                    "state": state,
+                    "in_flight": in_flight["n"],
+                    "queue_depth": queue.depth(),
+                    "busy_s": round(queue.busy_seconds(), 3),
+                    "queue": dict(queue.stats),
+                    "counters": counts,
+                    "latency_p50_s": _percentile(lat, 0.50),
+                    "latency_p99_s": _percentile(lat, 0.99),
+                    **server.stats,
+                })
             else:
                 self._json(404, {"error": "unknown path"})
+
+        def _parse_prompts(self, req):
+            """(prompts_ids, mode) from a /generate body; raises
+            ValueError with a client-facing message (HTTP 400)."""
+            if "prompt" in req or "prompts" in req:
+                if server.tokenizer is None:
+                    raise ValueError(
+                        "no tokenizer configured (Generation.tokenizer_dir); "
+                        "send prompt_ids/prompts_ids"
+                    )
+                if "prompt" in req:
+                    texts, mode = [req["prompt"]], "prompt"
+                else:
+                    texts, mode = list(req["prompts"]), "prompts"
+                if not texts or not all(
+                    isinstance(t, str) and t for t in texts
+                ):
+                    raise ValueError("prompts must be non-empty strings")
+                return [server.tokenizer.encode(t) for t in texts], mode
+            if "prompt_ids" in req:
+                ids, mode = [req["prompt_ids"]], "prompt_ids"
+            elif "prompts_ids" in req:
+                ids, mode = list(req["prompts_ids"]), "prompts_ids"
+            else:
+                raise ValueError("need prompt(s) or prompt(s)_ids")
+            if not ids or any(not p for p in ids):
+                raise ValueError(
+                    "prompts must be a non-empty list of non-empty id lists"
+                )
+            return [[int(t) for t in p] for p in ids], mode
+
+        def _check_batch_cap(self, prompts_ids):
+            # one request may not smuggle an unbounded batch past the
+            # admission bounds: a 4096-prompt entry would occupy ONE
+            # queue slot yet key a giant padded-batch compile that wedges
+            # the single scheduler thread for everyone else
+            if len(prompts_ids) > max_coalesce:
+                raise ValueError(
+                    f"too many prompts in one request "
+                    f"({len(prompts_ids)} > {max_coalesce}); split the batch"
+                )
 
         def do_POST(self):
             if self.path != "/generate":
@@ -108,54 +242,202 @@ def serve_http(server, port: int, host: str = "127.0.0.1",
             with in_flight_lock:
                 in_flight["n"] += 1
             try:
+                t0 = time.monotonic()
                 n = int(self.headers.get("Content-Length", 0))
-                req = json.loads(self.rfile.read(n) or b"{}")
-                max_toks = clamp_max_tokens(
-                    req.get("max_tokens"), server.gen.max_dec_len, cap
-                )
-                # bounded wait for the generation lock: a request stuck
-                # behind a wedged/slow generation gets an honest 503 (with
-                # Retry-After) instead of hanging its connection forever
-                if not gen_lock.acquire(timeout=gen_timeout_s):
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError as e:
+                    return self._json(400, {"error": f"bad JSON: {e}"})
+                # ---- validate BEFORE admission: a malformed request
+                # must never occupy a queue slot or a decode ----
+                try:
+                    prompts_ids, mode = self._parse_prompts(req)
+                    self._check_batch_cap(prompts_ids)
+                    max_toks = clamp_max_tokens(
+                        req.get("max_tokens"), server.gen.max_dec_len, cap
+                    )
+                    deadline_s = float(
+                        req.get("deadline_s", default_deadline_s)
+                    )
+                    # finite floor AND server-side ceiling: an unbounded
+                    # client deadline (or JSON Infinity) would pin the
+                    # handler thread + connection for as long as the
+                    # scheduler stays busy — the hung-connection mode
+                    # this queue exists to prevent
+                    if not (deadline_s > 0 and math.isfinite(deadline_s)):
+                        raise ValueError(
+                            "deadline_s must be a positive finite number"
+                        )
+                    deadline_s = min(deadline_s, max_deadline_s)
+                    trim, key = plan_request(
+                        prompts_ids, max_toks, bucket=bucket, context=context
+                    )
+                except (ValueError, TypeError) as e:
+                    return self._json(400, {"error": str(e)})
+                # ---- admission control ----
+                try:
+                    fut = queue.submit(
+                        prompts_ids, trim,
+                        coalesce_key=key, deadline_s=deadline_s,
+                    )
+                except QueueFull:
+                    return self._json(
+                        429,
+                        {"error": f"queue full ({queue_depth} waiting); "
+                                  "retry later"},
+                        headers={"Retry-After": "1"},
+                    )
+                except QueueClosed:
                     return self._json(
                         503,
-                        {"error": f"generation busy for {gen_timeout_s:.0f}s; "
-                                  "retry later"},
-                        headers={"Retry-After": str(max(1, int(gen_timeout_s)))},
+                        {"error": "draining: not admitting new requests"},
+                        headers={"Retry-After": "5"},
                     )
-                # generate under the lock, respond AFTER releasing it: a
-                # slow client blocked in the socket write must not stall
-                # other requests behind a held lock
-                payload = None
+                # ---- wait, bounded by the deadline + scheduling slack:
+                # an unanswerable request gets an honest 503, never a
+                # hung connection ----
                 try:
-                    if "prompt" in req:
-                        texts = server.generate_text([req["prompt"]], max_dec_len=max_toks)
-                        payload = {"completion": texts[0]}
-                    elif "prompts" in req:  # batched: rides the data axis together
-                        texts = server.generate_text(req["prompts"], max_dec_len=max_toks)
-                        payload = {"completions": texts}
-                    elif "prompt_ids" in req:
-                        ids = server.generate_ids([req["prompt_ids"]], max_dec_len=max_toks)
-                        payload = {"completion_ids": ids[0]}
-                    elif "prompts_ids" in req:
-                        ids = server.generate_ids(req["prompts_ids"], max_dec_len=max_toks)
-                        payload = {"completions_ids": ids}
-                finally:
-                    gen_lock.release()
-                if payload is None:
-                    return self._json(400, {"error": "need prompt(s) or prompt(s)_ids"})
+                    rows = fut.result(timeout=deadline_s + shed_slack_s)
+                except TimeoutError:
+                    queue.try_remove(fut)  # shed it if still queued
+                    return self._json(
+                        503,
+                        {"error": f"deadline {deadline_s:g}s exceeded"},
+                        headers={"Retry-After": "1"},
+                    )
+                except DeadlineExceeded as e:
+                    return self._json(
+                        503, {"error": str(e)}, headers={"Retry-After": "1"}
+                    )
+                except QueueClosed as e:  # flushed by a forced shutdown
+                    return self._json(
+                        503, {"error": str(e)}, headers={"Retry-After": "5"}
+                    )
+                except ValueError as e:  # bad request that got past checks
+                    return self._json(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — report, keep serving
+                    return self._json(500, {"error": str(e)})
+                if mode in ("prompt", "prompts"):
+                    texts = [server.tokenizer.decode(r) for r in rows]
+                    payload = ({"completion": texts[0]} if mode == "prompt"
+                               else {"completions": texts})
+                else:
+                    payload = ({"completion_ids": rows[0]}
+                               if mode == "prompt_ids"
+                               else {"completions_ids": rows})
+                with counters_lock:
+                    latencies.append(time.monotonic() - t0)
                 return self._json(200, payload)
-            except ValueError as e:  # bad request (empty prompts, etc.)
-                return self._json(400, {"error": str(e)})
-            except Exception as e:  # noqa: BLE001 — report, keep serving
+            except Exception as e:  # noqa: BLE001 — last-resort guard
                 return self._json(500, {"error": str(e)})
             finally:
                 with in_flight_lock:
                     in_flight["n"] -= 1
 
-    httpd = ThreadingHTTPServer((host, port), Handler)
-    print(f"serving on {host}:{port} (POST /generate, GET /healthz)", flush=True)
-    httpd.serve_forever()
+    class Server(ThreadingHTTPServer):
+        # NON-daemon handler threads: socketserver only tracks (and
+        # server_close only joins) non-daemon threads, and the drain
+        # contract requires every admitted request's response bytes to be
+        # written before the process exits.  A wedged handler cannot block
+        # a force-quit — the second signal's default SIGTERM action kills
+        # the process without waiting on threads — and the Handler socket
+        # timeout bounds how long a stalled client can delay a drain.
+        daemon_threads = False
+        block_on_close = True  # graceful drain joins in-flight responses
+
+        def handle_error(self, request, client_address):
+            exc = sys.exc_info()[1]
+            if isinstance(exc, (BrokenPipeError, ConnectionResetError,
+                                TimeoutError)):
+                with counters_lock:
+                    counters["client_gone"] += 1
+                return
+            super().handle_error(request, client_address)
+
+    httpd = Server((host, port), Handler)
+
+    def _watchdog():
+        # a generation stuck past the watchdog budget flips /healthz to
+        # degraded (ok=false) so orchestrators stop routing here; flips
+        # back if the scheduler ever comes unstuck
+        while not stop_event.wait(1.0):
+            busy = queue.busy_seconds()
+            if busy > watchdog_s and not flags["degraded"]:
+                flags["degraded"] = True
+                print(
+                    f"WATCHDOG: generation wedged for {busy:.0f}s "
+                    f"(budget {watchdog_s:.0f}s); /healthz degraded",
+                    flush=True,
+                )
+            elif flags["degraded"] and busy < watchdog_s:
+                # recovered: the wedged generation finished.  Compare
+                # against the budget, not exact idle — under a steady
+                # backlog a 1 Hz sampler may never catch busy == 0
+                flags["degraded"] = False
+                print("WATCHDOG: generation recovered; /healthz ok",
+                      flush=True)
+
+    orig_handlers = {}
+
+    def _on_signal(signum, frame):
+        # mirror the PR 2 engine contract: first signal drains (stop
+        # admitting -> finish admitted work -> exit 0), handlers are
+        # restored immediately so a second signal force-quits
+        for sig, h in orig_handlers.items():
+            signal.signal(sig, h)
+        flags["draining"] = True
+        print(
+            f"signal {signum}: draining — admission closed, "
+            f"{queue.depth()} queued request(s) will finish "
+            "(send again to force-quit)",
+            flush=True,
+        )
+
+        def _drain():
+            queue.close()
+            queue.join()
+            httpd.shutdown()
+
+        threading.Thread(target=_drain, name="serve-drain",
+                         daemon=True).start()
+
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            orig_handlers[sig] = signal.signal(sig, _on_signal)
+    except ValueError:
+        print("warning: not on the main thread; graceful drain handlers "
+              "unavailable", flush=True)
+
+    queue.start()
+    threading.Thread(target=_watchdog, name="serve-watchdog",
+                     daemon=True).start()
+    print(
+        f"serving on {host}:{port} (POST /generate, GET /healthz; "
+        f"queue depth {queue_depth}, coalesce {max_coalesce}, "
+        f"deadline {default_deadline_s:g}s, watchdog {watchdog_s:g}s)",
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        # second Ctrl-C (the first restored default handlers): honor the
+        # promised force-quit.  server_close would join non-daemon
+        # handler threads — one blocked on a wedged decode would hold
+        # the process for up to max_deadline + slack instead of quitting.
+        print("force-quit on second interrupt", flush=True)
+        os._exit(130)
+    finally:
+        stop_event.set()
+        # joins in-flight handler threads: every admitted request gets
+        # its response bytes before the process exits
+        httpd.server_close()
+    if flags["draining"]:
+        print("drained cleanly: all admitted requests answered", flush=True)
+    return 0
+
+
+def _csv_ints(raw: str):
+    return [int(x) for x in raw.split(",") if x.strip()]
 
 
 def main(argv=None):
@@ -168,9 +450,35 @@ def main(argv=None):
     ap.add_argument("--host", default="127.0.0.1",
                     help="bind address (use 0.0.0.0 to expose externally)")
     ap.add_argument("--no-warmup", action="store_true")
-    ap.add_argument("--gen-timeout", type=float, default=120.0,
-                    help="seconds a /generate request waits for the "
-                    "generation lock before returning HTTP 503")
+    ap.add_argument("--warmup-buckets", default="",
+                    help="comma-separated prompt-length buckets to compile "
+                    "at boot (default: 8); warmup fails loudly if any "
+                    "bucket cannot compile")
+    ap.add_argument("--warmup-batches", default="",
+                    help="comma-separated batch-size buckets to warm per "
+                    "prompt bucket (default under --port: powers of two "
+                    "up to --max-coalesce, so the first coalesced burst "
+                    "never pays a mid-traffic compile; default REPL: 1)")
+    ap.add_argument("--queue-depth", type=int, default=64,
+                    help="bounded admission queue depth; a request "
+                    "arriving when full gets HTTP 429 + Retry-After")
+    ap.add_argument("--max-coalesce", type=int, default=8,
+                    help="max prompts merged into one batched decode "
+                    "(same-bucket waiting requests coalesce)")
+    ap.add_argument("--deadline", type=float, default=120.0,
+                    help="default per-request deadline seconds (client "
+                    "overrides with deadline_s); expired requests are "
+                    "shed with HTTP 503 before a decode is wasted")
+    ap.add_argument("--max-deadline", type=float, default=600.0,
+                    help="server-side ceiling on client deadline_s — an "
+                    "unbounded deadline would pin a handler thread and "
+                    "its connection indefinitely")
+    ap.add_argument("--shed-slack", type=float, default=2.0,
+                    help="scheduling slack added to the deadline before "
+                    "the handler gives up waiting and sheds with 503")
+    ap.add_argument("--watchdog", type=float, default=300.0,
+                    help="seconds a single generation may run before "
+                    "/healthz flips to degraded (wedged-decode detector)")
     ap.add_argument("--max-tokens-cap", type=int, default=0,
                     help="hard per-request max_tokens ceiling (0 = use "
                     "Generation.max_tokens_cap from the config, which "
@@ -179,29 +487,60 @@ def main(argv=None):
 
     server = build_server(args.config, args.override)
     if not args.no_warmup:
-        server.warmup()
+        batches = _csv_ints(args.warmup_batches)
+        if not batches and args.port:
+            # HTTP serving coalesces: warm every power-of-two batch
+            # bucket a coalesced burst can land on, so the first burst
+            # rides compiled artifacts instead of paying a mid-traffic
+            # compile on the single scheduler thread
+            b, batches = 1, []
+            while b < max(1, args.max_coalesce):
+                batches.append(b)
+                b *= 2
+            batches.append(b)
+        server.warmup(
+            _csv_ints(args.warmup_buckets) or [8],
+            batch_sizes=batches or [1],
+        )
 
     if args.port:
-        return serve_http(server, args.port, args.host,
-                          gen_timeout_s=args.gen_timeout,
-                          max_tokens_cap=args.max_tokens_cap)
+        return serve_http(
+            server, args.port, args.host,
+            queue_depth=args.queue_depth,
+            max_coalesce=args.max_coalesce,
+            default_deadline_s=args.deadline,
+            max_deadline_s=args.max_deadline,
+            shed_slack_s=args.shed_slack,
+            watchdog_s=args.watchdog,
+            max_tokens_cap=args.max_tokens_cap,
+        )
 
     # REPL: one prompt per line -> completion (ids mode when no tokenizer)
-    print("prompt> ", end="", flush=True)
-    for line in sys.stdin:
-        line = line.strip()
-        if not line:
-            break
-        try:
-            if server.tokenizer is not None:
-                print(server.generate_text([line])[0], flush=True)
-            else:
-                ids = [int(t) for t in line.split()]
-                print(" ".join(map(str, server.generate_ids([ids])[0])), flush=True)
-        except ValueError as e:  # bad ids / empty prompt: report, keep serving
-            print(f"error: {e}", flush=True)
+    try:
         print("prompt> ", end="", flush=True)
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                break
+            try:
+                if server.tokenizer is not None:
+                    print(server.generate_text([line])[0], flush=True)
+                else:
+                    ids = [int(t) for t in line.split()]
+                    print(" ".join(map(str, server.generate_ids([ids])[0])),
+                          flush=True)
+            except ValueError as e:  # bad ids / empty prompt: report, keep serving
+                print(f"error: {e}", flush=True)
+            except Exception as e:  # noqa: BLE001 — a tokenizer/runtime
+                # failure is reported without tearing down the session
+                print(f"generation failed ({type(e).__name__}): {e}",
+                      flush=True)
+            print("prompt> ", end="", flush=True)
+    except (EOFError, KeyboardInterrupt):
+        pass  # clean exit on ^C / closed stdin
+    print("", flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
