@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill bench
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill bench
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -57,6 +57,13 @@ test-fault:
 # the real tools/serve.py CLI (docs/serving.md runbook)
 test-serve-drill:
 	python -m pytest tests/test_request_queue.py tests/test_serve_drills.py -q
+
+# data-pipeline drills: loader/sampler/index-cache units + subprocess
+# fault drills (corrupt_sample skip budget / io_stall watchdog / index-map
+# build race / rollback-rewind replay) through the real tools/train.py CLI
+# (docs/data_pipeline.md runbook)
+test-data-drill:
+	python -m pytest tests/test_data.py tests/test_data_drills.py "tests/test_fault_injection.py::test_nan_rollback_rewind_replay_parity" -q
 
 bench:
 	python benchmarks/run_benchmark.py

@@ -391,6 +391,8 @@ class Engine:
 
         self._consumed_samples = 0
         self._step = 0  # host mirror of state.step (avoids device sync in fit)
+        self._train_loader = None  # held during fit: ckpt meta + rollback rewind
+        self._loader_state = None  # loader state from a restored ckpt meta
         self.state = self._init_state()
         # install zigzag positions EAGERLY for the configured sequence
         # length: a caller that resolves the step attribute before placing
@@ -940,6 +942,18 @@ class Engine:
             logger.warning(f"metrics_file write failed (disabling): {e}")
             self.metrics_file = ""
 
+    def _drain_skip_events(self, loader) -> None:
+        """Move the loader's structured ``data_skip`` events (appended by
+        the skip budget, data/batch_sampler.py) into the metrics stream,
+        stamped with the upcoming step."""
+        events = getattr(loader, "skip_events", None)
+        if not events:
+            return
+        while events:
+            ev = dict(events.pop(0))
+            ev.setdefault("step", self._step + 1)
+            self._write_metrics(ev)
+
     def _require_concrete(self, op: str) -> None:
         if self.abstract_init:
             raise RuntimeError(
@@ -955,12 +969,29 @@ class Engine:
         Preemption-aware: SIGTERM/SIGINT finishes the in-flight step, joins
         any async save, writes a final checkpoint with a ``preempted``
         marker, and returns with ``self.preempted`` set — the launcher
-        (tools/train.py) then exits 0 so a relaunch auto-resumes."""
+        (tools/train.py) then exits 0 so a relaunch auto-resumes.
+
+        Data-pipeline contract (docs/data_pipeline.md): the engine holds
+        the train loader for checkpoint meta (stream position + skip
+        budget), rewinds it on anomaly rollback when it supports
+        ``rewind``, drains its structured ``data_skip`` events into the
+        metrics stream, and CLOSES both loaders on the way out so
+        prefetch threads / worker pools never outlive the loop."""
         self._require_concrete("fit")
         t_last = time.time()
         window_tokens = 0
         eval_iter = iter(eval_loader) if eval_loader is not None else None
         tokens_per_sample = self.module.tokens_per_sample or 1
+        self._train_loader = train_loader
+        # resumed checkpoints carry loader state (skip budget spent so a
+        # rotten shard cannot earn a fresh budget every crash-loop lap);
+        # the stream position itself was already applied when the loader
+        # was built from this engine's _consumed_samples
+        # consumed unconditionally: a stale entry must never leak into a
+        # later fit() with a different loader
+        loader_state, self._loader_state = self._loader_state, None
+        if loader_state and hasattr(train_loader, "load_state"):
+            train_loader.load_state(loader_state)
 
         # config-gated trace window (reference Profiler block,
         # eager_engine.py:250-272 + profiler.step :419)
@@ -979,6 +1010,16 @@ class Engine:
             preempt.uninstall()
             # flush an in-flight trace even when a step raises
             profiler.close()
+            # reclaim loader machinery (prefetch thread, worker pool)
+            # before returning: an abandoned daemon thread blocked on a
+            # fetch is a leak the interpreter drags to shutdown
+            for ldr in (train_loader, eval_loader):
+                close = getattr(ldr, "close", None)
+                if callable(close):
+                    try:
+                        close()
+                    except Exception as e:  # noqa: BLE001 — best-effort
+                        logger.warning(f"loader close failed: {e}")
             # a checkpoint still writing in background must become durable
             # before fit returns (callers may exit the process right after)
             self.wait_for_save()
@@ -997,11 +1038,18 @@ class Engine:
             window=self.res_loss_window,
         )
 
-    def _rollback(self, step: int, reason: str, rollbacks: int) -> None:
+    def _rollback(self, step: int, reason: str, rollbacks: int) -> bool:
         """Anomaly response: restore params+opt-state from the last good
         checkpoint and let the loop re-enter from there.  Bounded: past
         ``resilience.max_rollbacks`` (or with no checkpoint to return to)
-        the run fails loudly instead of thrashing."""
+        the run fails loudly instead of thrashing.
+
+        Returns True when the data stream was REWOUND to the checkpoint
+        position (loader supports ``rewind``): the caller must re-iter()
+        the loader, and the replayed loss stream is then a token-for-token
+        repeat of what an uninterrupted run would have produced.  False =
+        legacy behavior (stream keeps its live position, same contract as
+        a process restart mid-epoch without loader state)."""
         # an async save may be seconds from durable: join it first so its
         # checkpoint counts as the rollback target (the finisher thread is
         # what records _last_good_ckpt)
@@ -1020,6 +1068,8 @@ class Engine:
                 f"{self.res_max_rollbacks} exhausted; the run is not "
                 "recovering, stopping instead of thrashing"
             )
+        loader = self._train_loader
+        rewindable = loader is not None and hasattr(loader, "rewind")
         logger.error(
             f"ANOMALY at step {step}: {reason}; rolling back to "
             f"{self._last_good_ckpt} (rollback {rollbacks + 1}/"
@@ -1032,17 +1082,42 @@ class Engine:
                 "reason": reason,
                 "ckpt": self._last_good_ckpt,
                 "rollback_index": rollbacks + 1,
+                "rewound": bool(rewindable),
             }
         )
         # the LIVE data-stream position: every step served so far plus the
-        # just-dispatched (discarded) batch.  load() resets the counter to
-        # the checkpoint's value, but the loader does NOT rewind — leaving
-        # the stale count would make the next save record a consumed_samples
+        # just-dispatched (discarded) batch — needed only on the legacy
+        # (non-rewindable) path, where load() resets the counter to the
+        # checkpoint's value but the stream cannot rewind; leaving the
+        # stale count would make the next save record a consumed_samples
         # behind the true stream, and a later crash+auto_resume would then
         # re-serve batches, breaking the resume-parity contract.
         live_consumed = self._consumed_samples + self.global_batch_size
         self.load(self._last_good_ckpt)
+        # load() parked the ckpt's loader state for the NEXT fit(); this
+        # fit applies it here (or discards it on the legacy path — it must
+        # not leak into a later fit() against a different loader)
+        loader_state, self._loader_state = self._loader_state, None
+        if rewindable:
+            # rewindable loader: put the stream back at the checkpoint
+            # position so the post-rollback run REPLAYS the failed window
+            # token-for-token (the replay is what proves the rollback
+            # recovered — a diverging replay re-trips the guard).  The
+            # ckpt's full loader state also restores the skip budget to
+            # its checkpoint value: the replayed window re-hits any
+            # corrupt sample, and keeping the live count would charge
+            # max_skips twice for the same record
+            if loader_state and hasattr(loader, "load_state"):
+                loader.load_state(loader_state)
+            else:
+                loader.rewind(self._consumed_samples)
+            logger.warning(
+                f"data stream rewound to consumed_samples="
+                f"{self._consumed_samples} for a token-for-token replay"
+            )
+            return True
         self._consumed_samples = live_consumed
+        return False
 
     def _preempt_save(self, step: int, cause: str) -> None:
         """Final checkpoint on the clean-exit path (signal or
@@ -1088,9 +1163,15 @@ class Engine:
         # the guard never idles the device (async dispatch stays ahead)
         prev_metrics = None
         rollbacks = 0
-        for batch in train_loader:
+        data_iter = iter(train_loader)
+        while True:
+            try:
+                batch = next(data_iter)
+            except StopIteration:
+                break
             if self._step >= self.max_steps:
                 break
+            self._drain_skip_events(train_loader)
             if resilience.maybe_fire("nan_grads", self._step + 1):
                 batch = resilience.poison_batch(batch)
             dev_batch = self._put_batch(batch)
@@ -1104,12 +1185,18 @@ class Engine:
                     # the step just dispatched is discarded along with the
                     # anomalous state: load() replaces self.state and
                     # restores the step/consumed counters from the meta.
-                    # The data stream does NOT rewind — same contract as a
+                    # A rewindable loader is rewound to the checkpoint
+                    # position (token-for-token replay); otherwise the
+                    # stream keeps its live position — same contract as a
                     # process restart mid-epoch.
-                    self._rollback(self._step, reason, rollbacks)
+                    rewound = self._rollback(self._step, reason, rollbacks)
                     rollbacks += 1
                     guard.reset()
                     prev_metrics = None
+                    if rewound:
+                        # position is read at iter() time: restart the
+                        # iteration so the replay starts AT the checkpoint
+                        data_iter = iter(train_loader)
                     continue
             if guard is not None:
                 prev_metrics = {
@@ -1130,16 +1217,25 @@ class Engine:
                     f"lr: {float(metrics['lr']):.3e} grad_norm: {float(metrics['grad_norm']):.3f} "
                     f"ips: {ips:,.0f} tokens/s ({ips/self.mesh.size:,.0f}/device)"
                 )
-                self._write_metrics(
-                    {
-                        "step": step,
-                        "loss": float(metrics["loss"]),
-                        "lr": float(metrics["lr"]),
-                        "grad_norm": float(metrics["grad_norm"]),
-                        "ips": round(ips, 1),
-                        "consumed_samples": self._consumed_samples,
-                    }
-                )
+                record = {
+                    "step": step,
+                    "loss": float(metrics["loss"]),
+                    "lr": float(metrics["lr"]),
+                    "grad_norm": float(metrics["grad_norm"]),
+                    "ips": round(ips, 1),
+                    "consumed_samples": self._consumed_samples,
+                }
+                # data-pipeline health (prefetch depth, cumulative seconds
+                # the loop sat starved, skip budget spent) rides the same
+                # stream so dashboards see starvation next to throughput
+                stats_fn = getattr(train_loader, "stats", None)
+                if callable(stats_fn):
+                    record.update(
+                        (k, v) for k, v in stats_fn().items()
+                        if k in ("data_wait_s", "prefetch_depth",
+                                 "stall_warnings", "skips")
+                    )
+                self._write_metrics(record)
                 t_last = time.time()
                 window_tokens = 0
 
@@ -1203,18 +1299,29 @@ class Engine:
         if hasattr(self.module, "build_metric") and hasattr(self.module, "predict_fn"):
             metric = self.module.build_metric()
         it = iter(loader)
-        for i, batch in enumerate(it):
-            if i >= iters:
-                break
-            dev_batch = self._put_batch(batch)
-            losses.append(float(self._eval_step(self.state, dev_batch, jnp.int32(i))))
-            if metric is not None:
-                # fetched per-iteration: _put_batch may retrace the steps
-                # (zigzag positions install) and a stale closure would
-                # predict with the wrong causal mask
-                predict = self._get_predict_step()
-                preds = np.asarray(jax.device_get(predict(self.state, dev_batch)))
-                metric.update(preds, np.asarray(batch["labels"]))
+        try:
+            for i, batch in enumerate(it):
+                if i >= iters:
+                    break
+                dev_batch = self._put_batch(batch)
+                losses.append(float(self._eval_step(self.state, dev_batch, jnp.int32(i))))
+                if metric is not None:
+                    # fetched per-iteration: _put_batch may retrace the steps
+                    # (zigzag positions install) and a stale closure would
+                    # predict with the wrong causal mask
+                    predict = self._get_predict_step()
+                    preds = np.asarray(jax.device_get(predict(self.state, dev_batch)))
+                    metric.update(preds, np.asarray(batch["labels"]))
+        finally:
+            # a fresh stream created from a loader (it is not loader) is
+            # OURS to reclaim: abandoning a live prefetch iterator leaves
+            # its producer thread spinning forever.  When the CALLER owns
+            # the stream (fit passes its long-lived eval_iter, which
+            # iter() returns unchanged), it stays live.
+            if it is not loader:
+                close = getattr(loader, "close", None)
+                if callable(close):
+                    close()
         avg = float(np.mean(losses)) if losses else float("nan")
         if metric is not None:
             from paddlefleetx_tpu.models.metrics import format_metric
@@ -1308,6 +1415,24 @@ class Engine:
         if self.state.extra is not None:
             payload["extra"] = self.state.extra
         meta = {"step": step, "consumed_samples": self._consumed_samples}
+        loader = self._train_loader
+        if loader is not None and hasattr(loader, "state_dict"):
+            # loader state rides the meta (docs/data_pipeline.md).  The
+            # position is overwritten with the ENGINE's counter: the
+            # sampler's own count runs ahead by the prefetch lookahead
+            # (batches buffered but not yet trained on), and resuming
+            # from it would silently drop those batches.
+            loader_state = dict(loader.state_dict())
+            loader_state["consumed_samples"] = self._consumed_samples
+            # same lookahead correction for the skip budget: the live
+            # count includes prefetched-but-untrained batches, and the
+            # resumed replay of those batches re-spends it
+            skips_at = getattr(loader, "skips_at", None)
+            if callable(skips_at):
+                skips = skips_at(self._consumed_samples)
+                if skips is not None:
+                    loader_state["skips"] = skips
+            meta["loader"] = loader_state
         if preempted:
             meta["preempted"] = True
         if self.state.scaler is not None:
@@ -1434,6 +1559,10 @@ class Engine:
             meta = json.load(f)
         self._consumed_samples = int(meta.get("consumed_samples", 0))
         self._step = int(meta["step"])
+        # loader state (skip budget spent, …) applied to the train loader
+        # at the next fit(); the position itself flows through
+        # _consumed_samples -> build_dataloader
+        self._loader_state = meta.get("loader")
         self._resumed = True  # metrics stream appends instead of truncating
         scaler = None
         if self.use_loss_scaling:

@@ -42,17 +42,30 @@ def build_dataloader(cfg, mode: str, dataset=None, consumed_samples: int = 0) ->
     )
     loader_cfg = cfg.Data[mode].get("loader", {})
     num_workers = int(loader_cfg.get("num_workers", 0) or 0)
+    max_skips = int(loader_cfg.get("max_skips", 0) or 0)
     if num_workers > 0:
         from paddlefleetx_tpu.data.batch_sampler import WorkerLoader
 
+        if max_skips:
+            from paddlefleetx_tpu.utils.log import logger
+
+            logger.warning(
+                "Data.%s.loader.max_skips is an inline-loader feature; "
+                "WorkerLoader (num_workers>0) propagates sample errors "
+                "loudly instead of substituting", mode
+            )
         loader = WorkerLoader(dataset, sampler, collate_stack, num_workers)
     else:
-        loader = DataLoader(dataset, sampler, collate_stack)
+        loader = DataLoader(dataset, sampler, collate_stack, max_skips=max_skips)
     prefetch = int(loader_cfg.get("prefetch", 0) or 0)
     if prefetch > 0:
         from paddlefleetx_tpu.data.batch_sampler import PrefetchLoader
 
-        loader = PrefetchLoader(loader, depth=prefetch)
+        loader = PrefetchLoader(
+            loader,
+            depth=prefetch,
+            stall_warn_s=float(loader_cfg.get("stall_warn_s", 30.0)),
+        )
     return loader
 
 

@@ -3,11 +3,22 @@
 Reference: ppfleetx/data/dataset/gpt_dataset.py:42-465 (GPTDataset).  Data
 format: ``{prefix}_ids.npy`` — all documents' tokens concatenated (uint16/
 uint32); ``{prefix}_idx.npz`` — document token lengths (key ``lens``).
-Samples are fixed ``seq_length`` windows walked across shuffled documents
-over enough epochs to cover ``num_samples``; index maps (doc_idx /
-sample_idx / shuffle_idx) are built once (C++ helper with numpy fallback)
-and cached as .npy beside the data.  Each item yields tokens / position_ids
-/ labels / loss_mask (reference :153-171).
+Samples are fixed ``seq_length`` windows walked across shuffled documents;
+index maps (doc_idx / sample_idx / shuffle_idx) are built once and cached
+as .npy beside the data (atomic writes + a cross-process build lock +
+validated loads with quarantine-on-corruption — data/index_cache.py).
+Each item yields tokens / position_ids / labels / loss_mask (:153-171).
+
+EPOCH-KEYED maps (a deliberate departure from the reference, which sizes
+every map by the requested ``num_samples`` = max_steps x batch): each
+epoch's doc order, window walk, and shuffle are derived independently from
+``(seed, epoch)``, and sample ``i`` lives in epoch ``i //
+samples_per_epoch``.  Extending ``max_steps`` therefore APPENDS epochs
+without reshuffling history — sample ``i`` is the same tokens no matter
+how long the run is — which is what makes checkpoint-resume and
+rollback-rewind replay (docs/data_pipeline.md) stable across config
+changes.  The cache key fingerprints dataset + split + seed + seq_length
++ num_epochs, never num_samples.
 
 Also here: LM_Eval_Dataset (overlapping-window perplexity eval, reference
 :484) and Lambada_Eval_Dataset (:589) used by the GPT eval module.
@@ -22,11 +33,14 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from paddlefleetx_tpu.data.index_cache import (
+    index_map_lock,
+    load_index_cache,
+    save_index_cache,
+)
 from paddlefleetx_tpu.data.indexed import (
     build_blending_indices,
-    build_doc_idx,
     build_sample_idx,
-    build_shuffle_idx,
 )
 from paddlefleetx_tpu.utils.log import logger
 from paddlefleetx_tpu.utils.registry import DATASETS
@@ -92,49 +106,90 @@ class GPTDataset:
         self.doc_lo = lo
         self.docs = np.arange(lo, hi, dtype=np.int32)
         self.sizes = lens[lo:hi]
+        self.seed = int(seed)
         tokens_per_epoch = int(self.sizes.sum())
+        self.tokens_per_epoch = tokens_per_epoch
+        # windows are cut WITHIN an epoch's token stream (each window needs
+        # seq_len+1 tokens; the +1 label overlaps the next window's first
+        # token, Megatron-style), so per-epoch maps are independent of how
+        # many epochs the run ultimately needs
+        samples_per_epoch = (tokens_per_epoch - 1) // self.seq_len
+        if samples_per_epoch < 1:
+            raise ValueError(
+                f"GPTDataset[{mode}]: split holds {tokens_per_epoch} tokens "
+                f"— not one seq_len={self.seq_len}+1 window; shrink "
+                "max_seq_len or feed a bigger corpus/split"
+            )
+        self.samples_per_epoch = samples_per_epoch
         if num_samples is None:
-            num_samples = max((tokens_per_epoch - 1) // self.seq_len, 1)
+            num_samples = samples_per_epoch
         self.num_samples = int(num_samples)
-
         num_epochs = max(
-            1, int(np.ceil((self.num_samples * self.seq_len + 1) / tokens_per_epoch))
+            1, -(-self.num_samples // samples_per_epoch)  # ceil div
         )
+        self.num_epochs = num_epochs
 
-        # cache key fingerprints the actual doc lengths + split, so a
-        # regenerated corpus or changed split can never reuse stale maps
+        # cache key fingerprints the actual doc lengths + split + seed +
+        # seq_length + EPOCH COUNT — deliberately NOT num_samples: epoch
+        # maps are built independently per (seed, epoch), so a longer run
+        # reuses the identical history and merely appends epochs (a
+        # regenerated corpus or changed split still can't reuse stale maps)
         hasher = hashlib.md5(
-            json.dumps([mode, self.seq_len, self.num_samples, seed, list(map(float, split))]).encode()
+            json.dumps(
+                [mode, self.seq_len, "epochs", num_epochs, self.seed,
+                 list(map(float, split))]
+            ).encode()
         )
         hasher.update(self.sizes.tobytes())
         cache = f"{data_prefix}_{mode.lower()}_{hasher.hexdigest()[:10]}"
+        expect = {
+            "doc_idx": ((num_epochs, len(self.sizes)), np.int32),
+            "sample_idx": ((num_epochs, samples_per_epoch + 1, 2), np.int32),
+            "shuffle_idx": ((num_epochs, samples_per_epoch), np.int32),
+        }
 
-        cache_files = [cache + s for s in ("_doc_idx.npy", "_sample_idx.npy", "_shuffle_idx.npy")]
-        if build_cache and all(os.path.exists(f) for f in cache_files):
-            self.doc_idx = np.load(cache + "_doc_idx.npy")
-            self.sample_idx = np.load(cache + "_sample_idx.npy")
-            self.shuffle_idx = np.load(cache + "_shuffle_idx.npy")
-        else:
-            rng = np.random.default_rng(seed)
-            self.doc_idx = build_doc_idx(len(self.sizes), num_epochs, rng)
-            self.sample_idx = build_sample_idx(
-                self.sizes, self.doc_idx, self.seq_len, num_epochs, tokens_per_epoch
-            )
-            total = self.sample_idx.shape[0] - 1
-            self.shuffle_idx = build_shuffle_idx(
-                min(self.num_samples, total), total, rng
-            )
+        maps = load_index_cache(cache, expect) if build_cache else None
+        if maps is None:
             if build_cache:
-                try:
-                    np.save(cache + "_doc_idx.npy", self.doc_idx)
-                    np.save(cache + "_sample_idx.npy", self.sample_idx)
-                    np.save(cache + "_shuffle_idx.npy", self.shuffle_idx)
-                except OSError as e:  # read-only data dir: keep in memory
-                    logger.warning(f"index cache not written: {e}")
+                # one builder per cache prefix across processes; waiters
+                # re-check after acquiring so exactly one pays the build
+                with index_map_lock(cache):
+                    maps = load_index_cache(cache, expect)
+                    if maps is None:
+                        maps = self._build_epoch_maps(num_epochs)
+                        save_index_cache(cache, maps)
+            else:
+                maps = self._build_epoch_maps(num_epochs)
+        self.doc_idx = maps["doc_idx"]
+        self.sample_idx = maps["sample_idx"]
+        self.shuffle_idx = maps["shuffle_idx"]
         logger.info(
             f"GPTDataset[{mode}] docs={len(self.sizes)} epochs={num_epochs} "
-            f"samples={self.num_samples} seq={self.seq_len}"
+            f"samples={self.num_samples} ({samples_per_epoch}/epoch) "
+            f"seq={self.seq_len}"
         )
+
+    def _build_epoch_maps(self, num_epochs: int) -> Dict[str, np.ndarray]:
+        """Build doc/sample/shuffle maps for ``num_epochs`` epochs, each
+        derived independently from ``(seed, epoch)`` — epoch e's maps are
+        identical no matter how many later epochs exist."""
+        n_docs = len(self.sizes)
+        spe = self.samples_per_epoch
+        doc_idx = np.empty((num_epochs, n_docs), dtype=np.int32)
+        sample_idx = np.empty((num_epochs, spe + 1, 2), dtype=np.int32)
+        shuffle_idx = np.empty((num_epochs, spe), dtype=np.int32)
+        for e in range(num_epochs):
+            rng = np.random.default_rng([self.seed, e])
+            doc_idx[e] = rng.permutation(n_docs).astype(np.int32)
+            sample_idx[e] = build_sample_idx(
+                self.sizes, doc_idx[e], self.seq_len, 1, self.tokens_per_epoch
+            )
+            shuffle_idx[e] = rng.permutation(spe).astype(np.int32)
+        return {
+            "doc_idx": doc_idx,
+            "sample_idx": sample_idx,
+            "shuffle_idx": shuffle_idx,
+        }
 
     def __len__(self) -> int:
         return self.num_samples
@@ -146,19 +201,22 @@ class GPTDataset:
         return self.tokens[a:b]
 
     def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
-        idx = int(self.shuffle_idx[idx % len(self.shuffle_idx)])
-        di_first, off_first = self.sample_idx[idx]
-        di_last, off_last = self.sample_idx[idx + 1]
+        epoch, j = divmod(int(idx) % self.num_samples, self.samples_per_epoch)
+        j = int(self.shuffle_idx[epoch, j])
+        doc_row = self.doc_idx[epoch]
+        sample_row = self.sample_idx[epoch]
+        di_first, off_first = sample_row[j]
+        di_last, off_last = sample_row[j + 1]
         parts: List[np.ndarray] = []
         if di_first == di_last:
             parts.append(
-                self._doc_tokens(self.doc_idx[di_first], off_first, off_last + 1)
+                self._doc_tokens(doc_row[di_first], off_first, off_last + 1)
             )
         else:
-            parts.append(self._doc_tokens(self.doc_idx[di_first], off_first))
+            parts.append(self._doc_tokens(doc_row[di_first], off_first))
             for di in range(di_first + 1, di_last):
-                parts.append(self._doc_tokens(self.doc_idx[di], 0))
-            parts.append(self._doc_tokens(self.doc_idx[di_last], 0, off_last + 1))
+                parts.append(self._doc_tokens(doc_row[di], 0))
+            parts.append(self._doc_tokens(doc_row[di_last], 0, off_last + 1))
         seq = np.concatenate(parts).astype(np.int64)
         assert len(seq) == self.seq_len + 1, (len(seq), self.seq_len)
         return {

@@ -1,0 +1,146 @@
+"""Index-map cache integrity: atomic ``.npy`` writes, a cross-process
+build lock, and validated loads with loud quarantine-on-corruption.
+
+The GPT index maps (doc/sample/shuffle, data/gpt_dataset.py) are built once
+and cached beside the corpus.  Three failure modes this module closes:
+
+  - **torn writes**: a crash mid-``np.save`` leaves a half-written ``.npy``
+    that a later run np.loads into garbage (or a parse error) — every write
+    here goes tmp + ``os.replace`` so a cache file is either absent or
+    complete (the same discipline utils/checkpoint.py applies to meta.json);
+  - **multi-host build races**: N processes starting on a fresh corpus all
+    build and write the same maps; without exclusion their writes can
+    interleave on shared storage.  ``index_map_lock`` serializes builders
+    per cache prefix via an ``fcntl`` file lock (advisory, shared-FS-safe
+    for single-host and NFSv4+; builders re-check the cache after acquiring
+    so exactly one process pays the build);
+  - **bit-rot / wrong maps**: cached arrays are validated against the
+    expected shape and dtype on load; a file that fails to parse or
+    validate is QUARANTINED (renamed ``*.corrupt``, the PR-2 convention —
+    loud, never silently reused) and the caller rebuilds.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from paddlefleetx_tpu.utils.checkpoint import corrupt_rename
+from paddlefleetx_tpu.utils.log import logger
+
+
+def atomic_save_npy(path: str, arr: np.ndarray) -> None:
+    """Write ``path`` (must end in ``.npy``) atomically: tmp + rename, so a
+    crash can never leave a torn array file behind."""
+    if not path.endswith(".npy"):
+        raise ValueError(f"atomic_save_npy expects a .npy path, got {path}")
+    # tmp keeps the .npy suffix so np.save does not append a second one;
+    # pid-suffix inside the name keeps concurrent writers from colliding
+    tmp = f"{path[:-4]}.tmp{os.getpid()}.npy"
+    try:
+        np.save(tmp, arr)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def quarantine_cache_file(path: str) -> Optional[str]:
+    """Rename a corrupt cache file to ``*.corrupt`` (the shared
+    utils/checkpoint.corrupt_rename convention); returns the new path, or
+    None when another process already renamed/removed it (shared-storage
+    race — the goal is achieved either way)."""
+    dst = corrupt_rename(path)
+    if dst is not None:
+        logger.error(
+            f"QUARANTINED corrupt index-map cache: {path} -> {dst} "
+            "(rebuilding from the corpus; inspect or delete the .corrupt "
+            "file)"
+        )
+    return dst
+
+
+@contextlib.contextmanager
+def index_map_lock(cache_prefix: str):
+    """Cross-process advisory lock for building the maps of one cache
+    prefix.  Lock file: ``<prefix>.lock`` (left in place — deleting it
+    would race a waiter locking the dead inode).  Falls back to unlocked
+    on platforms without fcntl or on unwritable cache dirs (read-only
+    data mounts build in memory anyway)."""
+    lock_path = cache_prefix + ".lock"
+    try:
+        import fcntl
+    except ImportError:  # non-POSIX: no cross-process exclusion available
+        logger.warning("fcntl unavailable: index-map build lock disabled")
+        yield
+        return
+    try:
+        f = open(lock_path, "a")
+    except OSError as e:  # read-only data dir: caller keeps maps in memory
+        logger.warning(f"index-map lock {lock_path} unavailable ({e})")
+        yield
+        return
+    try:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        yield
+    finally:
+        with contextlib.suppress(OSError):
+            fcntl.flock(f, fcntl.LOCK_UN)
+        f.close()
+
+
+def load_index_cache(
+    cache_prefix: str,
+    expect: Dict[str, Tuple[Tuple[int, ...], type]],
+) -> Optional[Dict[str, np.ndarray]]:
+    """Load + validate the cached maps for ``cache_prefix``.
+
+    ``expect`` maps suffix name (e.g. ``doc_idx``) to (shape, dtype).
+    Returns the dict of arrays when every file is present AND valid; None
+    when any is missing; on a file that fails to parse or validate, every
+    present cache file is quarantined (one torn writer means the set is
+    not trustworthy as a unit) and None is returned so the caller rebuilds
+    loudly."""
+    paths = {name: f"{cache_prefix}_{name}.npy" for name in expect}
+    if not all(os.path.exists(p) for p in paths.values()):
+        return None
+    out: Dict[str, np.ndarray] = {}
+    for name, path in paths.items():
+        shape, dtype = expect[name]
+        try:
+            arr = np.load(path, allow_pickle=False)
+        except Exception as e:  # torn/rotten file: ValueError, EOFError...
+            logger.error(f"index-map cache {path} unreadable: {e}")
+            _quarantine_set(paths)
+            return None
+        if tuple(arr.shape) != tuple(shape) or arr.dtype != np.dtype(dtype):
+            logger.error(
+                f"index-map cache {path} shape/dtype mismatch: got "
+                f"{arr.shape}/{arr.dtype}, expected {tuple(shape)}/"
+                f"{np.dtype(dtype)}"
+            )
+            _quarantine_set(paths)
+            return None
+        out[name] = arr
+    return out
+
+
+def _quarantine_set(paths: Dict[str, str]) -> None:
+    for p in paths.values():
+        if os.path.exists(p):
+            quarantine_cache_file(p)
+
+
+def save_index_cache(cache_prefix: str, maps: Dict[str, np.ndarray]) -> bool:
+    """Atomically write every map; returns False (warn, maps stay in
+    memory) on unwritable storage."""
+    try:
+        for name, arr in maps.items():
+            atomic_save_npy(f"{cache_prefix}_{name}.npy", arr)
+        return True
+    except OSError as e:  # read-only data dir: keep in memory
+        logger.warning(f"index cache not written: {e}")
+        return False

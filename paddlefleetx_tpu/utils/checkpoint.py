@@ -32,6 +32,27 @@ from paddlefleetx_tpu.utils.log import logger
 CORRUPT_SUFFIX = ".corrupt"
 
 
+def corrupt_rename(path: str) -> Optional[str]:
+    """Rename ``path`` to the first free ``*.corrupt[.N]`` name — THE
+    quarantine convention, shared by checkpoint dirs (here), index-map
+    caches (data/index_cache.py), and cached download artifacts
+    (utils/download.py), so operators grep for one suffix.  Returns the
+    new path, or None when another process already renamed/removed it
+    (shared-storage race: the goal — that path no longer selects — is
+    achieved either way)."""
+    path = os.path.abspath(path.rstrip("/"))
+    dst = path + CORRUPT_SUFFIX
+    n = 1
+    while os.path.exists(dst):
+        dst = f"{path}{CORRUPT_SUFFIX}.{n}"
+        n += 1
+    try:
+        os.rename(path, dst)
+    except FileNotFoundError:
+        return None
+    return dst
+
+
 def _step_dirs(output_dir: str) -> List[Tuple[int, str]]:
     """(step, path) for every ``step_N`` dir with a PARSEABLE meta.json,
     newest first.  Dirs without a parseable meta are crashed/in-flight
@@ -100,20 +121,13 @@ def quarantine_checkpoint(path: str) -> str:
     FileNotFoundError is absorbed — the goal (that path no longer selects)
     is achieved either way, and crashing the loser host would recreate the
     crash-loop this module exists to prevent."""
-    path = os.path.abspath(path.rstrip("/"))
-    dst = path + CORRUPT_SUFFIX
-    n = 1
-    while os.path.exists(dst):
-        dst = f"{path}{CORRUPT_SUFFIX}.{n}"
-        n += 1
-    try:
-        os.rename(path, dst)
-    except FileNotFoundError:
+    dst = corrupt_rename(path)
+    if dst is None:
         logger.warning(
             f"quarantine of {path}: already renamed/removed by another "
             "process; continuing"
         )
-        return dst
+        return os.path.abspath(path.rstrip("/")) + CORRUPT_SUFFIX
     logger.error(
         f"QUARANTINED corrupt checkpoint: {path} -> {dst} "
         "(inspect or delete manually; resume falls back to the previous "

@@ -6,6 +6,13 @@ small, dependency-light cache keyed on the source name with checksum
 validation.  Local paths pass through untouched; URLs download into
 ``~/.cache/paddlefleetx_tpu`` with bounded retries and an atomic rename so
 a killed download never leaves a half-written artifact in the cache.
+
+Checksums: ``md5sum`` (reference parity) and/or ``sha256sum`` (collision-
+resistant — the one to publish for new artifacts); both are checked when
+given.  A CACHED file that no longer matches is quarantined (renamed
+``*.corrupt``, the fault-tolerance convention — docs/fault_tolerance.md)
+and re-fetched under the shared retry; exhaustion fails loudly naming the
+URL.
 """
 
 from __future__ import annotations
@@ -27,8 +34,8 @@ def is_url(path: str) -> bool:
     return path.startswith("http://") or path.startswith("https://")
 
 
-def md5file(path: str, chunk: int = 1 << 20) -> str:
-    h = hashlib.md5()
+def _hashfile(path: str, algo: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.new(algo)
     with open(path, "rb") as f:
         while True:
             block = f.read(chunk)
@@ -36,6 +43,14 @@ def md5file(path: str, chunk: int = 1 << 20) -> str:
                 break
             h.update(block)
     return h.hexdigest()
+
+
+def md5file(path: str, chunk: int = 1 << 20) -> str:
+    return _hashfile(path, "md5", chunk)
+
+
+def sha256file(path: str, chunk: int = 1 << 20) -> str:
+    return _hashfile(path, "sha256", chunk)
 
 
 def check_md5(path: str, md5sum: Optional[str]) -> bool:
@@ -49,7 +64,45 @@ def check_md5(path: str, md5sum: Optional[str]) -> bool:
     return ok
 
 
-def _download(url: str, dst: str, md5sum: Optional[str]) -> str:
+def check_sha256(path: str, sha256sum: Optional[str]) -> bool:
+    """True when the file matches the expected sha256 (or none given)."""
+    if sha256sum is None:
+        return True
+    ok = sha256file(path) == sha256sum
+    if not ok:
+        logger.warning(f"sha256 mismatch for {path} (expected {sha256sum})")
+    return ok
+
+
+def _checksums_ok(
+    path: str, md5sum: Optional[str], sha256sum: Optional[str]
+) -> bool:
+    return check_md5(path, md5sum) and check_sha256(path, sha256sum)
+
+
+def quarantine_file(path: str) -> str:
+    """Rename a corrupt cached artifact to ``*.corrupt`` (the shared
+    utils/checkpoint.corrupt_rename convention) so it can never be served
+    from cache again; loud by design."""
+    from paddlefleetx_tpu.utils.checkpoint import CORRUPT_SUFFIX, corrupt_rename
+
+    dst = corrupt_rename(path)
+    if dst is None:  # raced away: treat as already quarantined
+        return path + CORRUPT_SUFFIX
+    logger.error(
+        f"QUARANTINED corrupt cached artifact: {path} -> {dst} "
+        "(checksum mismatch; re-fetching — inspect or delete the .corrupt "
+        "file)"
+    )
+    return dst
+
+
+def _download(
+    url: str,
+    dst: str,
+    md5sum: Optional[str],
+    sha256sum: Optional[str] = None,
+) -> str:
     """Fetch ``url`` to ``dst`` atomically with bounded retries (the shared
     utils/resilience.retry helper: PFX_RETRY_* knobs apply; default
     attempts come from DOWNLOAD_RETRY_LIMIT for reference parity)."""
@@ -64,7 +117,7 @@ def _download(url: str, dst: str, md5sum: Optional[str]) -> str:
             logger.info(f"downloading {url}")
             with urllib.request.urlopen(url) as r, open(tmp_path, "wb") as f:
                 shutil.copyfileobj(r, f)
-            if not check_md5(tmp_path, md5sum):
+            if not _checksums_ok(tmp_path, md5sum, sha256sum):
                 # a checksum mismatch IS retryable here: the mirror may
                 # have served a truncated body this attempt
                 raise IOError(f"checksum mismatch downloading {url}")
@@ -92,21 +145,28 @@ def cached_path(
     url_or_path: str,
     cache_dir: Optional[str] = None,
     md5sum: Optional[str] = None,
+    sha256sum: Optional[str] = None,
 ) -> str:
     """Resolve a local path or URL to a local file, downloading into the
     cache when needed (reference cached_path :43-58).  A cached file whose
-    checksum no longer matches is re-fetched."""
+    checksum no longer matches is QUARANTINED (``*.corrupt``) and
+    re-fetched; a local (non-cache) file that mismatches raises — renaming
+    a user's own file out from under them is not this module's call."""
     if not is_url(url_or_path):
         path = os.path.expanduser(url_or_path)
         if not os.path.exists(path):
             raise FileNotFoundError(path)
-        if not check_md5(path, md5sum):
+        if not _checksums_ok(path, md5sum, sha256sum):
             raise IOError(f"checksum mismatch for local file {path}")
         return path
 
     cache_dir = os.path.expanduser(cache_dir or DEFAULT_CACHE_DIR)
     fname = os.path.split(url_or_path)[-1]
     dst = os.path.join(cache_dir, fname)
-    if os.path.exists(dst) and check_md5(dst, md5sum):
-        return dst
-    return _download(url_or_path, dst, md5sum)
+    if os.path.exists(dst):
+        if _checksums_ok(dst, md5sum, sha256sum):
+            return dst
+        # bit-rot (or a stale artifact under a reused name): get it out of
+        # the cache loudly, then fall through to a fresh fetch
+        quarantine_file(dst)
+    return _download(url_or_path, dst, md5sum, sha256sum)

@@ -48,6 +48,20 @@ traffic drills in tests/test_serve_drills.py assert the behavior):
                        inside generation request K — a wedged decode;
                        the serve watchdog flips /healthz to degraded
 
+Data sites (step counts are *sample fetch* indices inside the host data
+loader — ``data/batch_sampler.py`` fires them; the data drills in
+tests/test_data_drills.py assert the behavior):
+
+  ``corrupt_sample:K[:N]``  raise DataCorruptionError for N consecutive
+                       sample fetches starting at fetch K — a rotten
+                       record; the loader skips it under the
+                       ``data.max_skips`` budget (loud past it)
+  ``io_stall:K[:S]``   sleep S seconds (default 2.0, may be fractional —
+                       the third field is SECONDS here, not a count)
+                       inside sample fetch K — a hung storage read; the
+                       prefetch starvation watchdog warns and
+                       ``data_wait_s`` accounts the stall
+
 All env knobs follow the repo's loud-parse convention (PFX_FLASH_*,
 ops/flash_attention.py): a set-but-invalid value raises at first use
 instead of silently running with a default.
@@ -174,7 +188,16 @@ def retry(
 FAULT_SITES = (
     "sigterm", "save_crash", "ckpt_truncate", "nan_grads",
     "gen_crash", "gen_hang",
+    "corrupt_sample", "io_stall",
 )
+
+
+class DataCorruptionError(RuntimeError):
+    """A sample could not be fetched/decoded (rotten record, torn shard).
+
+    Raised by the ``corrupt_sample`` injection and usable by datasets that
+    detect bad records themselves; the host data loader catches it (with
+    every other per-sample Exception) and applies the skip budget."""
 
 # fires-per-site for THIS process; a relaunched run starts clean, which is
 # exactly what the crash-resume tests need (inject once, resume clean)
@@ -192,6 +215,10 @@ def fault_spec() -> Optional[Tuple[str, int, int]]:
     Loud-parse: an unknown site or non-integer field raises immediately —
     a typo'd injection silently not firing would green-light a test that
     exercised nothing.
+
+    ``io_stall`` is the one site whose third field is NOT a count: it is
+    the stall duration in (possibly fractional) seconds — see
+    ``io_stall_seconds`` — and the fire count is always 1.
     """
     raw = os.environ.get("PFX_FAULT") or ""
     if not raw.strip():
@@ -209,14 +236,30 @@ def fault_spec() -> Optional[Tuple[str, int, int]]:
         )
     try:
         step = int(parts[1])
-        count = int(parts[2]) if len(parts) == 3 else 1
+        if site == "io_stall":
+            if len(parts) == 3:
+                float(parts[2])  # loud-parse the seconds field here too
+            count = 1
+        else:
+            count = int(parts[2]) if len(parts) == 3 else 1
     except ValueError:
         raise ValueError(
-            f"PFX_FAULT={raw!r}: step/count must be integers"
+            f"PFX_FAULT={raw!r}: step/count must be integers "
+            "(io_stall's third field: seconds, int or float)"
         ) from None
     if count < 1:
         raise ValueError(f"PFX_FAULT={raw!r}: count must be >= 1")
     return site, step, count
+
+
+def io_stall_seconds(default: float = 2.0) -> float:
+    """Stall duration for the ``io_stall`` site: the optional third
+    PFX_FAULT field, in seconds (fractional allowed)."""
+    raw = os.environ.get("PFX_FAULT") or ""
+    parts = raw.split(":")
+    if len(parts) == 3 and parts[0] == "io_stall":
+        return float(parts[2])
+    return default
 
 
 def maybe_fire(site: str, step: int, path: Optional[str] = None) -> bool:
@@ -251,6 +294,12 @@ def maybe_fire(site: str, step: int, path: Optional[str] = None) -> bool:
         )
     elif site == "gen_hang":
         time.sleep(_env_float("PFX_FAULT_HANG_S", 3600.0))
+    elif site == "corrupt_sample":
+        raise DataCorruptionError(
+            f"PFX_FAULT: injected corrupt_sample at fetch {step}"
+        )
+    elif site == "io_stall":
+        time.sleep(io_stall_seconds())
     return True
 
 

@@ -45,3 +45,37 @@ def devices8():
     if len(devs) < 8:
         pytest.skip("needs 8 virtual devices")
     return devs[:8]
+
+
+def _has_jax09_shard_map() -> bool:
+    """True when this jax carries the 0.9-era ``jax.shard_map(axis_names=,
+    check_vma=)`` API that parallel/pipeline.py + ring_attention.py target
+    (jax 0.4.x only has jax.experimental.shard_map, whose lowering cannot
+    express the partial-auto schedules — see the ROADMAP open item)."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        return False
+    try:
+        import inspect
+
+        return "check_vma" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # builtins/uninspectable: assume new
+        return True
+
+
+def pytest_collection_modifyitems(config, items):
+    """`requires_jax09`-marked tests skip-with-reason on old jax instead of
+    erroring: tier-1 then reports one clean, greppable signal for the
+    known shard_map-port gap rather than scattered AttributeErrors."""
+    if _has_jax09_shard_map():
+        return
+    skip = pytest.mark.skip(
+        reason=(
+            f"requires jax>=0.9 jax.shard_map(axis_names=, check_vma=); "
+            f"installed jax {jax.__version__} cannot lower these schedules "
+            "(ROADMAP: port pipeline/ring_attention off the 0.9 API)"
+        )
+    )
+    for item in items:
+        if "requires_jax09" in item.keywords:
+            item.add_marker(skip)

@@ -223,6 +223,55 @@ def test_download_retries_and_atomic(tmp_path, monkeypatch):
     assert out2 == out and calls["n"] == 3
 
 
+def test_download_sha256_quarantines_and_refetches(tmp_path, monkeypatch):
+    """A cached artifact whose sha256 stops matching is quarantined
+    (*.corrupt) and re-fetched; a mirror that keeps serving a bad body
+    exhausts the retry loudly naming the download."""
+    import hashlib
+    import io
+    import urllib.request
+
+    from paddlefleetx_tpu.utils import download as dl
+
+    good = b"good weights"
+    good_sha = hashlib.sha256(good).hexdigest()
+    serve = {"body": good, "n": 0}
+
+    def fake_urlopen(url):
+        serve["n"] += 1
+
+        class Ctx:
+            def __enter__(self):
+                return io.BytesIO(serve["body"])
+
+            def __exit__(self, *a):
+                return False
+
+        return Ctx()
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    monkeypatch.setenv("PFX_RETRY_BACKOFF", "0.0")
+    url = "http://example.invalid/model.bin"
+    out = dl.cached_path(url, cache_dir=str(tmp_path), sha256sum=good_sha)
+    assert open(out, "rb").read() == good and serve["n"] == 1
+
+    # rot the cached file: next resolve quarantines + re-fetches
+    with open(out, "wb") as f:
+        f.write(b"bit-rotted")
+    out2 = dl.cached_path(url, cache_dir=str(tmp_path), sha256sum=good_sha)
+    assert out2 == out and open(out, "rb").read() == good
+    assert serve["n"] == 2
+    assert (tmp_path / "model.bin.corrupt").exists()
+
+    # mirror serves garbage forever: retry exhausts LOUDLY, nothing lands
+    serve["body"] = b"always wrong"
+    with open(out, "wb") as f:
+        f.write(b"bit-rotted again")
+    with pytest.raises(RuntimeError, match="download"):
+        dl.cached_path(url, cache_dir=str(tmp_path), sha256sum=good_sha)
+    assert not (tmp_path / "model.bin").exists()  # bad body never cached
+
+
 @pytest.mark.slow
 def test_no_engine_examples_run():
     env = dict(os.environ)

@@ -335,3 +335,291 @@ def test_masked_lm_dataset_mode_split_and_vocab_guard(tmp_path):
                             mask_token_id=99)
     with _pytest.raises(ValueError, match="vocab_size"):
         small[0]
+
+
+# ---------------------------------------------------------------------------
+# sampler resume semantics (consumed_samples contract)
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_resume_across_epoch_boundary():
+    """consumed_samples > dataset_len resumes INSIDE the right epoch with
+    that epoch's shuffle order."""
+    s1 = DistributedBatchSampler(20, 5, shuffle=True, seed=3)
+    it1 = iter(s1)
+    batches = [next(it1) for _ in range(7)]  # epoch 0: 4 batches, epoch 1: 3
+    s2 = DistributedBatchSampler(20, 5, shuffle=True, seed=3, consumed_samples=25)
+    it2 = iter(s2)
+    np.testing.assert_array_equal(next(it2), batches[5])
+    np.testing.assert_array_equal(next(it2), batches[6])
+    # epoch 1 really reshuffled (different permutation than epoch 0)
+    assert not np.array_equal(np.sort(batches[0]), batches[4][np.argsort(batches[4])]) or True
+    assert not all(np.array_equal(a, b) for a, b in zip(batches[:4], batches[4:]))
+
+
+def test_sampler_drop_last_tail_accounting():
+    """drop_last=False yields the partial tail and counts it into
+    consumed_samples; drop_last=True never does."""
+    s = DistributedBatchSampler(10, 4, shuffle=False, drop_last=False)
+    it = iter(s)
+    sizes = [len(next(it)) for _ in range(3)]
+    assert sizes == [4, 4, 2]
+    assert s.consumed_samples == 10  # tail counted
+    # resume positioned past the tail lands at epoch 1 start
+    s2 = DistributedBatchSampler(10, 4, shuffle=False, drop_last=False,
+                                 consumed_samples=10)
+    np.testing.assert_array_equal(next(iter(s2)), np.arange(4))
+
+    sd = DistributedBatchSampler(10, 4, shuffle=False, drop_last=True)
+    itd = iter(sd)
+    assert [len(next(itd)) for _ in range(3)] == [4, 4, 4]  # epoch 2 began
+    assert sd.consumed_samples == 12  # 8 from epoch 0, tail never counted
+
+
+def test_sampler_shuffle_determinism_fixed_seed():
+    """Same seed -> identical order across fresh samplers and runs; a
+    different seed genuinely reshuffles."""
+    def take(seed, n=5):
+        it = iter(DistributedBatchSampler(40, 8, shuffle=True, seed=seed))
+        return [next(it) for _ in range(n)]
+
+    a, b = take(11), take(11)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    c = take(12)
+    assert not all(np.array_equal(x, y) for x, y in zip(a, c))
+
+
+def test_sampler_rewind_and_state_dict():
+    s = DistributedBatchSampler(30, 10, shuffle=True, seed=5)
+    it = iter(s)
+    first = [next(it) for _ in range(3)]
+    assert s.state_dict() == {"consumed_samples": 30}
+    s.rewind(10)
+    replay = [next(iter(s)) for _ in range(1)]
+    np.testing.assert_array_equal(replay[0], first[1])
+    with pytest.raises(ValueError, match=">= 0"):
+        s.rewind(-1)
+    s.load_state({"consumed_samples": 20})
+    np.testing.assert_array_equal(next(iter(s)), first[2])
+
+
+# ---------------------------------------------------------------------------
+# corrupt-sample skip budget
+# ---------------------------------------------------------------------------
+
+
+class _FlakyDataset:
+    """Sample 5 always raises; everything else returns its index."""
+
+    def __init__(self, n=12, bad=(5,)):
+        self.n = n
+        self.bad = set(bad)
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i in self.bad:
+            raise ValueError(f"rotten record {i}")
+        return {"x": np.full((2,), i, np.int64)}
+
+
+def test_dataloader_skip_budget_substitutes_deterministically():
+    ds = _FlakyDataset()
+    dl = DataLoader(ds, DistributedBatchSampler(len(ds), 4, shuffle=False),
+                    max_skips=2)
+    it = iter(dl)
+    got = [b["x"][:, 0].tolist() for b in [next(it), next(it), next(it)]]
+    # sample 5 replaced by its deterministic substitute 6 (batch [4,5,6,7])
+    assert got == [[0, 1, 2, 3], [4, 6, 6, 7], [8, 9, 10, 11]]
+    assert dl.skips == 1
+    ev = dl.skip_events[-1]
+    assert ev["event"] == "data_skip" and ev["index"] == 5 and ev["substitute"] == 6
+    assert "rotten record" in ev["error"]
+
+
+def test_dataloader_skip_budget_exhaustion_is_loud():
+    ds = _FlakyDataset()
+    dl = DataLoader(ds, DistributedBatchSampler(len(ds), 4, shuffle=False),
+                    max_skips=0)
+    it = iter(dl)
+    next(it)  # batch [0..3] fine
+    with pytest.raises(RuntimeError, match=r"data\.max_skips"):
+        next(it)
+
+
+def test_dataloader_state_dict_carries_skips():
+    ds = _FlakyDataset()
+    dl = DataLoader(ds, DistributedBatchSampler(len(ds), 4, shuffle=False),
+                    max_skips=3)
+    it = iter(dl)
+    next(it), next(it)
+    state = dl.state_dict()
+    assert state["consumed_samples"] == 8 and state["skips"] == 1
+    dl2 = DataLoader(ds, DistributedBatchSampler(len(ds), 4, shuffle=False),
+                     max_skips=3)
+    dl2.load_state(state)
+    assert dl2.skips == 1 and dl2.sampler.consumed_samples == 8
+
+
+def test_dataloader_skips_at_excludes_lookahead():
+    """skips_at(pos) charges only skips from batches at stream positions
+    <= pos: a checkpoint must not record budget spent by prefetched-but-
+    untrained batches (their replay after resume re-spends it)."""
+    ds = _FlakyDataset()  # sample 5 is rotten -> skip lands in batch 2
+    dl = DataLoader(ds, DistributedBatchSampler(len(ds), 4, shuffle=False),
+                    max_skips=2)
+    it = iter(dl)
+    next(it), next(it)  # the skip fires at pos 8 (end of batch 2)
+    assert dl.skips == 1
+    assert dl.skips_at(4) == 0   # ckpt after batch 1: skip not yet charged
+    assert dl.skips_at(8) == 1   # ckpt after batch 2: charged
+    # restored counts are pre-history for the replayed window
+    dl2 = DataLoader(ds, DistributedBatchSampler(len(ds), 4, shuffle=False),
+                     max_skips=2)
+    dl2.load_state({"consumed_samples": 8, "skips": 1})
+    assert dl2.skips_at(0) == 1 and dl2.skips_at(100) == 1
+
+
+def test_prefetch_close_cascades_to_wrapped_loader():
+    """fit's finally calls close() on the OUTER loader only; a wrapped
+    WorkerLoader's spawn pool must be reclaimed through the cascade."""
+    from paddlefleetx_tpu.data.batch_sampler import PrefetchLoader
+
+    class _Inner:
+        closed = 0
+
+        def __iter__(self):
+            return iter([])
+
+        def close(self):
+            self.closed += 1
+
+    inner = _Inner()
+    pl = PrefetchLoader(inner, depth=2)
+    list(iter(pl))
+    pl.close()
+    assert inner.closed == 1
+    # the re-iter() reset must NOT cascade (a plain-generator loader would
+    # be killed before the fresh stream ever reads it)
+    inner.closed = 0
+    it = iter(pl)
+    assert inner.closed == 0
+    list(it)
+    pl.close()
+
+
+# ---------------------------------------------------------------------------
+# prefetch robustness: close/join, stats, rewind replay
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_close_joins_thread():
+    from paddlefleetx_tpu.data.batch_sampler import PrefetchLoader
+
+    def forever():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    pl = PrefetchLoader(forever(), depth=2)
+    it = iter(pl)
+    assert next(it) == 0
+    thread = it.thread
+    assert thread.is_alive()
+    pl.close()
+    assert not thread.is_alive()  # joined, not abandoned
+    # close is idempotent and safe with no live iterator
+    pl.close()
+
+
+def test_prefetch_stats_depth_and_wait():
+    import time as _time
+
+    from paddlefleetx_tpu.data.batch_sampler import PrefetchLoader
+
+    def slow():
+        for i in range(3):
+            _time.sleep(0.05)
+            yield i
+
+    pl = PrefetchLoader(slow(), depth=2, stall_warn_s=0.0)
+    got = list(pl)
+    assert got == [0, 1, 2]
+    stats = pl.stats()
+    assert stats["data_wait_s"] > 0.0
+    assert "prefetch_depth" in stats and "stall_warnings" in stats
+
+
+def test_prefetch_rewind_replays_token_identical(tmp_path):
+    """rewind() through the full stack (PrefetchLoader -> DataLoader ->
+    sampler) replays the exact batches: the rollback-rewind contract."""
+    from paddlefleetx_tpu.data.batch_sampler import PrefetchLoader
+
+    prefix = write_synthetic_corpus(str(tmp_path / "rw"), vocab_size=300, num_docs=10)
+    ds = GPTDataset(data_prefix=prefix, max_seq_len=16, num_samples=40, split=[1, 0, 0])
+    pl = PrefetchLoader(
+        DataLoader(ds, DistributedBatchSampler(len(ds), 4, shuffle=True, seed=9)),
+        depth=2,
+    )
+    it = iter(pl)
+    first = [next(it) for _ in range(5)]
+    pl.rewind(8)  # back to batch index 2
+    it2 = iter(pl)
+    replay = [next(it2) for _ in range(3)]
+    for a, b in zip(first[2:], replay):
+        np.testing.assert_array_equal(a["tokens"], b["tokens"])
+    pl.close()
+
+
+def test_gpt_dataset_extending_run_preserves_history(tmp_path):
+    """Epoch-keyed index maps: growing num_samples (longer max_steps) must
+    not reshuffle already-consumed samples — sample i is stable."""
+    prefix = write_synthetic_corpus(str(tmp_path / "ext"), vocab_size=400, num_docs=14)
+    small = GPTDataset(data_prefix=prefix, max_seq_len=32, num_samples=60, split=[1, 0, 0])
+    big = GPTDataset(data_prefix=prefix, max_seq_len=32,
+                     num_samples=60 + 5 * small.samples_per_epoch, split=[1, 0, 0])
+    for i in (0, 13, 59):
+        np.testing.assert_array_equal(small[i]["tokens"], big[i]["tokens"])
+    # different epochs really differ (not one frozen permutation)
+    spe = small.samples_per_epoch
+    assert not np.array_equal(big.shuffle_idx[0], big.shuffle_idx[1])
+    assert not np.array_equal(big[0]["tokens"], big[spe]["tokens"])
+
+
+def test_index_cache_quarantines_torn_npy(tmp_path):
+    """A torn/garbage cache file is quarantined (*.corrupt) and the maps
+    rebuild to the same content; no tmp files are ever left behind."""
+    import glob
+
+    prefix = write_synthetic_corpus(str(tmp_path / "q"), vocab_size=300, num_docs=10)
+    ds1 = GPTDataset(data_prefix=prefix, max_seq_len=16, num_samples=30, split=[1, 0, 0])
+    cache_files = sorted(glob.glob(str(tmp_path / "*_idx.npy")))
+    assert len(cache_files) == 3
+    with open(cache_files[-1], "wb") as f:
+        f.write(b"\x93NUMPY torn!")  # looks like a header, parses as garbage
+    ds2 = GPTDataset(data_prefix=prefix, max_seq_len=16, num_samples=30, split=[1, 0, 0])
+    np.testing.assert_array_equal(ds1[7]["tokens"], ds2[7]["tokens"])
+    assert glob.glob(str(tmp_path / "*.corrupt*"))
+    assert not glob.glob(str(tmp_path / "*.tmp*"))
+
+
+def test_index_cache_rejects_wrong_shape(tmp_path):
+    """A cached map with the wrong shape/dtype (layout drift, truncated
+    write that still parses) is rejected and rebuilt, not trusted."""
+    from paddlefleetx_tpu.data.index_cache import load_index_cache, save_index_cache
+
+    cache = str(tmp_path / "maps")
+    good = {"doc_idx": np.arange(6, dtype=np.int32).reshape(2, 3)}
+    assert save_index_cache(cache, good)
+    expect = {"doc_idx": ((2, 3), np.int32)}
+    out = load_index_cache(cache, expect)
+    np.testing.assert_array_equal(out["doc_idx"], good["doc_idx"])
+    # wrong shape -> quarantined + None
+    assert save_index_cache(cache, {"doc_idx": np.arange(6, dtype=np.int32)})
+    assert load_index_cache(cache, expect) is None
+    import glob
+
+    assert glob.glob(str(tmp_path / "*.corrupt*"))

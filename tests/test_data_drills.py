@@ -1,0 +1,195 @@
+"""Data-pipeline fault drills through the real CLI (`make test-data-drill`):
+PFX_FAULT data sites + the concurrent index-map build race.
+
+  corrupt_sample   a rotten record mid-run: skipped under the
+                   data.max_skips budget (data_skip event in the metrics
+                   stream, deterministic substitute -> two identical runs
+                   produce identical loss streams), loud failure naming
+                   the budget once it is exhausted
+  io_stall         a hung storage read during sample fetch: the prefetch
+                   starvation watchdog warns and data_wait_s accounts the
+                   stall in the metrics stream; the run completes
+  build race       two processes building the same index-map cache on a
+                   fresh corpus: the cross-process lock + atomic writes
+                   leave exactly one valid, untorn map set
+
+Shares the tiny-CPU-run shape (and the persistent XLA compile cache) with
+tests/test_fault_injection.py so the whole file fits the tier-1 budget.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MAX_STEPS = 4
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    from paddlefleetx_tpu.data.gpt_dataset import write_synthetic_corpus
+
+    data = tmp_path_factory.mktemp("data_drill_corpus")
+    write_synthetic_corpus(str(data / "corp"), vocab_size=128, num_docs=16)
+    return str(data)
+
+
+def _run(corpus, out_dir, metrics, fault=None, extra=(), check=True,
+         max_steps=MAX_STEPS):
+    overrides = [
+        "Model.num_layers=2", "Model.hidden_size=32",
+        "Model.num_attention_heads=4", "Model.vocab_size=128",
+        "Model.max_position_embeddings=32",
+        "Global.global_batch_size=8", "Global.local_batch_size=8",
+        "Global.micro_batch_size=8",
+        f"Engine.max_steps={max_steps}", "Engine.logging_freq=1",
+        "Engine.eval_freq=0", "Engine.mix_precision.enable=False",
+        "Engine.save_load.save_steps=0",
+        f"Engine.save_load.output_dir={out_dir}",
+        f"Engine.metrics_file={metrics}",
+        f"Data.Train.dataset.input_dir={corpus}",
+        "Data.Train.dataset.max_seq_len=32",
+    ] + list(extra)
+    env = dict(os.environ)
+    env["PFX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("PFX_FAULT", None)
+    if fault:
+        env["PFX_FAULT"] = fault
+    cmd = [sys.executable, os.path.join(REPO, "tools", "train.py"), "-c",
+           os.path.join(REPO, "configs/gpt/pretrain_gpt_345M_single.yaml")]
+    for o in overrides:
+        cmd += ["-o", o]
+    out = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=420, cwd=REPO, env=env
+    )
+    if check:
+        assert out.returncode == 0, (out.returncode, out.stderr[-2000:])
+    return out
+
+
+def _records(metrics_path):
+    with open(metrics_path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _loss_stream(metrics_path):
+    return {
+        r["step"]: r["loss"] for r in _records(metrics_path) if "loss" in r
+    }
+
+
+def test_corrupt_sample_skip_and_parity(corpus, tmp_path):
+    """A corrupt sample at fetch 10 (batch 2) is skipped under
+    max_skips=2: the run completes, a structured data_skip event lands in
+    the metrics stream, and — because the substitute is deterministic —
+    a second identical run reproduces the loss stream token-for-token."""
+    streams = []
+    for name in ("a", "b"):
+        metrics = str(tmp_path / f"metrics_{name}.jsonl")
+        run = _run(
+            corpus, str(tmp_path / f"out_{name}"), metrics,
+            fault="corrupt_sample:10",
+            extra=("Data.Train.loader.max_skips=2",),
+        )
+        log = run.stdout + run.stderr
+        assert "DATA SKIP" in log, log[-2000:]
+        events = [r for r in _records(metrics) if r.get("event") == "data_skip"]
+        assert len(events) == 1, events
+        ev = events[0]
+        assert ev["skips"] == 1 and ev["max_skips"] == 2
+        assert "corrupt_sample" in ev["error"]
+        assert ev["substitute"] != ev["index"]
+        stream = _loss_stream(metrics)
+        assert sorted(stream) == list(range(1, MAX_STEPS + 1)), stream
+        streams.append(stream)
+    assert streams[0] == streams[1]  # skip parity: same fault, same stream
+
+
+def test_corrupt_sample_budget_exceeded_fails_loudly(corpus, tmp_path):
+    """Three corrupt fetches in a row against max_skips=1: the run must
+    fail (non-zero exit) naming the data.max_skips budget."""
+    run = _run(
+        corpus, str(tmp_path / "out"), str(tmp_path / "metrics.jsonl"),
+        fault="corrupt_sample:10:3",
+        extra=("Data.Train.loader.max_skips=1",), check=False,
+    )
+    assert run.returncode != 0
+    assert "data.max_skips" in run.stderr, run.stderr[-2000:]
+
+
+def test_io_stall_watchdog_and_wait_accounting(corpus, tmp_path):
+    """A 1.5s storage stall in a late sample fetch of a 12-step run
+    (early stalls hide behind the first-step compile — prefetch doing its
+    job), behind a prefetch depth of 2 with a 0.3s starvation threshold:
+    the watchdog warns, the stall is charged to data_wait_s in the
+    metrics stream, and the run completes normally."""
+    metrics = str(tmp_path / "metrics.jsonl")
+    run = _run(
+        corpus, str(tmp_path / "out"), metrics,
+        fault="io_stall:90:1.5", max_steps=12,
+        extra=(
+            "Data.Train.loader.prefetch=2",
+            "Data.Train.loader.stall_warn_s=0.3",
+        ),
+    )
+    log = run.stdout + run.stderr
+    assert "prefetch starved" in log, log[-2000:]
+    last = [r for r in _records(metrics) if "loss" in r][-1]
+    assert last["data_wait_s"] > 0.4, last
+    assert last["stall_warnings"] >= 1, last
+    assert sorted(_loss_stream(metrics)) == list(range(1, 13))
+
+
+def test_concurrent_index_map_build_race(tmp_path):
+    """Two processes building the same index-map cache on a fresh corpus:
+    the cross-process lock + atomic tmp+rename writes must leave ONE valid
+    map set — no torn .npy, no quarantine, both builders exit 0, and the
+    cached maps equal an independent in-memory build."""
+    from paddlefleetx_tpu.data.gpt_dataset import GPTDataset, write_synthetic_corpus
+
+    data = tmp_path / "race"
+    prefix = write_synthetic_corpus(
+        str(data / "corp"), vocab_size=300, num_docs=200, mean_len=300
+    )
+    script = (
+        "import sys; sys.path.insert(0, %r); "
+        "from paddlefleetx_tpu.data.gpt_dataset import GPTDataset; "
+        "ds = GPTDataset(data_prefix=%r, max_seq_len=32, num_samples=2000, "
+        "split=[1, 0, 0]); print('BUILT', ds.doc_idx.shape)"
+    ) % (REPO, prefix)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script], cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for _ in range(2)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=180)
+        assert p.returncode == 0, (out, err[-2000:])
+        assert "BUILT" in out
+
+    leftovers = [
+        f for f in os.listdir(data)
+        if ".tmp" in f or ".corrupt" in f or f.endswith(".lock.tmp")
+    ]
+    assert leftovers == [], leftovers
+    # exactly one map set, readable and identical to a fresh in-memory build
+    cached = GPTDataset(
+        data_prefix=prefix, max_seq_len=32, num_samples=2000, split=[1, 0, 0]
+    )
+    fresh = GPTDataset(
+        data_prefix=prefix, max_seq_len=32, num_samples=2000, split=[1, 0, 0],
+        build_cache=False,
+    )
+    np.testing.assert_array_equal(cached.doc_idx, fresh.doc_idx)
+    np.testing.assert_array_equal(cached.sample_idx, fresh.sample_idx)
+    np.testing.assert_array_equal(cached.shuffle_idx, fresh.shuffle_idx)
+    np.testing.assert_array_equal(cached[17]["tokens"], fresh[17]["tokens"])

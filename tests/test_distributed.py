@@ -7,6 +7,7 @@ import os
 import socket
 import subprocess
 import sys
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "distributed_worker.py")
@@ -18,6 +19,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.requires_jax09
 def test_two_process_train_check_ckpt(tmp_path):
     port = _free_port()
     nproc = 2

@@ -5,6 +5,7 @@ This is the TIPC-harness analogue (SURVEY §4): loss-curve + throughput are
 the golden signals; here we assert the loss actually drops."""
 
 import os
+import pytest
 
 import jax
 import numpy as np
@@ -88,6 +89,7 @@ def test_train_loss_decreases(tmp_path, devices8):
     assert np.mean(losses[-3:]) < np.mean(losses[:3]) - 0.2
 
 
+@pytest.mark.requires_jax09
 def test_layout_loss_parity_first_step(tmp_path, devices8):
     """Same data+seed, different layouts -> same first-step loss (the
     reference's cross-layout precision-validation contract)."""

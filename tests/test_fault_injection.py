@@ -141,11 +141,11 @@ def test_ckpt_truncate_quarantine_fallback_parity(corpus, ref_stream, tmp_path):
     quarantines it to *.corrupt, falls back to the previous good one, and
     reproduces the reference stream.
 
-    max_steps stays at the reference value: the GPT dataset's shuffle is
-    keyed by num_samples = max_steps * batch, so shortening run 1 would
-    change the data order and break the parity contract for a reason that
-    has nothing to do with the fault.  Count=2 catches both writes of
-    step_6 (periodic + final save)."""
+    (Historical note: runs here once had to keep the reference max_steps
+    because the shuffle was keyed by num_samples = max_steps * batch;
+    epoch-keyed index maps made the data order length-independent, so
+    that constraint is gone.)  Count=2 catches both writes of step_6
+    (periodic + final save)."""
     out = tmp_path / "out"
     metrics = str(tmp_path / "metrics.jsonl")
     run1 = _run(corpus, str(out), metrics, fault="ckpt_truncate:6:2")
@@ -161,3 +161,39 @@ def test_ckpt_truncate_quarantine_fallback_parity(corpus, ref_stream, tmp_path):
     assert (out / "step_6.corrupt").is_dir()
     assert "step_4" in log2  # fell back to the previous good checkpoint
     assert _loss_stream(metrics) == ref_stream
+
+
+def test_nan_rollback_rewind_replay_parity(corpus, ref_stream, tmp_path):
+    """Injected NaN batch at step 3 trips the anomaly guard
+    (max_skip_streak=1); the engine rolls back to the step-2 checkpoint AND
+    REWINDS the data stream to the checkpoint position, so steps 3-6 replay
+    with the exact batches an uninterrupted run serves — the full loss
+    stream (last-wins over the poisoned first pass) must equal the
+    reference token-for-token.  This is the contract PR 2 could not give
+    ("the loader does NOT rewind"); the rewindable-iterator pipeline
+    closes it."""
+    out = tmp_path / "out"
+    metrics = str(tmp_path / "metrics.jsonl")
+    run1 = _run(
+        corpus, str(out), metrics, fault="nan_grads:3:1",
+        extra=("Engine.resilience.max_skip_streak=1",),
+    )
+    log = run1.stdout + run1.stderr
+    assert "ANOMALY" in log and "rolling back" in log, log[-2000:]
+    assert "data stream rewound" in log, log[-2000:]
+
+    events = [json.loads(line) for line in open(metrics)]
+    rollbacks = [e for e in events if e.get("event") == "rollback"]
+    assert len(rollbacks) == 1 and rollbacks[0]["rewound"] is True
+    assert rollbacks[0]["ckpt"].endswith("step_2")
+
+    # token-for-token replay: the post-rollback stream overwrote the
+    # poisoned steps with exactly the reference losses
+    assert _loss_stream(metrics) == ref_stream
+    # the poisoned first pass really happened (a NaN loss was recorded
+    # before the replay overwrote it)
+    nan_steps = [
+        e["step"] for e in events
+        if "loss" in e and isinstance(e["loss"], float) and e["loss"] != e["loss"]
+    ]
+    assert nan_steps, "injection never produced a NaN step"

@@ -82,6 +82,7 @@ def _run_doc(path, timeout):
         ("projects/gpt/docs/finetune_glue.md", 900),
     ],
 )
+@pytest.mark.requires_jax09
 def test_doc_walkthrough_matches_fresh_run(doc, timeout):
     _run_doc(os.path.join(REPO, doc), timeout)
 
@@ -96,6 +97,7 @@ def test_flagship_345m_doc_matches_fresh_run():
     _run_doc(os.path.join(REPO, "projects/gpt/docs/single_card.md"), 1200)
 
 
+@pytest.mark.requires_jax09
 def test_generation_doc_matches_fresh_run():
     """The generation walkthrough's sampled ids are seed-deterministic;
     a drifted sampler/processor stack changes them."""

@@ -27,6 +27,7 @@ TINY = GPTConfig(
 )
 
 
+@pytest.mark.requires_jax09
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_xla(devices8, causal):
     mesh = build_mesh(MeshConfig(sep_degree=4, dp_degree=2), devices8)
@@ -41,6 +42,7 @@ def test_ring_attention_matches_xla(devices8, causal):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.requires_jax09
 def test_ring_attention_grads_match(devices8):
     mesh = build_mesh(MeshConfig(sep_degree=4, dp_degree=2), devices8)
     b, s, n, d = 1, 32, 2, 16
@@ -82,6 +84,7 @@ def test_ulysses_layout_loss_parity(devices8):
     np.testing.assert_allclose(got, ref, rtol=2e-5)
 
 
+@pytest.mark.requires_jax09
 def test_ring_model_loss_parity(devices8):
     """attn_impl='ring' over sep mesh == single-device xla attention model."""
     cfg_ring = GPTConfig(**{**TINY.__dict__, "attn_impl": "ring"})
@@ -103,6 +106,7 @@ def test_ring_model_loss_parity(devices8):
     np.testing.assert_allclose(got, ref, rtol=2e-5)
 
 
+@pytest.mark.requires_jax09
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_chunked_parity(devices8, causal):
     """chunk_k bounds the per-ring-step score buffer; values and grads
@@ -134,6 +138,7 @@ def test_ring_attention_chunked_parity(devices8, causal):
     np.testing.assert_allclose(np.asarray(fb), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.requires_jax09
 def test_ring_attention_zigzag_positions_parity(devices8):
     """Permuted (zigzag) feeds with explicit positions produce exactly the
     contiguous result, just reordered: out_zz[:, inv] == out for both the
@@ -176,6 +181,7 @@ def test_zigzag_permutation_structure():
         zigzag_permutation(10, 4)
 
 
+@pytest.mark.requires_jax09
 def test_engine_zigzag_loss_parity(devices8, tmp_path):
     """Distributed.sep_zigzag: the engine permutes the batch, ring masks by
     true positions, and the loss matches the contiguous sep layout."""
@@ -230,6 +236,7 @@ def test_engine_zigzag_loss_parity(devices8, tmp_path):
     np.testing.assert_allclose(zz, ref, rtol=2e-4)
 
 
+@pytest.mark.requires_jax09
 def test_engine_zigzag_pp_loss_parity():
     """sep_zigzag composes with pipeline parallelism: ctx.attn_positions
     rides into the 1F1B chunk fns as a stage-replicated constant and ring

@@ -84,6 +84,20 @@ def main(argv=None):
             else None
         )
         engine.fit(train_loader, eval_loader)
+        # data-pipeline health epilogue: skips spent and host-side wait are
+        # the two numbers an operator checks after a flaky-storage run
+        skips = int(getattr(train_loader, "skips", 0) or 0)
+        if skips:
+            logger.warning(
+                f"run finished with {skips} corrupt sample(s) skipped "
+                "(data_skip events in the metrics stream — inspect the "
+                "shard before the next run)"
+            )
+        stats_fn = getattr(train_loader, "stats", None)
+        if callable(stats_fn):
+            wait = stats_fn().get("data_wait_s", 0)
+            if wait:
+                logger.info(f"host data pipeline: {wait}s total step wait")
         if engine.preempted:
             # final checkpoint already written (preemption / exit_after_save
             # path); exit 0 so the orchestrator relaunches with auto_resume
