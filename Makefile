@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill bench
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs bench
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -15,6 +15,7 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_gpt_model.py tests/test_mesh_sharding.py \
              tests/test_serving.py tests/test_request_queue.py \
              tests/test_chunked_ce.py tests/test_lint.py \
+             tests/test_telemetry.py \
              tests/test_bench_helpers.py tests/test_bench_cases.py
 
 # lint runs inside the gate via tests/test_lint.py::test_repo_is_clean
@@ -64,6 +65,14 @@ test-serve-drill:
 # (docs/data_pipeline.md runbook)
 test-data-drill:
 	python -m pytest tests/test_data.py tests/test_data_drills.py "tests/test_fault_injection.py::test_nan_rollback_rewind_replay_parity" -q
+
+# observability gate: telemetry registry/span/MFU/flight-recorder units,
+# the serving metrics surfaces, and the Prometheus-exposition + flight
+# recorder drills through the real tools/serve.py CLI
+# (docs/observability.md)
+test-obs:
+	python -m pytest tests/test_telemetry.py tests/test_serving.py tests/test_request_queue.py -q -m "not slow"
+	python -m pytest tests/test_serve_drills.py -q -k "metrics or gen_hang"
 
 bench:
 	python benchmarks/run_benchmark.py

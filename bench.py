@@ -45,10 +45,14 @@ def _backend_alive(timeout_s: float = None) -> bool:
 
 
 def model_flops_per_token(hidden: int, layers: int, vocab: int, seq: int) -> float:
-    """Model FLOPs per token, fwd + 2x bwd (standard MFU convention, no
-    remat extra; causal attention counted at half the score matrix).
-    Shared by bench.py and benchmarks/bench_extra.py so the two MFU
-    numbers stay comparable."""
+    """Model FLOPs per token, fwd + 2x bwd, WITH the seq-dependent
+    attention-score term (causal attention counted at half the score
+    matrix) — kept for benchmarks/bench_extra.py's detailed view.  The
+    headline row's ``mfu``/``tokens_per_sec`` fields instead come from
+    the repo-wide analytic 6·N estimator
+    (paddlefleetx_tpu.utils.telemetry.model_flops_per_token), the same
+    one the engine's step records and bench_decode.py use, so every
+    BENCH_*.json trajectory is normalized by ONE definition."""
     h, L, v = int(hidden), int(layers), int(vocab)
     ffn = 4 * h
     per = L * (2 * h * 3 * h + 2 * seq * h + 2 * h * h + 4 * h * ffn) + 2 * h * v
@@ -342,11 +346,19 @@ def _child() -> None:
 
     tokens_per_s = batch * seq * steps / dt
 
+    # hardware normalization via the repo-wide estimator (6·N per token)
+    # and per-device-kind peak table — BENCH_PEAK_TFLOPS / PFX_PEAK_FLOPS
+    # override, in that order (docs/observability.md)
+    from paddlefleetx_tpu.utils import telemetry
+
     mc = cfg.Model
-    flops_tok = model_flops_per_token(
-        mc.hidden_size, mc.num_layers, mc.vocab_size, seq
+    flops_tok = telemetry.model_flops_per_token(
+        vocab_size=mc.vocab_size, hidden_size=mc.hidden_size,
+        num_layers=mc.num_layers,
     )
-    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", 197)) * 1e12  # v5e bf16
+    env_peak = os.environ.get("BENCH_PEAK_TFLOPS")
+    peak = (float(env_peak) * 1e12 if env_peak
+            else telemetry.peak_flops(default=197e12))  # v5e bf16
     mfu = tokens_per_s / n_dev * flops_tok / peak
 
     print(
@@ -356,7 +368,10 @@ def _child() -> None:
                 "value": round(tokens_per_s / n_dev, 1),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(tokens_per_s / n_dev / BASELINE_TOKENS_PER_S, 3),
-                "mfu": round(mfu, 4),
+                "tokens_per_sec": round(tokens_per_s, 1),
+                # 6 digits: CPU smoke shapes under forced multi-device
+                # hosts land near 1e-5 and must not round to a dishonest 0
+                "mfu": round(mfu, 6),
                 # CPU smoke rows must never read as chip evidence
                 "platform": jax.default_backend(),
             }

@@ -95,6 +95,22 @@ def _parse_cases(cases_arg: str) -> list:
     return out
 
 
+def _mfu_fields(cfg, per_chip_tokens_per_s: float) -> dict:
+    """Hardware-normalized fields for a decode/serving row: the repo-wide
+    analytic estimator on its forward-only basis (2·N per token — decode
+    runs no backward) against the per-device-kind peak
+    (docs/observability.md).  Same estimator as bench.py and the engine's
+    step records, so BENCH_*.json trajectories compare on one definition."""
+    from paddlefleetx_tpu.utils import telemetry
+
+    flops_tok = telemetry.model_flops_per_token(cfg, backward=False)
+    peak = telemetry.peak_flops()
+    out = {"tokens_per_sec": round(per_chip_tokens_per_s, 1)}
+    if flops_tok and peak:
+        out["mfu"] = round(per_chip_tokens_per_s * flops_tok / peak, 6)
+    return out
+
+
 def _gpt_cfg(args):
     from paddlefleetx_tpu.models.gpt.config import GPTConfig
 
@@ -149,6 +165,7 @@ def run_decode_case(name: str, args, params_cache: dict) -> dict:
         "strategy": strategy,
         "decode_path": "legacy(dense+scan)" if legacy else "overhauled",
         "per_token_ms": round(dt / args.dec * 1e3, 3),
+        **_mfu_fields(cfg, batch * args.dec / dt),
         "platform": jax.default_backend(),
     }
 
@@ -224,6 +241,7 @@ def run_serving_case(args) -> dict:
         "strategy": "sampling(top_p=0.9)",
         "decode_path": "overhauled",
         "jit_traces": server.stats.get("traces"),
+        **_mfu_fields(module.config, computed / dt / n_dev),
         "platform": jax.default_backend(),
     }
 

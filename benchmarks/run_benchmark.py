@@ -39,7 +39,7 @@ def _provision(name: str, spec: dict, writer, writer_kwargs: dict,
     families: regenerate when the case spec changes, not on mere
     existence.  Returns the value to point input_dir at (the corpus
     prefix or its directory, per the dataset's convention)."""
-    data_dir = os.path.join("/tmp", "pfx_bench_data", name)
+    data_dir = os.path.join("/tmp", "pfx_bench_data", name)  # noqa — dir, not a metric
     prefix = os.path.join(data_dir, "corpus")
     spec_path = os.path.join(data_dir, "spec.json")
     spec_str = json.dumps(spec, sort_keys=True)

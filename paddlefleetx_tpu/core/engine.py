@@ -200,6 +200,31 @@ class Engine:
         # (the TIPC-style harness and dashboards parse this instead of
         # regexing the console log; "" disables)
         self.metrics_file = eng.get("metrics_file", "")
+        # unified telemetry (utils/telemetry.py): every record written to
+        # the metrics stream ALSO lands in the crash flight recorder (so a
+        # postmortem never depends on metrics_file being set) and the
+        # logged throughput feeds the process-wide registry.  MFU: the
+        # analytic GPT-family estimator (6·N per token) against the
+        # per-device-kind peak (PFX_PEAK_FLOPS override); None for
+        # non-GPT modules — no MFU column rather than a wrong one.
+        from paddlefleetx_tpu.utils.telemetry import (
+            get_flight_recorder,
+            get_registry,
+            model_flops_per_token,
+            peak_flops,
+        )
+
+        self._registry = get_registry()
+        self._recorder = get_flight_recorder()
+        self._flops_per_token = model_flops_per_token(
+            getattr(module, "config", None)
+        )
+        self._peak_flops = peak_flops() if self._flops_per_token else None
+        # first-dispatch trace+compile seconds (emitted once as compile_s
+        # in the step record and EXCLUDED from the ips/mfu window — the
+        # old first window understated early throughput wildly)
+        self._compile_s: Optional[float] = None
+        self._compile_emitted = False
 
         # fp16 parity path: dynamic loss scaling (reference DynamicLossScaler
         # apis/amp.py:193-234).  bf16 (the TPU default) needs no scaler —
@@ -923,6 +948,12 @@ class Engine:
         return jax.tree.map(lambda x: jax.device_put(x, self.batch_spec), batch)
 
     def _write_metrics(self, record: Dict) -> None:
+        # EVERY record (step, data_skip, rollback, preempt_save) also
+        # enters the flight recorder ring — before the metrics_file gates,
+        # so a crash postmortem exists even when no stream is configured
+        rec = dict(record)
+        rec.setdefault("event", "step")
+        self._recorder.record(rec)
         if not self.metrics_file:
             return
         if jax.process_index() != 0:
@@ -941,6 +972,47 @@ class Engine:
         except OSError as e:
             logger.warning(f"metrics_file write failed (disabling): {e}")
             self.metrics_file = ""
+
+    def _dump_flight(self, reason: str) -> None:
+        """Training-side flight-recorder dump: lands next to the
+        checkpoints (output_dir) so the postmortem travels with the run's
+        artifacts; PFX_FLIGHT_RECORDER still overrides inside dump()."""
+        if jax.process_index() != 0:
+            # multi-host: one writer to the shared output_dir, same
+            # convention as _write_metrics (rollback/preempt fire on
+            # every process; host 0's ring is the canonical postmortem)
+            return
+        self._recorder.dump(
+            path=os.path.join(self.output_dir, "flight_recorder.jsonl"),
+            reason=reason,
+        )
+
+    def _update_registry(self, record: Dict, ips: float) -> None:
+        """Mirror the logged step record onto the process-wide telemetry
+        registry (scraped by any /metrics surface this process hosts).
+        Cumulative values are ``set`` from the engine's own counters —
+        exporter style — so a resumed run reports monotonic totals."""
+        reg = self._registry
+        reg.counter("pfx_train_steps_total").set(self._step)
+        reg.counter("pfx_train_tokens_total").set(
+            self._consumed_samples * (self.module.tokens_per_sample or 1)
+        )
+        reg.gauge("pfx_train_loss").set(record["loss"])
+        reg.gauge("pfx_train_tokens_per_second").set(round(ips, 1))
+        reg.counter("pfx_train_data_wait_seconds_total").set(
+            record.get("data_wait_s", 0.0)
+        )
+        reg.counter("pfx_train_host_seconds_total").set(
+            record.get("host_s", 0.0)
+        )
+        if self._compile_s is not None:
+            reg.gauge("pfx_train_compile_seconds").set(round(self._compile_s, 3))
+        if "model_flops" in record:
+            reg.gauge("pfx_train_model_flops_per_second").set(
+                record["model_flops"]
+            )
+        if "mfu" in record:
+            reg.gauge("pfx_train_mfu").set(record["mfu"])
 
     def _drain_skip_events(self, loader) -> None:
         """Move the loader's structured ``data_skip`` events (appended by
@@ -1085,6 +1157,12 @@ class Engine:
                 "rewound": bool(rewindable),
             }
         )
+        # postmortem dump: the ring (recent step records, the rollback
+        # event, any data_skips) hits disk NOW — if the post-rollback
+        # replay diverges again and max_rollbacks kills the run, the
+        # window that tripped the guard is already preserved
+        self._registry.counter("pfx_train_rollbacks_total").inc()
+        self._dump_flight(f"anomaly_rollback: {reason}")
         # the LIVE data-stream position: every step served so far plus the
         # just-dispatched (discarded) batch — needed only on the legacy
         # (non-rewindable) path, where load() resets the counter to the
@@ -1150,6 +1228,10 @@ class Engine:
         self._write_metrics(
             {"event": "preempt_save", "step": step, "cause": cause, "ckpt": path}
         )
+        # the process is about to exit (or be killed if the grace window
+        # runs out): leave the flight ring on disk alongside the ckpt
+        self._registry.counter("pfx_train_preempt_saves_total").inc()
+        self._dump_flight(f"preempt_save: {cause}")
         self.preempted = True
 
     def _fit_loop(self, train_loader, eval_iter, tokens_per_sample, profiler,
@@ -1163,10 +1245,19 @@ class Engine:
         # the guard never idles the device (async dispatch stays ahead)
         prev_metrics = None
         rollbacks = 0
+        # per-phase accounting (docs/observability.md): cumulative seconds
+        # this fit spent blocked on data (consumer-side next()) and on the
+        # host path (batch placement + step dispatch).  Pure monotonic
+        # host clocks — no device sync is added to the hot path.
+        data_wait_total = 0.0
+        host_total = 0.0
+        steps_in_window = 0
         data_iter = iter(train_loader)
         while True:
             try:
+                t_fetch = time.monotonic()
                 batch = next(data_iter)
+                data_wait_total += time.monotonic() - t_fetch
             except StopIteration:
                 break
             if self._step >= self.max_steps:
@@ -1174,8 +1265,19 @@ class Engine:
             self._drain_skip_events(train_loader)
             if resilience.maybe_fire("nan_grads", self._step + 1):
                 batch = resilience.poison_batch(batch)
+            t_host = time.monotonic()
             dev_batch = self._put_batch(batch)
             self.state, metrics = self._train_step(self.state, dev_batch)
+            host_dt = time.monotonic() - t_host
+            if self._compile_s is None:
+                # the first dispatch traces + compiles synchronously inside
+                # the jit call: time it separately (compile_s) and restart
+                # the throughput window so ips/mfu never average the
+                # compile into the first window
+                self._compile_s = host_dt
+                t_last = time.time()
+            else:
+                host_total += host_dt
             if guard is not None and prev_metrics is not None:
                 pm = jax.device_get(prev_metrics)
                 reason = guard.observe(
@@ -1204,6 +1306,7 @@ class Engine:
                 }
             self._consumed_samples += self.global_batch_size
             window_tokens += self.global_batch_size * tokens_per_sample
+            steps_in_window += 1
             self._step += 1
             step = self._step
             profiler.step(step)
@@ -1224,10 +1327,33 @@ class Engine:
                     "grad_norm": float(metrics["grad_norm"]),
                     "ips": round(ips, 1),
                     "consumed_samples": self._consumed_samples,
+                    # phase breakdown: cumulative consumer-side data wait
+                    # and host-side (placement+dispatch) seconds, plus the
+                    # average wall seconds per step over this window —
+                    # wall minus data/host is dispatched-device time
+                    "tokens_per_sec": round(ips, 1),
+                    "data_wait_s": round(data_wait_total, 3),
+                    "host_s": round(host_total, 3),
+                    "step_s": round(dt / max(1, steps_in_window), 4),
                 }
+                if self._compile_s is not None and not self._compile_emitted:
+                    # first logged window: trace+compile seconds, timed at
+                    # the first dispatch and excluded from the ips window
+                    record["compile_s"] = round(self._compile_s, 3)
+                    self._compile_emitted = True
+                if self._flops_per_token:
+                    model_fps = ips * self._flops_per_token
+                    record["model_flops"] = round(model_fps, 1)
+                    if self._peak_flops:
+                        record["mfu"] = round(
+                            model_fps / (self._peak_flops * self.mesh.size), 6
+                        )
                 # data-pipeline health (prefetch depth, cumulative seconds
                 # the loop sat starved, skip budget spent) rides the same
-                # stream so dashboards see starvation next to throughput
+                # stream so dashboards see starvation next to throughput.
+                # The loader's own data_wait_s (producer-side, sees stalls
+                # the prefetch buffer hides from the loop) overrides the
+                # engine's consumer-side measurement when available.
                 stats_fn = getattr(train_loader, "stats", None)
                 if callable(stats_fn):
                     record.update(
@@ -1235,9 +1361,11 @@ class Engine:
                         if k in ("data_wait_s", "prefetch_depth",
                                  "stall_warnings", "skips")
                     )
+                self._update_registry(record, ips)
                 self._write_metrics(record)
                 t_last = time.time()
                 window_tokens = 0
+                steps_in_window = 0
 
             if self.consistency_check_freq and step % self.consistency_check_freq == 0:
                 from paddlefleetx_tpu.parallel.check import check_replica_consistency
@@ -1246,11 +1374,13 @@ class Engine:
                 logger.info(f"consistency check OK @ step {step}: params fp {fp:#010x}")
                 t_last = time.time()
                 window_tokens = 0
+                steps_in_window = 0
 
             if self.eval_freq and eval_iter is not None and step % self.eval_freq == 0:
                 self.evaluate(eval_iter, iters=self.eval_iters)
                 t_last = time.time()
                 window_tokens = 0
+                steps_in_window = 0
 
             if self.save_steps and step % self.save_steps == 0:
                 self.save()
@@ -1266,6 +1396,7 @@ class Engine:
                     rollbacks = 0
                 t_last = time.time()
                 window_tokens = 0
+                steps_in_window = 0
                 if self.exit_after_save:
                     # checkpoint-aligned clean exit: the save above is
                     # durable once wait_for_save joins (fit's finally);

@@ -47,6 +47,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
 
 from paddlefleetx_tpu.utils.log import logger
+from paddlefleetx_tpu.utils.telemetry import StatsView, get_registry
 
 
 class QueueFull(RuntimeError):
@@ -63,21 +64,30 @@ class DeadlineExceeded(RuntimeError):
 
 class RequestFuture:
     """Minimal one-shot future: the handler thread blocks on ``result``
-    while the scheduler thread resolves it exactly once."""
+    while the scheduler thread resolves it exactly once.
 
-    __slots__ = ("_event", "_value", "_exc")
+    ``times`` carries the request's lifecycle span stamps (monotonic):
+    ``enqueued`` at admission, ``picked`` when the scheduler takes the
+    entry, ``resolved`` when the result/exception lands — the transport
+    layer turns these into queue-wait/decode span phases and TTFT
+    histograms without the queue knowing about telemetry."""
+
+    __slots__ = ("_event", "_value", "_exc", "times")
 
     def __init__(self) -> None:
         self._event = threading.Event()
         self._value: Any = None
         self._exc: Optional[BaseException] = None
+        self.times: Dict[str, float] = {}
 
     def set_result(self, value: Any) -> None:
         self._value = value
+        self.times.setdefault("resolved", time.monotonic())
         self._event.set()
 
     def set_exception(self, exc: BaseException) -> None:
         self._exc = exc
+        self.times.setdefault("resolved", time.monotonic())
         self._event.set()
 
     def done(self) -> bool:
@@ -142,17 +152,32 @@ class RequestQueue:
         self._closed = False
         self._busy_since: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
-        self.stats: Dict[str, int] = {
-            "submitted": 0,
-            "completed": 0,
-            "batches": 0,
-            "coalesced_batches": 0,
-            "coalesced_requests": 0,
-            "shed_deadline": 0,
-            "rejected_full": 0,
-            "rejected_closed": 0,
-            "gen_errors": 0,
-        }
+        # per-instance counts with the old dict interface, exported onto
+        # the process-wide telemetry registry (StatsView) so /metrics and
+        # /healthz read the same locked snapshot; depth/busy ride along as
+        # live gauges via a weakly-held collector
+        self.stats = StatsView(
+            {
+                "submitted": "pfx_queue_submitted_total",
+                "completed": "pfx_queue_completed_total",
+                "batches": "pfx_queue_batches_total",
+                "coalesced_batches": "pfx_queue_coalesced_batches_total",
+                "coalesced_requests": "pfx_queue_coalesced_requests_total",
+                "shed_deadline": "pfx_queue_shed_deadline_total",
+                "rejected_full": "pfx_queue_rejected_full_total",
+                "rejected_closed": "pfx_queue_rejected_closed_total",
+                "gen_errors": "pfx_queue_gen_errors_total",
+            }
+        )
+        get_registry().register_collector(self)
+
+    def collect(self):
+        """Telemetry collector: live queue depth + runner busy seconds
+        (the watchdog's wedge probe) in every registry snapshot."""
+        return [
+            ("pfx_queue_depth", {}, float(self.depth())),
+            ("pfx_queue_busy_seconds", {}, self.busy_seconds()),
+        ]
 
     # -- admission ------------------------------------------------------
     def submit(
@@ -178,6 +203,7 @@ class RequestQueue:
             future=RequestFuture(),
             enqueued_at=time.monotonic(),
         )
+        entry.future.times["enqueued"] = entry.enqueued_at
         with self._wake:
             if self._closed:
                 self.stats["rejected_closed"] += 1
@@ -312,6 +338,9 @@ class RequestQueue:
                     self._wake.wait()
                     batch = self._take_batch_locked()
                 self._busy_since = time.monotonic()
+                for e in batch:
+                    # span stamp: queue-wait ends here, decode begins
+                    e.future.times.setdefault("picked", self._busy_since)
             try:
                 self._run_batch(batch)
             finally:

@@ -26,6 +26,7 @@ from paddlefleetx_tpu.models.gpt.generation import (
 )
 from paddlefleetx_tpu.utils.log import logger
 from paddlefleetx_tpu.utils.resilience import maybe_fire
+from paddlefleetx_tpu.utils.telemetry import StatsView, get_registry
 
 
 def plan_decode(padded_len: int, max_toks: int, *, context: int):
@@ -114,10 +115,21 @@ class GenerationServer:
         # gen_errors / last_error: structured generation-failure stats —
         # /healthz spreads server.stats, so an operator sees a failing
         # decode (and its class) without scraping logs
-        self.stats: Dict[str, float] = {
-            "requests": 0, "tokens_out": 0, "time_s": 0.0, "traces": 0,
-            "last_latency_s": 0.0, "gen_errors": 0, "last_error": "",
-        }
+        # StatsView: same dict interface as before, but the numeric keys
+        # are exported onto the process-wide telemetry registry so
+        # /metrics and /healthz render one locked snapshot (non-exported
+        # keys — last_error, warmup_s — stay instance-local)
+        self.stats = StatsView(
+            {
+                "requests": "pfx_serving_requests_total",
+                "tokens_out": "pfx_serving_tokens_out_total",
+                "time_s": "pfx_serving_gen_seconds_total",
+                "traces": "pfx_serving_traces_total",
+                "gen_errors": "pfx_serving_gen_errors_total",
+                "last_latency_s": "pfx_serving_last_latency_seconds",
+            },
+            init={"time_s": 0.0, "last_latency_s": 0.0, "last_error": ""},
+        )
 
     def _decode_fn(self, gen: GenerationConfig, batch: int, bucket_len: int):
         key = (gen, batch, bucket_len)
@@ -328,4 +340,7 @@ class GenerationServer:
                     f"(pad multiple {self.bucket}) compiled in {per[key]:.1f}s"
                 )
         self.stats["warmup_s"] = dict(per)
+        get_registry().counter("pfx_serving_warmup_seconds_total").inc(
+            sum(per.values())
+        )
         return per

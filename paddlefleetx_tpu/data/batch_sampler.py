@@ -520,6 +520,17 @@ class PrefetchLoader:
         out["data_wait_s"] = round(self.data_wait_s, 3)
         out["prefetch_depth"] = self._it.depth() if self._it is not None else 0
         out["stall_warnings"] = self.stall_warnings
+        # mirror onto the process-wide telemetry registry (/metrics):
+        # stats() runs at the engine's logging cadence, never per batch,
+        # so this is off the hot path; cumulative values are exporter-set
+        from paddlefleetx_tpu.utils.telemetry import get_registry
+
+        reg = get_registry()
+        reg.counter("pfx_data_wait_seconds_total").set(out["data_wait_s"])
+        reg.gauge("pfx_data_prefetch_depth").set(out["prefetch_depth"])
+        reg.counter("pfx_data_stall_warnings_total").set(out["stall_warnings"])
+        if "skips" in out:
+            reg.counter("pfx_data_skips_total").set(out["skips"])
         return out
 
     # -- iterator-state contract (delegates to the wrapped loader) ------

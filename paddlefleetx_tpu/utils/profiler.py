@@ -23,6 +23,7 @@ importable and degrades to trace-only with a warning otherwise.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -56,15 +57,26 @@ class ProfilerHook:
         self.summary_top = int(cfg.get("summary_top", 20))
         self._active = False
         self._pending_summary = False
+        self._trace_t0 = 0.0
 
     def step(self, step: int) -> None:
         """Call once per training step with the 1-based step counter."""
         if not self.enabled:
             return
+        from paddlefleetx_tpu.utils.telemetry import (
+            get_flight_recorder,
+            get_registry,
+        )
+
         if not self._active and self.start_step <= step < self.stop_step:
             os.makedirs(self.log_dir, exist_ok=True)
             jax.profiler.start_trace(self.log_dir)
             self._active = True
+            self._trace_t0 = time.monotonic()
+            get_flight_recorder().record(
+                {"event": "profiler_trace_start", "step": step,
+                 "log_dir": self.log_dir}
+            )
             logger.info(f"profiler: trace started (steps {self.start_step}-{self.stop_step}) -> {self.log_dir}")
         elif self._active and step >= self.stop_step:
             jax.profiler.stop_trace()
@@ -73,6 +85,14 @@ class ProfilerHook:
             # whole trace — deferred to close() so the remaining training
             # steps (whose throughput is being measured) are not stalled
             self._pending_summary = True
+            trace_s = time.monotonic() - self._trace_t0
+            reg = get_registry()
+            reg.counter("pfx_profiler_traces_total").inc()
+            reg.gauge("pfx_profiler_trace_seconds").set(round(trace_s, 3))
+            get_flight_recorder().record(
+                {"event": "profiler_trace_stop", "step": step,
+                 "trace_s": round(trace_s, 3)}
+            )
             logger.info(f"profiler: trace written to {self.log_dir} (view with TensorBoard)")
 
     def close(self) -> None:

@@ -1,0 +1,848 @@
+"""Unified telemetry: the process-wide metrics registry, span timing, MFU
+accounting, and the crash flight recorder.
+
+PRs 1-4 each grew an ad-hoc stats surface (``GenerationServer.stats``,
+``RequestQueue.stats``, loader ``stats()``, the ``/healthz`` counter dict,
+the engine's JSONL metrics stream) with no single place to scrape and no
+hardware-utilization signal.  This module is the one layer under all of
+them:
+
+  - **Registry** — thread-safe counters, gauges, and histograms with label
+    support.  Every metric NAME must be declared in the ``METRICS`` table
+    below and match ``^pfx_[a-z0-9_]+$`` (``tools/lint.py`` E10 enforces
+    both statically; the registry raises on undeclared names at runtime),
+    so the ``/metrics`` namespace cannot fragment the way the per-module
+    dicts did.  ``snapshot()`` returns ONE locked, consistent view;
+    ``render_prometheus()`` renders that same view as Prometheus text
+    exposition — ``/metrics`` and ``/healthz`` in ``tools/serve.py`` are
+    two renderings of one snapshot, never two racing read paths.
+  - **StatsView** — a dict-like per-instance stats object (drop-in for the
+    old hand-rolled dicts, so ``server.stats["traces"] += 1`` keeps
+    working) whose numeric keys are exported onto the registry through a
+    weakly-referenced collector.  Instance-local semantics stay exactly as
+    before (tests assert absolute per-instance counts); the registry sums
+    across live instances at snapshot time.
+  - **Span** — lightweight monotonic-clock phase timing.  ``mark()``
+    stamps a labeled instant (callers may inject externally-captured
+    timestamps, e.g. the request queue's pickup time); ``phases()`` turns
+    consecutive marks into durations; ``event()`` shapes the span for the
+    flight recorder.
+  - **MFU accounting** — the analytic GPT-family FLOPs estimator
+    (6·N per token for fwd+bwd, 2·N forward-only; PaLM's convention,
+    Chowdhery et al. 2022) plus the per-device-kind peak-FLOPs table
+    behind the ``PFX_PEAK_FLOPS`` override, shared by the engine's step
+    records, ``bench.py``, and ``benchmarks/bench_decode.py`` so every
+    throughput number is hardware-normalized by the SAME estimator.
+  - **FlightRecorder** — a bounded ring of recent structured events (step
+    records, data_skip, rollback, preempt_save, gen_errors, watchdog
+    flips, request spans) dumped to ``flight_recorder.jsonl`` on crash,
+    force-quit, watchdog-degraded, and anomaly rollback — postmortems no
+    longer depend on having had ``Engine.metrics_file`` set.
+
+Knobs (loud-parse, repo convention): ``PFX_PEAK_FLOPS`` (per-chip peak
+FLOP/s used as the MFU denominator; default per detected device kind),
+``PFX_FLIGHT_RECORDER`` (dump path, default ./flight_recorder.jsonl),
+``PFX_FLIGHT_RECORDER_CAP`` (ring capacity, default 256).
+
+Contract notes: metric *mutations* never take the registry lock (each
+metric/collector owns a private lock), so hot paths (the serving scheduler,
+the train loop) never contend with a scrape; ``snapshot()`` takes the
+registry lock and then each collector's lock, and nothing acquires them in
+the other order.  No jax import at module scope — ``bench.py``'s parent
+process and ``tools/lint.py`` stay jax-free.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import re
+import sys
+import threading
+import time
+import weakref
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from paddlefleetx_tpu.utils.log import logger
+
+METRIC_NAME_RE = re.compile(r"^pfx_[a-z0-9_]+$")
+
+# ---------------------------------------------------------------------------
+# THE metric declaration table: name -> (kind, help).  Every name emitted
+# through the registry must live here (runtime check + tools/lint.py E10).
+# Naming schema: pfx_<subsystem>_<what>[_<unit>][_total]; seconds for time,
+# *_total for monotonic cumulatives.
+# ---------------------------------------------------------------------------
+METRICS: Dict[str, Tuple[str, str]] = {
+    # serving core (core/serving.py GenerationServer)
+    "pfx_serving_requests_total": ("counter", "Completed generate_ids calls"),
+    "pfx_serving_tokens_out_total": ("counter", "Generated tokens delivered"),
+    "pfx_serving_gen_seconds_total": ("counter", "Wall seconds inside generate_ids"),
+    "pfx_serving_traces_total": ("counter", "Decode jit trace-time entries (retrace probe)"),
+    "pfx_serving_gen_errors_total": ("counter", "Generation failures"),
+    "pfx_serving_last_latency_seconds": ("gauge", "Latency of the most recent generate_ids call"),
+    "pfx_serving_warmup_seconds_total": ("counter", "Seconds spent in warmup compiles"),
+    # request queue (core/request_queue.py)
+    "pfx_queue_submitted_total": ("counter", "Requests admitted"),
+    "pfx_queue_completed_total": ("counter", "Requests answered"),
+    "pfx_queue_batches_total": ("counter", "Runner batches executed"),
+    "pfx_queue_coalesced_batches_total": ("counter", "Batches that merged >1 request"),
+    "pfx_queue_coalesced_requests_total": ("counter", "Requests served via a coalesced batch"),
+    "pfx_queue_shed_deadline_total": ("counter", "Requests shed at their deadline"),
+    "pfx_queue_rejected_full_total": ("counter", "Admissions rejected: queue full"),
+    "pfx_queue_rejected_closed_total": ("counter", "Admissions rejected: draining"),
+    "pfx_queue_gen_errors_total": ("counter", "Runner batches that raised"),
+    "pfx_queue_depth": ("gauge", "Requests waiting in the admission queue"),
+    "pfx_queue_busy_seconds": ("gauge", "Seconds the current runner call has been executing"),
+    # HTTP surface (tools/serve.py)
+    "pfx_http_requests_in_flight": ("gauge", "In-flight /generate requests"),
+    "pfx_http_responses_total": ("counter", "HTTP responses by status code"),
+    "pfx_http_client_gone_total": ("counter", "Responses lost to client disconnects"),
+    "pfx_request_latency_seconds": ("histogram", "End-to-end /generate latency"),
+    "pfx_request_ttft_seconds": ("histogram", "Time to first token (request receipt to decode done)"),
+    "pfx_request_queue_wait_seconds": ("histogram", "Admission to scheduler pickup"),
+    "pfx_request_decode_seconds": ("histogram", "Scheduler pickup to decode completion"),
+    "pfx_request_per_token_seconds": ("histogram", "Decode seconds per delivered token"),
+    "pfx_serve_draining": ("gauge", "1 while the server drains for shutdown"),
+    "pfx_serve_degraded": ("gauge", "1 while the wedged-generation watchdog is tripped"),
+    # training (core/engine.py)
+    "pfx_train_steps_total": ("counter", "Optimizer steps completed"),
+    "pfx_train_tokens_total": ("counter", "Training tokens consumed"),
+    "pfx_train_loss": ("gauge", "Loss at the last logged step"),
+    "pfx_train_tokens_per_second": ("gauge", "Throughput over the last logging window"),
+    "pfx_train_model_flops_per_second": ("gauge", "Achieved model FLOP/s (analytic estimator)"),
+    "pfx_train_mfu": ("gauge", "Model FLOPs utilization vs per-chip peak"),
+    "pfx_train_compile_seconds": ("gauge", "First-dispatch trace+compile seconds"),
+    "pfx_train_data_wait_seconds_total": ("counter", "Cumulative seconds the step loop waited on data"),
+    "pfx_train_host_seconds_total": ("counter", "Cumulative host-side seconds (placement + dispatch)"),
+    "pfx_train_rollbacks_total": ("counter", "Anomaly rollbacks executed"),
+    "pfx_train_preempt_saves_total": ("counter", "Preemption-path final checkpoints"),
+    # data pipeline (data/batch_sampler.py loader stats)
+    "pfx_data_skips_total": ("counter", "Corrupt samples skipped under the budget"),
+    "pfx_data_stall_warnings_total": ("counter", "Prefetch starvation warnings"),
+    "pfx_data_wait_seconds_total": ("counter", "Loader-reported cumulative data wait"),
+    "pfx_data_prefetch_depth": ("gauge", "Batches currently buffered by the prefetcher"),
+    # profiler (utils/profiler.py)
+    "pfx_profiler_traces_total": ("counter", "Profiler trace windows captured"),
+    "pfx_profiler_trace_seconds": ("gauge", "Wall seconds of the last trace window"),
+}
+
+# latency-shaped default buckets (seconds): sub-ms to minutes, exponential-ish
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+# reservoir per histogram child: enough for stable p50/p99 on /healthz
+# without unbounded memory (the old serve.py deque was maxlen=256 too)
+_RESERVOIR = 256
+
+
+def _env_float(name: str, default: float, minimum: float = 0.0) -> float:
+    """Loud-parse float env knob (repo convention, utils/resilience.py)."""
+    raw = os.environ.get(name) or ""
+    if not raw.strip():
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a number (loud-parse: unset it or "
+            f"pass a valid value)"
+        ) from None
+    if val < minimum:
+        raise ValueError(f"{name}={val} must be >= {minimum}")
+    return val
+
+
+def _env_int(name: str, default: int, minimum: int = 1) -> int:
+    """Loud-parse int env knob."""
+    raw = os.environ.get(name) or ""
+    if not raw.strip():
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not an integer (loud-parse: unset it or "
+            f"pass a valid value)"
+        ) from None
+    if val < minimum:
+        raise ValueError(f"{name}={val} must be >= {minimum}")
+    return val
+
+
+# ---------------------------------------------------------------------------
+# metric children
+# ---------------------------------------------------------------------------
+
+
+class Counter:
+    """Monotonic counter.  ``set()`` exists for exporter-style cumulative
+    imports (a loader's own ``data_wait_s`` total pushed as-is) and must
+    only ever be called with non-decreasing values."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self) -> None:
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._value += v
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def get(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge(Counter):
+    """Settable instantaneous value; ``add()`` for in-flight up/downs."""
+
+    __slots__ = ()
+
+    def add(self, v: float) -> None:
+        self.inc(v)
+
+
+class Histogram:
+    """Cumulative-bucket histogram + a bounded reservoir for percentiles.
+
+    Buckets render in Prometheus ``_bucket{le=...}`` form; the reservoir
+    (last ``_RESERVOIR`` observations) feeds ``percentile()`` for the
+    /healthz p50/p99 fields without a full-series store."""
+
+    __slots__ = ("buckets", "_counts", "_sum", "_count", "_reservoir", "_lock")
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self._sum = 0.0
+        self._count = 0
+        self._reservoir: deque = deque(maxlen=_RESERVOIR)
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        idx = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+            self._reservoir.append(v)
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile over the reservoir (0.0 when empty)."""
+        with self._lock:
+            vals = sorted(self._reservoir)
+        if not vals:
+            return 0.0
+        idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+        return vals[idx]
+
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            cum, total = [], 0
+            for c in self._counts:
+                total += c
+                cum.append(total)
+            vals = sorted(self._reservoir)
+            sum_ = self._sum
+
+        def pct(q: float) -> float:
+            if not vals:
+                return 0.0
+            return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+        return {
+            "buckets": list(zip(self.buckets, cum[:-1])),
+            "count": cum[-1],
+            "sum": sum_,
+            "p50": pct(0.50),
+            "p99": pct(0.99),
+        }
+
+
+class _Family:
+    """One declared metric: kind + per-labelset children."""
+
+    __slots__ = ("name", "kind", "help", "buckets", "children")
+
+    def __init__(self, name: str, kind: str, help_: str, buckets=None) -> None:
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self.buckets = buckets
+        self.children: Dict[Tuple[Tuple[str, str], ...], Any] = OrderedDict()
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+class Registry:
+    """Process-wide metric registry.  One instance per process in
+    production (``get_registry()``); tests may build private instances
+    for absolute-count isolation."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._families: Dict[str, _Family] = OrderedDict()
+        self._collectors: List[weakref.ref] = []
+
+    # -- declaration-checked accessors ---------------------------------
+    def _family(self, name: str, kind: str, buckets=None) -> _Family:
+        declared = METRICS.get(name)
+        if declared is None or declared[0] != kind:
+            raise ValueError(
+                f"metric {name!r} ({kind}) is not declared in "
+                "telemetry.METRICS — every emitted name must be declared "
+                "there (and match ^pfx_[a-z0-9_]+$; tools/lint.py E10)"
+            )
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, kind, declared[1], buckets)
+                self._families[name] = fam
+            return fam
+
+    def _child(self, name: str, kind: str, labels: Dict[str, str], buckets=None):
+        fam = self._family(name, kind, buckets)
+        key = _label_key(labels)
+        with self._lock:
+            child = fam.children.get(key)
+            if child is None:
+                if kind == "histogram":
+                    child = Histogram(fam.buckets or DEFAULT_BUCKETS)
+                elif kind == "gauge":
+                    child = Gauge()
+                else:
+                    child = Counter()
+                fam.children[key] = child
+            return child
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        return self._child(name, "counter", labels)
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        return self._child(name, "gauge", labels)
+
+    def histogram(self, name: str, buckets: Optional[Tuple[float, ...]] = None,
+                  **labels: str) -> Histogram:
+        return self._child(name, "histogram", labels, buckets)
+
+    # -- collectors -----------------------------------------------------
+    def register_collector(self, obj: Any) -> None:
+        """Register an object with a ``collect() -> iterable of
+        (metric_name, labels_dict, value)`` method.  Held by WEAK
+        reference: a dead GenerationServer/RequestQueue silently drops
+        out of the snapshot instead of reporting stale values forever."""
+        names = {n for n, _, _ in obj.collect()}
+        for n in names:
+            if n not in METRICS:
+                raise ValueError(
+                    f"collector exports undeclared metric {n!r}; declare "
+                    "it in telemetry.METRICS"
+                )
+        with self._lock:
+            self._collectors.append(weakref.ref(obj))
+
+    # -- snapshot + exposition -----------------------------------------
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """ONE consistent view of every metric: owned children plus live
+        collectors, read under the registry lock.  Counters from multiple
+        collectors of the same name sum (process-wide total); gauges are
+        last-writer-wins.  Shape::
+
+            {name: {"kind": ..., "help": ...,
+                    "values": [(labels_dict, value)], ...}}
+
+        histogram entries instead carry ``buckets``/``count``/``sum``/
+        ``p50``/``p99`` per labelset.
+        """
+        snap: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for name, fam in self._families.items():
+                entry = {"kind": fam.kind, "help": fam.help, "values": []}
+                for key, child in fam.children.items():
+                    labels = dict(key)
+                    if fam.kind == "histogram":
+                        entry["values"].append((labels, child.state()))
+                    else:
+                        entry["values"].append((labels, child.get()))
+                snap[name] = entry
+            live = []
+            for ref in self._collectors:
+                obj = ref()
+                if obj is None:
+                    continue
+                live.append(ref)
+                for name, labels, value in obj.collect():
+                    kind, help_ = METRICS[name]
+                    entry = snap.setdefault(
+                        name, {"kind": kind, "help": help_, "values": []}
+                    )
+                    labels = dict(labels or {})
+                    for i, (lab, old) in enumerate(entry["values"]):
+                        if lab == labels:
+                            entry["values"][i] = (
+                                lab,
+                                old + value if kind == "counter" else value,
+                            )
+                            break
+                    else:
+                        entry["values"].append((labels, float(value)))
+            self._collectors[:] = live
+        return snap
+
+    def render_prometheus(self, snap: Optional[Dict[str, Dict[str, Any]]] = None) -> str:
+        """Prometheus text exposition (format 0.0.4) of a snapshot —
+        pass the snapshot a ``/healthz`` view was built from to guarantee
+        the two endpoints agree."""
+        snap = snap if snap is not None else self.snapshot()
+        lines: List[str] = []
+        for name in sorted(snap):
+            entry = snap[name]
+            lines.append(f"# HELP {name} {entry['help']}")
+            lines.append(f"# TYPE {name} {entry['kind']}")
+            for labels, value in entry["values"]:
+                lstr = _render_labels(labels)
+                if entry["kind"] == "histogram":
+                    extra = dict(labels)
+                    for le, cum in value["buckets"]:
+                        bl = _render_labels({**extra, "le": _fmt(le)})
+                        lines.append(f"{name}_bucket{bl} {cum}")
+                    bl = _render_labels({**extra, "le": "+Inf"})
+                    lines.append(f"{name}_bucket{bl} {value['count']}")
+                    lines.append(f"{name}_sum{lstr} {_fmt(value['sum'])}")
+                    lines.append(f"{name}_count{lstr} {value['count']}")
+                else:
+                    lines.append(f"{name}{lstr} {_fmt(value)}")
+        return "\n".join(lines) + "\n"
+
+    def value(self, name: str, default: Any = 0.0,
+              snap: Optional[Dict[str, Dict[str, Any]]] = None,
+              **labels: str) -> Any:
+        """Convenience read of one metric value — a counter/gauge float,
+        or a histogram's state dict.  Pass ``snap`` to read out of an
+        already-taken snapshot (tools/serve.py renders /healthz and
+        /metrics from ONE snapshot so the endpoints agree)."""
+        entry = (snap if snap is not None else self.snapshot()).get(name)
+        if not entry:
+            return default
+        want = {str(k): str(v) for k, v in labels.items()}
+        for lab, val in entry["values"]:
+            if lab == want:
+                return val
+        return default
+
+    def reset(self) -> None:
+        """Drop every family and collector (test isolation only)."""
+        with self._lock:
+            self._families.clear()
+            self._collectors.clear()
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _render_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+_registry = Registry()
+
+
+def get_registry() -> Registry:
+    """The process-wide default registry."""
+    return _registry
+
+
+# ---------------------------------------------------------------------------
+# StatsView: dict-like per-instance stats exported via a collector
+# ---------------------------------------------------------------------------
+
+
+class StatsView:
+    """Per-instance stats with the old hand-rolled-dict interface
+    (``stats["requests"] += 1``, ``dict(stats)``, ``**stats``) whose
+    numeric keys are ALSO exported onto the registry.
+
+    ``exported`` maps dict key -> declared metric name; keys mapped to
+    ``None`` (and any key assigned later, e.g. ``warmup_s``/``last_error``)
+    stay instance-local.  The registry holds only a weak reference, so a
+    test-scoped server's counters vanish with it."""
+
+    def __init__(
+        self,
+        exported: Dict[str, Optional[str]],
+        init: Optional[Dict[str, Any]] = None,
+        registry: Optional[Registry] = None,
+    ) -> None:
+        self._exported = dict(exported)
+        self._lock = threading.Lock()
+        self._vals: Dict[str, Any] = {k: 0 for k in exported}
+        if init:
+            self._vals.update(init)
+        (registry or get_registry()).register_collector(self)
+
+    # collector protocol
+    def collect(self) -> List[Tuple[str, Dict[str, str], float]]:
+        with self._lock:
+            return [
+                (metric, {}, float(self._vals[key]))
+                for key, metric in self._exported.items()
+                if metric is not None
+                and isinstance(self._vals.get(key), (int, float))
+                and not isinstance(self._vals.get(key), bool)
+            ]
+
+    # mapping protocol (enough for dict(view), **view, view.items())
+    def __getitem__(self, key: str) -> Any:
+        with self._lock:
+            return self._vals[key]
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._vals[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._vals.get(key, default)
+
+    def keys(self):
+        with self._lock:
+            return list(self._vals.keys())
+
+    def items(self):
+        with self._lock:
+            return list(self._vals.items())
+
+    def values(self):
+        with self._lock:
+            return list(self._vals.values())
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._vals)
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._vals
+
+    def __repr__(self) -> str:
+        return f"StatsView({dict(self.items())!r})"
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+class Span:
+    """Monotonic-clock phase timing: consecutive ``mark()`` calls define
+    phases.  Callers may inject timestamps captured elsewhere (the request
+    queue stamps pickup/resolve under its own lock) via ``mark(label, t=)``;
+    marks are kept time-ordered so injected stamps slot in correctly."""
+
+    __slots__ = ("name", "marks")
+
+    def __init__(self, name: str, t0: Optional[float] = None) -> None:
+        self.name = name
+        self.marks: List[Tuple[str, float]] = [
+            ("start", time.monotonic() if t0 is None else float(t0))
+        ]
+
+    def mark(self, label: str, t: Optional[float] = None) -> None:
+        self.marks.append((label, time.monotonic() if t is None else float(t)))
+        self.marks.sort(key=lambda m: m[1])
+
+    def phases(self) -> "OrderedDict[str, float]":
+        """label -> seconds since the previous mark (phase ENDING at the
+        label), insertion-ordered by time."""
+        out: "OrderedDict[str, float]" = OrderedDict()
+        for (_, t_prev), (label, t) in zip(self.marks, self.marks[1:]):
+            out[label] = out.get(label, 0.0) + (t - t_prev)
+        return out
+
+    def total(self) -> float:
+        return self.marks[-1][1] - self.marks[0][1]
+
+    def event(self, **extra: Any) -> Dict[str, Any]:
+        """Shape this span as a flight-recorder event."""
+        return {
+            "event": "span",
+            "span": self.name,
+            "total_s": round(self.total(), 6),
+            "phases": {k: round(v, 6) for k, v in self.phases().items()},
+            **extra,
+        }
+
+
+# ---------------------------------------------------------------------------
+# MFU accounting
+# ---------------------------------------------------------------------------
+
+# per-chip dense bf16 peak FLOP/s by device kind substring (lowercased
+# containment match against jax's device_kind).  The cpu entry is a NOMINAL
+# 1 TFLOP/s so CPU smoke runs still produce a finite, comparable-over-time
+# mfu column — it is not a hardware claim (records carry the platform).
+PEAK_FLOPS_BY_DEVICE_KIND: Dict[str, float] = {
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v4": 275e12,
+    "cpu": 1e12,
+}
+
+
+def gpt_param_count(
+    *,
+    vocab_size: int,
+    hidden_size: int,
+    num_layers: int,
+    ffn_hidden_size: Optional[int] = None,
+) -> int:
+    """Analytic matmul-bearing parameter count N for a GPT-family stack:
+    tied token embedding/LM head counted once, per-layer fused-QKV +
+    output projection + 2-matmul MLP with biases, 2 LayerNorms per layer
+    plus the final one.  Position embeddings are excluded (lookup, not
+    matmul) — this is the N in the 6·N·T FLOPs convention."""
+    h = int(hidden_size)
+    ffn = int(ffn_hidden_size or 4 * h)
+    per_layer = (
+        (3 * h * h + 3 * h)      # fused qkv
+        + (h * h + h)            # attention output projection
+        + (h * ffn + ffn)        # mlp up
+        + (ffn * h + h)          # mlp down
+        + 4 * h                  # 2 LayerNorms (scale + bias)
+    )
+    return int(vocab_size) * h + int(num_layers) * per_layer + 2 * h
+
+
+def model_flops_per_token(config: Any = None, *, backward: bool = True,
+                          **fields: int) -> Optional[float]:
+    """Model FLOPs per token for a GPT-family config: ``6·N`` for a
+    training step (1 fwd + 2 bwd matmul passes, PaLM's MFU convention —
+    no remat extra, attention-score FLOPs excluded) or ``2·N`` forward-
+    only (``backward=False``, the decode/serving basis).
+
+    Accepts a config object carrying ``vocab_size``/``hidden_size``/
+    ``num_layers`` (``ffn_hidden_size`` optional) or the same as kwargs;
+    returns None when the fields are missing — non-GPT modules (ViT,
+    protein) simply get no MFU column rather than a wrong one."""
+    def grab(name):
+        if name in fields:
+            return fields[name]
+        return getattr(config, name, None)
+
+    vocab, hidden, layers = (
+        grab("vocab_size"), grab("hidden_size"), grab("num_layers")
+    )
+    if not vocab or not hidden or not layers:
+        return None
+    n = gpt_param_count(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        ffn_hidden_size=grab("ffn_hidden_size"),
+    )
+    return float((6 if backward else 2) * n)
+
+
+def detect_device_kind() -> str:
+    """The backend's device_kind string ('TPU v5e', 'cpu', ...); 'unknown'
+    when no backend is reachable.  Lazy jax import: callers that never ask
+    for a peak (bench parent, lint) stay jax-free."""
+    try:
+        import jax
+
+        return str(jax.devices()[0].device_kind)
+    except Exception:  # noqa: BLE001 — no backend is a valid state here
+        return "unknown"
+
+
+def peak_flops(default: Optional[float] = None,
+               device_kind: Optional[str] = None) -> Optional[float]:
+    """Per-chip peak FLOP/s for the MFU denominator.
+
+    Resolution order: ``PFX_PEAK_FLOPS`` env (loud-parse, > 0) ->
+    ``PEAK_FLOPS_BY_DEVICE_KIND`` by detected device kind -> ``default``
+    (None = caller omits MFU rather than fabricating one)."""
+    env = _env_float("PFX_PEAK_FLOPS", 0.0)
+    if env > 0.0:
+        return env
+    kind = (device_kind if device_kind is not None else detect_device_kind()).lower()
+    for sub, peak in PEAK_FLOPS_BY_DEVICE_KIND.items():
+        if sub in kind:
+            return peak
+    if default is not None:
+        return float(default)
+    logger.warning(
+        f"peak_flops: unknown device kind {kind!r} and no PFX_PEAK_FLOPS "
+        "set; MFU unavailable"
+    )
+    return None
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float, n_devices: int,
+        peak: Optional[float] = None) -> Optional[float]:
+    """Model FLOPs utilization: achieved model FLOP/s over the fleet's
+    aggregate peak.  None when no peak is resolvable."""
+    peak = peak if peak is not None else peak_flops()
+    if not peak or n_devices < 1:
+        return None
+    return tokens_per_sec * flops_per_token / (peak * n_devices)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+DEFAULT_FLIGHT_PATH = "flight_recorder.jsonl"
+
+
+class FlightRecorder:
+    """Bounded ring of recent structured events, dumped as JSONL on the
+    bad-day paths (crash, force-quit, watchdog-degraded, rollback).
+
+    ``record()`` is cheap (deque append under a lock) so hot-ish paths —
+    step records, request spans — can feed it unconditionally; ``dump()``
+    writes atomically (tmp + os.replace) and never raises: it runs inside
+    crash handlers where a secondary failure must not mask the primary."""
+
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        cap = capacity if capacity is not None else _env_int(
+            "PFX_FLIGHT_RECORDER_CAP", 256
+        )
+        self._events: deque = deque(maxlen=cap)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._hook_installed = False
+
+    def record(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            self._seq += 1
+            self._events.append({"seq": self._seq, "ts": time.time(), **event})
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def dump(self, path: Optional[str] = None, reason: str = "") -> Optional[str]:
+        """Write the ring to JSONL (newest last) under a dump header.
+        Path resolution: ``PFX_FLIGHT_RECORDER`` env first (the operator's
+        word wins even over an explicit caller path), then the caller's
+        ``path`` (the engine passes its checkpoint ``output_dir``), then
+        ./flight_recorder.jsonl.  Returns the path, or None when the
+        write failed (logged, never raised — this runs on crash paths)."""
+        path = os.environ.get("PFX_FLIGHT_RECORDER") or path or DEFAULT_FLIGHT_PATH
+        events = self.events()
+        header = {
+            "event": "flight_recorder_dump",
+            "reason": reason,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "events": len(events),
+        }
+        try:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            # pid-unique tmp: concurrent dumpers on shared storage (multi-
+            # host preemption fans out to every process) each write their
+            # own tmp and the atomic replace publishes whole files only —
+            # last writer wins, never a torn interleave
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(header) + "\n")
+                for ev in events:
+                    f.write(json.dumps(ev, default=str) + "\n")
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning(f"flight recorder dump failed: {e}")
+            return None
+        logger.warning(
+            f"flight recorder: {len(events)} event(s) dumped to {path}"
+            + (f" ({reason})" if reason else "")
+        )
+        return path
+
+    def install_excepthook(self, path: Optional[str] = None) -> None:
+        """Chain onto sys.excepthook AND threading.excepthook: an
+        uncaught exception — main thread or not — dumps the ring (reason
+        names the exception) before the normal traceback prints.
+        sys.excepthook alone never fires for worker threads, and the
+        serving process does its real work in them (scheduler, watchdog,
+        HTTP handlers); a watchdog thread dying silently would otherwise
+        leave no postmortem AND no degraded-detection.  ``path`` sets the
+        dump target (tools/train.py passes its checkpoint output_dir;
+        PFX_FLIGHT_RECORDER still wins).  Idempotent per recorder."""
+        if self._hook_installed:
+            return
+        self._hook_installed = True
+        prior = sys.excepthook
+
+        def hook(exc_type, exc, tb):
+            try:
+                self.record({
+                    "event": "crash",
+                    "error": f"{exc_type.__name__}: {exc}",
+                })
+                self.dump(path=path, reason=f"uncaught {exc_type.__name__}")
+            finally:
+                prior(exc_type, exc, tb)
+
+        sys.excepthook = hook
+        prior_thread = threading.excepthook
+
+        def thread_hook(args):
+            try:
+                name = args.thread.name if args.thread else "?"
+                self.record({
+                    "event": "crash",
+                    "thread": name,
+                    "error": f"{args.exc_type.__name__}: {args.exc_value}",
+                })
+                self.dump(
+                    path=path,
+                    reason=f"uncaught {args.exc_type.__name__} "
+                           f"in thread {name}",
+                )
+            finally:
+                prior_thread(args)
+
+        threading.excepthook = thread_hook
+
+
+_flight = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """The process-wide flight recorder."""
+    return _flight

@@ -64,6 +64,10 @@ def test_bench_happy_path_contract():
     assert row["metric"] == "gpt345m_pretrain_throughput_per_chip"
     assert row["value"] > 0
     assert row["platform"] == "cpu"
+    # hardware-normalized fields from the shared telemetry estimator
+    # (6·N per token vs the per-device-kind peak — docs/observability.md)
+    assert row["tokens_per_sec"] > 0
+    assert 0 < row["mfu"] < 1, row
 
 
 @pytest.mark.slow
@@ -120,6 +124,10 @@ def test_bench_decode_happy_path_contract(tmp_path):
         assert set(row) >= {"metric", "value", "unit", "vs_baseline"}
         assert row["value"] > 0
         assert row["platform"] == "cpu"
+        # decode rows are hardware-normalized by the same estimator as
+        # bench.py/the engine, on the forward-only (2·N) basis
+        assert row["tokens_per_sec"] > 0
+        assert 0 < row["mfu"] < 1, row
     # the A/B pair: one overhauled row, one legacy row, same shape keys
     paths = {r["decode_path"] for r in rows.values()}
     assert paths == {"overhauled", "legacy(dense+scan)"}, rows

@@ -128,6 +128,92 @@ def test_profiler_summary_views(tmp_path):
     assert not os.path.exists(os.path.join(log2, "summary_ops.txt"))
 
 
+def test_profiler_trace_event_rows_aggregation(tmp_path):
+    """The chrome-trace fallback aggregation (`_trace_event_rows`, the
+    live path on backends with no per-HLO device stats and no xprof):
+    complete 'X' events aggregate per op name with occurrence counts and
+    summed durations, and the op summary names the fallback source even
+    when the hlo_stats path raises."""
+    from paddlefleetx_tpu.utils.profiler import ProfilerHook
+
+    log_dir = str(tmp_path / "prof")
+    hook = ProfilerHook({"enable": True, "scheduler": [1, 2], "log_dir": log_dir})
+    for step in range(1, 4):
+        (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+        hook.step(step)
+    # rows straight off the captured CPU trace
+    rows = hook._trace_event_rows()
+    assert rows, "CPU trace produced no complete events"
+    for r in rows:
+        assert set(r) == {"op", "category", "occurrences", "total_us", "self_us"}
+        assert r["occurrences"] >= 1 and r["total_us"] >= 0
+        assert r["category"] == "trace" and r["self_us"] == r["total_us"]
+    # force the fallback branch explicitly: hlo_stats raising must degrade
+    # to trace events, never kill the close
+    hook._hlo_stats_rows = lambda: (_ for _ in ()).throw(RuntimeError("no xprof"))
+    hook.close()
+    text = open(os.path.join(log_dir, "summary_ops.txt")).read()
+    assert "trace events" in text.splitlines()[0], text.splitlines()[0]
+
+
+def test_profiler_memory_summary_branches(tmp_path, monkeypatch):
+    """`_write_memory_summary`: the no-`memory_stats()` branch writes the
+    honest pointer at the trace's memory_profile tool; a backend WITH
+    stats writes the sorted per-device key table."""
+    import jax as _jax
+
+    from paddlefleetx_tpu.utils.profiler import ProfilerHook
+
+    class _Dev:
+        def __init__(self, stats):
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+        def __repr__(self):
+            return "StubDevice(cpu:0)"
+
+    hook = ProfilerHook({"enable": False, "log_dir": str(tmp_path / "p")})
+    os.makedirs(hook.log_dir, exist_ok=True)
+
+    monkeypatch.setattr(_jax, "local_devices", lambda: [_Dev(None)])
+    hook._write_memory_summary()
+    path = os.path.join(hook.log_dir, "summary_memory.txt")
+    assert "no memory_stats()" in open(path).read()
+
+    monkeypatch.setattr(
+        _jax, "local_devices",
+        lambda: [_Dev({"bytes_in_use": 123, "peak_bytes_in_use": 456})],
+    )
+    hook._write_memory_summary()
+    text = open(path).read()
+    assert "StubDevice(cpu:0)" in text
+    assert "bytes_in_use" in text and "456" in text
+
+
+def test_profiler_trace_window_feeds_telemetry(tmp_path):
+    """A completed trace window lands on the registry (trace counter +
+    window seconds) and in the flight recorder ring."""
+    from paddlefleetx_tpu.utils import telemetry
+    from paddlefleetx_tpu.utils.profiler import ProfilerHook
+
+    reg = telemetry.get_registry()
+    before = reg.value("pfx_profiler_traces_total")
+    hook = ProfilerHook(
+        {"enable": True, "scheduler": [1, 2], "log_dir": str(tmp_path / "p"),
+         "summary": False}
+    )
+    for step in range(1, 4):
+        (jnp.ones((32, 32)) @ jnp.ones((32, 32))).block_until_ready()
+        hook.step(step)
+    hook.close()
+    assert reg.value("pfx_profiler_traces_total") == before + 1
+    assert reg.value("pfx_profiler_trace_seconds") > 0
+    kinds = [e.get("event") for e in telemetry.get_flight_recorder().events()]
+    assert "profiler_trace_start" in kinds and "profiler_trace_stop" in kinds
+
+
 def test_moe_grad_clip_parity(devices8):
     """GSPMD makes the reference ClipGradForMOEByGlobalNorm
     (optims/grad_clip.py:27-156) a plain global-norm clip: expert params

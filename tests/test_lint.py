@@ -95,3 +95,37 @@ def test_missing_module_docstring_in_package(tmp_path, monkeypatch):
     q = tmp_path / "m.py"
     q.write_text("x = 1\n")
     assert "E9" not in {c for _, _, c, _ in check_file(str(q))}
+
+
+def test_metric_name_lint_undeclared_and_malformed(tmp_path):
+    # undeclared name handed to a registry accessor
+    src = 'reg.counter("pfx_made_up_total").inc()\n'
+    assert "E10" in _lint_src(tmp_path, src)
+    # schema violation (uppercase) at a registry call site
+    src = 'reg.gauge("pfx_BAD_Name").set(1)\n'
+    assert "E10" in _lint_src(tmp_path, src)
+    # a metric-shaped string literal anywhere (e.g. a StatsView mapping)
+    src = 'M = {"requests": "pfx_never_declared_total"}\n'
+    assert "E10" in _lint_src(tmp_path, src)
+
+
+def test_metric_name_lint_declared_names_pass(tmp_path):
+    src = (
+        'reg.counter("pfx_serving_requests_total").inc()\n'
+        'reg.histogram("pfx_request_latency_seconds").observe(0.1)\n'
+        '# exposition suffixes resolve to the declared base name\n'
+        'x = "pfx_request_latency_seconds_bucket"\n'
+        'y = "pfx_serving_requests_total"\n'
+        'print(reg, x, y)\n'
+    )
+    assert "E10" not in _lint_src(tmp_path, src)
+
+
+def test_metric_name_lint_declared_table_parses():
+    # the AST parse of telemetry.METRICS finds the real table
+    import lint as _lint
+
+    _lint._declared_metrics = ...  # reset the cache
+    names = _lint.declared_metrics()
+    assert names and "pfx_serving_requests_total" in names
+    assert all(n.startswith("pfx_") for n in names)

@@ -11,7 +11,12 @@ tools/serve.py) must keep every contract:
   gen_crash   an injected generation crash returns 500 (structured
               gen_error stats on /healthz) while the server keeps serving
   gen_hang    a wedged decode: the watchdog flips /healthz to degraded,
-              queued requests shed honestly, a second SIGTERM force-quits
+              queued requests shed honestly, a second SIGTERM force-quits,
+              and a flight_recorder.jsonl postmortem (watchdog event +
+              recent request spans) lands on disk
+  metrics     GET /metrics returns valid Prometheus text exposition
+              (strict parser, tests/test_telemetry.py) that agrees with
+              /healthz counters taken from the same registry snapshot
 
 Follows tests/test_fault_injection.py conventions: `fault`-marked,
 subprocess-driven, one synthetic tiny-GPT config, persistent XLA compile
@@ -142,11 +147,21 @@ def test_flood_every_request_answered_or_honestly_shed(tmp_path):
     """Concurrent flood against a depth-3 queue: exactly one response per
     request, each in {200, 429, 503}, each within deadline + slack; the
     bounded queue really rejected (429 seen), and /healthz accounting
-    (rejects, latency reservoir, drained queue) adds up."""
+    (rejects, latency reservoir, drained queue) adds up.
+
+    The first traffic batch is wedged for a few seconds via the gen_hang
+    site (warmup_batches="1,2" spends generation requests 1-2, so first
+    traffic is request 3): with the scheduler deterministically busy
+    while the flood lands, the queue MUST fill and reject — without the
+    wedge, a fast warm-cache decode can drain 12 requests through a
+    depth-3 queue without ever refusing one, and the 429 assertion
+    becomes a coin flip (observed flaky at seed)."""
     deadline = 45.0
     proc, port = _start_server(tmp_path, deadline=deadline, depth=3,
                                coalesce=2, shed_slack=3.0,
-                               warmup_batches="1,2")
+                               warmup_batches="1,2",
+                               extra_env={"PFX_FAULT": "gen_hang:3",
+                                          "PFX_FAULT_HANG_S": "4.0"})
     try:
         n = 12
         results = [None] * n
@@ -194,6 +209,58 @@ def test_flood_every_request_answered_or_honestly_shed(tmp_path):
             timeout=30,
         )
         assert code == 400 and "too many prompts" in resp["error"], (code, resp)
+    finally:
+        log = _finish(proc)
+    assert "Traceback" not in log, log[-3000:]
+
+
+def test_metrics_exposition_parses_and_agrees_with_healthz(tmp_path):
+    """GET /metrics on a live server is valid Prometheus text exposition
+    (counter/gauge/histogram lines under the strict parser) and its
+    serving/queue counters agree with /healthz — both endpoints render
+    the SAME locked registry snapshot, so with no traffic between the two
+    scrapes the numbers must be identical."""
+    from test_telemetry import parse_prometheus
+
+    proc, port = _start_server(tmp_path)
+    try:
+        for ids in ([1, 2, 3], [4, 5]):
+            code, _ = _post(port, {"prompt_ids": ids, "max_tokens": 4},
+                            timeout=120)
+            assert code == 200
+        h = _healthz(port)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+
+        metrics, types = parse_prometheus(text)  # strict: raises on any bad line
+        # all three metric kinds present and well-formed
+        assert types["pfx_serving_requests_total"] == "counter"
+        assert types["pfx_http_requests_in_flight"] == "gauge"
+        assert types["pfx_request_latency_seconds"] == "histogram"
+
+        def val(name, **labels):
+            return metrics[name][frozenset(labels.items())]
+
+        # /metrics agrees with the /healthz snapshot taken just before it
+        # (no traffic in between; the scrapes themselves only bump http_*)
+        assert val("pfx_serving_requests_total") == h["requests"]
+        assert val("pfx_serving_tokens_out_total") == h["tokens_out"]
+        assert val("pfx_queue_submitted_total") == h["queue"]["submitted"]
+        assert val("pfx_queue_completed_total") == h["queue"]["completed"]
+        assert val("pfx_queue_depth") == h["queue_depth"] == 0
+        assert val("pfx_http_responses_total", code="200") >= h["counters"]["http_200"]
+        # both POSTs flowed through the span pipeline
+        assert val("pfx_request_latency_seconds_count") == 2
+        assert val("pfx_request_ttft_seconds_count") == 2
+        assert val("pfx_request_decode_seconds_count") == 2
+        assert val("pfx_request_per_token_seconds_count") == 2
+        assert val("pfx_request_latency_seconds_sum") > 0
+        # warmup registered on the shared registry, not a private dict
+        assert val("pfx_serving_warmup_seconds_total") > 0
     finally:
         log = _finish(proc)
     assert "Traceback" not in log, log[-3000:]
@@ -288,11 +355,16 @@ def test_gen_crash_returns_500_server_keeps_serving(tmp_path):
 def test_gen_hang_watchdog_degrades_sheds_and_force_quits(tmp_path):
     """PFX_FAULT=gen_hang:2 wedges the scheduler: the hanging client is
     shed at its deadline (no hung connection), the watchdog flips
-    /healthz to degraded, a queued request sheds before any decode, and
-    SIGTERM escalation (drain, then force-quit) works."""
+    /healthz to degraded, a queued request sheds before any decode,
+    SIGTERM escalation (drain, then force-quit) works, and the flight
+    recorder leaves a postmortem on disk — the watchdog-degraded event
+    plus the recent request spans — without the server ever having a
+    metrics file configured."""
+    flight_path = str(tmp_path / "flight_recorder.jsonl")
     proc, port = _start_server(
         tmp_path, watchdog=2.0, shed_slack=2.0,
-        extra_env={"PFX_FAULT": "gen_hang:2", "PFX_FAULT_HANG_S": "600"},
+        extra_env={"PFX_FAULT": "gen_hang:2", "PFX_FAULT_HANG_S": "600",
+                   "PFX_FLIGHT_RECORDER": flight_path},
     )
     try:
         t0 = time.monotonic()
@@ -347,5 +419,20 @@ def test_gen_hang_watchdog_degrades_sheds_and_force_quits(tmp_path):
         rc = proc.wait(timeout=30)
         assert rc == 130, rc  # force-quit exit, not a clean drain
         assert time.monotonic() - t0 < 15  # immediate, no thread joins
+
+        # flight-recorder postmortem: the watchdog degrade was dumped
+        # while the wedge was live, and the force-quit re-dumped the ring
+        # with everything since — the degrade event AND the shed request
+        # spans must be on disk even though no metrics stream was set
+        events = [json.loads(line) for line in open(flight_path)]
+        assert events[0]["event"] == "flight_recorder_dump"
+        assert events[0]["reason"] == "force_quit", events[0]
+        kinds = [e.get("event") for e in events]
+        assert "watchdog_degraded" in kinds, kinds
+        assert "force_quit" in kinds, kinds
+        spans = [e for e in events if e.get("event") == "span"]
+        assert len(spans) >= 2, events  # both shed requests left spans
+        assert all(e.get("code") == 503 for e in spans), spans
+        assert any("shed" in e.get("phases", {}) for e in spans), spans
     finally:
         _finish(proc)

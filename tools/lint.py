@@ -16,6 +16,13 @@ actually bite:
   E8  mutable default argument (def f(x=[]) / {} / set())
   E9  missing module docstring (package code under paddlefleetx_tpu/ only —
       the reference's docstring-checker analogue, codestyle/ SURVEY §4.3)
+  E10 telemetry metric-name lint: every name passed to a registry
+      `.counter(` / `.gauge(` / `.histogram(` call — and every string
+      literal shaped like a metric name (`^pfx_[a-z0-9_]+$`, exposition
+      suffixes _bucket/_sum/_count allowed) — must be declared in THE ONE
+      `METRICS` table in paddlefleetx_tpu/utils/telemetry.py, so the
+      /metrics namespace cannot fragment the way the per-module stats
+      dicts once did (docs/observability.md)
 
 Suppress a finding with `# noqa` on the offending line.
 Usage: python tools/lint.py [paths...]   (default: the whole repo)
@@ -23,6 +30,7 @@ Usage: python tools/lint.py [paths...]   (default: the whole repo)
 
 import ast
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -30,6 +38,44 @@ DEFAULT_DIRS = [
     "paddlefleetx_tpu", "tools", "tests", "benchmarks", "examples", "tasks",
 ]
 DEFAULT_FILES = ["bench.py", "__graft_entry__.py"]
+
+
+# E10: telemetry metric naming
+_METRIC_RE = re.compile(r"^pfx_[a-z0-9_]+$")
+_EXPOSITION_SUFFIX = re.compile(r"_(bucket|sum|count)$")
+_TELEMETRY_FNS = {"counter", "gauge", "histogram"}
+_declared_metrics = ...  # lazy cache; None = telemetry module unavailable
+
+
+def declared_metrics():
+    """Metric names declared in telemetry.METRICS, parsed from the AST
+    (never imported: lint stays jax-free).  None when the module or its
+    table is missing — the E10 check then degrades to regex-only."""
+    global _declared_metrics
+    if _declared_metrics is not ...:
+        return _declared_metrics
+    path = os.path.join(REPO, "paddlefleetx_tpu", "utils", "telemetry.py")
+    names = None
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in tree.body:
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target] if isinstance(node, ast.AnnAssign) else []
+            )
+            if any(isinstance(t, ast.Name) and t.id == "METRICS" for t in targets):
+                value = node.value
+                if isinstance(value, ast.Dict):
+                    names = {
+                        k.value for k in value.keys
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    }
+                break
+    except (OSError, SyntaxError):
+        names = None
+    _declared_metrics = names
+    return names
 
 
 def iter_py_files(paths):
@@ -156,10 +202,45 @@ def check_file(path):
             if name not in v.used and name not in string_refs:
                 add(lineno, "E2", f"unused import '{shown}'")
 
+    # E10: metric names — call-site check (any name handed to a registry
+    # accessor) + literal check (any metric-shaped string constant)
+    declared = declared_metrics()
+    flagged_metrics = set()
+
+    def _check_metric_name(lineno, name):
+        if (lineno, name) in flagged_metrics:
+            return
+        if not _METRIC_RE.match(name):
+            flagged_metrics.add((lineno, name))
+            add(lineno, "E10",
+                f"metric name '{name}' does not match ^pfx_[a-z0-9_]+$")
+        elif declared is not None and _EXPOSITION_SUFFIX.sub("", name) not in declared and name not in declared:
+            flagged_metrics.add((lineno, name))
+            add(lineno, "E10",
+                f"metric '{name}' not declared in telemetry.METRICS "
+                "(the one namespace table — declare it there)")
+
     for node in ast.walk(tree):
         # E3 bare except
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             add(node.lineno, "E3", "bare 'except:' (catch a class)")
+        # E10 telemetry registry call sites
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _TELEMETRY_FNS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            _check_metric_name(node.args[0].lineno, node.args[0].value)
+        # E10 metric-shaped string literals anywhere
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _METRIC_RE.match(node.value)
+        ):
+            _check_metric_name(node.lineno, node.value)
         # E7 eval/exec
         if (
             isinstance(node, ast.Call)

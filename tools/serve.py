@@ -14,11 +14,21 @@ graceful drain (stop admitting -> answer all admitted work -> exit 0;
 second signal force-quits), and a wedged-generation watchdog that flips
 `/healthz` to degraded.  Operations runbook: docs/serving.md.
 
+Observability (docs/observability.md): every counter rides the unified
+telemetry registry (`utils/telemetry.py`); `GET /metrics` renders it as
+Prometheus text exposition and `/healthz` renders the SAME locked
+snapshot as operator JSON — the two can never disagree.  Each request's
+lifecycle (admission -> queue_wait -> decode -> respond) is recorded as
+a span feeding TTFT / per-token-latency histograms and the crash flight
+recorder, which dumps `flight_recorder.jsonl` (PFX_FLIGHT_RECORDER) on
+watchdog-degraded, force-quit, and uncaught crashes.
+
 Usage:
   python tools/serve.py -c configs/gpt/pretrain_gpt_345M_single.yaml            # REPL
   python tools/serve.py -c ... --port 8000                                       # HTTP
       POST /generate {"prompt": "...", "max_tokens": 64, "deadline_s": 30}
       GET  /healthz
+      GET  /metrics
 """
 
 import argparse
@@ -91,13 +101,61 @@ def plan_request(prompts_ids, max_toks: int, *, bucket: int, context: int):
     return trim, (pbucket, run)
 
 
-def _percentile(sorted_vals, q: float) -> float:
-    """Nearest-rank percentile over an already-sorted list (0 when empty);
-    stdlib-only so /healthz never imports numpy on the hot path."""
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
-    return round(sorted_vals[idx], 4)
+# /healthz "queue" block: healthz key -> registry metric (one snapshot
+# feeds both /metrics and /healthz, so the two endpoints cannot disagree)
+_QUEUE_HEALTH_KEYS = {
+    "submitted": "pfx_queue_submitted_total",
+    "completed": "pfx_queue_completed_total",
+    "batches": "pfx_queue_batches_total",
+    "coalesced_batches": "pfx_queue_coalesced_batches_total",
+    "coalesced_requests": "pfx_queue_coalesced_requests_total",
+    "shed_deadline": "pfx_queue_shed_deadline_total",
+    "rejected_full": "pfx_queue_rejected_full_total",
+    "rejected_closed": "pfx_queue_rejected_closed_total",
+    "gen_errors": "pfx_queue_gen_errors_total",
+}
+
+
+def _record_request_span(reg, recorder, t0, fut, code, tokens=None):
+    """Turn one /generate lifecycle into telemetry: span phases
+    (admission -> queue_wait -> decode -> respond) from the queue's
+    monotonic stamps, TTFT + per-token histograms, and a flight-recorder
+    event so the last N request spans survive into a crash dump.  A
+    request shed before pickup has no decode phase (labeled ``shed``)."""
+    from paddlefleetx_tpu.utils.telemetry import Span
+
+    span = Span("request", t0=t0)
+    times = dict(getattr(fut, "times", {}) or {}) if fut is not None else {}
+    if "enqueued" in times:
+        span.mark("admission", t=times["enqueued"])
+    if "picked" in times:
+        span.mark("queue_wait", t=times["picked"])
+    if "resolved" in times:
+        span.mark("decode" if "picked" in times else "shed",
+                  t=times["resolved"])
+    span.mark("respond")
+    phases = span.phases()
+    if "queue_wait" in phases:
+        reg.histogram("pfx_request_queue_wait_seconds").observe(
+            phases["queue_wait"]
+        )
+    if "decode" in phases:
+        reg.histogram("pfx_request_decode_seconds").observe(phases["decode"])
+        if tokens:
+            reg.histogram("pfx_request_per_token_seconds").observe(
+                phases["decode"] / max(1, tokens)
+            )
+    if "resolved" in times and code == 200:
+        # non-streaming decode: the whole completion lands at once, so
+        # first-token time IS resolution time (an upper bound once a
+        # streaming path exists).  Success-only, like the latency
+        # histogram: a shed request's ~deadline wait is not a "time to
+        # first token" — it delivered none, and letting it in would turn
+        # TTFT p99 into the shed deadline exactly when operators alert
+        reg.histogram("pfx_request_ttft_seconds").observe(
+            max(0.0, times["resolved"] - t0)
+        )
+    recorder.record(span.event(code=code, tokens=tokens))
 
 
 def serve_http(server, port: int, host: str = "127.0.0.1", *,
@@ -105,7 +163,6 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                default_deadline_s: float = 120.0, max_deadline_s: float = 600.0,
                shed_slack_s: float = 2.0,
                watchdog_s: float = 300.0, max_tokens_cap: int = 0):
-    import collections
     import signal
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -116,6 +173,15 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         QueueFull,
         RequestQueue,
     )
+    from paddlefleetx_tpu.utils.telemetry import (
+        get_flight_recorder,
+        get_registry,
+    )
+
+    reg = get_registry()
+    recorder = get_flight_recorder()
+    # a crash anywhere in the serving process leaves a postmortem ring
+    recorder.install_excepthook()
 
     cap = max_tokens_cap or int(
         server.cfg.get("Generation", {}).get("max_tokens_cap", 0) or 0
@@ -135,14 +201,18 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     )
 
     # in-flight /generate requests (admission + wait + response write);
-    # /healthz surfaces it so an operator tells "busy" from "wedged"
-    in_flight = {"n": 0}
-    in_flight_lock = threading.Lock()
-    # health state flags + HTTP outcome counters + latency reservoir
+    # /healthz surfaces it so an operator tells "busy" from "wedged".
+    # All HTTP accounting lives on the telemetry registry: /healthz and
+    # /metrics read ONE locked snapshot instead of the old half-locked
+    # Counter + latency deque (the reservoir rides the latency histogram)
+    in_flight_gauge = reg.gauge("pfx_http_requests_in_flight")
+    client_gone = reg.counter("pfx_http_client_gone_total")
+    latency_hist = reg.histogram("pfx_request_latency_seconds")
+    draining_gauge = reg.gauge("pfx_serve_draining")
+    degraded_gauge = reg.gauge("pfx_serve_degraded")
+    # health state flags (process-local booleans drive control flow; the
+    # gauges mirror them for scrapes)
     flags = {"draining": False, "degraded": False}
-    counters = collections.Counter()
-    counters_lock = threading.Lock()
-    latencies = collections.deque(maxlen=256)
     stop_event = threading.Event()
 
     class Handler(BaseHTTPRequestHandler):
@@ -151,14 +221,13 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         def log_message(self, *a):  # route through our logger instead
             pass
 
-        def _json(self, code: int, obj, headers=None):
+        def _send(self, code: int, body: bytes, ctype: str, headers=None):
             # disconnect-tolerant: a client that hung up while we write
             # (including on an error path) is counted as client_gone —
             # never a stack trace, never a skewed http_* counter
             try:
-                body = json.dumps(obj).encode()
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
@@ -167,31 +236,79 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             except (BrokenPipeError, ConnectionResetError, TimeoutError):
                 # TimeoutError: the handler socket timeout fired while a
                 # stalled client refused our bytes — same client_gone class
-                with counters_lock:
-                    counters["client_gone"] += 1
+                client_gone.inc()
             else:
-                with counters_lock:
-                    counters[f"http_{code}"] += 1
+                reg.counter("pfx_http_responses_total", code=str(code)).inc()
+
+        def _json(self, code: int, obj, headers=None):
+            self._send(code, json.dumps(obj).encode(), "application/json",
+                       headers)
 
         def do_GET(self):
             if self.path == "/healthz":
+                # ONE registry snapshot renders the whole health view —
+                # the same snapshot function /metrics exposes, so the two
+                # endpoints agree and no field is read outside a lock
+                snap = reg.snapshot()
                 state = ("draining" if flags["draining"]
                          else "degraded" if flags["degraded"] else "ok")
-                with counters_lock:
-                    counts = dict(counters)
-                    lat = sorted(latencies)
+                counts = {}
+                for lab, v in snap.get(
+                    "pfx_http_responses_total", {"values": []}
+                )["values"]:
+                    counts[f"http_{lab.get('code', '?')}"] = int(v)
+                gone = int(reg.value("pfx_http_client_gone_total", snap=snap))
+                if gone:
+                    counts["client_gone"] = gone
+                lat = reg.value(
+                    "pfx_request_latency_seconds",
+                    default={"p50": 0.0, "p99": 0.0}, snap=snap,
+                )
+                # serving numerics come from the SAME snapshot (not a
+                # second read of server.stats) so /healthz and /metrics
+                # can never disagree; instance-local extras (last_error,
+                # warmup_s) overlay from the stats view
+                serving_keys = {
+                    "requests": ("pfx_serving_requests_total", int),
+                    "tokens_out": ("pfx_serving_tokens_out_total", int),
+                    "time_s": ("pfx_serving_gen_seconds_total", float),
+                    "traces": ("pfx_serving_traces_total", int),
+                    "gen_errors": ("pfx_serving_gen_errors_total", int),
+                    "last_latency_s":
+                        ("pfx_serving_last_latency_seconds", float),
+                }
+                serving_view = {
+                    k: v for k, v in server.stats.items()
+                    if k not in serving_keys
+                }
+                serving_view.update({
+                    k: cast(reg.value(m, snap=snap))
+                    for k, (m, cast) in serving_keys.items()
+                })
                 self._json(200, {
                     "ok": not flags["degraded"],
                     "state": state,
-                    "in_flight": in_flight["n"],
-                    "queue_depth": queue.depth(),
-                    "busy_s": round(queue.busy_seconds(), 3),
-                    "queue": dict(queue.stats),
+                    "in_flight": int(reg.value(
+                        "pfx_http_requests_in_flight", snap=snap)),
+                    "queue_depth": int(reg.value("pfx_queue_depth",
+                                                 snap=snap)),
+                    "busy_s": round(
+                        reg.value("pfx_queue_busy_seconds", snap=snap), 3),
+                    "queue": {
+                        k: int(reg.value(m, snap=snap))
+                        for k, m in _QUEUE_HEALTH_KEYS.items()
+                    },
                     "counters": counts,
-                    "latency_p50_s": _percentile(lat, 0.50),
-                    "latency_p99_s": _percentile(lat, 0.99),
-                    **server.stats,
+                    "latency_p50_s": round(lat["p50"], 4),
+                    "latency_p99_s": round(lat["p99"], 4),
+                    **serving_view,
                 })
+            elif self.path == "/metrics":
+                # Prometheus text exposition of the same registry snapshot
+                self._send(
+                    200, reg.render_prometheus().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
             else:
                 self._json(404, {"error": "unknown path"})
 
@@ -239,8 +356,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         def do_POST(self):
             if self.path != "/generate":
                 return self._json(404, {"error": "unknown path"})
-            with in_flight_lock:
-                in_flight["n"] += 1
+            in_flight_gauge.add(1)
             try:
                 t0 = time.monotonic()
                 n = int(self.headers.get("Content-Length", 0))
@@ -300,22 +416,27 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     rows = fut.result(timeout=deadline_s + shed_slack_s)
                 except TimeoutError:
                     queue.try_remove(fut)  # shed it if still queued
+                    _record_request_span(reg, recorder, t0, fut, 503)
                     return self._json(
                         503,
                         {"error": f"deadline {deadline_s:g}s exceeded"},
                         headers={"Retry-After": "1"},
                     )
                 except DeadlineExceeded as e:
+                    _record_request_span(reg, recorder, t0, fut, 503)
                     return self._json(
                         503, {"error": str(e)}, headers={"Retry-After": "1"}
                     )
                 except QueueClosed as e:  # flushed by a forced shutdown
+                    _record_request_span(reg, recorder, t0, fut, 503)
                     return self._json(
                         503, {"error": str(e)}, headers={"Retry-After": "5"}
                     )
                 except ValueError as e:  # bad request that got past checks
+                    _record_request_span(reg, recorder, t0, fut, 400)
                     return self._json(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — report, keep serving
+                    _record_request_span(reg, recorder, t0, fut, 500)
                     return self._json(500, {"error": str(e)})
                 if mode in ("prompt", "prompts"):
                     texts = [server.tokenizer.decode(r) for r in rows]
@@ -325,14 +446,16 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     payload = ({"completion_ids": rows[0]}
                                if mode == "prompt_ids"
                                else {"completions_ids": rows})
-                with counters_lock:
-                    latencies.append(time.monotonic() - t0)
+                latency_hist.observe(time.monotonic() - t0)
+                _record_request_span(
+                    reg, recorder, t0, fut, 200,
+                    tokens=sum(len(r) for r in rows),
+                )
                 return self._json(200, payload)
             except Exception as e:  # noqa: BLE001 — last-resort guard
                 return self._json(500, {"error": str(e)})
             finally:
-                with in_flight_lock:
-                    in_flight["n"] -= 1
+                in_flight_gauge.add(-1)
 
     class Server(ThreadingHTTPServer):
         # NON-daemon handler threads: socketserver only tracks (and
@@ -349,8 +472,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             exc = sys.exc_info()[1]
             if isinstance(exc, (BrokenPipeError, ConnectionResetError,
                                 TimeoutError)):
-                with counters_lock:
-                    counters["client_gone"] += 1
+                client_gone.inc()
                 return
             super().handle_error(request, client_address)
 
@@ -364,16 +486,28 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             busy = queue.busy_seconds()
             if busy > watchdog_s and not flags["degraded"]:
                 flags["degraded"] = True
+                degraded_gauge.set(1)
                 print(
                     f"WATCHDOG: generation wedged for {busy:.0f}s "
                     f"(budget {watchdog_s:.0f}s); /healthz degraded",
                     flush=True,
                 )
+                # postmortem while the wedge is live: the dump carries
+                # the degrade event plus the last N request spans, so a
+                # later kill -9 still leaves evidence on disk
+                recorder.record({
+                    "event": "watchdog_degraded",
+                    "busy_s": round(busy, 3),
+                    "budget_s": watchdog_s,
+                })
+                recorder.dump(reason="watchdog_degraded")
             elif flags["degraded"] and busy < watchdog_s:
                 # recovered: the wedged generation finished.  Compare
                 # against the budget, not exact idle — under a steady
                 # backlog a 1 Hz sampler may never catch busy == 0
                 flags["degraded"] = False
+                degraded_gauge.set(0)
+                recorder.record({"event": "watchdog_recovered"})
                 print("WATCHDOG: generation recovered; /healthz ok",
                       flush=True)
 
@@ -386,6 +520,9 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         for sig, h in orig_handlers.items():
             signal.signal(sig, h)
         flags["draining"] = True
+        draining_gauge.set(1)
+        recorder.record({"event": "drain_start", "signum": signum,
+                         "queued": queue.depth()})
         print(
             f"signal {signum}: draining — admission closed, "
             f"{queue.depth()} queued request(s) will finish "
@@ -425,6 +562,10 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         # handler threads — one blocked on a wedged decode would hold
         # the process for up to max_deadline + slack instead of quitting.
         print("force-quit on second interrupt", flush=True)
+        # last act before the hard exit: the flight recorder ring (request
+        # spans, watchdog events, the drain attempt) becomes a postmortem
+        recorder.record({"event": "force_quit", "signum": int(signal.SIGINT)})
+        recorder.dump(reason="force_quit")
         os._exit(130)
     finally:
         stop_event.set()

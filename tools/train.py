@@ -28,6 +28,19 @@ def main(argv=None):
     cfg = get_config(args.config, overrides=args.override)
     advertise()
 
+    # crash postmortem: an uncaught exception dumps the flight recorder
+    # ring (recent step records, data_skips, rollback/preempt events) to
+    # flight_recorder.jsonl (PFX_FLIGHT_RECORDER) before the traceback —
+    # no longer dependent on Engine.metrics_file being configured
+    from paddlefleetx_tpu.utils.telemetry import get_flight_recorder
+
+    get_flight_recorder().install_excepthook(
+        path=os.path.join(
+            cfg.Engine.save_load.get("output_dir", "./output"),
+            "flight_recorder.jsonl",
+        )
+    )
+
     mesh = init_dist_env(cfg)
     module = build_module(cfg)
 
