@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs bench
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged bench
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -31,7 +31,8 @@ MID_EXTRA = tests/test_engine.py tests/test_generation.py tests/test_moe.py \
             tests/test_ernie.py tests/test_t5.py tests/test_vit.py \
             tests/test_vision.py tests/test_auto_tune.py tests/test_check.py \
             tests/test_compression_profiler.py tests/test_hf_convert.py \
-            tests/test_long_context.py
+            tests/test_long_context.py tests/test_paged_cache.py \
+            tests/test_continuous_batching.py
 test-mid:
 	python -m pytest $(FAST_FILES) $(MID_EXTRA) -q -m "not slow" -x
 	python -m pytest "tests/test_pipeline.py::test_pipeline_1f1b_train_loss_and_grads[2-extra1-4-1]" -q
@@ -73,6 +74,14 @@ test-data-drill:
 test-obs:
 	python -m pytest tests/test_telemetry.py tests/test_serving.py tests/test_request_queue.py -q -m "not slow"
 	python -m pytest tests/test_serve_drills.py -q -k "metrics or gen_hang"
+
+# paged-serving gate: block allocator + paged-attention kernel units,
+# the continuous-batching engine/scheduler parity + eviction suite, and
+# the subprocess drills through tools/serve.py --scheduler continuous
+# (docs/serving.md scheduler section; drills reuse the warm
+# tests/.jax_cache like every other drill family)
+test-paged:
+	python -m pytest tests/test_paged_cache.py tests/test_continuous_batching.py tests/test_paged_drills.py -q
 
 bench:
 	python benchmarks/run_benchmark.py

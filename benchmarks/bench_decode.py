@@ -45,6 +45,12 @@ OUT_PATH = os.environ.get(
     "PFX_DECODE_RESULTS", os.path.join(ROOT, "benchmarks", "results_decode.jsonl")
 )
 
+# BENCH_DEC_DTYPE: bf16 is the honest chip bench dtype (near-tie argmax
+# flips between schedulers are counted in greedy_divergent_rows, not
+# hidden); the CPU contract smoke forces float32, where greedy
+# continuous-vs-coalesce divergence must be exactly ZERO
+DTYPE = os.environ.get("BENCH_DEC_DTYPE", "bfloat16")
+
 # case -> (batch, decode_strategy, legacy).  top_p 0.9 matches the
 # reference's default nucleus setting (projects/gpt/docs generation
 # configs).  ``*_legacy`` cases re-run the same shape with
@@ -61,6 +67,11 @@ CASES = {
     "b32_topp": (32, "sampling", False),
     "b32_topp_legacy": (32, "sampling", True),
     "serving": (None, None, False),  # GenerationServer bucketed-batch traffic
+    # staggered-arrival A/B: the SAME fixed-seed Poisson-ish request
+    # trace through the continuous-batching scheduler vs the PR 3
+    # coalescer — emits TWO rows (continuous + coalesce) reporting
+    # delivered tokens/s and p99 TTFT, the head-of-line-blocking evidence
+    "staggered": (None, None, False),
 }
 
 # env spellings of the two decode paths (read at trace time).  BOTH are
@@ -79,9 +90,18 @@ def _emit(row: dict) -> None:
         f.write(line + "\n")
 
 
+def _metrics_for(name: str) -> list:
+    """Metric names a case emits (staggered emits its A/B pair)."""
+    if name == "serving":
+        return ["gpt345m_serving_bucketed"]
+    if name == "staggered":
+        return ["gpt345m_decode_staggered_continuous",
+                "gpt345m_decode_staggered_coalesce"]
+    return [f"gpt345m_decode_{name}"]
+
+
 def _metric(name: str) -> str:
-    return ("gpt345m_serving_bucketed" if name == "serving"
-            else f"gpt345m_decode_{name}")
+    return _metrics_for(name)[0]
 
 
 def _parse_cases(cases_arg: str) -> list:
@@ -119,7 +139,7 @@ def _gpt_cfg(args):
         num_attention_heads=16,
         max_position_embeddings=args.prompt + args.dec,
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
-        dtype="bfloat16",
+        dtype=DTYPE,
     )
 
 
@@ -177,34 +197,7 @@ def run_serving_case(args) -> dict:
     import jax
     import numpy as np
 
-    from paddlefleetx_tpu.core.module import build_module
-    from paddlefleetx_tpu.core.serving import GenerationServer
-    from paddlefleetx_tpu.parallel.env import init_dist_env
-    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
-
-    raw = {
-        "Global": {"global_batch_size": 8, "seed": 7},
-        "Engine": {"mix_precision": {"enable": False},
-                   "save_load": {"save_steps": 0}},
-        "Model": {
-            "module": "GPTModule",
-            "vocab_size": 50304, "hidden_size": args.hidden,
-            "num_layers": args.layers, "num_attention_heads": 16,
-            "max_position_embeddings": args.prompt + args.dec,
-            "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
-            "dtype": "bfloat16",
-        },
-        "Distributed": {},
-        "Optimizer": {"name": "FusedAdamW",
-                      "lr": {"name": "Constant", "learning_rate": 1e-4}},
-        "Generation": {"max_dec_len": args.dec, "decode_strategy": "sampling",
-                       "top_p": 0.9, "pad_to_multiple": args.prompt,
-                       "eos_token_id": 50256, "pad_token_id": 0},
-    }
-    cfg = process_configs(AttrDict.from_nested(raw), num_devices=jax.device_count())
-    mesh = init_dist_env(cfg)
-    module = build_module(cfg)
-    server = GenerationServer(cfg, mesh, module)
+    server = _serving_server(args)  # sampling(top_p=0.9), the shared cfg
 
     rng = np.random.default_rng(0)
     # mixed client batch sizes -> power-of-two buckets 8 and 32; two
@@ -241,9 +234,214 @@ def run_serving_case(args) -> dict:
         "strategy": "sampling(top_p=0.9)",
         "decode_path": "overhauled",
         "jit_traces": server.stats.get("traces"),
-        **_mfu_fields(module.config, computed / dt / n_dev),
+        **_mfu_fields(server.module.config, computed / dt / n_dev),
         "platform": jax.default_backend(),
     }
+
+
+def _serving_server(args, *, greedy: bool = False):
+    """One tiny-or-real GenerationServer for the serving/staggered cases."""
+    import jax
+
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.core.serving import GenerationServer
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    raw = {
+        "Global": {"global_batch_size": 8, "seed": 7},
+        "Engine": {"mix_precision": {"enable": False},
+                   "save_load": {"save_steps": 0}},
+        "Model": {
+            "module": "GPTModule",
+            "vocab_size": 50304, "hidden_size": args.hidden,
+            "num_layers": args.layers, "num_attention_heads": 16,
+            "max_position_embeddings": args.prompt + args.dec,
+            "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
+            "dtype": DTYPE,
+        },
+        "Distributed": {},
+        "Optimizer": {"name": "FusedAdamW",
+                      "lr": {"name": "Constant", "learning_rate": 1e-4}},
+        "Generation": {
+            "max_dec_len": args.dec,
+            "decode_strategy": "greedy_search" if greedy else "sampling",
+            "top_p": 0.9, "pad_to_multiple": args.prompt,
+            "eos_token_id": 50256, "pad_token_id": 0,
+        },
+    }
+    cfg = process_configs(AttrDict.from_nested(raw),
+                          num_devices=jax.device_count())
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    return GenerationServer(cfg, mesh, module)
+
+
+def _staggered_trace(n: int, mean_gap_s: float):
+    """Fixed-seed Poisson-ish arrival offsets (exponential inter-arrival
+    gaps, cumulative) — deterministic across runs, no wall-clock
+    randomness, per the bench-contract rules."""
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    gaps = rng.exponential(mean_gap_s, size=n)
+    gaps[0] = 0.0
+    return np.cumsum(gaps)
+
+
+def _drive_staggered(submit, offsets, prompts, max_new):
+    """Replay one arrival trace against a scheduler ``submit`` callable;
+    returns (per-request TTFT seconds, per-request output rows, wall
+    seconds).  TTFT here is submit->resolved: the serving definition for
+    a non-streaming decode (tools/serve.py span semantics)."""
+    import threading
+
+    n = len(prompts)
+    ttft = [None] * n
+    outs = [None] * n
+    errs = [None] * n
+    t0 = time.perf_counter()
+
+    def worker(i):
+        time.sleep(max(0.0, offsets[i] - (time.perf_counter() - t0)))
+        t_sub = time.perf_counter()
+        try:
+            fut = submit([prompts[i]], max_new)
+            rows = fut.result(timeout=600)
+            ttft[i] = time.perf_counter() - t_sub
+            outs[i] = rows[0]
+        except Exception as e:  # noqa: BLE001 — recorded, parent stays honest
+            errs[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    bad = [e for e in errs if e is not None]
+    if bad:
+        raise RuntimeError(f"{len(bad)}/{n} staggered requests failed: {bad[0]}")
+    return ttft, outs, wall
+
+
+def run_staggered_case(args) -> list:
+    """Continuous-vs-coalesce under the SAME staggered arrival trace.
+
+    N single-prompt greedy requests arrive at fixed-seed Poisson-ish
+    offsets scaled to ~25% of a single warm decode: most arrivals land
+    while an earlier decode is mid-flight — exactly the head-of-line
+    case iteration-level scheduling exists for.  The coalescer can only
+    batch requests that are WAITING together, so late arrivals eat whole
+    decode windows; the continuous scheduler admits them at the next
+    step boundary.  Both paths deliver token-identical greedy output
+    (asserted: the A/B is fair or the row is invalid)."""
+    import jax
+    import numpy as np
+
+    from paddlefleetx_tpu.core.continuous_batching import (
+        ContinuousScheduler,
+        PagedDecodeEngine,
+    )
+    from paddlefleetx_tpu.core.request_queue import RequestQueue
+
+    from bench import knob_env
+
+    n_req = int(os.environ.get("BENCH_STAGGER_N", 6))
+    gap_frac = float(os.environ.get("BENCH_STAGGER_GAP", 0.5))
+    server = _serving_server(args, greedy=True)
+    rng = np.random.default_rng(1)
+    prompts = [
+        rng.integers(1, 50304, args.prompt).tolist() for _ in range(n_req)
+    ]
+
+    rows = []
+    with knob_env(_OVERHAUL_ENV):
+        # calibrate: one warm single-request decode bounds the gap scale
+        server.generate_ids([prompts[0]], max_dec_len=args.dec)
+        t0 = time.perf_counter()
+        ref = [server.generate_ids([p], max_dec_len=args.dec)[0]
+               for p in prompts]
+        t_one = (time.perf_counter() - t0) / n_req
+        offsets = _staggered_trace(n_req, mean_gap_s=gap_frac * t_one)
+
+        # -- continuous: iteration-level admission --------------------
+        engine = PagedDecodeEngine(server, max_batch=max(8, n_req))
+        sched = ContinuousScheduler(engine, max_depth=2 * n_req)
+        sched.warmup([args.prompt])
+        sched.start()
+        ttft_cb, outs_cb, wall_cb = _drive_staggered(
+            sched.submit, offsets, prompts, args.dec
+        )
+        sched.shutdown(timeout=60)
+        # fairness: both paths must DELIVER the same token counts or the
+        # tokens/s A/B is invalid.  Exact token identity is the f32 test
+        # contract (tests/test_continuous_batching.py); the bench model
+        # runs bf16 where random-init logits carry near-ties that flip
+        # argmax between float-equivalent summation orders — count the
+        # divergent rows honestly instead of failing the row
+        if [len(o) for o in outs_cb] != [len(r) for r in ref]:
+            raise RuntimeError(
+                "continuous staggered DELIVERED COUNTS diverged from the "
+                "sequential reference — the tokens/s A/B would be unfair"
+            )
+        divergent = sum(1 for a, b in zip(outs_cb, ref) if a != b)
+        toks_cb = sum(len(o) for o in outs_cb)
+
+        # -- coalesce: the PR 3 queue over the same server -------------
+        # warm every power-of-two batch bucket a coalesced burst can land
+        # on (exactly what tools/serve.py does at boot) so the A/B
+        # measures scheduling, not a mid-traffic compile
+        b = 1
+        while b <= 8:
+            server.generate_ids([prompts[0]] * b, max_dec_len=args.dec)
+            b *= 2
+        queue = RequestQueue(
+            lambda ps, mx: server.generate_ids(ps, max_dec_len=mx),
+            max_depth=2 * n_req, max_coalesce=8,
+        )
+        queue.start()
+        ttft_co, outs_co, wall_co = _drive_staggered(
+            lambda ps, mx: queue.submit(
+                ps, mx, coalesce_key=(args.prompt, args.dec)
+            ),
+            offsets, prompts, args.dec,
+        )
+        queue.shutdown(timeout=60)
+        toks_co = sum(len(o) for o in outs_co)
+
+    n_dev = jax.device_count()
+
+    def row(metric, ttft, toks, wall, extra):
+        r = {
+            "metric": metric, "value": round(toks / wall / n_dev, 1),
+            "unit": "delivered new tokens/s/chip (staggered arrivals)",
+            "vs_baseline": None,
+            "arrivals": n_req, "prompt_len": args.prompt,
+            "dec_len": args.dec,
+            "mean_gap_s": round(float(gap_frac * t_one), 4),
+            "single_decode_s": round(float(t_one), 4),
+            "p50_ttft_s": round(float(np.quantile(ttft, 0.5)), 4),
+            "p99_ttft_s": round(float(np.quantile(ttft, 0.99)), 4),
+            "strategy": "greedy_search",
+            "decode_path": "overhauled",
+            **_mfu_fields(server.module.config, toks / wall / n_dev),
+            "platform": jax.default_backend(),
+        }
+        r.update(extra)
+        return r
+
+    rows.append(row(
+        "gpt345m_decode_staggered_continuous", ttft_cb, toks_cb, wall_cb,
+        {"scheduler": "continuous", "jit_traces": engine.stats["traces"],
+         "steps": engine.stats["steps"],
+         "greedy_divergent_rows": divergent},
+    ))
+    rows.append(row(
+        "gpt345m_decode_staggered_coalesce", ttft_co, toks_co, wall_co,
+        {"scheduler": "coalesce"},
+    ))
+    return rows
 
 
 def _parent(argv) -> int:
@@ -259,10 +457,11 @@ def _parent(argv) -> int:
 
     def emit_missing(seen, reason):
         for name in cases:
-            if _metric(name) not in seen:
-                _emit({"metric": _metric(name), "value": 0.0,
-                       "unit": f"new tokens/s/chip ({reason})",
-                       "vs_baseline": None})
+            for metric in _metrics_for(name):
+                if metric not in seen:
+                    _emit({"metric": metric, "value": 0.0,
+                           "unit": f"new tokens/s/chip ({reason})",
+                           "vs_baseline": None})
 
     return run_child_with_honest_fallback(
         [sys.executable, os.path.abspath(__file__), "--child",
@@ -286,25 +485,30 @@ def _child(argv) -> None:
     cases = _parse_cases(args.cases)
     if platform in ("", "tpu", "axon") and not wait_for_backend():
         for name in cases:
-            _emit({"metric": _metric(name), "value": 0.0,
-                   "unit": "new tokens/s/chip (tpu backend unreachable)",
-                   "vs_baseline": None})
+            for metric in _metrics_for(name):
+                _emit({"metric": metric, "value": 0.0,
+                       "unit": "new tokens/s/chip (tpu backend unreachable)",
+                       "vs_baseline": None})
         return
 
     params_cache: dict = {}
     for name in cases:
         try:
             if name == "serving":
-                row = run_serving_case(args)
+                rows = [run_serving_case(args)]
+            elif name == "staggered":
+                rows = run_staggered_case(args)
             else:
-                row = run_decode_case(name, args, params_cache)
+                rows = [run_decode_case(name, args, params_cache)]
         except Exception as e:  # noqa: BLE001 — an OOM on b32 must not
             # abort the remaining cases
             traceback.print_exc(file=sys.stderr)
-            row = {"metric": _metric(name), "value": 0.0,
-                   "unit": f"new tokens/s/chip ({type(e).__name__})",
-                   "vs_baseline": None}
-        _emit(row)
+            rows = [{"metric": metric, "value": 0.0,
+                     "unit": f"new tokens/s/chip ({type(e).__name__})",
+                     "vs_baseline": None}
+                    for metric in _metrics_for(name)]
+        for row in rows:
+            _emit(row)
 
 
 def _argparser():
@@ -312,7 +516,8 @@ def _argparser():
     ap.add_argument(
         "--cases",
         default="b8_greedy,b8_greedy_legacy,b8_topp,b8_topp_legacy,"
-                "b32_greedy,b32_greedy_legacy,b32_topp,b32_topp_legacy,serving",
+                "b32_greedy,b32_greedy_legacy,b32_topp,b32_topp_legacy,"
+                "serving,staggered",
     )
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--dec", type=int, default=256)

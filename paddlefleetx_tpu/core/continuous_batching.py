@@ -1,0 +1,810 @@
+"""Continuous batching: iteration-level scheduling over a paged KV cache.
+
+The PR 3 coalescer (`core/request_queue.py`) merges requests that happen
+to be WAITING together — a request arriving one token after a decode
+started waits the entire decode (head-of-line blocking).  Orca's
+iteration-level scheduling (Yu et al., OSDI 2022) fixes that by making
+the decode STEP the scheduling unit: at every step boundary the running
+batch can admit new rows (prefill-on-admit) and retire finished or shed
+ones.  vLLM's PagedAttention (Kwon et al., SOSP 2023) supplies the
+memory model that makes mid-flight membership cheap: each row owns a
+block table into a shared arena (`core/paged_cache.py`), so admission
+allocates blocks, eviction frees them, and no row pays another row's
+length.
+
+Two layers here:
+
+  - :class:`PagedDecodeEngine` — the device side: owns the arena
+    (`PagedPools`), the per-slot row state, and the compiled
+    (prefill, step) functions.  ONE fixed-shape step per
+    (batch capacity, table-width bucket): batch capacity is static,
+    table width buckets to the next power of two of the widest active
+    row's allocation (which only changes at admit/evict), so the
+    retrace count is bounded by the bucket count and counted in
+    ``stats["traces"]`` exactly like `core/serving.py`.
+  - :class:`ContinuousScheduler` — the host side: the same admission
+    surface as :class:`~paddlefleetx_tpu.core.request_queue.RequestQueue`
+    (bounded ``submit`` -> 429/503, deadlines, ``try_remove``, graceful
+    ``close``/``join`` drain, ``busy_seconds`` wedge probe) so
+    `tools/serve.py` swaps schedulers behind ``--scheduler`` without
+    touching the HTTP layer.  Its loop runs one iteration per decode
+    step: shed expired waiting entries, EVICT expired active rows
+    (mid-decode — their blocks return to the pool immediately), admit
+    from the queue head while slots and blocks allow, then step.
+
+Greedy outputs are token-identical to the sequential/coalesced path
+(same logits-processor chain per row, per-row positions equal to the
+contiguous path's real-token positions); sampling rows draw from a
+per-step engine subkey — deterministic, but a different stream than the
+contiguous path's.  Every PR 2/3 contract holds: admission bounds,
+deadline shed (now also MID-decode via eviction), graceful drain, and
+drop-donated-state-on-error (a step failure resets the arena rather
+than ever reusing donation-invalidated pools).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddlefleetx_tpu.core.paged_cache import (
+    BlockPoolExhausted,
+    NULL_BLOCK,
+    PagedCacheManager,
+    blocks_for,
+    kv_block_size,
+)
+from paddlefleetx_tpu.core.request_queue import (
+    DeadlineExceeded,
+    QueueClosed,
+    QueueFull,
+    RequestFuture,
+)
+from paddlefleetx_tpu.utils.log import logger
+from paddlefleetx_tpu.utils.resilience import maybe_fire
+from paddlefleetx_tpu.utils.telemetry import StatsView, get_registry
+
+
+def _pow2_at_least(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class ArenaReset(RuntimeError):
+    """A donating dispatch failed and the arena was rebuilt: every row
+    that was live died with it.  ``dead_rows`` lets the scheduler fail
+    exactly the affected requests; the original failure is chained as
+    ``__cause__``."""
+
+    def __init__(self, msg: str, dead_rows: List["_Row"]) -> None:
+        super().__init__(msg)
+        self.dead_rows = dead_rows
+
+
+@dataclasses.dataclass(eq=False)
+class _Row:
+    """One active decode row (slot) in the running batch."""
+
+    seq_id: int
+    entry: "_CBEntry"
+    row_idx: int  # index into the entry's prompts
+    prompt_len: int
+    max_new: int
+    table: List[int]
+    tokens: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass(eq=False)
+class _CBEntry:
+    """One admitted client request (1..n prompts, answered atomically)."""
+
+    prompts: List[List[int]]
+    max_new: int
+    deadline: Optional[float]
+    future: RequestFuture
+    enqueued_at: float
+    next_row: int = 0  # rows [0, next_row) admitted so far
+    done_rows: int = 0
+    results: List[Optional[List[int]]] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        self.results = [None] * len(self.prompts)
+
+
+class PagedDecodeEngine:
+    """Device-side continuous-batching engine over a GenerationServer's
+    params/mesh/config.  Host code drives it one decode step at a time;
+    all compiled shapes are bucketed and counted (``stats["traces"]``).
+
+    The arena pools are DONATED through both compiled entry points
+    (prefill writes blocks, the step writes one slot per row): any
+    exception after a donating dispatch leaves the pools
+    donation-invalidated, so :meth:`reset` rebuilds the arena and the
+    caller fails the affected requests — never reuse a maybe-deleted
+    buffer (the `core/serving.py` drop-on-error contract).
+    """
+
+    def __init__(self, server, *, max_batch: int = 8, block: int = 0,
+                 num_blocks: int = 0) -> None:
+        from paddlefleetx_tpu.models.gpt.generation import init_paged_pools
+        from paddlefleetx_tpu.parallel.mesh import data_parallel_world
+
+        self.server = server
+        self.mcfg = server.module.config
+        self.gen = server.gen
+        self.ctx = server.ctx
+        self.mesh = server.mesh
+        self.bucket = server.bucket
+        self.block = kv_block_size(block)
+        context = int(self.mcfg.max_position_embeddings)
+        self.max_row_blocks = blocks_for(context, self.block)
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        dpw = data_parallel_world(self.mesh)
+        # fixed batch capacity (dp-world multiple): the step's batch dim
+        # NEVER changes shape, so traffic mix cannot key batch retraces
+        self.capacity = -(-int(max_batch) // dpw) * dpw
+        if num_blocks <= 0:
+            num_blocks = self.capacity * self.max_row_blocks + 1
+        self.cache = PagedCacheManager(num_blocks, self.block)
+        self.pools = init_paged_pools(self.mcfg, num_blocks, self.block)
+
+        import jax
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        self._jax = jax
+        vocab = int(self.mcfg.vocab_size)
+        B = self.capacity
+        self._logits = jnp.zeros((B, vocab), jnp.float32)
+        self._counts = jnp.zeros((B, vocab), jnp.int32)
+        self.positions = np.zeros((B,), np.int32)
+        self.gen_steps = np.zeros((B,), np.int32)
+        self.max_news = np.zeros((B,), np.int32)
+        self.forced_steps = np.zeros((B,), np.int32)
+        self.active = np.zeros((B,), bool)
+        self.slots: List[Optional[_Row]] = [None] * B
+        self._seq_counter = 0
+        self._compiled_step: Dict = {}
+        self._compiled_prefill: Dict = {}
+        # trace-time entries across BOTH compiled families — the bounded-
+        # retrace contract's probe, like GenerationServer.stats["traces"]
+        self.stats: Dict[str, Any] = {"traces": 0, "steps": 0, "prefills": 0}
+        self._key = jax.random.fold_in(
+            jax.random.key(int(server.cfg.get("Global", {}).get("seed", 0))),
+            0x9a6ed,
+        )
+        # decode_step never reads max_dec_len (budgets are per-row DATA):
+        # normalize it out of the compile key
+        self._gen_key = dataclasses.replace(self.gen, max_dec_len=0)
+
+    # -- capacity queries ----------------------------------------------
+    def row_capacity_tokens(self, prompt_len: int, max_new: int) -> int:
+        """Cache slots a row reserves: its full decode budget plus the
+        prefill bucket width (pad junk lands in the row's own blocks).
+        The budget is clamped to the context room like admit() clamps it
+        (plan_decode's trim), so reservation == allocation."""
+        from paddlefleetx_tpu.models.gpt.generation import bucket_len
+
+        P = bucket_len(prompt_len, self.bucket)
+        limit = int(self.mcfg.max_position_embeddings) - P
+        return max(prompt_len + min(max_new, max(1, limit)), P)
+
+    def free_slots(self) -> int:
+        return sum(1 for r in self.slots if r is None)
+
+    def active_rows(self) -> int:
+        return int(self.active.sum())
+
+    def can_admit(self, prompt_len: int, max_new: int) -> bool:
+        return self.free_slots() > 0 and self.cache.can_admit(
+            self.row_capacity_tokens(prompt_len, max_new)
+        )
+
+    def validate_request(self, prompt_len: int, max_new: int) -> None:
+        """Reject (loudly, pre-admission) a row that could NEVER fit."""
+        need = blocks_for(
+            self.row_capacity_tokens(prompt_len, max_new), self.block
+        )
+        usable = self.cache.allocator.num_blocks - 1
+        if need > usable:
+            raise ValueError(
+                f"request needs {need} KV blocks but the pool has {usable}; "
+                f"raise --kv-blocks or lower max_tokens"
+            )
+
+    # -- compiled entry points -----------------------------------------
+    def _prefill_fn(self, P: int, PB: int):
+        key = (self._gen_key, P, PB)
+        fn = self._compiled_prefill.get(key)
+        if fn is None:
+            from paddlefleetx_tpu.models.gpt.generation import (
+                PagedPools,
+                paged_prefill,
+            )
+
+            def traced(p, prompt, plen, kp, vp, table_row):
+                self.stats["traces"] += 1
+                pools, last, counts = paged_prefill(
+                    p, prompt, plen, PagedPools(kp, vp), table_row,
+                    self.mcfg, ctx=self.ctx,
+                )
+                return pools.k, pools.v, last, counts
+
+            fn = self._jax.jit(traced, donate_argnums=(3, 4))
+            self._compiled_prefill[key] = fn
+            get_registry().counter("pfx_serving_traces_total").inc()
+        return fn
+
+    def _step_fn(self, M: int):
+        key = (self._gen_key, self.capacity, M)
+        fn = self._compiled_step.get(key)
+        if fn is None:
+            from paddlefleetx_tpu.models.gpt.generation import (
+                PagedPools,
+                PagedRows,
+                decode_step,
+            )
+
+            def traced(p, kp, vp, tables, logits, counts, positions,
+                       gen_steps, max_news, active, forced_steps, rng):
+                self.stats["traces"] += 1
+                rows = PagedRows(logits, counts, positions, gen_steps,
+                                 max_news, active, forced_steps)
+                nxt, pools, rows2 = decode_step(
+                    p, PagedPools(kp, vp), tables, rows, self.mcfg,
+                    self._gen_key, key=rng, ctx=self.ctx,
+                )
+                return (nxt, pools.k, pools.v, rows2.logits, rows2.counts,
+                        rows2.positions, rows2.gen_steps, rows2.active)
+
+            fn = self._jax.jit(traced, donate_argnums=(1, 2))
+            self._compiled_step[key] = fn
+            get_registry().counter("pfx_serving_traces_total").inc()
+        return fn
+
+    # -- row lifecycle --------------------------------------------------
+    def admit(self, prompt_ids: Sequence[int], max_new: int,
+              entry: Optional[_CBEntry] = None, row_idx: int = 0) -> int:
+        """Allocate blocks + a batch slot and prefill the prompt into the
+        arena.  Raises :class:`BlockPoolExhausted` / RuntimeError("no
+        free slot") when full — callers check :meth:`can_admit` first."""
+        from paddlefleetx_tpu.models.gpt.generation import bucket_len
+
+        jnp = self._jnp
+        plen = len(prompt_ids)
+        if plen < 1:
+            raise ValueError("prompt must be non-empty")
+        if max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        P = bucket_len(plen, self.bucket)
+        context = int(self.mcfg.max_position_embeddings)
+        limit = context - P
+        if limit < 1:
+            raise ValueError(
+                f"prompt bucket {P} leaves no decode room in context "
+                f"{context}"
+            )
+        # the COALESCE path trims an over-budget request to the context
+        # room (core/serving.plan_decode); deliver the identical count —
+        # the HTTP layer pre-clamps, this covers direct library callers
+        max_new = min(max_new, limit)
+        slot = next((i for i, r in enumerate(self.slots) if r is None), None)
+        if slot is None:
+            raise RuntimeError("no free slot in the running batch")
+        self._seq_counter += 1
+        seq_id = self._seq_counter
+        table = self.cache.admit(
+            seq_id, self.row_capacity_tokens(plen, max_new)
+        )
+        PB = blocks_for(P, self.block)
+        # prefill scatters PB blocks (bucket width incl. pad junk, which
+        # lands in the row's own blocks — row_capacity_tokens reserves at
+        # least the bucket width, so the table always covers PB)
+        prefill_table = table[:PB]
+        prompt = np.full((1, P), self.gen.pad_token_id, np.int32)
+        prompt[0, :plen] = list(prompt_ids)  # RIGHT-pad (paged rows are unpadded)
+        fn = self._prefill_fn(P, PB)
+        try:
+            with self.mesh:
+                kp, vp, last, counts = fn(
+                    self.server.params,
+                    jnp.asarray(prompt),
+                    jnp.int32(plen),
+                    self.pools.k,
+                    self.pools.v,
+                    jnp.asarray(prefill_table, jnp.int32),
+                )
+        except BaseException as exc:
+            # pools were fed to a donating dispatch: assume invalidated
+            self.cache.release(seq_id)
+            dead = self.reset()
+            raise ArenaReset(
+                f"prefill failed ({type(exc).__name__}: {exc}); arena reset",
+                dead,
+            ) from exc
+        from paddlefleetx_tpu.models.gpt.generation import PagedPools
+
+        self.pools = PagedPools(kp, vp)
+        self._logits = self._logits.at[slot].set(last)
+        self._counts = self._counts.at[slot].set(counts)
+        self.positions[slot] = plen
+        self.gen_steps[slot] = 0
+        self.max_news[slot] = max_new
+        # forced-EOS fires where the COALESCE path fires it: the bucketed
+        # run end of core/serving.plan_decode (min(ceil32(budget), context
+        # room)) — NOT the raw budget, whose step the contiguous path's
+        # trimmed output usually never shows
+        self.forced_steps[slot] = min(-(-max_new // 32) * 32, limit) - 1
+        self.active[slot] = True
+        self.slots[slot] = _Row(
+            seq_id=seq_id, entry=entry, row_idx=row_idx, prompt_len=plen,
+            max_new=max_new, table=table,
+        )
+        self.stats["prefills"] += 1
+        get_registry().counter("pfx_prefill_admits_total").inc()
+        return slot
+
+    def table_width_bucket(self) -> int:
+        widest = max(
+            (len(r.table) for r in self.slots if r is not None), default=1
+        )
+        return min(_pow2_at_least(widest), _pow2_at_least(self.max_row_blocks))
+
+    def step(self) -> List[int]:
+        """Run ONE decode step for every active row; returns the slots
+        that finished this step (their tokens are complete — release
+        them with :meth:`release`)."""
+        jnp = self._jnp
+        if not self.active.any():
+            return []
+        M = self.table_width_bucket()
+        tables = np.full((self.capacity, M), NULL_BLOCK, np.int32)
+        for i, r in enumerate(self.slots):
+            if r is not None:
+                tables[i, : len(r.table)] = r.table
+        self._key, sub = self._jax.random.split(self._key)
+        was_active = self.active.copy()
+        fn = self._step_fn(M)
+        try:
+            with self.mesh:
+                (nxt, kp, vp, logits, counts, positions, gen_steps,
+                 active) = fn(
+                    self.server.params, self.pools.k, self.pools.v,
+                    jnp.asarray(tables), self._logits, self._counts,
+                    jnp.asarray(self.positions), jnp.asarray(self.gen_steps),
+                    jnp.asarray(self.max_news), jnp.asarray(self.active),
+                    jnp.asarray(self.forced_steps), sub,
+                )
+            nxt = np.array(nxt)
+            new_active = np.array(active)
+        except BaseException as exc:
+            dead = self.reset()
+            raise ArenaReset(
+                f"decode step failed ({type(exc).__name__}: {exc}); "
+                "arena reset",
+                dead,
+            ) from exc
+        from paddlefleetx_tpu.models.gpt.generation import PagedPools
+
+        self.pools = PagedPools(kp, vp)
+        self._logits, self._counts = logits, counts
+        # np.array (not asarray): device-array views can be read-only and
+        # admit/release mutate these in place
+        self.positions = np.array(positions)
+        self.gen_steps = np.array(gen_steps)
+        self.active = new_active
+        self.stats["steps"] += 1
+        finished: List[int] = []
+        for i, r in enumerate(self.slots):
+            if r is None or not was_active[i]:
+                continue
+            tok = int(nxt[i])
+            if tok != self.gen.eos_token_id:
+                r.tokens.append(tok)
+            if not new_active[i]:
+                finished.append(i)
+        return finished
+
+    def release(self, slot: int) -> None:
+        """Return a finished/evicted row's blocks to the pool and clear
+        its batch slot (loud on an empty slot — a double release means
+        the caller's bookkeeping aliased two rows)."""
+        row = self.slots[slot]
+        if row is None:
+            raise ValueError(f"slot {slot} is already empty")
+        self.cache.release(row.seq_id)
+        self.slots[slot] = None
+        self.active[slot] = False
+        self.positions[slot] = 0
+        self.gen_steps[slot] = 0
+        self.max_news[slot] = 0
+        self.forced_steps[slot] = 0
+
+    def reset(self) -> List["_Row"]:
+        """Rebuild the arena after a failed donating dispatch: the old
+        pools may be donation-invalidated and must never be reused.
+        Returns the rows that were live (the caller fails their
+        requests)."""
+        from paddlefleetx_tpu.models.gpt.generation import init_paged_pools
+
+        dead = [r for r in self.slots if r is not None]
+        for r in dead:
+            self.cache.release(r.seq_id)
+        self.slots = [None] * self.capacity
+        self.active[:] = False
+        self.positions[:] = 0
+        self.gen_steps[:] = 0
+        self.max_news[:] = 0
+        self.forced_steps[:] = 0
+        self.pools = init_paged_pools(
+            self.mcfg, self.cache.allocator.num_blocks, self.block
+        )
+        jnp = self._jnp
+        self._logits = jnp.zeros_like(self._logits)
+        self._counts = jnp.zeros_like(self._counts)
+        return dead
+
+    def warmup(self, prompt_lens: Sequence[int]) -> Dict[str, float]:
+        """Compile (prefill, step) for each prompt bucket at the default
+        decode budget — the continuous counterpart of
+        `GenerationServer.warmup`; fails loudly naming the bucket."""
+        per: Dict[str, float] = {}
+        for n in prompt_lens:
+            t0 = time.time()
+            try:
+                slot = self.admit([1] * int(n), max_new=self.gen.max_dec_len)
+                self.step()
+                if self.slots[slot] is not None:
+                    self.release(slot)
+            except Exception as exc:
+                raise RuntimeError(
+                    f"continuous warmup failed at bucket {n} (warmed so "
+                    f"far: {sorted(per) or 'none'}): "
+                    f"{type(exc).__name__}: {exc}"
+                ) from exc
+            per[str(int(n))] = round(time.time() - t0, 2)
+            logger.info(
+                f"continuous warmup: prompt bucket {n} compiled in "
+                f"{per[str(int(n))]:.1f}s"
+            )
+        return per
+
+
+class ContinuousScheduler:
+    """Iteration-level scheduler with the RequestQueue admission surface.
+
+    ``submit`` -> bounded waiting queue (QueueFull/QueueClosed exactly
+    like RequestQueue); the scheduler thread loops one decode step per
+    iteration: shed expired waiting entries, evict expired ACTIVE rows
+    mid-decode (blocks freed immediately), admit from the queue head
+    while slots + blocks allow (prefill-on-admit), then step the batch.
+    """
+
+    def __init__(self, engine: PagedDecodeEngine, *, max_depth: int = 64,
+                 name: str = "serve-cb") -> None:
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.engine = engine
+        self.max_depth = int(max_depth)
+        self.name = name
+        self._entries: List[_CBEntry] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        self._busy_since: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self._req_counter = 0
+        self._step_counter = 0
+        # same pfx_queue_* registry names as RequestQueue (one scheduler
+        # runs per process; /healthz's queue block works unchanged) plus
+        # the continuous-only counters
+        self.stats = StatsView(
+            {
+                "submitted": "pfx_queue_submitted_total",
+                "completed": "pfx_queue_completed_total",
+                "batches": "pfx_queue_batches_total",
+                "coalesced_batches": "pfx_queue_coalesced_batches_total",
+                "coalesced_requests": "pfx_queue_coalesced_requests_total",
+                "shed_deadline": "pfx_queue_shed_deadline_total",
+                "rejected_full": "pfx_queue_rejected_full_total",
+                "rejected_closed": "pfx_queue_rejected_closed_total",
+                "gen_errors": "pfx_queue_gen_errors_total",
+                "evictions": "pfx_request_evictions_total",
+                "prefill_admits": "pfx_prefill_admits_total",
+            }
+        )
+        get_registry().register_collector(self)
+
+    def collect(self):
+        eng = self.engine
+        occ = eng.active_rows() / max(1, eng.capacity)
+        cstats = eng.cache.stats()
+        return [
+            ("pfx_queue_depth", {}, float(self.depth())),
+            ("pfx_queue_busy_seconds", {}, self.busy_seconds()),
+            ("pfx_batch_occupancy", {}, occ),
+            ("pfx_kv_blocks_used", {}, float(cstats["kv_blocks_used"])),
+            ("pfx_kv_blocks_free", {}, float(cstats["kv_blocks_free"])),
+        ]
+
+    # -- admission (RequestQueue-compatible surface) --------------------
+    def submit(self, prompts: Sequence[Any], max_new_tokens: int, *,
+               coalesce_key=None, deadline_s: Optional[float] = None
+               ) -> RequestFuture:
+        if not prompts:
+            raise ValueError("prompts must be non-empty")
+        for p in prompts:
+            self.engine.validate_request(len(p), int(max_new_tokens))
+        entry = _CBEntry(
+            prompts=[list(p) for p in prompts],
+            max_new=int(max_new_tokens),
+            deadline=(time.monotonic() + float(deadline_s))
+            if deadline_s is not None else None,
+            future=RequestFuture(),
+            enqueued_at=time.monotonic(),
+        )
+        entry.future.times["enqueued"] = entry.enqueued_at
+        with self._wake:
+            if self._closed:
+                self.stats["rejected_closed"] += 1
+                raise QueueClosed(f"{self.name} queue is draining")
+            if len(self._entries) >= self.max_depth:
+                self.stats["rejected_full"] += 1
+                raise QueueFull(
+                    f"{self.name} queue full ({self.max_depth} waiting)"
+                )
+            self._entries.append(entry)
+            self.stats["submitted"] += 1
+            self._wake.notify_all()
+        return entry.future
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def busy_seconds(self) -> float:
+        with self._lock:
+            if self._busy_since is None:
+                return 0.0
+            return time.monotonic() - self._busy_since
+
+    def try_remove(self, future: RequestFuture) -> bool:
+        """Shed a WAITING entry (no row admitted yet).  An entry already
+        in the running batch resolves via mid-decode eviction at its
+        deadline instead."""
+        with self._wake:
+            for e in self._entries:
+                if e.future is future and e.next_row == 0:
+                    self._entries.remove(e)
+                    self.stats["shed_deadline"] += 1
+                    e.future.set_exception(
+                        DeadlineExceeded("deadline exceeded while queued")
+                    )
+                    return True
+        return False
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "ContinuousScheduler":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name=f"{self.name}-scheduler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        with self._wake:
+            self._closed = True
+            self._wake.notify_all()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> bool:
+        self.close()
+        if not drain:
+            with self._wake:
+                while self._entries:
+                    e = self._entries.pop(0)
+                    e.future.set_exception(
+                        QueueClosed(f"{self.name} queue shut down")
+                    )
+                self._wake.notify_all()
+        return self.join(timeout)
+
+    def warmup(self, prompt_lens: Sequence[int]) -> Dict[str, float]:
+        return self.engine.warmup(prompt_lens)
+
+    # -- scheduler loop -------------------------------------------------
+    def _has_live_rows(self) -> bool:
+        return any(r is not None for r in self.engine.slots)
+
+    def _run(self) -> None:
+        while True:
+            with self._wake:
+                while not self._entries and not self._has_live_rows():
+                    if self._closed:
+                        return  # drained
+                    self._wake.wait()
+                self._busy_since = time.monotonic()
+            try:
+                self._iterate()
+            finally:
+                with self._lock:
+                    self._busy_since = None
+
+    def _shed_locked(self, entry: _CBEntry) -> None:
+        self.stats["shed_deadline"] += 1
+        waited = time.monotonic() - entry.enqueued_at
+        logger.warning(
+            f"{self.name}: shed expired request after {waited:.2f}s queued"
+        )
+        entry.future.set_exception(
+            DeadlineExceeded(f"deadline exceeded after {waited:.2f}s queued")
+        )
+
+    def _evict_entry(self, entry: _CBEntry, reason: str) -> None:
+        """Mid-decode eviction: free every admitted row of the entry and
+        resolve its future.  Blocks return to the pool IMMEDIATELY — the
+        next admission can use them this same iteration."""
+        eng = self.engine
+        n = 0
+        for i, r in enumerate(eng.slots):
+            if r is not None and r.entry is entry:
+                eng.release(i)
+                n += 1
+        self.stats["evictions"] += n
+        self.stats["shed_deadline"] += 1
+        waited = time.monotonic() - entry.enqueued_at
+        logger.warning(
+            f"{self.name}: evicted {n} mid-decode row(s) of an expired "
+            f"request after {waited:.2f}s ({reason})"
+        )
+        if not entry.future.done():
+            entry.future.set_exception(
+                DeadlineExceeded(
+                    f"deadline exceeded after {waited:.2f}s ({reason})"
+                )
+            )
+
+    def _fail_rows(self, rows, exc: BaseException) -> None:
+        failed = {r.entry for r in rows if r.entry is not None}
+        for e in failed:
+            if not e.future.done():
+                e.future.set_exception(exc)
+
+    def _iterate(self) -> None:
+        eng = self.engine
+        now = time.monotonic()
+
+        admitted: List[tuple] = []
+        expired_partial: List[_CBEntry] = []
+        with self._wake:
+            # shed expired WAITING entries before spending anything; an
+            # expired PARTIALLY-admitted entry leaves the queue too (its
+            # remaining rows must never start) and is evicted below
+            keep: List[_CBEntry] = []
+            for e in self._entries:
+                if e.deadline is not None and now > e.deadline:
+                    if e.next_row == 0:
+                        self._shed_locked(e)
+                    else:
+                        expired_partial.append(e)
+                else:
+                    keep.append(e)
+            self._entries = keep
+
+        # evict expired ACTIVE rows BEFORE picking admissions (mid-decode
+        # shed): their slots and blocks return to the pool for this same
+        # iteration's admissions
+        expired = set(expired_partial)
+        for r in eng.slots:
+            if r is not None and r.entry is not None:
+                e = r.entry
+                if e.deadline is not None and now > e.deadline:
+                    expired.add(e)
+        for e in expired:
+            self._evict_entry(e, "mid-decode")
+
+        with self._wake:
+            # FCFS admission from the head: pull rows while they fit.
+            # Nothing is allocated until the prefill loop below, so the
+            # pull accounts for its OWN picks — a burst larger than free
+            # capacity stays queued instead of hard-failing at admit()
+            free_slots = eng.free_slots()
+            free_blocks = eng.cache.allocator.free_count()
+            while self._entries:
+                head = self._entries[0]
+                if head.future.done():
+                    # already failed (e.g. an earlier row died in an
+                    # ArenaReset): its remaining rows must never start,
+                    # and must not reserve capacity others could use
+                    self._entries.pop(0)
+                    continue
+                p = head.prompts[head.next_row]
+                need = blocks_for(
+                    eng.row_capacity_tokens(len(p), head.max_new), eng.block
+                )
+                if free_slots < 1 or need > free_blocks:
+                    break
+                free_slots -= 1
+                free_blocks -= need
+                head.future.times.setdefault("picked", time.monotonic())
+                admitted.append((head, head.next_row, p))
+                head.next_row += 1
+                if head.next_row >= len(head.prompts):
+                    self._entries.pop(0)
+
+        # prefill-on-admit (outside the lock: device work)
+        for entry, row_idx, prompt in admitted:
+            if entry.future.done():
+                continue  # an earlier row of this entry already failed
+            self._req_counter += 1
+            try:
+                maybe_fire("gen_crash", self._req_counter)
+                eng.admit(prompt, entry.max_new, entry=entry, row_idx=row_idx)
+            except ArenaReset as exc:
+                # the donating prefill dispatch failed: every live row
+                # died with the arena — fail them all, keep serving on
+                # the fresh pools
+                self.stats["gen_errors"] += 1
+                self._fail_rows(exc.dead_rows, exc)
+                if not entry.future.done():
+                    entry.future.set_exception(exc)
+                logger.warning(f"{self.name}: {exc}")
+            except (BlockPoolExhausted, RuntimeError, ValueError) as exc:
+                # host-side failure BEFORE any dispatch (capacity raced
+                # between the locked check and here, or an injected
+                # crash): arena intact, fail only this entry
+                self.stats["gen_errors"] += 1
+                for i, r in enumerate(eng.slots):
+                    if r is not None and r.entry is entry:
+                        eng.release(i)
+                if not entry.future.done():
+                    entry.future.set_exception(exc)
+                logger.warning(
+                    f"{self.name}: admission failed: "
+                    f"{type(exc).__name__}: {exc}"
+                )
+
+        if not self._has_live_rows():
+            return
+
+        # one iteration-level decode step
+        self._step_counter += 1
+        maybe_fire("cb_step_hang", self._step_counter)
+        try:
+            finished = eng.step()
+        except ArenaReset as exc:
+            self.stats["gen_errors"] += 1
+            self._fail_rows(exc.dead_rows, exc)
+            logger.warning(f"{self.name}: {exc}")
+            return
+        self.stats["batches"] += 1
+        reg = get_registry()
+        for slot in finished:
+            row = eng.slots[slot]
+            entry = row.entry
+            eng.release(slot)
+            if entry is None:
+                continue
+            entry.results[row.row_idx] = row.tokens
+            entry.done_rows += 1
+            if entry.done_rows == len(entry.prompts):
+                entry.future.set_result(list(entry.results))
+                self.stats["completed"] += 1
+                reg.counter("pfx_serving_requests_total").inc()
+                reg.counter("pfx_serving_tokens_out_total").inc(
+                    sum(len(t) for t in entry.results)
+                )

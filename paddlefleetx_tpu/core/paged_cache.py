@@ -1,0 +1,221 @@
+"""Block-paged KV cache: a preallocated arena of fixed-size blocks plus
+the host-side allocator and per-sequence block tables over it.
+
+The contiguous serving path (`core/serving.py`) pools one DONATED
+[layers, b, heads, max_len, dim] pair per compile bucket — great for
+whole-batch decodes, but a row cannot join or leave mid-flight and every
+row pays the bucket's full length.  PagedAttention (Kwon et al., SOSP
+2023) replaces the monolith with fixed-size blocks handed out on demand:
+a sequence owns a BLOCK TABLE (logical block j -> arena block id), rows
+of a running batch can hold wildly different lengths, and freeing a
+finished/evicted row returns its blocks to the pool immediately.  This
+module owns that bookkeeping; the kernels that consume the layout live
+in `ops/decode_attention.paged_decode_attention`, and the scheduler that
+drives it is `core/continuous_batching.py`.
+
+Design points:
+
+  - **block 0 is the null block**: never allocated, never freed.  Padded
+    table entries and inactive batch rows point at it, so a fixed-shape
+    decode step always has a safe write/gather target.
+  - **loud exhaustion, never corruption**: `alloc` raises
+    `BlockPoolExhausted` when the pool cannot satisfy a request (the
+    scheduler turns that into "stay queued"), `free` raises on a
+    double-free or an out-of-range id — a silent bad id would alias two
+    sequences onto one block and corrupt BOTH of their caches.
+  - **allocator is pure host Python** (testable without jax); the device
+    arena (`PagedPools`) is created by `models/gpt/generation.py
+    init_paged_pools` and owned by the engine.
+
+Knobs (loud-parse like PFX_DECODE_BLOCK):
+
+  PFX_KV_BLOCK   block size in cache slots (default 16; positive
+                 multiple of 8 — TPU sublane tiling)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+_DEFAULT_KV_BLOCK = 16
+
+NULL_BLOCK = 0
+
+
+class BlockPoolExhausted(RuntimeError):
+    """Not enough free KV blocks for the request (scheduler: stay queued)."""
+
+
+def kv_block_size(block: int = 0) -> int:
+    """Resolve the paged-cache block size: explicit arg, else
+    PFX_KV_BLOCK, else {_DEFAULT_KV_BLOCK}.  Must be a positive multiple
+    of 8 (TPU sublane tiling for the pallas spelling); invalid values
+    raise at setup, never silently mislabel a run."""
+    raw = os.environ.get("PFX_KV_BLOCK") or "0"
+    try:
+        env = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"PFX_KV_BLOCK={raw!r} is not an integer; pass a positive "
+            "multiple of 8 (e.g. 16) or unset it"
+        ) from None
+    force = int(block) or env or _DEFAULT_KV_BLOCK
+    if force < 8 or force % 8:
+        raise ValueError(
+            f"kv block size {force} must be a positive multiple of 8 "
+            "(block arg / PFX_KV_BLOCK)"
+        )
+    return force
+
+
+def blocks_for(tokens: int, block: int) -> int:
+    """Blocks needed to hold ``tokens`` cache slots."""
+    if tokens < 0:
+        raise ValueError(f"tokens must be >= 0, got {tokens}")
+    return -(-int(tokens) // int(block))
+
+
+class BlockAllocator:
+    """Fixed-size block pool bookkeeping (ids 1..num_blocks-1; 0 = null).
+
+    Free blocks are handed out lowest-id-first (`defrag` keeps the free
+    list sorted), which keeps live allocations packed toward the front of
+    the arena — helpful DMA locality, and `fragmentation()` stays an
+    honest metric instead of an artifact of churn order.
+    """
+
+    def __init__(self, num_blocks: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (1 usable + the null block), got {num_blocks}"
+            )
+        self.num_blocks = int(num_blocks)
+        self._free: List[int] = list(range(1, self.num_blocks))
+        self._used: set = set()
+
+    # -- queries --------------------------------------------------------
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def used_count(self) -> int:
+        return len(self._used)
+
+    def fragmentation(self) -> float:
+        """1 - (largest contiguous free run / free blocks): 0.0 when the
+        free space is one run (or empty), approaching 1.0 when it is
+        shattered into single blocks."""
+        if not self._free:
+            return 0.0
+        runs, best, cur = sorted(self._free), 1, 1
+        for a, b in zip(runs, runs[1:]):
+            cur = cur + 1 if b == a + 1 else 1
+            best = max(best, cur)
+        return 1.0 - best / len(self._free)
+
+    # -- alloc/free -----------------------------------------------------
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` blocks; raises :class:`BlockPoolExhausted` (with
+        the shortfall named) when the pool cannot satisfy the request —
+        the caller keeps the request queued rather than corrupting."""
+        if n < 1:
+            raise ValueError(f"alloc needs n >= 1, got {n}")
+        if n > len(self._free):
+            raise BlockPoolExhausted(
+                f"KV block pool exhausted: need {n}, have {len(self._free)} "
+                f"free of {self.num_blocks - 1} usable"
+            )
+        self._free.sort()
+        out, self._free = self._free[:n], self._free[n:]
+        self._used.update(out)
+        return out
+
+    def free(self, blocks) -> None:
+        """Return blocks to the pool.  LOUD on a double-free, the null
+        block, or an out-of-range id: any of those means two sequences
+        believe they own one block — silent acceptance would corrupt
+        both caches."""
+        blocks = list(blocks)
+        seen: set = set()
+        for b in blocks:
+            if b == NULL_BLOCK:
+                raise ValueError("cannot free the null block (id 0)")
+            if not (0 < b < self.num_blocks):
+                raise ValueError(
+                    f"block id {b} out of range (1..{self.num_blocks - 1})"
+                )
+            if b not in self._used or b in seen:
+                raise ValueError(
+                    f"double free of block {b} (not currently allocated)"
+                )
+            seen.add(b)
+        for b in blocks:
+            self._used.discard(b)
+            self._free.append(b)
+
+    def defrag(self) -> None:
+        """Sort the free list so future allocations are as contiguous as
+        possible.  With uniform blocks behind a table indirection this is
+        purely a locality/telemetry nicety — correctness never depends
+        on it."""
+        self._free.sort()
+
+
+class PagedCacheManager:
+    """Per-sequence block tables over one :class:`BlockAllocator`.
+
+    A sequence reserves its WHOLE capacity (prompt + decode budget) at
+    admission: growth never fails mid-decode, the table is static for the
+    row's lifetime, and the scheduler's compile-shape bucket (table
+    width) only changes at admit/evict boundaries.
+    """
+
+    def __init__(self, num_blocks: int, block: int = 0) -> None:
+        self.block = kv_block_size(block)
+        self.allocator = BlockAllocator(num_blocks)
+        self._tables: Dict[int, List[int]] = {}
+
+    def can_admit(self, tokens: int) -> bool:
+        return blocks_for(tokens, self.block) <= self.allocator.free_count()
+
+    def admit(self, seq_id: int, tokens: int) -> List[int]:
+        """Allocate ``ceil(tokens / block)`` blocks for a new sequence."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id} already admitted")
+        table = self.allocator.alloc(blocks_for(tokens, self.block))
+        self._tables[seq_id] = table
+        return list(table)
+
+    def release(self, seq_id: int) -> None:
+        """Free a finished/evicted sequence's blocks (loud on unknown id)."""
+        table = self._tables.pop(seq_id, None)
+        if table is None:
+            raise ValueError(f"sequence {seq_id} has no allocation")
+        self.allocator.free(table)
+
+    def table(self, seq_id: int, width: Optional[int] = None) -> List[int]:
+        """The sequence's block table, null-padded to ``width`` entries
+        (the scheduler's bucketed table width)."""
+        table = list(self._tables[seq_id])
+        if width is not None:
+            if width < len(table):
+                raise ValueError(
+                    f"table width {width} < {len(table)} allocated blocks"
+                )
+            table += [NULL_BLOCK] * (width - len(table))
+        return table
+
+    def blocks_of(self, seq_id: int) -> int:
+        return len(self._tables[seq_id])
+
+    def live_sequences(self) -> int:
+        return len(self._tables)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "kv_blocks_used": self.allocator.used_count(),
+            "kv_blocks_free": self.allocator.free_count(),
+            "kv_block_size": self.block,
+            "live_sequences": len(self._tables),
+            "fragmentation": round(self.allocator.fragmentation(), 4),
+        }

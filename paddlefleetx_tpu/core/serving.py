@@ -81,6 +81,8 @@ class GenerationServer:
             repetition_penalty=float(gen_cfg.get("repetition_penalty", 1.0)),
             eos_token_id=int(gen_cfg.get("eos_token_id", 50256)),
             pad_token_id=int(gen_cfg.get("pad_token_id", 0)),
+            forced_bos_token_id=int(gen_cfg.get("forced_bos_token_id", -1)),
+            forced_eos_token_id=int(gen_cfg.get("forced_eos_token_id", -1)),
         )
 
         rules = make_rules(mesh=mesh)

@@ -32,6 +32,7 @@ from paddlefleetx_tpu.ops.decode_attention import (
     decode_attention,
     decode_attn_mode,
     dense_cache_attention,
+    paged_decode_attention,
 )
 from paddlefleetx_tpu.ops.sampling import sample_logits
 
@@ -468,6 +469,273 @@ def generate(
         loop_cond, loop_body, (carry0, jnp.int32(0), tokens0)
     )
     return (tokens, carry.cache) if return_cache else tokens  # [b, max_dec_len]
+
+
+# ---------------------------------------------------------------------------
+# Paged decode: block-pool KV cache + the step-wise entry the
+# continuous-batching scheduler drives (core/continuous_batching.py).
+# The contiguous generate() above runs ONE request set to completion
+# inside a fused loop; these functions instead expose ONE decode step
+# over a batch of INDEPENDENT rows (own positions, own budgets, own
+# block tables into a shared arena), so the host scheduler can admit and
+# evict rows at every step boundary.
+# ---------------------------------------------------------------------------
+
+
+class PagedPools(NamedTuple):
+    """The paged KV arena: [layers, num_blocks, heads, block, head_dim]
+    (heads-major within a block, matching KVCache's tiling rationale).
+    Block 0 is the NULL block — never allocated to a sequence; inactive
+    batch rows route their writes there (core/paged_cache.py)."""
+
+    k: jax.Array
+    v: jax.Array
+
+
+def init_paged_pools(
+    cfg: GPTConfig, num_blocks: int, block: int, dtype=None
+) -> PagedPools:
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (cfg.num_layers, num_blocks, cfg.num_attention_heads, block,
+             cfg.head_dim)
+    return PagedPools(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+class PagedRows(NamedTuple):
+    """Per-row decode state the scheduler threads through decode_step.
+
+    ``positions`` is each row's NEXT write slot (= real prompt length +
+    tokens generated so far); ``gen_steps`` counts generated tokens;
+    ``max_news`` is the per-row decode budget (runtime data, NOT a
+    compile key — unlike the contiguous path, a new max_tokens value
+    never keys a retrace); ``forced_steps`` is the per-row step index
+    where ``forced_eos_token_id`` fires — the CONTIGUOUS path's bucketed
+    run end (`core/serving.plan_decode`'s ``run - 1``), not the raw
+    budget, so forced-EOS output stays token-identical to the coalesce
+    path (whose forced step usually lands beyond the trimmed output);
+    ``logits`` are the pending next-token logits the next step samples
+    from; ``counts`` back repetition penalty."""
+
+    logits: jax.Array        # [B, vocab] f32
+    counts: jax.Array        # [B, vocab] int32
+    positions: jax.Array     # [B] int32
+    gen_steps: jax.Array     # [B] int32
+    max_news: jax.Array      # [B] int32
+    active: jax.Array        # [B] bool
+    forced_steps: jax.Array  # [B] int32
+
+
+def _paged_layer_step(
+    p: Dict[str, Any],
+    x: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    blk: jax.Array,
+    off: jax.Array,
+    tables: jax.Array,
+    positions: jax.Array,
+    cfg: GPTConfig,
+    ctx: Optional[ShardingCtx] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder layer over x [b, 1, h]: write this step's K/V at pool
+    slot (blk[i], off[i]) per row, then block-table paged attention."""
+    dtype = x.dtype
+    b = x.shape[0]
+    n = cfg.num_attention_heads
+
+    y = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
+    qkv = jnp.einsum("bsh,htnd->bstnd", y, p["attn"]["qkv_kernel"].astype(dtype))
+    qkv = qkv + p["attn"]["qkv_bias"].astype(dtype)[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = _constrain(ctx, q, ("batch", None, "heads", "kv"))
+
+    # scatter the [b, n, d] step chunk into each row's current block:
+    # rows own disjoint blocks, so the only index collisions are inactive
+    # rows' null-block writes (garbage-on-garbage, never read)
+    idx_b = blk[:, None]
+    idx_n = jnp.arange(n)[None, :]
+    idx_o = off[:, None]
+    k_pool = k_pool.at[idx_b, idx_n, idx_o, :].set(k[:, 0].astype(k_pool.dtype))
+    v_pool = v_pool.at[idx_b, idx_n, idx_o, :].set(v[:, 0].astype(v_pool.dtype))
+
+    attn_out = paged_decode_attention(
+        q, k_pool, v_pool, tables, positions,
+        impl="lax" if ctx is not None else "auto",
+    )
+    attn_out = jnp.einsum(
+        "bsnd,ndh->bsh", attn_out, p["attn"]["out_kernel"].astype(dtype)
+    ) + p["attn"]["out_bias"].astype(dtype)
+    x = x + attn_out
+
+    y = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
+    mp = p["mlp"]
+    y = y @ mp["fc_in_kernel"].astype(dtype) + mp["fc_in_bias"].astype(dtype)
+    y = jax.nn.gelu(y, approximate=True)
+    y = y @ mp["fc_out_kernel"].astype(dtype) + mp["fc_out_bias"].astype(dtype)
+    return x + y, k_pool, v_pool
+
+
+def paged_forward_step(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    pools: PagedPools,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    active: jax.Array,
+    cfg: GPTConfig,
+    ctx: Optional[ShardingCtx] = None,
+) -> Tuple[jax.Array, PagedPools]:
+    """tokens [B] at per-row slots ``positions`` -> (logits [B, v] f32,
+    pools).  Inactive rows still run (fixed shape) but write to the null
+    block and their logits are garbage the caller ignores."""
+    dtype = jnp.dtype(cfg.dtype)
+    word = params["embeddings"]["word"].astype(dtype)
+    pe = params["embeddings"]["position"].astype(dtype)
+    # clamp inactive rows' embedding index: an evicted slot may carry a
+    # stale position beyond the table
+    pos_emb = jnp.where(active, positions, 0)
+    x = word[tokens][:, None, :] + pe[pos_emb][:, None, :]  # [B, 1, h]
+    x = _constrain(ctx, x, ("batch", None, "embed"))
+
+    bs = pools.k.shape[3]
+    blk_log = jnp.clip(positions // bs, 0, block_tables.shape[1] - 1)
+    blk = jnp.take_along_axis(block_tables, blk_log[:, None], axis=1)[:, 0]
+    blk = jnp.where(active, blk, 0)  # inactive rows -> null block
+    off = positions % bs
+
+    def body(x, inp):
+        p_l, kp, vp = inp
+        x, kp, vp = _paged_layer_step(
+            p_l, x, kp, vp, blk, off, block_tables, positions, cfg, ctx
+        )
+        return x, (kp, vp)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], pools.k, pools.v))
+    x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
+    logits = jnp.einsum("bsh,vh->bsv", x, word)
+    logits = _constrain(ctx, logits, ("batch", None, "vocab"))
+    return logits[:, -1, :].astype(jnp.float32), PagedPools(ks, vs)
+
+
+def paged_prefill(
+    params: Dict[str, Any],
+    prompt: jax.Array,
+    prompt_len: jax.Array,
+    pools: PagedPools,
+    table_row: jax.Array,
+    cfg: GPTConfig,
+    ctx: Optional[ShardingCtx] = None,
+) -> Tuple[PagedPools, jax.Array, jax.Array]:
+    """Prefill ONE row's prompt into its pool blocks (prefill-on-admit).
+
+    ``prompt`` [1, P] is RIGHT-padded to the bucket (real tokens at
+    [0, prompt_len); pad junk after) — unlike the contiguous serving
+    path's left padding, paged rows are unpadded in their logical cache,
+    so real token i lives at slot i and positions need no offset.  The
+    prompt runs through the contiguous ``forward_cached`` prefill (causal
+    masking makes the real rows' math exactly the unpadded computation),
+    then the temp cache is repacked block-wise into the arena at
+    ``table_row`` [PB] (PB * block >= P).  Pad-slot junk K/V land in the
+    row's own blocks past ``prompt_len`` and are overwritten by decode
+    steps before any attention limit reaches them — the same stale-tail
+    argument as the donated contiguous pool.
+
+    Returns (pools, last real token's logits [v] f32, prompt token
+    counts [v] for repetition penalty)."""
+    P = int(prompt.shape[1])
+    layers = cfg.num_layers
+    n = cfg.num_attention_heads
+    d = cfg.head_dim
+    PB = int(table_row.shape[0])
+    bs = int(pools.k.shape[3])
+    L = PB * bs
+    if L < P:
+        raise ValueError(
+            f"table_row covers {PB}x{bs}={L} slots < prompt bucket {P}"
+        )
+    cache = init_cache(cfg, 1, L)
+    pos_ids = jnp.arange(P, dtype=jnp.int32)[None, :]
+    logits, cache = forward_cached(
+        params, prompt, cache, jnp.int32(0), cfg, ctx, position_ids=pos_ids
+    )
+    last = jax.lax.dynamic_index_in_dim(
+        logits[0], prompt_len - 1, axis=0, keepdims=False
+    ).astype(jnp.float32)
+    # repack [layers, 1, n, L, d] -> per-block [layers, PB, n, bs, d]
+    def pack(c):
+        return c[:, 0].reshape(layers, n, PB, bs, d).transpose(0, 2, 1, 3, 4)
+
+    k_pool = pools.k.at[:, table_row].set(pack(cache.k).astype(pools.k.dtype))
+    v_pool = pools.v.at[:, table_row].set(pack(cache.v).astype(pools.v.dtype))
+    counts = jnp.zeros((cfg.vocab_size,), jnp.int32).at[prompt[0]].add(
+        (jnp.arange(P) < prompt_len).astype(jnp.int32)
+    )
+    return PagedPools(k_pool, v_pool), last, counts
+
+
+def decode_step(
+    params: Dict[str, Any],
+    pools: PagedPools,
+    block_tables: jax.Array,
+    rows: PagedRows,
+    cfg: GPTConfig,
+    gen: GenerationConfig,
+    key: Optional[jax.Array] = None,
+    ctx: Optional[ShardingCtx] = None,
+) -> Tuple[jax.Array, PagedPools, PagedRows]:
+    """ONE iteration-level decode step over the running batch.
+
+    Samples each active row's next token from its pending logits through
+    the same processor chain as :func:`generate` (min-length, repetition
+    penalty, forced BOS/EOS — all per-row: rows sit at different steps),
+    writes the token's K/V at the row's current slot, and returns the
+    refreshed pending logits.  Greedy rows are token-identical to the
+    contiguous path; sampling rows draw from per-step subkeys (a
+    different, but deterministic, stream).  Returns (sampled tokens [B],
+    pools, rows')."""
+    B, vocab = rows.logits.shape
+    i = rows.gen_steps
+    logits = apply_min_length(rows.logits, i, gen.min_dec_len, gen.eos_token_id)
+    logits = apply_repetition_penalty(logits, rows.counts, gen.repetition_penalty)
+    if gen.forced_bos_token_id >= 0:
+        forced = jnp.full_like(logits, -1e10).at[
+            ..., gen.forced_bos_token_id].set(0.0)
+        logits = jnp.where((i == 0)[:, None], forced, logits)
+    if gen.forced_eos_token_id >= 0:
+        forced = jnp.full_like(logits, -1e10).at[
+            ..., gen.forced_eos_token_id].set(0.0)
+        logits = jnp.where((i == rows.forced_steps)[:, None], forced, logits)
+    if gen.decode_strategy == "greedy_search":
+        nxt = jnp.argmax(logits, axis=-1)
+    else:
+        if key is None:
+            raise ValueError("sampling decode_step needs a PRNG key")
+        nxt = sample_logits(
+            key, logits, temperature=gen.temperature, top_k=gen.top_k,
+            top_p=gen.top_p,
+        )
+    nxt = jnp.where(rows.active, nxt, gen.pad_token_id)
+    counts = rows.counts.at[jnp.arange(B), nxt].add(
+        rows.active.astype(jnp.int32)
+    )
+    finished = rows.active & (
+        (nxt == gen.eos_token_id) | (i + 1 >= rows.max_news)
+    )
+    new_logits, pools = paged_forward_step(
+        params, nxt, pools, block_tables, rows.positions, rows.active,
+        cfg, ctx,
+    )
+    act = rows.active.astype(jnp.int32)
+    new_rows = PagedRows(
+        logits=new_logits,
+        counts=counts,
+        positions=rows.positions + act,
+        gen_steps=i + act,
+        max_news=rows.max_news,
+        active=rows.active & ~finished,
+        forced_steps=rows.forced_steps,
+    )
+    return nxt, pools, new_rows
 
 
 # ---------------------------------------------------------------------------

@@ -112,6 +112,17 @@ def init_extra(cfg: MoCoConfig, key: jax.Array, params: Dict[str, Any]) -> Dict[
     return extra
 
 
+def _l2_normalize(x: jax.Array, eps: float = 1e-12) -> jax.Array:
+    """Row-normalize with a NaN-SAFE gradient: ``x / (||x|| + eps)``
+    differentiates ``||x||`` whose gradient at x == 0 is 0/0 = NaN —
+    exactly what a degenerate zero embedding produces (constant images
+    through global-batch BN collapse to the zero feature at 1x1 spatial,
+    and then one poisoned row NaNs the whole batch's gradient).
+    ``x * rsqrt(sum(x^2) + eps)`` is the same map away from zero but its
+    gradient at zero is finite (rsqrt(eps) * I)."""
+    return x * jax.lax.rsqrt(jnp.sum(x * x, axis=1, keepdims=True) + eps)
+
+
 def _encode(
     enc_params: Dict[str, Any],
     bn_state: Dict[str, Any],
@@ -146,7 +157,7 @@ def loss_fn(
 
     # queries
     q, new_bn = _encode(params, extra["bn"], img_q, cfg, train)
-    q = q / (jnp.linalg.norm(q, axis=1, keepdims=True) + 1e-12)
+    q = _l2_normalize(q)
 
     # momentum encoder update (EMA, no grad — moco.py:135-144)
     m_eff = cfg.m ** (1.0 / max(cfg.ema_substeps, 1))
@@ -160,7 +171,7 @@ def loss_fn(
     # global-batch BN statistics are permutation-invariant.
     k, new_bn_m = _encode(new_momentum, extra["bn_m"], img_k, cfg, train)
     k = jax.lax.stop_gradient(k)
-    k = k / (jnp.linalg.norm(k, axis=1, keepdims=True) + 1e-12)
+    k = _l2_normalize(k)
 
     # logits: positives Nx1 against paired key, negatives NxK against queue
     l_pos = jnp.sum(q * k, axis=1, keepdims=True)

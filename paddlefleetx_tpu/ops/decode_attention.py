@@ -19,16 +19,16 @@ Two implementations behind one entry point:
   - ``pallas``: one grid program per (batch, head); the kernel fori-loops
     over visited blocks with a runtime trip count read from a scalar
     input.  Runs on TPU; interpret mode elsewhere (tests force it).
-    KNOWN LIMIT: like the flash fwd kernel, the BlockSpec streams the
+    KNOWN LIMIT (contiguous spelling only): the BlockSpec streams the
     full [max_len, d] cache row into VMEM per program, so the length
-    scaling applies to FLOPs but NOT to the HBM reads — converting the
-    kv fetch to scalar-prefetch-clamped per-block DMA (paged-attention
-    style) is the chip-window follow-up; note the partial last block
-    must keep the in-kernel dslice clamp, since a grid-blocked tail
-    would matmul against out-of-bounds padding (0 * NaN poisons the
-    accumulator even under the mask).  Until then the first chip A/B
-    should also compare PFX-forced lax-vs-pallas: the lax spelling's
-    ``dynamic_slice`` IS length-scaled in traffic too.
+    scaling applies to FLOPs but NOT to the HBM reads; note the partial
+    last block must keep the in-kernel dslice clamp, since a grid-blocked
+    tail would matmul against out-of-bounds padding (0 * NaN poisons the
+    accumulator even under the mask).  The paged spelling
+    (:func:`paged_decode_attention`, used by the continuous-batching
+    engine) retires this: its scalar-prefetch-clamped index map DMAs
+    exactly one pool block per grid step, so HBM reads scale with each
+    row's real length.
   - ``lax``: the same blocked loop as ``lax.fori_loop`` +
     ``dynamic_slice`` — CPU fallback and the path used under GSPMD
     sharding (a pallas_call inside a partitioned jit would need
@@ -298,6 +298,212 @@ def decode_attention(
         out = _decode_pallas(q_t, k_cache, v_cache, limit, kv_valid_from, bs, scale)
     else:
         out = _decode_lax(q_t, k_cache, v_cache, limit, kv_valid_from, bs, scale)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Paged (block-table-indexed) decode attention — the continuous-batching
+# serving engine's kernel (core/paged_cache.py owns the pool layout)
+# ---------------------------------------------------------------------------
+
+
+def _paged_lax(q_t, k_pool, v_pool, tables, positions, scale):
+    """q_t [b, n, 1, d]; pools [nb, n, bs, d]; tables [b, M] block ids;
+    positions [b] = global slot of each row's query token.
+
+    Blocked online-softmax over each row's OWN block list: block j of row
+    i holds key slots [j*bs, (j+1)*bs) of that row's logical cache, stored
+    at pool block ``tables[i, j]``.  Rows attend over [0, positions[i]+1)
+    — per-row limits, unlike :func:`_decode_lax`'s shared ``limit``.
+    Table entries beyond a row's limit (null-block padding) are masked by
+    the causal bound, so their garbage never reaches the accumulator.
+    """
+    b, n, t, d = q_t.shape
+    bs = k_pool.shape[2]
+
+    m0 = jnp.full((b, n, t), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n, t), jnp.float32)
+    acc0 = jnp.zeros((b, n, t, d), jnp.float32)
+
+    # each row's last needed block: the fori bound below is the BATCH max,
+    # so shorter rows clamp their gather to their own last block (re-read,
+    # fully masked) — same per-row clamp as the pallas index_map, keeping
+    # both spellings honestly bounded by each row's real length
+    last_blk = jnp.maximum(positions, 0) // bs
+
+    def body(j, carry):
+        m, l, acc = carry
+        jidx = jnp.minimum(j, last_blk)  # [b]
+        blk = jnp.take_along_axis(tables, jidx[:, None], axis=1)[:, 0]  # [b]
+        k = jnp.take(k_pool, blk, axis=0)  # [b, n, bs, d] gather
+        v = jnp.take(v_pool, blk, axis=0)
+        s = scale * jnp.einsum(
+            "bntd,bnkd->bntk", q_t, k, preferred_element_type=jnp.float32
+        )  # [b, n, t, bs]
+        col = j * bs + jnp.arange(bs)  # logical slot of each key column
+        mask = col[None, None, None, :] <= positions[:, None, None, None]
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bntk,bnkd->bntd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    nvisit = jnp.minimum(
+        (jnp.max(positions) + 1 + bs - 1) // bs, tables.shape[1]
+    )
+    m, l, acc = jax.lax.fori_loop(0, nvisit, body, (m0, l0, acc0))
+    # rows whose table is all-null (inactive slots, positions < 0 would
+    # not occur — positions >= 0 always covers block 0) still get a
+    # finite result; fully-masked rows divide by the epsilon floor
+    return acc / jnp.maximum(l, 1e-30)[..., None]
+
+
+def _paged_kernel(
+    tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale, bs, t
+):
+    """One (batch, head, block) grid step.  The kv BlockSpec's index_map
+    already DMA'd pool block ``tables[i, min(j, last_needed(i))]`` — the
+    scalar-prefetch CLAMP: grid steps past a row's limit re-address the
+    previously fetched block (no new DMA) and are fully masked here, so
+    HBM traffic scales with the tokens the row actually holds, not with
+    the padded table width."""
+    i = pl.program_id(0)
+    j = pl.program_id(2)
+    nblk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0]  # [t, d]
+    k = k_ref[0, 0]  # [bs, d] (one pool block for this head)
+    v = v_ref[0, 0]
+    pos = pos_ref[i]
+    s = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [t, bs]
+    col = j * bs + jax.lax.broadcasted_iota(jnp.int32, (t, bs), 1)
+    mask = col <= pos
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_ref[:, :1]  # [t, 1] (lane-replicated store)
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_ref[:, :1] * alpha + p.sum(axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nblk - 1)
+    def _done():
+        o_ref[0, 0] = (
+            acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _paged_pallas(q_t, k_pool, v_pool, tables, positions, scale):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, n, t, d = q_t.shape
+    bs = k_pool.shape[2]
+    M = tables.shape[1]
+    tables = tables.astype(jnp.int32)
+    positions = positions.astype(jnp.int32)
+
+    def kv_index(i, j, k, tables_ref, pos_ref):
+        # scalar-prefetch clamp: past a row's last needed block, re-address
+        # the block we already fetched — Pallas skips the DMA when the
+        # index is unchanged between consecutive grid steps
+        last = jnp.maximum(pos_ref[i], 0) // bs
+        return tables_ref[i, jnp.minimum(k, last)], j, 0, 0
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n, M),
+        in_specs=[
+            pl.BlockSpec((1, 1, t, d), lambda i, j, k, *_: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, t, d), lambda i, j, k, *_: (i, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((t, d), jnp.float32),
+            pltpu.VMEM((t, 128), jnp.float32),
+            pltpu.VMEM((t, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, scale=scale, bs=bs, t=t)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n, t, d), jnp.float32),
+        interpret=_interpret(),
+    )(tables, positions, q_t, k_pool, v_pool)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    impl: str = "auto",
+) -> jax.Array:
+    """Block-table-indexed decode attention for the paged KV cache.
+
+    q [b, 1, n, d]; pools [num_blocks, n, block, d] (one layer's arena —
+    ``core/paged_cache.py``); ``block_tables`` [b, M] maps row i's logical
+    block j to a pool block id; ``positions`` [b] is each row's CURRENT
+    token slot (the chunk already written) — row i attends over its
+    logical slots [0, positions[i]+1).  Rows are fully independent: each
+    has its own length, so there is no shared ``limit`` and no
+    ``kv_valid_from`` (paged rows are unpadded).  Returns [b, 1, n, d].
+
+    ``impl``: "auto" (pallas on TPU, lax elsewhere) | "pallas" | "lax".
+    The pallas spelling DMAs exactly one pool block per grid step with a
+    scalar-prefetch-clamped index map — the HBM reads scale with each
+    row's real length, retiring the known limit of `_decode_pallas`
+    (which streams the whole cache row).  The lax spelling gathers via
+    ``jnp.take`` (XLA partitions it freely under GSPMD).
+    """
+    if impl not in ("auto", "pallas", "lax"):
+        raise ValueError(
+            f"paged_decode_attention impl {impl!r}; valid: auto, pallas, lax"
+        )
+    b, t, n, d = q.shape
+    if t != 1:
+        raise ValueError(
+            f"paged_decode_attention is a decode-step kernel (t=1); got t={t}"
+        )
+    bs = k_pool.shape[2]
+    if impl == "pallas" and bs % 8:
+        # an explicit pallas request must run pallas or fail LOUDLY — a
+        # silent lax fallback would mislabel A/B evidence
+        raise ValueError(
+            f"paged block size {bs} is not a multiple of 8 (TPU sublane "
+            "tiling); impl='pallas' cannot honor it — use impl='lax' or "
+            "a multiple-of-8 PFX_KV_BLOCK"
+        )
+    scale = float(1.0 / (d**0.5))
+    q_t = q.transpose(0, 2, 1, 3)  # [b, n, t, d]
+    use_pallas = impl == "pallas" or (impl == "auto" and not _interpret())
+    if use_pallas and bs % 8 == 0:
+        out = _paged_pallas(q_t, k_pool, v_pool, block_tables, positions, scale)
+    else:
+        out = _paged_lax(q_t, k_pool, v_pool, block_tables, positions, scale)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 

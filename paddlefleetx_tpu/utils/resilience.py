@@ -47,6 +47,11 @@ traffic drills in tests/test_serve_drills.py assert the behavior):
   ``gen_hang:K``       sleep PFX_FAULT_HANG_S (default 3600) seconds
                        inside generation request K — a wedged decode;
                        the serve watchdog flips /healthz to degraded
+  ``cb_step_hang:K``   sleep PFX_FAULT_HANG_S seconds before continuous-
+                       batching decode step K (`core/continuous_batching`
+                       fires it between steps — a mid-decode stall that
+                       carries active rows past their deadlines, driving
+                       the eviction drills in tests/test_paged_drills.py)
 
 Data sites (step counts are *sample fetch* indices inside the host data
 loader — ``data/batch_sampler.py`` fires them; the data drills in
@@ -187,7 +192,7 @@ def retry(
 
 FAULT_SITES = (
     "sigterm", "save_crash", "ckpt_truncate", "nan_grads",
-    "gen_crash", "gen_hang",
+    "gen_crash", "gen_hang", "cb_step_hang",
     "corrupt_sample", "io_stall",
 )
 
@@ -292,7 +297,7 @@ def maybe_fire(site: str, step: int, path: Optional[str] = None) -> bool:
         raise RuntimeError(
             f"PFX_FAULT: injected gen_crash at request {step}"
         )
-    elif site == "gen_hang":
+    elif site in ("gen_hang", "cb_step_hang"):
         time.sleep(_env_float("PFX_FAULT_HANG_S", 3600.0))
     elif site == "corrupt_sample":
         raise DataCorruptionError(

@@ -97,6 +97,12 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_queue_depth": ("gauge", "Requests waiting in the admission queue"),
     "pfx_queue_busy_seconds": ("gauge", "Seconds the current runner call has been executing"),
     # HTTP surface (tools/serve.py)
+    "pfx_batch_occupancy": ("gauge", "Active rows / capacity of the continuous decode batch"),
+    "pfx_kv_blocks_used": ("gauge", "Paged KV arena blocks allocated to live sequences"),
+    "pfx_kv_blocks_free": ("gauge", "Paged KV arena blocks available"),
+    "pfx_request_evictions_total": ("counter", "Rows evicted mid-decode (deadline shed frees their blocks)"),
+    "pfx_prefill_admits_total": ("counter", "Rows admitted into the running batch (prefill-on-admit)"),
+
     "pfx_http_requests_in_flight": ("gauge", "In-flight /generate requests"),
     "pfx_http_responses_total": ("counter", "HTTP responses by status code"),
     "pfx_http_client_gone_total": ("counter", "Responses lost to client disconnects"),

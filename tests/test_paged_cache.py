@@ -1,0 +1,236 @@
+"""Paged KV cache units: the pure host-side block allocator/manager
+(`core/paged_cache.py` — alloc/free/fragmentation/exhaustion, loud on
+every corruption-shaped misuse) and the block-table-indexed attention
+kernel (`ops/decode_attention.paged_decode_attention`, lax + pallas
+spellings vs a dense gather reference).  `make test-paged` runs these
+plus the continuous-batching suite and drill."""
+
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.core.paged_cache import (
+    BlockAllocator,
+    BlockPoolExhausted,
+    NULL_BLOCK,
+    PagedCacheManager,
+    blocks_for,
+    kv_block_size,
+)
+
+# ---------------------------------------------------------------------------
+# allocator (no jax: pure host bookkeeping)
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_free_roundtrip():
+    a = BlockAllocator(9)  # blocks 1..8 usable
+    got = a.alloc(3)
+    assert len(got) == 3 and NULL_BLOCK not in got
+    assert a.used_count() == 3 and a.free_count() == 5
+    a.free(got)
+    assert a.used_count() == 0 and a.free_count() == 8
+    # freed blocks are reusable
+    again = a.alloc(8)
+    assert sorted(again) == list(range(1, 9))
+
+
+def test_null_block_never_allocated():
+    a = BlockAllocator(4)
+    assert NULL_BLOCK not in a.alloc(3)
+    with pytest.raises(ValueError, match="null block"):
+        a.free([NULL_BLOCK])
+
+
+def test_exhaustion_is_loud_and_names_the_shortfall():
+    a = BlockAllocator(5)
+    a.alloc(3)
+    with pytest.raises(BlockPoolExhausted, match="need 2, have 1"):
+        a.alloc(2)
+    # the failed alloc took nothing: the remaining block is still usable
+    assert a.free_count() == 1
+    assert len(a.alloc(1)) == 1
+
+
+def test_double_free_and_bad_ids_raise():
+    a = BlockAllocator(6)
+    got = a.alloc(2)
+    a.free(got)
+    with pytest.raises(ValueError, match="double free"):
+        a.free([got[0]])
+    with pytest.raises(ValueError, match="out of range"):
+        a.free([99])
+    with pytest.raises(ValueError, match="out of range"):
+        a.free([-1])
+    # a duplicate id WITHIN one call is the same silent-aliasing hazard:
+    # accepted, it would enter the free list twice and later hand one
+    # block to two sequences
+    got2 = a.alloc(2)
+    with pytest.raises(ValueError, match="double free"):
+        a.free([got2[0], got2[0]])
+    # a loud free must be ATOMIC: nothing was freed by the failing calls
+    assert a.used_count() == 2
+    a.free(got2)
+    assert a.free_count() == 5
+
+
+def test_fragmentation_metric_and_defrag():
+    a = BlockAllocator(9)
+    rows = [a.alloc(2) for _ in range(4)]  # all 8 blocks out
+    assert a.fragmentation() == 0.0  # empty free list counts as unfragmented
+    a.free(rows[0])  # blocks 1,2
+    a.free(rows[2])  # blocks 5,6 — two separate runs of 2
+    assert a.fragmentation() == pytest.approx(0.5)
+    a.free(rows[1])  # 3,4: free space becomes one run 1..6
+    a.defrag()
+    assert a.fragmentation() == 0.0
+    # lowest-first handout keeps live allocations packed
+    assert a.alloc(3) == [1, 2, 3]
+
+
+def test_blocks_for_and_block_size_knob(monkeypatch):
+    assert blocks_for(0, 16) == 0
+    assert blocks_for(1, 16) == 1
+    assert blocks_for(16, 16) == 1
+    assert blocks_for(17, 16) == 2
+    assert kv_block_size(32) == 32
+    monkeypatch.setenv("PFX_KV_BLOCK", "24")
+    assert kv_block_size() == 24
+    monkeypatch.setenv("PFX_KV_BLOCK", "12")
+    with pytest.raises(ValueError, match="multiple of 8"):
+        kv_block_size()
+    monkeypatch.setenv("PFX_KV_BLOCK", "lots")
+    with pytest.raises(ValueError, match="not an integer"):
+        kv_block_size()
+
+
+def test_manager_admit_release_tables():
+    m = PagedCacheManager(10, block=16)
+    t1 = m.admit(1, 40)  # 3 blocks
+    assert len(t1) == 3
+    assert m.table(1, width=5) == t1 + [NULL_BLOCK, NULL_BLOCK]
+    with pytest.raises(ValueError, match="already admitted"):
+        m.admit(1, 16)
+    with pytest.raises(ValueError, match="width"):
+        m.table(1, width=2)
+    assert m.stats()["kv_blocks_used"] == 3
+    assert m.can_admit(16 * 6) and not m.can_admit(16 * 7)
+    m.release(1)
+    with pytest.raises(ValueError, match="no allocation"):
+        m.release(1)
+    assert m.stats()["kv_blocks_used"] == 0 and m.live_sequences() == 0
+
+
+def test_manager_exhaustion_keeps_bookkeeping_consistent():
+    m = PagedCacheManager(4, block=16)
+    m.admit(1, 32)  # 2 of 3 usable
+    with pytest.raises(BlockPoolExhausted):
+        m.admit(2, 32)
+    # the failed admission left no phantom sequence behind
+    assert m.live_sequences() == 1
+    m.release(1)
+    assert len(m.admit(2, 48)) == 3
+
+
+# ---------------------------------------------------------------------------
+# paged attention kernel (lax CPU-mandatory; pallas interpret-mode, slow
+# per the repo's interpret-compile convention)
+# ---------------------------------------------------------------------------
+
+LAX = pytest.param("lax", id="lax")
+PALLAS = pytest.param("pallas", id="pallas", marks=pytest.mark.slow)
+
+
+def _paged_case(rng, b, n, d, bs, M, nb):
+    import jax.numpy as jnp
+
+    k_pool = jnp.asarray(rng.normal(size=(nb, n, bs, d)), jnp.float32)
+    v_pool = jnp.asarray(rng.normal(size=(nb, n, bs, d)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(b, 1, n, d)), jnp.float32)
+    # disjoint per-row tables, shuffled so pool order != logical order
+    ids = rng.permutation(np.arange(1, nb))[: b * M].reshape(b, M)
+    tables = jnp.asarray(ids, jnp.int32)
+    return q, k_pool, v_pool, tables
+
+
+def _dense_ref(q, k_pool, v_pool, tables, positions):
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    outs = []
+    for r in range(q.shape[0]):
+        ks = jnp.concatenate([k_pool[t] for t in np.asarray(tables[r])], axis=1)
+        vs = jnp.concatenate([v_pool[t] for t in np.asarray(tables[r])], axis=1)
+        lim = int(positions[r]) + 1
+        s = jnp.einsum("nd,nkd->nk", q[r, 0], ks[:, :lim]) / np.sqrt(d)
+        outs.append(jnp.einsum(
+            "nk,nkd->nd", jax.nn.softmax(s, axis=-1), vs[:, :lim]
+        ))
+    return jnp.stack(outs)[:, None]
+
+
+@pytest.mark.parametrize("impl", [LAX, PALLAS])
+def test_paged_attention_matches_dense_gather(impl):
+    import jax.numpy as jnp
+
+    from paddlefleetx_tpu.ops.decode_attention import paged_decode_attention
+
+    rng = np.random.default_rng(0)
+    q, k_pool, v_pool, tables = _paged_case(rng, b=3, n=2, d=8, bs=8, M=4, nb=16)
+    positions = jnp.asarray([17, 9, 30], jnp.int32)  # per-row lengths differ
+    got = paged_decode_attention(q, k_pool, v_pool, tables, positions, impl=impl)
+    want = _dense_ref(q, k_pool, v_pool, tables, positions)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", [LAX, PALLAS])
+def test_paged_attention_never_reads_past_a_rows_limit(impl):
+    """NaN-poison proof (the PR 1 convention): every pool block WHOLLY
+    beyond a row's visit bound ``ceil((pos+1)/bs)`` is poisoned with NaN
+    — table padding a fori bound or a DMA clamp must never gather.  The
+    kernel must stay finite AND equal the unpoisoned result, or it read
+    blocks it has no business touching.  (Within a visited block, masked
+    tail slots follow the stale-tail contract: they hold stale-but-
+    finite values in real traffic, same as the contiguous kernel.)"""
+    import jax.numpy as jnp
+
+    from paddlefleetx_tpu.ops.decode_attention import paged_decode_attention
+
+    rng = np.random.default_rng(1)
+    bs, M = 8, 4
+    q, k_pool, v_pool, tables = _paged_case(rng, b=2, n=2, d=8, bs=bs, M=M, nb=12)
+    positions = jnp.asarray([10, 3], jnp.int32)
+    clean = paged_decode_attention(q, k_pool, v_pool, tables, positions, impl=impl)
+
+    kp, vp = np.array(k_pool), np.array(v_pool)
+    for r, pos in enumerate([10, 3]):
+        first_unvisited = -(-(pos + 1) // bs)
+        for j in range(first_unvisited, M):
+            blk = int(tables[r, j])
+            kp[blk] = np.nan
+            vp[blk] = np.nan
+    poisoned = paged_decode_attention(
+        q, jnp.asarray(kp), jnp.asarray(vp), tables, positions, impl=impl
+    )
+    assert np.all(np.isfinite(np.asarray(poisoned)))
+    np.testing.assert_allclose(
+        np.asarray(poisoned), np.asarray(clean), atol=1e-6
+    )
+
+
+def test_paged_attention_rejects_multi_token_chunks():
+    import jax.numpy as jnp
+
+    from paddlefleetx_tpu.ops.decode_attention import paged_decode_attention
+
+    rng = np.random.default_rng(2)
+    q, k_pool, v_pool, tables = _paged_case(rng, b=1, n=1, d=8, bs=8, M=2, nb=4)
+    q2 = jnp.concatenate([q, q], axis=1)  # t=2
+    with pytest.raises(ValueError, match="t=1"):
+        paged_decode_attention(
+            q2, k_pool, v_pool, tables, jnp.asarray([3], jnp.int32)
+        )
+    with pytest.raises(ValueError, match="valid: auto"):
+        paged_decode_attention(
+            q, k_pool, v_pool, tables, jnp.asarray([3], jnp.int32), impl="cuda"
+        )

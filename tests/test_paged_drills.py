@@ -1,0 +1,241 @@
+"""Continuous-batching serve drills through the real CLI
+(`make test-paged`): tools/serve.py with ``--scheduler continuous`` must
+keep the PR 3 serving contracts on the paged engine, plus the new one —
+a mid-decode deadline EVICTION frees the row's KV blocks and later
+requests still produce token-identical greedy output.
+
+Follows tests/test_serve_drills.py conventions: ``fault``-marked,
+subprocess-driven, tiny synthetic GPT, persistent XLA compile cache
+shared through the environment (tests/conftest.py)."""
+
+import json
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+import os
+
+import pytest
+import yaml
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = {
+    "Global": {"global_batch_size": 8, "seed": 11},
+    "Engine": {"mix_precision": {"enable": False},
+               "save_load": {"save_steps": 0}},
+    "Model": {
+        "module": "GPTModule",
+        "vocab_size": 96,
+        "hidden_size": 32,
+        "num_layers": 2,
+        "num_attention_heads": 4,
+        "max_position_embeddings": 64,
+        "dtype": "float32",
+    },
+    "Optimizer": {"name": "FusedAdamW",
+                  "lr": {"name": "Constant", "learning_rate": 1e-3}},
+    "Generation": {"max_dec_len": 8, "decode_strategy": "greedy_search",
+                   "pad_to_multiple": 8, "eos_token_id": 95,
+                   "pad_token_id": 0},
+}
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _post(port, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _healthz(port, timeout=10):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=timeout
+    ) as r:
+        return json.load(r)
+
+
+def _metrics(port, timeout=10):
+    from test_telemetry import parse_prometheus
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=timeout
+    ) as r:
+        metrics, _ = parse_prometheus(r.read().decode())
+    return {name: vals[frozenset()] for name, vals in metrics.items()
+            if frozenset() in vals}
+
+
+def _start_server(tmp_path, *, deadline=45.0, depth=32, shed_slack=3.0,
+                  watchdog=300.0, extra_env=None, extra_args=()):
+    cfg_path = tmp_path / "tiny_cb.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    port = _free_port()
+    env = dict(os.environ)
+    env["PFX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("PFX_FAULT", None)
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "-c", str(cfg_path), "--port", str(port),
+         "--scheduler", "continuous", "--cb-batch", "4",
+         "--queue-depth", str(depth),
+         "--deadline", str(deadline), "--shed-slack", str(shed_slack),
+         "--watchdog", str(watchdog), "--warmup-buckets", "4",
+         *extra_args],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    deadline_t = time.time() + 300
+    while time.time() < deadline_t:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"server died at boot: {proc.stdout.read()[-3000:]}"
+            )
+        try:
+            h = _healthz(port, timeout=5)
+            if h.get("ok"):
+                return proc, port
+        except Exception:
+            time.sleep(0.5)
+    proc.kill()
+    raise AssertionError("server never became healthy")
+
+
+def _finish(proc, timeout=30):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+    return proc.stdout.read()
+
+
+def test_continuous_mid_decode_eviction_frees_blocks_token_identical(tmp_path):
+    """THE paged-serving drill: a wedged decode step (cb_step_hang)
+    carries a short-deadline request past its deadline MID-decode; the
+    scheduler evicts the row (503, eviction + shed counters, blocks
+    freed back to the pool), and the server then answers identical
+    requests with token-identical greedy output — the arena was reused,
+    not poisoned.  The continuous warmup consumes step 1, so the first
+    traffic decode step is 2."""
+    proc, port = _start_server(
+        tmp_path, deadline=45.0, shed_slack=3.0,
+        extra_env={"PFX_FAULT": "cb_step_hang:2",
+                   "PFX_FAULT_HANG_S": "5"},
+    )
+    try:
+        # doomed: expires inside the 5s wedge of its own first step
+        t0 = time.monotonic()
+        code, resp = _post(
+            port,
+            {"prompt_ids": [1, 2, 3], "max_tokens": 8, "deadline_s": 1.5},
+            timeout=60,
+        )
+        assert code == 503, (code, resp)
+        assert time.monotonic() - t0 < 20  # honest shed, not a hang
+
+        # the eviction lands once the wedge clears: blocks return to the
+        # pool and the scheduler keeps serving
+        t_end = time.time() + 30
+        m = {}
+        while time.time() < t_end:
+            m = _metrics(port)
+            if m.get("pfx_request_evictions_total", 0) >= 1:
+                break
+            time.sleep(0.5)
+        assert m.get("pfx_request_evictions_total", 0) >= 1, m
+        assert m.get("pfx_queue_shed_deadline_total", 0) >= 1, m
+
+        body = {"prompt_ids": [1, 2, 3], "max_tokens": 8, "deadline_s": 45}
+        code2, resp2 = _post(port, body, timeout=90)
+        assert code2 == 200, (code2, resp2)
+        code3, resp3 = _post(port, body, timeout=90)
+        assert code3 == 200, (code3, resp3)
+        # token-identical greedy across the eviction: freed blocks were
+        # recycled without cache corruption
+        assert resp2["completion_ids"] == resp3["completion_ids"]
+
+        m = _metrics(port)
+        # all rows retired: arena fully free, batch empty
+        assert m["pfx_kv_blocks_used"] == 0, m
+        assert m["pfx_batch_occupancy"] == 0, m
+        assert m["pfx_kv_blocks_free"] > 0, m
+        assert m["pfx_prefill_admits_total"] >= 3, m  # warmup + 3 admits
+        h = _healthz(port)
+        assert h["state"] == "ok" and h["queue_depth"] == 0, h
+        assert h["queue"]["shed_deadline"] >= 1, h
+
+        # graceful drain still holds on the continuous scheduler
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        assert rc == 0, rc
+    finally:
+        log = _finish(proc)
+    assert "evicted" in log, log[-3000:]
+    assert "Traceback" not in log, log[-3000:]
+
+
+@pytest.mark.slow  # a second full server boot; the mid-decode-eviction
+# drill above is the ISSUE acceptance drill and stays in tier-1, this
+# staggered-traffic variant runs in make test-paged / test-all
+def test_continuous_staggered_arrivals_all_served_and_batched(tmp_path):
+    """Requests arriving while the batch is mid-decode are admitted at
+    step boundaries (prefill admits grow while earlier requests are
+    still decoding) and every response is token-identical to the same
+    prompt served alone."""
+    proc, port = _start_server(tmp_path, deadline=60.0)
+    try:
+        import threading
+
+        # reference: served alone
+        body = {"prompt_ids": [5, 6, 7], "max_tokens": 8, "deadline_s": 60}
+        code, ref = _post(port, body, timeout=90)
+        assert code == 200, (code, ref)
+
+        n = 6
+        results = [None] * n
+
+        def worker(i):
+            time.sleep(0.05 * i)  # staggered arrivals
+            results[i] = _post(port, body, timeout=120)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "hung connection"
+        for code_i, resp_i in results:
+            assert code_i == 200, (code_i, resp_i)
+            assert resp_i["completion_ids"] == ref["completion_ids"]
+
+        m = _metrics(port)
+        assert m["pfx_prefill_admits_total"] >= n + 1, m
+        assert m["pfx_kv_blocks_used"] == 0, m
+        h = _healthz(port)
+        assert h["queue"]["completed"] >= n + 1, h
+    finally:
+        log = _finish(proc)
+    assert "Traceback" not in log, log[-3000:]

@@ -92,10 +92,16 @@ def test_moco_momentum_starts_as_copy(moco_bits):
 
 
 def test_moco_loss_and_queue_update(moco_bits):
+    # NON-degenerate inputs: 8 identical constant images collapse to the
+    # exact-zero feature (global-batch BN at 1x1 spatial sees zero
+    # variance and emits its zero bias), whose keys CANNOT be unit-norm —
+    # the invariant under test needs real images; the degenerate case has
+    # its own finiteness regression below
     params, extra = moco_bits
+    rng = np.random.default_rng(5)
     batch = {
-        "img_q": jnp.ones((8, 32, 32, 3)) * 0.1,
-        "img_k": jnp.ones((8, 32, 32, 3)) * 0.2,
+        "img_q": jnp.asarray(rng.normal(0, 1, (8, 32, 32, 3)).astype(np.float32)),
+        "img_k": jnp.asarray(rng.normal(0, 1, (8, 32, 32, 3)).astype(np.float32)),
     }
     loss, new_extra = jax.jit(
         lambda p, b, e: moco.loss_fn(
@@ -151,6 +157,35 @@ def test_moco_grads_only_touch_base(moco_bits):
         for g in jax.tree.leaves(extra_grads[path]):
             if jnp.issubdtype(g.dtype, jnp.floating):
                 assert float(jnp.max(jnp.abs(g))) == 0.0
+
+
+def test_moco_degenerate_batch_stays_finite(moco_bits):
+    """Regression for the seed NaN pair: a batch of identical constant
+    images drives every stage-4 BatchNorm to zero variance (1x1 spatial,
+    identical rows), so the encoder emits the EXACT zero feature.  The
+    old ``q / (||q|| + eps)`` normalization has a 0/0 = NaN gradient at
+    zero, which poisoned the whole batch's gradients; the safe-rsqrt
+    normalization must keep both the loss and the full gradient finite
+    (and nonzero — the classifier bias path still carries signal)."""
+    params, extra = moco_bits
+    batch = {
+        "img_q": jnp.ones((8, 32, 32, 3)) * 0.1,
+        "img_k": jnp.ones((8, 32, 32, 3)) * 0.3,
+    }
+    loss, _ = moco.loss_fn(
+        params, batch, TINY_MOCO, extra, dropout_key=jax.random.key(1), train=True
+    )
+    assert np.isfinite(float(loss))
+    grads = jax.grad(
+        lambda p: moco.loss_fn(
+            p, batch, TINY_MOCO, extra, dropout_key=jax.random.key(3), train=True
+        )[0]
+    )(params)
+    flat = np.concatenate(
+        [np.asarray(g).ravel() for g in jax.tree.leaves(grads)]
+    )
+    assert np.all(np.isfinite(flat)), "NaN/inf gradient on degenerate batch"
+    assert float(np.sum(flat**2)) > 0.0
 
 
 def test_moco_engine_end_to_end(tmp_path):

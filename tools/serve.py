@@ -158,11 +158,55 @@ def _record_request_span(reg, recorder, t0, fut, code, tokens=None):
     recorder.record(span.event(code=code, tokens=tokens))
 
 
+def build_scheduler(server, scheduler: str, *, queue_depth: int,
+                    max_coalesce: int, cb_batch: int = 8,
+                    kv_blocks: int = 0, name: str = "serve"):
+    """Construct the serving scheduler behind ``--scheduler``:
+
+    - ``coalesce`` (default): the PR 3 `RequestQueue` — same-bucket
+      waiting requests merge into one batched decode.
+    - ``continuous``: iteration-level scheduling over the block-paged KV
+      cache (`core/continuous_batching.py`) — rows join and leave the
+      running decode batch at every step boundary, so a request arriving
+      mid-decode no longer waits a full decode (head-of-line blocking).
+      Flips to the default once the paged drills have soaked on a chip
+      window (docs/serving.md).
+
+    Both expose the same surface (submit/try_remove/depth/busy_seconds/
+    close/join/stats), so the HTTP layer below is scheduler-agnostic."""
+    from paddlefleetx_tpu.core.request_queue import RequestQueue
+
+    if scheduler == "coalesce":
+        return RequestQueue(
+            lambda prompts, max_new: server.generate_ids(
+                prompts, max_dec_len=max_new
+            ),
+            max_depth=queue_depth, max_coalesce=max_coalesce, name=name,
+        )
+    if scheduler == "continuous":
+        from paddlefleetx_tpu.core.continuous_batching import (
+            ContinuousScheduler,
+            PagedDecodeEngine,
+        )
+
+        engine = PagedDecodeEngine(
+            server, max_batch=cb_batch, num_blocks=kv_blocks
+        )
+        return ContinuousScheduler(
+            engine, max_depth=queue_depth, name=name
+        )
+    raise ValueError(
+        f"unknown scheduler {scheduler!r}; valid: coalesce, continuous"
+    )
+
+
 def serve_http(server, port: int, host: str = "127.0.0.1", *,
                queue_depth: int = 64, max_coalesce: int = 8,
                default_deadline_s: float = 120.0, max_deadline_s: float = 600.0,
                shed_slack_s: float = 2.0,
-               watchdog_s: float = 300.0, max_tokens_cap: int = 0):
+               watchdog_s: float = 300.0, max_tokens_cap: int = 0,
+               scheduler: str = "coalesce", cb_batch: int = 8,
+               kv_blocks: int = 0, cb_warmup=()):
     import signal
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -171,7 +215,6 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         DeadlineExceeded,
         QueueClosed,
         QueueFull,
-        RequestQueue,
     )
     from paddlefleetx_tpu.utils.telemetry import (
         get_flight_recorder,
@@ -189,15 +232,16 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     context = int(server.module.config.max_position_embeddings)
     bucket = server.bucket
 
-    # the scheduler thread is the ONLY caller of generate_ids once
-    # traffic starts: generation mutates server state (RNG key split,
-    # stats, cache pool) and shares one compiled-artifact cache, so the
-    # queue replaces the old global gen_lock outright
-    queue = RequestQueue(
-        lambda prompts, max_new: server.generate_ids(
-            prompts, max_dec_len=max_new
-        ),
-        max_depth=queue_depth, max_coalesce=max_coalesce, name="serve",
+    # the scheduler thread is the ONLY caller of generation once traffic
+    # starts: generation mutates server state (RNG key split, stats,
+    # cache pool / paged arena) and shares one compiled-artifact cache,
+    # so the queue replaces the old global gen_lock outright.  Behind
+    # --scheduler this is either the PR 3 coalescing RequestQueue or the
+    # continuous-batching ContinuousScheduler (same surface).
+    queue = build_scheduler(
+        server, scheduler, queue_depth=queue_depth,
+        max_coalesce=max_coalesce, cb_batch=cb_batch, kv_blocks=kv_blocks,
+        name="serve",
     )
 
     # in-flight /generate requests (admission + wait + response write);
@@ -409,6 +453,11 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                         {"error": "draining: not admitting new requests"},
                         headers={"Retry-After": "5"},
                     )
+                except ValueError as e:
+                    # continuous-scheduler pre-admission validation: the
+                    # request could NEVER fit the KV pool — a client-side
+                    # misconfiguration, not a server error
+                    return self._json(400, {"error": str(e)})
                 # ---- wait, bounded by the deadline + scheduling slack:
                 # an unanswerable request gets an honest 503, never a
                 # hung connection ----
@@ -545,12 +594,17 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         print("warning: not on the main thread; graceful drain handlers "
               "unavailable", flush=True)
 
+    if cb_warmup and scheduler == "continuous":
+        # compile (prefill, step) per bucket BEFORE the listener opens —
+        # the continuous counterpart of the coalesce-path server.warmup
+        queue.warmup([int(n) for n in cb_warmup])
     queue.start()
     threading.Thread(target=_watchdog, name="serve-watchdog",
                      daemon=True).start()
     print(
         f"serving on {host}:{port} (POST /generate, GET /healthz; "
-        f"queue depth {queue_depth}, coalesce {max_coalesce}, "
+        f"scheduler {scheduler}, queue depth {queue_depth}, "
+        f"coalesce {max_coalesce}, "
         f"deadline {default_deadline_s:g}s, watchdog {watchdog_s:g}s)",
         flush=True,
     )
@@ -624,10 +678,41 @@ def main(argv=None):
                     help="hard per-request max_tokens ceiling (0 = use "
                     "Generation.max_tokens_cap from the config, which "
                     "defaults to uncapped-within-context)")
+    ap.add_argument("--scheduler", choices=("coalesce", "continuous"),
+                    default="coalesce",
+                    help="serving scheduler: 'coalesce' batches same-"
+                    "bucket WAITING requests (PR 3); 'continuous' is "
+                    "iteration-level scheduling over the block-paged KV "
+                    "cache — requests join/leave the running decode "
+                    "batch at step boundaries (docs/serving.md; flips "
+                    "to default after chip-window soak)")
+    ap.add_argument("--cb-batch", type=int, default=8,
+                    help="continuous scheduler: running-batch row "
+                    "capacity (fixed compile shape)")
+    ap.add_argument("--kv-blocks", type=int, default=0,
+                    help="continuous scheduler: total KV arena blocks "
+                    "(0 = auto: cb-batch full-context rows + null "
+                    "block); block size via PFX_KV_BLOCK")
     args = ap.parse_args(argv)
 
+    if args.scheduler == "continuous" and not args.port:
+        # the REPL serves one prompt at a time through the contiguous
+        # path — iteration-level scheduling only exists behind --port.
+        # Fall back loudly rather than silently skipping warmup.
+        print(
+            "warning: --scheduler continuous requires --port (HTTP "
+            "serving); REPL mode uses the contiguous path",
+            file=sys.stderr, flush=True,
+        )
+        args.scheduler = "coalesce"
+
     server = build_server(args.config, args.override)
-    if not args.no_warmup:
+    if not args.no_warmup and args.scheduler == "continuous":
+        # the coalesce-path warmup would compile artifacts continuous
+        # serving never calls; the engine warms its own (prefill, step)
+        # pairs inside serve_http before the listener opens
+        pass
+    elif not args.no_warmup:
         batches = _csv_ints(args.warmup_batches)
         if not batches and args.port:
             # HTTP serving coalesces: warm every power-of-two batch
@@ -645,6 +730,9 @@ def main(argv=None):
         )
 
     if args.port:
+        cb_warmup = ()
+        if args.scheduler == "continuous" and not args.no_warmup:
+            cb_warmup = tuple(_csv_ints(args.warmup_buckets) or [8])
         return serve_http(
             server, args.port, args.host,
             queue_depth=args.queue_depth,
@@ -654,6 +742,10 @@ def main(argv=None):
             shed_slack_s=args.shed_slack,
             watchdog_s=args.watchdog,
             max_tokens_cap=args.max_tokens_cap,
+            scheduler=args.scheduler,
+            cb_batch=args.cb_batch,
+            kv_blocks=args.kv_blocks,
+            cb_warmup=cb_warmup,
         )
 
     # REPL: one prompt per line -> completion (ids mode when no tokenizer)
