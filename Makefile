@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged bench
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-spec bench
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -32,7 +32,7 @@ MID_EXTRA = tests/test_engine.py tests/test_generation.py tests/test_moe.py \
             tests/test_vision.py tests/test_auto_tune.py tests/test_check.py \
             tests/test_compression_profiler.py tests/test_hf_convert.py \
             tests/test_long_context.py tests/test_paged_cache.py \
-            tests/test_continuous_batching.py
+            tests/test_continuous_batching.py tests/test_speculative.py
 test-mid:
 	python -m pytest $(FAST_FILES) $(MID_EXTRA) -q -m "not slow" -x
 	python -m pytest "tests/test_pipeline.py::test_pipeline_1f1b_train_loss_and_grads[2-extra1-4-1]" -q
@@ -82,6 +82,15 @@ test-obs:
 # tests/.jax_cache like every other drill family)
 test-paged:
 	python -m pytest tests/test_paged_cache.py tests/test_continuous_batching.py tests/test_paged_drills.py -q
+
+# speculative-decoding + KV-quant gate: drafter/accept units, greedy
+# parity (contiguous + paged, incl. full-rejection iterations), int8
+# kernel tolerance + arena-bytes halving, the sampled
+# distribution-preservation statistical test, serving-config routing,
+# and the spec/kvint8 decode-bench A/B contract (docs/decode_path.md)
+test-spec:
+	python -m pytest tests/test_speculative.py -q
+	python -m pytest tests/test_bench_contract.py -q -k "decode"
 
 bench:
 	python benchmarks/run_benchmark.py

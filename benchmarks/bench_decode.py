@@ -66,6 +66,13 @@ CASES = {
     "b32_greedy_legacy": (32, "greedy_search", True),
     "b32_topp": (32, "sampling", False),
     "b32_topp_legacy": (32, "sampling", True),
+    # speculative + quantized A/B rows: each case runs its OWN baseline
+    # on the same prompts and reports both sides in one row (value =
+    # the feature side; baseline_tokens_per_s alongside).  The spec case
+    # uses a REPETITIVE prompt — the self-draft lookup's best case, the
+    # regime the acceptance contract pins (accept_rate >= 0.5).
+    "b8_greedy_spec4": (8, "greedy_search", False),
+    "b8_greedy_kvint8": (8, "greedy_search", False),
     "serving": (None, None, False),  # GenerationServer bucketed-batch traffic
     # staggered-arrival A/B: the SAME fixed-seed Poisson-ish request
     # trace through the continuous-batching scheduler vs the PR 3
@@ -186,6 +193,154 @@ def run_decode_case(name: str, args, params_cache: dict) -> dict:
         "decode_path": "legacy(dense+scan)" if legacy else "overhauled",
         "per_token_ms": round(dt / args.dec * 1e3, 3),
         **_mfu_fields(cfg, batch * args.dec / dt),
+        "platform": jax.default_backend(),
+    }
+
+
+def _delivered(rows, eos_token_id: int) -> int:
+    """Delivered tokens (cut at EOS) — both A/B sides of a greedy pair
+    deliver the same count when token-identical, and the honest count
+    when not."""
+    total = 0
+    for row in rows.tolist():
+        if eos_token_id in row:
+            row = row[: row.index(eos_token_id)]
+        total += len(row)
+    return total
+
+
+def run_spec_case(name: str, args, params_cache: dict) -> dict:
+    """Speculative-vs-baseline A/B on the SAME repetitive prompts: one
+    row whose ``value`` is the speculative tokens/s, carrying the
+    baseline rate, the measured acceptance rate, and the count of rows
+    whose greedy output diverged (must be 0 — greedy speculation is
+    token-identical by construction; bf16 near-ties are counted, not
+    hidden)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddlefleetx_tpu.models.gpt import model as gpt
+    from paddlefleetx_tpu.models.gpt.generation import GenerationConfig, generate
+    from paddlefleetx_tpu.ops.speculative import SpecConfig
+
+    batch, strategy, _ = CASES[name]
+    k = int(name.rsplit("spec", 1)[1])
+    # a floor on the decode window: acceptance is a STEADY-STATE metric —
+    # the first iteration's drafts derive from the prompt before the
+    # model's own output loop establishes, so a handful of decode steps
+    # under-reports the rate every longer window sustains (the row
+    # reports the dec_len it actually ran)
+    dec = max(int(args.dec), 24)
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        _gpt_cfg(args), max_position_embeddings=args.prompt + dec
+    )
+    gen = GenerationConfig(decode_strategy=strategy, max_dec_len=dec)
+    # the extended context keys its own position table: params are cached
+    # per context length (the plain cases keep sharing theirs)
+    pkey = ("params", cfg.max_position_embeddings)
+    if pkey not in params_cache:
+        params_cache[pkey] = gpt.init(cfg, jax.random.key(0))
+    params = params_cache[pkey]
+    # repetitive prompt: a short token cycle fills the window, so the
+    # n-gram lookup's needle always has an earlier occurrence
+    cycle = np.array([11, 23, 7, 41], np.int32)
+    prompt_row = np.tile(cycle, -(-args.prompt // len(cycle)))[: args.prompt]
+    prompts = jnp.asarray(np.tile(prompt_row, (batch, 1)))
+    key = jax.random.key(2)
+    spec = SpecConfig(draft_k=k)
+
+    from bench import host_fence, knob_env
+
+    with knob_env(_OVERHAUL_ENV):
+        base_fn = jax.jit(lambda p, ids, kk: generate(p, ids, cfg, gen, key=kk))
+        spec_fn = jax.jit(lambda p, ids, kk: generate(
+            p, ids, cfg, gen, key=kk, spec=spec, return_spec_stats=True))
+        base_out = base_fn(params, prompts, key)
+        host_fence(base_out)  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            host_fence(base_fn(params, prompts, key))
+        dt_base = (time.perf_counter() - t0) / args.iters
+        spec_out, (prop, acc) = spec_fn(params, prompts, key)
+        host_fence(spec_out)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            host_fence(spec_fn(params, prompts, key)[0])
+        dt_spec = (time.perf_counter() - t0) / args.iters
+
+    base_rows = np.asarray(base_out)
+    spec_rows = np.asarray(spec_out)
+    divergent = int((base_rows != spec_rows).any(axis=1).sum())
+    delivered = _delivered(spec_rows, gen.eos_token_id)
+    prop, acc = int(prop), int(acc)
+    toks = delivered / dt_spec
+    return {
+        "metric": _metric(name), "value": round(toks, 1),
+        "unit": "new tokens/s/chip (speculative)", "vs_baseline": None,
+        "batch": batch, "prompt_len": args.prompt, "dec_len": dec,
+        "strategy": strategy, "decode_path": "overhauled",
+        "draft_k": k, "drafter": "ngram",
+        "baseline_tokens_per_s": round(delivered / dt_base, 1),
+        "speedup": round(dt_base / dt_spec, 3),
+        "accept_rate": round(acc / prop, 4) if prop else 0.0,
+        "spec_proposed": prop, "spec_accepted": acc,
+        "greedy_divergent_rows": divergent,
+        **_mfu_fields(cfg, toks),
+        "platform": jax.default_backend(),
+    }
+
+
+def run_kvint8_case(name: str, args, params_cache: dict) -> dict:
+    """int8-KV-vs-native A/B on the same prompts: ``value`` is the int8
+    tokens/s (the HBM-bytes win is chip evidence — CPU rows pay the
+    dequant multiplies without the bandwidth relief), with the native
+    rate and honest divergence count alongside."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddlefleetx_tpu.models.gpt import model as gpt
+    from paddlefleetx_tpu.models.gpt.generation import GenerationConfig, generate
+
+    batch, strategy, _ = CASES[name]
+    cfg = _gpt_cfg(args)
+    gen = GenerationConfig(decode_strategy=strategy, max_dec_len=args.dec)
+    if "params" not in params_cache:
+        params_cache["params"] = gpt.init(cfg, jax.random.key(0))
+    params = params_cache["params"]
+    prompts = jax.random.randint(
+        jax.random.key(1), (batch, args.prompt), 0, cfg.vocab_size
+    )
+    key = jax.random.key(2)
+
+    from bench import host_fence, knob_env
+
+    outs, rates = {}, {}
+    for kv in ("bf16", "int8"):
+        with knob_env({**_OVERHAUL_ENV, "PFX_KV_DTYPE": kv}):
+            fn = jax.jit(lambda p, ids, kk: generate(p, ids, cfg, gen, key=kk))
+            out = fn(params, prompts, key)
+            host_fence(out)  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                host_fence(fn(params, prompts, key))
+            dt = (time.perf_counter() - t0) / args.iters
+            outs[kv] = np.asarray(out)
+            rates[kv] = _delivered(outs[kv], gen.eos_token_id) / dt
+
+    divergent = int((outs["bf16"] != outs["int8"]).any(axis=1).sum())
+    return {
+        "metric": _metric(name), "value": round(rates["int8"], 1),
+        "unit": "new tokens/s/chip (int8 KV cache)", "vs_baseline": None,
+        "batch": batch, "prompt_len": args.prompt, "dec_len": args.dec,
+        "strategy": strategy, "decode_path": "overhauled",
+        "kv_dtype": "int8",
+        "baseline_tokens_per_s": round(rates["bf16"], 1),
+        "divergent_rows": divergent,
+        **_mfu_fields(cfg, rates["int8"]),
         "platform": jax.default_backend(),
     }
 
@@ -498,6 +653,10 @@ def _child(argv) -> None:
                 rows = [run_serving_case(args)]
             elif name == "staggered":
                 rows = run_staggered_case(args)
+            elif "_spec" in name:
+                rows = [run_spec_case(name, args, params_cache)]
+            elif name.endswith("_kvint8"):
+                rows = [run_kvint8_case(name, args, params_cache)]
             else:
                 rows = [run_decode_case(name, args, params_cache)]
         except Exception as e:  # noqa: BLE001 — an OOM on b32 must not
@@ -517,7 +676,7 @@ def _argparser():
         "--cases",
         default="b8_greedy,b8_greedy_legacy,b8_topp,b8_topp_legacy,"
                 "b32_greedy,b32_greedy_legacy,b32_topp,b32_topp_legacy,"
-                "serving,staggered",
+                "b8_greedy_spec4,b8_greedy_kvint8,serving,staggered",
     )
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--dec", type=int, default=256)

@@ -64,6 +64,8 @@ from paddlefleetx_tpu.core.request_queue import (
     QueueFull,
     RequestFuture,
 )
+from paddlefleetx_tpu.ops.decode_attention import kv_cache_dtype
+from paddlefleetx_tpu.ops.speculative import SpecConfig, ngram_propose_host
 from paddlefleetx_tpu.utils.log import logger
 from paddlefleetx_tpu.utils.resilience import maybe_fire
 from paddlefleetx_tpu.utils.telemetry import StatsView, get_registry
@@ -98,6 +100,9 @@ class _Row:
     max_new: int
     table: List[int]
     tokens: List[int] = dataclasses.field(default_factory=list)
+    # prompt ids kept host-side for the self-drafting n-gram lookup
+    # (the speculative drafter reads prompt + tokens between steps)
+    prompt_ids: List[int] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass(eq=False)
@@ -131,7 +136,7 @@ class PagedDecodeEngine:
     """
 
     def __init__(self, server, *, max_batch: int = 8, block: int = 0,
-                 num_blocks: int = 0) -> None:
+                 num_blocks: int = 0, spec="auto", kv_dtype: str = "") -> None:
         from paddlefleetx_tpu.models.gpt.generation import init_paged_pools
         from paddlefleetx_tpu.parallel.mesh import data_parallel_world
 
@@ -142,8 +147,23 @@ class PagedDecodeEngine:
         self.mesh = server.mesh
         self.bucket = server.bucket
         self.block = kv_block_size(block)
+        # speculation + KV quantization: default ("auto"/"") inherits the
+        # server's ALREADY-PARSED Generation.speculative settings (ONE
+        # parse site — core/serving.py — so both schedulers can never
+        # drift apart on the same config); explicit args override (None
+        # disables speculation)
+        if spec == "auto":
+            spec = server.spec
+        if spec is not None and not isinstance(spec, SpecConfig):
+            raise ValueError(f"spec must be a SpecConfig or None, got {spec!r}")
+        self.spec = spec
+        self.kv_dtype = (
+            kv_cache_dtype(kv_dtype) if kv_dtype else server.kv_dtype
+        )
         context = int(self.mcfg.max_position_embeddings)
-        self.max_row_blocks = blocks_for(context, self.block)
+        self.max_row_blocks = blocks_for(
+            context + (self.spec.draft_k if self.spec else 0), self.block
+        )
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         dpw = data_parallel_world(self.mesh)
@@ -153,7 +173,9 @@ class PagedDecodeEngine:
         if num_blocks <= 0:
             num_blocks = self.capacity * self.max_row_blocks + 1
         self.cache = PagedCacheManager(num_blocks, self.block)
-        self.pools = init_paged_pools(self.mcfg, num_blocks, self.block)
+        self.pools = init_paged_pools(
+            self.mcfg, num_blocks, self.block, kv_dtype=self.kv_dtype
+        )
 
         import jax
         import jax.numpy as jnp
@@ -164,6 +186,7 @@ class PagedDecodeEngine:
         B = self.capacity
         self._logits = jnp.zeros((B, vocab), jnp.float32)
         self._counts = jnp.zeros((B, vocab), jnp.int32)
+        self._reject = jnp.full((B,), -1, jnp.int32)
         self.positions = np.zeros((B,), np.int32)
         self.gen_steps = np.zeros((B,), np.int32)
         self.max_news = np.zeros((B,), np.int32)
@@ -175,7 +198,10 @@ class PagedDecodeEngine:
         self._compiled_prefill: Dict = {}
         # trace-time entries across BOTH compiled families — the bounded-
         # retrace contract's probe, like GenerationServer.stats["traces"]
-        self.stats: Dict[str, Any] = {"traces": 0, "steps": 0, "prefills": 0}
+        self.stats: Dict[str, Any] = {
+            "traces": 0, "steps": 0, "prefills": 0,
+            "spec_proposed": 0, "spec_accepted": 0,
+        }
         self._key = jax.random.fold_in(
             jax.random.key(int(server.cfg.get("Global", {}).get("seed", 0))),
             0x9a6ed,
@@ -189,12 +215,28 @@ class PagedDecodeEngine:
         """Cache slots a row reserves: its full decode budget plus the
         prefill bucket width (pad junk lands in the row's own blocks).
         The budget is clamped to the context room like admit() clamps it
-        (plan_decode's trim), so reservation == allocation."""
+        (plan_decode's trim), so reservation == allocation.  With
+        speculation on, draft_k slack slots absorb the verify chunk's
+        rejected-tail overrun (paged_forward_step also null-routes any
+        write past the table — belt and braces)."""
         from paddlefleetx_tpu.models.gpt.generation import bucket_len
 
         P = bucket_len(prompt_len, self.bucket)
         limit = int(self.mcfg.max_position_embeddings) - P
-        return max(prompt_len + min(max_new, max(1, limit)), P)
+        slack = self.spec.draft_k if self.spec else 0
+        return max(prompt_len + min(max_new, max(1, limit)) + slack, P)
+
+    def kv_block_bytes(self) -> int:
+        """K+V payload bytes per arena block (what the decode kernels
+        stream from HBM; int8 halves this vs bf16).  The per-(slot,
+        head) scale planes are excluded — they are the small constant
+        overhead documented in docs/decode_path.md."""
+        k = self.pools.k
+        layers, _, heads, bs, d = k.shape
+        return 2 * layers * heads * bs * d * k.dtype.itemsize
+
+    def _pools_tuple(self):
+        return tuple(x for x in self.pools if x is not None)
 
     def free_slots(self) -> int:
         return sum(1 for r in self.slots if r is None)
@@ -220,6 +262,9 @@ class PagedDecodeEngine:
             )
 
     # -- compiled entry points -----------------------------------------
+    # the arena rides through both families as ONE donated pytree arg
+    # (k, v[, k_scale, v_scale]) so the int8 scale planes donate with
+    # their payload
     def _prefill_fn(self, P: int, PB: int):
         key = (self._gen_key, P, PB)
         fn = self._compiled_prefill.get(key)
@@ -229,15 +274,16 @@ class PagedDecodeEngine:
                 paged_prefill,
             )
 
-            def traced(p, prompt, plen, kp, vp, table_row):
+            def traced(p, prompt, plen, pools_t, table_row):
                 self.stats["traces"] += 1
                 pools, last, counts = paged_prefill(
-                    p, prompt, plen, PagedPools(kp, vp), table_row,
+                    p, prompt, plen, PagedPools(*pools_t), table_row,
                     self.mcfg, ctx=self.ctx,
                 )
-                return pools.k, pools.v, last, counts
+                out = tuple(x for x in pools if x is not None)
+                return out, last, counts
 
-            fn = self._jax.jit(traced, donate_argnums=(3, 4))
+            fn = self._jax.jit(traced, donate_argnums=(3,))
             self._compiled_prefill[key] = fn
             get_registry().counter("pfx_serving_traces_total").inc()
         return fn
@@ -250,21 +296,38 @@ class PagedDecodeEngine:
                 PagedPools,
                 PagedRows,
                 decode_step,
+                decode_step_spec,
             )
 
-            def traced(p, kp, vp, tables, logits, counts, positions,
-                       gen_steps, max_news, active, forced_steps, rng):
-                self.stats["traces"] += 1
-                rows = PagedRows(logits, counts, positions, gen_steps,
-                                 max_news, active, forced_steps)
-                nxt, pools, rows2 = decode_step(
-                    p, PagedPools(kp, vp), tables, rows, self.mcfg,
-                    self._gen_key, key=rng, ctx=self.ctx,
-                )
-                return (nxt, pools.k, pools.v, rows2.logits, rows2.counts,
-                        rows2.positions, rows2.gen_steps, rows2.active)
+            spec = self.spec
 
-            fn = self._jax.jit(traced, donate_argnums=(1, 2))
+            def traced(p, pools_t, tables, logits, counts, positions,
+                       gen_steps, max_news, active, forced_steps, reject,
+                       drafts, rng):
+                self.stats["traces"] += 1
+                if spec is not None:
+                    rows = PagedRows(logits, counts, positions, gen_steps,
+                                     max_news, active, forced_steps, reject)
+                    window, ncommit, pools, rows2 = decode_step_spec(
+                        p, PagedPools(*pools_t), tables, rows, drafts,
+                        self.mcfg, self._gen_key, key=rng, ctx=self.ctx,
+                    )
+                    rej2 = rows2.reject
+                else:
+                    rows = PagedRows(logits, counts, positions, gen_steps,
+                                     max_news, active, forced_steps)
+                    nxt, pools, rows2 = decode_step(
+                        p, PagedPools(*pools_t), tables, rows, self.mcfg,
+                        self._gen_key, key=rng, ctx=self.ctx,
+                    )
+                    window = nxt[:, None]
+                    ncommit = active.astype(self._jnp.int32)
+                    rej2 = reject
+                out = tuple(x for x in pools if x is not None)
+                return (window, ncommit, out, rows2.logits, rows2.counts,
+                        rows2.positions, rows2.gen_steps, rows2.active, rej2)
+
+            fn = self._jax.jit(traced, donate_argnums=(1,))
             self._compiled_step[key] = fn
             get_registry().counter("pfx_serving_traces_total").inc()
         return fn
@@ -313,12 +376,11 @@ class PagedDecodeEngine:
         fn = self._prefill_fn(P, PB)
         try:
             with self.mesh:
-                kp, vp, last, counts = fn(
+                pools_t, last, counts = fn(
                     self.server.params,
                     jnp.asarray(prompt),
                     jnp.int32(plen),
-                    self.pools.k,
-                    self.pools.v,
+                    self._pools_tuple(),
                     jnp.asarray(prefill_table, jnp.int32),
                 )
         except BaseException as exc:
@@ -331,9 +393,10 @@ class PagedDecodeEngine:
             ) from exc
         from paddlefleetx_tpu.models.gpt.generation import PagedPools
 
-        self.pools = PagedPools(kp, vp)
+        self.pools = PagedPools(*pools_t)
         self._logits = self._logits.at[slot].set(last)
         self._counts = self._counts.at[slot].set(counts)
+        self._reject = self._reject.at[slot].set(-1)
         self.positions[slot] = plen
         self.gen_steps[slot] = 0
         self.max_news[slot] = max_new
@@ -345,7 +408,7 @@ class PagedDecodeEngine:
         self.active[slot] = True
         self.slots[slot] = _Row(
             seq_id=seq_id, entry=entry, row_idx=row_idx, prompt_len=plen,
-            max_new=max_new, table=table,
+            max_new=max_new, table=table, prompt_ids=list(prompt_ids),
         )
         self.stats["prefills"] += 1
         get_registry().counter("pfx_prefill_admits_total").inc()
@@ -357,10 +420,35 @@ class PagedDecodeEngine:
         )
         return min(_pow2_at_least(widest), _pow2_at_least(self.max_row_blocks))
 
+    def _host_drafts(self) -> np.ndarray:
+        """Self-draft every active row from its host-side prompt+output
+        history: the n-gram lookup proposes k+1 tokens continuing the
+        trailing n-gram's last earlier occurrence; proposal[0] predicts
+        the not-yet-sampled pending token, proposals[1:] are the drafts
+        the verify chunk carries.  Pure runtime data — never a compile
+        key."""
+        from paddlefleetx_tpu.ops.speculative import NGRAM_WINDOW
+
+        k = self.spec.draft_k
+        # the lookup never scans past NGRAM_WINDOW, so hand it only the
+        # tail (+ needle/draft slack) — a 100k-token history must not
+        # pay an O(history) copy per row per step on the decode hot path
+        need = NGRAM_WINDOW + self.spec.ngram + k + 2
+        out = np.zeros((self.capacity, k), np.int32)
+        for i, r in enumerate(self.slots):
+            if r is not None and self.active[i]:
+                if len(r.tokens) >= need:
+                    seq = r.tokens[-need:]
+                else:
+                    seq = r.prompt_ids[-(need - len(r.tokens)):] + r.tokens
+                out[i] = ngram_propose_host(seq, k + 1, n=self.spec.ngram)[1:]
+        return out
+
     def step(self) -> List[int]:
-        """Run ONE decode step for every active row; returns the slots
-        that finished this step (their tokens are complete — release
-        them with :meth:`release`)."""
+        """Run ONE decode step (speculative: one draft-verify iteration,
+        committing 1..draft_k+1 tokens per row) for every active row;
+        returns the slots that finished this step (their tokens are
+        complete — release them with :meth:`release`)."""
         jnp = self._jnp
         if not self.active.any():
             return []
@@ -371,18 +459,25 @@ class PagedDecodeEngine:
                 tables[i, : len(r.table)] = r.table
         self._key, sub = self._jax.random.split(self._key)
         was_active = self.active.copy()
+        k = self.spec.draft_k if self.spec else 0
+        drafts = (
+            self._host_drafts() if self.spec
+            else np.zeros((self.capacity, 1), np.int32)
+        )
         fn = self._step_fn(M)
         try:
             with self.mesh:
-                (nxt, kp, vp, logits, counts, positions, gen_steps,
-                 active) = fn(
-                    self.server.params, self.pools.k, self.pools.v,
+                (window, ncommit, pools_t, logits, counts, positions,
+                 gen_steps, active, reject) = fn(
+                    self.server.params, self._pools_tuple(),
                     jnp.asarray(tables), self._logits, self._counts,
                     jnp.asarray(self.positions), jnp.asarray(self.gen_steps),
                     jnp.asarray(self.max_news), jnp.asarray(self.active),
-                    jnp.asarray(self.forced_steps), sub,
+                    jnp.asarray(self.forced_steps), self._reject,
+                    jnp.asarray(drafts), sub,
                 )
-            nxt = np.array(nxt)
+            window = np.array(window)
+            ncommit = np.array(ncommit)
             new_active = np.array(active)
         except BaseException as exc:
             dead = self.reset()
@@ -393,8 +488,9 @@ class PagedDecodeEngine:
             ) from exc
         from paddlefleetx_tpu.models.gpt.generation import PagedPools
 
-        self.pools = PagedPools(kp, vp)
+        self.pools = PagedPools(*pools_t)
         self._logits, self._counts = logits, counts
+        self._reject = reject
         # np.array (not asarray): device-array views can be read-only and
         # admit/release mutate these in place
         self.positions = np.array(positions)
@@ -402,14 +498,23 @@ class PagedDecodeEngine:
         self.active = new_active
         self.stats["steps"] += 1
         finished: List[int] = []
+        n_act = int(was_active.sum())
         for i, r in enumerate(self.slots):
             if r is None or not was_active[i]:
                 continue
-            tok = int(nxt[i])
-            if tok != self.gen.eos_token_id:
-                r.tokens.append(tok)
+            for tok in window[i, : int(ncommit[i])].tolist():
+                if tok != self.gen.eos_token_id:
+                    r.tokens.append(int(tok))
             if not new_active[i]:
                 finished.append(i)
+        if self.spec and n_act:
+            proposed = k * n_act
+            accepted = int(ncommit[was_active].sum()) - n_act
+            self.stats["spec_proposed"] += proposed
+            self.stats["spec_accepted"] += accepted
+            reg = get_registry()
+            reg.counter("pfx_spec_proposed_total").inc(proposed)
+            reg.counter("pfx_spec_accepted_total").inc(accepted)
         return finished
 
     def release(self, slot: int) -> None:
@@ -444,11 +549,13 @@ class PagedDecodeEngine:
         self.max_news[:] = 0
         self.forced_steps[:] = 0
         self.pools = init_paged_pools(
-            self.mcfg, self.cache.allocator.num_blocks, self.block
+            self.mcfg, self.cache.allocator.num_blocks, self.block,
+            kv_dtype=self.kv_dtype,
         )
         jnp = self._jnp
         self._logits = jnp.zeros_like(self._logits)
         self._counts = jnp.zeros_like(self._counts)
+        self._reject = jnp.full_like(self._reject, -1)
         return dead
 
     def warmup(self, prompt_lens: Sequence[int]) -> Dict[str, float]:
@@ -526,13 +633,24 @@ class ContinuousScheduler:
         eng = self.engine
         occ = eng.active_rows() / max(1, eng.capacity)
         cstats = eng.cache.stats()
-        return [
+        out = [
             ("pfx_queue_depth", {}, float(self.depth())),
             ("pfx_queue_busy_seconds", {}, self.busy_seconds()),
             ("pfx_batch_occupancy", {}, occ),
             ("pfx_kv_blocks_used", {}, float(cstats["kv_blocks_used"])),
             ("pfx_kv_blocks_free", {}, float(cstats["kv_blocks_free"])),
+            # live arena payload bytes: used blocks x K+V bytes/block —
+            # int8 halves the per-block bytes, the acceptance evidence
+            ("pfx_kv_bytes", {},
+             float(cstats["kv_blocks_used"]) * eng.kv_block_bytes()),
         ]
+        if eng.spec is not None:
+            prop = float(eng.stats["spec_proposed"])
+            out.append((
+                "pfx_spec_accept_rate", {},
+                float(eng.stats["spec_accepted"]) / prop if prop else 0.0,
+            ))
+        return out
 
     # -- admission (RequestQueue-compatible surface) --------------------
     def submit(self, prompts: Sequence[Any], max_new_tokens: int, *,

@@ -24,6 +24,8 @@ from paddlefleetx_tpu.models.gpt.generation import (
     init_cache,
     pad_prompts,
 )
+from paddlefleetx_tpu.ops.decode_attention import kv_cache_dtype
+from paddlefleetx_tpu.ops.speculative import spec_config_from
 from paddlefleetx_tpu.utils.log import logger
 from paddlefleetx_tpu.utils.resilience import maybe_fire
 from paddlefleetx_tpu.utils.telemetry import StatsView, get_registry
@@ -84,6 +86,16 @@ class GenerationServer:
             forced_bos_token_id=int(gen_cfg.get("forced_bos_token_id", -1)),
             forced_eos_token_id=int(gen_cfg.get("forced_eos_token_id", -1)),
         )
+        # Generation.speculative: {draft_k, drafter, ngram, kv_dtype} —
+        # draft_k > 0 routes the contiguous decode through the
+        # speculative while-loop (greedy stays token-identical); kv_dtype
+        # int8 quantizes the donated cache pool (PFX_KV_DTYPE is the env
+        # spelling for benches; an explicit config value wins)
+        spec_section = dict(gen_cfg.get("speculative", {}) or {})
+        self.spec = spec_config_from(spec_section)
+        self.kv_dtype = kv_cache_dtype(
+            str(spec_section.get("kv_dtype", "") or "")
+        )
 
         rules = make_rules(mesh=mesh)
         self.ctx = ShardingCtx(mesh, rules) if mesh.size > 1 else None
@@ -129,6 +141,8 @@ class GenerationServer:
                 "traces": "pfx_serving_traces_total",
                 "gen_errors": "pfx_serving_gen_errors_total",
                 "last_latency_s": "pfx_serving_last_latency_seconds",
+                "spec_proposed": "pfx_spec_proposed_total",
+                "spec_accepted": "pfx_spec_accepted_total",
             },
             init={"time_s": 0.0, "last_latency_s": 0.0, "last_error": ""},
         )
@@ -138,16 +152,19 @@ class GenerationServer:
         fn = self._compiled.get(key)
         if fn is None:
             beam = gen.decode_strategy == "beam_search"
+            spec = None if beam else self.spec
 
             def traced(p, x, lens, k, cache):
                 # trace-time side effect: runs once per compile, never at
                 # execution — the retrace-count contract's probe
                 self.stats["traces"] += 1
-                # (tokens, final cache) on the sampling/greedy path;
-                # bare tokens for beam (no donation there)
+                # (tokens, final cache[, (proposed, accepted)]) on the
+                # sampling/greedy path; bare tokens for beam (no
+                # donation there)
                 return generate(
                     p, x, self.module.config, gen, key=k, ctx=self.ctx,
                     prompt_lens=lens, cache=cache, return_cache=not beam,
+                    spec=spec, return_spec_stats=spec is not None,
                 )
 
             # the KV cache is DONATED and RETURNED: donation aliases the
@@ -230,9 +247,14 @@ class GenerationServer:
             if not beam:
                 cache = self._cache_pool.pop(bucket_key, None)
                 if cache is None:
+                    # speculation needs draft_k slack slots for the
+                    # verify chunk's rejected tail; kv_dtype int8
+                    # allocates the quantized pair + scale planes
+                    slack = self.spec.draft_k if self.spec else 0
                     cache = init_cache(
                         self.module.config, prompt.shape[0],
-                        prompt.shape[1] + gen.max_dec_len,
+                        prompt.shape[1] + gen.max_dec_len + slack,
+                        kv_dtype=self.kv_dtype,
                     )
             try:
                 # serving fault sites (tests/test_serve_drills.py): both
@@ -257,8 +279,12 @@ class GenerationServer:
                 self.stats["gen_errors"] += 1
                 self.stats["last_error"] = f"{type(exc).__name__}: {exc}"
                 raise
+            spec_stats = None
             if not beam:
-                out, final_cache = out
+                if self.spec is not None:
+                    out, final_cache, spec_stats = out
+                else:
+                    out, final_cache = out
                 self._cache_pool[bucket_key] = final_cache
                 self._cache_pool.move_to_end(bucket_key)
                 while len(self._cache_pool) > self._cache_pool_size:
@@ -275,6 +301,13 @@ class GenerationServer:
         self.stats["tokens_out"] += sum(len(o) for o in outs)
         self.stats["time_s"] += dt
         self.stats["last_latency_s"] = round(dt, 4)
+        if spec_stats is not None:
+            self.stats["spec_proposed"] += int(spec_stats[0])
+            self.stats["spec_accepted"] += int(spec_stats[1])
+            prop = float(self.stats["spec_proposed"])
+            get_registry().gauge("pfx_spec_accept_rate").set(
+                float(self.stats["spec_accepted"]) / prop if prop else 0.0
+            )
         return outs
 
     def generate_text(self, prompts: Sequence[str], max_dec_len: Optional[int] = None):

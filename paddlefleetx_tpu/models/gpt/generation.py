@@ -32,19 +32,47 @@ from paddlefleetx_tpu.ops.decode_attention import (
     decode_attention,
     decode_attn_mode,
     dense_cache_attention,
+    kv_cache_dtype,
     paged_decode_attention,
+    quantize_kv,
 )
-from paddlefleetx_tpu.ops.sampling import sample_logits
+from paddlefleetx_tpu.ops.sampling import filtered_logits, sample_logits
+from paddlefleetx_tpu.ops.speculative import (
+    SpecConfig,
+    ngram_propose,
+    speculative_verify,
+)
 
 
 class KVCache(NamedTuple):
+    """Contiguous decode cache.  ``k``/``v`` are [layers, b, heads,
+    max_len, head_dim] in the model dtype — or int8 under
+    PFX_KV_DTYPE=int8, in which case ``k_scale``/``v_scale`` [layers, b,
+    heads, max_len] carry the per-(slot, head) quantization scales
+    written alongside every cache update (quantize-on-write,
+    dequantize-in-kernel — ``ops/decode_attention``)."""
+
     k: jax.Array  # [layers, b, heads, max_len, head_dim]
     v: jax.Array
+    k_scale: Optional[jax.Array] = None  # [layers, b, heads, max_len]
+    v_scale: Optional[jax.Array] = None
 
 
-def init_cache(cfg: GPTConfig, batch: int, max_len: int, dtype=None) -> KVCache:
+def init_cache(
+    cfg: GPTConfig, batch: int, max_len: int, dtype=None, kv_dtype: str = ""
+) -> KVCache:
+    """``kv_dtype``: "" resolves PFX_KV_DTYPE (the serving path passes the
+    ``Generation.speculative.kv_dtype`` config value through); "bf16"
+    keeps the cache in the model dtype, "int8" allocates the quantized
+    pair plus its scale planes (HBM bytes per slot halve vs bf16)."""
     dtype = dtype or jnp.dtype(cfg.dtype)
     shape = (cfg.num_layers, batch, cfg.num_attention_heads, max_len, cfg.head_dim)
+    if kv_cache_dtype(kv_dtype) == "int8":
+        sshape = shape[:-1]
+        return KVCache(
+            jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+            jnp.zeros(sshape, jnp.float32), jnp.zeros(sshape, jnp.float32),
+        )
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
 
@@ -63,7 +91,9 @@ def _layer_with_cache(
     cfg: GPTConfig,
     ctx: Optional[ShardingCtx] = None,
     kv_valid_from: Optional[jax.Array] = None,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+):
     """One decoder layer over x [b, t, h] writing K/V at offset ``pos``.
 
     Attends over cache[:pos+t] via the length-aware blocked kernel
@@ -87,24 +117,37 @@ def _layer_with_cache(
     q = _constrain(ctx, q, ("batch", None, "heads", "kv"))
 
     # cache layout [b, heads, max_len, head_dim]: transpose the (small)
-    # step chunk, never the cache
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.transpose(0, 2, 1, 3), (0, 0, pos, 0)
-    )
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.transpose(0, 2, 1, 3), (0, 0, pos, 0)
-    )
+    # step chunk, never the cache.  Under int8 the chunk quantizes HERE
+    # (quantize-on-write) and the scale planes update alongside — the
+    # kernels below dequantize in-kernel, so the cache only ever streams
+    # as int8.
+    kc = k.transpose(0, 2, 1, 3)
+    vc = v.transpose(0, 2, 1, 3)
+    if k_scale is not None:
+        kq, ks = quantize_kv(kc)
+        vq, vs = quantize_kv(vc)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, kq, (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, vq, (0, 0, pos, 0))
+        k_scale = jax.lax.dynamic_update_slice(k_scale, ks, (0, 0, pos))
+        v_scale = jax.lax.dynamic_update_slice(v_scale, vs, (0, 0, pos))
+        k_scale = _constrain(ctx, k_scale, ("batch", "heads", None))
+        v_scale = _constrain(ctx, v_scale, ("batch", "heads", None))
+    else:
+        k_cache = jax.lax.dynamic_update_slice(k_cache, kc, (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, vc, (0, 0, pos, 0))
     k_cache = _constrain(ctx, k_cache, ("batch", "heads", None, "kv"))
     v_cache = _constrain(ctx, v_cache, ("batch", "heads", None, "kv"))
 
     if decode_attn_mode() == "dense":
         attn_out = dense_cache_attention(
-            q, k_cache, v_cache, pos, kv_valid_from=kv_valid_from
+            q, k_cache, v_cache, pos, kv_valid_from=kv_valid_from,
+            k_scale=k_scale, v_scale=v_scale,
         )
     else:
         attn_out = decode_attention(
             q, k_cache, v_cache, pos, kv_valid_from=kv_valid_from,
             impl="lax" if ctx is not None else "auto",
+            k_scale=k_scale, v_scale=v_scale,
         )
     attn_out = jnp.einsum(
         "bsnd,ndh->bsh", attn_out, p["attn"]["out_kernel"].astype(dtype)
@@ -116,7 +159,7 @@ def _layer_with_cache(
     y = y @ mp["fc_in_kernel"].astype(dtype) + mp["fc_in_bias"].astype(dtype)
     y = jax.nn.gelu(y, approximate=True)
     y = y @ mp["fc_out_kernel"].astype(dtype) + mp["fc_out_bias"].astype(dtype)
-    return x + y, k_cache, v_cache
+    return x + y, k_cache, v_cache, k_scale, v_scale
 
 
 def forward_cached(
@@ -145,15 +188,31 @@ def forward_cached(
         x = word[tokens] + pe[position_ids]
     x = _constrain(ctx, x, ("batch", None, "embed"))
 
-    def body(x, inp):
-        p_l, kc, vc = inp
-        x, kc, vc = _layer_with_cache(p_l, x, kc, vc, pos, cfg, ctx, kv_valid_from)
-        return x, (kc, vc)
+    quant = cache.k_scale is not None
+    if quant:
+        def body(x, inp):
+            p_l, kc, vc, ksl, vsl = inp
+            x, kc, vc, ksl, vsl = _layer_with_cache(
+                p_l, x, kc, vc, pos, cfg, ctx, kv_valid_from, ksl, vsl
+            )
+            return x, (kc, vc, ksl, vsl)
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+        xs = (params["layers"], cache.k, cache.v, cache.k_scale, cache.v_scale)
+        x, (ks, vs, kss, vss) = jax.lax.scan(body, x, xs)
+        out_cache = KVCache(ks, vs, kss, vss)
+    else:
+        def body(x, inp):
+            p_l, kc, vc = inp
+            x, kc, vc, _, _ = _layer_with_cache(
+                p_l, x, kc, vc, pos, cfg, ctx, kv_valid_from
+            )
+            return x, (kc, vc)
+
+        x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+        out_cache = KVCache(ks, vs)
     x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
     logits = jnp.einsum("bsh,vh->bsv", x, word)
-    return _constrain(ctx, logits, ("batch", None, "vocab")), KVCache(ks, vs)
+    return _constrain(ctx, logits, ("batch", None, "vocab")), out_cache
 
 
 # ---------------------------------------------------------------------------
@@ -302,6 +361,8 @@ def generate(
     prompt_lens: Optional[jax.Array] = None,
     cache: Optional[KVCache] = None,
     return_cache: bool = False,
+    spec: Optional[SpecConfig] = None,
+    return_spec_stats: bool = False,
 ) -> jax.Array:
     """input_ids [b, prompt_len] -> generated ids [b, max_dec_len]
     (eos/pad-filled after finish).
@@ -330,11 +391,25 @@ def generate(
     blocks beyond ``pos + t``).
 
     ``return_cache``: return ``(tokens, final KVCache)`` instead of
-    tokens (sampling/greedy only)."""
+    tokens (sampling/greedy only).
+
+    ``spec``: a :class:`~paddlefleetx_tpu.ops.speculative.SpecConfig`
+    routes sampling/greedy decode through the speculative while-loop
+    (:func:`_generate_speculative`): draft k tokens per iteration,
+    verify them in ONE t=k+1 forward, commit the accepted prefix —
+    greedy output is token-identical to the plain loop by construction.
+    The cache needs ``spec.draft_k`` slack slots past ``prompt_len +
+    max_dec_len`` (the verify chunk's rejected tail overruns before the
+    rewind); a caller-provided cache must include them.
+    ``return_spec_stats`` appends an ``(proposed, accepted)`` int32 pair
+    to the return tuple (acceptance telemetry)."""
     if cfg.num_experts > 1:
         raise NotImplementedError("KV-cache generation for MoE models unsupported")
+    if return_spec_stats and spec is None:
+        raise ValueError("return_spec_stats needs a SpecConfig")
     b, prompt_len = input_ids.shape
     max_len = prompt_len + gen.max_dec_len
+    cache_len = max_len + (spec.draft_k if spec is not None else 0)
     if max_len > cfg.max_position_embeddings:
         # with prompt_lens, position ids are bounded by the REAL lengths,
         # not the bucket width: only reject when the real positions
@@ -358,18 +433,36 @@ def generate(
                 "cache donation/return is not supported for beam_search (the "
                 "beam loop reorders the cache by parent each step)"
             )
+        if spec is not None:
+            raise ValueError(
+                "speculative decoding is not supported for beam_search "
+                "(the beam loop reorders the cache by parent each step)"
+            )
         return beam_search(params, input_ids, cfg, gen, ctx=ctx, prompt_lens=prompt_lens)
+    if spec is not None and decode_loop_mode() == "scan":
+        raise ValueError(
+            "speculative decoding needs the early-exit while-loop decode "
+            "(variable tokens per iteration); unset PFX_DECODE_SCAN"
+        )
 
     pad_len, prefill_pos_ids = _left_pad_prefill(prompt_len, prompt_lens)
     if cache is None:
-        cache = init_cache(cfg, b, max_len)
+        cache = init_cache(cfg, b, cache_len)
     else:
-        want = (cfg.num_layers, b, cfg.num_attention_heads, max_len, cfg.head_dim)
+        want = (cfg.num_layers, b, cfg.num_attention_heads, cache_len,
+                cfg.head_dim)
         if cache.k.shape != want:
             raise ValueError(
                 f"provided cache shape {cache.k.shape} != required {want} "
-                f"(prompt {prompt_len} + max_dec_len {gen.max_dec_len})"
+                f"(prompt {prompt_len} + max_dec_len {gen.max_dec_len}"
+                + (f" + draft_k {spec.draft_k}" if spec is not None else "")
+                + ")"
             )
+    if spec is not None:
+        return _generate_speculative(
+            params, input_ids, cfg, gen, spec, key, ctx, prompt_lens,
+            pad_len, prefill_pos_ids, cache, return_cache, return_spec_stats,
+        )
     vocab = cfg.vocab_size
     valid = (
         jnp.ones((b, prompt_len), jnp.int32)
@@ -472,6 +565,195 @@ def generate(
 
 
 # ---------------------------------------------------------------------------
+# Speculative decode loop (contiguous path).  Leviathan et al. 2023 via
+# ops/speculative.py: each iteration forwards a [pending, draft_0..k-1]
+# chunk (t = k+1) through the SAME cached forward the plain loop uses,
+# verifies the drafts against the target's own processed logits, and
+# commits the batch-min accepted prefix + the pending token — between 1
+# and k+1 tokens per forward instead of exactly 1.
+# ---------------------------------------------------------------------------
+
+
+def _generate_speculative(
+    params, input_ids, cfg, gen, spec: SpecConfig, key, ctx, prompt_lens,
+    pad_len, prefill_pos_ids, cache, return_cache, return_spec_stats,
+):
+    """The speculative spelling of generate()'s early-exit while loop.
+
+    Commit discipline: per iteration every row verifies its own k drafts,
+    but the batch commits the MINIMUM accepted length m across unfinished
+    rows (the contiguous cache writes one shared [b, t] chunk at a
+    scalar position, so rows cannot advance independently — the paged
+    path's :func:`decode_step_spec` does true per-row commit).  Each
+    row's committed tokens are a verified prefix of its own acceptance,
+    so greedy output stays token-identical to the plain loop; rows that
+    accepted beyond m simply re-verify the surplus next iteration.  Rows
+    that hit EOS inside their accepted prefix stop constraining the
+    minimum (they are done — pad-substitution covers their tail).
+
+    Cache rewind: the chunk writes K/V at [pos, pos+k]; only [pos,
+    pos+m] are committed.  The next iteration's chunk starts at
+    pos+m+1 and spans k+1 slots, so every stale slot is rewritten
+    BEFORE any attention visits it — the same stale-tail argument as
+    the donated serving pool (docs/decode_path.md).  The cache carries
+    ``draft_k`` slack slots past prompt+max_dec_len for the final
+    iteration's overrun; overrun position ids clamp to the embedding
+    table (those slots are never committed)."""
+    b, prompt_len = input_ids.shape
+    k = spec.draft_k
+    K = k + 1
+    DEC = gen.max_dec_len
+    vocab = cfg.vocab_size
+    use_counts = gen.repetition_penalty != 1.0
+    greedy = gen.decode_strategy == "greedy_search"
+    if key is None:
+        key = jax.random.key(0)
+
+    valid = (
+        jnp.ones((b, prompt_len), jnp.int32)
+        if pad_len is None
+        else (jnp.arange(prompt_len)[None, :] >= pad_len[:, None]).astype(jnp.int32)
+    )
+    token_counts0 = jnp.zeros((b, vocab), jnp.int32).at[
+        jnp.arange(b)[:, None], input_ids
+    ].add(valid)
+
+    logits, cache = forward_cached(
+        params, input_ids, cache, jnp.int32(0), cfg, ctx,
+        position_ids=prefill_pos_ids, kv_valid_from=pad_len,
+    )
+    last_logits = logits[:, -1, :].astype(jnp.float32)
+
+    # pending_0 = the baseline loop's step-0 token, sampled through the
+    # identical (single-sourced) processor chain
+    p0 = process_step_logits(
+        last_logits, jnp.zeros((b,), jnp.int32), token_counts0,
+        jnp.full((b,), DEC - 1, jnp.int32), gen,
+    )
+    key, sub0 = jax.random.split(key)
+    if greedy:
+        pending0 = jnp.argmax(p0, axis=-1).astype(jnp.int32)
+    else:
+        pending0 = sample_logits(
+            sub0, p0, temperature=gen.temperature, top_k=gen.top_k,
+            top_p=gen.top_p,
+        ).astype(jnp.int32)
+
+    class SpecCarry(NamedTuple):
+        cache: KVCache
+        pending: jax.Array    # [b] token for step `emitted`
+        pos: jax.Array        # cache slot where pending will be written
+        emitted: jax.Array    # committed tokens so far (shared)
+        unfinished: jax.Array
+        token_counts: jax.Array
+        key: jax.Array
+        tokens: jax.Array     # [b, DEC + k + 1] (k+1 write slack)
+        proposed: jax.Array   # drafted tokens (acceptance telemetry)
+        accepted: jax.Array   # committed drafted tokens
+
+    tokens0 = jnp.full((b, DEC + K), gen.pad_token_id, jnp.int32)
+
+    def loop_cond(st: SpecCarry):
+        return (st.emitted < DEC) & jnp.any(st.unfinished)
+
+    def loop_body(st: SpecCarry):
+        emitted = st.emitted
+        # self-draft from the row's own prompt + committed output
+        ctx_buf = jnp.concatenate([input_ids, st.tokens], axis=1)
+        draft = ngram_propose(
+            ctx_buf, prompt_len + emitted, st.pending, k, n=spec.ngram
+        )
+        chunk = jnp.concatenate([st.pending[:, None], draft], axis=1)
+
+        # ONE t=k+1 forward verifies the whole chunk; overrun position
+        # ids clamp to the embedding table (never committed)
+        base = (
+            prompt_lens if prompt_lens is not None
+            else jnp.full((b,), prompt_len, jnp.int32)
+        )
+        pos_ids = jnp.clip(
+            base[:, None] + emitted + jnp.arange(K)[None, :],
+            0, cfg.max_position_embeddings - 1,
+        )
+        logits_all, cache = forward_cached(
+            params, chunk, st.cache, st.pos, cfg, ctx,
+            position_ids=pos_ids, kv_valid_from=pad_len,
+        )
+
+        key, sub = jax.random.split(st.key)
+        sv = speculative_verify(
+            sub, logits_all.astype(jnp.float32), chunk,
+            st.token_counts if use_counts else None,
+            st.unfinished, emitted, gen,
+        )
+
+        # batch-min commit: rows finished before the window, or finished
+        # BY it (EOS inside their accepted prefix), stop constraining
+        constraint = jnp.where(
+            ~st.unfinished | sv.eos_hit.any(axis=1), k, sv.accepted
+        )
+        m = jnp.minimum(jnp.min(constraint), DEC - 1 - emitted)
+
+        jmask = jnp.arange(K) <= m  # [K]
+        window = jnp.where(jmask[None, :], sv.w, gen.pad_token_id)
+        tokens = jax.lax.dynamic_update_slice(st.tokens, window, (0, emitted))
+        counts = st.token_counts.at[jnp.arange(b)[:, None], sv.w].add(
+            jmask[None, :].astype(jnp.int32)
+        )
+        unfinished = st.unfinished & ~(sv.eos_hit & jmask[None, :]).any(axis=1)
+
+        # next pending = the token for step emitted + m + 1: the already-
+        # accepted surplus draft when the row out-accepted the batch, else
+        # the verify candidate (correction / residual / bonus)
+        m_col = jnp.full((b, 1), m, jnp.int32)
+        beyond = sv.accepted > m
+        from_chunk = jnp.take_along_axis(
+            chunk, jnp.minimum(m_col + 1, k), axis=1
+        )[:, 0]
+        from_pend = jnp.take_along_axis(sv.pend, m_col, axis=1)[:, 0]
+        pending = jnp.where(
+            unfinished,
+            jnp.where(beyond, from_chunk, from_pend),
+            gen.pad_token_id,
+        ).astype(jnp.int32)
+
+        n_alive = st.unfinished.sum().astype(jnp.int32)
+        return SpecCarry(
+            cache=cache,
+            pending=pending,
+            pos=st.pos + m + 1,
+            emitted=emitted + m + 1,
+            unfinished=unfinished,
+            token_counts=counts,
+            key=key,
+            tokens=tokens,
+            proposed=st.proposed + k * n_alive,
+            accepted=st.accepted + m * n_alive,
+        )
+
+    st0 = SpecCarry(
+        cache=cache,
+        pending=pending0,
+        pos=jnp.int32(prompt_len),
+        emitted=jnp.int32(0),
+        unfinished=jnp.ones((b,), bool),
+        token_counts=token_counts0,
+        key=key,
+        tokens=tokens0,
+        proposed=jnp.int32(0),
+        accepted=jnp.int32(0),
+    )
+    st = jax.lax.while_loop(loop_cond, loop_body, st0)
+    tokens = st.tokens[:, :DEC]
+    out = (tokens,)
+    if return_cache:
+        out = out + (st.cache,)
+    if return_spec_stats:
+        out = out + ((st.proposed, st.accepted),)
+    return out if len(out) > 1 else tokens
+
+
+# ---------------------------------------------------------------------------
 # Paged decode: block-pool KV cache + the step-wise entry the
 # continuous-batching scheduler drives (core/continuous_batching.py).
 # The contiguous generate() above runs ONE request set to completion
@@ -486,18 +768,32 @@ class PagedPools(NamedTuple):
     """The paged KV arena: [layers, num_blocks, heads, block, head_dim]
     (heads-major within a block, matching KVCache's tiling rationale).
     Block 0 is the NULL block — never allocated to a sequence; inactive
-    batch rows route their writes there (core/paged_cache.py)."""
+    batch rows route their writes there (core/paged_cache.py).  Under
+    PFX_KV_DTYPE=int8 the arrays are int8 and ``k_scale``/``v_scale``
+    [layers, num_blocks, heads, block] carry per-(slot, head) scale
+    tiles stored alongside the arena — each pool block owns its
+    [heads, block] scale tile, DMA'd with it by the pallas kernel's
+    clamped index map."""
 
     k: jax.Array
     v: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
 
 def init_paged_pools(
-    cfg: GPTConfig, num_blocks: int, block: int, dtype=None
+    cfg: GPTConfig, num_blocks: int, block: int, dtype=None,
+    kv_dtype: str = "",
 ) -> PagedPools:
-    dtype = dtype or jnp.dtype(cfg.dtype)
     shape = (cfg.num_layers, num_blocks, cfg.num_attention_heads, block,
              cfg.head_dim)
+    if kv_cache_dtype(kv_dtype) == "int8":
+        sshape = shape[:-1]
+        return PagedPools(
+            jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+            jnp.zeros(sshape, jnp.float32), jnp.zeros(sshape, jnp.float32),
+        )
+    dtype = dtype or jnp.dtype(cfg.dtype)
     return PagedPools(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
 
@@ -514,7 +810,14 @@ class PagedRows(NamedTuple):
     budget, so forced-EOS output stays token-identical to the coalesce
     path (whose forced step usually lands beyond the trimmed output);
     ``logits`` are the pending next-token logits the next step samples
-    from; ``counts`` back repetition penalty."""
+    from; ``counts`` back repetition penalty.
+
+    ``reject`` (speculative path only, else None): the draft token id
+    the last iteration's verify REJECTED at exactly the carried logits'
+    position, or -1.  Sampled decode masks it out of the filtered
+    distribution before drawing — the Leviathan residual rule carried
+    across the step boundary; greedy ignores it (the argmax already
+    differs from a rejected draft)."""
 
     logits: jax.Array        # [B, vocab] f32
     counts: jax.Array        # [B, vocab] int32
@@ -523,6 +826,7 @@ class PagedRows(NamedTuple):
     max_news: jax.Array      # [B] int32
     active: jax.Array        # [B] bool
     forced_steps: jax.Array  # [B] int32
+    reject: Optional[jax.Array] = None  # [B] int32 (-1 = none)
 
 
 def _paged_layer_step(
@@ -536,11 +840,16 @@ def _paged_layer_step(
     positions: jax.Array,
     cfg: GPTConfig,
     ctx: Optional[ShardingCtx] = None,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One decoder layer over x [b, 1, h]: write this step's K/V at pool
-    slot (blk[i], off[i]) per row, then block-table paged attention."""
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+):
+    """One decoder layer over x [b, t, h]: write each of the t chunk
+    tokens' K/V at pool slot (blk[i, j], off[i, j]) per row (t > 1 is
+    the speculative verify chunk), then block-table paged attention with
+    per-query causal bounds.  Under int8 the chunk quantizes on write
+    and the per-slot scales land in the arena's scale planes."""
     dtype = x.dtype
-    b = x.shape[0]
+    b, t, _ = x.shape
     n = cfg.num_attention_heads
 
     y = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
@@ -549,18 +858,28 @@ def _paged_layer_step(
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     q = _constrain(ctx, q, ("batch", None, "heads", "kv"))
 
-    # scatter the [b, n, d] step chunk into each row's current block:
-    # rows own disjoint blocks, so the only index collisions are inactive
-    # rows' null-block writes (garbage-on-garbage, never read)
-    idx_b = blk[:, None]
-    idx_n = jnp.arange(n)[None, :]
-    idx_o = off[:, None]
-    k_pool = k_pool.at[idx_b, idx_n, idx_o, :].set(k[:, 0].astype(k_pool.dtype))
-    v_pool = v_pool.at[idx_b, idx_n, idx_o, :].set(v[:, 0].astype(v_pool.dtype))
+    # scatter the [b, t, n, d] chunk into each row's blocks: rows own
+    # disjoint blocks and a row's t slots are distinct, so the only index
+    # collisions are inactive/overrun rows' null-block writes
+    # (garbage-on-garbage, never read)
+    idx_b = blk[:, :, None]                  # [b, t, 1]
+    idx_n = jnp.arange(n)[None, None, :]     # [1, 1, n]
+    idx_o = off[:, :, None]
+    if k_scale is not None:
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        k_pool = k_pool.at[idx_b, idx_n, idx_o, :].set(kq)
+        v_pool = v_pool.at[idx_b, idx_n, idx_o, :].set(vq)
+        k_scale = k_scale.at[idx_b, idx_n, idx_o].set(ks)
+        v_scale = v_scale.at[idx_b, idx_n, idx_o].set(vs)
+    else:
+        k_pool = k_pool.at[idx_b, idx_n, idx_o, :].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[idx_b, idx_n, idx_o, :].set(v.astype(v_pool.dtype))
 
     attn_out = paged_decode_attention(
         q, k_pool, v_pool, tables, positions,
         impl="lax" if ctx is not None else "auto",
+        k_scale=k_scale, v_scale=v_scale,
     )
     attn_out = jnp.einsum(
         "bsnd,ndh->bsh", attn_out, p["attn"]["out_kernel"].astype(dtype)
@@ -572,7 +891,7 @@ def _paged_layer_step(
     y = y @ mp["fc_in_kernel"].astype(dtype) + mp["fc_in_bias"].astype(dtype)
     y = jax.nn.gelu(y, approximate=True)
     y = y @ mp["fc_out_kernel"].astype(dtype) + mp["fc_out_bias"].astype(dtype)
-    return x + y, k_pool, v_pool
+    return x + y, k_pool, v_pool, k_scale, v_scale
 
 
 def paged_forward_step(
@@ -585,36 +904,65 @@ def paged_forward_step(
     cfg: GPTConfig,
     ctx: Optional[ShardingCtx] = None,
 ) -> Tuple[jax.Array, PagedPools]:
-    """tokens [B] at per-row slots ``positions`` -> (logits [B, v] f32,
-    pools).  Inactive rows still run (fixed shape) but write to the null
-    block and their logits are garbage the caller ignores."""
+    """tokens [B] or [B, t] at per-row slots positions..positions+t-1 ->
+    (logits [B, t, v] f32, pools).  t = 1 is the plain decode step;
+    t > 1 is the speculative verify chunk (causal within the chunk).
+    Inactive rows still run (fixed shape) but write to the null block
+    and their logits are garbage the caller ignores.  Chunk slots past a
+    row's block-table allocation gather the NULL padding entry, so a
+    near-budget verify overrun can never alias another row's blocks
+    (the engine also reserves draft_k slack — belt and braces)."""
+    if tokens.ndim == 1:
+        tokens = tokens[:, None]
+    B, t = tokens.shape
     dtype = jnp.dtype(cfg.dtype)
     word = params["embeddings"]["word"].astype(dtype)
     pe = params["embeddings"]["position"].astype(dtype)
-    # clamp inactive rows' embedding index: an evicted slot may carry a
-    # stale position beyond the table
-    pos_emb = jnp.where(active, positions, 0)
-    x = word[tokens][:, None, :] + pe[pos_emb][:, None, :]  # [B, 1, h]
+    # per-slot positions; clamp inactive rows' (stale) and overrun
+    # slots' embedding indices into the table
+    pos_t = positions[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    pos_emb = jnp.clip(
+        jnp.where(active[:, None], pos_t, 0),
+        0, cfg.max_position_embeddings - 1,
+    )
+    x = word[tokens] + pe[pos_emb]  # [B, t, h]
     x = _constrain(ctx, x, ("batch", None, "embed"))
 
     bs = pools.k.shape[3]
-    blk_log = jnp.clip(positions // bs, 0, block_tables.shape[1] - 1)
-    blk = jnp.take_along_axis(block_tables, blk_log[:, None], axis=1)[:, 0]
-    blk = jnp.where(active, blk, 0)  # inactive rows -> null block
-    off = positions % bs
+    blk_log = jnp.clip(pos_t // bs, 0, block_tables.shape[1] - 1)
+    blk = jnp.take_along_axis(block_tables, blk_log, axis=1)  # [B, t]
+    blk = jnp.where(active[:, None], blk, 0)  # inactive rows -> null block
+    off = pos_t % bs
 
-    def body(x, inp):
-        p_l, kp, vp = inp
-        x, kp, vp = _paged_layer_step(
-            p_l, x, kp, vp, blk, off, block_tables, positions, cfg, ctx
+    quant = pools.k_scale is not None
+    if quant:
+        def body(x, inp):
+            p_l, kp, vp, ksl, vsl = inp
+            x, kp, vp, ksl, vsl = _paged_layer_step(
+                p_l, x, kp, vp, blk, off, block_tables, positions, cfg, ctx,
+                ksl, vsl,
+            )
+            return x, (kp, vp, ksl, vsl)
+
+        xs = (params["layers"], pools.k, pools.v, pools.k_scale, pools.v_scale)
+        x, (ks, vs, kss, vss) = jax.lax.scan(body, x, xs)
+        out_pools = PagedPools(ks, vs, kss, vss)
+    else:
+        def body(x, inp):
+            p_l, kp, vp = inp
+            x, kp, vp, _, _ = _paged_layer_step(
+                p_l, x, kp, vp, blk, off, block_tables, positions, cfg, ctx
+            )
+            return x, (kp, vp)
+
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["layers"], pools.k, pools.v)
         )
-        return x, (kp, vp)
-
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], pools.k, pools.v))
+        out_pools = PagedPools(ks, vs)
     x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"])
     logits = jnp.einsum("bsh,vh->bsv", x, word)
     logits = _constrain(ctx, logits, ("batch", None, "vocab"))
-    return logits[:, -1, :].astype(jnp.float32), PagedPools(ks, vs)
+    return logits.astype(jnp.float32), out_pools
 
 
 def paged_prefill(
@@ -653,7 +1001,11 @@ def paged_prefill(
         raise ValueError(
             f"table_row covers {PB}x{bs}={L} slots < prompt bucket {P}"
         )
-    cache = init_cache(cfg, 1, L)
+    # the temp prefill cache is NATIVE dtype even when the arena is int8:
+    # the prompt's self-attention runs at full precision and the K/V
+    # quantize ONCE on the repack below (decode then reads the same
+    # quantized prompt keys whether speculating or not)
+    cache = init_cache(cfg, 1, L, kv_dtype="bf16")
     pos_ids = jnp.arange(P, dtype=jnp.int32)[None, :]
     logits, cache = forward_cached(
         params, prompt, cache, jnp.int32(0), cfg, ctx, position_ids=pos_ids
@@ -665,12 +1017,46 @@ def paged_prefill(
     def pack(c):
         return c[:, 0].reshape(layers, n, PB, bs, d).transpose(0, 2, 1, 3, 4)
 
-    k_pool = pools.k.at[:, table_row].set(pack(cache.k).astype(pools.k.dtype))
-    v_pool = pools.v.at[:, table_row].set(pack(cache.v).astype(pools.v.dtype))
     counts = jnp.zeros((cfg.vocab_size,), jnp.int32).at[prompt[0]].add(
         (jnp.arange(P) < prompt_len).astype(jnp.int32)
     )
+    if pools.k_scale is not None:
+        kq, ksl = quantize_kv(pack(cache.k))
+        vq, vsl = quantize_kv(pack(cache.v))
+        return PagedPools(
+            pools.k.at[:, table_row].set(kq),
+            pools.v.at[:, table_row].set(vq),
+            pools.k_scale.at[:, table_row].set(ksl),
+            pools.v_scale.at[:, table_row].set(vsl),
+        ), last, counts
+    k_pool = pools.k.at[:, table_row].set(pack(cache.k).astype(pools.k.dtype))
+    v_pool = pools.v.at[:, table_row].set(pack(cache.v).astype(pools.v.dtype))
     return PagedPools(k_pool, v_pool), last, counts
+
+
+def process_step_logits(logits, steps, counts, forced_steps, gen):
+    """THE per-step logits-processor chain (min-length -> repetition
+    penalty -> forced BOS/EOS), shape-agnostic: ``logits`` [..., v] with
+    ``steps``/``forced_steps`` matching the leading dims (per-row on the
+    paged path, per-slot on the speculative verify chunk).
+    Single-sourced on purpose: :func:`decode_step`,
+    :func:`decode_step_spec`'s pending-token sampling, the speculative
+    prefill seed, and `ops/speculative.speculative_verify` must all stay
+    BITWISE identical or the greedy token-identity contract silently
+    drifts.  ``counts`` None skips repetition penalty (callers pass None
+    exactly when the penalty is 1.0)."""
+    logits = apply_min_length(logits, steps, gen.min_dec_len, gen.eos_token_id)
+    if counts is not None:
+        logits = apply_repetition_penalty(logits, counts, gen.repetition_penalty)
+    if gen.forced_bos_token_id >= 0:
+        forced = jnp.full_like(logits, -1e10).at[
+            ..., gen.forced_bos_token_id].set(0.0)
+        logits = jnp.where((steps == 0)[..., None], forced, logits)
+    if gen.forced_eos_token_id >= 0:
+        forced = jnp.full_like(logits, -1e10).at[
+            ..., gen.forced_eos_token_id].set(0.0)
+        logits = jnp.where((steps == forced_steps)[..., None], forced, logits)
+    return logits
 
 
 def decode_step(
@@ -695,16 +1081,9 @@ def decode_step(
     pools, rows')."""
     B, vocab = rows.logits.shape
     i = rows.gen_steps
-    logits = apply_min_length(rows.logits, i, gen.min_dec_len, gen.eos_token_id)
-    logits = apply_repetition_penalty(logits, rows.counts, gen.repetition_penalty)
-    if gen.forced_bos_token_id >= 0:
-        forced = jnp.full_like(logits, -1e10).at[
-            ..., gen.forced_bos_token_id].set(0.0)
-        logits = jnp.where((i == 0)[:, None], forced, logits)
-    if gen.forced_eos_token_id >= 0:
-        forced = jnp.full_like(logits, -1e10).at[
-            ..., gen.forced_eos_token_id].set(0.0)
-        logits = jnp.where((i == rows.forced_steps)[:, None], forced, logits)
+    logits = process_step_logits(
+        rows.logits, i, rows.counts, rows.forced_steps, gen
+    )
     if gen.decode_strategy == "greedy_search":
         nxt = jnp.argmax(logits, axis=-1)
     else:
@@ -727,7 +1106,7 @@ def decode_step(
     )
     act = rows.active.astype(jnp.int32)
     new_rows = PagedRows(
-        logits=new_logits,
+        logits=new_logits[:, 0],
         counts=counts,
         positions=rows.positions + act,
         gen_steps=i + act,
@@ -736,6 +1115,132 @@ def decode_step(
         forced_steps=rows.forced_steps,
     )
     return nxt, pools, new_rows
+
+
+def decode_step_spec(
+    params: Dict[str, Any],
+    pools: PagedPools,
+    block_tables: jax.Array,
+    rows: PagedRows,
+    drafts: jax.Array,
+    cfg: GPTConfig,
+    gen: GenerationConfig,
+    key: Optional[jax.Array] = None,
+    ctx: Optional[ShardingCtx] = None,
+) -> Tuple[jax.Array, jax.Array, PagedPools, PagedRows]:
+    """ONE speculative iteration over the running batch — the paged
+    spelling of :func:`_generate_speculative`'s body, with TRUE per-row
+    commit (each row owns its positions, so accepted lengths never
+    constrain each other; accepted length is runtime DATA, not a compile
+    key — ``drafts`` [B, k] are host-proposed runtime data too).
+
+    Per row: sample the pending token t0 from ``rows.logits`` through
+    exactly :func:`decode_step`'s processor chain (greedy rows are
+    bitwise the baseline), forward the [t0, draft_0..k-1] chunk in ONE
+    t=k+1 dispatch (writing K/V at positions..positions+k), verify the
+    drafts with :func:`~paddlefleetx_tpu.ops.speculative.
+    speculative_verify`, and commit t0 plus the accepted prefix —
+    truncated by the per-row budget.  Rejected-tail K/V slots are
+    rewritten by the next iteration's chunk before any attention visits
+    them (positions advance only by the committed count: the per-row
+    position REWIND; block tables are untouched — rows reserved their
+    full capacity, plus draft_k slack, at admission).
+
+    Returns (window [B, k+1] committed tokens — pad past each row's
+    count, ncommit [B] int32 in [0, k+1] (0 only for inactive rows),
+    pools, rows').  ``rows'.logits`` carries the RAW target logits at
+    each row's last committed position; ``rows'.reject`` the residual
+    mask for the next sample (sampling mode; see :class:`PagedRows`)."""
+    B, vocab = rows.logits.shape
+    k = int(drafts.shape[1])
+    K = k + 1
+    i = rows.gen_steps
+    greedy = gen.decode_strategy == "greedy_search"
+    use_counts = gen.repetition_penalty != 1.0
+    if not greedy and key is None:
+        raise ValueError("sampling decode_step_spec needs a PRNG key")
+
+    # --- t0: the baseline decode_step sampling rule on pending logits
+    logits = process_step_logits(
+        rows.logits, i, rows.counts, rows.forced_steps, gen
+    )
+    if greedy:
+        t0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        key_verify = key
+    else:
+        key, key_t0, key_verify = (
+            jax.random.split(key, 3)
+        )
+        filt = filtered_logits(
+            logits, temperature=gen.temperature, top_k=gen.top_k,
+            top_p=gen.top_p,
+        )
+        if rows.reject is not None:
+            # residual rule carried across the step boundary: mask the
+            # draft the last verify rejected at THIS position (post-
+            # filter, so the renormalized nucleus is the exact residual)
+            hit = rows.reject >= 0
+            safe = jnp.clip(rows.reject, 0, vocab - 1)
+            filt = jnp.where(
+                hit[:, None]
+                & (jnp.arange(vocab)[None, :] == safe[:, None]),
+                -1e10, filt,
+            )
+        t0 = jax.random.categorical(key_t0, filt, axis=-1).astype(jnp.int32)
+    nxt0 = jnp.where(rows.active, t0, gen.pad_token_id)
+    chunk = jnp.concatenate([nxt0[:, None], drafts.astype(jnp.int32)], axis=1)
+
+    # --- ONE t=k+1 verify forward
+    logits_all, pools = paged_forward_step(
+        params, chunk, pools, block_tables, rows.positions, rows.active,
+        cfg, ctx,
+    )
+    sv = speculative_verify(
+        key_verify, logits_all, chunk,
+        rows.counts if use_counts else None,
+        rows.active, i, gen, forced_steps=rows.forced_steps,
+    )
+
+    # --- per-row commit: the accepted prefix cut by the decode budget
+    budget_ok = (i[:, None] + jnp.arange(K)[None, :]) < rows.max_news[:, None]
+    valid = sv.real & budget_ok
+    ncommit = valid.sum(axis=1).astype(jnp.int32)
+    window = jnp.where(valid, sv.w, gen.pad_token_id)
+    jmask = (jnp.arange(K)[None, :] < ncommit[:, None]).astype(jnp.int32)
+    counts = rows.counts.at[jnp.arange(B)[:, None], window].add(jmask)
+
+    eos_fin = (sv.eos_hit & valid).any(axis=1)
+    budget_fin = (i + ncommit) >= rows.max_news
+    finished = rows.active & (eos_fin | budget_fin)
+
+    # --- carry the RAW logits at each row's last committed position
+    sel = jnp.clip(ncommit - 1, 0, k)[:, None, None]
+    new_logits = jnp.take_along_axis(logits_all, sel, axis=1)[:, 0]
+    new_logits = jnp.where(rows.active[:, None], new_logits, rows.logits)
+
+    # --- residual mask: a MISMATCH rejection at exactly the carried slot
+    a = sv.accepted
+    a_cl = jnp.clip(a, 0, k - 1)
+    ok_at_a = jnp.take_along_axis(sv.ok, a_cl[:, None], axis=1)[:, 0]
+    real_at_a = jnp.take_along_axis(sv.real, a[:, None], axis=1)[:, 0]
+    mism = (a < k) & real_at_a & ~ok_at_a
+    rej_draft = jnp.take_along_axis(drafts, a_cl[:, None], axis=1)[:, 0]
+    reject = jnp.where(
+        mism & (ncommit == a + 1) & rows.active & ~finished,
+        rej_draft.astype(jnp.int32), jnp.int32(-1),
+    )
+
+    new_rows = PagedRows(
+        logits=new_logits,
+        counts=counts,
+        positions=rows.positions + ncommit,
+        gen_steps=i + ncommit,
+        max_news=rows.max_news,
+        active=rows.active & ~finished,
+        forced_steps=rows.forced_steps,
+        reject=reject,
+    )
+    return window, ncommit, pools, new_rows
 
 
 # ---------------------------------------------------------------------------
@@ -779,7 +1284,10 @@ def beam_search(
     # beams share the prompt; re-running the forward K times would be
     # K x the prefill FLOPs for identical results)
     pad_len, prefill_pos_ids = _left_pad_prefill(prompt_len, prompt_lens)
-    cache = init_cache(cfg, b, max_len)
+    # beam reorders the cache by parent each step and rebuilds it here —
+    # always native dtype (int8 KV quant covers the sampling/greedy
+    # serving paths, not beam)
+    cache = init_cache(cfg, b, max_len, kv_dtype="bf16")
     logits, cache = forward_cached(
         params, input_ids, cache, jnp.int32(0), cfg, ctx,
         position_ids=prefill_pos_ids, kv_valid_from=pad_len,

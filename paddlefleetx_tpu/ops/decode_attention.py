@@ -45,6 +45,18 @@ instead of silently mislabeling a chip sweep):
   PFX_DECODE_ATTN   "blocked" (default) | "dense" — generation-layer
                     dispatch, read at trace time; "dense" restores the
                     attend-over-everything path for A/B benching
+  PFX_KV_DTYPE      "bf16" (default: the cache stays in the model dtype)
+                    | "int8" — int8 KV-cache quantization.  Quantize
+                    happens ON WRITE (generation-layer scatter paths,
+                    symmetric per-(slot, head) amax/127 scales stored
+                    alongside the cache/arena) and dequantize IN-KERNEL
+                    in every spelling here: the scores absorb the
+                    per-key scale (``s *= k_scale[col]``) and the
+                    probabilities absorb the per-value scale
+                    (``p *= v_scale[col]``) — no dequantized cache is
+                    ever materialized, so the decode step's HBM reads
+                    HALVE vs bf16 (which is exactly what the
+                    flash/paged kernels made the bottleneck)
 
 Inference-only: the blocked loop has a data-dependent trip count (a
 ``while_loop`` under the hood), so it is not reverse-differentiable.
@@ -110,6 +122,44 @@ def decode_block(max_len: int, block: int = 0) -> int:
     return clamped
 
 
+KV_QMAX = 127.0
+
+
+def kv_cache_dtype(override: str = "") -> str:
+    """Resolve the KV-cache storage dtype: explicit ``override`` (the
+    ``Generation.speculative.kv_dtype`` config knob), else PFX_KV_DTYPE,
+    else "bf16".  "bf16" means NATIVE — the cache stays in the model
+    dtype (an f32 model keeps f32; the name follows the knob contract);
+    "int8" enables quantize-on-write + dequantize-in-kernel.  Loud
+    parse: a typo must not silently mislabel a chip A/B as quantized."""
+    raw = (override or os.environ.get("PFX_KV_DTYPE") or "bf16")
+    raw = str(raw).strip().lower()
+    if raw not in ("bf16", "int8"):
+        raise ValueError(
+            f"PFX_KV_DTYPE={raw!r}; valid: bf16 (native), int8"
+        )
+    return raw
+
+
+def quantize_kv(x: jax.Array):
+    """Symmetric per-vector int8 quantization of a K/V chunk.
+
+    ``x`` [..., d] -> (int8 values [..., d], f32 scales [...]): one
+    amax/127 scale per (slot, head) [d]-vector — finer than a per-block
+    scale, so writing one token into a half-full block never forces a
+    requantization of its neighbors (the scatter paths write exactly the
+    new slots).  Deterministic round-to-nearest: parity suites need
+    bit-stable runs.  The scale floor keeps all-zero vectors (fresh
+    arena blocks) finite; their dequantized values stay exactly 0."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scl = jnp.maximum(amax / KV_QMAX, 1e-8)
+    q = jnp.clip(
+        jnp.round(xf / scl[..., None]), -KV_QMAX, KV_QMAX
+    ).astype(jnp.int8)
+    return q, scl
+
+
 def decode_attn_mode() -> str:
     """PFX_DECODE_ATTN dispatch read by the generation layer at trace
     time: "blocked" (this op) or "dense" (the legacy attend-over-the-
@@ -137,13 +187,18 @@ def blocks_visited(limit, block: int, max_len: int):
 # ---------------------------------------------------------------------------
 
 
-def _decode_lax(q_t, k_cache, v_cache, limit, valid_from, block, scale):
+def _decode_lax(q_t, k_cache, v_cache, limit, valid_from, block, scale,
+                k_scale=None, v_scale=None):
     """q_t [b, n, t, d]; caches [b, n, L, d]; limit = pos + t (traced ok).
 
     Returns [b, n, t, d] fp32-accumulated attention over keys [vf, limit).
-    """
+    With int8 caches, ``k_scale``/``v_scale`` [b, n, L] dequantize
+    in-loop: per-key scales fold into the score columns and per-value
+    scales into the probability columns — the cache itself streams as
+    int8."""
     b, n, t, d = q_t.shape
     max_len = k_cache.shape[2]
+    quant = k_scale is not None
     q_pos = limit - t + jnp.arange(t)  # global position of each query row
 
     m0 = jnp.full((b, n, t), NEG_INF, jnp.float32)
@@ -157,9 +212,16 @@ def _decode_lax(q_t, k_cache, v_cache, limit, valid_from, block, scale):
         start = jnp.maximum(jnp.minimum(j * block, max_len - block), 0)
         k = jax.lax.dynamic_slice_in_dim(k_cache, start, block, axis=2)
         v = jax.lax.dynamic_slice_in_dim(v_cache, start, block, axis=2)
+        if quant:
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
+            ksl = jax.lax.dynamic_slice_in_dim(k_scale, start, block, axis=2)
+            vsl = jax.lax.dynamic_slice_in_dim(v_scale, start, block, axis=2)
         s = scale * jnp.einsum(
             "bntd,bnkd->bntk", q_t, k, preferred_element_type=jnp.float32
         )  # [b, n, t, block]
+        if quant:
+            s = s * ksl[:, :, None, :]
         col = start + jnp.arange(block)  # [block]
         mask = (col[None, :] <= q_pos[:, None]) & (col[None, :] >= j * block)
         mask = mask[None, None]  # [1, 1, t, block]
@@ -172,8 +234,9 @@ def _decode_lax(q_t, k_cache, v_cache, limit, valid_from, block, scale):
         p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1)
+        pv = p * vsl[:, :, None, :] if quant else p.astype(v.dtype)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bntk,bnkd->bntd", p.astype(v.dtype), v,
+            "bntk,bnkd->bntd", pv, v,
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
@@ -229,7 +292,55 @@ def _decode_kernel(
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
 
 
-def _decode_pallas(q_t, k_cache, v_cache, limit, valid_from, block, scale):
+def _decode_kernel_q8(
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, limit_ref, vf_ref, o_ref,
+    *, scale, block, max_len, t
+):
+    """int8 spelling of :func:`_decode_kernel`: the kv refs stream the
+    cache as int8 and the per-slot scales ride two [max_len] f32 rows —
+    scores absorb the key scale per COLUMN, probabilities absorb the
+    value scale per column, so the dequantized cache never exists and
+    the block's HBM bytes are half the bf16 kernel's."""
+    q = q_ref[0, 0].astype(jnp.float32)  # [t, d]
+    d = q.shape[-1]
+    limit = limit_ref[0, 0]
+    vf = vf_ref[0, 0, 0]
+    row_pos = (limit - t) + jax.lax.broadcasted_iota(jnp.int32, (t, block), 0)
+
+    m0 = jnp.full((t,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((t,), jnp.float32)
+    acc0 = jnp.zeros((t, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        start = jnp.maximum(jnp.minimum(j * block, max_len - block), 0)
+        k = k_ref[0, 0, pl.dslice(start, block), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(start, block), :].astype(jnp.float32)
+        ksl = ks_ref[0, 0, pl.dslice(start, block)]
+        vsl = vs_ref[0, 0, pl.dslice(start, block)]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * ksl[None, :]  # [t, block]
+        col = start + jax.lax.broadcasted_iota(jnp.int32, (t, block), 1)
+        mask = (col <= row_pos) & (col >= j * block) & (col >= vf)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p * vsl[None, :], v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    nvisit = blocks_visited(limit, block, max_len)
+    m, l, acc = jax.lax.fori_loop(0, nvisit, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _decode_pallas(q_t, k_cache, v_cache, limit, valid_from, block, scale,
+                   k_scale=None, v_scale=None):
     b, n, t, d = q_t.shape
     max_len = k_cache.shape[2]
     limit_arr = jnp.full((1, 1), limit, jnp.int32)
@@ -238,6 +349,25 @@ def _decode_pallas(q_t, k_cache, v_cache, limit, valid_from, block, scale):
         if valid_from is None
         else valid_from.astype(jnp.int32).reshape(b, 1, 1)
     )
+    kv_spec = pl.BlockSpec((1, 1, max_len, d), lambda i, j: (i, j, 0, 0))
+    scl_spec = pl.BlockSpec((1, 1, max_len), lambda i, j: (i, j, 0))
+    if k_scale is not None:
+        kernel = functools.partial(
+            _decode_kernel_q8, scale=scale, block=block, max_len=max_len, t=t
+        )
+        return pl.pallas_call(
+            kernel,
+            grid=(b, n),
+            in_specs=[
+                pl.BlockSpec((1, 1, t, d), lambda i, j: (i, j, 0, 0)),
+                kv_spec, kv_spec, scl_spec, scl_spec,
+                pl.BlockSpec((1, 1), lambda i, j: (0, 0)),
+                pl.BlockSpec((1, 1, 1), lambda i, j: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, t, d), lambda i, j: (i, j, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((b, n, t, d), jnp.float32),
+            interpret=_interpret(),
+        )(q_t, k_cache, v_cache, k_scale, v_scale, limit_arr, vf_arr)
     kernel = functools.partial(
         _decode_kernel, scale=scale, block=block, max_len=max_len, t=t
     )
@@ -246,8 +376,7 @@ def _decode_pallas(q_t, k_cache, v_cache, limit, valid_from, block, scale):
         grid=(b, n),
         in_specs=[
             pl.BlockSpec((1, 1, t, d), lambda i, j: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, max_len, d), lambda i, j: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, max_len, d), lambda i, j: (i, j, 0, 0)),
+            kv_spec, kv_spec,
             pl.BlockSpec((1, 1), lambda i, j: (0, 0)),
             pl.BlockSpec((1, 1, 1), lambda i, j: (i, 0, 0)),
         ],
@@ -272,6 +401,8 @@ def decode_attention(
     kv_valid_from: Optional[jax.Array] = None,
     block: int = 0,
     impl: str = "auto",
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Blocked KV-cache attention over keys [0, pos + t).
 
@@ -280,10 +411,17 @@ def decode_attention(
     already written).  ``kv_valid_from`` [b] masks keys before a row's
     first real token (left-padded serving buckets).  Returns [b, t, n, d].
 
+    With an int8 cache (PFX_KV_DTYPE=int8), ``k_scale``/``v_scale``
+    [b, n, max_len] carry the per-(slot, head) quantization scales and
+    both spellings dequantize IN-KERNEL (scores absorb the key scale,
+    probabilities the value scale) — pass both or neither.
+
     ``impl``: "auto" (pallas on TPU, lax elsewhere) | "pallas" | "lax".
     """
     if impl not in ("auto", "pallas", "lax"):
         raise ValueError(f"decode_attention impl {impl!r}; valid: auto, pallas, lax")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
     b, t, n, d = q.shape
     max_len = k_cache.shape[2]
     bs = decode_block(max_len, block)
@@ -295,9 +433,11 @@ def decode_attention(
     # tile it, so route to the lax spelling
     use_pallas = impl == "pallas" or (impl == "auto" and not _interpret())
     if use_pallas and bs % 8 == 0:
-        out = _decode_pallas(q_t, k_cache, v_cache, limit, kv_valid_from, bs, scale)
+        out = _decode_pallas(q_t, k_cache, v_cache, limit, kv_valid_from,
+                             bs, scale, k_scale, v_scale)
     else:
-        out = _decode_lax(q_t, k_cache, v_cache, limit, kv_valid_from, bs, scale)
+        out = _decode_lax(q_t, k_cache, v_cache, limit, kv_valid_from,
+                          bs, scale, k_scale, v_scale)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
@@ -307,29 +447,38 @@ def decode_attention(
 # ---------------------------------------------------------------------------
 
 
-def _paged_lax(q_t, k_pool, v_pool, tables, positions, scale):
-    """q_t [b, n, 1, d]; pools [nb, n, bs, d]; tables [b, M] block ids;
-    positions [b] = global slot of each row's query token.
+def _paged_lax(q_t, k_pool, v_pool, tables, positions, scale,
+               k_scale=None, v_scale=None):
+    """q_t [b, n, t, d]; pools [nb, n, bs, d]; tables [b, M] block ids;
+    positions [b] = global slot of each row's FIRST query token (query
+    qi sits at slot positions[i] + qi — t > 1 is the speculative
+    multi-token verify chunk, causal within the chunk).
 
     Blocked online-softmax over each row's OWN block list: block j of row
     i holds key slots [j*bs, (j+1)*bs) of that row's logical cache, stored
-    at pool block ``tables[i, j]``.  Rows attend over [0, positions[i]+1)
-    — per-row limits, unlike :func:`_decode_lax`'s shared ``limit``.
-    Table entries beyond a row's limit (null-block padding) are masked by
-    the causal bound, so their garbage never reaches the accumulator.
+    at pool block ``tables[i, j]``.  Query qi of row i attends over
+    [0, positions[i] + qi + 1) — per-row, per-query limits, unlike
+    :func:`_decode_lax`'s shared ``limit``.  Table entries beyond a row's
+    limit (null-block padding) are masked by the causal bound, so their
+    garbage never reaches the accumulator.  With int8 pools,
+    ``k_scale``/``v_scale`` [nb, n, bs] dequantize in-loop (scores absorb
+    the key scale, probabilities the value scale).
     """
     b, n, t, d = q_t.shape
     bs = k_pool.shape[2]
+    quant = k_scale is not None
 
     m0 = jnp.full((b, n, t), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, n, t), jnp.float32)
     acc0 = jnp.zeros((b, n, t, d), jnp.float32)
 
-    # each row's last needed block: the fori bound below is the BATCH max,
-    # so shorter rows clamp their gather to their own last block (re-read,
-    # fully masked) — same per-row clamp as the pallas index_map, keeping
-    # both spellings honestly bounded by each row's real length
-    last_blk = jnp.maximum(positions, 0) // bs
+    # each row's last needed block (its LAST query's slot): the fori
+    # bound below is the BATCH max, so shorter rows clamp their gather to
+    # their own last block (re-read, fully masked) — same per-row clamp
+    # as the pallas index_map, keeping both spellings honestly bounded by
+    # each row's real length
+    last_blk = jnp.maximum(positions + t - 1, 0) // bs
+    q_off = jnp.arange(t)  # query qi's slot offset within the chunk
 
     def body(j, carry):
         m, l, acc = carry
@@ -337,24 +486,33 @@ def _paged_lax(q_t, k_pool, v_pool, tables, positions, scale):
         blk = jnp.take_along_axis(tables, jidx[:, None], axis=1)[:, 0]  # [b]
         k = jnp.take(k_pool, blk, axis=0)  # [b, n, bs, d] gather
         v = jnp.take(v_pool, blk, axis=0)
+        if quant:
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
+            ksl = jnp.take(k_scale, blk, axis=0)  # [b, n, bs]
+            vsl = jnp.take(v_scale, blk, axis=0)
         s = scale * jnp.einsum(
             "bntd,bnkd->bntk", q_t, k, preferred_element_type=jnp.float32
         )  # [b, n, t, bs]
+        if quant:
+            s = s * ksl[:, :, None, :]
         col = j * bs + jnp.arange(bs)  # logical slot of each key column
-        mask = col[None, None, None, :] <= positions[:, None, None, None]
+        qpos = positions[:, None] + q_off[None, :]  # [b, t]
+        mask = col[None, None, None, :] <= qpos[:, None, :, None]
         s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1)
+        pv = p * vsl[:, :, None, :] if quant else p.astype(v.dtype)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bntk,bnkd->bntd", p.astype(v.dtype), v,
+            "bntk,bnkd->bntd", pv, v,
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
 
     nvisit = jnp.minimum(
-        (jnp.max(positions) + 1 + bs - 1) // bs, tables.shape[1]
+        (jnp.max(positions) + t + bs - 1) // bs, tables.shape[1]
     )
     m, l, acc = jax.lax.fori_loop(0, nvisit, body, (m0, l0, acc0))
     # rows whose table is all-null (inactive slots, positions < 0 would
@@ -365,17 +523,22 @@ def _paged_lax(q_t, k_pool, v_pool, tables, positions, scale):
 
 def _paged_kernel(
     tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, scale, bs, t
+    *, scale, bs, t, ks_ref=None, vs_ref=None
 ):
     """One (batch, head, block) grid step.  The kv BlockSpec's index_map
     already DMA'd pool block ``tables[i, min(j, last_needed(i))]`` — the
     scalar-prefetch CLAMP: grid steps past a row's limit re-address the
     previously fetched block (no new DMA) and are fully masked here, so
     HBM traffic scales with the tokens the row actually holds, not with
-    the padded table width."""
+    the padded table width.  ``t`` > 1 is the speculative verify chunk:
+    query qi sits at slot pos + qi, causal within the chunk.  With int8
+    pools the optional scale refs dequantize in-kernel: the scores
+    absorb the per-key scale column-wise and the probabilities the
+    per-value scale — the dequantized block never materializes."""
     i = pl.program_id(0)
     j = pl.program_id(2)
     nblk = pl.num_programs(2)
+    quant = ks_ref is not None
 
     @pl.when(j == 0)
     def _init():
@@ -386,12 +549,20 @@ def _paged_kernel(
     q = q_ref[0, 0]  # [t, d]
     k = k_ref[0, 0]  # [bs, d] (one pool block for this head)
     v = v_ref[0, 0]
+    if quant:
+        q = q.astype(jnp.float32)
+        k = k.astype(jnp.float32)
+        v = v.astype(jnp.float32)
     pos = pos_ref[i]
     s = scale * jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [t, bs]
+    if quant:
+        s = s * ks_ref[0, 0][None, :]
     col = j * bs + jax.lax.broadcasted_iota(jnp.int32, (t, bs), 1)
-    mask = col <= pos
+    # query qi's own causal bound: slot pos + qi
+    qrow = pos + jax.lax.broadcasted_iota(jnp.int32, (t, bs), 0)
+    mask = col <= qrow
     s = jnp.where(mask, s, NEG_INF)
 
     m_prev = m_ref[:, :1]  # [t, 1] (lane-replicated store)
@@ -399,8 +570,9 @@ def _paged_kernel(
     p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
     alpha = jnp.exp(m_prev - m_new)
     l_new = l_ref[:, :1] * alpha + p.sum(axis=-1, keepdims=True)
+    pv = p * vs_ref[0, 0][None, :] if quant else p.astype(v.dtype)
     acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        pv, v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -413,7 +585,8 @@ def _paged_kernel(
         ).astype(o_ref.dtype)
 
 
-def _paged_pallas(q_t, k_pool, v_pool, tables, positions, scale):
+def _paged_pallas(q_t, k_pool, v_pool, tables, positions, scale,
+                  k_scale=None, v_scale=None):
     from jax.experimental.pallas import tpu as pltpu
 
     b, n, t, d = q_t.shape
@@ -421,22 +594,48 @@ def _paged_pallas(q_t, k_pool, v_pool, tables, positions, scale):
     M = tables.shape[1]
     tables = tables.astype(jnp.int32)
     positions = positions.astype(jnp.int32)
+    quant = k_scale is not None
 
     def kv_index(i, j, k, tables_ref, pos_ref):
         # scalar-prefetch clamp: past a row's last needed block, re-address
         # the block we already fetched — Pallas skips the DMA when the
-        # index is unchanged between consecutive grid steps
-        last = jnp.maximum(pos_ref[i], 0) // bs
+        # index is unchanged between consecutive grid steps.  The last
+        # needed block covers the chunk's LAST query slot (pos + t - 1).
+        last = jnp.maximum(pos_ref[i] + (t - 1), 0) // bs
         return tables_ref[i, jnp.minimum(k, last)], j, 0, 0
+
+    def scl_index(i, j, k, tables_ref, pos_ref):
+        # same clamped pool-block address, scale tile [1, 1, bs]
+        last = jnp.maximum(pos_ref[i] + (t - 1), 0) // bs
+        return tables_ref[i, jnp.minimum(k, last)], j, 0
+
+    in_specs = [
+        pl.BlockSpec((1, 1, t, d), lambda i, j, k, *_: (i, j, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+    ]
+    operands = [q_t, k_pool, v_pool]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, bs), scl_index),
+            pl.BlockSpec((1, 1, bs), scl_index),
+        ]
+        operands += [k_scale, v_scale]
+
+        def kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                   o_ref, acc_ref, m_ref, l_ref):
+            _paged_kernel(
+                tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                acc_ref, m_ref, l_ref, scale=scale, bs=bs, t=t,
+                ks_ref=ks_ref, vs_ref=vs_ref,
+            )
+    else:
+        kernel = functools.partial(_paged_kernel, scale=scale, bs=bs, t=t)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, n, M),
-        in_specs=[
-            pl.BlockSpec((1, 1, t, d), lambda i, j, k, *_: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, bs, d), kv_index),
-            pl.BlockSpec((1, 1, bs, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, t, d), lambda i, j, k, *_: (i, j, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((t, d), jnp.float32),
@@ -444,13 +643,12 @@ def _paged_pallas(q_t, k_pool, v_pool, tables, positions, scale):
             pltpu.VMEM((t, 128), jnp.float32),
         ],
     )
-    kernel = functools.partial(_paged_kernel, scale=scale, bs=bs, t=t)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n, t, d), jnp.float32),
         interpret=_interpret(),
-    )(tables, positions, q_t, k_pool, v_pool)
+    )(tables, positions, *operands)
 
 
 def paged_decode_attention(
@@ -461,16 +659,27 @@ def paged_decode_attention(
     positions: jax.Array,
     *,
     impl: str = "auto",
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Block-table-indexed decode attention for the paged KV cache.
 
-    q [b, 1, n, d]; pools [num_blocks, n, block, d] (one layer's arena —
+    q [b, t, n, d]; pools [num_blocks, n, block, d] (one layer's arena —
     ``core/paged_cache.py``); ``block_tables`` [b, M] maps row i's logical
-    block j to a pool block id; ``positions`` [b] is each row's CURRENT
-    token slot (the chunk already written) — row i attends over its
-    logical slots [0, positions[i]+1).  Rows are fully independent: each
-    has its own length, so there is no shared ``limit`` and no
-    ``kv_valid_from`` (paged rows are unpadded).  Returns [b, 1, n, d].
+    block j to a pool block id; ``positions`` [b] is the slot of each
+    row's FIRST query token (the chunk already written) — query qi of
+    row i attends over its logical slots [0, positions[i] + qi + 1),
+    causal within the chunk.  t = 1 is the plain decode step; t > 1 is
+    the speculative multi-token verify chunk (k drafts + 1).  Rows are
+    fully independent: each has its own length, so there is no shared
+    ``limit`` and no ``kv_valid_from`` (paged rows are unpadded).
+    Returns [b, t, n, d].
+
+    With int8 pools (PFX_KV_DTYPE=int8), ``k_scale``/``v_scale``
+    [num_blocks, n, block] carry the per-(slot, head) scales stored
+    alongside the arena; both spellings dequantize in-kernel (the pallas
+    spelling rides the same scalar-prefetch-clamped index map, so the
+    scale tiles DMA with their block) — pass both or neither.
 
     ``impl``: "auto" (pallas on TPU, lax elsewhere) | "pallas" | "lax".
     The pallas spelling DMAs exactly one pool block per grid step with a
@@ -483,11 +692,11 @@ def paged_decode_attention(
         raise ValueError(
             f"paged_decode_attention impl {impl!r}; valid: auto, pallas, lax"
         )
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
     b, t, n, d = q.shape
-    if t != 1:
-        raise ValueError(
-            f"paged_decode_attention is a decode-step kernel (t=1); got t={t}"
-        )
+    if t < 1:
+        raise ValueError(f"paged_decode_attention needs t >= 1; got t={t}")
     bs = k_pool.shape[2]
     if impl == "pallas" and bs % 8:
         # an explicit pallas request must run pallas or fail LOUDLY — a
@@ -501,9 +710,11 @@ def paged_decode_attention(
     q_t = q.transpose(0, 2, 1, 3)  # [b, n, t, d]
     use_pallas = impl == "pallas" or (impl == "auto" and not _interpret())
     if use_pallas and bs % 8 == 0:
-        out = _paged_pallas(q_t, k_pool, v_pool, block_tables, positions, scale)
+        out = _paged_pallas(q_t, k_pool, v_pool, block_tables, positions,
+                            scale, k_scale, v_scale)
     else:
-        out = _paged_lax(q_t, k_pool, v_pool, block_tables, positions, scale)
+        out = _paged_lax(q_t, k_pool, v_pool, block_tables, positions,
+                         scale, k_scale, v_scale)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
@@ -514,14 +725,30 @@ def dense_cache_attention(
     pos: jax.Array,
     *,
     kv_valid_from: Optional[jax.Array] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """The legacy decode attention: attend over the ENTIRE preallocated
     cache with a materialized [., 1, t, max_len] additive bias (what
     ``_layer_with_cache`` did via ``xla_attention`` before the blocked
     kernel).  Kept verbatim-in-semantics for PFX_DECODE_ATTN=dense A/B
     benchmark rows; same [b, n, L, d] cache layout, no extra transposes,
-    so a legacy row measures the old math, not a layout penalty."""
+    so a legacy row measures the old math, not a layout penalty.  An
+    int8 cache is simply dequantized up front — this path exists for
+    honest legacy A/B rows, not for HBM savings."""
     b, t, n, d = q.shape
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    if k_scale is not None:
+        # dequantize in f32 and cast the PRODUCT once: the blocked/paged
+        # kernels apply scales in f32, and an A/B row comparing against
+        # them must not carry extra bf16-rounded-scale error
+        k_cache = (
+            k_cache.astype(jnp.float32) * k_scale[..., None]
+        ).astype(q.dtype)
+        v_cache = (
+            v_cache.astype(jnp.float32) * v_scale[..., None]
+        ).astype(q.dtype)
     max_len = k_cache.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     q_pos = pos + jnp.arange(t)[:, None]

@@ -135,6 +135,33 @@ def sample_top_p_topk(
     return jax.lax.cond(covered, fast, slow, operand=None)
 
 
+def filtered_logits(
+    logits: jax.Array,
+    *,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """The sampling pipeline's FILTER stages only (temperature -> top-k ->
+    top-p), returning the filtered logits instead of a draw.
+
+    The speculative-decoding verify path (``ops/speculative.py``) needs
+    the target DISTRIBUTION, not a sample: acceptance tests draft tokens
+    against ``softmax(filtered_logits)`` and the Leviathan residual rule
+    re-samples from the same filtered distribution with the rejected
+    draft masked — both must see exactly the distribution the baseline
+    sampler draws from, which is what these filters define
+    (:func:`sample_top_p_topk` is distribution-identical to the full
+    sort by construction)."""
+    if temperature != 1.0:
+        logits = logits / temperature
+    if top_k > 0:
+        logits = top_k_filter(logits, top_k)
+    if top_p < 1.0:
+        logits = top_p_filter(logits, top_p)
+    return logits
+
+
 def sample_logits(
     key: jax.Array,
     logits: jax.Array,
@@ -151,7 +178,31 @@ def sample_logits(
     (:func:`sample_top_p_topk`, ``top_p_prefilter_k`` candidates —
     PFX_TOPP_K overrides, 0 disables) so the per-step cost is a top-k
     over the vocab instead of a full argsort+cumsum; the full sort runs
-    only when some row's nucleus overflows the prefilter."""
+    only when some row's nucleus overflows the prefilter.
+
+    ``logits`` may be [b, vocab] (one position -> ids [b], the original
+    contract, unchanged) or [b, k, vocab] (k positions -> ids [b, k]):
+    the multi-position form splits ``key`` into k per-position subkeys
+    and samples each position independently.  The speculative verify
+    step (``ops/speculative.py``) draws its fresh/residual candidates
+    through this form with the filters at identity settings — it
+    filters ONCE itself via :func:`filtered_logits`, so passing
+    non-default filter args there would double-filter."""
+    if logits.ndim == 3:
+        b, k, _ = logits.shape
+        subkeys = jax.random.split(key, k)
+
+        def one(pos_key, pos_logits):  # pos_logits [b, vocab]
+            return sample_logits(
+                pos_key, pos_logits, temperature=temperature, top_k=top_k,
+                top_p=top_p, top_p_prefilter_k=top_p_prefilter_k,
+            )
+
+        # vmap over the position axis: per-position subkeys, independent
+        # draws, [k, b] -> [b, k]
+        return jax.vmap(one, in_axes=(0, 1), out_axes=1)(
+            subkeys, logits
+        )
     if temperature != 1.0:
         logits = logits / temperature
     if top_k > 0:

@@ -1,0 +1,397 @@
+"""Speculative decoding: draft proposal + accept/reject verification.
+
+Leviathan et al. 2023 ("Fast Inference from Transformers via Speculative
+Decoding"): a cheap DRAFTER proposes k tokens, the target model verifies
+all of them in ONE multi-token forward (the cached forward and both
+decode-attention spellings already take t > 1), and a rejection rule
+guarantees the output distribution is unchanged — greedy output is
+token-identical to the non-speculative path by construction (accept
+exactly the prefix matching the target argmax; the first mismatch is
+replaced by the target's own token), and sampled output preserves the
+target distribution via the residual rule for a point-mass drafter
+(accept draft d w.p. p(d); on rejection sample from p with d's mass
+removed and renormalized — the marginal is exactly p).
+
+This module is the scheduler-agnostic toolbox; the decode loops that
+consume it live in ``models/gpt/generation.py`` (contiguous while-loop +
+paged ``decode_step_spec``) and the wiring in ``core/serving.py`` /
+``core/continuous_batching.py``:
+
+  - :class:`SpecConfig` — draft_k / drafter knobs (the ``Generation.
+    speculative`` config section; part of the jit compile key, so a
+    changed k retraces exactly like a changed decode strategy).
+  - :func:`ngram_propose` (in-graph) / :func:`ngram_propose_host` —
+    the default SELF-DRAFTING prompt-lookup drafter: find the last
+    earlier occurrence of the trailing n-gram in the row's own
+    prompt+output and propose the tokens that followed it.  No second
+    model, no extra weights; acceptance is high exactly when decode is
+    repetitive (code, tables, random-weight argmax cycles).  A wrong
+    proposal costs nothing but the verify FLOPs — the accept rule
+    discards it.
+  - :func:`speculative_verify` — the vectorized accept/reject rule over
+    one verified chunk, shared by both decode paths: per-row accepted
+    prefix length, EOS handling, pad substitution for finished rows,
+    and the per-slot "next pending token" candidates (target argmax for
+    greedy; fresh/residual samples with per-position subkeys for
+    sampling — ``ops/sampling.filtered_logits`` defines the target
+    distribution the acceptance test and the residual draw share).
+
+A draft-MODEL drafter (a small GPT sharing the tokenizer) plugs in by
+generating the k proposal tokens with its own cached decode and handing
+them to the same verify rule; the accept math never cares where the
+proposal came from (point-mass q covers any deterministic drafter;
+greedy draft models are deterministic).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddlefleetx_tpu.ops.sampling import filtered_logits
+
+NEG = -1e10
+
+DRAFTERS = ("ngram",)
+
+# backwards-scan cap of the host prompt-lookup drafter: bounds the
+# per-step host cost on long non-repetitive rows (callers may also
+# slice their history to this window + needle/draft slack — the scan
+# never looks further back)
+NGRAM_WINDOW = 2048
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Speculation knobs (``Generation.speculative`` in serving configs).
+
+    ``draft_k``: proposal length per iteration — each verify forward
+    processes k+1 tokens and commits between 1 and k+1 of them.
+    ``drafter``: proposal source ("ngram" = self-drafting prompt lookup).
+    ``ngram``: match length of the lookup needle (2 = bigram retrieval,
+    the prompt-lookup default)."""
+
+    draft_k: int = 4
+    drafter: str = "ngram"
+    ngram: int = 2
+
+    def __post_init__(self):
+        if self.draft_k < 1:
+            raise ValueError(f"draft_k must be >= 1, got {self.draft_k}")
+        if self.drafter not in DRAFTERS:
+            raise ValueError(
+                f"bad drafter {self.drafter!r}; valid: {', '.join(DRAFTERS)}"
+            )
+        if self.ngram < 1:
+            raise ValueError(f"ngram must be >= 1, got {self.ngram}")
+
+
+def spec_config_from(section) -> Optional[SpecConfig]:
+    """Parse a ``Generation.speculative`` config section -> SpecConfig,
+    or None when speculation is disabled (absent section / draft_k 0).
+    Loud on unknown drafters or invalid k — a typo must not silently
+    serve the non-speculative path while the operator benchmarks "spec".
+    (``kv_dtype`` lives in the same section but routes to the cache
+    allocation, not here — see ``ops/decode_attention.kv_cache_dtype``.)
+    """
+    section = dict(section or {})
+    draft_k = int(section.get("draft_k", 0) or 0)
+    if draft_k == 0:
+        return None
+    return SpecConfig(
+        draft_k=draft_k,
+        drafter=str(section.get("drafter", "ngram")),
+        ngram=int(section.get("ngram", 2)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Self-drafting n-gram / prompt-lookup proposal
+# ---------------------------------------------------------------------------
+
+
+def ngram_propose(
+    ctx: jax.Array,
+    known_len: jax.Array,
+    pending: jax.Array,
+    k: int,
+    n: int = 2,
+) -> jax.Array:
+    """In-graph prompt-lookup drafter (runs inside the fused decode loop).
+
+    ``ctx`` [b, L] holds each row's prompt + committed tokens in slots
+    [0, known_len); ``pending`` [b] is the already-decided next token
+    (not yet in ctx).  The proposal needle is the n-gram ending at the
+    pending token; the draft is the k tokens that followed the needle's
+    LAST earlier occurrence.  Rows with no match (or a match whose
+    continuation runs past the known region) fall back to repeating the
+    pending token — the cheapest proposal that still wins on the
+    single-token loops random-weight greedy decode collapses into.
+    Returns [b, k] int32; a bad proposal is merely rejected downstream,
+    so this function has no correctness burden beyond shape."""
+    if k < 1:
+        raise ValueError(f"ngram_propose needs k >= 1, got {k}")
+    b, L = ctx.shape
+    known_len = jnp.asarray(known_len, jnp.int32)
+    idx = jnp.arange(L, dtype=jnp.int32)
+    match = jnp.ones((b, L), bool)
+    for j in range(n):
+        shift = n - 1 - j
+        if shift == 0:
+            need = pending
+            shifted = ctx
+        else:
+            gpos = jnp.clip(known_len - shift, 0, L - 1)
+            need = ctx[:, gpos]
+            shifted = jnp.pad(ctx, ((0, 0), (shift, 0)))[:, :L]
+        match = match & (shifted == need[:, None])
+    # a candidate end-position p must fit the whole needle and leave at
+    # least one predictable token: n-1 <= p <= known_len - 2
+    match = match & (idx >= n - 1)[None, :] & (idx <= known_len - 2)[None, :]
+    has = match.any(axis=1)
+    last_p = (L - 1) - jnp.argmax(
+        match[:, ::-1].astype(jnp.int32), axis=1
+    ).astype(jnp.int32)
+    offs = jnp.arange(1, k + 1, dtype=jnp.int32)
+    gidx = jnp.clip(last_p[:, None] + offs[None, :], 0, L - 1)
+    cand = jnp.take_along_axis(ctx, gidx, axis=1)
+    valid = has[:, None] & (last_p[:, None] + offs[None, :] <= known_len - 1)
+    return jnp.where(valid, cand, pending[:, None]).astype(jnp.int32)
+
+
+def ngram_propose_host(seq, k: int, n: int = 2, window: int = NGRAM_WINDOW):
+    """Host-side prompt-lookup drafter (the continuous-batching scheduler
+    drafts from each row's python-side prompt+tokens history between
+    steps — proposals are runtime DATA fed to the compiled spec step,
+    never a compile key).
+
+    ``seq``: list of ints (prompt + generated so far).  Proposes the k
+    tokens following the last earlier occurrence of the trailing
+    n-gram; falls back to repeating the last token.  The backwards scan
+    is capped at the last ``window`` positions so the per-step host
+    cost stays bounded on long non-repetitive rows (a miss would
+    otherwise walk the whole history every step, serialized with the
+    device dispatch); an incremental {n-gram -> last position} index
+    per row is the upgrade path if profiles ever show this cap
+    mattering."""
+    if k < 1:
+        raise ValueError(f"ngram_propose_host needs k >= 1, got {k}")
+    seq = list(seq)
+    if not seq:
+        return [0] * k
+    last = seq[-1]
+    if len(seq) > n:
+        needle = seq[-n:]
+        lo = max(n - 2, len(seq) - 2 - int(window))
+        for p in range(len(seq) - 2, lo, -1):
+            if seq[p - n + 1 : p + 1] == needle:
+                out = list(seq[p + 1 : p + 1 + k])
+                while len(out) < k:
+                    out.append(out[-1])
+                return out
+    return [last] * k
+
+
+# ---------------------------------------------------------------------------
+# Accept/reject verification over one chunk
+# ---------------------------------------------------------------------------
+
+
+class SpecVerify(NamedTuple):
+    """Verification of one [b, k+1] chunk = [pending, draft_0..draft_{k-1}].
+
+    Slot j of ``logits_all`` is the target distribution for the decode
+    step AFTER chunk slot j; slots are verified under the SAME processor
+    chain the baseline loop applies (min-length, repetition penalty,
+    forced BOS/EOS) at the step each token would occupy.
+
+    ``real`` [b, k+1]: slot j would be committed as a real (non-pad)
+    token if the commit window reaches it — the chain breaks at the
+    first draft mismatch/rejection and at the first EOS.
+    ``accepted`` [b]: accepted draft count (length of the real chain
+    past slot 0).
+    ``eos_hit`` [b, k+1]: real slots carrying EOS (the row finishes
+    there once the window covers it).
+    ``ok`` [b, k]: per-draft accept test (greedy: matches the processed
+    argmax; sampled: u < p(draft) on the filtered target distribution).
+    ``pend`` [b, k+1]: per-slot NEXT-pending candidate if the window
+    ends at slot j — greedy: the processed argmax (= the corrected token
+    on a mismatch, the bonus token at slot k); sampled: a residual draw
+    (draft masked, renormalized) where the draft was rejected, a fresh
+    draw elsewhere — per-position subkeys.
+    ``w`` [b, k+1]: the chunk with baseline pad substitution applied
+    (finished / post-EOS / never-alive slots -> pad_token_id), i.e. what
+    the baseline loop would have emitted at those steps."""
+
+    real: jax.Array
+    accepted: jax.Array
+    eos_hit: jax.Array
+    ok: jax.Array
+    pend: jax.Array
+    w: jax.Array
+
+
+def _process(logits, counts, steps, gen, forced_steps):
+    """THE baseline per-step logits-processor chain — delegates to the
+    single-sourced ``generation.process_step_logits`` (lazy import:
+    generation imports this module at top level), so the verify-time
+    acceptance distributions can never drift from the distributions the
+    decode loops actually sample from."""
+    from paddlefleetx_tpu.models.gpt.generation import process_step_logits
+
+    return process_step_logits(logits, steps, counts, forced_steps, gen)
+
+
+def _cat_multi(key: jax.Array, logits: jax.Array) -> jax.Array:
+    """Per-position categorical with per-position subkeys:
+    [b, K, v] -> [b, K].  Rides ``sample_logits``'s multi-position form
+    with every filter at its identity setting — verify already filtered
+    these logits, so the draw must be a bare categorical (re-applying
+    top-p on filtered logits would re-truncate the renormalized
+    nucleus)."""
+    from paddlefleetx_tpu.ops.sampling import sample_logits
+
+    return sample_logits(key, logits)
+
+
+def speculative_verify(
+    key: Optional[jax.Array],
+    logits_all: jax.Array,
+    chunk: jax.Array,
+    base_counts: Optional[jax.Array],
+    alive0: jax.Array,
+    step0: jax.Array,
+    gen,
+    forced_steps: Optional[jax.Array] = None,
+) -> SpecVerify:
+    """Verify one chunk against the target logits — THE accept/reject
+    rule, shared by the contiguous and paged decode paths.
+
+    ``logits_all`` [b, k+1, v] f32: slot j = target distribution for
+    step ``step0 + 1 + j`` (conditioned on chunk[:, :j+1]).
+    ``chunk`` [b, k+1]: slot 0 the already-decided pending token, slots
+    1..k the drafts.  ``base_counts`` [b, v] or None (None when
+    repetition_penalty == 1.0): tokens emitted through step step0 - 1.
+    ``alive0`` [b]: unfinished at window start.  ``step0`` scalar or [b]
+    (the paged path's rows sit at different steps).  ``forced_steps``
+    [b] overrides the forced-EOS firing step (paged rows carry the
+    coalesce-path bucketed run end); defaults to max_dec_len - 1.
+
+    Greedy verification is exact-match against the processed argmax —
+    committed tokens are bitwise the baseline loop's.  Sampled
+    verification accepts draft d with probability p(d) under the
+    FILTERED target distribution (``ops/sampling.filtered_logits``) and
+    the residual candidates mask d post-filter — the Leviathan
+    point-mass-q rule, exact for any temperature/top-k/top-p setting."""
+    greedy = gen.decode_strategy == "greedy_search"
+    if not greedy and key is None:
+        raise ValueError("sampled speculative_verify needs a PRNG key")
+    b, K, _ = logits_all.shape
+    k = K - 1
+    pad = gen.pad_token_id
+    eos = gen.eos_token_id
+    steps0 = jnp.broadcast_to(jnp.asarray(step0, jnp.int32), (b,))
+    if forced_steps is None:
+        forced_steps = jnp.full((b,), gen.max_dec_len - 1, jnp.int32)
+    noeos = chunk != eos
+    logits_all = logits_all.astype(jnp.float32)
+
+    def slot_pend_ok(proc, slot_key):
+        """proc [b, K, v] processed logits -> (pend [b, K], ok [b, k])."""
+        if greedy:
+            tgt = jnp.argmax(proc, axis=-1).astype(jnp.int32)
+            return tgt, chunk[:, 1:] == tgt[:, :k]
+        filt = filtered_logits(
+            proc, temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p
+        )
+        probs = jax.nn.softmax(filt, axis=-1)
+        k_acc, k_fresh, k_resid = jax.random.split(slot_key, 3)
+        p_d = jnp.take_along_axis(
+            probs[:, :k], chunk[:, 1:, None], axis=-1
+        )[..., 0]
+        ok = jax.random.uniform(k_acc, (b, k)) < p_d
+        fresh = _cat_multi(k_fresh, filt).astype(jnp.int32)
+        resid_logits = filt[:, :k].at[
+            jnp.arange(b)[:, None], jnp.arange(k)[None, :], chunk[:, 1:]
+        ].set(NEG)
+        resid = _cat_multi(k_resid, resid_logits).astype(jnp.int32)
+        pend = jnp.concatenate(
+            [jnp.where(ok, fresh[:, :k], resid), fresh[:, k:]], axis=1
+        )
+        return pend, ok
+
+    if base_counts is None or gen.repetition_penalty == 1.0:
+        # vectorized: no counts feedback, every slot processed at once
+        steps = steps0[:, None] + 1 + jnp.arange(K, dtype=jnp.int32)[None, :]
+        proc = _process(logits_all, None, steps, gen, forced_steps[:, None])
+        pend, ok = slot_pend_ok(proc, key)
+    else:
+        # repetition penalty consumes the counts of every PRIOR chunk
+        # token (with baseline pad substitution), which depend on the
+        # accept chain so far — unroll the k+1 slots sequentially
+        # (k is small and static)
+        counts = base_counts
+        real_j = alive0
+        pends, oks = [], []
+        slot_keys = (
+            jax.random.split(key, K) if not greedy else [None] * K
+        )
+        for j in range(K):
+            w_j = jnp.where(real_j, chunk[:, j], pad)
+            counts = counts.at[jnp.arange(b), w_j].add(1)
+            steps_j = steps0 + 1 + j
+            proc_j = _process(
+                logits_all[:, j], counts, steps_j, gen, forced_steps
+            )
+            # slot-wise spelling of slot_pend_ok (proc_j is [b, v])
+            if greedy:
+                tgt_j = jnp.argmax(proc_j, axis=-1).astype(jnp.int32)
+                pend_j = tgt_j
+                ok_j = (chunk[:, j + 1] == tgt_j) if j < k else None
+            else:
+                filt_j = filtered_logits(
+                    proc_j, temperature=gen.temperature, top_k=gen.top_k,
+                    top_p=gen.top_p,
+                )
+                probs_j = jax.nn.softmax(filt_j, axis=-1)
+                k_acc, k_fresh, k_resid = jax.random.split(slot_keys[j], 3)
+                fresh_j = jax.random.categorical(
+                    k_fresh, filt_j, axis=-1
+                ).astype(jnp.int32)
+                if j < k:
+                    d_j = chunk[:, j + 1]
+                    p_d = jnp.take_along_axis(
+                        probs_j, d_j[:, None], axis=-1
+                    )[:, 0]
+                    ok_j = jax.random.uniform(k_acc, (b,)) < p_d
+                    resid_j = jax.random.categorical(
+                        k_resid,
+                        filt_j.at[jnp.arange(b), d_j].set(NEG),
+                        axis=-1,
+                    ).astype(jnp.int32)
+                    pend_j = jnp.where(ok_j, fresh_j, resid_j)
+                else:
+                    ok_j = None
+                    pend_j = fresh_j
+            pends.append(pend_j)
+            if ok_j is not None:
+                oks.append(ok_j)
+                real_j = real_j & ok_j & noeos[:, j]
+        pend = jnp.stack(pends, axis=1)
+        ok = jnp.stack(oks, axis=1)
+
+    cond = ok & noeos[:, :k]
+    chain = jnp.cumprod(cond.astype(jnp.int32), axis=1).astype(bool)
+    real = (
+        jnp.concatenate([jnp.ones((b, 1), bool), chain], axis=1)
+        & alive0[:, None]
+    )
+    accepted = chain.sum(axis=1).astype(jnp.int32)
+    eos_hit = real & ~noeos
+    w = jnp.where(real, chunk, pad).astype(jnp.int32)
+    return SpecVerify(
+        real=real, accepted=accepted, eos_hit=eos_hit, ok=ok, pend=pend, w=w
+    )

@@ -102,6 +102,12 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_kv_blocks_free": ("gauge", "Paged KV arena blocks available"),
     "pfx_request_evictions_total": ("counter", "Rows evicted mid-decode (deadline shed frees their blocks)"),
     "pfx_prefill_admits_total": ("counter", "Rows admitted into the running batch (prefill-on-admit)"),
+    # speculative decoding + KV quantization (ops/speculative.py,
+    # models/gpt/generation.py spec loops, core/continuous_batching.py)
+    "pfx_spec_proposed_total": ("counter", "Draft tokens proposed to the speculative verify step"),
+    "pfx_spec_accepted_total": ("counter", "Draft tokens accepted and committed by the verify step"),
+    "pfx_spec_accept_rate": ("gauge", "Lifetime accepted/proposed draft ratio"),
+    "pfx_kv_bytes": ("gauge", "Live KV-cache payload bytes (used blocks x K+V bytes per block)"),
 
     "pfx_http_requests_in_flight": ("gauge", "In-flight /generate requests"),
     "pfx_http_responses_total": ("counter", "HTTP responses by status code"),

@@ -132,6 +132,30 @@ def test_bench_decode_happy_path_contract(tmp_path):
     paths = {r["decode_path"] for r in rows.values()}
     assert paths == {"overhauled", "legacy(dense+scan)"}, rows
 
+    # speculative A/B row: the CPU contract regime (f32, repetitive
+    # prompt, self-draft) must show the win honestly — greedy spec is
+    # token-identical BY CONSTRUCTION (divergent rows exactly zero at
+    # f32), acceptance is high in the repetitive steady state, and the
+    # committed-token accounting agrees with the counters
+    spec = rows["gpt345m_decode_b8_greedy_spec4"]
+    assert spec["draft_k"] == 4 and spec["drafter"] == "ngram"
+    assert spec["greedy_divergent_rows"] == 0, spec
+    assert spec["accept_rate"] >= 0.5, spec
+    assert spec["value"] >= spec["baseline_tokens_per_s"], spec
+    assert spec["spec_proposed"] > 0
+    assert abs(
+        spec["accept_rate"]
+        - spec["spec_accepted"] / spec["spec_proposed"]
+    ) < 1e-3, spec
+
+    # int8-KV A/B row: the bytes win is chip evidence (CPU pays dequant
+    # multiplies with no bandwidth relief), so the contract pins only
+    # the row shape + honest divergence accounting at f32
+    q8 = rows["gpt345m_decode_b8_greedy_kvint8"]
+    assert q8["kv_dtype"] == "int8"
+    assert q8["baseline_tokens_per_s"] > 0
+    assert "divergent_rows" in q8, q8
+
     # staggered-arrival continuous-vs-coalesce A/B pair: same fixed-seed
     # arrival trace, both rows report delivered tokens/s + TTFT
     # percentiles.  The CPU smoke asserts the ROW CONTRACT and the

@@ -85,6 +85,9 @@ def _loss_stream(metrics_path):
     }
 
 
+@pytest.mark.slow  # tier-1 budget: the skip-budget contract is covered
+# by the test_data.py units; the two-run CLI stream-parity spelling
+# rides `make test-data-drill` / test-all
 def test_corrupt_sample_skip_and_parity(corpus, tmp_path):
     """A corrupt sample at fetch 10 (batch 2) is skipped under
     max_skips=2: the run completes, a structured data_skip event lands in

@@ -118,6 +118,10 @@ def test_sigterm_preempt_resume_parity(corpus, ref_stream, tmp_path):
     assert _loss_stream(metrics) == ref_stream
 
 
+@pytest.mark.slow  # tier-1 budget (870s): the SIGTERM preempt-resume
+# drill above keeps the crash-resume parity contract in tier-1; this
+# mid-save variant overlaps it + the checkpoint units and rides
+# `make test-fault` / test-all instead
 def test_save_crash_resume_parity(corpus, ref_stream, tmp_path):
     """Hard crash mid-save at step 4 (arrays written, meta.json never
     lands): the marker-less dir is skipped, resume falls back to step 2,
@@ -136,6 +140,9 @@ def test_save_crash_resume_parity(corpus, ref_stream, tmp_path):
     assert _loss_stream(metrics) == ref_stream
 
 
+@pytest.mark.slow  # tier-1 budget: quarantine/fallback is unit-covered
+# in test_fault_tolerance.py; the through-the-CLI spelling rides
+# `make test-fault` / test-all
 def test_ckpt_truncate_quarantine_fallback_parity(corpus, ref_stream, tmp_path):
     """Bit-rot in the newest (complete-looking) checkpoint: resume
     quarantines it to *.corrupt, falls back to the previous good one, and

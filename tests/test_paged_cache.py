@@ -218,18 +218,38 @@ def test_paged_attention_never_reads_past_a_rows_limit(impl):
     )
 
 
-def test_paged_attention_rejects_multi_token_chunks():
+@pytest.mark.parametrize("impl", [LAX, PALLAS])
+def test_paged_attention_multi_token_chunk_is_causal(impl):
+    """t > 1 (the speculative verify chunk): query qi of row r attends
+    its logical slots [0, positions[r] + qi + 1) — each chunk query must
+    equal a t=1 call at its own position (same cache, shifted limit)."""
+    import jax.numpy as jnp
+
+    from paddlefleetx_tpu.ops.decode_attention import paged_decode_attention
+
+    rng = np.random.default_rng(2)
+    t = 3
+    q, k_pool, v_pool, tables = _paged_case(rng, b=2, n=2, d=8, bs=8, M=4, nb=12)
+    qt = jnp.asarray(rng.normal(size=(2, t, 2, 8)).astype(np.float32))
+    positions = jnp.asarray([9, 3], jnp.int32)
+    got = paged_decode_attention(qt, k_pool, v_pool, tables, positions, impl=impl)
+    for qi in range(t):
+        one = paged_decode_attention(
+            qt[:, qi : qi + 1], k_pool, v_pool, tables, positions + qi,
+            impl=impl,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got[:, qi : qi + 1]), np.asarray(one), atol=2e-5
+        )
+
+
+def test_paged_attention_arg_validation():
     import jax.numpy as jnp
 
     from paddlefleetx_tpu.ops.decode_attention import paged_decode_attention
 
     rng = np.random.default_rng(2)
     q, k_pool, v_pool, tables = _paged_case(rng, b=1, n=1, d=8, bs=8, M=2, nb=4)
-    q2 = jnp.concatenate([q, q], axis=1)  # t=2
-    with pytest.raises(ValueError, match="t=1"):
-        paged_decode_attention(
-            q2, k_pool, v_pool, tables, jnp.asarray([3], jnp.int32)
-        )
     with pytest.raises(ValueError, match="valid: auto"):
         paged_decode_attention(
             q, k_pool, v_pool, tables, jnp.asarray([3], jnp.int32), impl="cuda"

@@ -693,7 +693,22 @@ def main(argv=None):
                     help="continuous scheduler: total KV arena blocks "
                     "(0 = auto: cb-batch full-context rows + null "
                     "block); block size via PFX_KV_BLOCK")
+    ap.add_argument("--draft-k", type=int, default=-1,
+                    help="speculative decoding: draft tokens per verify "
+                    "step (overrides Generation.speculative.draft_k; "
+                    "0 disables, -1 = leave the config value)")
+    ap.add_argument("--kv-dtype", choices=("bf16", "int8"), default="",
+                    help="KV-cache storage dtype (overrides Generation."
+                    "speculative.kv_dtype; int8 halves decode HBM "
+                    "bytes — docs/decode_path.md)")
     args = ap.parse_args(argv)
+    # spec/quant CLI flags become plain config overrides so BOTH
+    # schedulers (GenerationServer + PagedDecodeEngine read the same
+    # Generation.speculative section) see one source of truth
+    if args.draft_k >= 0:
+        args.override.append(f"Generation.speculative.draft_k={args.draft_k}")
+    if args.kv_dtype:
+        args.override.append(f"Generation.speculative.kv_dtype={args.kv_dtype}")
 
     if args.scheduler == "continuous" and not args.port:
         # the REPL serves one prompt at a time through the contiguous
