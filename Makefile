@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-spec bench
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-spec test-trace bench
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -15,7 +15,7 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_gpt_model.py tests/test_mesh_sharding.py \
              tests/test_serving.py tests/test_request_queue.py \
              tests/test_chunked_ce.py tests/test_lint.py \
-             tests/test_telemetry.py \
+             tests/test_telemetry.py tests/test_tracing.py \
              tests/test_bench_helpers.py tests/test_bench_cases.py
 
 # lint runs inside the gate via tests/test_lint.py::test_repo_is_clean
@@ -74,6 +74,15 @@ test-data-drill:
 test-obs:
 	python -m pytest tests/test_telemetry.py tests/test_serving.py tests/test_request_queue.py -q -m "not slow"
 	python -m pytest tests/test_serve_drills.py -q -k "metrics or gen_hang"
+
+# deep-dive tracing gate: trace-context/buffer/export + SLO units, the
+# decision-log replay agreement suite, and the /debug + SLO-breach
+# drills through the real tools/serve.py CLI (docs/observability.md
+# "Deep-dive tracing" + the runbook)
+test-trace:
+	python -m pytest tests/test_tracing.py tests/test_telemetry.py -q -m "not slow"
+	python -m pytest tests/test_serve_drills.py -q -k "metrics or slo"
+	python -m pytest "tests/test_paged_drills.py::test_continuous_mid_decode_eviction_frees_blocks_token_identical" -q
 
 # paged-serving gate: block allocator + paged-attention kernel units,
 # the continuous-batching engine/scheduler parity + eviction suite, and

@@ -32,6 +32,21 @@ Two layers here:
     (mid-decode — their blocks return to the pool immediately), admit
     from the queue head while slots and blocks allow, then step.
 
+Observability (docs/observability.md): the scheduler appends one
+structured row per iteration to a bounded **decision log** (admissions,
+evictions, sheds, block/width-bucket state, spec proposed/accepted
+deltas — replaying an untruncated log reproduces
+pfx_prefill_admits_total / pfx_request_evictions_total /
+pfx_spec_accepted_total EXACTLY via `utils/tracing.replay_decision_log`;
+shed rows cover scheduler-side sheds, while a handler-side
+``try_remove`` shed lands between iterations and only in the counter),
+stamps sampled
+per-request trace contexts (admission → prefill → per-chunk decode),
+and publishes a read-only ``debug_state()`` snapshot (queue ages,
+per-row positions/budgets, arena occupancy, compile-key families) that
+`tools/serve.py` exposes as ``GET /debug/state`` without ever blocking
+this thread.
+
 Greedy outputs are token-identical to the sequential/coalesced path
 (same logits-processor chain per row, per-row positions equal to the
 contiguous path's real-token positions); sampling rows draw from a
@@ -47,6 +62,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -68,7 +84,12 @@ from paddlefleetx_tpu.ops.decode_attention import kv_cache_dtype
 from paddlefleetx_tpu.ops.speculative import SpecConfig, ngram_propose_host
 from paddlefleetx_tpu.utils.log import logger
 from paddlefleetx_tpu.utils.resilience import maybe_fire
-from paddlefleetx_tpu.utils.telemetry import StatsView, get_registry
+from paddlefleetx_tpu.utils.telemetry import StatsView, _env_int, get_registry
+from paddlefleetx_tpu.utils.tracing import (
+    attach_request_trace,
+    discard_request_trace,
+    get_trace_buffer,
+)
 
 
 def _pow2_at_least(n: int) -> int:
@@ -103,6 +124,9 @@ class _Row:
     # prompt ids kept host-side for the self-drafting n-gram lookup
     # (the speculative drafter reads prompt + tokens between steps)
     prompt_ids: List[int] = dataclasses.field(default_factory=list)
+    # sampled deep-dive trace context (utils/tracing.py) or None: the
+    # engine stamps prefill + per-chunk decode events onto it
+    trace: Any = None
 
 
 @dataclasses.dataclass(eq=False)
@@ -202,6 +226,10 @@ class PagedDecodeEngine:
             "traces": 0, "steps": 0, "prefills": 0,
             "spec_proposed": 0, "spec_accepted": 0,
         }
+        # True only inside warmup(): warmup admits/steps are not traffic
+        # and must not bump the traffic-facing registry counters (the
+        # decision-log replay must reproduce them EXACTLY)
+        self._warmup = False
         self._key = jax.random.fold_in(
             jax.random.key(int(server.cfg.get("Global", {}).get("seed", 0))),
             0x9a6ed,
@@ -374,6 +402,8 @@ class PagedDecodeEngine:
         prompt = np.full((1, P), self.gen.pad_token_id, np.int32)
         prompt[0, :plen] = list(prompt_ids)  # RIGHT-pad (paged rows are unpadded)
         fn = self._prefill_fn(P, PB)
+        trace = entry.future.trace if entry is not None else None
+        t_prefill = time.monotonic()
         try:
             with self.mesh:
                 pools_t, last, counts = fn(
@@ -406,12 +436,17 @@ class PagedDecodeEngine:
         # trimmed output usually never shows
         self.forced_steps[slot] = min(-(-max_new // 32) * 32, limit) - 1
         self.active[slot] = True
+        if trace is not None:
+            trace.span(
+                "prefill", t0=t_prefill, t1=time.monotonic(),
+                prompt_len=plen, bucket=P, blocks=len(table), slot=slot,
+            )
         self.slots[slot] = _Row(
             seq_id=seq_id, entry=entry, row_idx=row_idx, prompt_len=plen,
             max_new=max_new, table=table, prompt_ids=list(prompt_ids),
+            trace=trace,
         )
         self.stats["prefills"] += 1
-        get_registry().counter("pfx_prefill_admits_total").inc()
         return slot
 
     def table_width_bucket(self) -> int:
@@ -499,15 +534,27 @@ class PagedDecodeEngine:
         self.stats["steps"] += 1
         finished: List[int] = []
         n_act = int(was_active.sum())
+        t_chunk = time.monotonic()
         for i, r in enumerate(self.slots):
             if r is None or not was_active[i]:
                 continue
-            for tok in window[i, : int(ncommit[i])].tolist():
+            committed = int(ncommit[i])
+            for tok in window[i, :committed].tolist():
                 if tok != self.gen.eos_token_id:
                     r.tokens.append(int(tok))
+            if r.trace is not None:
+                # per-chunk decode timeline: one event per iteration the
+                # row decoded in, carrying its commit + spec-accept
+                # counts (counts only — never token values)
+                r.trace.event(
+                    "decode_chunk", t=t_chunk, slot=i,
+                    committed=committed,
+                    accepted=(committed - 1 if self.spec else 0),
+                    position=int(self.positions[i]),
+                )
             if not new_active[i]:
                 finished.append(i)
-        if self.spec and n_act:
+        if self.spec and n_act and not self._warmup:
             proposed = k * n_act
             accepted = int(ncommit[was_active].sum()) - n_act
             self.stats["spec_proposed"] += proposed
@@ -563,24 +610,30 @@ class PagedDecodeEngine:
         decode budget — the continuous counterpart of
         `GenerationServer.warmup`; fails loudly naming the bucket."""
         per: Dict[str, float] = {}
-        for n in prompt_lens:
-            t0 = time.time()
-            try:
-                slot = self.admit([1] * int(n), max_new=self.gen.max_dec_len)
-                self.step()
-                if self.slots[slot] is not None:
-                    self.release(slot)
-            except Exception as exc:
-                raise RuntimeError(
-                    f"continuous warmup failed at bucket {n} (warmed so "
-                    f"far: {sorted(per) or 'none'}): "
-                    f"{type(exc).__name__}: {exc}"
-                ) from exc
-            per[str(int(n))] = round(time.time() - t0, 2)
-            logger.info(
-                f"continuous warmup: prompt bucket {n} compiled in "
-                f"{per[str(int(n))]:.1f}s"
-            )
+        self._warmup = True  # warmup admits/steps are not traffic
+        try:
+            for n in prompt_lens:
+                t0 = time.time()
+                try:
+                    slot = self.admit(
+                        [1] * int(n), max_new=self.gen.max_dec_len
+                    )
+                    self.step()
+                    if self.slots[slot] is not None:
+                        self.release(slot)
+                except Exception as exc:
+                    raise RuntimeError(
+                        f"continuous warmup failed at bucket {n} (warmed so "
+                        f"far: {sorted(per) or 'none'}): "
+                        f"{type(exc).__name__}: {exc}"
+                    ) from exc
+                per[str(int(n))] = round(time.time() - t0, 2)
+                logger.info(
+                    f"continuous warmup: prompt bucket {n} compiled in "
+                    f"{per[str(int(n))]:.1f}s"
+                )
+        finally:
+            self._warmup = False
         return per
 
 
@@ -609,6 +662,27 @@ class ContinuousScheduler:
         self._thread: Optional[threading.Thread] = None
         self._req_counter = 0
         self._step_counter = 0
+        # per-iteration decision log (docs/observability.md): one
+        # structured row per scheduler iteration — admitted/evicted/shed
+        # counts, block + width-bucket state, spec proposed/accepted
+        # deltas.  Bounded (PFX_DECISION_LOG_CAP, default 4096) and
+        # gated on tracing being enabled (PFX_TRACE_SAMPLE>0): replaying
+        # an untruncated log reproduces pfx_prefill_admits_total /
+        # pfx_request_evictions_total / pfx_spec_accepted_total exactly
+        # (utils/tracing.replay_decision_log; agreement-tested).
+        self.decision_log: deque = deque(
+            maxlen=_env_int("PFX_DECISION_LOG_CAP", 4096)
+        )
+        self._iter_counter = 0
+        # engine-side debug view published by the scheduler thread at
+        # the end of every iteration (read by debug_state() without
+        # taking any lock the scheduler holds during decode).  With
+        # tracing disabled AND no /debug client ever seen, the per-
+        # iteration rebuild is skipped — the zero-observability-work
+        # configuration pays nothing; the first debug_state() call
+        # latches interest and views are fresh from the next iteration
+        self._debug_requested = False
+        self._debug_engine: Dict[str, Any] = self._engine_debug_view()
         # same pfx_queue_* registry names as RequestQueue (one scheduler
         # runs per process; /healthz's queue block works unchanged) plus
         # the continuous-only counters
@@ -669,18 +743,29 @@ class ContinuousScheduler:
             enqueued_at=time.monotonic(),
         )
         entry.future.times["enqueued"] = entry.enqueued_at
-        with self._wake:
-            if self._closed:
-                self.stats["rejected_closed"] += 1
-                raise QueueClosed(f"{self.name} queue is draining")
-            if len(self._entries) >= self.max_depth:
-                self.stats["rejected_full"] += 1
-                raise QueueFull(
-                    f"{self.name} queue full ({self.max_depth} waiting)"
-                )
-            self._entries.append(entry)
-            self.stats["submitted"] += 1
-            self._wake.notify_all()
+        # deep-dive tracing (sampled; no-op at PFX_TRACE_SAMPLE=0):
+        # attached BEFORE the entry becomes visible to the scheduler
+        # thread, or a fast pickup could miss the prefill span
+        attach_request_trace(
+            entry.future, t0=entry.enqueued_at, scheduler=self.name,
+            prompts=len(entry.prompts), max_new=entry.max_new,
+        )
+        try:
+            with self._wake:
+                if self._closed:
+                    self.stats["rejected_closed"] += 1
+                    raise QueueClosed(f"{self.name} queue is draining")
+                if len(self._entries) >= self.max_depth:
+                    self.stats["rejected_full"] += 1
+                    raise QueueFull(
+                        f"{self.name} queue full ({self.max_depth} waiting)"
+                    )
+                self._entries.append(entry)
+                self.stats["submitted"] += 1
+                self._wake.notify_all()
+        except (QueueClosed, QueueFull):
+            discard_request_trace(entry.future)  # never admitted
+            raise
         return entry.future
 
     def depth(self) -> int:
@@ -702,11 +787,120 @@ class ContinuousScheduler:
                 if e.future is future and e.next_row == 0:
                     self._entries.remove(e)
                     self.stats["shed_deadline"] += 1
+                    if e.future.trace is not None:
+                        e.future.trace.event("shed", reason="handler_timeout")
                     e.future.set_exception(
                         DeadlineExceeded("deadline exceeded while queued")
                     )
                     return True
         return False
+
+    # -- live introspection (GET /debug/state) --------------------------
+    def _engine_debug_view(self) -> Dict[str, Any]:
+        """The engine-side half of debug_state(), built ONLY on the
+        scheduler thread (or before it starts): per-row positions and
+        budgets, arena occupancy/fragmentation, width bucket, compile-
+        key family counts.  Carries lengths/counts, never token ids."""
+        eng = self.engine
+        rows = []
+        for i, r in enumerate(eng.slots):
+            if r is None:
+                continue
+            rows.append({
+                "slot": i,
+                "seq_id": r.seq_id,
+                "prompt_len": r.prompt_len,
+                "max_new": r.max_new,
+                "position": int(eng.positions[i]),
+                "gen_step": int(eng.gen_steps[i]),
+                "tokens_out": len(r.tokens),
+                "blocks": len(r.table),
+                "active": bool(eng.active[i]),
+            })
+        view: Dict[str, Any] = {
+            # which scheduler iteration this view reflects: staleness is
+            # visible to the reader, never silent
+            "as_of_iter": self._iter_counter,
+            "batch": {
+                "capacity": eng.capacity,
+                "active_rows": eng.active_rows(),
+                "occupancy": round(
+                    eng.active_rows() / max(1, eng.capacity), 4
+                ),
+                "width_bucket": eng.table_width_bucket(),
+                "rows": rows,
+            },
+            "arena": eng.cache.stats(),
+            "compiled": {
+                "prefill_families": len(eng._compiled_prefill),
+                "step_families": len(eng._compiled_step),
+                "traces": int(eng.stats["traces"]),
+            },
+        }
+        if eng.spec is not None:
+            prop = int(eng.stats["spec_proposed"])
+            acc = int(eng.stats["spec_accepted"])
+            view["spec"] = {
+                "draft_k": eng.spec.draft_k,
+                "proposed": prop,
+                "accepted": acc,
+                "accept_rate": round(acc / prop, 4) if prop else 0.0,
+            }
+        return view
+
+    def _publish_debug(self) -> None:
+        # one atomic reference assignment: readers get either the old
+        # or the new fully-built view, never a torn one
+        self._debug_engine = self._engine_debug_view()
+
+    def debug_state(self) -> Dict[str, Any]:
+        """Read-only snapshot for ``GET /debug/state``: the waiting
+        queue (under this scheduler's lock, briefly) plus the engine
+        view.  While the scheduler is mid-iteration the view is the one
+        PUBLISHED at the last iteration end (the HTTP thread never
+        touches live engine state, so a decode step is never blocked or
+        torn); while the scheduler is provably parked (``_busy_since``
+        is None under this lock, and it cannot enter ``_iterate``
+        without re-acquiring it) the view is rebuilt LIVE here — an
+        idle, quiesced server always reports current arena/row state
+        even with tracing disabled.  ``as_of_iter`` marks which
+        iteration the view reflects."""
+        self._debug_requested = True
+        now = time.monotonic()
+        with self._lock:
+            waiting = [
+                {
+                    "age_s": round(now - e.enqueued_at, 4),
+                    "prompts": len(e.prompts),
+                    "admitted_rows": e.next_row,
+                    "max_new": e.max_new,
+                    "deadline_in_s": (
+                        round(e.deadline - now, 4)
+                        if e.deadline is not None else None
+                    ),
+                }
+                for e in self._entries
+            ]
+            closed = self._closed
+            busy = (
+                now - self._busy_since if self._busy_since is not None else 0.0
+            )
+            decisions = list(self.decision_log)  # appended under this lock
+            if self._busy_since is None:
+                # scheduler parked: engine state is stable, refresh the
+                # view (O(capacity) dict build — microseconds; the next
+                # iteration can't start until we release this lock)
+                self._publish_debug()
+        return {
+            "scheduler": "continuous",
+            "depth": len(waiting),
+            "waiting": waiting,
+            "busy_s": round(busy, 4),
+            "closed": closed,
+            "iterations": self._iter_counter,
+            "decisions": decisions,
+            **self._debug_engine,
+        }
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "ContinuousScheduler":
@@ -742,7 +936,9 @@ class ContinuousScheduler:
         return self.join(timeout)
 
     def warmup(self, prompt_lens: Sequence[int]) -> Dict[str, float]:
-        return self.engine.warmup(prompt_lens)
+        per = self.engine.warmup(prompt_lens)
+        self._publish_debug()  # /debug/state sees the warmed compile keys
+        return per
 
     # -- scheduler loop -------------------------------------------------
     def _has_live_rows(self) -> bool:
@@ -768,6 +964,8 @@ class ContinuousScheduler:
         logger.warning(
             f"{self.name}: shed expired request after {waited:.2f}s queued"
         )
+        if entry.future.trace is not None:
+            entry.future.trace.event("shed", reason="expired_in_queue")
         entry.future.set_exception(
             DeadlineExceeded(f"deadline exceeded after {waited:.2f}s queued")
         )
@@ -785,6 +983,8 @@ class ContinuousScheduler:
         self.stats["evictions"] += n
         self.stats["shed_deadline"] += 1
         waited = time.monotonic() - entry.enqueued_at
+        if entry.future.trace is not None:
+            entry.future.trace.event("evicted", rows=n, reason=reason)
         logger.warning(
             f"{self.name}: evicted {n} mid-decode row(s) of an expired "
             f"request after {waited:.2f}s ({reason})"
@@ -803,6 +1003,55 @@ class ContinuousScheduler:
                 e.future.set_exception(exc)
 
     def _iterate(self) -> None:
+        # per-iteration decision accounting (the decision log's row):
+        # pre-iteration counter baselines diffed at the end, so every
+        # SCHEDULER-side admit/evict/shed — including helper-raised
+        # ones — lands in exactly one row.  (A handler-thread
+        # try_remove shed can land between iterations: shed rows are
+        # scheduler-side only, and shed is deliberately NOT part of the
+        # exact-replay trio.)
+        eng = self.engine
+        admit0 = int(self.stats["prefill_admits"])
+        shed0 = int(self.stats["shed_deadline"])
+        evict0 = int(self.stats["evictions"])
+        spec_p0 = int(eng.stats["spec_proposed"])
+        spec_a0 = int(eng.stats["spec_accepted"])
+        blocks_free0 = eng.cache.allocator.free_count()
+        n_finished = 0
+        try:
+            n_finished = self._iterate_inner()
+        finally:
+            self._iter_counter += 1
+            if get_trace_buffer().enabled:
+                row = {
+                    "iter": self._iter_counter,
+                    "t": round(time.monotonic(), 6),
+                    # baseline-diffed (like evicted/shed), NOT the inner
+                    # return value: an exception escaping after some
+                    # admits succeeded must still land them in this row
+                    # or the replay contract breaks with no event lost
+                    "admitted": int(self.stats["prefill_admits"]) - admit0,
+                    "evicted": int(self.stats["evictions"]) - evict0,
+                    "shed": int(self.stats["shed_deadline"]) - shed0,
+                    # informational only (not a replayed counter): 0 when
+                    # the step raised before resolving finishes
+                    "finished": n_finished,
+                    "active": eng.active_rows(),
+                    "width_bucket": eng.table_width_bucket(),
+                    "blocks_free": eng.cache.allocator.free_count(),
+                    "blocks_delta":
+                        eng.cache.allocator.free_count() - blocks_free0,
+                    "spec_proposed":
+                        int(eng.stats["spec_proposed"]) - spec_p0,
+                    "spec_accepted":
+                        int(eng.stats["spec_accepted"]) - spec_a0,
+                }
+                with self._lock:
+                    self.decision_log.append(row)
+            if get_trace_buffer().enabled or self._debug_requested:
+                self._publish_debug()
+
+    def _iterate_inner(self):
         eng = self.engine
         now = time.monotonic()
 
@@ -858,7 +1107,12 @@ class ContinuousScheduler:
                     break
                 free_slots -= 1
                 free_blocks -= need
-                head.future.times.setdefault("picked", time.monotonic())
+                t_pick = time.monotonic()
+                head.future.times.setdefault("picked", t_pick)
+                if head.future.trace is not None and head.next_row == 0:
+                    head.future.trace.span(
+                        "queue_wait", t0=head.enqueued_at, t1=t_pick,
+                    )
                 admitted.append((head, head.next_row, p))
                 head.next_row += 1
                 if head.next_row >= len(head.prompts):
@@ -872,6 +1126,7 @@ class ContinuousScheduler:
             try:
                 maybe_fire("gen_crash", self._req_counter)
                 eng.admit(prompt, entry.max_new, entry=entry, row_idx=row_idx)
+                self.stats["prefill_admits"] += 1
             except ArenaReset as exc:
                 # the donating prefill dispatch failed: every live row
                 # died with the arena — fail them all, keep serving on
@@ -897,7 +1152,7 @@ class ContinuousScheduler:
                 )
 
         if not self._has_live_rows():
-            return
+            return 0
 
         # one iteration-level decode step
         self._step_counter += 1
@@ -908,7 +1163,7 @@ class ContinuousScheduler:
             self.stats["gen_errors"] += 1
             self._fail_rows(exc.dead_rows, exc)
             logger.warning(f"{self.name}: {exc}")
-            return
+            return 0
         self.stats["batches"] += 1
         reg = get_registry()
         for slot in finished:
@@ -926,3 +1181,4 @@ class ContinuousScheduler:
                 reg.counter("pfx_serving_tokens_out_total").inc(
                     sum(len(t) for t in entry.results)
                 )
+        return len(finished)

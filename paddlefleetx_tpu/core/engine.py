@@ -1238,7 +1238,16 @@ class Engine:
                   t_last, window_tokens, preempt=None):
         from paddlefleetx_tpu.utils import resilience
 
+        from paddlefleetx_tpu.utils.tracing import get_trace_buffer
+
         guard = self._build_anomaly_guard()
+        # deep-dive tracing (sampled, docs/observability.md): one trace
+        # per fit; each logged window appends a span mirroring the step
+        # record's phase fields, and the record carries the trace_id so
+        # a JSONL row links to its timeline.  None at PFX_TRACE_SAMPLE=0
+        # — the loop then does zero tracing work.
+        fit_trace = get_trace_buffer().maybe_start("train")
+        window_t0 = time.monotonic()
         # metrics of the previous step, observed AFTER the next step has
         # been dispatched: step N-1 necessarily finished before step N
         # runs on device, so the fetch resolves while step N computes and
@@ -1361,6 +1370,21 @@ class Engine:
                         if k in ("data_wait_s", "prefetch_depth",
                                  "stall_warnings", "skips")
                     )
+                if fit_trace is not None:
+                    # mirror the record's phase fields as a trace span:
+                    # the step-record JSONL and the Perfetto timeline
+                    # describe the SAME window, linked by trace_id
+                    now_mono = time.monotonic()
+                    fit_trace.span(
+                        "step_window", t0=window_t0, t1=now_mono,
+                        step=step, loss=record["loss"],
+                        tokens_per_sec=record["tokens_per_sec"],
+                        data_wait_s=record["data_wait_s"],
+                        host_s=record["host_s"],
+                        step_s=record["step_s"],
+                    )
+                    window_t0 = now_mono
+                    record["trace_id"] = fit_trace.trace_id
                 self._update_registry(record, ips)
                 self._write_metrics(record)
                 t_last = time.time()
@@ -1416,6 +1440,10 @@ class Engine:
                 self._preempt_save(step, "preemption signal")
                 break
 
+        if fit_trace is not None:
+            # finished cleanly; a crashed fit deliberately stays
+            # done=false in the buffer — that IS the postmortem signal
+            fit_trace.finish()
         return self.state
 
     def evaluate(self, loader: Iterable, iters: Optional[int] = None) -> float:

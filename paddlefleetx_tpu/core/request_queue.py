@@ -48,6 +48,10 @@ from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
 
 from paddlefleetx_tpu.utils.log import logger
 from paddlefleetx_tpu.utils.telemetry import StatsView, get_registry
+from paddlefleetx_tpu.utils.tracing import (
+    attach_request_trace,
+    discard_request_trace,
+)
 
 
 class QueueFull(RuntimeError):
@@ -70,15 +74,22 @@ class RequestFuture:
     ``enqueued`` at admission, ``picked`` when the scheduler takes the
     entry, ``resolved`` when the result/exception lands — the transport
     layer turns these into queue-wait/decode span phases and TTFT
-    histograms without the queue knowing about telemetry."""
+    histograms without the queue knowing about telemetry.
 
-    __slots__ = ("_event", "_value", "_exc", "times")
+    ``trace`` is the request's sampled deep-dive trace context
+    (`utils/tracing.py`) or None: both schedulers stamp their phases
+    onto it (admission/queue_wait/decode; the continuous scheduler adds
+    prefill + per-chunk decode events), and `/debug/trace?id=` replays
+    the full timeline offline."""
+
+    __slots__ = ("_event", "_value", "_exc", "times", "trace")
 
     def __init__(self) -> None:
         self._event = threading.Event()
         self._value: Any = None
         self._exc: Optional[BaseException] = None
         self.times: Dict[str, float] = {}
+        self.trace = None
 
     def set_result(self, value: Any) -> None:
         self._value = value
@@ -204,18 +215,29 @@ class RequestQueue:
             enqueued_at=time.monotonic(),
         )
         entry.future.times["enqueued"] = entry.enqueued_at
-        with self._wake:
-            if self._closed:
-                self.stats["rejected_closed"] += 1
-                raise QueueClosed(f"{self.name} queue is draining")
-            if len(self._entries) >= self.max_depth:
-                self.stats["rejected_full"] += 1
-                raise QueueFull(
-                    f"{self.name} queue full ({self.max_depth} waiting)"
-                )
-            self._entries.append(entry)
-            self.stats["submitted"] += 1
-            self._wake.notify_all()
+        # deep-dive tracing (sampled; no-op at PFX_TRACE_SAMPLE=0):
+        # attached BEFORE the entry becomes visible to the scheduler
+        # thread, or a fast pickup could miss the phase stamps
+        attach_request_trace(
+            entry.future, t0=entry.enqueued_at, scheduler=self.name,
+            prompts=len(entry.prompts), max_new=entry.max_new_tokens,
+        )
+        try:
+            with self._wake:
+                if self._closed:
+                    self.stats["rejected_closed"] += 1
+                    raise QueueClosed(f"{self.name} queue is draining")
+                if len(self._entries) >= self.max_depth:
+                    self.stats["rejected_full"] += 1
+                    raise QueueFull(
+                        f"{self.name} queue full ({self.max_depth} waiting)"
+                    )
+                self._entries.append(entry)
+                self.stats["submitted"] += 1
+                self._wake.notify_all()
+        except (QueueClosed, QueueFull):
+            discard_request_trace(entry.future)  # never admitted
+            raise
         return entry.future
 
     def depth(self) -> int:
@@ -241,11 +263,44 @@ class RequestQueue:
                 if e.future is future:
                     self._entries.remove(e)
                     self.stats["shed_deadline"] += 1
+                    if e.future.trace is not None:
+                        e.future.trace.event("shed", reason="handler_timeout")
                     e.future.set_exception(
                         DeadlineExceeded("deadline exceeded while queued")
                     )
                     return True
         return False
+
+    def debug_state(self) -> Dict[str, Any]:
+        """Read-only, lock-consistent live-introspection snapshot for
+        ``GET /debug/state``: waiting-entry ages and sizes (NO prompt
+        contents — redaction contract), depth, drain flag.  Takes only
+        this queue's lock, briefly — never blocks a running decode."""
+        now = time.monotonic()
+        with self._lock:
+            waiting = [
+                {
+                    "age_s": round(now - e.enqueued_at, 4),
+                    "prompts": len(e.prompts),
+                    "max_new": e.max_new_tokens,
+                    "deadline_in_s": (
+                        round(e.deadline - now, 4)
+                        if e.deadline is not None else None
+                    ),
+                }
+                for e in self._entries
+            ]
+            closed = self._closed
+            busy = (
+                now - self._busy_since if self._busy_since is not None else 0.0
+            )
+        return {
+            "scheduler": "coalesce",
+            "depth": len(waiting),
+            "waiting": waiting,
+            "busy_s": round(busy, 4),
+            "closed": closed,
+        }
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "RequestQueue":
@@ -294,6 +349,8 @@ class RequestQueue:
             f"{self.name}: shed expired request after {waited:.2f}s queued "
             f"({len(entry.prompts)} prompt(s))"
         )
+        if entry.future.trace is not None:
+            entry.future.trace.event("shed", reason="expired_in_queue")
         entry.future.set_exception(
             DeadlineExceeded(f"deadline exceeded after {waited:.2f}s queued")
         )
@@ -341,6 +398,11 @@ class RequestQueue:
                 for e in batch:
                     # span stamp: queue-wait ends here, decode begins
                     e.future.times.setdefault("picked", self._busy_since)
+                    if e.future.trace is not None:
+                        e.future.trace.span(
+                            "queue_wait", t0=e.enqueued_at,
+                            t1=self._busy_since,
+                        )
             try:
                 self._run_batch(batch)
             finally:
@@ -358,6 +420,7 @@ class RequestQueue:
                 f"{self.name}: coalesced {len(batch)} requests "
                 f"({len(prompts)} prompts) into one batch"
             )
+        t_decode = time.monotonic()
         try:
             rows = self._runner(prompts, max_new)
         except BaseException as exc:  # noqa: BLE001 — fan the error out
@@ -365,6 +428,8 @@ class RequestQueue:
             # itself survives and keeps draining the queue
             self.stats["gen_errors"] += 1
             for e in batch:
+                if e.future.trace is not None:
+                    e.future.trace.event("error", type=type(exc).__name__)
                 e.future.set_exception(exc)
             logger.warning(
                 f"{self.name}: generation failed for a batch of "
@@ -380,6 +445,7 @@ class RequestQueue:
             for e in batch:
                 e.future.set_exception(exc)
             return
+        t_done = time.monotonic()
         i = 0
         for e in batch:
             out = rows[i:i + len(e.prompts)]
@@ -390,5 +456,11 @@ class RequestQueue:
                 r[: e.max_new_tokens] if len(r) > e.max_new_tokens else r
                 for r in out
             ]
+            if e.future.trace is not None:
+                e.future.trace.span(
+                    "decode", t0=t_decode, t1=t_done,
+                    batch=len(batch), prompts=len(prompts),
+                    tokens=sum(len(r) for r in out),
+                )
             e.future.set_result(out)
             self.stats["completed"] += 1

@@ -41,8 +41,12 @@ them:
 
 Knobs (loud-parse, repo convention): ``PFX_PEAK_FLOPS`` (per-chip peak
 FLOP/s used as the MFU denominator; default per detected device kind),
-``PFX_FLIGHT_RECORDER`` (dump path, default ./flight_recorder.jsonl),
-``PFX_FLIGHT_RECORDER_CAP`` (ring capacity, default 256).
+``PFX_FLIGHT_DIR`` (artifact directory for dumps + trace exports,
+default ./artifacts/), ``PFX_FLIGHT_RECORDER`` (explicit dump path —
+overrides everything), ``PFX_FLIGHT_RECORDER_CAP`` (ring capacity,
+default 256).  The :class:`SLOTracker` evaluates configured serving
+objectives (p99 TTFT, error rate) over rolling multi-window burn rates
+and exports them as ``pfx_slo_*`` gauges (docs/observability.md).
 
 Contract notes: metric *mutations* never take the registry lock (each
 metric/collector owns a private lock), so hot paths (the serving scheduler,
@@ -139,6 +143,13 @@ METRICS: Dict[str, Tuple[str, str]] = {
     # profiler (utils/profiler.py)
     "pfx_profiler_traces_total": ("counter", "Profiler trace windows captured"),
     "pfx_profiler_trace_seconds": ("gauge", "Wall seconds of the last trace window"),
+    # deep-dive tracing (utils/tracing.py)
+    "pfx_trace_sampled_total": ("counter", "Requests/runs sampled into the trace buffer"),
+    # SLO burn rates (telemetry.SLOTracker; labels: objective, window)
+    "pfx_slo_objective": ("gauge", "Configured SLO objective value by objective label"),
+    "pfx_slo_burn_rate": ("gauge", "Error-budget burn rate over a rolling window (labels: objective, window)"),
+    "pfx_slo_breach": ("gauge", "1 while the labeled objective burns >threshold on every window"),
+    "pfx_slo_ttft_p99_seconds": ("gauge", "Rolling short-window p99 TTFT seen by the SLO tracker"),
 }
 
 # latency-shaped default buckets (seconds): sub-ms to minutes, exponential-ish
@@ -734,10 +745,244 @@ def mfu(tokens_per_sec: float, flops_per_token: float, n_devices: int,
 
 
 # ---------------------------------------------------------------------------
+# SLO burn rates
+# ---------------------------------------------------------------------------
+
+
+class SLOTracker:
+    """Rolling multi-window burn-rate evaluation of serving SLOs
+    (docs/observability.md), Google-SRE style: an objective grants an
+    error budget (p99 TTFT <= X allows 1% of requests over X; error
+    rate <= Y allows a Y fraction of failures), and the *burn rate* is
+    how many times faster than sustainable the current window spends
+    it.  Breach = every window burning past ``burn_threshold`` — the
+    short window makes the flag flip fast, the long window keeps a
+    single slow request from paging anyone.
+
+    ``observe_request`` ingests one served request (called by
+    ``tools/serve.py`` per response — the HTTP layer, never the decode
+    hot path); ``evaluate`` returns the operator view ``/healthz``
+    embeds as its ``slo`` block; ``collect`` exports the same numbers
+    as ``pfx_slo_*`` gauges for ``/metrics`` (register the tracker as
+    a registry collector).  Explicit ``t``/``now`` injection keeps the
+    unit tests wall-clock-free."""
+
+    def __init__(self, *, ttft_p99_s: float = 0.0, error_rate: float = 0.0,
+                 windows_s=(60.0, 600.0), burn_threshold: float = 1.0,
+                 cap: int = 131072) -> None:
+        if ttft_p99_s < 0 or error_rate < 0:
+            raise ValueError("SLO objectives must be >= 0 (0 disables)")
+        ws = tuple(float(w) for w in windows_s)
+        if len(ws) < 1 or any(w <= 0 for w in ws):
+            raise ValueError(f"SLO windows must be positive, got {windows_s}")
+        self.ttft_p99_s = float(ttft_p99_s)
+        self.error_rate = float(error_rate)
+        self.windows_s = tuple(sorted(ws))
+        self.burn_threshold = float(burn_threshold)
+        # time-pruned on observe (events older than the LONG window drop
+        # off), so the long window is not silently truncated by a count
+        # bound under load; ``cap`` is a memory backstop (default bites
+        # at ~218 rps sustained over a 600s window) that WARNS when it
+        # evicts a still-in-window event — the long-window burn is then
+        # computed over less history than configured
+        self.cap = int(cap)
+        self._cap_warned = False
+        self._events: deque = deque()
+        self._lock = threading.Lock()
+        self._memo: Optional[Tuple[float, Dict[str, Any]]] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.ttft_p99_s > 0.0 or self.error_rate > 0.0
+
+    def observe_request(self, *, ttft_s: Optional[float] = None,
+                        ok: bool = True, t: Optional[float] = None) -> None:
+        """One served request: ``ok`` means the server answered within
+        contract (200); a shed/error (500, 503, 429) is budget spend.
+        ``ttft_s`` is set only for requests that delivered tokens — a
+        failed request (no first token ever) counts as a TTFT violation
+        in :meth:`evaluate`, not as a missing sample."""
+        if not self.enabled:
+            return
+        now = time.monotonic() if t is None else float(t)
+        horizon = self.windows_s[-1]
+        with self._lock:
+            self._events.append((
+                now,
+                None if ttft_s is None else float(ttft_s),
+                bool(ok),
+            ))
+            while self._events and self._events[0][0] < now - horizon:
+                self._events.popleft()
+            truncated = False
+            while len(self._events) > self.cap:
+                self._events.popleft()
+                truncated = True
+            if truncated and not self._cap_warned:
+                self._cap_warned = True
+                logger.warning(
+                    f"SLOTracker: event cap {self.cap} evicted events "
+                    f"still inside the {horizon:g}s window — long-window "
+                    "burn rates now cover less history than configured "
+                    "(sustained rps exceeds cap/window; raise cap= or "
+                    "shorten --slo-windows)"
+                )
+
+    @staticmethod
+    def _window_name(w: float) -> str:
+        return f"{w:g}s"
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The ``/healthz`` ``slo`` block: per-objective burn rates per
+        window, the breach flag (+ per-objective ``breached`` map), and
+        a human reason naming the burning objective.  Empty windows burn
+        0 (a quiesced server recovers).  Live calls (``now=None``) are
+        memoized for 0.2s: one /healthz request evaluates once even
+        though both the registry collector and the JSON block read it —
+        at the event cap a double evaluation is ~1.5M tuple scans."""
+        if now is None:
+            live = time.monotonic()
+            memo = self._memo
+            if memo is not None and live - memo[0] < 0.2:
+                return memo[1]
+            out = self.evaluate(now=live)
+            self._memo = (live, out)
+            return out
+        now = float(now)
+        with self._lock:
+            events = list(self._events)
+        out: Dict[str, Any] = {
+            "enabled": self.enabled,
+            "windows_s": list(self.windows_s),
+            "burn_threshold": self.burn_threshold,
+            "objectives": {},
+            "burn": {},
+            "breached": {},
+            "breach": False,
+            "reason": None,
+        }
+        if not self.enabled:
+            return out
+        reasons = []
+        short = self.windows_s[0]
+        if self.ttft_p99_s > 0:
+            out["objectives"]["ttft_p99"] = self.ttft_p99_s
+            burns = {}
+            for w in self.windows_s:
+                win = [e for e in events if e[0] >= now - w]
+                ttfts = [e[1] for e in win if e[1] is not None]
+                # a FAILED request (shed/error: no first token, ever) is
+                # a TTFT violation, not a missing sample — otherwise a
+                # fully wedged server, where every request 503s, would
+                # report zero TTFT burn exactly when TTFT is worst
+                failed = sum(1 for e in win if e[1] is None and not e[2])
+                total = len(ttfts) + failed
+                bad = sum(1 for v in ttfts if v > self.ttft_p99_s) + failed
+                frac = bad / total if total else 0.0
+                # p99 objective => 1% error budget
+                burns[self._window_name(w)] = round(frac / 0.01, 3)
+            out["burn"]["ttft_p99"] = burns
+            # observed p99 over DELIVERED requests only (failures have
+            # no finite TTFT; they show up in the burn rate above, and
+            # an inf here would break strict Prometheus rendering)
+            short_ttfts = sorted(
+                e[1] for e in events
+                if e[0] >= now - short and e[1] is not None
+            )
+            out["ttft_p99_s"] = (
+                short_ttfts[min(len(short_ttfts) - 1,
+                                int(round(0.99 * (len(short_ttfts) - 1))))]
+                if short_ttfts else 0.0
+            )
+            breached = all(b > self.burn_threshold for b in burns.values())
+            out["breached"]["ttft_p99"] = breached
+            if breached:
+                reasons.append(
+                    f"ttft_p99: burn {'/'.join(str(b) for b in burns.values())}"
+                    f"x over the {self.ttft_p99_s:g}s objective"
+                )
+        if self.error_rate > 0:
+            out["objectives"]["error_rate"] = self.error_rate
+            burns = {}
+            for w in self.windows_s:
+                evs = [e for e in events if e[0] >= now - w]
+                bad = sum(1 for e in evs if not e[2])
+                frac = bad / len(evs) if evs else 0.0
+                burns[self._window_name(w)] = round(frac / self.error_rate, 3)
+            out["burn"]["error_rate"] = burns
+            breached = all(b > self.burn_threshold for b in burns.values())
+            out["breached"]["error_rate"] = breached
+            if breached:
+                reasons.append(
+                    f"error_rate: burn "
+                    f"{'/'.join(str(b) for b in burns.values())}x over the "
+                    f"{self.error_rate:g} objective"
+                )
+        if reasons:
+            out["breach"] = True
+            out["reason"] = "; ".join(reasons)
+        return out
+
+    def collect(self):
+        """Registry-collector protocol: the evaluate() numbers as
+        ``pfx_slo_*`` gauges (labels: objective, window)."""
+        ev = self.evaluate()
+        rows = []
+        for obj, target in ev["objectives"].items():
+            rows.append(("pfx_slo_objective", {"objective": obj}, target))
+        for obj, burns in ev["burn"].items():
+            for window, burn in burns.items():
+                rows.append((
+                    "pfx_slo_burn_rate",
+                    {"objective": obj, "window": window},
+                    burn,
+                ))
+            rows.append((
+                "pfx_slo_breach", {"objective": obj},
+                # the structured per-objective flag, NOT a substring
+                # match on the human reason text (rewording the message
+                # must never zero the gauge)
+                1.0 if ev["breached"].get(obj) else 0.0,
+            ))
+        if "ttft_p99_s" in ev:
+            rows.append(("pfx_slo_ttft_p99_seconds", {}, ev["ttft_p99_s"]))
+        return rows
+
+
+# ---------------------------------------------------------------------------
 # flight recorder
 # ---------------------------------------------------------------------------
 
-DEFAULT_FLIGHT_PATH = "flight_recorder.jsonl"
+DEFAULT_FLIGHT_DIR = "artifacts"
+
+
+def flight_dir() -> str:
+    """Directory for operational artifacts (flight-recorder dumps, trace
+    exports): ``PFX_FLIGHT_DIR``, default ``./artifacts/`` — dumps used
+    to land in the process cwd and pollute the repo root."""
+    return os.environ.get("PFX_FLIGHT_DIR") or DEFAULT_FLIGHT_DIR
+
+
+def atomic_artifact_write(path: str, write_fn) -> bool:
+    """THE crash-path artifact-write recipe, shared by the flight
+    recorder and the trace exporter: makedirs + pid-unique tmp +
+    ``os.replace``.  The pid-unique tmp matters on multi-host shared
+    storage — a preemption fans a dump out to every process, and each
+    must publish whole files only (last writer wins, never a torn
+    interleave).  Returns False on OSError (logged, never raised: this
+    runs inside crash handlers where a secondary failure must not mask
+    the primary); ``write_fn(f)`` does the actual writing."""
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            write_fn(f)
+        os.replace(tmp, path)
+    except OSError as e:
+        logger.warning(f"artifact write to {path} failed: {e}")
+        return False
+    return True
 
 
 class FlightRecorder:
@@ -772,9 +1017,14 @@ class FlightRecorder:
         Path resolution: ``PFX_FLIGHT_RECORDER`` env first (the operator's
         word wins even over an explicit caller path), then the caller's
         ``path`` (the engine passes its checkpoint ``output_dir``), then
-        ./flight_recorder.jsonl.  Returns the path, or None when the
-        write failed (logged, never raised — this runs on crash paths)."""
-        path = os.environ.get("PFX_FLIGHT_RECORDER") or path or DEFAULT_FLIGHT_PATH
+        ``<PFX_FLIGHT_DIR>/flight_recorder.jsonl`` (default
+        ``./artifacts/`` — dumps no longer litter the process cwd).
+        Returns the path, or None when the write failed (logged, never
+        raised — this runs on crash paths)."""
+        path = (
+            os.environ.get("PFX_FLIGHT_RECORDER") or path
+            or os.path.join(flight_dir(), "flight_recorder.jsonl")
+        )
         events = self.events()
         header = {
             "event": "flight_recorder_dump",
@@ -783,21 +1033,12 @@ class FlightRecorder:
             "pid": os.getpid(),
             "events": len(events),
         }
-        try:
-            d = os.path.dirname(os.path.abspath(path))
-            os.makedirs(d, exist_ok=True)
-            # pid-unique tmp: concurrent dumpers on shared storage (multi-
-            # host preemption fans out to every process) each write their
-            # own tmp and the atomic replace publishes whole files only —
-            # last writer wins, never a torn interleave
-            tmp = f"{path}.{os.getpid()}.tmp"
-            with open(tmp, "w") as f:
-                f.write(json.dumps(header) + "\n")
-                for ev in events:
-                    f.write(json.dumps(ev, default=str) + "\n")
-            os.replace(tmp, path)
-        except OSError as e:
-            logger.warning(f"flight recorder dump failed: {e}")
+        def write(f):
+            f.write(json.dumps(header) + "\n")
+            for ev in events:
+                f.write(json.dumps(ev, default=str) + "\n")
+
+        if not atomic_artifact_write(path, write):
             return None
         logger.warning(
             f"flight recorder: {len(events)} event(s) dumped to {path}"

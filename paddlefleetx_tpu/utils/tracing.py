@@ -1,0 +1,378 @@
+"""Deep-dive tracing: per-request trace timelines, a bounded sampled
+trace buffer, and a Chrome-trace/Perfetto JSON exporter.
+
+The PR 5 telemetry registry (`utils/telemetry.py`) answers "what are the
+aggregate rates?"; this module answers "why was THIS request slow" and
+"what did the scheduler decide at step N":
+
+  - :class:`TraceContext` — one traced unit of work (a served request, a
+    training run): a ``trace_id`` plus a flat list of spans and instant
+    events on the monotonic clock.  Producers stamp phases with
+    externally-captured timestamps (the request queue's ``enqueued``/
+    ``picked`` stamps, the paged engine's prefill dispatch window), so
+    the timeline is reconstructable offline exactly as it happened.
+  - :class:`TraceBuffer` — the bounded, sampled, in-memory store.
+    ``PFX_TRACE_SAMPLE`` (0..1, default 1.0) gates sampling with a
+    deterministic accumulator (sample=0.5 traces every other request);
+    ``PFX_TRACE_CAP`` (default 256) bounds retained traces (oldest
+    evicted).  With ``PFX_TRACE_SAMPLE=0`` the buffer is disabled and
+    ``maybe_start`` returns ``None`` without taking any lock or touching
+    the registry — the serving hot path then carries zero tracing work.
+  - :func:`chrome_trace` / :func:`export_chrome_trace` — render traces
+    as Chrome trace-event JSON (``{"traceEvents": [...]}``, all events
+    ``ph="X"`` complete spans with microsecond ``ts``/``dur``), loadable
+    directly in Perfetto / chrome://tracing.  Exports land under
+    ``PFX_FLIGHT_DIR`` (default ``./artifacts/``) next to the flight
+    recorder dumps.
+  - :func:`replay_decision_log` — fold a ``ContinuousScheduler``
+    per-iteration decision log (`core/continuous_batching.py`) back into
+    the counters it must agree with (``pfx_prefill_admits_total``,
+    ``pfx_request_evictions_total``, ``pfx_spec_accepted_total``, ...):
+    a silently dropped decision row shows up as a replay/counter
+    mismatch in the agreement tests.
+
+Redaction contract: traces carry NO prompt or token CONTENTS — only
+lengths, counts, slots, and timings — so `/debug/trace` and trace
+exports are safe to hand to an operator or attach to a ticket.
+
+Serving wiring (tools/serve.py, docs/observability.md): every
+``RequestFuture`` carries ``trace`` (a sampled :class:`TraceContext` or
+None); both schedulers stamp their phases onto it; ``GET /debug/trace``
+returns one timeline and ``GET /debug/traces`` the recent window as
+Perfetto-loadable JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+from paddlefleetx_tpu.utils.log import logger
+from paddlefleetx_tpu.utils.telemetry import (
+    _env_float,
+    _env_int,
+    atomic_artifact_write,
+    flight_dir,
+    get_registry,
+)
+
+
+# events retained per trace (oldest dropped): request traces are
+# naturally bounded by request length, but a long training fit appends
+# one step_window span per logged window for its whole life — without a
+# ring, a million-step run pins tens of MB on one context
+TRACE_EVENT_CAP = 4096
+
+
+class TraceContext:
+    """One traced unit of work: ``trace_id`` + time-ordered spans and
+    instant events on the monotonic clock.
+
+    Events are plain dicts ``{"name", "ph", "t", "dur", "args"}`` with
+    ``t``/``dur`` in monotonic SECONDS (the exporter converts to the
+    Chrome trace format's microseconds).  ``ph`` is ``"X"`` (complete
+    span) for phases and ``"i"``-style instants are stored as ``"X"``
+    with ``dur=0`` so consumers parse exactly one event shape.  The
+    event list is a bounded ring (``TRACE_EVENT_CAP``, newest kept) so
+    no single long-lived trace grows without bound.
+
+    Thread-safe: a request trace is stamped by the scheduler thread and
+    finished by the HTTP handler thread."""
+
+    __slots__ = ("trace_id", "name", "meta", "t0", "t_end", "_lock", "_events")
+
+    def __init__(self, trace_id: str, name: str, t0: Optional[float] = None,
+                 **meta: Any) -> None:
+        self.trace_id = trace_id
+        self.name = name
+        self.meta = dict(meta)
+        self.t0 = time.monotonic() if t0 is None else float(t0)
+        self.t_end: Optional[float] = None
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=TRACE_EVENT_CAP)
+
+    def event(self, name: str, t: Optional[float] = None, **args: Any) -> None:
+        """Record an instant (zero-duration) event — a scheduler
+        decision, a decode chunk's commit counts, the respond stamp."""
+        self.span(name, t0=t, t1=t, **args)
+
+    def span(self, name: str, t0: Optional[float] = None,
+             t1: Optional[float] = None, **args: Any) -> None:
+        """Record a completed span [t0, t1] (monotonic seconds; ``None``
+        means "now").  Negative durations are clamped to 0 — injected
+        stamps may quantize, and the exporter promises non-negative
+        ``dur``."""
+        now = time.monotonic()
+        a = now if t0 is None else float(t0)
+        b = now if t1 is None else float(t1)
+        ev = {
+            "name": name,
+            "ph": "X",
+            "t": a,
+            "dur": max(0.0, b - a),
+            "args": args,
+        }
+        with self._lock:
+            self._events.append(ev)
+
+    def finish(self, t: Optional[float] = None) -> None:
+        """Stamp the end of the whole trace (idempotent: first wins)."""
+        with self._lock:
+            if self.t_end is None:
+                self.t_end = time.monotonic() if t is None else float(t)
+
+    def events(self) -> List[Dict[str, Any]]:
+        """Time-ordered copies of the recorded events."""
+        with self._lock:
+            evs = [dict(e) for e in self._events]
+        evs.sort(key=lambda e: (e["t"], -e["dur"]))
+        return evs
+
+    def total_s(self) -> float:
+        end = self.t_end
+        if end is None:
+            with self._lock:
+                end = max(
+                    [e["t"] + e["dur"] for e in self._events], default=self.t0
+                )
+        return max(0.0, end - self.t0)
+
+    def timeline(self) -> Dict[str, Any]:
+        """The offline-reconstruction view (`GET /debug/trace?id=`):
+        start-relative phase rows, newest last.  Carries no prompt/token
+        contents — only names, counts, and timings."""
+        return {
+            "trace_id": self.trace_id,
+            "name": self.name,
+            "meta": dict(self.meta),
+            "total_s": round(self.total_s(), 6),
+            "done": self.t_end is not None,
+            "events": [
+                {
+                    "name": e["name"],
+                    "at_s": round(e["t"] - self.t0, 6),
+                    "dur_s": round(e["dur"], 6),
+                    "args": e["args"],
+                }
+                for e in self.events()
+            ],
+        }
+
+
+class TraceBuffer:
+    """Bounded, sampled, in-memory trace store (process-wide via
+    :func:`get_trace_buffer`; tests may build private instances).
+
+    Sampling is a deterministic accumulator — ``sample=1.0`` traces
+    everything, ``0.5`` every other request, ``0`` disables tracing
+    entirely (``maybe_start`` returns None without taking this buffer's
+    lock or touching the registry: the acceptance contract is that the
+    serving hot path does zero tracing work at sample 0)."""
+
+    def __init__(self, sample: Optional[float] = None,
+                 cap: Optional[int] = None) -> None:
+        self.sample = (
+            _env_float("PFX_TRACE_SAMPLE", 1.0) if sample is None
+            else float(sample)
+        )
+        if not 0.0 <= self.sample <= 1.0:
+            raise ValueError(
+                f"PFX_TRACE_SAMPLE={self.sample} must be within [0, 1]"
+            )
+        self.cap = cap if cap is not None else _env_int("PFX_TRACE_CAP", 256)
+        self._lock = threading.Lock()
+        self._traces: "OrderedDict[str, TraceContext]" = OrderedDict()
+        self._acc = 0.0
+        self._seq = 0
+        self._sampled_counter = None  # lazy registry child
+
+    @property
+    def enabled(self) -> bool:
+        return self.sample > 0.0
+
+    def maybe_start(self, name: str, t0: Optional[float] = None,
+                    **meta: Any) -> Optional[TraceContext]:
+        """Start a trace if the sampler picks this request; None
+        otherwise.  The fast path at sample=0 is a single float compare."""
+        if self.sample <= 0.0:
+            return None
+        with self._lock:
+            self._acc += self.sample
+            if self._acc < 1.0:
+                return None
+            self._acc -= 1.0
+            self._seq += 1
+            trace_id = f"{os.getpid():x}-{self._seq:08x}"
+            tc = TraceContext(trace_id, name, t0=t0, **meta)
+            self._traces[trace_id] = tc
+            while len(self._traces) > self.cap:
+                self._traces.popitem(last=False)  # evict oldest
+            counter = self._sampled_counter
+        if counter is None:
+            counter = get_registry().counter("pfx_trace_sampled_total")
+            self._sampled_counter = counter
+        counter.inc()
+        return tc
+
+    def get(self, trace_id: str) -> Optional[TraceContext]:
+        with self._lock:
+            return self._traces.get(trace_id)
+
+    def discard(self, trace_id: str) -> None:
+        """Drop a trace that never became a unit of work (an admission
+        that was rejected after sampling) so the retained window holds
+        only real timelines."""
+        with self._lock:
+            self._traces.pop(trace_id, None)
+
+    def traces(self) -> List[TraceContext]:
+        """Oldest-first snapshot of the retained window."""
+        with self._lock:
+            return list(self._traces.values())
+
+
+def attach_request_trace(future, *, t0: float, scheduler: str,
+                         prompts: int, max_new: int) -> None:
+    """THE scheduler-side request-trace attach recipe (both
+    `RequestQueue.submit` and `ContinuousScheduler.submit` use it, so
+    the admission-event shape cannot drift between schedulers): sample
+    a trace, hang it on the future BEFORE the entry becomes visible to
+    the scheduler thread, stamp the admission instant.  No-op when
+    sampled out."""
+    tr = get_trace_buffer().maybe_start(
+        "request", t0=t0, scheduler=scheduler,
+    )
+    if tr is not None:
+        future.trace = tr
+        tr.event("admission", t=t0, prompts=prompts, max_new=max_new)
+
+
+def discard_request_trace(future) -> None:
+    """Undo :func:`attach_request_trace` for an admission that was
+    REJECTED (QueueFull/QueueClosed): the trace never became a unit of
+    work and must not sit in the sampled window as an empty timeline."""
+    tr = getattr(future, "trace", None)
+    if tr is not None:
+        future.trace = None
+        get_trace_buffer().discard(tr.trace_id)
+
+
+_buffer: Optional[TraceBuffer] = None
+_buffer_lock = threading.Lock()
+
+
+def get_trace_buffer() -> TraceBuffer:
+    """The process-wide trace buffer (knobs read at first use)."""
+    global _buffer
+    if _buffer is None:
+        with _buffer_lock:
+            if _buffer is None:
+                _buffer = TraceBuffer()
+    return _buffer
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event / Perfetto export
+# ---------------------------------------------------------------------------
+
+
+def chrome_trace(traces: List[TraceContext]) -> Dict[str, Any]:
+    """Render traces as a Chrome trace-event document (Perfetto- and
+    chrome://tracing-loadable).  Every event is a ``ph="X"`` complete
+    span carrying ``ts``/``dur`` in microseconds, ``pid`` (this
+    process), ``tid`` (one lane per trace), and ``name``; each trace
+    additionally gets an enclosing span named after the trace so the
+    phase rows nest under one bar per request."""
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = []
+    for tid, tc in enumerate(traces, start=1):
+        # ONE event-list snapshot per trace, and the enclosing bar's end
+        # derived from that SAME snapshot: an in-flight trace (scraped
+        # mid-decode) may grow concurrently, and re-reading the live
+        # events per child would let a just-appended child overhang the
+        # already-computed bar — the partial overlap the nesting
+        # contract forbids
+        evs = tc.events()
+        t_end = tc.t_end
+        if t_end is None:
+            t_end = max([e["t"] + e["dur"] for e in evs], default=tc.t0)
+        bar_end = max(tc.t0, t_end)
+        events.append({
+            "ph": "X",
+            "ts": round(tc.t0 * 1e6, 3),
+            "dur": round((bar_end - tc.t0) * 1e6, 3),
+            "pid": pid,
+            "tid": tid,
+            "name": tc.name,
+            "cat": "trace",
+            "args": {"trace_id": tc.trace_id, **tc.meta},
+        })
+        for ev in evs:
+            # clamp children into the enclosing bar so nesting stays
+            # valid even when a stamp lands after finish()
+            t0 = max(tc.t0, ev["t"])
+            dur = min(ev["dur"], max(0.0, bar_end - t0))
+            events.append({
+                "ph": "X",
+                "ts": round(t0 * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "pid": pid,
+                "tid": tid,
+                "name": ev["name"],
+                "cat": tc.name,
+                "args": dict(ev["args"]),
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: Optional[str] = None,
+                        buffer: Optional[TraceBuffer] = None) -> Optional[str]:
+    """Write the buffer's retained window as Chrome-trace JSON.  Default
+    path: ``<PFX_FLIGHT_DIR>/trace.json`` (next to the flight-recorder
+    dumps).  Atomic write; returns the path, or None on failure (logged,
+    never raised — callers include crash/debug paths)."""
+    buf = buffer if buffer is not None else get_trace_buffer()
+    path = path or os.path.join(flight_dir(), "trace.json")
+    doc = chrome_trace(buf.traces())
+    if not atomic_artifact_write(path, lambda f: json.dump(doc, f)):
+        return None
+    logger.info(
+        f"trace export: {len(doc['traceEvents'])} event(s) to {path}"
+    )
+    return path
+
+
+# ---------------------------------------------------------------------------
+# decision-log replay
+# ---------------------------------------------------------------------------
+
+
+def replay_decision_log(rows) -> Dict[str, int]:
+    """Fold ContinuousScheduler decision-log rows back into the counters
+    they must reproduce.  The agreement contract (tested): on a run whose
+    log was not truncated, ``prefill_admits`` == pfx_prefill_admits_total,
+    ``evictions`` == pfx_request_evictions_total, and ``spec_accepted`` ==
+    pfx_spec_accepted_total — a trace event silently dropped by the
+    scheduler shows up here as a mismatch."""
+    out = {
+        "iterations": 0,
+        "prefill_admits": 0,
+        "evictions": 0,
+        "shed": 0,
+        "finished": 0,
+        "spec_proposed": 0,
+        "spec_accepted": 0,
+    }
+    for row in rows:
+        out["iterations"] += 1
+        out["prefill_admits"] += int(row.get("admitted", 0))
+        out["evictions"] += int(row.get("evicted", 0))
+        out["shed"] += int(row.get("shed", 0))
+        out["finished"] += int(row.get("finished", 0))
+        out["spec_proposed"] += int(row.get("spec_proposed", 0))
+        out["spec_accepted"] += int(row.get("spec_accepted", 0))
+    return out

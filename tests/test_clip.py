@@ -89,6 +89,8 @@ def test_overfit_tiny():
     assert float(loss) < 0.1
 
 
+@pytest.mark.slow  # ~19s grad compile; CLIP tier-1 keeps tower_shapes +
+# overfit_tiny (forward+grad paths); runs in make test-all (PR 8 budget)
 def test_logit_scale_clamped():
     params = clip.init(TINY, jax.random.key(4))
     params["logit_scale"] = jnp.asarray(10.0)  # exp(10) >> 100

@@ -129,3 +129,57 @@ def test_metric_name_lint_declared_table_parses():
     names = _lint.declared_metrics()
     assert names and "pfx_serving_requests_total" in names
     assert all(n.startswith("pfx_") for n in names)
+
+
+def test_metrics_docs_table_parses_and_agrees():
+    """E11 happy path on the real repo: the docs table exists and the
+    two-way agreement holds (the repo-clean test covers this too, but
+    this one names the check)."""
+    import lint as _lint
+
+    documented, linenos = _lint.documented_metrics()
+    assert documented, "docs/observability.md Metrics reference missing"
+    assert documented == _lint.declared_metrics()
+    assert all(n in linenos for n in documented)
+    assert _lint.check_metrics_docs() == []
+
+
+def test_metrics_docs_drift_is_detected(tmp_path, monkeypatch):
+    """E11 both directions, hermetically: a declared-but-undocumented
+    metric and a stale doc row each produce a finding; a missing table
+    is itself a finding."""
+    import lint as _lint
+
+    pkg = tmp_path / "paddlefleetx_tpu" / "utils"
+    pkg.mkdir(parents=True)
+    (pkg / "telemetry.py").write_text(
+        '"""t."""\nMETRICS = {\n'
+        '    "pfx_a_total": ("counter", "a"),\n'  # noqa — fixture table
+        '    "pfx_b_total": ("counter", "b"),\n'  # noqa — fixture table
+        "}\n"
+    )
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    doc = docs / "observability.md"
+    doc.write_text(
+        "# x\n\n### Metrics reference\n\n"
+        "| metric | kind | meaning |\n|---|---|---|\n"
+        "| `pfx_a_total` | counter | a |\n"
+        "| `pfx_stale_total` | counter | gone |\n\n## next\n"  # noqa
+    )
+    monkeypatch.setattr(_lint, "REPO", str(tmp_path))
+    _lint._declared_metrics = ...  # re-read from the tmp repo
+    try:
+        findings = _lint.check_metrics_docs()
+        codes = {(code, msg.split("'")[1]) for _, _, code, msg in findings}
+        assert ("E11", "pfx_b_total") in codes  # noqa — fixture name
+        assert ("E11", "pfx_stale_total") in codes  # noqa — fixture name
+        # stale rows point at their doc line
+        stale = next(f for f in findings if "pfx_stale_total" in f[3])  # noqa
+        assert stale[0].endswith("observability.md") and stale[1] > 1
+        # a missing table is loud, not silently clean
+        doc.write_text("# x\n\nno table here\n")
+        missing = _lint.check_metrics_docs()
+        assert len(missing) == 1 and "missing" in missing[0][3]
+    finally:
+        _lint._declared_metrics = ...  # drop the tmp-repo cache

@@ -181,10 +181,60 @@ def test_continuous_mid_decode_eviction_frees_blocks_token_identical(tmp_path):
         assert m["pfx_kv_blocks_used"] == 0, m
         assert m["pfx_batch_occupancy"] == 0, m
         assert m["pfx_kv_blocks_free"] > 0, m
-        assert m["pfx_prefill_admits_total"] >= 3, m  # warmup + 3 admits
+        # 3 traffic admits (doomed + 2 served); warmup is NOT traffic
+        # and no longer inflates the counter
+        assert m["pfx_prefill_admits_total"] >= 3, m
         h = _healthz(port)
         assert h["state"] == "ok" and h["queue_depth"] == 0, h
         assert h["queue"]["shed_deadline"] >= 1, h
+
+        # ---- deep-dive acceptance: the served request reconstructs
+        # offline from /debug/trace, the decision log replays to the
+        # registry counters EXACTLY, and the trace window is
+        # Perfetto-loadable (docs/observability.md runbook) ----
+        from test_tracing import validate_chrome_trace
+
+        from paddlefleetx_tpu.utils.tracing import replay_decision_log
+
+        def _get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                assert r.status == 200, path
+                return json.load(r)
+
+        assert "trace_id" in resp2, resp2
+        tl = _get(f"/debug/trace?id={resp2['trace_id']}")
+        names = [e["name"] for e in tl["events"]]
+        assert {"admission", "queue_wait", "prefill", "respond"} <= set(names)
+        chunks = [e for e in tl["events"] if e["name"] == "decode_chunk"]
+        assert chunks, names  # per-chunk decode timeline present
+        assert sum(c["args"]["committed"] for c in chunks) >= len(
+            resp2["completion_ids"]
+        )
+        assert all("accepted" in c["args"] for c in chunks)
+        assert next(
+            e for e in tl["events"] if e["name"] == "respond"
+        )["args"]["code"] == 200
+
+        dbg = _get("/debug/state")
+        assert dbg["scheduler"] == "continuous"
+        assert dbg["arena"]["kv_blocks_used"] == 0 == m["pfx_kv_blocks_used"]
+        assert dbg["batch"]["active_rows"] == 0
+        assert dbg["compiled"]["prefill_families"] >= 1
+        assert dbg["metrics"]["pfx_kv_blocks_used"] == m["pfx_kv_blocks_used"]
+        assert dbg["metrics"]["pfx_kv_blocks_free"] == m["pfx_kv_blocks_free"]
+        replay = replay_decision_log(dbg["decisions"])
+        assert replay["prefill_admits"] == m["pfx_prefill_admits_total"], (
+            replay, m)
+        assert replay["evictions"] == m["pfx_request_evictions_total"], (
+            replay, m)
+        assert replay["spec_accepted"] == m.get("pfx_spec_accepted_total", 0)
+        # shed rows cover scheduler-side sheds (a handler-side try_remove
+        # of a still-queued entry lands outside the iteration loop)
+        assert replay["shed"] >= 1, replay
+
+        validate_chrome_trace(_get("/debug/traces"))
 
         # graceful drain still holds on the continuous scheduler
         proc.send_signal(signal.SIGTERM)

@@ -89,7 +89,7 @@ def _healthz(port, timeout=10):
 
 def _start_server(tmp_path, *, deadline=45.0, depth=32, coalesce=2,
                   watchdog=300.0, shed_slack=3.0, warmup_batches="1",
-                  extra_env=None):
+                  extra_env=None, extra_args=()):
     """Boot tools/serve.py on the tiny config; wait until /healthz is up
     (warmup compiles ride the persistent XLA cache).  Returns (proc, port).
 
@@ -111,7 +111,7 @@ def _start_server(tmp_path, *, deadline=45.0, depth=32, coalesce=2,
          "--queue-depth", str(depth), "--max-coalesce", str(coalesce),
          "--deadline", str(deadline), "--shed-slack", str(shed_slack),
          "--watchdog", str(watchdog), "--warmup-buckets", "4",
-         "--warmup-batches", warmup_batches],
+         "--warmup-batches", warmup_batches, *extra_args],
         env=env, cwd=REPO, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True,
     )
@@ -219,14 +219,19 @@ def test_metrics_exposition_parses_and_agrees_with_healthz(tmp_path):
     (counter/gauge/histogram lines under the strict parser) and its
     serving/queue counters agree with /healthz — both endpoints render
     the SAME locked registry snapshot, so with no traffic between the two
-    scrapes the numbers must be identical."""
+    scrapes the numbers must be identical.  Rides the same boot:
+    /debug/state agrees with the /metrics gauges, a 200's trace_id
+    resolves on /debug/trace with the full coalesce-path timeline, and
+    /debug/traces is Perfetto-loadable Chrome-trace JSON."""
     from test_telemetry import parse_prometheus
+    from test_tracing import validate_chrome_trace
 
     proc, port = _start_server(tmp_path)
     try:
+        last = None
         for ids in ([1, 2, 3], [4, 5]):
-            code, _ = _post(port, {"prompt_ids": ids, "max_tokens": 4},
-                            timeout=120)
+            code, last = _post(port, {"prompt_ids": ids, "max_tokens": 4},
+                               timeout=120)
             assert code == 200
         h = _healthz(port)
         with urllib.request.urlopen(
@@ -261,11 +266,129 @@ def test_metrics_exposition_parses_and_agrees_with_healthz(tmp_path):
         assert val("pfx_request_latency_seconds_sum") > 0
         # warmup registered on the shared registry, not a private dict
         assert val("pfx_serving_warmup_seconds_total") > 0
+
+        # ---- /debug/state: the live-introspection snapshot agrees with
+        # the /metrics gauges (quiesced server, one snapshot) ----
+        def _get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                assert r.status == 200, path
+                return json.load(r)
+
+        dbg = _get("/debug/state")
+        assert dbg["scheduler"] == "coalesce" and not dbg["closed"]
+        assert dbg["depth"] == 0 == val("pfx_queue_depth")
+        assert dbg["waiting"] == []
+        assert dbg["metrics"]["pfx_queue_depth"] == val("pfx_queue_depth")
+        assert dbg["serving"]["traces"] == val("pfx_serving_traces_total")
+        assert dbg["serving"]["compiled_families"] >= 1
+        assert dbg["trace_buffer"]["retained"] >= 2  # both POSTs sampled
+
+        # ---- /debug/trace: the 200's trace_id replays its timeline ----
+        assert "trace_id" in last, last
+        tl = _get(f"/debug/trace?id={last['trace_id']}")
+        names = [e["name"] for e in tl["events"]]
+        assert {"admission", "queue_wait", "decode", "respond"} <= set(names)
+        respond = next(e for e in tl["events"] if e["name"] == "respond")
+        assert respond["args"]["code"] == 200
+        # redaction: args carry counts only, never token ids
+        decode = next(e for e in tl["events"] if e["name"] == "decode")
+        assert isinstance(decode["args"]["tokens"], int)
+
+        # ---- /debug/traces: Perfetto-loadable window ----
+        validate_chrome_trace(_get("/debug/traces"))
+
+        # unknown id / path: honest 4xx, not a traceback
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/debug/trace?id=nope"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
     finally:
         log = _finish(proc)
     assert "Traceback" not in log, log[-3000:]
 
 
+def test_slo_breach_flips_on_wedged_decode_and_recovers(tmp_path):
+    """The SLO acceptance drill: with a 0.2s p99-TTFT objective over
+    short rolling windows, a decode wedged for ~2s (gen_hang, shorter
+    than the deadline so the request still succeeds) burns the whole
+    budget — /healthz grows an `slo` block whose breach flag flips with
+    a reason naming ttft_p99 and pfx_slo_* gauges land in /metrics —
+    and once the bad window rolls past, the flag recovers on its own."""
+    from test_telemetry import parse_prometheus
+
+    proc, port = _start_server(
+        tmp_path, deadline=45.0,
+        extra_env={"PFX_FAULT": "gen_hang:2", "PFX_FAULT_HANG_S": "2.0"},
+        extra_args=("--slo-ttft-p99", "0.2", "--slo-windows", "3,6"),
+    )
+    try:
+        h = _healthz(port)
+        assert h["slo"]["enabled"] and not h["slo"]["breach"], h["slo"]
+        assert h["slo"]["objectives"] == {"ttft_p99": 0.2}, h["slo"]
+
+        # first traffic request (generation request 2) hangs 2s, then
+        # SUCCEEDS: a slow 200, i.e. a TTFT-budget burn, not an error
+        code, _ = _post(port, {"prompt_ids": [1, 2, 3], "max_tokens": 4,
+                               "deadline_s": 40}, timeout=90)
+        assert code == 200
+
+        h = _healthz(port)
+        slo = h["slo"]
+        assert slo["breach"], slo
+        assert "ttft_p99" in slo["reason"], slo
+        assert all(b > 1.0 for b in slo["burn"]["ttft_p99"].values()), slo
+        assert slo["ttft_p99_s"] > 0.2, slo
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            metrics, types = parse_prometheus(r.read().decode())
+        assert types["pfx_slo_burn_rate"] == "gauge"
+        key = frozenset({("objective", "ttft_p99"), ("window", "3s")})
+        assert metrics["pfx_slo_burn_rate"][key] > 1.0
+        assert metrics["pfx_slo_breach"][
+            frozenset({("objective", "ttft_p99")})
+        ] == 1.0
+        # ONE objective label across objective/burn/breach gauges, so a
+        # PromQL join on {objective=} actually matches
+        assert metrics["pfx_slo_objective"][
+            frozenset({("objective", "ttft_p99")})
+        ] == 0.2
+
+        # recovery: the bad observation ages out of the windows (the
+        # short one first — breach clears the moment ANY window stops
+        # burning — then the long one drains too); fresh fast requests
+        # stay under the objective
+        recovered = drained = False
+        t_end = time.time() + 25
+        while time.time() < t_end:
+            code, _ = _post(port, {"prompt_ids": [4, 5], "max_tokens": 2,
+                                   "deadline_s": 30}, timeout=60)
+            assert code == 200
+            slo = _healthz(port)["slo"]
+            if not slo["breach"]:
+                recovered = True
+            if all(b <= 1.0 for b in slo["burn"]["ttft_p99"].values()):
+                drained = True
+                break
+            time.sleep(1.0)
+        assert recovered and drained, slo
+        assert not slo["breach"], slo
+    finally:
+        log = _finish(proc)
+    assert "Traceback" not in log, log[-3000:]
+
+
+@pytest.mark.slow  # ~10s boot; the drain contract stays tier-1-drilled by
+# the gen_hang drill (drain state + second-signal escalation) and the paged
+# drill's SIGTERM exit-0; still in make test-serve-drill / test-all (PR 8
+# tier-1 budget convention)
 def test_sigterm_mid_traffic_drains_and_exits_zero(tmp_path):
     """SIGTERM with a queued backlog: admission closes (/healthz reports
     draining), every admitted request is answered, exit code 0."""
@@ -321,6 +444,10 @@ def test_sigterm_mid_traffic_drains_and_exits_zero(tmp_path):
     assert "Traceback" not in log, log[-3000:]
 
 
+@pytest.mark.slow  # ~12s boot; crash recovery is unit-covered (test_serving
+# pool-not-poisoned, continuous ArenaReset recovery) and the SLO drill
+# exercises a fault boot through the same CLI; still in make
+# test-serve-drill / test-all (PR 8 tier-1 budget convention)
 def test_gen_crash_returns_500_server_keeps_serving(tmp_path):
     """PFX_FAULT=gen_crash:2 (warmup is request 1): the first traffic
     request gets a 500 with the injected error, the cache pool is not

@@ -312,6 +312,160 @@ def test_flight_recorder_excepthook_dumps(tmp_path, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# SLO burn rates
+# ---------------------------------------------------------------------------
+
+
+def test_slo_tracker_disabled_by_default_and_loud_on_bad_config():
+    t = T.SLOTracker()
+    assert not t.enabled
+    t.observe_request(ttft_s=1.0, ok=False)  # no-op when disabled
+    assert t.evaluate()["enabled"] is False and t.collect() == []
+    with pytest.raises(ValueError, match=">= 0"):
+        T.SLOTracker(ttft_p99_s=-1)
+    with pytest.raises(ValueError, match="positive"):
+        T.SLOTracker(ttft_p99_s=1, windows_s=(0,))
+
+
+def test_slo_ttft_burn_rate_breach_and_time_recovery():
+    """p99-TTFT objective: a window where every request blows the
+    objective burns 100x the budget (bad_frac 1.0 / allowed 0.01) and
+    breaches on BOTH windows; once the events age out of the windows the
+    burn returns to 0 and the breach clears — no manual reset."""
+    t = T.SLOTracker(ttft_p99_s=0.5, windows_s=(5.0, 30.0))
+    for i in range(10):
+        t.observe_request(ttft_s=2.0, ok=True, t=100.0 + i * 0.1)
+    ev = t.evaluate(now=101.0)
+    assert ev["objectives"] == {"ttft_p99": 0.5}
+    assert ev["burn"]["ttft_p99"] == {"5s": 100.0, "30s": 100.0}
+    assert ev["breach"] and "ttft_p99" in ev["reason"]
+    assert ev["ttft_p99_s"] == 2.0
+    # recovery: the bad window ages out
+    ev2 = t.evaluate(now=200.0)
+    assert ev2["burn"]["ttft_p99"] == {"5s": 0.0, "30s": 0.0}
+    assert not ev2["breach"] and ev2["reason"] is None
+
+
+def test_slo_failed_requests_count_as_ttft_violations():
+    """A request that never delivered a first token (shed 503 / 500) is
+    a TTFT violation, NOT a missing sample — a fully wedged server
+    where every request fails must breach the TTFT objective, not
+    report zero burn (the worst-TTFT-invisible failure mode)."""
+    t = T.SLOTracker(ttft_p99_s=0.5, windows_s=(5.0, 30.0))
+    for i in range(10):
+        t.observe_request(ok=False, t=100.0 + i * 0.1)  # no ttft at all
+    ev = t.evaluate(now=101.0)
+    assert ev["burn"]["ttft_p99"] == {"5s": 100.0, "30s": 100.0}
+    assert ev["breach"] and "ttft_p99" in ev["reason"]
+    # delivered-only observed percentile stays finite (0 when none)
+    assert ev["ttft_p99_s"] == 0.0
+    # mixed: 1 failure among 99 fast deliveries = 1% bad = burn 1.0
+    t2 = T.SLOTracker(ttft_p99_s=0.5, windows_s=(5.0, 30.0))
+    for i in range(99):
+        t2.observe_request(ttft_s=0.1, ok=True, t=100.0 + i * 0.01)
+    t2.observe_request(ok=False, t=101.0)
+    ev2 = t2.evaluate(now=101.0)
+    assert ev2["burn"]["ttft_p99"]["5s"] == 1.0
+    assert not ev2["breach"]  # burning AT budget, not past it
+
+
+def test_slo_long_window_is_time_pruned_not_count_truncated():
+    """The event store prunes by TIME (the long window), never by a
+    small count bound — under load a count-bounded ring would shrink
+    the long window to minutes and let a short burst page through the
+    multi-window gate it should have diluted."""
+    t = T.SLOTracker(ttft_p99_s=0.5, windows_s=(5.0, 600.0))
+    # 7000 events over ~580s: a 4096-cap ring would have dropped the
+    # first ~half; time pruning keeps everything inside 600s
+    for i in range(7000):
+        t.observe_request(ttft_s=0.1, ok=True, t=100.0 + i * 0.083)
+    ev = t.evaluate(now=100.0 + 7000 * 0.083)
+    with t._lock:
+        n = len(t._events)
+    assert n == 7000
+    # a 3-request bad burst at the end: diluted far below threshold on
+    # the long window -> no breach
+    for i in range(3):
+        t.observe_request(ttft_s=2.0, ok=True, t=100.0 + 7000 * 0.083 + i)
+    ev = t.evaluate(now=100.0 + 7000 * 0.083 + 3)
+    assert ev["burn"]["ttft_p99"]["600s"] < 1.0
+    assert not ev["breach"]
+    # events beyond the long window drop off on the next observe
+    t.observe_request(ttft_s=0.1, ok=True, t=100.0 + 7000 * 0.083 + 700)
+    with t._lock:
+        assert len(t._events) < 7003
+
+
+def test_slo_multiwindow_gate_needs_both_windows_burning():
+    """One bad spike inside the short window but diluted over the long
+    window must NOT breach — the long window is the page-worthiness
+    gate (multi-window burn-rate semantics)."""
+    t = T.SLOTracker(ttft_p99_s=0.5, windows_s=(5.0, 60.0))
+    # 200 good requests spread over the long window
+    for i in range(200):
+        t.observe_request(ttft_s=0.1, ok=True, t=50.0 + i * 0.25)
+    # a short burst of bad ones right at the end
+    for i in range(3):
+        t.observe_request(ttft_s=2.0, ok=True, t=99.5 + i * 0.1)
+    ev = t.evaluate(now=100.0)
+    assert ev["burn"]["ttft_p99"]["5s"] > 1.0
+    assert ev["burn"]["ttft_p99"]["60s"] <= 1.5  # diluted
+    # short window burns but the long window gates the page
+    if ev["burn"]["ttft_p99"]["60s"] <= 1.0:
+        assert not ev["breach"]
+
+
+def test_slo_error_rate_burn_and_collect_gauges():
+    import time as _time
+
+    t = T.SLOTracker(error_rate=0.1, windows_s=(5.0, 30.0))
+    # real-clock-relative stamps: collect() evaluates at the live
+    # monotonic now, so the window must contain them
+    now = _time.monotonic()
+    for i in range(8):
+        t.observe_request(ok=True, t=now - 1.0 + i * 0.1)
+    for i in range(2):
+        t.observe_request(ok=False, t=now - 0.2 + i * 0.1)
+    ev = t.evaluate(now=now)
+    # 2/10 failures over a 0.1 objective = 2x burn, both windows
+    assert ev["burn"]["error_rate"] == {"5s": 2.0, "30s": 2.0}
+    assert ev["breach"] and "error_rate" in ev["reason"]
+    # the collector exports the same numbers as declared pfx_slo_* rows
+    r = T.Registry()
+    r.register_collector(t)
+    rows = {(n, frozenset(lab.items())): v for n, lab, v in t.collect()}
+    assert rows[("pfx_slo_objective", frozenset({("objective", "error_rate")}))] == 0.1
+    assert all(n in T.METRICS for (n, _), _ in zip(rows.keys(), rows.values()))
+    snap = r.snapshot()
+    assert "pfx_slo_burn_rate" in snap
+    metrics, types = parse_prometheus(r.render_prometheus(snap))
+    assert types["pfx_slo_breach"] == "gauge"
+    assert metrics["pfx_slo_breach"][
+        frozenset({("objective", "error_rate")})
+    ] == 1.0
+
+
+def test_flight_dir_routes_default_dump(tmp_path, monkeypatch):
+    """Satellite: flight dumps land under PFX_FLIGHT_DIR (default
+    ./artifacts/) instead of polluting the process cwd."""
+    monkeypatch.delenv("PFX_FLIGHT_RECORDER", raising=False)
+    monkeypatch.delenv("PFX_FLIGHT_DIR", raising=False)
+    monkeypatch.chdir(tmp_path)
+    fr = T.FlightRecorder(capacity=2)
+    fr.record({"event": "x"})
+    path = fr.dump(reason="unit")
+    assert path == os.path.join("artifacts", "flight_recorder.jsonl")
+    assert os.path.exists(tmp_path / "artifacts" / "flight_recorder.jsonl")
+    # the env dir re-routes; an explicit caller path still wins over it
+    monkeypatch.setenv("PFX_FLIGHT_DIR", str(tmp_path / "ops"))
+    assert fr.dump(reason="dir") == str(
+        tmp_path / "ops" / "flight_recorder.jsonl"
+    )
+    explicit = str(tmp_path / "here.jsonl")
+    assert fr.dump(path=explicit, reason="explicit") == explicit
+
+
+# ---------------------------------------------------------------------------
 # engine step records: the training-side observability contract
 # ---------------------------------------------------------------------------
 
@@ -402,3 +556,15 @@ def test_engine_step_records_carry_phases_compile_and_mfu(tmp_path, devices8):
     steps = [e.get("step") for e in T.get_flight_recorder().events()
              if e.get("event") == "step"]
     assert {1, 2, 3} <= set(steps)
+    # the fit's trace mirrors each logged window as a step_window span
+    # (records link to it via trace_id)
+    from paddlefleetx_tpu.utils.tracing import get_trace_buffer
+
+    assert all(r["trace_id"] == records[0]["trace_id"] for r in records)
+    tc = get_trace_buffer().get(records[0]["trace_id"])
+    assert tc is not None and tc.name == "train"
+    spans = [e for e in tc.timeline()["events"]
+             if e["name"] == "step_window"]
+    assert [s["args"]["step"] for s in spans] == [1, 2, 3]
+    assert spans[0]["args"]["loss"] == records[0]["loss"]
+    assert spans[0]["args"]["data_wait_s"] == records[0]["data_wait_s"]

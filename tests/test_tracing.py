@@ -1,0 +1,438 @@
+"""Deep-dive tracing units + the decision-log agreement suite
+(utils/tracing.py, docs/observability.md "Deep-dive tracing").
+
+The strict Chrome-trace validator here (`validate_chrome_trace`) stands
+in for a manual Perfetto load, the way test_telemetry's
+`parse_prometheus` stands in for a Prometheus scrape: required keys
+(ph/ts/dur/pid/tid/name), non-negative monotone-consistent durations,
+and valid nesting per lane.  Reused by the serve drills against the
+live `/debug/traces` endpoint.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddlefleetx_tpu.utils import tracing as TR
+
+
+# ---------------------------------------------------------------------------
+# strict Chrome-trace-event validator (the Perfetto-load stand-in)
+# ---------------------------------------------------------------------------
+
+
+def validate_chrome_trace(doc):
+    """Assert `doc` is a loadable Chrome trace-event document: a
+    ``traceEvents`` list whose every event carries ph/ts/dur/pid/tid/
+    name, with non-negative numeric ts/dur, and — per (pid, tid) lane —
+    valid nesting: any two spans are either disjoint or one strictly
+    contains the other (Perfetto renders partial overlap as garbage).
+    Returns the events grouped per lane."""
+    assert isinstance(doc, dict) and isinstance(doc.get("traceEvents"), list), doc
+    lanes = {}
+    for i, ev in enumerate(doc["traceEvents"]):
+        for key in ("ph", "ts", "dur", "pid", "tid", "name"):
+            assert key in ev, f"event {i} missing {key!r}: {ev}"
+        assert ev["ph"] == "X", f"event {i}: only complete spans: {ev['ph']}"
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0, ev
+        assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0, ev
+        assert isinstance(ev["name"], str) and ev["name"], ev
+        lanes.setdefault((ev["pid"], ev["tid"]), []).append(ev)
+    # tolerance: chrome_trace rounds ts and dur INDEPENDENTLY to 1e-3 µs,
+    # so a child clamped exactly to its parent's end can overshoot by up
+    # to ~2e-3 µs after rounding — 0.01 µs (10 ns) absorbs that while
+    # still catching any real partial overlap
+    eps = 1e-2
+    for lane, evs in lanes.items():
+        # sort like Perfetto: by start, widest first at equal starts
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []
+        for ev in evs:
+            start, end = ev["ts"], ev["ts"] + ev["dur"]
+            while stack and start >= stack[-1][1] - eps:
+                stack.pop()
+            if stack:
+                assert end <= stack[-1][1] + eps, (
+                    f"lane {lane}: {ev['name']} [{start}, {end}] partially "
+                    f"overlaps its enclosing span ending at {stack[-1][1]}"
+                )
+            stack.append((start, end))
+    return lanes
+
+
+# ---------------------------------------------------------------------------
+# TraceContext
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_timeline_orders_and_redacts_nothing_it_isnt_given():
+    tc = TR.TraceContext("t-1", "request", t0=100.0, scheduler="x")
+    tc.span("queue_wait", t0=100.0, t1=100.5)
+    tc.event("decode_chunk", t=101.0, committed=2, accepted=1)
+    tc.span("prefill", t0=100.5, t1=100.9, prompt_len=3)
+    tc.event("respond", t=101.2, code=200)
+    tc.finish(t=101.2)
+    tl = tc.timeline()
+    assert tl["trace_id"] == "t-1" and tl["done"]
+    assert tl["total_s"] == pytest.approx(1.2)
+    names = [e["name"] for e in tl["events"]]
+    assert names == ["queue_wait", "prefill", "decode_chunk", "respond"]
+    assert tl["events"][0]["at_s"] == pytest.approx(0.0)
+    assert tl["events"][1]["dur_s"] == pytest.approx(0.4)
+    assert tl["events"][2]["args"] == {"committed": 2, "accepted": 1}
+
+
+def test_trace_context_negative_duration_clamps():
+    tc = TR.TraceContext("t-2", "x", t0=10.0)
+    tc.span("weird", t0=11.0, t1=10.5)  # quantized injected stamps
+    assert tc.events()[0]["dur"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# TraceBuffer: sampling, bounds, knobs
+# ---------------------------------------------------------------------------
+
+
+def test_buffer_sample_one_traces_everything_and_caps():
+    buf = TR.TraceBuffer(sample=1.0, cap=3)
+    ids = []
+    for i in range(5):
+        tc = buf.maybe_start("request", i=i)
+        assert tc is not None
+        ids.append(tc.trace_id)
+    kept = [t.trace_id for t in buf.traces()]
+    assert kept == ids[-3:]  # bounded: oldest evicted
+    assert buf.get(ids[0]) is None and buf.get(ids[-1]) is not None
+
+
+def test_buffer_sample_zero_is_disabled_and_free():
+    buf = TR.TraceBuffer(sample=0.0)
+    assert not buf.enabled
+    assert buf.maybe_start("request") is None
+    assert buf.traces() == []
+
+
+def test_buffer_discard_drops_never_admitted_traces():
+    buf = TR.TraceBuffer(sample=1.0, cap=8)
+    tc = buf.maybe_start("request")
+    buf.discard(tc.trace_id)
+    assert buf.get(tc.trace_id) is None and buf.traces() == []
+    buf.discard("not-there")  # idempotent
+
+
+def test_rejected_admission_leaves_no_trace_in_the_window():
+    """A 429'd submit must not leave an empty timeline in the sampled
+    window (the buffer holds real units of work only)."""
+    from paddlefleetx_tpu.core.request_queue import QueueFull, RequestQueue
+    from paddlefleetx_tpu.utils import tracing
+
+    before = {t.trace_id for t in tracing.get_trace_buffer().traces()}
+    q = RequestQueue(lambda p, m: [[1]] * len(p), max_depth=1)
+    q.submit([[1]], 4)  # not started: occupies the one slot
+    with pytest.raises(QueueFull):
+        q.submit([[2]], 4)
+    after = tracing.get_trace_buffer().traces()
+    new = [t for t in after if t.trace_id not in before]
+    assert len(new) == 1  # the admitted one only; the rejected discarded
+    q.shutdown(drain=False, timeout=10)
+
+
+def test_buffer_fractional_sampling_is_deterministic():
+    buf = TR.TraceBuffer(sample=0.5, cap=64)
+    picks = [buf.maybe_start("r") is not None for _ in range(10)]
+    assert picks == [False, True] * 5  # accumulator: every other request
+
+
+def test_buffer_knobs_loud_parse(monkeypatch):
+    monkeypatch.setenv("PFX_TRACE_SAMPLE", "nope")
+    with pytest.raises(ValueError, match="PFX_TRACE_SAMPLE"):
+        TR.TraceBuffer()
+    monkeypatch.setenv("PFX_TRACE_SAMPLE", "1.5")
+    with pytest.raises(ValueError, match=r"\[0, 1\]"):
+        TR.TraceBuffer()
+    monkeypatch.setenv("PFX_TRACE_SAMPLE", "0.25")
+    monkeypatch.setenv("PFX_TRACE_CAP", "7")
+    buf = TR.TraceBuffer()
+    assert buf.sample == 0.25 and buf.cap == 7
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export
+# ---------------------------------------------------------------------------
+
+
+def _traced_buffer():
+    buf = TR.TraceBuffer(sample=1.0, cap=8)
+    for k in range(2):
+        tc = buf.maybe_start("request", t0=100.0 + k, kind="unit")
+        tc.span("queue_wait", t0=100.0 + k, t1=100.2 + k)
+        tc.span("decode", t0=100.2 + k, t1=100.9 + k, tokens=4)
+        tc.event("respond", t=100.9 + k, code=200)
+        tc.finish(t=100.95 + k)
+    return buf
+
+
+def test_chrome_trace_strict_parses_with_valid_nesting():
+    doc = TR.chrome_trace(_traced_buffer().traces())
+    lanes = validate_chrome_trace(doc)
+    assert len(lanes) == 2  # one lane per trace
+    for evs in lanes.values():
+        names = [e["name"] for e in evs]
+        # enclosing request bar first (widest), phases nested inside
+        assert names[0] == "request"
+        assert {"queue_wait", "decode", "respond"} <= set(names)
+    # round-trips through json (what /debug/traces serves)
+    validate_chrome_trace(json.loads(json.dumps(doc)))
+
+
+def test_export_chrome_trace_lands_in_flight_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("PFX_FLIGHT_DIR", str(tmp_path / "arts"))
+    path = TR.export_chrome_trace(buffer=_traced_buffer())
+    assert path == str(tmp_path / "arts" / "trace.json")
+    validate_chrome_trace(json.load(open(path)))
+    # explicit path wins; unwritable target returns None, never raises
+    p2 = TR.export_chrome_trace(path=str(tmp_path / "t.json"),
+                                buffer=_traced_buffer())
+    assert p2 == str(tmp_path / "t.json") and os.path.exists(p2)
+    assert TR.export_chrome_trace(path="/proc/nope/t.json",
+                                  buffer=_traced_buffer()) is None
+
+
+# ---------------------------------------------------------------------------
+# decision-log replay
+# ---------------------------------------------------------------------------
+
+
+def test_replay_decision_log_sums_rows():
+    rows = [
+        {"iter": 1, "admitted": 2, "evicted": 0, "shed": 1, "finished": 0,
+         "spec_proposed": 6, "spec_accepted": 4},
+        {"iter": 2, "admitted": 1, "evicted": 1, "shed": 0, "finished": 2,
+         "spec_proposed": 9, "spec_accepted": 2},
+    ]
+    out = TR.replay_decision_log(rows)
+    assert out == {
+        "iterations": 2, "prefill_admits": 3, "evictions": 1, "shed": 1,
+        "finished": 2, "spec_proposed": 15, "spec_accepted": 6,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the agreement suite: a REAL continuous-scheduler run's decision log
+# replays to exactly the counters (same tiny shape as
+# test_continuous_batching/test_speculative, so compiles ride the warm
+# persistent cache)
+# ---------------------------------------------------------------------------
+
+TINY = {
+    "Global": {"global_batch_size": 8, "seed": 3},
+    "Engine": {"mix_precision": {"enable": False},
+               "save_load": {"save_steps": 0}},
+    "Model": {
+        "module": "GPTModule",
+        "vocab_size": 96,
+        "hidden_size": 32,
+        "num_layers": 2,
+        "num_attention_heads": 4,
+        "max_position_embeddings": 128,
+        "dtype": "float32",
+    },
+    "Distributed": {},
+    "Optimizer": {"name": "FusedAdamW",
+                  "lr": {"name": "Constant", "learning_rate": 1e-3}},
+    "Generation": {"max_dec_len": 8, "decode_strategy": "greedy_search",
+                   "pad_to_multiple": 16, "eos_token_id": 95,
+                   "pad_token_id": 0},
+}
+
+PROMPTS = [[1, 2, 3], [4, 5, 6, 7, 8], [9, 10], [11, 12, 13, 14]]
+
+
+@pytest.fixture(scope="module")
+def server():
+    import jax
+
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.core.serving import GenerationServer
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    cfg = AttrDict.from_nested(TINY)
+    cfg = process_configs(cfg, num_devices=jax.device_count())
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    return GenerationServer(cfg, mesh, module)
+
+
+def test_decision_log_replay_reproduces_counters_exactly(server):
+    """THE agreement acceptance: admissions, a mid-decode eviction, and
+    per-chunk speculative accepts all land in the decision log, and
+    replaying it reproduces the per-instance counters the registry
+    exports (pfx_prefill_admits_total / pfx_request_evictions_total /
+    pfx_spec_accepted_total) EXACTLY — a silently dropped trace event
+    would break the equality."""
+    from paddlefleetx_tpu.core.continuous_batching import (
+        ContinuousScheduler,
+        PagedDecodeEngine,
+    )
+    from paddlefleetx_tpu.core.request_queue import DeadlineExceeded
+    from paddlefleetx_tpu.ops.speculative import SpecConfig
+
+    eng = PagedDecodeEngine(server, max_batch=4, spec=SpecConfig(draft_k=3))
+    sched = ContinuousScheduler(eng, max_depth=8)
+    # a TRUE mid-decode eviction, deterministically: admit the doomed
+    # request by hand-driving one iteration, then force its deadline
+    # into the past so the NEXT iteration must evict the ACTIVE row
+    # (a deadline_s=tiny + sleep would shed it while still queued —
+    # the _shed_locked path — and the eviction column would be a
+    # vacuous 0 == 0)
+    doomed = sched.submit([PROMPTS[1]], 64, deadline_s=60)
+    sched._iterate()  # admit + first decode step
+    assert eng.active_rows() == 1
+    doomed_row = next(r for r in eng.slots if r is not None)
+    doomed_row.entry.deadline = time.monotonic() - 1.0
+    sched._iterate()  # eviction fires before this iteration's step
+    assert sched.stats["evictions"] == 1  # really evicted mid-decode
+    assert eng.active_rows() == 0
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=10)
+
+    futs = [sched.submit([p], 6, deadline_s=120) for p in PROMPTS]
+    sched.start()
+    outs = [f.result(timeout=300)[0] for f in futs]
+    assert all(len(o) >= 1 for o in outs)
+    assert sched.shutdown(timeout=60)
+
+    replay = TR.replay_decision_log(sched.decision_log)
+    # the three acceptance counters, exactly (per-instance views == what
+    # the registry exports for this scheduler/engine)
+    assert replay["prefill_admits"] == sched.stats["prefill_admits"] \
+        == eng.stats["prefills"]
+    assert replay["evictions"] == sched.stats["evictions"]
+    assert replay["spec_accepted"] == eng.stats["spec_accepted"]
+    assert replay["spec_proposed"] == eng.stats["spec_proposed"]
+    assert replay["shed"] == sched.stats["shed_deadline"]
+    assert replay["prefill_admits"] >= len(PROMPTS)
+    assert replay["spec_proposed"] > 0
+    # block accounting closes: everything released, deltas net to zero
+    assert eng.cache.stats()["kv_blocks_used"] == 0
+    rows = list(sched.decision_log)
+    assert rows[-1]["blocks_free"] == eng.cache.allocator.free_count()
+    # width buckets recorded as positive pow2s
+    assert all(r["width_bucket"] >= 1 for r in rows)
+
+
+def test_request_trace_carries_full_continuous_timeline(server):
+    """A request served through the continuous scheduler can be fully
+    reconstructed offline: admission -> queue_wait -> prefill ->
+    decode_chunk* (with spec accepted counts summing to the delivered
+    tokens' chunks) -> respond-able timeline, and the Chrome export of
+    the window strict-parses."""
+    from paddlefleetx_tpu.core.continuous_batching import (
+        ContinuousScheduler,
+        PagedDecodeEngine,
+    )
+    from paddlefleetx_tpu.ops.speculative import SpecConfig
+
+    eng = PagedDecodeEngine(server, max_batch=4, spec=SpecConfig(draft_k=3))
+    sched = ContinuousScheduler(eng, max_depth=8)
+    sched.start()
+    fut = sched.submit([PROMPTS[0]], 6, deadline_s=120)
+    toks = fut.result(timeout=300)[0]
+    assert sched.shutdown(timeout=60)
+
+    tc = fut.trace
+    assert tc is not None, "default sampling must trace the request"
+    tc.finish()
+    tl = tc.timeline()
+    names = [e["name"] for e in tl["events"]]
+    # admission + queue_wait share the enqueue instant (the span sorts
+    # first as the wider event); prefill and every decode chunk follow
+    assert {"admission", "queue_wait", "prefill"} <= set(names)
+    assert max(names.index("admission"), names.index("queue_wait")) \
+        < names.index("prefill")
+    chunks = [e for e in tl["events"] if e["name"] == "decode_chunk"]
+    assert chunks, names
+    # committed counts cover every delivered token (EOS chunks may
+    # commit tokens the row drops, hence >=)
+    assert sum(c["args"]["committed"] for c in chunks) >= len(toks)
+    assert all("accepted" in c["args"] for c in chunks)
+    # phases are ordered and the prefill span has real width
+    prefill = next(e for e in tl["events"] if e["name"] == "prefill")
+    assert prefill["dur_s"] >= 0 and prefill["args"]["prompt_len"] == 3
+    # redaction: no token values anywhere in the event args
+    for e in tl["events"]:
+        assert "tokens" not in e["args"] or isinstance(
+            e["args"]["tokens"], int
+        ), e
+    # the whole window exports as strict-parsing Perfetto JSON
+    validate_chrome_trace(
+        TR.chrome_trace([TR.get_trace_buffer().get(tc.trace_id) or tc])
+    )
+
+
+def test_scheduler_does_no_tracing_work_when_sampled_out(server, monkeypatch):
+    """With the buffer disabled (PFX_TRACE_SAMPLE=0 semantics), futures
+    carry no trace and the decision log stays empty — the hot path does
+    zero tracing work."""
+    from paddlefleetx_tpu.core.continuous_batching import (
+        ContinuousScheduler,
+        PagedDecodeEngine,
+    )
+    from paddlefleetx_tpu.utils import tracing
+
+    monkeypatch.setattr(
+        tracing, "_buffer", tracing.TraceBuffer(sample=0.0)
+    )
+    eng = PagedDecodeEngine(server, max_batch=4, spec=None)
+    sched = ContinuousScheduler(eng, max_depth=8)
+    sched.start()
+    fut = sched.submit([PROMPTS[0]], 6, deadline_s=120)
+    assert len(fut.result(timeout=300)[0]) >= 1
+    assert fut.trace is None
+    assert list(sched.decision_log) == []
+    # with tracing off the per-iteration debug publish is ALSO skipped
+    # (zero observability work) until a /debug/state call latches
+    # interest — the first call may see the boot view, views are fresh
+    # from the next iteration on
+    dbg = sched.debug_state()
+    assert dbg["scheduler"] == "continuous"
+    fut2 = sched.submit([PROMPTS[2]], 6, deadline_s=120)
+    assert len(fut2.result(timeout=300)[0]) >= 1
+    dbg2 = sched.debug_state()
+    assert dbg2["compiled"]["prefill_families"] >= 1
+    assert sched.shutdown(timeout=60)
+
+
+def test_debug_state_snapshot_matches_live_engine(server):
+    """debug_state() is published per iteration: after a drained run it
+    agrees with the live engine/cache state and exposes per-row data
+    while rows are live (positions, budgets, blocks — no token ids)."""
+    from paddlefleetx_tpu.core.continuous_batching import PagedDecodeEngine, ContinuousScheduler
+
+    eng = PagedDecodeEngine(server, max_batch=4)
+    sched = ContinuousScheduler(eng, max_depth=8)
+    # drive by hand: admit two rows, step once, publish
+    s0 = eng.admit(PROMPTS[0], 6)
+    eng.admit(PROMPTS[1], 6)
+    eng.step()
+    sched._publish_debug()
+    dbg = sched.debug_state()
+    rows = dbg["batch"]["rows"]
+    assert {r["slot"] for r in rows} >= {s0}
+    for r in rows:
+        assert set(r) == {"slot", "seq_id", "prompt_len", "max_new",
+                          "position", "gen_step", "tokens_out", "blocks",
+                          "active"}
+        assert r["position"] >= r["prompt_len"]
+    assert dbg["arena"]["kv_blocks_used"] == eng.cache.stats()["kv_blocks_used"]
+    assert dbg["batch"]["active_rows"] == eng.active_rows()
+    assert dbg["batch"]["width_bucket"] == eng.table_width_bucket()
+    for i, r in enumerate(list(eng.slots)):
+        if r is not None:
+            eng.release(i)

@@ -21,6 +21,9 @@ def _resnet_state(cfg, key=0):
     )
 
 
+@pytest.mark.slow  # ~16s compile for a shape/BN-motion check; tier-1 keeps
+# the resnet50 feature path + the MoCo end-to-end train (which compiles the
+# resnet base); runs in make test-all (PR 8 tier-1 budget convention)
 def test_resnet18_forward_shape():
     params, state = _resnet_state(TINY_R18)
     x = jnp.ones((2, 32, 32, 3))
@@ -137,6 +140,9 @@ def test_moco_ptr_wraps(moco_bits):
     assert int(e["ptr"]) == 0  # 2*32 % 64
 
 
+@pytest.mark.slow  # ~26s grad compile; MoCo tier-1 coverage stays via the
+# end-to-end engine train, ptr-wrap, and degenerate-batch finiteness tests;
+# runs in make test-all (PR 8 tier-1 budget convention)
 def test_moco_grads_only_touch_base(moco_bits):
     params, extra = moco_bits
     batch = {

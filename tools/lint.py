@@ -23,6 +23,11 @@ actually bite:
       `METRICS` table in paddlefleetx_tpu/utils/telemetry.py, so the
       /metrics namespace cannot fragment the way the per-module stats
       dicts once did (docs/observability.md)
+  E11 metrics-docs agreement: every name in the `METRICS` table must
+      have a row in the "### Metrics reference" table of
+      docs/observability.md, and every row there must name a declared
+      metric — the doc drifted from the table twice before this gate.
+      (Repo-level check: runs once per invocation, not per file.)
 
 Suppress a finding with `# noqa` on the offending line.
 Usage: python tools/lint.py [paths...]   (default: the whole repo)
@@ -76,6 +81,70 @@ def declared_metrics():
         names = None
     _declared_metrics = names
     return names
+
+
+# E11: docs/observability.md "### Metrics reference" table
+DOC_METRICS_HEADING = "### Metrics reference"
+
+
+def documented_metrics(doc_path=None):
+    """(names, line_numbers) documented in the Metrics reference table of
+    docs/observability.md: rows matching ``| `pfx_...` | ...`` between
+    the heading and the next heading.  (None, {}) when the doc or the
+    heading is missing — E11 then reports the missing table itself."""
+    path = doc_path or os.path.join(REPO, "docs", "observability.md")
+    try:
+        with open(path) as f:
+            lines = f.read().split("\n")
+    except OSError:
+        return None, {}
+    names, linenos = set(), {}
+    in_table = False
+    for i, ln in enumerate(lines, 1):
+        if ln.strip() == DOC_METRICS_HEADING:
+            in_table = True
+            continue
+        if in_table and ln.startswith("#"):
+            break  # next heading ends the table's section
+        if in_table:
+            m = re.match(r"^\|\s*`(pfx_[a-z0-9_]+)`", ln)
+            if m:
+                names.add(m.group(1))
+                linenos.setdefault(m.group(1), i)
+    if not in_table:
+        return None, {}
+    return names, linenos
+
+
+def check_metrics_docs():
+    """E11 (repo-level, once per run): METRICS <-> docs/observability.md
+    Metrics-reference agreement, both directions."""
+    declared = declared_metrics()
+    if declared is None:
+        return []  # no table to check against (E10 degrades the same way)
+    doc_path = os.path.join(REPO, "docs", "observability.md")
+    tel_path = os.path.join(
+        REPO, "paddlefleetx_tpu", "utils", "telemetry.py"
+    )
+    documented, linenos = documented_metrics(doc_path)
+    if documented is None:
+        return [(doc_path, 1, "E11",
+                 f"missing '{DOC_METRICS_HEADING}' table documenting the "
+                 "METRICS names")]
+    findings = []
+    for name in sorted(declared - documented):
+        findings.append((
+            tel_path, 1, "E11",
+            f"metric '{name}' is declared in METRICS but has no row in "
+            f"docs/observability.md '{DOC_METRICS_HEADING}'",
+        ))
+    for name in sorted(documented - declared):
+        findings.append((
+            doc_path, linenos.get(name, 1), "E11",
+            f"documented metric '{name}' is not declared in "
+            "telemetry.METRICS (stale doc row?)",
+        ))
+    return findings
 
 
 def iter_py_files(paths):
@@ -286,6 +355,9 @@ def main(argv=None):
     for path in iter_py_files(paths):
         n_files += 1
         all_findings.extend(check_file(path))
+    # E11 is a repo-level invariant (code table <-> doc table), checked
+    # once per run rather than per file
+    all_findings.extend(check_metrics_docs())
     for path, lineno, code, msg in sorted(all_findings):
         rel = os.path.relpath(path, REPO)
         print(f"{rel}:{lineno}: {code} {msg}")

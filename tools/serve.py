@@ -20,8 +20,19 @@ Prometheus text exposition and `/healthz` renders the SAME locked
 snapshot as operator JSON — the two can never disagree.  Each request's
 lifecycle (admission -> queue_wait -> decode -> respond) is recorded as
 a span feeding TTFT / per-token-latency histograms and the crash flight
-recorder, which dumps `flight_recorder.jsonl` (PFX_FLIGHT_RECORDER) on
+recorder, which dumps its postmortem under PFX_FLIGHT_DIR (default
+./artifacts/; PFX_FLIGHT_RECORDER overrides the exact path) on
 watchdog-degraded, force-quit, and uncaught crashes.
+
+Deep-dive layer (`utils/tracing.py`): sampled per-request trace
+timelines (`PFX_TRACE_SAMPLE`/`PFX_TRACE_CAP`; 200 responses carry
+`trace_id`), the continuous scheduler's per-iteration decision log, and
+read-only live introspection — `GET /debug/state` (queue ages, per-row
+positions, arena occupancy, compile families), `GET /debug/trace?id=`
+(one request's timeline), `GET /debug/traces` (the sampled window as
+Perfetto-loadable Chrome-trace JSON).  Configured SLOs (`--slo-ttft-p99`,
+`--slo-error-rate`) export `pfx_slo_*` burn-rate gauges and an `slo`
+block (with breach reason) on `/healthz`.
 
 Usage:
   python tools/serve.py -c configs/gpt/pretrain_gpt_345M_single.yaml            # REPL
@@ -29,6 +40,7 @@ Usage:
       POST /generate {"prompt": "...", "max_tokens": 64, "deadline_s": 30}
       GET  /healthz
       GET  /metrics
+      GET  /debug/state | /debug/trace?id=<trace_id> | /debug/traces
 """
 
 import argparse
@@ -121,9 +133,16 @@ def _record_request_span(reg, recorder, t0, fut, code, tokens=None):
     (admission -> queue_wait -> decode -> respond) from the queue's
     monotonic stamps, TTFT + per-token histograms, and a flight-recorder
     event so the last N request spans survive into a crash dump.  A
-    request shed before pickup has no decode phase (labeled ``shed``)."""
+    request shed before pickup has no decode phase (labeled ``shed``).
+    The request's sampled deep-dive trace (if any) gets its terminal
+    ``respond`` stamp here and is finished — ``/debug/trace?id=`` then
+    replays the full timeline."""
     from paddlefleetx_tpu.utils.telemetry import Span
 
+    trace = getattr(fut, "trace", None) if fut is not None else None
+    if trace is not None:
+        trace.event("respond", code=code, tokens=tokens)
+        trace.finish()
     span = Span("request", t0=t0)
     times = dict(getattr(fut, "times", {}) or {}) if fut is not None else {}
     if "enqueued" in times:
@@ -206,10 +225,13 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                shed_slack_s: float = 2.0,
                watchdog_s: float = 300.0, max_tokens_cap: int = 0,
                scheduler: str = "coalesce", cb_batch: int = 8,
-               kv_blocks: int = 0, cb_warmup=()):
+               kv_blocks: int = 0, cb_warmup=(),
+               slo_ttft_p99_s: float = 0.0, slo_error_rate: float = 0.0,
+               slo_windows_s=(60.0, 600.0)):
     import signal
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from urllib.parse import parse_qs, urlsplit
 
     from paddlefleetx_tpu.core.request_queue import (
         DeadlineExceeded,
@@ -217,14 +239,41 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         QueueFull,
     )
     from paddlefleetx_tpu.utils.telemetry import (
+        SLOTracker,
         get_flight_recorder,
         get_registry,
     )
+    from paddlefleetx_tpu.utils.tracing import chrome_trace, get_trace_buffer
 
     reg = get_registry()
     recorder = get_flight_recorder()
     # a crash anywhere in the serving process leaves a postmortem ring
     recorder.install_excepthook()
+    trace_buffer = get_trace_buffer()
+
+    # SLO burn-rate layer (docs/observability.md): objectives evaluated
+    # over rolling multi-window burn rates, exported as pfx_slo_* gauges
+    # and surfaced as the /healthz "slo" block.  Observed per RESPONSE
+    # in the HTTP layer — the decode hot path never touches it.
+    slo = SLOTracker(
+        ttft_p99_s=slo_ttft_p99_s, error_rate=slo_error_rate,
+        windows_s=slo_windows_s,
+    )
+    if slo.enabled:
+        reg.register_collector(slo)
+
+    def _slo_observe(code, fut, t0):
+        if not slo.enabled:
+            return
+        # contract outcomes: 200 is budget-neutral; 429/500/503 spend the
+        # error budget; 400/404 are the client's fault and observe nothing
+        if code in (400, 404):
+            return
+        ttft = None
+        times = getattr(fut, "times", {}) if fut is not None else {}
+        if code == 200 and "resolved" in times:
+            ttft = max(0.0, times["resolved"] - t0)
+        slo.observe_request(ttft_s=ttft, ok=code == 200)
 
     cap = max_tokens_cap or int(
         server.cfg.get("Generation", {}).get("max_tokens_cap", 0) or 0
@@ -329,7 +378,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     k: cast(reg.value(m, snap=snap))
                     for k, (m, cast) in serving_keys.items()
                 })
-                self._json(200, {
+                body = {
                     "ok": not flags["degraded"],
                     "state": state,
                     "in_flight": int(reg.value(
@@ -346,15 +395,75 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     "latency_p50_s": round(lat["p50"], 4),
                     "latency_p99_s": round(lat["p99"], 4),
                     **serving_view,
-                })
+                }
+                if slo.enabled:
+                    # burn-rate view with the breach reason: an operator
+                    # reads WHY /healthz is angry without a dashboard
+                    body["slo"] = slo.evaluate()
+                self._json(200, body)
             elif self.path == "/metrics":
                 # Prometheus text exposition of the same registry snapshot
                 self._send(
                     200, reg.render_prometheus().encode(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
+            elif self.path.startswith("/debug/"):
+                self._debug_get()
             else:
                 self._json(404, {"error": "unknown path"})
+
+        def _debug_get(self):
+            """Live introspection (docs/observability.md): read-only,
+            lock-consistent snapshots that never block the scheduler
+            thread; prompt/token CONTENTS are never exposed."""
+            parts = urlsplit(self.path)
+            if parts.path == "/debug/state":
+                # one registry snapshot rides along so the debug view and
+                # the scraped gauges can be compared from a single read
+                snap = reg.snapshot()
+                dbg = queue.debug_state()
+                dbg["serving"] = {
+                    "compiled_families": len(getattr(server, "_compiled", {})),
+                    "traces": int(server.stats["traces"]),
+                    "gen_errors": int(server.stats["gen_errors"]),
+                }
+                dbg["flags"] = dict(flags)
+                dbg["trace_buffer"] = {
+                    "sample": trace_buffer.sample,
+                    "cap": trace_buffer.cap,
+                    "retained": len(trace_buffer.traces()),
+                }
+                if slo.enabled:
+                    dbg["slo"] = slo.evaluate()
+                gauges = {}
+                for name in (
+                    "pfx_queue_depth", "pfx_queue_busy_seconds",
+                    "pfx_http_requests_in_flight", "pfx_batch_occupancy",
+                    "pfx_kv_blocks_used", "pfx_kv_blocks_free",
+                    "pfx_kv_bytes", "pfx_prefill_admits_total",
+                    "pfx_request_evictions_total", "pfx_spec_accept_rate",
+                    "pfx_spec_accepted_total", "pfx_spec_proposed_total",
+                ):
+                    if name in snap:
+                        gauges[name] = reg.value(name, snap=snap)
+                dbg["metrics"] = gauges
+                return self._json(200, dbg)
+            if parts.path == "/debug/trace":
+                tid = (parse_qs(parts.query).get("id") or [""])[0]
+                if not tid:
+                    return self._json(400, {"error": "need ?id=<trace_id>"})
+                tc = trace_buffer.get(tid)
+                if tc is None:
+                    return self._json(404, {
+                        "error": f"trace {tid!r} not in the sampled window "
+                                 f"(cap {trace_buffer.cap}, sample "
+                                 f"{trace_buffer.sample:g})"
+                    })
+                return self._json(200, tc.timeline())
+            if parts.path == "/debug/traces":
+                # the retained window as Perfetto/chrome://tracing JSON
+                return self._json(200, chrome_trace(trace_buffer.traces()))
+            return self._json(404, {"error": "unknown debug path"})
 
         def _parse_prompts(self, req):
             """(prompts_ids, mode) from a /generate body; raises
@@ -401,8 +510,10 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             if self.path != "/generate":
                 return self._json(404, {"error": "unknown path"})
             in_flight_gauge.add(1)
+            t0 = time.monotonic()
+            fut = None
+            observed = False  # span + SLO recorded for this request
             try:
-                t0 = time.monotonic()
                 n = int(self.headers.get("Content-Length", 0))
                 try:
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -441,6 +552,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                         coalesce_key=key, deadline_s=deadline_s,
                     )
                 except QueueFull:
+                    _slo_observe(429, None, t0)
+                    observed = True
                     return self._json(
                         429,
                         {"error": f"queue full ({queue_depth} waiting); "
@@ -448,6 +561,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                         headers={"Retry-After": "1"},
                     )
                 except QueueClosed:
+                    _slo_observe(503, None, t0)
+                    observed = True
                     return self._json(
                         503,
                         {"error": "draining: not admitting new requests"},
@@ -466,6 +581,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 except TimeoutError:
                     queue.try_remove(fut)  # shed it if still queued
                     _record_request_span(reg, recorder, t0, fut, 503)
+                    _slo_observe(503, fut, t0)
+                    observed = True
                     return self._json(
                         503,
                         {"error": f"deadline {deadline_s:g}s exceeded"},
@@ -473,19 +590,26 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     )
                 except DeadlineExceeded as e:
                     _record_request_span(reg, recorder, t0, fut, 503)
+                    _slo_observe(503, fut, t0)
+                    observed = True
                     return self._json(
                         503, {"error": str(e)}, headers={"Retry-After": "1"}
                     )
                 except QueueClosed as e:  # flushed by a forced shutdown
                     _record_request_span(reg, recorder, t0, fut, 503)
+                    _slo_observe(503, fut, t0)
+                    observed = True
                     return self._json(
                         503, {"error": str(e)}, headers={"Retry-After": "5"}
                     )
                 except ValueError as e:  # bad request that got past checks
                     _record_request_span(reg, recorder, t0, fut, 400)
+                    observed = True
                     return self._json(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — report, keep serving
                     _record_request_span(reg, recorder, t0, fut, 500)
+                    _slo_observe(500, fut, t0)
+                    observed = True
                     return self._json(500, {"error": str(e)})
                 if mode in ("prompt", "prompts"):
                     texts = [server.tokenizer.decode(r) for r in rows]
@@ -495,13 +619,26 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     payload = ({"completion_ids": rows[0]}
                                if mode == "prompt_ids"
                                else {"completions_ids": rows})
+                if fut.trace is not None:
+                    # the handle for GET /debug/trace?id= (sampled only)
+                    payload["trace_id"] = fut.trace.trace_id
                 latency_hist.observe(time.monotonic() - t0)
                 _record_request_span(
                     reg, recorder, t0, fut, 200,
                     tokens=sum(len(r) for r in rows),
                 )
+                _slo_observe(200, fut, t0)
+                observed = True
                 return self._json(200, payload)
             except Exception as e:  # noqa: BLE001 — last-resort guard
+                # a failure AFTER decode (tokenizer decode, payload
+                # build) is still a failed request: it must spend SLO
+                # budget and close its trace, or a bug here would be
+                # invisible to the burn gauges exactly like the old
+                # wedged-503 blind spot
+                if not observed:
+                    _record_request_span(reg, recorder, t0, fut, 500)
+                    _slo_observe(500, fut, t0)
                 return self._json(500, {"error": str(e)})
             finally:
                 in_flight_gauge.add(-1)
@@ -701,6 +838,18 @@ def main(argv=None):
                     help="KV-cache storage dtype (overrides Generation."
                     "speculative.kv_dtype; int8 halves decode HBM "
                     "bytes — docs/decode_path.md)")
+    ap.add_argument("--slo-ttft-p99", type=float, default=0.0,
+                    help="SLO objective: p99 time-to-first-token seconds "
+                    "(0 = off).  Breach when >1%% of requests exceed it "
+                    "on EVERY --slo-windows window — /healthz grows an "
+                    "'slo' block and pfx_slo_* gauges appear in /metrics")
+    ap.add_argument("--slo-error-rate", type=float, default=0.0,
+                    help="SLO objective: allowed fraction of failed "
+                    "requests (429/500/503; 0 = off), burn-rate "
+                    "evaluated like --slo-ttft-p99")
+    ap.add_argument("--slo-windows", default="60,600",
+                    help="comma-separated rolling burn-rate window "
+                    "seconds, short first (default 60,600)")
     args = ap.parse_args(argv)
     # spec/quant CLI flags become plain config overrides so BOTH
     # schedulers (GenerationServer + PagedDecodeEngine read the same
@@ -761,6 +910,11 @@ def main(argv=None):
             cb_batch=args.cb_batch,
             kv_blocks=args.kv_blocks,
             cb_warmup=cb_warmup,
+            slo_ttft_p99_s=args.slo_ttft_p99,
+            slo_error_rate=args.slo_error_rate,
+            slo_windows_s=tuple(
+                float(x) for x in args.slo_windows.split(",") if x.strip()
+            ),
         )
 
     # REPL: one prompt per line -> completion (ids mode when no tokenizer)
