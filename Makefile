@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-spec test-trace bench
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-spec test-trace bench bench-check
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -68,11 +68,12 @@ test-data-drill:
 	python -m pytest tests/test_data.py tests/test_data_drills.py "tests/test_fault_injection.py::test_nan_rollback_rewind_replay_parity" -q
 
 # observability gate: telemetry registry/span/MFU/flight-recorder units,
-# the serving metrics surfaces, and the Prometheus-exposition + flight
-# recorder drills through the real tools/serve.py CLI
-# (docs/observability.md)
+# the training observatory (per-layer-group stats, non-finite provenance,
+# memory watermarks, compile watcher, tools/report.py), the serving
+# metrics surfaces, and the Prometheus-exposition + flight recorder
+# drills through the real tools/serve.py CLI (docs/observability.md)
 test-obs:
-	python -m pytest tests/test_telemetry.py tests/test_serving.py tests/test_request_queue.py -q -m "not slow"
+	python -m pytest tests/test_telemetry.py tests/test_model_stats.py tests/test_serving.py tests/test_request_queue.py -q -m "not slow"
 	python -m pytest tests/test_serve_drills.py -q -k "metrics or gen_hang"
 
 # deep-dive tracing gate: trace-context/buffer/export + SLO units, the
@@ -103,3 +104,9 @@ test-spec:
 
 bench:
 	python benchmarks/run_benchmark.py
+
+# bench-trajectory gate: newest two BENCH_r*.json compared, >10%
+# regression of any shared metric fails; backend-unreachable rows are
+# skipped loudly (tools/bench_check.py)
+bench-check:
+	python tools/bench_check.py

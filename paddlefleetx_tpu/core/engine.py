@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import json
+import math
 import os
 import time
 from typing import Any, Dict, Iterable, Optional
@@ -200,6 +201,25 @@ class Engine:
         # (the TIPC-style harness and dashboards parse this instead of
         # regexing the console log; "" disables)
         self.metrics_file = eng.get("metrics_file", "")
+        # training observatory (utils/model_stats.py): per-layer-group
+        # grad/param/update statistics computed IN-GRAPH every
+        # ``model_stats_every`` steps (Engine.logging.model_stats_every,
+        # default = logging cadence) behind a lax.cond, riding the
+        # existing step-record device fetch — no new per-step host syncs.
+        # 0 disables: the train step graph is then identical to the
+        # stats-less one (tests/test_model_stats.py asserts the dispatch
+        # and host-sync counts match the pre-observatory loop exactly).
+        log_cfg = eng.get("logging", {}) or {}
+        raw_every = log_cfg.get(
+            "model_stats_every", eng.get("model_stats_every")
+        )
+        self.model_stats_every = (
+            int(raw_every) if raw_every is not None else self.logging_freq
+        )
+        self._group_spec = None
+        self._pending_stats = None  # (step, device refs) until next log
+        self._fit_peak_bytes = None  # memory watermark peak, per fit
+        self._headroom_warned = False
         # unified telemetry (utils/telemetry.py): every record written to
         # the metrics stream ALSO lands in the crash flight recorder (so a
         # postmortem never depends on metrics_file being set) and the
@@ -216,6 +236,14 @@ class Engine:
 
         self._registry = get_registry()
         self._recorder = get_flight_recorder()
+        # retrace attribution (utils/model_stats.py): structured compile
+        # events (fn, aval diff vs the previous key, elapsed) into the
+        # flight ring + pfx_compile_* — installed before the first jit so
+        # the train step's own compile is attributed too.  Process-wide
+        # and idempotent; PFX_COMPILE_LOG=0 disables.
+        from paddlefleetx_tpu.utils.model_stats import install_compile_watcher
+
+        install_compile_watcher()
         self._flops_per_token = model_flops_per_token(
             getattr(module, "config", None)
         )
@@ -419,6 +447,13 @@ class Engine:
         self._train_loader = None  # held during fit: ckpt meta + rollback rewind
         self._loader_state = None  # loader state from a restored ckpt meta
         self.state = self._init_state()
+        if self.model_stats_every > 0:
+            # deterministic path -> layer-group mapping (embed / block_<i>
+            # / head), total over every model in the zoo; built from the
+            # state tree so abstract_init fit-checks get it too
+            from paddlefleetx_tpu.utils.model_stats import build_group_spec
+
+            self._group_spec = build_group_spec(self.state.params)
         # install zigzag positions EAGERLY for the configured sequence
         # length: a caller that resolves the step attribute before placing
         # the first batch must not run a positions-less (wrong-mask) graph
@@ -687,6 +722,8 @@ class Engine:
         decr_ratio = self.scale_decr_ratio
         qat = self.qat_transform
         grad_dtype = None if self.main_grad else jnp.dtype(self.compute_dtype)
+        group_spec = self._group_spec
+        stats_every = self.model_stats_every
 
         @functools.partial(
             jax.jit,
@@ -774,7 +811,18 @@ class Engine:
                 # optimizer update then all-gathers only the param updates
                 grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
 
-            gnorm = global_norm_f32(grads)
+            if group_spec is not None:
+                # per-layer-group sum of squares feeds BOTH the global
+                # grad norm (sum of the group sums — same fp32 rule as
+                # global_norm_f32, one pass over the gradients) and the
+                # per-group finiteness vector the non-finite-provenance
+                # contract needs on every step
+                from paddlefleetx_tpu.utils import model_stats as _ms
+
+                grad_gsq = _ms.group_sqsum(group_spec, grads)
+                gnorm = jnp.sqrt(jnp.sum(grad_gsq))
+            else:
+                gnorm = global_norm_f32(grads)
             finite = jnp.isfinite(gnorm)
             safe = jax.tree.map(lambda g: jnp.where(finite, g, 0.0), grads)
             # host offload: stage the moments onto device for the update,
@@ -827,6 +875,45 @@ class Engine:
             }
             if use_scaling:
                 metrics["loss_scale"] = new_scaler["scale"]
+            if group_spec is not None:
+                from paddlefleetx_tpu.utils import model_stats as _ms
+
+                # every step (free: isfinite of the sums the norm needed):
+                # which groups went non-finite — rides the anomaly guard's
+                # existing prev-metrics fetch, so rollback postmortems can
+                # name the first offending group with no extra sync
+                metrics["group_nonfinite"] = (
+                    ~jnp.isfinite(grad_gsq)
+                ).astype(jnp.int32)
+
+                # cadence steps only (lax.cond: the untaken branch costs
+                # nothing off-cadence): the full per-group statistic set.
+                # (state.step + 1) is the 1-based step number this
+                # dispatch computes — the same numbering the host loop and
+                # step records use.
+                def _stats_on(args):
+                    g_sq, p, u, g = args
+                    return _ms.group_stats(
+                        group_spec, grad_sqsum=g_sq, params=p, updates=u,
+                        grads=g,
+                    )
+
+                def _stats_off(args):
+                    zeros = jnp.zeros(
+                        (group_spec.num_groups,), jnp.float32
+                    )
+                    return {
+                        k: zeros
+                        for k in ("grad_norm", "param_norm", "update_norm",
+                                  "update_ratio", "nonfinite_frac")
+                    }
+
+                metrics["model_stats"] = jax.lax.cond(
+                    (state.step + 1) % stats_every == 0,
+                    _stats_on,
+                    _stats_off,
+                    (grad_gsq, state.params, updates, grads),
+                )
             return new_state, metrics
 
         return train_step
@@ -1014,6 +1101,61 @@ class Engine:
         if "mfu" in record:
             reg.gauge("pfx_train_mfu").set(record["mfu"])
 
+    def _format_model_stats(self, stats_step: int, vals: Dict) -> Dict:
+        """Shape one fetched per-group statistic set for the step record
+        (and mirror it onto the pfx_train_group_* gauges): group names in
+        canonical order plus parallel value lists — compact enough for
+        JSONL, self-describing enough for tools/report.py."""
+        names = list(self._group_spec.names)
+        out: Dict[str, Any] = {"step": int(stats_step), "groups": names}
+        reg = self._registry
+        gauge_of = {
+            "grad_norm": "pfx_train_group_grad_norm",
+            "param_norm": "pfx_train_group_param_norm",
+            "update_ratio": "pfx_train_group_update_ratio",
+            "nonfinite_frac": "pfx_train_group_nonfinite_frac",
+        }
+        for key in ("grad_norm", "param_norm", "update_norm",
+                    "update_ratio", "nonfinite_frac"):
+            row = [round(float(v), 6) for v in np.asarray(vals[key])]
+            out[key] = row
+            metric = gauge_of.get(key)
+            if metric:
+                for name, v in zip(names, row):
+                    if math.isfinite(v):
+                        reg.gauge(metric, group=name).set(v)
+        return out
+
+    def _sample_memory(self, record: Dict) -> None:
+        """Attach a memory-watermark block to a step record and mirror it
+        onto the pfx_mem_* gauges.  ``fit_peak_bytes`` is the highest
+        SAMPLED in-use watermark THIS fit (worst device bytes_in_use
+        where the backend reports it, host RSS otherwise, sampled at
+        logging cadence) — the allocator's own ``device_peak_bytes`` is
+        reported alongside but is process-lifetime (the backend never
+        resets it, so it cannot be per-fit).  A loud warning fires once
+        per fit when device headroom drops under
+        PFX_MEM_WARN_HEADROOM."""
+        from paddlefleetx_tpu.utils import model_stats as _ms
+
+        wm = _ms.memory_watermarks()
+        mem = {
+            k: wm[k]
+            for k in ("host_rss_bytes", "device_in_use_bytes",
+                      "device_peak_bytes", "headroom_frac")
+            if wm.get(k) is not None
+        }
+        watermark = wm.get("device_in_use_bytes") or wm.get("host_rss_bytes")
+        if watermark:
+            self._fit_peak_bytes = max(self._fit_peak_bytes or 0, watermark)
+        if self._fit_peak_bytes:
+            mem["fit_peak_bytes"] = self._fit_peak_bytes
+        if mem:
+            record["mem"] = mem
+        _ms.export_memory_gauges(self._registry, wm)
+        if not self._headroom_warned and _ms.warn_headroom(wm):
+            self._headroom_warned = True
+
     def _drain_skip_events(self, loader) -> None:
         """Move the loader's structured ``data_skip`` events (appended by
         the skip budget, data/batch_sampler.py) into the metrics stream,
@@ -1072,6 +1214,12 @@ class Engine:
 
         profiler = ProfilerHook(self.cfg.get("Profiler"))
         self.preempted = False
+        # per-fit observatory state: stats stashed for the next logging
+        # fetch, the memory watermark peak, and the once-per-fit headroom
+        # warning latch
+        self._pending_stats = None
+        self._fit_peak_bytes = None
+        self._headroom_warned = False
         preempt = PreemptionGuard().install()
         try:
             return self._fit_loop(
@@ -1110,11 +1258,18 @@ class Engine:
             window=self.res_loss_window,
         )
 
-    def _rollback(self, step: int, reason: str, rollbacks: int) -> bool:
+    def _rollback(self, step: int, reason: str, rollbacks: int,
+                  nonfinite_groups: Optional[list] = None) -> bool:
         """Anomaly response: restore params+opt-state from the last good
         checkpoint and let the loop re-enter from there.  Bounded: past
         ``resilience.max_rollbacks`` (or with no checkpoint to return to)
         the run fails loudly instead of thrashing.
+
+        ``nonfinite_groups`` is the non-finite-provenance list (canonical
+        group order, first entry = first offending layer group) observed
+        on the step that tripped the guard; it rides the ``rollback``
+        event and the flight postmortem so the postmortem names a
+        culprit layer, not just "found_inf fired".
 
         Returns True when the data stream was REWOUND to the checkpoint
         position (loader supports ``rewind``): the caller must re-iter()
@@ -1142,21 +1297,26 @@ class Engine:
             )
         loader = self._train_loader
         rewindable = loader is not None and hasattr(loader, "rewind")
+        culprit = (
+            f" (first non-finite group(s): {', '.join(nonfinite_groups[:3])})"
+            if nonfinite_groups else ""
+        )
         logger.error(
-            f"ANOMALY at step {step}: {reason}; rolling back to "
+            f"ANOMALY at step {step}: {reason}{culprit}; rolling back to "
             f"{self._last_good_ckpt} (rollback {rollbacks + 1}/"
             f"{self.res_max_rollbacks})"
         )
-        self._write_metrics(
-            {
-                "event": "rollback",
-                "step": step,
-                "reason": reason,
-                "ckpt": self._last_good_ckpt,
-                "rollback_index": rollbacks + 1,
-                "rewound": bool(rewindable),
-            }
-        )
+        event = {
+            "event": "rollback",
+            "step": step,
+            "reason": reason,
+            "ckpt": self._last_good_ckpt,
+            "rollback_index": rollbacks + 1,
+            "rewound": bool(rewindable),
+        }
+        if nonfinite_groups:
+            event["nonfinite_groups"] = list(nonfinite_groups)
+        self._write_metrics(event)
         # postmortem dump: the ring (recent step records, the rollback
         # event, any data_skips) hits disk NOW — if the post-rollback
         # replay diverges again and max_rollbacks kills the run, the
@@ -1278,6 +1438,14 @@ class Engine:
             dev_batch = self._put_batch(batch)
             self.state, metrics = self._train_step(self.state, dev_batch)
             host_dt = time.monotonic() - t_host
+            if (
+                self._group_spec is not None
+                and (self._step + 1) % self.model_stats_every == 0
+            ):
+                # device REFS only (no sync): the stats branch just ran
+                # in-graph; the arrays are fetched with the next logging
+                # fetch and attached to that record
+                self._pending_stats = (self._step + 1, metrics["model_stats"])
             if self._compile_s is None:
                 # the first dispatch traces + compiles synchronously inside
                 # the jit call: time it separately (compile_s) and restart
@@ -1300,10 +1468,25 @@ class Engine:
                     # position (token-for-token replay); otherwise the
                     # stream keeps its live position — same contract as a
                     # process restart mid-epoch.
-                    rewound = self._rollback(self._step, reason, rollbacks)
+                    culprits = None
+                    if self._group_spec is not None and "group_nonfinite" in pm:
+                        from paddlefleetx_tpu.utils.model_stats import (
+                            nonfinite_group_names,
+                        )
+
+                        culprits = nonfinite_group_names(
+                            self._group_spec, pm["group_nonfinite"]
+                        ) or None
+                    rewound = self._rollback(
+                        self._step, reason, rollbacks,
+                        nonfinite_groups=culprits,
+                    )
                     rollbacks += 1
                     guard.reset()
                     prev_metrics = None
+                    # stats stashed from the discarded window must not
+                    # label a post-rollback record
+                    self._pending_stats = None
                     if rewound:
                         # position is read at iter() time: restart the
                         # iteration so the replay starts AT the checkpoint
@@ -1313,6 +1496,10 @@ class Engine:
                 prev_metrics = {
                     "loss": metrics["loss"], "found_inf": metrics["found_inf"]
                 }
+                if self._group_spec is not None:
+                    # provenance rides the guard's existing step-behind
+                    # fetch: [G] int32, no extra sync
+                    prev_metrics["group_nonfinite"] = metrics["group_nonfinite"]
             self._consumed_samples += self.global_batch_size
             window_tokens += self.global_batch_size * tokens_per_sample
             steps_in_window += 1
@@ -1321,7 +1508,17 @@ class Engine:
             profiler.step(step)
 
             if step % self.logging_freq == 0:
-                metrics = jax.device_get(metrics)
+                # ONE host fetch: the step metrics plus any pending
+                # model-stats arrays stashed at the last cadence step —
+                # the observatory's "stats ride the existing step-record
+                # device fetch" contract
+                pending_stats, self._pending_stats = self._pending_stats, None
+                if pending_stats is not None:
+                    metrics, stats_vals = jax.device_get(
+                        (metrics, pending_stats[1])
+                    )
+                else:
+                    metrics = jax.device_get(metrics)
                 dt = time.time() - t_last
                 ips = window_tokens / dt
                 logger.info(
@@ -1370,6 +1567,27 @@ class Engine:
                         if k in ("data_wait_s", "prefetch_depth",
                                  "stall_warnings", "skips")
                     )
+                if pending_stats is not None:
+                    record["model_stats"] = self._format_model_stats(
+                        pending_stats[0], stats_vals
+                    )
+                if (
+                    self._group_spec is not None
+                    and float(metrics.get("found_inf", 0.0)) > 0
+                ):
+                    # non-finite provenance: this logged step was skipped;
+                    # name the offending group(s) right on the record
+                    from paddlefleetx_tpu.utils.model_stats import (
+                        nonfinite_group_names,
+                    )
+
+                    record["found_inf"] = 1
+                    record["nonfinite_groups"] = nonfinite_group_names(
+                        self._group_spec, metrics["group_nonfinite"]
+                    )
+                # memory watermarks: host-side accounting only (device
+                # memory_stats where the backend has it, host RSS always)
+                self._sample_memory(record)
                 if fit_trace is not None:
                     # mirror the record's phase fields as a trace span:
                     # the step-record JSONL and the Perfetto timeline
@@ -1401,7 +1619,10 @@ class Engine:
                 steps_in_window = 0
 
             if self.eval_freq and eval_iter is not None and step % self.eval_freq == 0:
-                self.evaluate(eval_iter, iters=self.eval_iters)
+                # on_empty="event": a finite eval stream exhausting mid-fit
+                # logs loudly + emits a structured event instead of either
+                # nan-poisoning silently or killing the training run
+                self.evaluate(eval_iter, iters=self.eval_iters, on_empty="event")
                 t_last = time.time()
                 window_tokens = 0
                 steps_in_window = 0
@@ -1446,8 +1667,23 @@ class Engine:
             fit_trace.finish()
         return self.state
 
-    def evaluate(self, loader: Iterable, iters: Optional[int] = None) -> float:
+    def evaluate(self, loader: Iterable, iters: Optional[int] = None,
+                 on_empty: str = "raise") -> float:
+        """Average eval loss over up to ``iters`` batches.
+
+        An empty/exhausted loader used to return ``float("nan")``
+        silently, poisoning every downstream consumer of the value.  Now
+        ``on_empty`` decides: ``"raise"`` (default — a CLI eval against
+        no data is a config error and must be loud) or ``"event"``
+        (ERROR log + structured ``eval_empty`` metrics/flight event +
+        nan return — the in-fit periodic path uses this, where a finite
+        eval stream legitimately exhausts mid-run and must not kill the
+        training loop)."""
         self._require_concrete("evaluate")
+        if on_empty not in ("raise", "event"):
+            raise ValueError(
+                f"on_empty={on_empty!r}: use 'raise' or 'event'"
+            )
         # loaders iterate forever (epoch-looping sampler): always bound
         iters = iters if iters is not None else self.eval_iters
         losses = []
@@ -1481,7 +1717,20 @@ class Engine:
                 close = getattr(loader, "close", None)
                 if callable(close):
                     close()
-        avg = float(np.mean(losses)) if losses else float("nan")
+        if not losses:
+            msg = (
+                f"evaluate saw ZERO batches (iters={iters}): the eval "
+                "loader is empty or exhausted — the old behavior returned "
+                "nan and silently poisoned downstream records"
+            )
+            if on_empty == "raise":
+                raise RuntimeError(msg)
+            logger.error(msg)
+            self._write_metrics(
+                {"event": "eval_empty", "step": self._step, "iters": iters}
+            )
+            return float("nan")
+        avg = float(np.mean(losses))
         if metric is not None:
             from paddlefleetx_tpu.models.metrics import format_metric
 

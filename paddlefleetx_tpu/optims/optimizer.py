@@ -30,6 +30,14 @@ def _no_decay_mask(params: Any) -> Any:
     return jax.tree.map(lambda p: p.ndim > 1, params)
 
 
+def sqsum_f32(x):
+    """Sum of squares of one leaf, accumulated in fp32 — THE shared
+    reduction rule under both the global grad norm below and the
+    per-layer-group statistics (utils/model_stats.py), so the grouped
+    and global norms can never disagree on accumulation dtype."""
+    return jnp.sum(jnp.square(x.astype(jnp.float32)))
+
+
 def global_norm_f32(tree: Any):
     """Global L2 norm with the sum-of-squares accumulated in fp32.
 
@@ -38,9 +46,7 @@ def global_norm_f32(tree: Any):
     1e8+ elements is garbage.  The convert sits inside the reduction, so
     XLA fuses it — no fp32 copy of any leaf is materialized."""
     leaves = [x for x in jax.tree.leaves(tree) if x is not None]
-    return jnp.sqrt(
-        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
-    )
+    return jnp.sqrt(sum(sqsum_f32(x) for x in leaves))
 
 
 def clip_by_global_norm_f32(clip_norm: float) -> optax.GradientTransformation:

@@ -135,6 +135,20 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_train_host_seconds_total": ("counter", "Cumulative host-side seconds (placement + dispatch)"),
     "pfx_train_rollbacks_total": ("counter", "Anomaly rollbacks executed"),
     "pfx_train_preempt_saves_total": ("counter", "Preemption-path final checkpoints"),
+    # training observatory (utils/model_stats.py; labels: group)
+    "pfx_train_group_grad_norm": ("gauge", "Per-layer-group gradient L2 norm at the last stats step"),
+    "pfx_train_group_param_norm": ("gauge", "Per-layer-group parameter L2 norm at the last stats step"),
+    "pfx_train_group_update_ratio": ("gauge", "Per-layer-group update-norm / param-norm ratio at the last stats step"),
+    "pfx_train_group_nonfinite_frac": ("gauge", "Per-layer-group fraction of non-finite gradient elements at the last stats step"),
+    # memory watermarks (utils/model_stats.py; labels: device)
+    "pfx_mem_host_rss_bytes": ("gauge", "Host resident-set size of this process"),
+    "pfx_mem_device_bytes_in_use": ("gauge", "Accelerator bytes currently allocated, per device"),
+    "pfx_mem_device_peak_bytes": ("gauge", "Peak accelerator bytes allocated, per device"),
+    "pfx_mem_device_limit_bytes": ("gauge", "Accelerator memory capacity, per device"),
+    "pfx_mem_headroom_frac": ("gauge", "Worst-device free-memory fraction (None-limit devices excluded)"),
+    # retrace attribution (utils/model_stats.py CompileWatcher)
+    "pfx_compile_events_total": ("counter", "Backend compiles observed by the compile watcher"),
+    "pfx_compile_seconds_total": ("counter", "Cumulative backend-compile seconds observed"),
     # data pipeline (data/batch_sampler.py loader stats)
     "pfx_data_skips_total": ("counter", "Corrupt samples skipped under the budget"),
     "pfx_data_stall_warnings_total": ("counter", "Prefetch starvation warnings"),

@@ -1,0 +1,99 @@
+"""tools/bench_check.py units (`make bench-check`): the newest-two
+BENCH_r*.json comparison flags >10% regressions of shared metrics, skips
+backend-unreachable rows loudly with rc 0, and never silently passes a
+short history."""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_check  # noqa: E402
+
+
+def _write(d, n, rows, rc=0):
+    path = d / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps({
+        "n": n, "cmd": "bench", "rc": rc, "tail": "",
+        "parsed": rows,
+    }))
+    return path
+
+
+def _row(metric, value, unit="tokens/s/chip"):
+    return {"metric": metric, "value": value, "unit": unit, "vs_baseline": 1.0}
+
+
+def test_regression_over_threshold_fails(tmp_path, capsys):
+    _write(tmp_path, 1, _row("tp", 1000.0))
+    _write(tmp_path, 2, _row("tp", 850.0))  # -15%
+    assert bench_check.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "tp" in out
+
+
+def test_within_threshold_and_improvement_pass(tmp_path, capsys):
+    _write(tmp_path, 1, [_row("tp", 1000.0), _row("p99", 2.0)])
+    _write(tmp_path, 2, [_row("tp", 950.0), _row("p99", 3.0)])  # -5%, +50%
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    assert "2 shared metric(s) within threshold" in capsys.readouterr().out
+
+
+def test_unreachable_backend_rows_skip_loudly_rc0(tmp_path, capsys):
+    """The honest-skip contract: a dead-backend 0.0 is not a regression;
+    the comparison falls back to the last two COMPARABLE snapshots."""
+    _write(tmp_path, 1, _row("tp", 1000.0))
+    _write(tmp_path, 2, _row("tp", 990.0))
+    _write(tmp_path, 3, _row("tp", 0.0, unit="tokens/s/chip (tpu backend unreachable)"))
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "unreachable" in out and "SKIP" in out
+    # and it compared r1 vs r2, not the dead r3
+    assert "r1=1000" in out and "r2=990" in out
+
+
+def test_failed_lap_spellings_skip_not_regress(tmp_path, capsys):
+    """bench.py's honest-fallback rows (deadline exceeded, killed by
+    signal, no JSON) are value-0 rows with the reason in the unit — they
+    must SKIP, never read as a 100% regression."""
+    _write(tmp_path, 1, _row("tp", 1000.0))
+    _write(tmp_path, 2, _row("tp", 990.0))
+    _write(tmp_path, 3, _row("tp", 0.0, unit="new tokens/s/chip (self-deadline 1200s exceeded)"))
+    _write(tmp_path, 4, _row("tp", 0.0, unit="tokens/s/chip (killed by signal 15 before completion)"))
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "REGRESSION" not in out
+    assert "r1=1000" in out and "r2=990" in out  # compared the live laps
+
+
+def test_corrupt_snapshot_skips_loudly_instead_of_crashing(tmp_path, capsys):
+    _write(tmp_path, 1, _row("tp", 1000.0))
+    _write(tmp_path, 2, _row("tp", 990.0))
+    (tmp_path / "BENCH_r03.json").write_text('{"n": 3, "parsed": {"met')
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "unparseable JSON" in out and "r2=990" in out
+
+
+def test_unparsed_lap_and_short_history_pass_loudly(tmp_path, capsys):
+    _write(tmp_path, 1, None, rc=124)  # timed-out lap: parsed null
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "PASS by default (loudly)" in out
+    assert bench_check.main(["--dir", str(tmp_path / "empty")]) == 0
+
+
+def test_disjoint_metrics_pass_loudly(tmp_path, capsys):
+    _write(tmp_path, 1, _row("old_metric", 10.0))
+    _write(tmp_path, 2, _row("new_metric", 10.0))
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    assert "share no metric" in capsys.readouterr().out
+
+
+def test_real_repo_history_is_parseable():
+    """The committed BENCH_r*.json trajectory must run clean (rc 0: the
+    reachable-backend rows are r1-only, so there is at most one
+    comparable snapshot)."""
+    assert bench_check.main(["--dir", REPO]) == 0

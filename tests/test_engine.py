@@ -413,3 +413,36 @@ def test_async_save_error_surfaces(tmp_path, devices8, monkeypatch):
     # no completeness marker: resume correctly skips the directory
     assert not os.path.exists(os.path.join(bad, "meta.json"))
     assert os.path.exists(os.path.join(path, "meta.json"))
+
+
+def test_evaluate_empty_loader_raises_loudly(tmp_path, devices8):
+    """Satellite (ISSUE 9): evaluate on an empty/exhausted loader used to
+    return float('nan') silently; the default now raises, and the in-fit
+    spelling (on_empty='event') logs + emits a structured eval_empty
+    event instead of poisoning downstream records."""
+    import json
+
+    cfg = tiny_cfg(tmp_path)
+    cfg.Engine.metrics_file = str(tmp_path / "ev_metrics.jsonl")
+    _, engine = _losses_from_run(cfg, steps=1)
+    with pytest.raises(RuntimeError, match="ZERO batches"):
+        engine.evaluate([], iters=4)
+    # event branch: nan returned, but loudly + structured
+    val = engine.evaluate([], iters=4, on_empty="event")
+    assert val != val  # nan
+    events = [json.loads(x) for x in open(cfg.Engine.metrics_file)]
+    assert any(e.get("event") == "eval_empty" for e in events)
+    with pytest.raises(ValueError, match="on_empty"):
+        engine.evaluate([], on_empty="typo")
+
+
+def test_evaluate_nonempty_still_returns_mean(tmp_path, devices8):
+    """The healthy branch: a real loader evaluates to a finite mean."""
+    cfg = tiny_cfg(tmp_path)
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    loader = build_dataloader(cfg, "Train")
+    with mesh:
+        engine = Engine(cfg, module, mesh)
+        val = engine.evaluate(loader, iters=2)
+    assert np.isfinite(val)

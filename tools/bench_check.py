@@ -1,0 +1,161 @@
+"""Bench-trajectory regression gate: compare the newest two
+``BENCH_r*.json`` snapshots and exit nonzero on a >10% regression of any
+shared metric (``make bench-check``).
+
+Each ``BENCH_r<N>.json`` records one bench lap: ``{"n": N, "rc": ...,
+"parsed": <row | [rows] | null>}`` where a row is ``{"metric", "value",
+"unit", ...}``.  Comparison rules (honest by construction):
+
+  - rows whose ``unit`` admits the lap failed are SKIPPED with a loud
+    note — ``bench.py``'s honest-fallback rows spell the failure as a
+    parenthetical unit suffix (``tokens/s/chip (tpu backend
+    unreachable)``, ``(self-deadline 1200s exceeded)``, ``(killed by
+    signal 15 before completion)``, ...) with value 0.0, so the skip
+    rule is: unit matches the failure regex, OR value == 0 with ANY
+    parenthetical annotation.  A dead backend is not a regression, and
+    pretending the 0.0 is comparable would flag (or mask) nonsense;
+  - only metrics present in BOTH snapshots are compared (all bench
+    metrics are higher-is-better throughputs);
+  - fewer than two comparable snapshots → rc 0 with a loud note, never
+    a silent green.
+
+Usage: ``python tools/bench_check.py [--dir REPO] [--threshold 0.10]``
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAILED_UNIT_RE = re.compile(
+    r"unreachable|unavailable|no backend|exceeded|killed|timed? ?out|"
+    r"before completion|no JSON|exited",
+    re.IGNORECASE,
+)
+
+
+def load_rows(path: str) -> Tuple[int, List[dict]]:
+    """(lap number, parsed rows) for one BENCH_r*.json; rows may be a
+    single dict, a list, or null (a timed-out lap).  A corrupt/truncated
+    snapshot raises ValueError — the caller skips it loudly instead of
+    crashing the gate on it."""
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"unparseable JSON: {e}") from None
+    if not isinstance(doc, dict):
+        raise ValueError(f"expected a JSON object, got {type(doc).__name__}")
+    parsed = doc.get("parsed")
+    if parsed is None:
+        rows: List[dict] = []
+    elif isinstance(parsed, dict):
+        rows = [parsed]
+    else:
+        rows = [r for r in parsed if isinstance(r, dict)]
+    n = doc.get("n")
+    if n is None:
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        n = int(m.group(1)) if m else -1
+    return int(n), rows
+
+
+def usable_metrics(rows: List[dict], label: str,
+                   notes: List[str]) -> Dict[str, float]:
+    """metric -> value for the comparable rows; failed-lap rows (the
+    honest-fallback spelling: failure reason in the unit, value 0.0)
+    are skipped loudly."""
+    out: Dict[str, float] = {}
+    for row in rows:
+        metric = row.get("metric")
+        value = row.get("value")
+        unit = str(row.get("unit", ""))
+        if not metric or not isinstance(value, (int, float)):
+            continue
+        if FAILED_UNIT_RE.search(unit) or (value == 0 and "(" in unit):
+            notes.append(
+                f"SKIP {label}: {metric} unit says the lap failed "
+                f"({unit!r}) — not comparable"
+            )
+            continue
+        out[str(metric)] = float(value)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--dir", default=REPO, help="directory holding BENCH_r*.json")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative drop that counts as a regression")
+    args = ap.parse_args(argv)
+
+    notes: List[str] = []
+    # parse once up front (a corrupt snapshot is a loud skip, not a
+    # traceback), then compare the newest two snapshots WITH comparable
+    # rows: a timed-out or backend-dead lap in between must not blind
+    # the gate
+    loaded: List[Tuple[int, str, List[dict]]] = []
+    for p in glob.glob(os.path.join(args.dir, "BENCH_r*.json")):
+        try:
+            n, rows = load_rows(p)
+        except (OSError, ValueError) as e:
+            notes.append(f"SKIP {os.path.basename(p)}: {e}")
+            continue
+        loaded.append((n, p, rows))
+    usable: List[Tuple[int, str, Dict[str, float]]] = []
+    for n, p, rows in sorted(loaded):
+        metrics = usable_metrics(rows, os.path.basename(p), notes)
+        if metrics:
+            usable.append((n, p, metrics))
+        else:
+            notes.append(
+                f"SKIP {os.path.basename(p)}: no comparable rows "
+                "(failed lap or unparsed output)"
+            )
+    for note in notes:
+        print(f"bench-check: {note}")
+    if len(usable) < 2:
+        print(
+            f"bench-check: only {len(usable)} comparable snapshot(s) under "
+            f"{args.dir} — nothing to compare, PASS by default (loudly)"
+        )
+        return 0
+
+    (n_old, p_old, old), (n_new, p_new, new) = usable[-2], usable[-1]
+    shared = sorted(set(old) & set(new))
+    if not shared:
+        print(
+            f"bench-check: r{n_old} and r{n_new} share no metric names — "
+            "nothing to compare, PASS by default (loudly)"
+        )
+        return 0
+    failures = 0
+    for metric in shared:
+        ov, nv = old[metric], new[metric]
+        if ov <= 0:
+            print(f"bench-check: {metric}: old value {ov} not comparable, skipped")
+            continue
+        drop = (ov - nv) / ov
+        verdict = "REGRESSION" if drop > args.threshold else "ok"
+        print(
+            f"bench-check: {metric}: r{n_old}={ov:g} -> r{n_new}={nv:g} "
+            f"({-drop:+.1%}) {verdict}"
+        )
+        failures += verdict == "REGRESSION"
+    if failures:
+        print(
+            f"bench-check: {failures} metric(s) regressed >"
+            f"{args.threshold:.0%} between {os.path.basename(p_old)} and "
+            f"{os.path.basename(p_new)}"
+        )
+        return 1
+    print(f"bench-check: {len(shared)} shared metric(s) within threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

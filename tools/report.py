@@ -1,0 +1,615 @@
+"""Offline run-report renderer: one self-contained HTML (or markdown)
+page from a training run's artifacts — no server, no deps beyond stdlib,
+no jax import, so it runs on CI artifacts and laptops alike.
+
+Inputs (any subset; missing ones get a loud note in the report):
+
+  - the engine's metrics JSONL (``Engine.metrics_file``) — step records
+    + structured events (rollback / preempt_save / data_skip /
+    eval_empty);
+  - a flight-recorder dump (``<output_dir>/flight_recorder.jsonl`` or
+    ``<PFX_FLIGHT_DIR>/flight_recorder.jsonl``) — for a CRASHED run this
+    is usually the only artifact, and its ring carries the step records
+    the metrics stream never flushed, plus compile events (retrace
+    attribution) and the dump reason;
+  - a Chrome-trace export (``<PFX_FLIGHT_DIR>/trace.json``).
+
+Rendered: loss / lr / MFU / data-wait curves (rollback, preempt and
+compile markers overlaid), the per-layer-group norm heatmap from the
+observatory's ``model_stats`` records, a memory-watermark timeline, and
+an annotated event table.  Usage::
+
+    python tools/report.py --metrics m.jsonl --flight out/flight_recorder.jsonl \
+        --trace artifacts/trace.json -o report.html
+    python tools/report.py --run-dir out/ --format md -o report.md
+
+``--run-dir`` scans for the conventional file names.  Exit is nonzero
+only when NO input artifact could be read.
+"""
+
+import argparse
+import html
+import json
+import math
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+STEP_EVENT_KINDS = ("rollback", "preempt_save", "data_skip", "eval_empty")
+
+
+# ---------------------------------------------------------------------------
+# artifact loading
+# ---------------------------------------------------------------------------
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                rows.append({"event": "unparseable", "raw": line[:200]})
+    return rows
+
+
+class RunData:
+    """Everything the renderer needs, merged from whichever artifacts
+    exist.  Step records from the metrics stream win over flight-ring
+    copies of the same step (the stream is the durable writer); a
+    crashed run with no metrics file still gets records from the ring."""
+
+    def __init__(self) -> None:
+        self.sources: List[str] = []
+        self.notes: List[str] = []
+        self.records: Dict[int, Dict[str, Any]] = {}
+        self.events: List[Dict[str, Any]] = []
+        self.compiles: List[Dict[str, Any]] = []
+        self.flight_header: Optional[Dict[str, Any]] = None
+        self.trace_summary: Optional[Dict[str, Any]] = None
+
+    def _ingest_row(self, row: Dict[str, Any], prefer: bool) -> None:
+        kind = row.get("event", "step" if "loss" in row else None)
+        if kind == "step" and isinstance(row.get("step"), (int, float)):
+            step = int(row["step"])
+            if prefer or step not in self.records:
+                self.records[step] = row
+            elif "ts" in row:
+                # a metrics-stream record won, but only the flight-ring
+                # copy carries a wall-clock ts — backfill it so compile
+                # events (ts-only) can be mapped onto the step axis
+                self.records[step].setdefault("ts", row["ts"])
+        elif kind == "compile":
+            self.compiles.append(row)
+        elif kind == "flight_recorder_dump":
+            self.flight_header = row
+        elif kind in STEP_EVENT_KINDS:
+            self.events.append(row)
+        elif kind in ("crash", "span", "unparseable"):
+            self.events.append(row)
+
+    def add_metrics(self, path: str) -> None:
+        for row in load_jsonl(path):
+            self._ingest_row(row, prefer=True)
+        self.sources.append(f"metrics: {path}")
+
+    def add_flight(self, path: str) -> None:
+        seen = {
+            (e.get("event"), e.get("step"), e.get("reason"))
+            for e in self.events
+        }
+        for row in load_jsonl(path):
+            kind = row.get("event", "step" if "loss" in row else None)
+            if kind in STEP_EVENT_KINDS:
+                key = (kind, row.get("step"), row.get("reason"))
+                if key in seen:
+                    continue  # already ingested from the metrics stream
+            self._ingest_row(row, prefer=False)
+        self.sources.append(f"flight: {path}")
+
+    def add_trace(self, path: str) -> None:
+        with open(path) as f:
+            doc = json.load(f)
+        # both Chrome-trace containers are valid: the object form
+        # ({"traceEvents": [...]}) our exporter writes, and the bare
+        # JSON-array form many Perfetto tools emit
+        evs = doc if isinstance(doc, list) else doc.get("traceEvents", [])
+        evs = [e for e in evs if isinstance(e, dict)]
+        dur = sum(e.get("dur", 0) for e in evs if e.get("ph") == "X")
+        self.trace_summary = {
+            "path": path,
+            "events": len(evs),
+            "lanes": len({(e.get("pid"), e.get("tid")) for e in evs}),
+            "span_seconds": round(dur / 1e6, 3),
+        }
+        self.sources.append(f"trace: {path}")
+
+    # -- derived views --------------------------------------------------
+    def steps(self) -> List[int]:
+        return sorted(self.records)
+
+    def series(self, key: str, sub: Optional[str] = None) -> List[Tuple[int, float]]:
+        out = []
+        for s in self.steps():
+            rec = self.records[s]
+            v = rec.get(key)
+            if sub is not None and isinstance(v, dict):
+                v = v.get(sub)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out.append((s, float(v)))
+        return out
+
+    def model_stats_rows(self) -> List[Dict[str, Any]]:
+        return [
+            self.records[s]["model_stats"] for s in self.steps()
+            if isinstance(self.records[s].get("model_stats"), dict)
+        ]
+
+    def status(self) -> str:
+        preempts = [e for e in self.events if e.get("event") == "preempt_save"]
+        crashes = [e for e in self.events if e.get("event") == "crash"]
+        if crashes:
+            return f"CRASHED: {crashes[-1].get('error', '?')}"
+        if preempts:
+            return f"preempted at step {preempts[-1].get('step', '?')} ({preempts[-1].get('cause', '?')})"
+        if self.flight_header and self.flight_header.get("reason"):
+            return f"flight dump: {self.flight_header['reason']}"
+        return "completed (no crash/preempt markers)"
+
+
+def find_artifacts(args) -> RunData:
+    data = RunData()
+    metrics, flight, trace = args.metrics, args.flight, args.trace
+    if args.run_dir:
+        d = args.run_dir
+        metrics = metrics or _first_existing(
+            os.path.join(d, "metrics.jsonl"), os.path.join(d, "m.jsonl")
+        )
+        flight = flight or _first_existing(
+            os.path.join(d, "flight_recorder.jsonl"),
+            os.path.join(d, "artifacts", "flight_recorder.jsonl"),
+        )
+        trace = trace or _first_existing(
+            os.path.join(d, "trace.json"),
+            os.path.join(d, "artifacts", "trace.json"),
+        )
+    for path, add, label in (
+        (metrics, data.add_metrics, "metrics JSONL"),
+        (flight, data.add_flight, "flight-recorder dump"),
+        (trace, data.add_trace, "trace export"),
+    ):
+        if not path:
+            data.notes.append(f"no {label} given — section skipped")
+            continue
+        try:
+            add(path)
+        except (OSError, ValueError, TypeError, AttributeError, KeyError) as e:
+            # the contract: an unreadable/foreign artifact is a loud
+            # note and the rest of the report still renders — never a
+            # traceback on a crashed run's half-written files
+            data.notes.append(f"could not read {label} {path}: {e!r}")
+    return data
+
+
+def _first_existing(*paths: str) -> Optional[str]:
+    for p in paths:
+        if os.path.exists(p):
+            return p
+    return None
+
+
+# ---------------------------------------------------------------------------
+# SVG primitives (hand-rolled: self-contained, no chart deps)
+# ---------------------------------------------------------------------------
+
+W, H, PAD = 640, 180, 36
+
+
+def _scale(vals: Sequence[float], lo_px: float, hi_px: float):
+    lo, hi = min(vals), max(vals)
+    if hi == lo:
+        hi = lo + 1.0
+    span = hi - lo
+
+    def f(v: float) -> float:
+        return lo_px + (v - lo) / span * (hi_px - lo_px)
+
+    return f, lo, hi
+
+
+def _fmt(v: float) -> str:
+    return f"{v:.4g}"
+
+
+def svg_line(
+    title: str,
+    series: Sequence[Tuple[int, float]],
+    color: str = "#2563eb",
+    markers: Optional[Dict[int, Tuple[str, str]]] = None,
+) -> str:
+    """One line chart; ``markers`` maps step -> (color, label) vertical
+    annotation lines (rollback / preempt / compile)."""
+    if not series:
+        return (
+            f'<div class="chart"><h3>{html.escape(title)}</h3>'
+            "<p class='note'>no data</p></div>"
+        )
+    xs = [s for s, _ in series]
+    ys = [v for _, v in series]
+    fx, xlo, xhi = _scale(xs, PAD, W - 8)
+    fy, ylo, yhi = _scale(ys, H - 20, 12)  # y grows downward in SVG
+    pts = " ".join(f"{fx(x):.1f},{fy(y):.1f}" for x, y in series)
+    parts = [
+        f'<svg viewBox="0 0 {W} {H}" role="img" aria-label="{html.escape(title)}">',
+        f'<rect x="0" y="0" width="{W}" height="{H}" fill="#fafafa"/>',
+        f'<line x1="{PAD}" y1="{H - 20}" x2="{W - 8}" y2="{H - 20}" stroke="#999"/>',
+        f'<line x1="{PAD}" y1="12" x2="{PAD}" y2="{H - 20}" stroke="#999"/>',
+    ]
+    for step, (mcolor, label) in sorted((markers or {}).items()):
+        if xlo <= step <= xhi:
+            x = fx(step)
+            parts.append(
+                f'<line x1="{x:.1f}" y1="12" x2="{x:.1f}" y2="{H - 20}" '
+                f'stroke="{mcolor}" stroke-dasharray="3,2">'
+                f"<title>{html.escape(label)} @ step {step}</title></line>"
+            )
+    parts.append(
+        f'<polyline fill="none" stroke="{color}" stroke-width="1.5" points="{pts}"/>'
+    )
+    parts += [
+        f'<text x="{PAD}" y="{H - 6}" class="ax">{_fmt(xlo)}</text>',
+        f'<text x="{W - 8}" y="{H - 6}" text-anchor="end" class="ax">{_fmt(xhi)}</text>',
+        f'<text x="{PAD - 4}" y="{H - 20}" text-anchor="end" class="ax">{_fmt(ylo)}</text>',
+        f'<text x="{PAD - 4}" y="16" text-anchor="end" class="ax">{_fmt(yhi)}</text>',
+        "</svg>",
+    ]
+    return (
+        f'<div class="chart"><h3>{html.escape(title)}</h3>' + "".join(parts) + "</div>"
+    )
+
+
+def _heat_color(t: float) -> str:
+    """0..1 -> light blue .. deep red ramp."""
+    t = min(1.0, max(0.0, t))
+    r = int(40 + 215 * t)
+    g = int(90 + 60 * (1 - t) - 60 * t)
+    b = int(220 * (1 - t) + 40 * t)
+    return f"rgb({r},{max(0, g)},{b})"
+
+
+def svg_heatmap(title: str, groups: List[str], steps: List[int],
+                matrix: List[List[Optional[float]]], log_scale: bool = True) -> str:
+    """groups x steps heatmap (matrix[g][s]); log10 color scale by
+    default (norms span decades), non-finite cells black."""
+    if not groups or not steps:
+        return (
+            f'<div class="chart"><h3>{html.escape(title)}</h3>'
+            "<p class='note'>no model_stats records</p></div>"
+        )
+    label_w = 8 + max(len(g) for g in groups) * 7
+    cw = max(4, min(28, (W - label_w - 8) // max(1, len(steps))))
+    ch = 16
+    width = label_w + cw * len(steps) + 8
+    height = 24 + ch * len(groups) + 18
+    flat = [
+        v for row in matrix for v in row
+        if v is not None and math.isfinite(v) and (not log_scale or v > 0)
+    ]
+    if log_scale:
+        flat = [math.log10(v) for v in flat]
+    lo, hi = (min(flat), max(flat)) if flat else (0.0, 1.0)
+    if hi == lo:
+        hi = lo + 1.0
+    parts = [
+        f'<svg viewBox="0 0 {width} {height}" role="img" aria-label="{html.escape(title)}">'
+    ]
+    for gi, g in enumerate(groups):
+        y = 20 + gi * ch
+        parts.append(
+            f'<text x="{label_w - 6}" y="{y + ch - 4}" text-anchor="end" '
+            f'class="ax">{html.escape(g)}</text>'
+        )
+        for si, step in enumerate(steps):
+            v = matrix[gi][si]
+            if v is None or not math.isfinite(v) or (log_scale and v <= 0):
+                fill = "#111"
+                tip = f"{g} @ step {step}: non-finite/none"
+            else:
+                t = ((math.log10(v) if log_scale else v) - lo) / (hi - lo)
+                fill = _heat_color(t)
+                tip = f"{g} @ step {step}: {_fmt(v)}"
+            parts.append(
+                f'<rect x="{label_w + si * cw}" y="{y}" width="{cw - 1}" '
+                f'height="{ch - 1}" fill="{fill}"><title>{html.escape(tip)}</title></rect>'
+            )
+    parts.append(
+        f'<text x="{label_w}" y="{height - 4}" class="ax">steps '
+        f"{steps[0]}..{steps[-1]}; color = log10 scale {_fmt(lo)}..{_fmt(hi)}</text>"
+    )
+    parts.append("</svg>")
+    return (
+        f'<div class="chart"><h3>{html.escape(title)}</h3>' + "".join(parts) + "</div>"
+    )
+
+
+# ---------------------------------------------------------------------------
+# report assembly
+# ---------------------------------------------------------------------------
+
+
+def summarize(data: RunData) -> List[Tuple[str, Any]]:
+    steps = data.steps()
+    loss = data.series("loss")
+    mfu = data.series("mfu")
+    dw = data.series("data_wait_s")
+    rollbacks = [e for e in data.events if e.get("event") == "rollback"]
+    preempts = [e for e in data.events if e.get("event") == "preempt_save"]
+    skips = [e for e in data.events if e.get("event") == "data_skip"]
+    nonfinite = [
+        s for s in steps
+        if data.records[s].get("found_inf")
+        or (isinstance(data.records[s].get("loss"), float)
+            and math.isnan(data.records[s]["loss"]))
+    ]
+    mem_peak = max(
+        (r.get("mem", {}).get("fit_peak_bytes", 0) for r in data.records.values()),
+        default=0,
+    )
+    rows: List[Tuple[str, Any]] = [
+        ("status", data.status()),
+        ("steps logged", f"{steps[0]}..{steps[-1]} ({len(steps)} records)"
+         if steps else "none"),
+        ("final loss", _fmt(loss[-1][1]) if loss else "n/a"),
+        ("best loss", _fmt(min(v for _, v in loss)) if loss else "n/a"),
+        ("mean MFU", _fmt(sum(v for _, v in mfu) / len(mfu)) if mfu else "n/a"),
+        ("total data wait", f"{dw[-1][1]:.2f}s" if dw else "n/a"),
+        ("non-finite steps", f"{len(nonfinite)} ({nonfinite[:8]})"
+         if nonfinite else "0"),
+        ("rollbacks", len(rollbacks)),
+        ("preempt saves", len(preempts)),
+        ("data skips", len(skips)),
+        ("compiles observed",
+         f"{len(data.compiles)} ({sum(c.get('elapsed_s', 0) for c in data.compiles):.1f}s total)"
+         if data.compiles else "0"),
+        ("peak memory watermark", _bytes(mem_peak) if mem_peak else "n/a"),
+    ]
+    if data.trace_summary:
+        ts = data.trace_summary
+        rows.append((
+            "trace export",
+            f"{ts['events']} events / {ts['lanes']} lanes / "
+            f"{ts['span_seconds']}s total span",
+        ))
+    return rows
+
+
+def _bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def annotation_markers(data: RunData) -> Dict[int, Tuple[str, str]]:
+    markers: Dict[int, Tuple[str, str]] = {}
+    for e in data.events:
+        step = e.get("step")
+        if not isinstance(step, (int, float)):
+            continue
+        kind = e.get("event")
+        if kind == "rollback":
+            markers[int(step)] = ("#dc2626", f"rollback ({e.get('reason', '')})")
+        elif kind == "preempt_save":
+            markers[int(step)] = ("#d97706", f"preempt ({e.get('cause', '')})")
+        elif kind == "eval_empty":
+            markers.setdefault(int(step), ("#7c3aed", "eval_empty"))
+    # compile events: flight rows carry wall-clock ts; map each onto the
+    # nearest step record that has a ts (flight step copies do)
+    step_ts = [
+        (data.records[s]["ts"], s) for s in data.steps()
+        if isinstance(data.records[s].get("ts"), (int, float))
+    ]
+    if step_ts:
+        step_ts.sort()
+        for c in data.compiles:
+            ts = c.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            nearest = min(step_ts, key=lambda p: abs(p[0] - ts))[1]
+            markers.setdefault(
+                nearest,
+                ("#059669",
+                 f"compile {c.get('fn', '?')} {c.get('elapsed_s', 0)}s"),
+            )
+    return markers
+
+
+def event_rows(data: RunData) -> List[List[str]]:
+    rows = []
+    for e in data.events:
+        kind = e.get("event", "?")
+        detail = {
+            k: v for k, v in e.items()
+            if k not in ("event", "seq", "ts") and v is not None
+        }
+        rows.append([str(kind), str(e.get("step", "")),
+                     json.dumps(detail, default=str)[:240]])
+    for c in data.compiles:
+        rows.append([
+            "compile", "",
+            f"{c.get('fn', '?')}: {c.get('elapsed_s', '?')}s, "
+            f"{c.get('diff', '')}"
+            + (" [persistent-cache hit]" if c.get("cache_hit") else ""),
+        ])
+    return rows
+
+
+def heatmap_inputs(data: RunData, key: str):
+    ms_rows = data.model_stats_rows()
+    if not ms_rows:
+        return [], [], []
+    groups = ms_rows[0].get("groups", [])
+    steps = [int(r.get("step", i)) for i, r in enumerate(ms_rows)]
+    matrix: List[List[Optional[float]]] = []
+    for gi in range(len(groups)):
+        row = []
+        for r in ms_rows:
+            vals = r.get(key) or []
+            v = vals[gi] if gi < len(vals) else None
+            row.append(float(v) if isinstance(v, (int, float)) else None)
+        matrix.append(row)
+    return groups, steps, matrix
+
+
+CSS = """
+body { font: 14px/1.45 system-ui, sans-serif; margin: 24px auto; max-width: 900px; color: #1f2937; }
+h1 { font-size: 20px; } h2 { font-size: 16px; margin-top: 28px; border-bottom: 1px solid #e5e7eb; }
+h3 { font-size: 13px; margin: 8px 0 2px; color: #374151; }
+table { border-collapse: collapse; width: 100%; font-size: 13px; }
+td, th { border: 1px solid #e5e7eb; padding: 3px 8px; text-align: left; vertical-align: top; }
+th { background: #f3f4f6; }
+.note { color: #92400e; background: #fef3c7; padding: 2px 8px; display: inline-block; }
+.ax { font-size: 9px; fill: #6b7280; }
+svg { width: 100%; height: auto; }
+.chart { margin-bottom: 10px; }
+code { background: #f3f4f6; padding: 0 3px; }
+"""
+
+
+def render_html(data: RunData, title: str) -> str:
+    markers = annotation_markers(data)
+    out = [
+        "<!doctype html>",
+        '<html lang="en"><head><meta charset="utf-8">',
+        f"<title>{html.escape(title)}</title>",
+        f"<style>{CSS}</style></head><body>",
+        f"<h1>{html.escape(title)}</h1>",
+        "<p>" + " · ".join(html.escape(s) for s in data.sources) + "</p>",
+    ]
+    for n in data.notes:
+        out.append(f'<p class="note">{html.escape(n)}</p>')
+
+    out.append("<h2>Summary</h2><table>")
+    for k, v in summarize(data):
+        out.append(
+            f"<tr><th>{html.escape(str(k))}</th><td>{html.escape(str(v))}</td></tr>"
+        )
+    out.append("</table>")
+
+    out.append("<h2>Curves</h2>")
+    out.append(svg_line("loss", data.series("loss"), "#2563eb", markers))
+    out.append(svg_line("learning rate", data.series("lr"), "#7c3aed", markers))
+    out.append(svg_line("MFU", data.series("mfu"), "#059669", markers))
+    out.append(svg_line(
+        "data wait (cumulative s)", data.series("data_wait_s"), "#d97706", markers
+    ))
+    out.append(svg_line(
+        "tokens/s", data.series("tokens_per_sec"), "#0891b2", markers
+    ))
+
+    out.append("<h2>Per-layer-group statistics</h2>")
+    for key, label in (
+        ("grad_norm", "grad norm by layer group"),
+        ("update_ratio", "update/param ratio by layer group"),
+    ):
+        groups, steps, matrix = heatmap_inputs(data, key)
+        out.append(svg_heatmap(label, groups, steps, matrix))
+
+    out.append("<h2>Memory watermarks</h2>")
+    out.append(svg_line(
+        "host RSS (bytes)", data.series("mem", "host_rss_bytes"), "#be123c", markers
+    ))
+    dev = data.series("mem", "device_peak_bytes")
+    if dev:
+        out.append(svg_line("device peak (bytes)", dev, "#9d174d", markers))
+
+    out.append("<h2>Events &amp; compiles</h2>")
+    rows = event_rows(data)
+    if rows:
+        out.append("<table><tr><th>event</th><th>step</th><th>detail</th></tr>")
+        for r in rows:
+            out.append(
+                "<tr>" + "".join(f"<td>{html.escape(c)}</td>" for c in r) + "</tr>"
+            )
+        out.append("</table>")
+    else:
+        out.append("<p>no events recorded</p>")
+    out.append("</body></html>")
+    return "\n".join(out) + "\n"
+
+
+def render_markdown(data: RunData, title: str) -> str:
+    lines = [f"# {title}", "", "sources: " + "; ".join(data.sources), ""]
+    for n in data.notes:
+        lines.append(f"> NOTE: {n}")
+    lines += ["", "## Summary", "", "| key | value |", "|---|---|"]
+    for k, v in summarize(data):
+        lines.append(f"| {k} | {v} |")
+    loss = data.series("loss")
+    if loss:
+        lines += ["", "## Loss", "", "| step | loss |", "|---|---|"]
+        stride = max(1, len(loss) // 40)
+        for s, v in loss[::stride]:
+            lines.append(f"| {s} | {_fmt(v)} |")
+    ms = data.model_stats_rows()
+    if ms:
+        last = ms[-1]
+        lines += ["", f"## Layer groups (step {last.get('step', '?')})", "",
+                  "| group | grad_norm | param_norm | update_ratio | nonfinite_frac |",
+                  "|---|---|---|---|---|"]
+        for i, g in enumerate(last.get("groups", [])):
+            cells = [
+                _fmt(last[k][i]) if i < len(last.get(k) or []) else ""
+                for k in ("grad_norm", "param_norm", "update_ratio",
+                          "nonfinite_frac")
+            ]
+            lines.append("| " + " | ".join([g] + cells) + " |")
+    rows = event_rows(data)
+    if rows:
+        lines += ["", "## Events", "", "| event | step | detail |", "|---|---|---|"]
+        for r in rows:
+            lines.append("| " + " | ".join(c.replace("|", "\\|") for c in r) + " |")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--metrics", help="engine metrics JSONL")
+    ap.add_argument("--flight", help="flight_recorder.jsonl dump")
+    ap.add_argument("--trace", help="Chrome-trace JSON export")
+    ap.add_argument("--run-dir", help="directory to scan for the conventional names")
+    ap.add_argument("-o", "--out", default="report.html",
+                    help="output path ('-' = stdout)")
+    ap.add_argument("--format", choices=("html", "md"), default=None,
+                    help="default: by --out extension (html unless .md)")
+    ap.add_argument("--title", default="PaddleFleetX-TPU run report")
+    args = ap.parse_args(argv)
+
+    data = find_artifacts(args)
+    if not data.sources:
+        print("report.py: no readable artifact (give --metrics/--flight/"
+              "--trace or --run-dir)", file=sys.stderr)
+        for n in data.notes:
+            print(f"  {n}", file=sys.stderr)
+        return 2
+    fmt = args.format or ("md" if args.out.endswith(".md") else "html")
+    doc = (render_markdown if fmt == "md" else render_html)(data, args.title)
+    if args.out == "-":
+        sys.stdout.write(doc)
+    else:
+        with open(args.out, "w") as f:
+            f.write(doc)
+        kind = "markdown" if fmt == "md" else "self-contained HTML"
+        print(f"report.py: wrote {kind} report to {args.out} "
+              f"({len(data.records)} step records, {len(data.events)} events, "
+              f"{len(data.compiles)} compiles)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -249,6 +249,12 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     recorder = get_flight_recorder()
     # a crash anywhere in the serving process leaves a postmortem ring
     recorder.install_excepthook()
+    # retrace attribution (utils/model_stats.py): mid-traffic compiles
+    # land in the flight ring + pfx_compile_* with the aval diff that
+    # keyed them (PFX_COMPILE_LOG=0 disables)
+    from paddlefleetx_tpu.utils.model_stats import install_compile_watcher
+
+    install_compile_watcher()
     trace_buffer = get_trace_buffer()
 
     # SLO burn-rate layer (docs/observability.md): objectives evaluated

@@ -111,6 +111,30 @@ def main(argv=None):
             wait = stats_fn().get("data_wait_s", 0)
             if wait:
                 logger.info(f"host data pipeline: {wait}s total step wait")
+        # observatory epilogue: the run's memory watermark + compile tally
+        # and the one-liner that turns this run's artifacts into a report
+        from paddlefleetx_tpu.utils.model_stats import get_compile_watcher
+        from paddlefleetx_tpu.utils.tracing import export_chrome_trace
+
+        if engine._fit_peak_bytes:
+            logger.info(
+                f"memory watermark: {engine._fit_peak_bytes / (1 << 20):.0f} "
+                "MiB peak this fit (per-record detail under 'mem')"
+            )
+        compiles = get_compile_watcher().snapshot()
+        if compiles:
+            total = sum(c.get("elapsed_s", 0.0) for c in compiles)
+            logger.info(
+                f"compile events: {len(compiles)} ({total:.1f}s backend "
+                "compile) — retrace attribution rides the flight ring"
+            )
+        trace_path = export_chrome_trace()
+        report_cmd = f"python tools/report.py --run-dir {output_dir}"
+        if cfg.Engine.get("metrics_file"):
+            report_cmd += f" --metrics {cfg.Engine.metrics_file}"
+        if trace_path:
+            report_cmd += f" --trace {trace_path}"
+        logger.info(f"run report: {report_cmd} -o report.html")
         if engine.preempted:
             # final checkpoint already written (preemption / exit_after_save
             # path); exit 0 so the orchestrator relaunches with auto_resume
