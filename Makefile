@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-spec test-trace bench bench-check
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-spec test-trace test-router bench bench-check
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -16,7 +16,8 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_serving.py tests/test_request_queue.py \
              tests/test_chunked_ce.py tests/test_lint.py \
              tests/test_telemetry.py tests/test_tracing.py \
-             tests/test_bench_helpers.py tests/test_bench_cases.py
+             tests/test_bench_helpers.py tests/test_bench_cases.py \
+             tests/test_router.py
 
 # lint runs inside the gate via tests/test_lint.py::test_repo_is_clean
 test-fast:
@@ -32,7 +33,8 @@ MID_EXTRA = tests/test_engine.py tests/test_generation.py tests/test_moe.py \
             tests/test_vision.py tests/test_auto_tune.py tests/test_check.py \
             tests/test_compression_profiler.py tests/test_hf_convert.py \
             tests/test_long_context.py tests/test_paged_cache.py \
-            tests/test_continuous_batching.py tests/test_speculative.py
+            tests/test_continuous_batching.py tests/test_speculative.py \
+            tests/test_kv_handoff.py
 test-mid:
 	python -m pytest $(FAST_FILES) $(MID_EXTRA) -q -m "not slow" -x
 	python -m pytest "tests/test_pipeline.py::test_pipeline_1f1b_train_loss_and_grads[2-extra1-4-1]" -q
@@ -101,6 +103,14 @@ test-paged:
 test-spec:
 	python -m pytest tests/test_speculative.py -q
 	python -m pytest tests/test_bench_contract.py -q -k "decode"
+
+# multi-host router gate: router-core units against stub replicas (no
+# model), the KV-handoff codec + export/adopt parity suite, and the
+# multi-process drills — rolling drain under flood, SIGKILL failover,
+# disaggregated prefill/decode parity — through the real tools/serve.py
+# + tools/router.py CLIs (docs/serving.md "Multi-host serving")
+test-router:
+	python -m pytest tests/test_router.py tests/test_kv_handoff.py tests/test_router_drills.py -q
 
 bench:
 	python benchmarks/run_benchmark.py

@@ -72,6 +72,7 @@ from paddlefleetx_tpu.core.paged_cache import (
     NULL_BLOCK,
     PagedCacheManager,
     blocks_for,
+    check_handoff_meta,
     kv_block_size,
 )
 from paddlefleetx_tpu.core.request_queue import (
@@ -141,6 +142,10 @@ class _CBEntry:
     next_row: int = 0  # rows [0, next_row) admitted so far
     done_rows: int = 0
     results: List[Optional[List[int]]] = dataclasses.field(default_factory=list)
+    # disaggregated serving: a (meta, arrays) KV-handoff payload instead
+    # of a prompt to prefill — the admission loop ADOPTS the exported
+    # blocks (engine.adopt) rather than running paged_prefill
+    handoff: Optional[tuple] = None
 
     def __post_init__(self) -> None:
         self.results = [None] * len(self.prompts)
@@ -220,11 +225,14 @@ class PagedDecodeEngine:
         self._seq_counter = 0
         self._compiled_step: Dict = {}
         self._compiled_prefill: Dict = {}
-        # trace-time entries across BOTH compiled families — the bounded-
+        self._compiled_adopt: Dict = {}
+        # trace-time entries across the compiled families — the bounded-
         # retrace contract's probe, like GenerationServer.stats["traces"]
+        # ("exports"/"adopts" count disaggregated KV handoffs served)
         self.stats: Dict[str, Any] = {
             "traces": 0, "steps": 0, "prefills": 0,
             "spec_proposed": 0, "spec_accepted": 0,
+            "exports": 0, "adopts": 0,
         }
         # True only inside warmup(): warmup admits/steps are not traffic
         # and must not bump the traffic-facing registry counters (the
@@ -449,6 +457,224 @@ class PagedDecodeEngine:
         self.stats["prefills"] += 1
         return slot
 
+    # -- disaggregated prefill/decode (KV handoff) ----------------------
+    def _pool_sig(self) -> List[int]:
+        """[layers, heads, block, head_dim] — the arena compatibility
+        signature a handoff payload must match (num_blocks excluded: the
+        two replicas' pools may legitimately differ in size)."""
+        layers, _, heads, bs, d = self.pools.k.shape
+        return [int(layers), int(heads), int(bs), int(d)]
+
+    def _clamp_budget(self, prompt_len: int, max_new: int):
+        """(P, PB, limit, clamped max_new) — THE admit-side budget clamp,
+        shared by admit/export/adopt so a payload clamped on the prefill
+        replica re-clamps to the identical value on the decode replica."""
+        from paddlefleetx_tpu.models.gpt.generation import bucket_len
+
+        if prompt_len < 1:
+            raise ValueError("prompt must be non-empty")
+        if max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        P = bucket_len(prompt_len, self.bucket)
+        context = int(self.mcfg.max_position_embeddings)
+        limit = context - P
+        if limit < 1:
+            raise ValueError(
+                f"prompt bucket {P} leaves no decode room in context "
+                f"{context}"
+            )
+        return P, blocks_for(P, self.block), limit, min(max_new, limit)
+
+    def prefill_export(self, prompt_ids: Sequence[int], max_new: int,
+                       trace: Any = None):
+        """Prefill-replica half of the disaggregated handoff: run ONE
+        row's prompt through `paged_prefill` into this arena, then copy
+        the prefilled blocks + row state out as ``(meta, arrays)`` for
+        `core/paged_cache.pack_handoff` and free the blocks.  Only the
+        prompt bucket's blocks are held (and only for the duration of
+        the export), so a prefill pool stays small regardless of decode
+        budgets.  ``meta["max_new"]`` carries the ALREADY-clamped budget;
+        the adopting engine re-clamps with the same formula, so the two
+        agree whenever the replicas share a Model config (and
+        `check_handoff_meta` has already insisted they do)."""
+        plen = len(prompt_ids)
+        P, PB, _, max_new = self._clamp_budget(plen, int(max_new))
+        self._seq_counter += 1
+        seq_id = self._seq_counter
+        # reserve ONLY the prompt bucket: the decode budget is the
+        # decode replica's to hold
+        table = self.cache.admit(seq_id, P)
+        prompt = np.full((1, P), self.gen.pad_token_id, np.int32)
+        prompt[0, :plen] = list(prompt_ids)
+        jnp = self._jnp
+        fn = self._prefill_fn(P, PB)
+        t0 = time.monotonic()
+        try:
+            with self.mesh:
+                pools_t, last, counts = fn(
+                    self.server.params,
+                    jnp.asarray(prompt),
+                    jnp.int32(plen),
+                    self._pools_tuple(),
+                    jnp.asarray(table, jnp.int32),
+                )
+        except BaseException as exc:
+            self.cache.release(seq_id)
+            dead = self.reset()
+            raise ArenaReset(
+                f"prefill export failed ({type(exc).__name__}: {exc}); "
+                "arena reset",
+                dead,
+            ) from exc
+        from paddlefleetx_tpu.models.gpt.generation import (
+            PagedPools,
+            gather_kv_blocks,
+        )
+
+        self.pools = PagedPools(*pools_t)
+        arrays = gather_kv_blocks(self.pools, table)
+        arrays["logits"] = np.asarray(last, np.float32)
+        arrays["counts"] = np.asarray(counts, np.int32)
+        self.cache.release(seq_id)  # contents copied out; blocks free
+        meta = {
+            "prompt_ids": [int(t) for t in prompt_ids],
+            "prompt_len": plen,
+            "max_new": int(max_new),
+            "block": self.block,
+            "kv_dtype": self.kv_dtype,
+            "pool_sig": self._pool_sig(),
+        }
+        self.stats["prefills"] += 1
+        self.stats["exports"] += 1
+        if not self._warmup:
+            get_registry().counter("pfx_handoff_exports_total").inc()
+        if trace is not None:
+            trace.span("prefill_export", t0=t0, t1=time.monotonic(),
+                       prompt_len=plen, bucket=P, blocks=PB)
+        return meta, arrays
+
+    def _adopt_fn(self, PB: int):
+        key = (PB,)
+        fn = self._compiled_adopt.get(key)
+        if fn is None:
+            from paddlefleetx_tpu.models.gpt.generation import (
+                PagedPools,
+                scatter_kv_blocks,
+            )
+
+            names = ("k", "v", "k_scale", "v_scale")
+
+            def traced(pools_t, idx, blocks_t):
+                self.stats["traces"] += 1
+                pools = scatter_kv_blocks(
+                    PagedPools(*pools_t), idx, dict(zip(names, blocks_t))
+                )
+                return tuple(x for x in pools if x is not None)
+
+            fn = self._jax.jit(traced, donate_argnums=(0,))
+            self._compiled_adopt[key] = fn
+            get_registry().counter("pfx_serving_traces_total").inc()
+        return fn
+
+    def adopt(self, meta: Dict[str, Any], arrays: Dict[str, Any],
+              entry: Optional[_CBEntry] = None, row_idx: int = 0) -> int:
+        """Decode-replica half of the handoff: validate the payload
+        against this arena (LOUD on dtype/block-size/shape mismatch),
+        allocate the row's FULL capacity (prompt + decode budget, like
+        `admit`), scatter the exported blocks into its first PB blocks
+        (donated dispatch — a failure resets the arena, the `admit`
+        contract), and seed the row state so the continuous scheduler
+        continues exactly where the prefill replica's math stopped —
+        greedy output token-identical to a single-process `admit`."""
+        check_handoff_meta(
+            meta, block=self.block, kv_dtype=self.kv_dtype,
+            pool_sig=self._pool_sig(),
+        )
+        prompt_ids = [int(t) for t in meta["prompt_ids"]]
+        plen = int(meta["prompt_len"])
+        if plen != len(prompt_ids):
+            raise ValueError(
+                f"handoff prompt_len {plen} != {len(prompt_ids)} prompt ids"
+            )
+        P, PB, limit, max_new = self._clamp_budget(plen, int(meta["max_new"]))
+        jnp = self._jnp
+        vocab = int(self.mcfg.vocab_size)
+        for name, want in (("logits", (vocab,)), ("counts", (vocab,))):
+            got = tuple(np.shape(arrays.get(name)))
+            if got != want:
+                raise ValueError(
+                    f"handoff {name} shape {got} != {want} (vocab {vocab})"
+                )
+        # the block-array SET is validated BEFORE the donated dispatch: a
+        # payload missing k/v must fail this request alone, not trip the
+        # in-trace check and reset the arena under every live row
+        names = ("k", "v", "k_scale", "v_scale")
+        need = set(names[: 4 if self.kv_dtype == "int8" else 2])
+        if not need <= set(arrays):
+            raise ValueError(
+                f"handoff payload missing arrays "
+                f"{sorted(need - set(arrays))} (has {sorted(arrays)})"
+            )
+        slot = next((i for i, r in enumerate(self.slots) if r is None), None)
+        if slot is None:
+            raise RuntimeError("no free slot in the running batch")
+        self._seq_counter += 1
+        seq_id = self._seq_counter
+        table = self.cache.admit(
+            seq_id, self.row_capacity_tokens(plen, max_new)
+        )
+        # NAMES order (k, v, scales) — _adopt_fn zips the same order
+        blocks_t = tuple(jnp.asarray(arrays[n]) for n in names if n in need)
+        trace = entry.future.trace if entry is not None else None
+        t0 = time.monotonic()
+        fn = self._adopt_fn(PB)
+        try:
+            with self.mesh:
+                pools_t = fn(
+                    self._pools_tuple(),
+                    jnp.asarray(table[:PB], jnp.int32),
+                    blocks_t,
+                )
+        except BaseException as exc:
+            self.cache.release(seq_id)
+            dead = self.reset()
+            raise ArenaReset(
+                f"handoff adopt failed ({type(exc).__name__}: {exc}); "
+                "arena reset",
+                dead,
+            ) from exc
+        from paddlefleetx_tpu.models.gpt.generation import PagedPools
+
+        self.pools = PagedPools(*pools_t)
+        self._logits = self._logits.at[slot].set(
+            jnp.asarray(arrays["logits"], jnp.float32)
+        )
+        self._counts = self._counts.at[slot].set(
+            jnp.asarray(arrays["counts"], jnp.int32)
+        )
+        self._reject = self._reject.at[slot].set(-1)
+        self.positions[slot] = plen
+        self.gen_steps[slot] = 0
+        self.max_news[slot] = max_new
+        # same forced-EOS step as admit(): the coalesce path's bucketed
+        # run end, so disaggregated output stays token-identical
+        self.forced_steps[slot] = min(-(-max_new // 32) * 32, limit) - 1
+        self.active[slot] = True
+        if trace is not None:
+            trace.span(
+                "adopt", t0=t0, t1=time.monotonic(),
+                prompt_len=plen, bucket=P, blocks=len(table), slot=slot,
+            )
+        self.slots[slot] = _Row(
+            seq_id=seq_id, entry=entry, row_idx=row_idx, prompt_len=plen,
+            max_new=max_new, table=table, prompt_ids=prompt_ids,
+            trace=trace,
+        )
+        self.stats["adopts"] += 1
+        if not self._warmup:
+            get_registry().counter("pfx_handoff_adopts_total").inc()
+        return slot
+
     def table_width_bucket(self) -> int:
         widest = max(
             (len(r.table) for r in self.slots if r is not None), default=1
@@ -605,6 +831,33 @@ class PagedDecodeEngine:
         self._reject = jnp.full_like(self._reject, -1)
         return dead
 
+    def warmup_prefill(self, prompt_lens: Sequence[int]) -> Dict[str, float]:
+        """Prefill-replica warmup: compile the prefill family per prompt
+        bucket by running one export end-to-end (the blocks are freed on
+        export, so nothing stays allocated).  Warmup exports are not
+        traffic — the handoff counters stay clean."""
+        per: Dict[str, float] = {}
+        self._warmup = True
+        try:
+            for n in prompt_lens:
+                t0 = time.time()
+                try:
+                    self.prefill_export([1] * int(n), self.gen.max_dec_len)
+                except Exception as exc:
+                    raise RuntimeError(
+                        f"prefill warmup failed at bucket {n} (warmed so "
+                        f"far: {sorted(per) or 'none'}): "
+                        f"{type(exc).__name__}: {exc}"
+                    ) from exc
+                per[str(int(n))] = round(time.time() - t0, 2)
+                logger.info(
+                    f"prefill warmup: prompt bucket {n} compiled in "
+                    f"{per[str(int(n))]:.1f}s"
+                )
+        finally:
+            self._warmup = False
+        return per
+
     def warmup(self, prompt_lens: Sequence[int]) -> Dict[str, float]:
         """Compile (prefill, step) for each prompt bucket at the default
         decode budget — the continuous counterpart of
@@ -749,6 +1002,55 @@ class ContinuousScheduler:
         attach_request_trace(
             entry.future, t0=entry.enqueued_at, scheduler=self.name,
             prompts=len(entry.prompts), max_new=entry.max_new,
+        )
+        try:
+            with self._wake:
+                if self._closed:
+                    self.stats["rejected_closed"] += 1
+                    raise QueueClosed(f"{self.name} queue is draining")
+                if len(self._entries) >= self.max_depth:
+                    self.stats["rejected_full"] += 1
+                    raise QueueFull(
+                        f"{self.name} queue full ({self.max_depth} waiting)"
+                    )
+                self._entries.append(entry)
+                self.stats["submitted"] += 1
+                self._wake.notify_all()
+        except (QueueClosed, QueueFull):
+            discard_request_trace(entry.future)  # never admitted
+            raise
+        return entry.future
+
+    def submit_handoff(self, meta: Dict[str, Any], arrays: Dict[str, Any],
+                       *, deadline_s: Optional[float] = None
+                       ) -> RequestFuture:
+        """Admit a disaggregated KV-handoff payload (one prefilled row
+        from a prefill replica): same bounded-queue/deadline surface as
+        :meth:`submit`, but the admission loop ADOPTS the exported blocks
+        instead of prefilling.  Pre-admission validation is loud: an
+        incompatible payload (dtype/block-size/pool-shape) or a
+        could-never-fit budget raises ``ValueError`` before a queue slot
+        is spent (HTTP 400 in tools/serve.py)."""
+        check_handoff_meta(
+            meta, block=self.engine.block, kv_dtype=self.engine.kv_dtype,
+            pool_sig=self.engine._pool_sig(),
+        )
+        prompt = [int(t) for t in meta.get("prompt_ids", [])]
+        max_new = int(meta.get("max_new", 0))
+        self.engine.validate_request(len(prompt), max_new)
+        entry = _CBEntry(
+            prompts=[prompt],
+            max_new=max_new,
+            deadline=(time.monotonic() + float(deadline_s))
+            if deadline_s is not None else None,
+            future=RequestFuture(),
+            enqueued_at=time.monotonic(),
+            handoff=(meta, arrays),
+        )
+        entry.future.times["enqueued"] = entry.enqueued_at
+        attach_request_trace(
+            entry.future, t0=entry.enqueued_at, scheduler=self.name,
+            prompts=1, max_new=entry.max_new,
         )
         try:
             with self._wake:
@@ -1125,7 +1427,17 @@ class ContinuousScheduler:
             self._req_counter += 1
             try:
                 maybe_fire("gen_crash", self._req_counter)
-                eng.admit(prompt, entry.max_new, entry=entry, row_idx=row_idx)
+                if entry.handoff is not None:
+                    # disaggregated: adopt the prefill replica's exported
+                    # blocks instead of running paged_prefill.  Counted in
+                    # prefill_admits too — it IS a row admission, and the
+                    # decision-log replay contract stays exact
+                    meta, arrays = entry.handoff
+                    eng.adopt(meta, arrays, entry=entry, row_idx=row_idx)
+                else:
+                    eng.admit(
+                        prompt, entry.max_new, entry=entry, row_idx=row_idx
+                    )
                 self.stats["prefill_admits"] += 1
             except ArenaReset as exc:
                 # the donating prefill dispatch failed: every live row

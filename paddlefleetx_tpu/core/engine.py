@@ -603,20 +603,27 @@ class Engine:
         else:
             self.extra_shardings = None
 
-        @functools.partial(
-            jax.jit,
-            out_shardings=TrainState(
-                step=self.replicated,
-                params=self.param_shardings,
-                # host-placed directly when offload is active: materializing
-                # on device first would OOM exactly the models offload serves
-                opt_state=self.opt_shardings,
-                extra=self.extra_shardings,
-                scaler={"scale": self.replicated, "good_steps": self.replicated}
-                if self.use_loss_scaling
-                else None,
-            ),
+        # ONE sharding tree for the whole TrainState, shared by make_state
+        # and the train step's out_shardings: with the step's output left
+        # to sharding propagation (out_shardings=None), XLA under a
+        # model-parallel mesh may pick a DIFFERENT output sharding than
+        # the input state carries — the donated buffers then cannot alias
+        # ("Some donated buffers were not usable" on every step, and a
+        # silent reshard of the whole state).  Pinning output == input
+        # sharding makes donation always usable.
+        self.state_shardings = TrainState(
+            step=self.replicated,
+            params=self.param_shardings,
+            # host-placed directly when offload is active: materializing
+            # on device first would OOM exactly the models offload serves
+            opt_state=self.opt_shardings,
+            extra=self.extra_shardings,
+            scaler={"scale": self.replicated, "good_steps": self.replicated}
+            if self.use_loss_scaling
+            else None,
         )
+
+        @functools.partial(jax.jit, out_shardings=self.state_shardings)
         def make_state(key):
             params = self.module.init_params(key)
             if self._param_cast is not None:
@@ -729,7 +736,13 @@ class Engine:
             jax.jit,
             donate_argnums=(0,),
             in_shardings=(None, self.batch_spec),
-            out_shardings=(None, self.replicated),
+            # the state output is PINNED to the input state's sharding tree
+            # (built at init): letting propagation choose (None) can pick a
+            # different sharding for the new params/moments under a
+            # model-parallel mesh, which both breaks donation ("donated
+            # buffers were not usable" every step) and resharding-copies
+            # the whole state each step
+            out_shardings=(self.state_shardings, self.replicated),
         )
         def train_step(state: TrainState, batch: Dict[str, jax.Array]):
             # per-step dropout stream: 'global' stream folded with the step

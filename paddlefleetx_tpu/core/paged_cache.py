@@ -35,8 +35,12 @@ Knobs (loud-parse like PFX_DECODE_BLOCK):
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Dict, List, Optional
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
 
 _DEFAULT_KV_BLOCK = 16
 
@@ -159,6 +163,123 @@ class BlockAllocator:
         purely a locality/telemetry nicety — correctness never depends
         on it."""
         self._free.sort()
+
+
+# ---------------------------------------------------------------------------
+# KV-handoff payload codec (disaggregated prefill/decode serving)
+#
+# A prefill replica exports one row's prefilled arena blocks + row state
+# as a single binary payload; the router hands it to a decode replica,
+# which adopts the blocks into its OWN arena and continues decoding
+# (docs/serving.md "Multi-host serving").  The format is a compact
+# header + raw buffers (no base64: handoff bytes are a measured metric):
+#
+#   magic "PFXH1" | uint32 header length | JSON header | raw array bytes
+#
+# The header's "meta" block carries the row state (prompt ids, lengths,
+# decode budget) plus the COMPATIBILITY SIGNATURE (block size, kv dtype,
+# pool shape) that `check_handoff_meta` validates loudly on the adopting
+# side — a dtype or block-size mismatch must never scatter garbage into
+# a live arena.  Arrays are listed in header order with dtype + shape;
+# int8 arenas ship their per-(slot, head) scale planes as extra arrays.
+# ---------------------------------------------------------------------------
+
+HANDOFF_MAGIC = b"PFXH1"
+
+
+def pack_handoff(meta: Dict[str, Any],
+                 arrays: Dict[str, np.ndarray]) -> bytes:
+    """Serialize (meta, named arrays) into one handoff payload.  Arrays
+    are C-contiguous raw bytes; the header records name/dtype/shape in
+    order, so `unpack_handoff` round-trips BIT-exactly."""
+    specs = []
+    chunks = []
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        specs.append(
+            {"name": name, "dtype": a.dtype.str, "shape": list(a.shape)}
+        )
+        chunks.append(a.tobytes())
+    header = json.dumps(
+        {"meta": meta, "arrays": specs}, separators=(",", ":")
+    ).encode()
+    return b"".join(
+        [HANDOFF_MAGIC, struct.pack("<I", len(header)), header, *chunks]
+    )
+
+
+def unpack_handoff(data: bytes) -> Tuple[Dict[str, Any],
+                                         Dict[str, np.ndarray]]:
+    """Parse a handoff payload back into (meta, arrays).  LOUD on a bad
+    magic, a truncated header, or a byte count that does not match the
+    declared dtypes/shapes — a torn payload must never be adopted."""
+    if data[:5] != HANDOFF_MAGIC:
+        raise ValueError(
+            f"not a KV-handoff payload (magic {data[:5]!r}, "
+            f"want {HANDOFF_MAGIC!r})"
+        )
+    if len(data) < 9:
+        raise ValueError("truncated KV-handoff payload (no header length)")
+    (hlen,) = struct.unpack("<I", data[5:9])
+    if len(data) < 9 + hlen:
+        raise ValueError(
+            f"truncated KV-handoff payload (header wants {hlen} bytes, "
+            f"{len(data) - 9} present)"
+        )
+    try:
+        header = json.loads(data[9:9 + hlen])
+    except json.JSONDecodeError as e:
+        raise ValueError(f"corrupt KV-handoff header: {e}") from None
+    arrays: Dict[str, np.ndarray] = {}
+    off = 9 + hlen
+    for spec in header["arrays"]:
+        dt = np.dtype(spec["dtype"])
+        shape = tuple(int(s) for s in spec["shape"])
+        nbytes = dt.itemsize * int(np.prod(shape, dtype=np.int64))
+        if off + nbytes > len(data):
+            raise ValueError(
+                f"truncated KV-handoff payload: array {spec['name']!r} "
+                f"wants {nbytes} bytes past offset {off}, "
+                f"{len(data) - off} present"
+            )
+        arrays[spec["name"]] = np.frombuffer(
+            data, dtype=dt, count=nbytes // dt.itemsize, offset=off
+        ).reshape(shape)
+        off += nbytes
+    if off != len(data):
+        raise ValueError(
+            f"KV-handoff payload has {len(data) - off} trailing bytes "
+            "past the declared arrays"
+        )
+    return header["meta"], arrays
+
+
+def check_handoff_meta(meta: Dict[str, Any], *, block: int, kv_dtype: str,
+                       pool_sig: List[int]) -> None:
+    """Validate a payload's compatibility signature against the adopting
+    arena — LOUD, naming every mismatch.  ``pool_sig`` is
+    [layers, heads, block, head_dim] (the arena shape minus the
+    num_blocks dim, which may legitimately differ between replicas)."""
+    problems = []
+    if int(meta.get("block", -1)) != int(block):
+        problems.append(
+            f"block size {meta.get('block')} != arena block {block}"
+        )
+    if str(meta.get("kv_dtype", "")) != str(kv_dtype):
+        problems.append(
+            f"kv dtype {meta.get('kv_dtype')!r} != arena dtype {kv_dtype!r}"
+        )
+    if [int(x) for x in meta.get("pool_sig", [])] != [int(x) for x in pool_sig]:
+        problems.append(
+            f"pool shape {meta.get('pool_sig')} != arena {list(pool_sig)}"
+        )
+    if problems:
+        raise ValueError(
+            "KV-handoff payload incompatible with this arena: "
+            + "; ".join(problems)
+            + " (prefill and decode replicas must share Model config, "
+            "PFX_KV_BLOCK, and kv_dtype)"
+        )
 
 
 class PagedCacheManager:

@@ -1,0 +1,674 @@
+"""Multi-host serving router: N model replicas behind one front door,
+queue-aware load balancing, replica lifecycle management, rolling
+drains, and the disaggregated prefill/decode dispatch.
+
+One `tools/serve.py` process serves one host.  Scaling past it is pure
+host-side composition of contracts that already exist (docs/serving.md
+"Multi-host serving"):
+
+  - **admission** stays the RequestQueue surface: the router bounds its
+    own in-flight work (`QueueFull` -> HTTP 429, `QueueClosed` while
+    draining -> 503) and checks deadlines BEFORE dispatching, so
+    backpressure reaches clients at the front door instead of piling
+    onto a replica's queue.
+  - **replica lifecycle** is a small state machine fed by /healthz
+    polls: ``booting`` (never answered) -> ``warm`` (answered, building
+    trust) -> ``serving`` (eligible for traffic) -> ``draining``
+    (SIGTERM sent or self-reported; no new traffic) -> ``gone``
+    (exited, or ejected after consecutive poll failures).  A degraded
+    replica (watchdog-tripped ``ok: false``) stays ``serving`` but is
+    ineligible until it recovers — the PR 3 watchdog contract, read
+    remotely.
+  - **scoring** is queue-depth/deadline-aware least-loaded: eligible
+    replicas are ranked by ``reported queue depth + router in-flight``,
+    and a replica whose estimated wait (backlog x its recent per-request
+    latency, plus any in-progress decode) exceeds the request's
+    remaining deadline is penalized to last resort — a request with 2s
+    left never waits behind a 30s backlog while an idle replica sits by.
+  - **retry** is bounded and ONLY for connection-refused (the request
+    never reached a process): anything after bytes were exchanged —
+    a reset mid-response, a read timeout — returns an honest 503 and is
+    never replayed, because the decode may have happened (the
+    "never retry partial responses" rule).
+  - **rolling drain** rides the PR 3 SIGTERM contract end-to-end:
+    `drain()` marks the replica ineligible, signals its pid (from the
+    /healthz ``identity`` block — same-host deploys), and the poller
+    walks it draining -> gone as it answers its admitted work and exits
+    0.  Drain one, redeploy, wait ``serving``, drain the next: that is
+    the whole rolling deploy (runbook in docs/serving.md).
+  - **disaggregation**: with separate ``prefill`` and ``decode`` pools,
+    `generate_disaggregated` runs each prompt's prefill on a prefill
+    replica (-> KV-handoff payload, `core/paged_cache.pack_handoff`),
+    hands the payload to a decode replica that adopts the blocks into
+    its own arena, and returns the continued decode — greedy output
+    token-identical to the single-process continuous path (drilled).
+
+Observability: per-replica depth/state gauges, dispatch outcome
+counters, handoff bytes + seconds, poll failures — all ``pfx_router_*``
+in THE ONE telemetry.METRICS table; sampled requests carry a trace
+whose timeline records every routing decision (replica picked, score,
+retries) for ``GET /debug/traces``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import urlsplit
+
+from paddlefleetx_tpu.core.request_queue import QueueClosed, QueueFull
+from paddlefleetx_tpu.utils.log import logger
+from paddlefleetx_tpu.utils.telemetry import get_registry
+
+REPLICA_STATES = ("booting", "warm", "serving", "draining", "gone")
+STATE_CODE = {s: i for i, s in enumerate(REPLICA_STATES)}
+
+
+class NoReplicaAvailable(RuntimeError):
+    """No eligible replica for the requested role (HTTP 503)."""
+
+
+class ReplicaUnavailable(RuntimeError):
+    """Dispatch failed after bytes may have been exchanged — honest 503,
+    NEVER retried on another replica (the decode may have happened)."""
+
+
+@dataclasses.dataclass
+class Replica:
+    """One backend replica as the router sees it."""
+
+    key: str               # router-assigned stable id (r0, r1, ...)
+    url: str               # base URL, e.g. http://127.0.0.1:8001
+    role: str = "monolith"  # monolith | prefill | decode (configured pool)
+    state: str = "booting"
+    # from the /healthz identity block (tools/serve.py)
+    replica_id: Optional[str] = None
+    pid: Optional[int] = None
+    scheduler: Optional[str] = None
+    # last poll view
+    healthy: bool = False   # healthz ok (False while degraded)
+    depth: int = 0
+    busy_s: float = 0.0
+    last_poll: float = 0.0
+    ok_streak: int = 0
+    failures: int = 0
+    role_mismatch: bool = False
+    drain_requested: bool = False
+    # router-side live accounting
+    in_flight: int = 0
+    last_latency_s: float = 0.05
+
+    def eligible(self) -> bool:
+        return (self.state == "serving" and self.healthy
+                and not self.drain_requested and not self.role_mismatch)
+
+    def view(self) -> Dict[str, Any]:
+        """Operator JSON for GET /replicas (no secrets, no prompt data)."""
+        return {
+            "key": self.key,
+            "url": self.url,
+            "role": self.role,
+            "state": self.state,
+            "replica_id": self.replica_id,
+            "pid": self.pid,
+            "scheduler": self.scheduler,
+            "healthy": self.healthy,
+            "eligible": self.eligible(),
+            "depth": self.depth,
+            "busy_s": round(self.busy_s, 3),
+            "in_flight": self.in_flight,
+            "last_latency_s": round(self.last_latency_s, 4),
+            "failures": self.failures,
+            "role_mismatch": self.role_mismatch,
+            "draining": self.drain_requested or self.state == "draining",
+        }
+
+
+def _http_request(base_url: str, method: str, path: str, body=None,
+                  headers=None, timeout: float = 30.0
+                  ) -> Tuple[int, bytes, str]:
+    """One downstream HTTP exchange.  ``ConnectionRefusedError``
+    propagates untouched (the retryable class: no process listened, so
+    nothing was processed); every other transport failure raises
+    :class:`ReplicaUnavailable` (bytes may have been exchanged — never
+    replay)."""
+    u = urlsplit(base_url)
+    conn = http.client.HTTPConnection(
+        u.hostname, u.port or 80, timeout=timeout
+    )
+    try:
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+        except ConnectionRefusedError:
+            raise
+        except OSError as e:
+            # DNS failure / unreachable before the request line went out
+            # behaves like refused for routing purposes
+            if isinstance(e, ConnectionError) or getattr(e, "errno", None) in (
+                111, 113,  # ECONNREFUSED, EHOSTUNREACH
+            ):
+                raise ConnectionRefusedError(str(e)) from e
+            raise ReplicaUnavailable(f"send failed: {e}") from e
+        try:
+            resp = conn.getresponse()
+            data = resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            raise ReplicaUnavailable(
+                f"reply lost mid-request ({type(e).__name__}: {e}); "
+                "not retried — the decode may have run"
+            ) from e
+        return (resp.status, data,
+                resp.getheader("Content-Type") or "application/json")
+    finally:
+        conn.close()
+
+
+class RouterCore:
+    """The transport-independent router: replica registry + health
+    poller + admission + scored dispatch (tools/router.py is the HTTP
+    skin).  ``replicas`` is a list of (url, role) pairs; roles partition
+    into pools, and `pick` draws from one pool."""
+
+    def __init__(self, replicas: Sequence[Tuple[str, str]], *,
+                 max_inflight: int = 64, retries: int = 2,
+                 poll_interval_s: float = 0.5, poll_timeout_s: float = 2.0,
+                 eject_after: int = 3, serve_after: int = 1,
+                 name: str = "router") -> None:
+        if not replicas:
+            raise ValueError("router needs >= 1 replica")
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        self.name = name
+        self.retries = int(retries)
+        self.max_inflight = int(max_inflight)
+        self.poll_interval_s = float(poll_interval_s)
+        self.poll_timeout_s = float(poll_timeout_s)
+        self.eject_after = int(eject_after)
+        self.serve_after = max(1, int(serve_after))
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._closed = False
+        self._in_flight_total = 0
+        self._stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        self._rr = 0  # round-robin tiebreak cursor
+        self.replicas: Dict[str, Replica] = {}
+        for i, (url, role) in enumerate(replicas):
+            if role not in ("monolith", "prefill", "decode"):
+                raise ValueError(
+                    f"unknown replica role {role!r}; "
+                    "valid: monolith, prefill, decode"
+                )
+            self.replicas[f"r{i}"] = Replica(
+                key=f"r{i}", url=url.rstrip("/"), role=role
+            )
+        roles = {r.role for r in self.replicas.values()}
+        if "monolith" in roles and roles != {"monolith"}:
+            raise ValueError(
+                "mixing monolith replicas with prefill/decode pools is not "
+                "supported; run either --replica or --prefill/--decode"
+            )
+        if roles != {"monolith"} and not (
+            "prefill" in roles and "decode" in roles
+        ):
+            raise ValueError(
+                "disaggregated mode needs BOTH --prefill and --decode "
+                f"replicas (got roles {sorted(roles)})"
+            )
+        self.disaggregated = roles != {"monolith"}
+        reg = get_registry()
+        self._requests = lambda replica, outcome: reg.counter(
+            "pfx_router_requests_total", replica=replica, outcome=outcome
+        )
+        self._retries_ctr = reg.counter("pfx_router_retries_total")
+        self._drains_ctr = reg.counter("pfx_router_drains_total")
+        self._handoff_bytes = reg.counter("pfx_router_handoff_bytes_total")
+        self._handoff_hist = reg.histogram("pfx_router_handoff_seconds")
+        reg.register_collector(self)
+
+    # -- telemetry ------------------------------------------------------
+    def collect(self):
+        with self._lock:
+            rows = [("pfx_router_in_flight", {},
+                     float(self._in_flight_total))]
+            for key, r in self.replicas.items():
+                rows.append(("pfx_router_replica_depth", {"replica": key},
+                             float(r.depth)))
+                rows.append(("pfx_router_replica_state", {"replica": key},
+                             float(STATE_CODE[r.state])))
+        return rows
+
+    # -- health polling + lifecycle -------------------------------------
+    def poll_replica(self, r: Replica) -> None:
+        """One /healthz poll, driving the state machine (called by the
+        poll loop; tests call it directly for determinism)."""
+        try:
+            status, body, _ = _http_request(
+                r.url, "GET", "/healthz", timeout=self.poll_timeout_s
+            )
+            if status != 200:
+                raise ReplicaUnavailable(f"/healthz returned {status}")
+            h = json.loads(body)
+        except Exception as exc:  # noqa: BLE001 — any failed poll counts
+            with self._lock:
+                r.failures += 1
+                r.ok_streak = 0
+                r.last_poll = time.monotonic()
+                refused = isinstance(exc, ConnectionRefusedError)
+                if r.state == "draining" and refused:
+                    # the drained process exited: clean end of life
+                    self._transition(r, "gone", "drained and exited")
+                elif r.failures >= self.eject_after and r.state != "gone":
+                    self._transition(
+                        r, "gone",
+                        f"ejected after {r.failures} failed polls "
+                        f"({type(exc).__name__})",
+                    )
+            get_registry().counter(
+                "pfx_router_poll_failures_total", replica=r.key
+            ).inc()
+            return
+        with self._lock:
+            r.failures = 0
+            r.last_poll = time.monotonic()
+            r.healthy = bool(h.get("ok", False))
+            r.depth = int(h.get("queue_depth", 0))
+            r.busy_s = float(h.get("busy_s", 0.0))
+            ident = h.get("identity") or {}
+            old_pid = r.pid
+            if ident:
+                r.replica_id = ident.get("replica_id", r.replica_id)
+                r.pid = ident.get("pid", r.pid)
+                r.scheduler = ident.get("scheduler", r.scheduler)
+                reported = ident.get("role")
+                if reported and reported != r.role and not r.role_mismatch:
+                    # a decode replica in the prefill pool would 404 every
+                    # dispatch: refuse to route rather than half-work
+                    r.role_mismatch = True
+                    logger.warning(
+                        f"{self.name}: {r.key} reports role "
+                        f"{reported!r} but is configured {r.role!r}; "
+                        "marked ineligible"
+                    )
+            if r.drain_requested and (
+                r.state == "gone"
+                or (old_pid is not None and r.pid is not None
+                    and r.pid != old_pid)
+            ):
+                # a REDEPLOYED process answered on the drained replica's
+                # url (we saw it reach gone, or the pid changed): the
+                # drain is complete for the OLD process — clearing the
+                # flag lets the new one re-enter via warm -> serving,
+                # which is the whole rolling-deploy recipe
+                r.drain_requested = False
+                logger.info(
+                    f"{self.name}: replica {r.key} redeployed "
+                    f"(pid {r.pid}); drain flag cleared"
+                )
+            if h.get("state") == "draining" or r.drain_requested:
+                if r.state not in ("draining", "gone"):
+                    self._transition(r, "draining", "replica drain observed")
+                r.ok_streak = 0
+                return
+            r.ok_streak = r.ok_streak + 1 if r.healthy else 0
+            if r.state in ("booting", "gone"):
+                self._transition(r, "warm", "healthz answered")
+            if r.state == "warm" and r.ok_streak >= self.serve_after:
+                self._transition(r, "serving", "health streak met")
+
+    def _transition(self, r: Replica, state: str, why: str) -> None:
+        # caller holds the lock
+        if r.state != state:
+            logger.info(
+                f"{self.name}: replica {r.key} ({r.url}) "
+                f"{r.state} -> {state}: {why}"
+            )
+            r.state = state
+
+    def _poll_loop(self) -> None:
+        # gone replicas keep getting polled (cheap): a redeployed process
+        # on the same url re-enters the rotation via warm -> serving
+        while not self._stop.wait(self.poll_interval_s):
+            for r in list(self.replicas.values()):
+                self.poll_replica(r)
+
+    def start(self) -> "RouterCore":
+        if self._poll_thread is None or not self._poll_thread.is_alive():
+            # first sweep synchronously: the front door opens with a view
+            for r in self.replicas.values():
+                self.poll_replica(r)
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, name=f"{self.name}-poll", daemon=True
+            )
+            self._poll_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5)
+
+    # -- admission (the RequestQueue surface, router-level) -------------
+    def acquire(self) -> None:
+        """Admit one request into the router.  ``QueueFull`` -> 429,
+        ``QueueClosed`` (draining) -> 503 — the PR 3 admission contract
+        applied at the front door.
+
+        LOCK ORDER: the registry snapshot holds the registry lock while
+        calling :meth:`collect` (which takes ``self._lock``), so nothing
+        here may touch the registry while holding ``self._lock`` — the
+        rejection counters are bumped AFTER release or a concurrent
+        /metrics scrape deadlocks the router."""
+        reason = None
+        with self._lock:
+            if self._closed:
+                reason = "draining"
+            elif self._in_flight_total >= self.max_inflight:
+                reason = "full"
+            else:
+                self._in_flight_total += 1
+        if reason is not None:
+            get_registry().counter(
+                "pfx_router_rejected_total", reason=reason
+            ).inc()
+            if reason == "draining":
+                raise QueueClosed(f"{self.name} is draining")
+            raise QueueFull(
+                f"{self.name} at capacity ({self.max_inflight} in flight)"
+            )
+
+    def release(self) -> None:
+        with self._idle:
+            self._in_flight_total -= 1
+            if self._in_flight_total == 0:
+                self._idle.notify_all()
+
+    def close(self) -> None:
+        """Stop admitting (drain): in-flight requests finish."""
+        with self._lock:
+            self._closed = True
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every admitted request has left the router."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._idle:
+            while self._in_flight_total > 0:
+                left = (None if deadline is None
+                        else max(0.0, deadline - time.monotonic()))
+                if left == 0.0:
+                    return False
+                self._idle.wait(left)
+        return True
+
+    def depth(self) -> int:
+        with self._lock:
+            return self._in_flight_total
+
+    # -- scoring + dispatch ---------------------------------------------
+    def _score(self, r: Replica, remaining_s: float) -> float:
+        """Queue-depth/deadline-aware least-loaded score (lower wins):
+        base = reported depth + router-side in-flight; a replica whose
+        estimated wait (backlog x recent per-request latency + the
+        in-progress decode's age) exceeds the request's remaining
+        deadline is pushed to last resort."""
+        backlog = r.depth + r.in_flight
+        est_wait = backlog * max(r.last_latency_s, 0.01) + min(r.busy_s, 60.0)
+        score = float(backlog)
+        if remaining_s > 0 and est_wait > remaining_s:
+            score += 1e6  # only if every replica is past the deadline
+        return score
+
+    def pick(self, role: str, remaining_s: float,
+             exclude: Optional[set] = None) -> Replica:
+        """The routing decision: least-loaded eligible replica of the
+        pool (round-robin tiebreak).  Raises :class:`NoReplicaAvailable`
+        when the pool has no eligible member."""
+        with self._lock:
+            pool = [
+                r for r in self.replicas.values()
+                if r.role == role and r.eligible()
+                and (not exclude or r.key not in exclude)
+            ]
+            if not pool:
+                raise NoReplicaAvailable(
+                    f"no eligible {role} replica "
+                    f"({len(self.replicas)} configured)"
+                )
+            self._rr += 1
+            rr = self._rr
+            best = min(
+                enumerate(pool),
+                key=lambda ir: (
+                    self._score(ir[1], remaining_s),
+                    (ir[0] + rr) % len(pool),
+                ),
+            )[1]
+            best.in_flight += 1
+            return best
+
+    def dispatch(self, method: str, path: str, body: Optional[bytes], *,
+                 role: str, deadline_s: float, headers=None,
+                 trace=None) -> Tuple[int, bytes, str]:
+        """Route one request: pick -> forward -> account.  Bounded retry
+        on ANOTHER replica only for connection-refused (never after a
+        partial exchange); every attempt's routing decision lands on the
+        request's trace.  Raises :class:`NoReplicaAvailable` /
+        :class:`ReplicaUnavailable` for the transport layer to turn into
+        503."""
+        deadline_abs = time.monotonic() + float(deadline_s)
+        tried: set = set()
+        attempt = 0
+        while True:
+            remaining = deadline_abs - time.monotonic()
+            if remaining <= 0:
+                raise ReplicaUnavailable(
+                    f"deadline {deadline_s:g}s exhausted before dispatch"
+                )
+            try:
+                r = self.pick(role, remaining, exclude=tried)
+            except NoReplicaAvailable:
+                if tried:
+                    raise NoReplicaAvailable(
+                        f"no eligible {role} replica left after "
+                        f"{len(tried)} refused attempt(s) "
+                        f"(tried {sorted(tried)})"
+                    ) from None
+                raise
+            if trace is not None:
+                trace.event(
+                    "route", replica=r.key, role=role, depth=r.depth,
+                    in_flight=r.in_flight, attempt=attempt,
+                )
+            t0 = time.monotonic()
+            try:
+                status, data, ctype = _http_request(
+                    r.url, method, path, body=body, headers=headers,
+                    timeout=remaining + 5.0,
+                )
+            except ConnectionRefusedError:
+                with self._lock:
+                    r.in_flight -= 1
+                    r.failures += 1
+                    # refuse NOW rather than waiting eject_after polls:
+                    # nothing listens on that port
+                    if r.state not in ("gone", "draining"):
+                        self._transition(
+                            r, "gone", "connection refused on dispatch"
+                        )
+                self._requests(r.key, "refused").inc()
+                tried.add(r.key)
+                if attempt < self.retries:
+                    attempt += 1
+                    self._retries_ctr.inc()
+                    if trace is not None:
+                        trace.event("retry", replica=r.key, attempt=attempt)
+                    continue
+                raise NoReplicaAvailable(
+                    f"all {role} dispatch attempts refused "
+                    f"(tried {sorted(tried)})"
+                ) from None
+            except ReplicaUnavailable:
+                with self._lock:
+                    r.in_flight -= 1
+                self._requests(r.key, "lost").inc()
+                raise
+            dt = time.monotonic() - t0
+            with self._lock:
+                r.in_flight -= 1
+                r.last_latency_s = dt
+            get_registry().histogram(
+                "pfx_router_replica_latency_seconds", replica=r.key
+            ).observe(dt)
+            self._requests(r.key, str(status)).inc()
+            if trace is not None:
+                trace.event("routed", replica=r.key, code=status,
+                            seconds=round(dt, 4))
+            return status, data, ctype
+
+    # -- disaggregated prefill -> decode --------------------------------
+    def _handoff_one(self, prompt: List[int], max_tokens: Optional[int],
+                     deadline_abs: float, deadline_s: float,
+                     trace=None) -> List[int]:
+        """One prompt's prefill -> handoff -> decode chain."""
+        remaining = deadline_abs - time.monotonic()
+        if remaining <= 0:
+            raise ReplicaUnavailable(
+                f"deadline {deadline_s:g}s exhausted mid-request"
+            )
+        t0 = time.monotonic()
+        req: Dict[str, Any] = {
+            "prompt_ids": prompt, "deadline_s": remaining,
+        }
+        if max_tokens is not None:
+            # omitted -> the replica's configured default decides
+            req["max_tokens"] = int(max_tokens)
+        status, payload, _ = self.dispatch(
+            "POST", "/prefill", json.dumps(req).encode(),
+            role="prefill", deadline_s=remaining,
+            headers={"Content-Type": "application/json"}, trace=trace,
+        )
+        if status != 200:
+            raise _DownstreamError(status, payload)
+        self._handoff_bytes.inc(len(payload))
+        self._handoff_hist.observe(time.monotonic() - t0)
+        if trace is not None:
+            trace.event("handoff", bytes=len(payload))
+        remaining = deadline_abs - time.monotonic()
+        if remaining <= 0:
+            raise ReplicaUnavailable(
+                f"deadline {deadline_s:g}s exhausted after prefill"
+            )
+        status, body, _ = self.dispatch(
+            "POST", f"/decode?deadline_s={remaining:.3f}", payload,
+            role="decode", deadline_s=remaining,
+            headers={"Content-Type": "application/octet-stream"},
+            trace=trace,
+        )
+        if status != 200:
+            raise _DownstreamError(status, body)
+        return json.loads(body)["completion_ids"]
+
+    def generate_disaggregated(self, prompts_ids: List[List[int]],
+                               max_tokens: Optional[int], deadline_s: float,
+                               trace=None) -> List[List[int]]:
+        """Serve one request through the split pools: per prompt, a
+        prefill replica exports the KV-handoff payload and a decode
+        replica adopts it and decodes.  A plural request runs its
+        prompts' chains CONCURRENTLY (the decode replica batches the
+        rows at its own step boundaries anyway — serializing here would
+        regress plural latency linearly in prompt count).  Raises
+        :class:`_DownstreamError` carrying the downstream (status, body)
+        on a non-200 leg."""
+        deadline_abs = time.monotonic() + float(deadline_s)
+        if len(prompts_ids) == 1:
+            return [self._handoff_one(
+                prompts_ids[0], max_tokens, deadline_abs, deadline_s,
+                trace=trace,
+            )]
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=min(len(prompts_ids), 8),
+            thread_name_prefix=f"{self.name}-handoff",
+        ) as pool:
+            futs = [
+                pool.submit(self._handoff_one, p, max_tokens,
+                            deadline_abs, deadline_s, trace)
+                for p in prompts_ids
+            ]
+            return [f.result() for f in futs]
+
+    # -- rolling drain ---------------------------------------------------
+    def drain(self, replica_key: Optional[str] = None) -> Dict[str, Any]:
+        """Initiate a drain-one-replica deploy step: mark the replica
+        ineligible (no new traffic), send SIGTERM to its pid (from the
+        /healthz identity block — same-host topology), and let the PR 3
+        drain contract finish its admitted work and exit 0; the poller
+        then walks it draining -> gone.  Picks the least-loaded serving
+        replica when none is named.  Raises ValueError when the target
+        does not exist / is already gone / never reported a pid."""
+        with self._lock:
+            if replica_key is None:
+                candidates = [
+                    r for r in self.replicas.values()
+                    if r.state == "serving" and not r.drain_requested
+                ]
+                if not candidates:
+                    raise ValueError("no serving replica left to drain")
+                target = min(candidates,
+                             key=lambda r: r.depth + r.in_flight)
+            else:
+                target = None
+                for r in self.replicas.values():
+                    if replica_key in (r.key, r.replica_id):
+                        target = r
+                        break
+                if target is None:
+                    raise ValueError(
+                        f"unknown replica {replica_key!r} "
+                        f"(known: {sorted(self.replicas)})"
+                    )
+            if target.state == "gone":
+                raise ValueError(f"replica {target.key} is already gone")
+            if target.pid is None:
+                raise ValueError(
+                    f"replica {target.key} never reported a pid via its "
+                    "/healthz identity block; cannot signal it"
+                )
+            target.drain_requested = True
+            self._transition(target, "draining", "drain requested")
+            pid = target.pid
+            key = target.key
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            with self._lock:
+                self._transition(target, "gone", "pid already exited")
+        self._drains_ctr.inc()
+        logger.info(f"{self.name}: drain initiated for {key} (pid {pid})")
+        return {"replica": key, "pid": pid, "state": target.state}
+
+    # -- views -----------------------------------------------------------
+    def replica_views(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.view() for r in self.replicas.values()]
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return {k: r.state for k, r in self.replicas.items()}
+
+
+class _DownstreamError(RuntimeError):
+    """A non-200 from a prefill/decode leg, propagated verbatim so the
+    front door can hand the client the replica's own status + error."""
+
+    def __init__(self, status: int, body: bytes) -> None:
+        super().__init__(f"downstream {status}")
+        self.status = int(status)
+        self.body = bytes(body)

@@ -1034,6 +1034,58 @@ def paged_prefill(
     return PagedPools(k_pool, v_pool), last, counts
 
 
+def gather_kv_blocks(pools: PagedPools, table) -> Dict[str, "np.ndarray"]:
+    """Copy one row's arena blocks to host for the KV-handoff payload:
+    ``{"k", "v"[, "k_scale", "v_scale"]}`` with k/v shaped
+    [layers, len(table), heads, block, dim] in the ARENA dtype (int8
+    blocks ship with their per-(slot, head) scale planes — the decode
+    replica adopts the quantized values bit-exactly instead of paying a
+    second quantization error).  Host-side indexing, not a jit: handoff
+    happens once per request at the prefill/decode boundary, never on
+    the per-token hot path."""
+    import numpy as np
+
+    idx = jnp.asarray(table, jnp.int32)
+    out = {"k": np.asarray(pools.k[:, idx]), "v": np.asarray(pools.v[:, idx])}
+    if pools.k_scale is not None:
+        out["k_scale"] = np.asarray(pools.k_scale[:, idx])
+        out["v_scale"] = np.asarray(pools.v_scale[:, idx])
+    return out
+
+
+def scatter_kv_blocks(pools: PagedPools, table, blocks) -> PagedPools:
+    """Adopt exported blocks into this arena at ``table`` (the adopting
+    row's first ``len(table)`` allocated blocks).  The caller validates
+    compatibility first (`core/paged_cache.check_handoff_meta`); this
+    helper still refuses a dtype or per-block-shape mismatch loudly —
+    scattering mistyped bytes would corrupt a live arena."""
+    want = {"k", "v"} | (
+        {"k_scale", "v_scale"} if pools.k_scale is not None else set()
+    )
+    if set(blocks) != want:
+        raise ValueError(
+            f"handoff arrays {sorted(blocks)} != arena arrays {sorted(want)}"
+        )
+    idx = jnp.asarray(table, jnp.int32)
+    new = {}
+    for name in sorted(want):
+        pool = getattr(pools, name)
+        arr = blocks[name]
+        if str(arr.dtype) != str(pool.dtype):
+            raise ValueError(
+                f"handoff {name} dtype {arr.dtype} != arena {pool.dtype}"
+            )
+        if tuple(arr.shape) != (pool.shape[0], len(table)) + pool.shape[2:]:
+            raise ValueError(
+                f"handoff {name} shape {tuple(arr.shape)} does not cover "
+                f"{len(table)} blocks of arena {tuple(pool.shape)}"
+            )
+        new[name] = pool.at[:, idx].set(jnp.asarray(arr))
+    return PagedPools(
+        new["k"], new["v"], new.get("k_scale"), new.get("v_scale")
+    )
+
+
 def process_step_logits(logits, steps, counts, forced_steps, gen):
     """THE per-step logits-processor chain (min-length -> repetition
     penalty -> forced BOS/EOS), shape-agnostic: ``logits`` [..., v] with

@@ -159,6 +159,21 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_profiler_trace_seconds": ("gauge", "Wall seconds of the last trace window"),
     # deep-dive tracing (utils/tracing.py)
     "pfx_trace_sampled_total": ("counter", "Requests/runs sampled into the trace buffer"),
+    # disaggregated KV handoff (core/continuous_batching.py replica side)
+    "pfx_handoff_exports_total": ("counter", "Prefilled rows exported as KV-handoff payloads (prefill replica)"),
+    "pfx_handoff_adopts_total": ("counter", "KV-handoff payloads adopted into the arena (decode replica)"),
+    # multi-host router (core/router.py + tools/router.py; labels noted)
+    "pfx_router_requests_total": ("counter", "Requests dispatched by the router (labels: replica, outcome)"),
+    "pfx_router_rejected_total": ("counter", "Router admissions rejected before dispatch (labels: reason)"),
+    "pfx_router_retries_total": ("counter", "Dispatches retried on another replica after connection-refused"),
+    "pfx_router_in_flight": ("gauge", "Requests currently inside the router"),
+    "pfx_router_replica_depth": ("gauge", "Queue depth last reported by the replica /healthz (labels: replica)"),
+    "pfx_router_replica_state": ("gauge", "Replica lifecycle state code: 0 booting, 1 warm, 2 serving, 3 draining, 4 gone (labels: replica)"),
+    "pfx_router_replica_latency_seconds": ("histogram", "Downstream dispatch latency (labels: replica)"),
+    "pfx_router_poll_failures_total": ("counter", "Failed replica health polls (labels: replica)"),
+    "pfx_router_drains_total": ("counter", "Replica drains initiated through the router"),
+    "pfx_router_handoff_bytes_total": ("counter", "KV-handoff payload bytes moved prefill -> decode"),
+    "pfx_router_handoff_seconds": ("histogram", "Prefill dispatch + handoff transfer seconds per prompt"),
     # SLO burn rates (telemetry.SLOTracker; labels: objective, window)
     "pfx_slo_objective": ("gauge", "Configured SLO objective value by objective label"),
     "pfx_slo_burn_rate": ("gauge", "Error-budget burn rate over a rolling window (labels: objective, window)"),

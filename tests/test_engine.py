@@ -110,6 +110,10 @@ def test_layout_loss_parity_first_step(tmp_path, devices8):
         np.testing.assert_allclose(ls, base, rtol=2e-4, err_msg=name)
 
 
+@pytest.mark.slow  # ~15s engine boot; the bf16 precision family stays
+# tier-1 via test_multi_precision_off_bf16_params_train (the sibling
+# bf16 contract) and the fp16 loss-scaling pair; still in make test-mid
+# / test-all (PR 8 tier-1 budget convention)
 def test_main_grad_off_bf16_grads_train(tmp_path, devices8):
     """mix_precision.main_grad=False (bf16 grads, the 1.3B-fit lever):
     still trains, and tracks the fp32-main-grad bf16 run closely."""

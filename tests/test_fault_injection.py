@@ -99,6 +99,12 @@ def ref_stream(corpus, tmp_path_factory):
     return stream
 
 
+@pytest.mark.slow  # ~25s two full CLI runs; the preemption contract
+# stays tier-1 via test_fault_tolerance's in-process units (SIGTERM
+# finishes the step, writes the `preempted` marker, resumes at step+1)
+# and the nan-rollback CLI drill keeps a crash-resume parity path
+# drilled; this flagship parity drill still runs in make test-fault /
+# test-all (PR 8 tier-1 budget convention)
 def test_sigterm_preempt_resume_parity(corpus, ref_stream, tmp_path):
     """Injected SIGTERM at step 3: run 1 checkpoints (preempted marker) and
     exits 0; the relaunch resumes at step 4 and the full loss stream equals

@@ -391,6 +391,13 @@ def test_engine_exit_after_save(tmp_path, devices8):
     )
 
 
+@pytest.mark.slow  # ~19s engine boot; anomaly rollback stays
+# tier-1-drilled through the real CLI by BOTH
+# test_fault_injection.py::test_nan_rollback_rewind_replay_parity and
+# test_model_stats.py::test_nan_rollback_drill_names_group_in_event_flight_and_report,
+# and the rollback skip-budget contract by
+# test_engine_rollback_restores_skip_budget; still in make test-fault /
+# test-all (PR 8 tier-1 budget convention)
 def test_engine_anomaly_rollback_reenters_loop(tmp_path, devices8, monkeypatch):
     """A NaN streak past the skip budget rolls params+opt-state back to the
     last checkpoint, emits a structured rollback event, and training

@@ -110,6 +110,10 @@ def test_glue_dataset_bert_style(tmp_path):
     }
 
 
+@pytest.mark.slow  # ~36s learning curve; the glue surface stays tier-1
+# via the dataset builders + every metric unit in this file, and the
+# finetune Engine path shares the GPT train step the engine suites
+# drill; still in make test-all (PR 8 tier-1 budget convention)
 def test_gpt_finetune_learns(tmp_path):
     """End-to-end: tiny GPT finetune on synthetic SST-2 via the Engine, with
     metric-streaming eval; accuracy must beat chance."""

@@ -1,0 +1,287 @@
+"""KV-handoff serialization + disaggregated prefill/decode adoption
+(`core/paged_cache.pack_handoff`/`unpack_handoff`,
+`PagedDecodeEngine.prefill_export`/`adopt`,
+`ContinuousScheduler.submit_handoff`).
+
+The acceptance contracts, in-process and deterministic:
+
+  - the payload codec round-trips BIT-exactly (bf16/native and int8 with
+    its scale planes) and is loud on truncation/corruption;
+  - an incompatible payload (block size, kv dtype, pool shape) is
+    rejected loudly BEFORE touching a live arena;
+  - export-on-one-engine -> adopt-on-another continues the decode
+    token-identically to a single-process `admit` (f32 exact) — the
+    multi-host disaggregation's parity spine (the subprocess drill in
+    tests/test_router_drills.py proves the same thing through the real
+    CLIs).
+"""
+
+import numpy as np
+import pytest
+
+TINY = {
+    "Global": {"global_batch_size": 8, "seed": 3},
+    "Engine": {"mix_precision": {"enable": False},
+               "save_load": {"save_steps": 0}},
+    "Model": {
+        "module": "GPTModule",
+        "vocab_size": 96,
+        "hidden_size": 32,
+        "num_layers": 2,
+        "num_attention_heads": 4,
+        "max_position_embeddings": 128,
+        "dtype": "float32",
+    },
+    "Distributed": {},
+    "Optimizer": {"name": "FusedAdamW",
+                  "lr": {"name": "Constant", "learning_rate": 1e-3}},
+    "Generation": {"max_dec_len": 8, "decode_strategy": "greedy_search",
+                   "pad_to_multiple": 16, "eos_token_id": 95,
+                   "pad_token_id": 0},
+}
+
+PROMPTS = [[1, 2, 3], [4, 5, 6, 7, 8], [9, 10], [11, 12, 13, 14]]
+
+
+@pytest.fixture(scope="module")
+def server():
+    import jax
+
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.core.serving import GenerationServer
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    cfg = AttrDict.from_nested(TINY)
+    cfg = process_configs(cfg, num_devices=jax.device_count())
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    return GenerationServer(cfg, mesh, module)
+
+
+def _engine(server, **kw):
+    from paddlefleetx_tpu.core.continuous_batching import PagedDecodeEngine
+
+    kw.setdefault("max_batch", 4)
+    return PagedDecodeEngine(server, **kw)
+
+
+def _drain(engine, max_steps=64):
+    for _ in range(max_steps):
+        engine.step()
+        if not engine.active.any():
+            return
+    raise AssertionError("engine never drained")
+
+
+@pytest.fixture(scope="module")
+def sequential(server):
+    """Reference outputs: each request served alone on the coalesce path."""
+    return [server.generate_ids([p], max_dec_len=6)[0] for p in PROMPTS]
+
+
+# ---------------------------------------------------------------------------
+# payload codec (pure host)
+# ---------------------------------------------------------------------------
+
+
+def test_pack_unpack_roundtrip_bit_exact():
+    from paddlefleetx_tpu.core.paged_cache import pack_handoff, unpack_handoff
+
+    rng = np.random.default_rng(0)
+    arrays = {
+        "k": rng.standard_normal((2, 3, 4, 16, 8)).astype(np.float32),
+        "v": rng.standard_normal((2, 3, 4, 16, 8)).astype(np.float32),
+        "k_scale": rng.standard_normal((2, 3, 4, 16)).astype(np.float32),
+        "q": rng.integers(-127, 128, (2, 3, 4, 16, 8)).astype(np.int8),
+        "logits": rng.standard_normal((96,)).astype(np.float32),
+        "counts": rng.integers(0, 5, (96,)).astype(np.int32),
+    }
+    meta = {"prompt_ids": [1, 2, 3], "prompt_len": 3, "max_new": 6,
+            "block": 16, "kv_dtype": "int8", "pool_sig": [2, 4, 16, 8]}
+    payload = pack_handoff(meta, arrays)
+    meta2, arrays2 = unpack_handoff(payload)
+    assert meta2 == meta
+    assert set(arrays2) == set(arrays)
+    for name, a in arrays.items():
+        assert arrays2[name].dtype == a.dtype, name
+        assert arrays2[name].shape == a.shape, name
+        # BIT-exact: the decode replica adopts the same bytes the prefill
+        # replica exported — quantized values never re-quantize
+        assert arrays2[name].tobytes() == a.tobytes(), name
+
+
+def test_unpack_rejects_corruption_loudly():
+    from paddlefleetx_tpu.core.paged_cache import pack_handoff, unpack_handoff
+
+    payload = pack_handoff(
+        {"block": 16}, {"k": np.ones((2, 2), np.float32)}
+    )
+    with pytest.raises(ValueError, match="magic"):
+        unpack_handoff(b"NOPE" + payload[4:])
+    with pytest.raises(ValueError, match="truncated"):
+        unpack_handoff(payload[:7])
+    with pytest.raises(ValueError, match="truncated"):
+        unpack_handoff(payload[:-3])  # torn array bytes
+    with pytest.raises(ValueError, match="trailing"):
+        unpack_handoff(payload + b"xx")
+
+
+def test_check_handoff_meta_names_every_mismatch():
+    from paddlefleetx_tpu.core.paged_cache import check_handoff_meta
+
+    meta = {"block": 16, "kv_dtype": "bf16", "pool_sig": [2, 4, 16, 8]}
+    check_handoff_meta(meta, block=16, kv_dtype="bf16",
+                       pool_sig=[2, 4, 16, 8])  # compatible: no raise
+    with pytest.raises(ValueError, match="block size 16 != arena block 32"):
+        check_handoff_meta(meta, block=32, kv_dtype="bf16",
+                           pool_sig=[2, 4, 16, 8])
+    with pytest.raises(ValueError, match="kv dtype"):
+        check_handoff_meta(meta, block=16, kv_dtype="int8",
+                           pool_sig=[2, 4, 16, 8])
+    with pytest.raises(ValueError, match="pool shape"):
+        check_handoff_meta(meta, block=16, kv_dtype="bf16",
+                           pool_sig=[4, 4, 16, 8])
+
+
+# ---------------------------------------------------------------------------
+# export -> adopt parity (the disaggregation spine)
+# ---------------------------------------------------------------------------
+
+
+def test_export_adopt_parity_native(server, sequential):
+    """Prefill on engine A, serialize, adopt on engine B (a separate
+    arena), decode to completion: token-identical to the sequential
+    reference, including adoptions landing MID-decode of other rows."""
+    from paddlefleetx_tpu.core.paged_cache import pack_handoff, unpack_handoff
+
+    exporter = _engine(server)
+    decoder = _engine(server)
+
+    def handoff(i):
+        meta, arrays = exporter.prefill_export(PROMPTS[i], 6)
+        # through the real payload bytes, not object handles
+        meta2, arrays2 = unpack_handoff(pack_handoff(meta, arrays))
+        return decoder.adopt(meta2, arrays2)
+
+    s0 = handoff(0)
+    s1 = handoff(1)
+    decoder.step()
+    decoder.step()
+    s2 = handoff(2)  # adopted mid-decode of rows 0/1
+    decoder.step()
+    s3 = handoff(3)
+    _drain(decoder)
+    got = [decoder.slots[s].tokens for s in (s0, s1, s2, s3)]
+    assert got == sequential
+    for s in (s0, s1, s2, s3):
+        decoder.release(s)
+    assert decoder.cache.stats()["kv_blocks_used"] == 0
+    # the exporter held blocks only for the duration of each export
+    assert exporter.cache.stats()["kv_blocks_used"] == 0
+    assert exporter.stats["exports"] == 4
+    assert decoder.stats["adopts"] == 4
+
+
+def test_export_adopt_int8_blocks_and_scales_bit_exact(server):
+    """An int8 arena's handoff ships the quantized blocks AND their
+    per-(slot, head) scale planes; gathering the adopted row back out of
+    the decode arena reproduces the payload bit-for-bit (no second
+    quantization), and the continued decode matches the single-process
+    int8 engine token-for-token."""
+    from paddlefleetx_tpu.core.paged_cache import (
+        blocks_for,
+        pack_handoff,
+        unpack_handoff,
+    )
+    from paddlefleetx_tpu.models.gpt.generation import (
+        bucket_len,
+        gather_kv_blocks,
+    )
+
+    exporter = _engine(server, kv_dtype="int8")
+    decoder = _engine(server, kv_dtype="int8")
+    reference = _engine(server, kv_dtype="int8")
+
+    meta, arrays = exporter.prefill_export(PROMPTS[0], 6)
+    assert {"k", "v", "k_scale", "v_scale"} <= set(arrays)
+    assert arrays["k"].dtype == np.int8
+    assert arrays["k_scale"].dtype == np.float32
+    meta2, arrays2 = unpack_handoff(pack_handoff(meta, arrays))
+    slot = decoder.adopt(meta2, arrays2)
+
+    # adopted row's first PB blocks == the exported payload, bit-exact
+    row = decoder.slots[slot]
+    PB = blocks_for(bucket_len(len(PROMPTS[0]), decoder.bucket),
+                    decoder.block)
+    adopted = gather_kv_blocks(decoder.pools, row.table[:PB])
+    for name in ("k", "v", "k_scale", "v_scale"):
+        assert adopted[name].tobytes() == arrays[name].tobytes(), name
+
+    ref_slot = reference.admit(PROMPTS[0], 6)
+    _drain(reference)
+    _drain(decoder)
+    assert decoder.slots[slot].tokens == reference.slots[ref_slot].tokens
+
+
+def test_adopt_rejects_incompatible_payload_loudly(server):
+    """Dtype and block-size mismatches fail BEFORE touching the arena:
+    the decode engine keeps serving and its pool stays clean."""
+    from paddlefleetx_tpu.core.paged_cache import pack_handoff, unpack_handoff
+
+    exporter = _engine(server, kv_dtype="int8")
+    meta, arrays = unpack_handoff(
+        pack_handoff(*exporter.prefill_export(PROMPTS[0], 6))
+    )
+
+    bf16_engine = _engine(server)  # native arena
+    with pytest.raises(ValueError, match="kv dtype"):
+        bf16_engine.adopt(meta, arrays)
+    assert bf16_engine.cache.stats()["kv_blocks_used"] == 0
+
+    wide = _engine(server, kv_dtype="int8", block=32)
+    with pytest.raises(ValueError, match="block size"):
+        wide.adopt(meta, arrays)
+    assert wide.cache.stats()["kv_blocks_used"] == 0
+
+    # a lying header (right signature, wrong payload bytes) is caught by
+    # the scatter-side shape check, and the allocation is rolled back
+    ok_engine = _engine(server, kv_dtype="int8")
+    bad = dict(arrays)
+    bad["k"] = arrays["k"][:, :0]  # right dtype, empty blocks
+    with pytest.raises(Exception, match="shape|cover"):
+        ok_engine.adopt(meta, bad)
+    assert ok_engine.cache.stats()["kv_blocks_used"] == 0
+
+
+def test_scheduler_submit_handoff_end_to_end(server, sequential):
+    """`ContinuousScheduler.submit_handoff`: a payload rides the same
+    bounded-queue/deadline surface as submit() and resolves to the
+    sequential-reference tokens; an incompatible payload is rejected
+    pre-admission with ValueError (HTTP 400), never queued."""
+    from paddlefleetx_tpu.core.continuous_batching import ContinuousScheduler
+    from paddlefleetx_tpu.core.paged_cache import pack_handoff, unpack_handoff
+
+    exporter = _engine(server)
+    sched = ContinuousScheduler(_engine(server), max_depth=8,
+                                name="handoff-test")
+    sched.start()
+    try:
+        futs = []
+        for p in PROMPTS:
+            meta, arrays = unpack_handoff(
+                pack_handoff(*exporter.prefill_export(p, 6))
+            )
+            futs.append(sched.submit_handoff(meta, arrays, deadline_s=60))
+        got = [f.result(timeout=120)[0] for f in futs]
+        assert got == sequential
+
+        # pre-admission rejection: wrong-dtype payload never takes a slot
+        bad_meta, bad_arrays = _engine(
+            server, kv_dtype="int8"
+        ).prefill_export(PROMPTS[0], 6)
+        with pytest.raises(ValueError, match="kv dtype"):
+            sched.submit_handoff(bad_meta, bad_arrays, deadline_s=60)
+        assert sched.depth() == 0
+    finally:
+        sched.shutdown(drain=False, timeout=30)

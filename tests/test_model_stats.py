@@ -623,6 +623,11 @@ def test_nan_rollback_drill_names_group_in_event_flight_and_report(
     assert "rollback" in doc and "embed" in doc
 
 
+@pytest.mark.slow  # ~12s; report rendering stays tier-1-drilled by
+# test_report_from_crashed_preempted_run (the HARDER contract: render
+# from the flight ring alone, no metrics file) plus the strict-HTML
+# renderer units; still in make test-obs / test-all (PR 8 tier-1 budget
+# convention)
 @pytest.mark.fault
 def test_report_from_real_12_step_run(drill_corpus, tmp_path):
     """Acceptance: a real 12-step CLI run's artifacts render into a valid

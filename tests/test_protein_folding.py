@@ -158,6 +158,9 @@ def test_structure_module_outputs():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~26s full-model compile; the folding stack stays
+# tier-1 via the torsion/FAPE/IPA-invariance/structure-module units
+# above; still in make test-all (PR 8 tier-1 budget convention)
 def test_folding_loss_finite_and_template_gating():
     batch = _batch()
     params = folding.init(TINY, jax.random.key(0))

@@ -1,0 +1,458 @@
+"""Router core units (`core/router.py`): replica lifecycle state
+machine, queue-aware scoring, bounded connection-refused retry, the
+router-level admission surface, and drain bookkeeping — all against
+in-process stub replicas (no jax, no model): the multi-process drills
+live in tests/test_router_drills.py.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from paddlefleetx_tpu.core.request_queue import QueueClosed, QueueFull
+from paddlefleetx_tpu.core.router import (
+    NoReplicaAvailable,
+    ReplicaUnavailable,
+    RouterCore,
+    STATE_CODE,
+)
+
+
+class StubReplica:
+    """A canned tools/serve.py stand-in: /healthz serves a mutable dict,
+    /generate|/prefill|/decode record the hit and answer (or abort,
+    under ``fail_mode='reset'``)."""
+
+    def __init__(self, *, role="monolith", ok=True, depth=0,
+                 state="ok", pid=None):
+        self.hits = []
+        self.fail_mode = None
+        self.health = {
+            "ok": ok, "state": state, "queue_depth": depth, "busy_s": 0.0,
+            "identity": {
+                "replica_id": f"stub-{id(self) % 997}", "role": role,
+                "scheduler": "continuous", "listen": "stub",
+                "pid": pid if pid is not None else os.getpid(),
+            },
+        }
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._json(200, stub.health)
+                return self._json(404, {"error": "nope"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                stub.hits.append((self.path, body))
+                if stub.fail_mode == "reset":
+                    # accept + read, then die without a response: the
+                    # "partial exchange" class that must NOT be retried
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                    self.connection.close()
+                    return
+                return self._json(200, {"completion_ids": [7, 8, 9]})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _dead_url():
+    """A url nothing listens on (bound + closed so the port was ours)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return f"http://127.0.0.1:{s.getsockname()[1]}"
+
+
+@pytest.fixture
+def stub():
+    s = StubReplica()
+    yield s
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle state machine
+# ---------------------------------------------------------------------------
+
+
+def test_lifecycle_booting_warm_serving(stub):
+    core = RouterCore([(stub.url, "monolith")], serve_after=2)
+    r = core.replicas["r0"]
+    assert r.state == "booting" and not r.eligible()
+    core.poll_replica(r)
+    assert r.state == "warm"  # answered once, trust not yet earned
+    assert not r.eligible()
+    core.poll_replica(r)
+    assert r.state == "serving" and r.eligible()
+    # identity block landed: the router knows who this is
+    assert r.pid == os.getpid()
+    assert r.replica_id and r.scheduler == "continuous"
+
+
+def test_lifecycle_degraded_is_ineligible_but_not_ejected(stub):
+    core = RouterCore([(stub.url, "monolith")])
+    r = core.replicas["r0"]
+    core.poll_replica(r)
+    core.poll_replica(r)
+    assert r.eligible()
+    stub.health["ok"] = False  # watchdog degraded
+    core.poll_replica(r)
+    assert r.state == "serving" and not r.eligible()
+    stub.health["ok"] = True  # recovered
+    core.poll_replica(r)
+    assert r.eligible()
+
+
+def test_lifecycle_draining_then_gone(stub):
+    core = RouterCore([(stub.url, "monolith")])
+    r = core.replicas["r0"]
+    core.poll_replica(r)
+    core.poll_replica(r)
+    stub.health["state"] = "draining"  # SIGTERM landed replica-side
+    core.poll_replica(r)
+    assert r.state == "draining" and not r.eligible()
+    stub.stop()  # drained process exited
+    core.poll_replica(r)
+    assert r.state == "gone"  # refused while draining = clean exit
+
+
+def test_lifecycle_eject_after_consecutive_failures(stub):
+    core = RouterCore([(stub.url, "monolith")], eject_after=3)
+    r = core.replicas["r0"]
+    core.poll_replica(r)
+    core.poll_replica(r)
+    assert r.state == "serving"
+    stub.stop()  # crashed, not draining
+    for _ in range(2):
+        core.poll_replica(r)
+        assert r.state == "serving"  # grace: transient blips tolerated
+    core.poll_replica(r)
+    assert r.state == "gone"
+
+
+def test_role_mismatch_marks_ineligible(stub):
+    # stub reports monolith but is configured into the prefill pool
+    decode = StubReplica(role="decode")
+    try:
+        core = RouterCore(
+            [(stub.url, "prefill"), (decode.url, "decode")]
+        )
+        r = core.replicas["r0"]
+        core.poll_replica(r)
+        core.poll_replica(r)
+        assert r.role_mismatch and not r.eligible()
+    finally:
+        decode.stop()
+
+
+# ---------------------------------------------------------------------------
+# scoring
+# ---------------------------------------------------------------------------
+
+
+def _serving_pair():
+    a, b = StubReplica(), StubReplica()
+    core = RouterCore([(a.url, "monolith"), (b.url, "monolith")])
+    for r in core.replicas.values():
+        core.poll_replica(r)
+        core.poll_replica(r)
+    return a, b, core
+
+
+def test_pick_least_loaded_by_depth():
+    a, b, core = _serving_pair()
+    try:
+        core.replicas["r0"].depth = 5
+        core.replicas["r1"].depth = 1
+        picked = core.pick("monolith", remaining_s=60)
+        assert picked.key == "r1"
+        # the pick reserved router-side capacity on the winner
+        assert picked.in_flight == 1
+    finally:
+        a.stop(), b.stop()
+
+
+def test_pick_deadline_aware_penalty():
+    """A shallower replica whose estimated wait blows the remaining
+    deadline loses to a deeper-but-fast one."""
+    a, b, core = _serving_pair()
+    try:
+        r0, r1 = core.replicas["r0"], core.replicas["r1"]
+        r0.depth, r0.last_latency_s = 3, 2.0   # ~6s estimated wait
+        r1.depth, r1.last_latency_s = 5, 0.01  # ~0.05s
+        assert core.pick("monolith", remaining_s=1.0).key == "r1"
+        # with a lax deadline the depth ordering rules again
+        r1.in_flight = 0
+        assert core.pick("monolith", remaining_s=60.0).key == "r0"
+    finally:
+        a.stop(), b.stop()
+
+
+def test_pick_raises_when_pool_empty():
+    a, b, core = _serving_pair()
+    try:
+        for r in core.replicas.values():
+            r.drain_requested = True
+        with pytest.raises(NoReplicaAvailable):
+            core.pick("monolith", remaining_s=60)
+    finally:
+        a.stop(), b.stop()
+
+
+# ---------------------------------------------------------------------------
+# dispatch: bounded refused-retry, never-retry-partial
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_retries_refused_on_another_replica(stub):
+    core = RouterCore(
+        [(_dead_url(), "monolith"), (stub.url, "monolith")], retries=2
+    )
+    # force both serving; the dead one looks attractive (depth 0)
+    for r in core.replicas.values():
+        r.state, r.healthy = "serving", True
+    core.replicas["r1"].depth = 9  # make the dead replica the first pick
+    status, body, _ = core.dispatch(
+        "POST", "/generate", b"{}", role="monolith", deadline_s=30
+    )
+    assert status == 200
+    assert json.loads(body)["completion_ids"] == [7, 8, 9]
+    assert core.replicas["r0"].state == "gone"  # refused = ejected now
+    assert len(stub.hits) == 1
+
+
+def test_dispatch_refused_everywhere_raises(stub):
+    core = RouterCore([(_dead_url(), "monolith")], retries=2)
+    core.replicas["r0"].state, core.replicas["r0"].healthy = "serving", True
+    with pytest.raises(NoReplicaAvailable, match="refused"):
+        core.dispatch("POST", "/generate", b"{}", role="monolith",
+                      deadline_s=10)
+
+
+def test_dispatch_never_retries_partial_exchange():
+    """A replica that dies AFTER reading the request (reset mid-reply)
+    raises ReplicaUnavailable and the OTHER live replica never sees the
+    request — the decode may have run, replays could double-generate."""
+    bad, good = StubReplica(), StubReplica()
+    bad.fail_mode = "reset"
+    core = RouterCore(
+        [(bad.url, "monolith"), (good.url, "monolith")], retries=2
+    )
+    for r in core.replicas.values():
+        r.state, r.healthy = "serving", True
+    core.replicas["r1"].depth = 9  # bad replica picked first
+    try:
+        with pytest.raises(ReplicaUnavailable):
+            core.dispatch("POST", "/generate", b"{}", role="monolith",
+                          deadline_s=30)
+        assert len(bad.hits) == 1
+        assert len(good.hits) == 0  # NOT replayed
+    finally:
+        bad.stop(), good.stop()
+
+
+# ---------------------------------------------------------------------------
+# router-level admission (the RequestQueue surface)
+# ---------------------------------------------------------------------------
+
+
+def test_admission_bounds_and_drain(stub):
+    core = RouterCore([(stub.url, "monolith")], max_inflight=2)
+    core.acquire()
+    core.acquire()
+    with pytest.raises(QueueFull):
+        core.acquire()
+    core.release()
+    core.acquire()  # capacity came back
+    core.close()  # draining: no new admissions, in-flight finish
+    with pytest.raises(QueueClosed):
+        core.acquire()
+    assert not core.join(timeout=0.05)  # two still in flight
+    core.release(), core.release()
+    assert core.join(timeout=5)
+
+
+def test_collect_exports_depth_and_state(stub):
+    core = RouterCore([(stub.url, "monolith")])
+    r = core.replicas["r0"]
+    core.poll_replica(r)
+    core.poll_replica(r)
+    stub.health["queue_depth"] = 4
+    core.poll_replica(r)
+    rows = {(name, tuple(sorted(labels.items()))): v
+            for name, labels, v in core.collect()}
+    assert rows[("pfx_router_in_flight", ())] == 0
+    assert rows[
+        ("pfx_router_replica_depth", (("replica", "r0"),))
+    ] == 4.0
+    assert rows[
+        ("pfx_router_replica_state", (("replica", "r0"),))
+    ] == STATE_CODE["serving"]
+
+
+# ---------------------------------------------------------------------------
+# drain (rolling deploy primitive)
+# ---------------------------------------------------------------------------
+
+
+def test_drain_signals_pid_and_walks_to_gone(stub):
+    """drain() rides the identity pid: the target stops receiving
+    traffic immediately, gets SIGTERM, and the poller marks it gone once
+    its port refuses.  A harmless sleeper subprocess stands in for the
+    serve.py process."""
+    proc = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(120)"])
+    try:
+        stub.health["identity"]["pid"] = proc.pid
+        core = RouterCore([(stub.url, "monolith")])
+        r = core.replicas["r0"]
+        core.poll_replica(r)
+        core.poll_replica(r)
+        assert r.eligible()
+        out = core.drain()  # unnamed: picks the serving replica
+        assert out["replica"] == "r0" and out["pid"] == proc.pid
+        assert r.drain_requested and r.state == "draining"
+        assert not r.eligible()
+        with pytest.raises(NoReplicaAvailable):
+            core.pick("monolith", remaining_s=60)
+        assert proc.wait(timeout=10) == -signal.SIGTERM
+        stub.stop()  # the real serve.py closes its listener on exit
+        core.poll_replica(r)
+        assert r.state == "gone"
+        with pytest.raises(ValueError, match="already gone"):
+            core.drain("r0")
+        with pytest.raises(ValueError, match="no serving replica"):
+            core.drain()
+        with pytest.raises(ValueError, match="unknown replica"):
+            core.drain("r9")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_drained_replica_redeployed_on_same_url_reenters_rotation(stub):
+    """The rolling-deploy recipe's second half: after drain walks a
+    replica to gone, a REDEPLOYED process answering on the same url must
+    re-enter via warm -> serving — the drain flag belongs to the old
+    process, not the slot (regression: drain_requested was never
+    cleared, permanently blackholing the slot)."""
+    proc = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(120)"])
+    try:
+        stub.health["identity"]["pid"] = proc.pid
+        core = RouterCore([(stub.url, "monolith")], serve_after=2)
+        r = core.replicas["r0"]
+        core.poll_replica(r)
+        core.poll_replica(r)
+        core.poll_replica(r)
+        core.drain()
+        proc.wait(timeout=10)
+        stub.stop()
+        core.poll_replica(r)
+        assert r.state == "gone" and r.drain_requested
+        # redeploy: a fresh process (new pid) binds the same port
+        redeployed = StubReplica(pid=os.getpid())
+        try:
+            r2 = core.replicas["r0"]
+            r2_url = r2.url
+            # point the slot at the new listener (same-url in production;
+            # the stub can't rebind the exact port portably, so rewrite)
+            r2.url = redeployed.url
+            core.poll_replica(r2)
+            assert not r2.drain_requested, "drain flag survived redeploy"
+            assert r2.state == "warm"
+            core.poll_replica(r2)
+            assert r2.state == "serving" and r2.eligible()
+            assert core.pick("monolith", remaining_s=60).key == "r0"
+            r2.url = r2_url
+        finally:
+            redeployed.stop()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_acquire_never_touches_registry_under_router_lock(stub, monkeypatch):
+    """Lock-order regression: the registry snapshot holds the registry
+    lock while calling RouterCore.collect() (which takes the router
+    lock), so admission-rejection counters must be bumped OUTSIDE the
+    router lock or a concurrent /metrics scrape deadlocks the router.
+    Probed deterministically: a registry accessor that asserts the
+    router lock is free at call time."""
+    import paddlefleetx_tpu.core.router as router_mod
+
+    core = RouterCore([(stub.url, "monolith")], max_inflight=1)
+    real_get = router_mod.get_registry
+    violations = []
+
+    class Probe:
+        def counter(self, name, **labels):
+            if core._lock.acquire(blocking=False):
+                core._lock.release()
+            else:
+                violations.append(name)
+            return real_get().counter(name, **labels)
+
+        def __getattr__(self, name):
+            return getattr(real_get(), name)
+
+    monkeypatch.setattr(router_mod, "get_registry", lambda: Probe())
+    core.acquire()
+    with pytest.raises(QueueFull):
+        core.acquire()  # full -> rejected counter fires
+    core.release()
+    core.close()
+    with pytest.raises(QueueClosed):
+        core.acquire()  # draining -> rejected counter fires
+    assert not violations, (
+        f"registry touched under the router lock: {violations}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# configuration validation
+# ---------------------------------------------------------------------------
+
+
+def test_pool_configuration_is_validated():
+    with pytest.raises(ValueError, match=">= 1 replica"):
+        RouterCore([])
+    with pytest.raises(ValueError, match="unknown replica role"):
+        RouterCore([("http://x:1", "turbo")])
+    with pytest.raises(ValueError, match="mixing monolith"):
+        RouterCore([("http://x:1", "monolith"), ("http://x:2", "prefill")])
+    with pytest.raises(ValueError, match="BOTH"):
+        RouterCore([("http://x:1", "prefill")])
+    core = RouterCore([("http://x:1", "prefill"), ("http://x:2", "decode")])
+    assert core.disaggregated
+    assert not RouterCore([("http://x:1", "monolith")]).disaggregated

@@ -1,0 +1,403 @@
+"""Multi-host serving drills through the real CLIs (`make test-router`):
+N `tools/serve.py` replicas behind `tools/router.py`, driven as real
+processes (docs/serving.md "Multi-host serving").
+
+  drain+kill   rolling drain under flood (the deploy primitive): the
+               `router.py drain` CLI takes one replica out while traffic
+               flows — ZERO dropped admitted requests (every in-flight
+               request answers 200), the replica exits 0, and the router
+               walks it draining -> gone.  Then a SIGKILL of a second
+               replica mid-traffic: in-flight requests get an honest 503
+               (never a hang, never a silent replay), new traffic fails
+               over to the survivor, and the router ejects the corpse.
+  disagg       prefill/decode pools: greedy output through
+               prefill -> KV-handoff -> decode is TOKEN-IDENTICAL to a
+               single-process continuous replica (f32 exact), with
+               handoff bytes/seconds accounted on the router and
+               export/adopt counters on the replicas.
+
+Follows tests/test_serve_drills.py conventions: `fault`-marked,
+subprocess-driven, tiny synthetic GPT, persistent XLA compile cache
+shared through the environment (tests/conftest.py)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = {
+    "Global": {"global_batch_size": 8, "seed": 11},
+    "Engine": {"mix_precision": {"enable": False},
+               "save_load": {"save_steps": 0}},
+    "Model": {
+        "module": "GPTModule",
+        "vocab_size": 96,
+        "hidden_size": 32,
+        "num_layers": 2,
+        "num_attention_heads": 4,
+        "max_position_embeddings": 64,
+        "dtype": "float32",
+    },
+    "Optimizer": {"name": "FusedAdamW",
+                  "lr": {"name": "Constant", "learning_rate": 1e-3}},
+    "Generation": {"max_dec_len": 8, "decode_strategy": "greedy_search",
+                   "pad_to_multiple": 8, "eos_token_id": 95,
+                   "pad_token_id": 0},
+}
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env["PFX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("PFX_FAULT", None)
+    env.update(extra or {})
+    return env
+
+
+def _post(port, body, timeout=90, path="/generate"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return json.load(r)
+
+
+def _metrics(port, timeout=10):
+    from test_telemetry import parse_prometheus
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=timeout
+    ) as r:
+        metrics, _ = parse_prometheus(r.read().decode())
+    return metrics
+
+
+def _spawn_replica(cfg_path, port, *extra):
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "-c", str(cfg_path), "--port", str(port),
+         "--queue-depth", "32", "--deadline", "60",
+         "--warmup-buckets", "4", "--warmup-batches", "1", *extra],
+        env=_env(), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _spawn_router(port, *args):
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "router.py"),
+         "--port", str(port), "--poll-interval", "0.2",
+         "--eject-after", "3", *args],
+        env=_env(), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _wait_healthy(procs_ports, timeout=300):
+    """Wait for every (proc, port) to answer /healthz ok (they warm
+    their compile families in PARALLEL off the shared XLA cache)."""
+    end = time.time() + timeout
+    pending = dict(procs_ports)
+    while pending and time.time() < end:
+        for port, proc in list(pending.items()):
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"replica on {port} died at boot: "
+                    f"{proc.stdout.read()[-3000:]}"
+                )
+            try:
+                if _get(port, "/healthz", timeout=5).get("ok"):
+                    del pending[port]
+            except Exception:
+                pass
+        time.sleep(0.3)
+    assert not pending, f"never healthy: {sorted(pending)}"
+
+
+def _wait_eligible(router_port, n, timeout=30):
+    end = time.time() + timeout
+    h = {}
+    while time.time() < end:
+        try:
+            h = _get(router_port, "/healthz")
+        except Exception:  # router listener still booting
+            h = {}
+        if h.get("eligible", 0) >= n:
+            return h
+        time.sleep(0.2)
+    raise AssertionError(f"router never saw {n} eligible replicas: {h}")
+
+
+def _finish(proc, timeout=30):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+    return proc.stdout.read()
+
+
+def test_rolling_drain_then_replica_kill_under_flood(tmp_path):
+    """THE multi-host acceptance drill, one 3-replica topology, two
+    phases:
+
+    1. rolling drain under flood: `tools/router.py drain` takes r0 out
+       while traffic flows — every request in the drain window answers
+       200 (zero dropped admitted requests), r0 exits 0, the router
+       walks it draining -> gone, traffic continues on the survivors.
+    2. replica-kill mid-request: SIGKILL r1 under flood — every
+       response is exactly one of 200/503 (an in-flight request on the
+       corpse gets an honest 503, never a hang, never a replay), the
+       router ejects it, and follow-up traffic serves 200 on r2."""
+    cfg_path = tmp_path / "tiny_router.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    ports = [_free_port() for _ in range(3)]
+    replicas = [
+        _spawn_replica(cfg_path, p, "--replica-id", f"rep{i}")
+        for i, p in enumerate(ports)
+    ]
+    rport = _free_port()
+    router = None
+    try:
+        _wait_healthy(list(zip(ports, replicas)))
+        router = _spawn_router(
+            rport, *[a for p in ports
+                     for a in ("--replica", f"http://127.0.0.1:{p}")],
+        )
+        h = _wait_eligible(rport, 3)
+        assert h["mode"] == "replicated", h
+        # identity satellite: the router (and a human) can tell the
+        # replicas apart — distinct ids, roles, pids on /replicas
+        views = _get(rport, "/replicas")["replicas"]
+        assert {v["replica_id"] for v in views} == {"rep0", "rep1", "rep2"}
+        assert {v["role"] for v in views} == {"monolith"}
+        assert len({v["pid"] for v in views}) == 3
+        rep_id = {v["key"]: v["replica_id"] for v in views}
+
+        body = {"prompt_ids": [1, 2, 3], "max_tokens": 8, "deadline_s": 60}
+        code, ref = _post(rport, body)
+        assert code == 200, (code, ref)
+
+        # ---- phase 1: rolling drain under flood ----
+        stop = threading.Event()
+        results, lock = [], threading.Lock()
+
+        def flood():
+            while not stop.is_set():
+                c, _r = _post(rport, body, timeout=90)
+                with lock:
+                    results.append(c)
+
+        threads = [threading.Thread(target=flood) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # traffic flowing on all replicas
+        drain = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "router.py"),
+             "drain", "--admin", f"http://127.0.0.1:{rport}",
+             "--replica-id", "r0", "--timeout", "120"],
+            env=_env(), cwd=REPO, capture_output=True, text=True,
+            timeout=180,
+        )
+        assert drain.returncode == 0, (drain.stdout, drain.stderr)
+        assert "drained and exited" in drain.stdout, drain.stdout
+        # the drained replica honored the SIGTERM contract: exit 0
+        drained = replicas[ports.index(ports[0])]
+        assert drained.wait(timeout=60) == 0
+        time.sleep(1.0)  # a little post-drain traffic on the survivors
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "hung connection through the drain"
+        with lock:
+            drain_codes = list(results)
+        # ZERO dropped admitted requests: nothing 5xx'd or hung through
+        # the whole drain window, and traffic really flowed
+        assert drain_codes and all(c == 200 for c in drain_codes), (
+            drain_codes
+        )
+        assert _get(rport, "/healthz")["replicas"]["r0"] == "gone"
+
+        # ---- phase 2: replica kill mid-request ----
+        results.clear()
+        stop.clear()
+        threads = [threading.Thread(target=flood) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.6)  # requests in flight on both survivors
+        victim = replicas[1]
+        victim.kill()  # SIGKILL: no drain, sockets die mid-exchange
+        time.sleep(2.0)  # traffic through the failover window
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "hung connection through the kill"
+        with lock:
+            kill_codes = list(results)
+        # exactly one honest outcome per request, never a hang: a request
+        # that died with the victim 503s; everything else keeps serving
+        assert kill_codes and all(c in (200, 503) for c in kill_codes), (
+            kill_codes
+        )
+        assert kill_codes.count(200) >= 1, kill_codes
+
+        # the router ejected the corpse (refused dispatch or failed
+        # polls) and the survivor keeps answering token-identically
+        end = time.time() + 20
+        while time.time() < end:
+            if _get(rport, "/healthz")["replicas"]["r1"] == "gone":
+                break
+            time.sleep(0.3)
+        assert _get(rport, "/healthz")["replicas"]["r1"] == "gone"
+        for _ in range(3):
+            code, resp = _post(rport, body)
+            assert code == 200, (code, resp)
+            assert resp["completion_ids"] == ref["completion_ids"]
+
+        # router accounting: dispatches landed on every replica, the
+        # kill surfaced as lost/refused outcomes, depth/state gauges up
+        m = _metrics(rport)
+        req_total = m["pfx_router_requests_total"]
+        seen = {dict(k)["replica"] for k in req_total}
+        assert seen == {"r0", "r1", "r2"}, req_total
+        outcomes = {dict(k)["outcome"] for k in req_total}
+        assert "200" in outcomes and (
+            "lost" in outcomes or "refused" in outcomes
+        ), outcomes
+        state_by_replica = {
+            dict(k)["replica"]: v
+            for k, v in m["pfx_router_replica_state"].items()
+        }
+        assert state_by_replica["r0"] == 4.0  # gone
+        assert state_by_replica["r1"] == 4.0  # gone
+        assert state_by_replica["r2"] == 2.0  # serving
+        assert rep_id["r2"] == "rep2"
+
+        # router's own drain contract: SIGTERM -> exit 0
+        router.send_signal(signal.SIGTERM)
+        assert router.wait(timeout=60) == 0
+    finally:
+        logs = [_finish(p) for p in replicas]
+        rlog = _finish(router) if router is not None else ""
+    for log in logs + [rlog]:
+        assert "Traceback" not in log, log[-3000:]
+
+
+def test_disaggregated_prefill_decode_parity_via_router(tmp_path):
+    """THE disaggregation acceptance drill: the same prompts through
+    (a) one single-process `--scheduler continuous` replica and
+    (b) router -> prefill replica -> KV handoff -> decode replica
+    produce IDENTICAL greedy token ids (f32 exact), with handoff bytes
+    and seconds accounted on the router and export/adopt counters on
+    the replicas' own /metrics."""
+    cfg_path = tmp_path / "tiny_disagg.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    mono_p, pre_p, dec_p = (_free_port() for _ in range(3))
+    mono = _spawn_replica(cfg_path, mono_p, "--scheduler", "continuous",
+                          "--cb-batch", "4")
+    pre = _spawn_replica(cfg_path, pre_p, "--role", "prefill",
+                         "--replica-id", "pre0")
+    dec = _spawn_replica(cfg_path, dec_p, "--role", "decode",
+                         "--cb-batch", "4", "--replica-id", "dec0")
+    rport = _free_port()
+    router = None
+    try:
+        _wait_healthy([(mono_p, mono), (pre_p, pre), (dec_p, dec)])
+        # identity satellite: the roles are self-reported and distinct
+        assert _get(pre_p, "/healthz")["identity"]["role"] == "prefill"
+        ident = _get(dec_p, "/healthz")["identity"]
+        assert ident["role"] == "decode"
+        assert ident["scheduler"] == "continuous"
+        assert ident["pid"] == dec.pid
+
+        # a prefill replica refuses /generate honestly
+        code, resp = _post(pre_p, {"prompt_ids": [1, 2], "max_tokens": 4})
+        assert code == 400 and "prefill" in resp["error"], (code, resp)
+
+        router = _spawn_router(
+            rport,
+            "--prefill", f"http://127.0.0.1:{pre_p}",
+            "--decode", f"http://127.0.0.1:{dec_p}",
+        )
+        h = _wait_eligible(rport, 2)
+        assert h["mode"] == "disaggregated", h
+
+        prompts = [[1, 2, 3], [4, 5, 6, 7, 8], [9, 10]]
+        for ids in prompts:
+            body = {"prompt_ids": ids, "max_tokens": 6, "deadline_s": 60}
+            c_ref, ref = _post(mono_p, body)
+            c_got, got = _post(rport, body)
+            assert c_ref == 200 and c_got == 200, (c_ref, c_got, got)
+            # THE acceptance assert: disaggregated greedy output is
+            # token-identical to the single-process continuous path
+            assert got["completion_ids"] == ref["completion_ids"], ids
+
+        # multi-prompt requests hand off per prompt and stay atomic
+        body = {"prompts_ids": prompts, "max_tokens": 6, "deadline_s": 60}
+        c_ref, ref = _post(mono_p, body)
+        c_got, got = _post(rport, body)
+        assert c_ref == 200 and c_got == 200
+        assert got["completions_ids"] == ref["completions_ids"]
+
+        # a text-mode request is refused honestly (no tokenizer here)
+        code, resp = _post(rport, {"prompt": "hi", "max_tokens": 4})
+        assert code == 400 and "token-id" in resp["error"], (code, resp)
+
+        # handoff accounting: bytes + seconds on the router, export/
+        # adopt counters on the replicas (warmup exports excluded)
+        n = len(prompts) * 2  # singles + the batch
+        m = _metrics(rport)
+        assert m["pfx_router_handoff_bytes_total"][frozenset()] > 0
+        assert m["pfx_router_handoff_seconds_count"][frozenset()] == n
+        pre_m = _metrics(pre_p)
+        dec_m = _metrics(dec_p)
+        assert pre_m["pfx_handoff_exports_total"][frozenset()] == n
+        assert dec_m["pfx_handoff_adopts_total"][frozenset()] == n
+        # adoption rides the admission path: admits counted, arena clean
+        assert dec_m["pfx_prefill_admits_total"][frozenset()] >= n
+        assert dec_m["pfx_kv_blocks_used"][frozenset()] == 0
+
+        # every process honors the drain contract: SIGTERM -> exit 0
+        for proc in (router, mono, pre, dec):
+            proc.send_signal(signal.SIGTERM)
+        for proc in (router, mono, pre, dec):
+            assert proc.wait(timeout=60) == 0
+    finally:
+        logs = [_finish(p) for p in (mono, pre, dec)]
+        rlog = _finish(router) if router is not None else ""
+    for log in logs + [rlog]:
+        assert "Traceback" not in log, log[-3000:]

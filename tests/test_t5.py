@@ -4,6 +4,7 @@ tokenizer round-trip."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from paddlefleetx_tpu.data.tokenizers.t5_tokenizer import T5Tokenizer
 from paddlefleetx_tpu.models.gpt.model import ShardingCtx
@@ -203,6 +204,10 @@ def test_t5_pretrain_dataset_span_corruption(tmp_path):
     np.testing.assert_array_equal(item["labels"][: len(exp_targets)], exp_targets)
 
 
+@pytest.mark.slow  # ~11s engine boot; T5 stays tier-1 via the forward/
+# loss-level and dataset tests in this file (the Engine train loop it
+# rides is drilled by the GPT engine suites); still in make test-mid /
+# test-all (PR 8 tier-1 budget convention)
 def test_t5_trains_from_pretrain_dataset(tmp_path, devices8):
     """End-to-end: T5PretrainDataset -> Engine train step (finite loss)."""
     from paddlefleetx_tpu.core.engine import Engine

@@ -1,0 +1,461 @@
+"""Multi-host serving front door: route client traffic across N
+`tools/serve.py` replicas (`core/router.py`), with queue-aware load
+balancing, replica health management, rolling drains, and the
+disaggregated prefill/decode topology.
+
+Two topologies (docs/serving.md "Multi-host serving"):
+
+  replicated     N monolith replicas; each POST /generate is forwarded
+                 to the least-loaded serving replica (bounded retry on
+                 connection-refused ONLY — a partial exchange returns an
+                 honest 503, never a replay).
+  disaggregated  separate --prefill and --decode pools: the router runs
+                 each prompt's prefill on a prefill replica, carries the
+                 KV-handoff payload to a decode replica, and returns the
+                 continued decode — long prompts stop head-of-line-
+                 blocking decode steps (greedy output token-identical to
+                 the single-process continuous path; drilled).
+
+The router owns front-door admission (bounded in-flight -> 429,
+draining -> 503, deadline checked before every dispatch) and mirrors
+the serve.py drain contract: SIGTERM stops admission, in-flight
+requests finish, exit 0; a second signal force-quits.
+
+Usage:
+  # replicated
+  python tools/router.py --port 9000 \
+      --replica http://127.0.0.1:8001 --replica http://127.0.0.1:8002
+  # disaggregated
+  python tools/router.py --port 9000 \
+      --prefill http://127.0.0.1:8001 --decode http://127.0.0.1:8002
+  # rolling deploy, one replica at a time (requires the router up):
+  python tools/router.py drain --admin http://127.0.0.1:9000 [--replica-id r0]
+
+Endpoints:
+  POST /generate      route one request (token-id modes only in
+                      disaggregated mode — the router has no tokenizer)
+  GET  /healthz       router health + per-replica lifecycle states
+  GET  /metrics       Prometheus exposition (pfx_router_* and friends)
+  GET  /replicas      detailed per-replica view (identity, scores)
+  POST /admin/drain   initiate drain-one-replica (body: {"replica": id})
+  GET  /debug/traces  sampled routing timelines (Perfetto JSON)
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def serve_router(args) -> int:
+    import signal
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from paddlefleetx_tpu.core.request_queue import QueueClosed, QueueFull
+    from paddlefleetx_tpu.core.router import (
+        NoReplicaAvailable,
+        ReplicaUnavailable,
+        RouterCore,
+        _DownstreamError,
+    )
+    from paddlefleetx_tpu.utils.telemetry import (
+        get_flight_recorder,
+        get_registry,
+    )
+    from paddlefleetx_tpu.utils.tracing import chrome_trace, get_trace_buffer
+
+    replicas = [(u, "monolith") for u in args.replica]
+    replicas += [(u, "prefill") for u in args.prefill]
+    replicas += [(u, "decode") for u in args.decode]
+    core = RouterCore(
+        replicas,
+        max_inflight=args.max_inflight,
+        retries=args.retries,
+        poll_interval_s=args.poll_interval,
+        eject_after=args.eject_after,
+        serve_after=args.serve_after,
+    )
+    reg = get_registry()
+    recorder = get_flight_recorder()
+    recorder.install_excepthook()
+    trace_buffer = get_trace_buffer()
+    identity = {
+        "replica_id": args.router_id or f"{args.host}:{args.port}",
+        "role": "router",
+        "scheduler": "disaggregated" if core.disaggregated else "replicated",
+        "listen": f"{args.host}:{args.port}",
+        "pid": os.getpid(),
+    }
+    flags = {"draining": False}
+    default_deadline = float(args.deadline)
+    max_deadline = float(args.max_deadline)
+
+    class Handler(BaseHTTPRequestHandler):
+        timeout = 120
+
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, body, ctype, headers=None):
+            try:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError, TimeoutError):
+                reg.counter("pfx_http_client_gone_total").inc()
+            else:
+                reg.counter("pfx_http_responses_total", code=str(code)).inc()
+
+        def _json(self, code, obj, headers=None):
+            self._send(code, json.dumps(obj).encode(), "application/json",
+                       headers)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                states = core.states()
+                body = {
+                    "ok": not flags["draining"],
+                    "state": "draining" if flags["draining"] else "ok",
+                    "identity": identity,
+                    "mode": identity["scheduler"],
+                    "in_flight": core.depth(),
+                    "replicas": states,
+                    "eligible": sum(
+                        1 for v in core.replica_views() if v["eligible"]
+                    ),
+                }
+                return self._json(200, body)
+            if self.path == "/metrics":
+                return self._send(
+                    200, reg.render_prometheus().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            if self.path == "/replicas":
+                return self._json(200, {"replicas": core.replica_views()})
+            if self.path == "/debug/traces":
+                return self._json(200, chrome_trace(trace_buffer.traces()))
+            return self._json(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            if self.path == "/admin/drain":
+                return self._admin_drain()
+            if self.path != "/generate":
+                return self._json(404, {"error": "unknown path"})
+            return self._generate()
+
+        def _admin_drain(self):
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(n) or b"{}")
+            except json.JSONDecodeError as e:
+                return self._json(400, {"error": f"bad JSON: {e}"})
+            try:
+                out = core.drain(req.get("replica"))
+            except ValueError as e:
+                return self._json(409, {"error": str(e)})
+            return self._json(200, out)
+
+        def _generate(self):
+            t0 = time.monotonic()
+            try:
+                core.acquire()
+            except QueueFull:
+                return self._json(
+                    429,
+                    {"error": f"router at capacity "
+                              f"({args.max_inflight} in flight)"},
+                    headers={"Retry-After": "1"},
+                )
+            except QueueClosed:
+                return self._json(
+                    503, {"error": "router draining"},
+                    headers={"Retry-After": "5"},
+                )
+            trace = trace_buffer.maybe_start("route", t0=t0)
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                try:
+                    req = json.loads(body or b"{}")
+                except json.JSONDecodeError as e:
+                    return self._json(400, {"error": f"bad JSON: {e}"})
+                try:
+                    deadline_s = float(req.get("deadline_s",
+                                               default_deadline))
+                    if not (deadline_s > 0 and math.isfinite(deadline_s)):
+                        raise ValueError(
+                            "deadline_s must be a positive finite number"
+                        )
+                    deadline_s = min(deadline_s, max_deadline)
+                except (ValueError, TypeError) as e:
+                    return self._json(400, {"error": str(e)})
+                if core.disaggregated:
+                    return self._generate_disagg(req, deadline_s, trace)
+                try:
+                    status, data, ctype = core.dispatch(
+                        "POST", "/generate", body,
+                        role="monolith", deadline_s=deadline_s,
+                        headers={"Content-Type": "application/json"},
+                        trace=trace,
+                    )
+                except NoReplicaAvailable as e:
+                    return self._json(
+                        503, {"error": str(e)},
+                        headers={"Retry-After": "2"},
+                    )
+                except ReplicaUnavailable as e:
+                    return self._json(
+                        503, {"error": str(e)},
+                        headers={"Retry-After": "1"},
+                    )
+                headers = (
+                    {"Retry-After": "1"} if status in (429, 503) else None
+                )
+                return self._send(status, data, ctype, headers)
+            except Exception as e:  # noqa: BLE001 — last-resort guard
+                return self._json(500, {"error": str(e)})
+            finally:
+                if trace is not None:
+                    trace.event("respond")
+                    trace.finish()
+                core.release()
+
+        def _generate_disagg(self, req, deadline_s, trace):
+            if "prompt_ids" in req:
+                prompts, plural = [list(req["prompt_ids"])], False
+            elif "prompts_ids" in req:
+                prompts, plural = [list(p) for p in req["prompts_ids"]], True
+            else:
+                return self._json(400, {
+                    "error": "disaggregated routing serves token-id "
+                             "requests (prompt_ids / prompts_ids); the "
+                             "router has no tokenizer"
+                })
+            if not prompts or any(not p for p in prompts):
+                return self._json(400, {
+                    "error": "prompts must be non-empty id lists"
+                })
+            mt = req.get("max_tokens")
+            try:
+                rows = core.generate_disaggregated(
+                    prompts, None if mt is None else int(mt),
+                    deadline_s, trace=trace,
+                )
+            except _DownstreamError as e:
+                try:
+                    obj = json.loads(e.body)
+                except json.JSONDecodeError:
+                    obj = {"error": e.body.decode(errors="replace")}
+                headers = (
+                    {"Retry-After": "1"} if e.status in (429, 503) else None
+                )
+                return self._json(e.status, obj, headers)
+            except NoReplicaAvailable as e:
+                return self._json(503, {"error": str(e)},
+                                  headers={"Retry-After": "2"})
+            except ReplicaUnavailable as e:
+                return self._json(503, {"error": str(e)},
+                                  headers={"Retry-After": "1"})
+            payload = ({"completions_ids": rows} if plural
+                       else {"completion_ids": rows[0]})
+            if trace is not None:
+                payload["trace_id"] = trace.trace_id
+            return self._json(200, payload)
+
+    class Server(ThreadingHTTPServer):
+        daemon_threads = False  # drain joins in-flight responses
+        block_on_close = True
+
+        def handle_error(self, request, client_address):
+            exc = sys.exc_info()[1]
+            if isinstance(exc, (BrokenPipeError, ConnectionResetError,
+                                TimeoutError)):
+                reg.counter("pfx_http_client_gone_total").inc()
+                return
+            super().handle_error(request, client_address)
+
+    httpd = Server((args.host, args.port), Handler)
+    orig_handlers = {}
+
+    def _on_signal(signum, frame):
+        for sig, h in orig_handlers.items():
+            signal.signal(sig, h)
+        flags["draining"] = True
+        recorder.record({"event": "drain_start", "signum": signum,
+                         "in_flight": core.depth()})
+        print(
+            f"signal {signum}: router draining — admission closed, "
+            f"{core.depth()} request(s) in flight "
+            "(send again to force-quit)",
+            flush=True,
+        )
+
+        def _drain():
+            core.close()
+            core.join()
+            httpd.shutdown()
+
+        threading.Thread(target=_drain, name="router-drain",
+                         daemon=True).start()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        orig_handlers[sig] = signal.signal(sig, _on_signal)
+
+    core.start()
+    mode = identity["scheduler"]
+    print(
+        f"router on {args.host}:{args.port} ({mode}; "
+        f"{len(core.replicas)} replica(s), max in-flight "
+        f"{args.max_inflight}, retries {args.retries})",
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        print("force-quit on second interrupt", flush=True)
+        recorder.record({"event": "force_quit"})
+        recorder.dump(reason="force_quit")
+        os._exit(130)
+    finally:
+        core.stop()
+        httpd.server_close()
+    if flags["draining"]:
+        print("router drained cleanly: all admitted requests answered",
+              flush=True)
+    return 0
+
+
+def cmd_drain(args) -> int:
+    """The rolling-deploy primitive: ask a RUNNING router to drain one
+    replica, then watch it walk draining -> gone (the replica answers
+    its admitted work, exits 0, and its port goes refused).  Repeat per
+    replica — redeploying between drains — for a full rolling deploy
+    (runbook: docs/serving.md)."""
+    import urllib.error
+    import urllib.request
+
+    admin = args.admin.rstrip("/")
+    req = urllib.request.Request(
+        f"{admin}/admin/drain",
+        data=json.dumps(
+            {"replica": args.replica_id or None}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            out = json.load(r)
+    except urllib.error.HTTPError as e:
+        print(f"drain refused: {e.code} "
+              f"{(e.read() or b'').decode(errors='replace')}",
+              file=sys.stderr, flush=True)
+        return 1
+    except (urllib.error.URLError, OSError) as e:
+        # router down / wrong --admin: a clean rc-1 message, never a
+        # traceback from the deploy tooling
+        print(f"cannot reach router at {admin}: {e}",
+              file=sys.stderr, flush=True)
+        return 1
+    key = out["replica"]
+    print(f"drain initiated: replica {key} (pid {out.get('pid')})",
+          flush=True)
+    last = None
+    t_end = time.time() + args.timeout
+    while time.time() < t_end:
+        try:
+            with urllib.request.urlopen(
+                f"{admin}/replicas", timeout=10
+            ) as r:
+                views = json.load(r)["replicas"]
+        except (urllib.error.URLError, OSError) as e:
+            # transient: the router itself may be mid-restart; keep
+            # polling until the timeout decides
+            print(f"router poll failed ({e}); retrying", flush=True)
+            time.sleep(1.0)
+            continue
+        view = next((v for v in views if v["key"] == key), None)
+        if view is None:
+            print(f"replica {key} disappeared from the router",
+                  file=sys.stderr, flush=True)
+            return 1
+        if view["state"] != last:
+            last = view["state"]
+            print(f"replica {key}: {last}", flush=True)
+        if view["state"] == "gone":
+            print(f"replica {key} drained and exited", flush=True)
+            return 0
+        time.sleep(0.3)
+    print(f"timeout: replica {key} still {last!r} after "
+          f"{args.timeout:g}s", file=sys.stderr, flush=True)
+    return 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("command", nargs="?", default="serve",
+                    choices=("serve", "drain"),
+                    help="serve (default): run the front door; drain: "
+                    "ask a running router to drain one replica and wait "
+                    "for it to exit (rolling deploy)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="router listen port (serve mode)")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address (unauthenticated endpoint: "
+                    "exposing beyond loopback is an operator decision)")
+    ap.add_argument("--replica", action="append", default=[],
+                    help="monolith replica base URL (repeatable)")
+    ap.add_argument("--prefill", action="append", default=[],
+                    help="prefill-pool replica base URL (repeatable; "
+                    "requires --decode too)")
+    ap.add_argument("--decode", action="append", default=[],
+                    help="decode-pool replica base URL (repeatable)")
+    ap.add_argument("--max-inflight", type=int, default=64,
+                    help="router admission bound: requests in flight "
+                    "beyond this get HTTP 429 (the front-door queue)")
+    ap.add_argument("--retries", type=int, default=2,
+                    help="max retries on ANOTHER replica after "
+                    "connection-refused (partial responses never retry)")
+    ap.add_argument("--deadline", type=float, default=120.0,
+                    help="default per-request routing deadline seconds")
+    ap.add_argument("--max-deadline", type=float, default=600.0,
+                    help="ceiling on client deadline_s")
+    ap.add_argument("--poll-interval", type=float, default=0.5,
+                    help="replica /healthz poll cadence seconds")
+    ap.add_argument("--eject-after", type=int, default=3,
+                    help="consecutive failed polls before a replica is "
+                    "marked gone")
+    ap.add_argument("--serve-after", type=int, default=1,
+                    help="consecutive healthy polls before a warm "
+                    "replica starts receiving traffic")
+    ap.add_argument("--router-id", default="",
+                    help="identity for this router's /healthz block")
+    ap.add_argument("--admin", default="http://127.0.0.1:9000",
+                    help="drain mode: the running router's base URL")
+    ap.add_argument("--replica-id", default="",
+                    help="drain mode: replica to drain (router key or "
+                    "identity id; default: least-loaded serving replica)")
+    ap.add_argument("--timeout", type=float, default=180.0,
+                    help="drain mode: seconds to wait for the replica "
+                    "to reach gone")
+    args = ap.parse_args(argv)
+
+    if args.command == "drain":
+        return cmd_drain(args)
+    if not args.port:
+        ap.error("serve mode requires --port")
+    if not (args.replica or args.prefill or args.decode):
+        ap.error("need --replica URLs, or --prefill and --decode URLs")
+    return serve_router(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -179,7 +179,8 @@ def _record_request_span(reg, recorder, t0, fut, code, tokens=None):
 
 def build_scheduler(server, scheduler: str, *, queue_depth: int,
                     max_coalesce: int, cb_batch: int = 8,
-                    kv_blocks: int = 0, name: str = "serve"):
+                    kv_blocks: int = 0, name: str = "serve",
+                    role: str = "monolith"):
     """Construct the serving scheduler behind ``--scheduler``:
 
     - ``coalesce`` (default): the PR 3 `RequestQueue` — same-bucket
@@ -191,10 +192,35 @@ def build_scheduler(server, scheduler: str, *, queue_depth: int,
       Flips to the default once the paged drills have soaked on a chip
       window (docs/serving.md).
 
-    Both expose the same surface (submit/try_remove/depth/busy_seconds/
-    close/join/stats), so the HTTP layer below is scheduler-agnostic."""
+    ``role="prefill"`` (disaggregated serving, docs/serving.md
+    "Multi-host serving") instead wires a `RequestQueue` whose runner is
+    `PagedDecodeEngine.prefill_export`: each admitted request prefills
+    one prompt into the arena and leaves as a KV-handoff payload — the
+    whole admission/deadline/drain contract rides the queue unchanged.
+
+    All spellings expose the same surface (submit/try_remove/depth/
+    busy_seconds/close/join/stats), so the HTTP layer below is
+    scheduler-agnostic."""
     from paddlefleetx_tpu.core.request_queue import RequestQueue
 
+    if role == "prefill":
+        from paddlefleetx_tpu.core.continuous_batching import (
+            PagedDecodeEngine,
+        )
+
+        engine = PagedDecodeEngine(
+            server, max_batch=cb_batch, num_blocks=kv_blocks
+        )
+
+        def prefill_runner(prompts, max_new):
+            return [engine.prefill_export(p, max_new) for p in prompts]
+
+        queue = RequestQueue(
+            prefill_runner, max_depth=queue_depth, max_coalesce=1,
+            name=name,
+        )
+        queue.engine = engine  # warmup + /debug introspection
+        return queue
     if scheduler == "coalesce":
         return RequestQueue(
             lambda prompts, max_new: server.generate_ids(
@@ -227,7 +253,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                scheduler: str = "coalesce", cb_batch: int = 8,
                kv_blocks: int = 0, cb_warmup=(),
                slo_ttft_p99_s: float = 0.0, slo_error_rate: float = 0.0,
-               slo_windows_s=(60.0, 600.0)):
+               slo_windows_s=(60.0, 600.0),
+               role: str = "monolith", replica_id: str = ""):
     import signal
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -296,8 +323,20 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     queue = build_scheduler(
         server, scheduler, queue_depth=queue_depth,
         max_coalesce=max_coalesce, cb_batch=cb_batch, kv_blocks=kv_blocks,
-        name="serve",
+        name="serve", role=role,
     )
+
+    # /healthz identity block (docs/serving.md "Multi-host serving"):
+    # the router (and a human with curl) can tell replicas apart, and
+    # the pid is what lets `tools/router.py drain` ride the SIGTERM
+    # drain contract on same-host topologies
+    identity = {
+        "replica_id": replica_id or f"{host}:{port}",
+        "role": role,
+        "scheduler": "queue" if role == "prefill" else scheduler,
+        "listen": f"{host}:{port}",
+        "pid": os.getpid(),
+    }
 
     # in-flight /generate requests (admission + wait + response write);
     # /healthz surfaces it so an operator tells "busy" from "wedged".
@@ -387,6 +426,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 body = {
                     "ok": not flags["degraded"],
                     "state": state,
+                    "identity": identity,
                     "in_flight": int(reg.value(
                         "pfx_http_requests_in_flight", snap=snap)),
                     "queue_depth": int(reg.value("pfx_queue_depth",
@@ -513,8 +553,97 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 )
 
         def do_POST(self):
-            if self.path != "/generate":
-                return self._json(404, {"error": "unknown path"})
+            parts = urlsplit(self.path)
+            if parts.path == "/generate":
+                if role == "prefill":
+                    # a prefill replica has no decode loop to finish a
+                    # request: an honest 400 beats a silent wrong answer
+                    return self._json(400, {
+                        "error": "--role prefill serves POST /prefill "
+                                 "only (disaggregated topology; see "
+                                 "docs/serving.md)"
+                    })
+                return self._generate()
+            if parts.path == "/prefill":
+                if role != "prefill":
+                    return self._json(404, {"error": "not a prefill replica"})
+                return self._prefill()
+            if parts.path == "/decode":
+                if role != "decode":
+                    return self._json(404, {"error": "not a decode replica"})
+                return self._decode(parts)
+            return self._json(404, {"error": "unknown path"})
+
+        def _fail(self, code: int, msg: str, fut, t0, retry=None):
+            """One failed-request epilogue: span + SLO accounting (400s
+            are the client's fault and spend no SLO budget) + response."""
+            _record_request_span(reg, recorder, t0, fut, code)
+            if code != 400:
+                _slo_observe(code, fut, t0)
+            self._json(code, {"error": msg},
+                       headers={"Retry-After": retry} if retry else None)
+
+        def _await_result(self, fut, deadline_s: float, t0):
+            """THE result-wait ladder, shared by /generate, /prefill and
+            /decode: block bounded by deadline + scheduling slack; on any
+            failure send the honest error (503 shed / 400 / 500) and
+            return None — an unanswerable request never hangs a
+            connection."""
+            try:
+                return fut.result(timeout=deadline_s + shed_slack_s)
+            except TimeoutError:
+                queue.try_remove(fut)  # shed it if still queued
+                self._fail(503, f"deadline {deadline_s:g}s exceeded",
+                           fut, t0, retry="1")
+            except DeadlineExceeded as e:
+                self._fail(503, str(e), fut, t0, retry="1")
+            except QueueClosed as e:  # flushed by a forced shutdown
+                self._fail(503, str(e), fut, t0, retry="5")
+            except ValueError as e:  # bad request that got past checks
+                self._fail(400, str(e), fut, t0)
+            except Exception as e:  # noqa: BLE001 — report, keep serving
+                self._fail(500, str(e), fut, t0)
+            return None
+
+        def _read_deadline(self, raw):
+            """Validate a client deadline: positive, finite, capped by
+            the server ceiling (raises ValueError -> HTTP 400)."""
+            deadline_s = float(raw)
+            if not (deadline_s > 0 and math.isfinite(deadline_s)):
+                raise ValueError(
+                    "deadline_s must be a positive finite number"
+                )
+            return min(deadline_s, max_deadline_s)
+
+        def _submit_guarded(self, submit, t0):
+            """THE admission-rejection contract, shared by /generate,
+            /prefill and /decode: run the queue-submit callable and
+            return its future, or answer 429 (full) / 503 (draining) /
+            400 (pre-admission validation) and return None."""
+            try:
+                return submit()
+            except QueueFull:
+                _slo_observe(429, None, t0)
+                self._json(
+                    429,
+                    {"error": f"queue full ({queue_depth} waiting); "
+                              "retry later"},
+                    headers={"Retry-After": "1"},
+                )
+            except QueueClosed:
+                _slo_observe(503, None, t0)
+                self._json(
+                    503,
+                    {"error": "draining: not admitting new requests"},
+                    headers={"Retry-After": "5"},
+                )
+            except ValueError as e:
+                # pre-admission validation (could-never-fit budget,
+                # incompatible handoff payload): the client's fault
+                self._json(400, {"error": str(e)})
+            return None
+
+        def _generate(self):
             in_flight_gauge.add(1)
             t0 = time.monotonic()
             fut = None
@@ -533,90 +662,37 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     max_toks = clamp_max_tokens(
                         req.get("max_tokens"), server.gen.max_dec_len, cap
                     )
-                    deadline_s = float(
-                        req.get("deadline_s", default_deadline_s)
-                    )
                     # finite floor AND server-side ceiling: an unbounded
                     # client deadline (or JSON Infinity) would pin the
                     # handler thread + connection for as long as the
                     # scheduler stays busy — the hung-connection mode
                     # this queue exists to prevent
-                    if not (deadline_s > 0 and math.isfinite(deadline_s)):
-                        raise ValueError(
-                            "deadline_s must be a positive finite number"
-                        )
-                    deadline_s = min(deadline_s, max_deadline_s)
+                    deadline_s = self._read_deadline(
+                        req.get("deadline_s", default_deadline_s)
+                    )
                     trim, key = plan_request(
                         prompts_ids, max_toks, bucket=bucket, context=context
                     )
                 except (ValueError, TypeError) as e:
                     return self._json(400, {"error": str(e)})
                 # ---- admission control ----
-                try:
-                    fut = queue.submit(
+                fut = self._submit_guarded(
+                    lambda: queue.submit(
                         prompts_ids, trim,
                         coalesce_key=key, deadline_s=deadline_s,
-                    )
-                except QueueFull:
-                    _slo_observe(429, None, t0)
-                    observed = True
-                    return self._json(
-                        429,
-                        {"error": f"queue full ({queue_depth} waiting); "
-                                  "retry later"},
-                        headers={"Retry-After": "1"},
-                    )
-                except QueueClosed:
-                    _slo_observe(503, None, t0)
-                    observed = True
-                    return self._json(
-                        503,
-                        {"error": "draining: not admitting new requests"},
-                        headers={"Retry-After": "5"},
-                    )
-                except ValueError as e:
-                    # continuous-scheduler pre-admission validation: the
-                    # request could NEVER fit the KV pool — a client-side
-                    # misconfiguration, not a server error
-                    return self._json(400, {"error": str(e)})
+                    ),
+                    t0,
+                )
+                if fut is None:
+                    observed = True  # _submit_guarded answered + spent SLO
+                    return
                 # ---- wait, bounded by the deadline + scheduling slack:
                 # an unanswerable request gets an honest 503, never a
                 # hung connection ----
-                try:
-                    rows = fut.result(timeout=deadline_s + shed_slack_s)
-                except TimeoutError:
-                    queue.try_remove(fut)  # shed it if still queued
-                    _record_request_span(reg, recorder, t0, fut, 503)
-                    _slo_observe(503, fut, t0)
-                    observed = True
-                    return self._json(
-                        503,
-                        {"error": f"deadline {deadline_s:g}s exceeded"},
-                        headers={"Retry-After": "1"},
-                    )
-                except DeadlineExceeded as e:
-                    _record_request_span(reg, recorder, t0, fut, 503)
-                    _slo_observe(503, fut, t0)
-                    observed = True
-                    return self._json(
-                        503, {"error": str(e)}, headers={"Retry-After": "1"}
-                    )
-                except QueueClosed as e:  # flushed by a forced shutdown
-                    _record_request_span(reg, recorder, t0, fut, 503)
-                    _slo_observe(503, fut, t0)
-                    observed = True
-                    return self._json(
-                        503, {"error": str(e)}, headers={"Retry-After": "5"}
-                    )
-                except ValueError as e:  # bad request that got past checks
-                    _record_request_span(reg, recorder, t0, fut, 400)
-                    observed = True
-                    return self._json(400, {"error": str(e)})
-                except Exception as e:  # noqa: BLE001 — report, keep serving
-                    _record_request_span(reg, recorder, t0, fut, 500)
-                    _slo_observe(500, fut, t0)
-                    observed = True
-                    return self._json(500, {"error": str(e)})
+                rows = self._await_result(fut, deadline_s, t0)
+                if rows is None:
+                    observed = True  # _await_result spent the span + SLO
+                    return
                 if mode in ("prompt", "prompts"):
                     texts = [server.tokenizer.decode(r) for r in rows]
                     payload = ({"completion": texts[0]} if mode == "prompt"
@@ -645,6 +721,114 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 if not observed:
                     _record_request_span(reg, recorder, t0, fut, 500)
                     _slo_observe(500, fut, t0)
+                return self._json(500, {"error": str(e)})
+            finally:
+                in_flight_gauge.add(-1)
+
+        def _prefill(self):
+            """POST /prefill (role=prefill): run one prompt's paged
+            prefill and answer with the binary KV-handoff payload the
+            router hands to a decode replica.  Same admission surface
+            as /generate: bounded queue (429), deadlines (503 shed),
+            graceful drain."""
+            from paddlefleetx_tpu.core.paged_cache import pack_handoff
+
+            in_flight_gauge.add(1)
+            t0 = time.monotonic()
+            fut = None
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError as e:
+                    return self._json(400, {"error": f"bad JSON: {e}"})
+                try:
+                    ids = req.get("prompt_ids")
+                    if not ids:
+                        raise ValueError("need a non-empty prompt_ids list")
+                    prompt_ids = [int(t) for t in ids]
+                    max_toks = clamp_max_tokens(
+                        req.get("max_tokens"), server.gen.max_dec_len, cap
+                    )
+                    deadline_s = self._read_deadline(
+                        req.get("deadline_s", default_deadline_s)
+                    )
+                except (ValueError, TypeError) as e:
+                    return self._json(400, {"error": str(e)})
+                fut = self._submit_guarded(
+                    lambda: queue.submit(
+                        [prompt_ids], max_toks,
+                        coalesce_key=None, deadline_s=deadline_s,
+                    ),
+                    t0,
+                )
+                if fut is None:
+                    return
+                exports = self._await_result(fut, deadline_s, t0)
+                if exports is None:
+                    return
+                payload = pack_handoff(*exports[0])
+                latency_hist.observe(time.monotonic() - t0)
+                _record_request_span(reg, recorder, t0, fut, 200)
+                _slo_observe(200, fut, t0)
+                return self._send(
+                    200, payload, "application/octet-stream",
+                    headers=(
+                        {"X-Trace-Id": fut.trace.trace_id}
+                        if fut.trace is not None else None
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001 — last-resort guard
+                _record_request_span(reg, recorder, t0, fut, 500)
+                _slo_observe(500, fut, t0)
+                return self._json(500, {"error": str(e)})
+            finally:
+                in_flight_gauge.add(-1)
+
+        def _decode(self, parts):
+            """POST /decode (role=decode): adopt a KV-handoff payload
+            into the continuous scheduler's arena and decode it to
+            completion — the other half of the disaggregated topology.
+            ``?deadline_s=`` rides the query string (the body is the
+            binary payload)."""
+            from paddlefleetx_tpu.core.paged_cache import unpack_handoff
+
+            in_flight_gauge.add(1)
+            t0 = time.monotonic()
+            fut = None
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                try:
+                    raw = (parse_qs(parts.query).get("deadline_s")
+                           or [default_deadline_s])[0]
+                    deadline_s = self._read_deadline(raw)
+                    meta, arrays = unpack_handoff(body)
+                except (ValueError, TypeError) as e:
+                    return self._json(400, {"error": str(e)})
+                fut = self._submit_guarded(
+                    lambda: queue.submit_handoff(
+                        meta, arrays, deadline_s=deadline_s
+                    ),
+                    t0,
+                )
+                if fut is None:
+                    return
+                rows = self._await_result(fut, deadline_s, t0)
+                if rows is None:
+                    return
+                payload = {"completion_ids": rows[0]}
+                if fut.trace is not None:
+                    payload["trace_id"] = fut.trace.trace_id
+                latency_hist.observe(time.monotonic() - t0)
+                _record_request_span(
+                    reg, recorder, t0, fut, 200, tokens=len(rows[0])
+                )
+                _slo_observe(200, fut, t0)
+                return self._json(200, payload)
+            except Exception as e:  # noqa: BLE001 — last-resort guard
+                _record_request_span(reg, recorder, t0, fut, 500)
+                _slo_observe(500, fut, t0)
                 return self._json(500, {"error": str(e)})
             finally:
                 in_flight_gauge.add(-1)
@@ -737,16 +921,24 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         print("warning: not on the main thread; graceful drain handlers "
               "unavailable", flush=True)
 
-    if cb_warmup and scheduler == "continuous":
+    if cb_warmup and role == "prefill":
+        # compile the prefill-export family per bucket before the
+        # listener opens (blocks are freed per export — nothing stays)
+        queue.engine.warmup_prefill([int(n) for n in cb_warmup])
+    elif cb_warmup and scheduler == "continuous":
         # compile (prefill, step) per bucket BEFORE the listener opens —
         # the continuous counterpart of the coalesce-path server.warmup
         queue.warmup([int(n) for n in cb_warmup])
     queue.start()
     threading.Thread(target=_watchdog, name="serve-watchdog",
                      daemon=True).start()
+    endpoint = {"prefill": "POST /prefill", "decode": "POST /decode + /generate"}.get(
+        role, "POST /generate"
+    )
     print(
-        f"serving on {host}:{port} (POST /generate, GET /healthz; "
-        f"scheduler {scheduler}, queue depth {queue_depth}, "
+        f"serving on {host}:{port} ({endpoint}, GET /healthz; "
+        f"role {role}, replica {identity['replica_id']}, "
+        f"scheduler {identity['scheduler']}, queue depth {queue_depth}, "
         f"coalesce {max_coalesce}, "
         f"deadline {default_deadline_s:g}s, watchdog {watchdog_s:g}s)",
         flush=True,
@@ -856,6 +1048,18 @@ def main(argv=None):
     ap.add_argument("--slo-windows", default="60,600",
                     help="comma-separated rolling burn-rate window "
                     "seconds, short first (default 60,600)")
+    ap.add_argument("--role", choices=("monolith", "prefill", "decode"),
+                    default="monolith",
+                    help="disaggregated serving role (docs/serving.md "
+                    "'Multi-host serving'): 'prefill' serves POST "
+                    "/prefill (prompt -> KV-handoff payload), 'decode' "
+                    "adopts payloads via POST /decode and decodes them "
+                    "on the continuous scheduler; 'monolith' (default) "
+                    "is the single-process path")
+    ap.add_argument("--replica-id", default="",
+                    help="stable identity for the /healthz identity "
+                    "block (default host:port) — how tools/router.py "
+                    "and humans tell replicas apart")
     args = ap.parse_args(argv)
     # spec/quant CLI flags become plain config overrides so BOTH
     # schedulers (GenerationServer + PagedDecodeEngine read the same
@@ -864,6 +1068,19 @@ def main(argv=None):
         args.override.append(f"Generation.speculative.draft_k={args.draft_k}")
     if args.kv_dtype:
         args.override.append(f"Generation.speculative.kv_dtype={args.kv_dtype}")
+
+    if args.role != "monolith" and not args.port:
+        ap.error(f"--role {args.role} requires --port (HTTP serving); "
+                 "the stdin REPL has no handoff transport")
+    if args.role == "decode" and args.scheduler != "continuous":
+        # adoption needs the paged arena + iteration-level scheduler;
+        # force it loudly instead of booting a replica that 400s
+        print(
+            "note: --role decode forces --scheduler continuous "
+            "(KV-handoff adoption runs on the paged engine)",
+            file=sys.stderr, flush=True,
+        )
+        args.scheduler = "continuous"
 
     if args.scheduler == "continuous" and not args.port:
         # the REPL serves one prompt at a time through the contiguous
@@ -877,10 +1094,12 @@ def main(argv=None):
         args.scheduler = "coalesce"
 
     server = build_server(args.config, args.override)
-    if not args.no_warmup and args.scheduler == "continuous":
-        # the coalesce-path warmup would compile artifacts continuous
-        # serving never calls; the engine warms its own (prefill, step)
-        # pairs inside serve_http before the listener opens
+    if not args.no_warmup and (
+        args.scheduler == "continuous" or args.role == "prefill"
+    ):
+        # the coalesce-path warmup would compile artifacts continuous/
+        # prefill serving never calls; the engine warms its own families
+        # inside serve_http before the listener opens
         pass
     elif not args.no_warmup:
         batches = _csv_ints(args.warmup_batches)
@@ -901,7 +1120,9 @@ def main(argv=None):
 
     if args.port:
         cb_warmup = ()
-        if args.scheduler == "continuous" and not args.no_warmup:
+        if not args.no_warmup and (
+            args.scheduler == "continuous" or args.role == "prefill"
+        ):
             cb_warmup = tuple(_csv_ints(args.warmup_buckets) or [8])
         return serve_http(
             server, args.port, args.host,
@@ -921,6 +1142,8 @@ def main(argv=None):
             slo_windows_s=tuple(
                 float(x) for x in args.slo_windows.split(",") if x.strip()
             ),
+            role=args.role,
+            replica_id=args.replica_id,
         )
 
     # REPL: one prompt per line -> completion (ids mode when no tokenizer)
