@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-spec test-trace test-router bench bench-check
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-spec test-trace test-router test-elastic bench bench-check
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -17,7 +17,7 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_chunked_ce.py tests/test_lint.py \
              tests/test_telemetry.py tests/test_tracing.py \
              tests/test_bench_helpers.py tests/test_bench_cases.py \
-             tests/test_router.py
+             tests/test_router.py tests/test_controller.py
 
 # lint runs inside the gate via tests/test_lint.py::test_repo_is_clean
 test-fast:
@@ -111,6 +111,16 @@ test-spec:
 # + tools/router.py CLIs (docs/serving.md "Multi-host serving")
 test-router:
 	python -m pytest tests/test_router.py tests/test_kv_handoff.py tests/test_router_drills.py -q
+
+# elastic-control-plane gate: controller/supervisor units against stub
+# cores + injected clocks, the router-core remote-drain/auth/rejoin
+# units, and the chaos drills through the real CLIs — authenticated
+# remote drain + /debug gating, crash-loop quarantine within the flap
+# budget, SIGKILL-under-flood supervisor restart + router re-admission,
+# SLO-breach scale-up + burn recovery (docs/serving.md "Elastic control
+# plane")
+test-elastic:
+	python -m pytest tests/test_controller.py tests/test_router.py tests/test_elastic_drills.py -q
 
 bench:
 	python benchmarks/run_benchmark.py

@@ -119,6 +119,59 @@ def wait_for_backend() -> bool:
         time.sleep(min(30, max(1, deadline - time.time())))
 
 
+# the one fixed shape every cpu-fallback lap runs (identical across laps
+# so `make bench-check` compares like with like; finishes in well under a
+# minute on one core where the real 345M shape cannot)
+CPU_FALLBACK_SHAPE = {
+    "BENCH_VOCAB": "8192",
+    "BENCH_HIDDEN": "256",
+    "BENCH_LAYERS": "4",
+    "BENCH_HEADS": "8",
+    "BENCH_SEQ": "256",
+    "BENCH_BATCH": "4",
+    "BENCH_STEPS": "4",
+}
+
+
+def ensure_backend_or_fallback() -> str:
+    """Dead-backend fallback (ROADMAP open item: BENCH_r02..r05 were
+    four flat-zero "tpu backend unreachable" laps after r01 measured a
+    real number — four laps of noise that `make bench-check` could only
+    skip).  When the default/pinned TPU backend does not answer within
+    the probe window, repoint the child at the CPU backend and RUN the
+    benchmark there: an honest row on the backend that exists (the row
+    carries ``platform`` so tools/bench_check.py compares like with
+    like) beats a value-0.0 placeholder.  Returns the fallback note
+    ("" when no fallback was needed).  Child-process only (the parent
+    never imports jax)."""
+    platform = os.environ.get("PFX_PLATFORM", "").lower()
+    if platform not in ("", "tpu", "axon"):
+        return ""  # explicitly pinned elsewhere (cpu smoke): no probe
+    if wait_for_backend():
+        return ""
+    os.environ["PFX_PLATFORM"] = "cpu"
+    from paddlefleetx_tpu.utils.device import apply_platform_env
+
+    apply_platform_env()
+    # the REAL 345M shape cannot finish on one CPU core inside the
+    # parent's BENCH_DEADLINE_S window (compile alone is minutes) — the
+    # fallback would then time out into the exact value-0.0 placeholder
+    # it exists to eliminate.  Pin ONE fixed small shape for every
+    # fallback lap (setdefault: explicit operator knobs still win), so
+    # cpu laps are comparable with EACH OTHER and finish in seconds;
+    # the row's unit names the shrink so it never reads as chip-scale.
+    for knob, val in CPU_FALLBACK_SHAPE.items():
+        os.environ.setdefault(knob, val)
+    note = (
+        "bench: tpu backend unreachable after the probe window; "
+        "falling back to the cpu backend with the fixed fallback shape "
+        '— the row is labeled platform="cpu" and is only compared '
+        "against other cpu laps"
+    )
+    print(note, file=sys.stderr, flush=True)
+    return note
+
+
 def _honest_row(reason: str) -> dict:
     return {
         "metric": METRIC,
@@ -225,13 +278,10 @@ def _child() -> None:
     apply_platform_env()
 
     # probe unless explicitly pinned to a non-TPU platform (a pinned
-    # PFX_PLATFORM=tpu must still be guarded — it is the hang case)
-    platform = os.environ.get("PFX_PLATFORM", "").lower()
-    if platform in ("", "tpu", "axon"):
-        if not wait_for_backend():
-            # emit an honest failure line rather than hanging the driver
-            print(json.dumps(_honest_row("tpu backend unreachable")), flush=True)
-            return
+    # PFX_PLATFORM=tpu must still be guarded — it is the hang case);
+    # an unreachable TPU now falls back to benchmarking the backend
+    # that EXISTS instead of emitting a value-0.0 placeholder lap
+    fallback = ensure_backend_or_fallback()
 
     import jax
     import numpy as np
@@ -366,7 +416,11 @@ def _child() -> None:
             {
                 "metric": METRIC,
                 "value": round(tokens_per_s / n_dev, 1),
-                "unit": "tokens/s/chip",
+                # the fallback suffix keeps a shrunk-shape cpu lap from
+                # ever reading as chip-scale evidence (value > 0, so
+                # bench_check still compares it against other cpu laps)
+                "unit": ("tokens/s/chip (cpu-fallback shape)" if fallback
+                         else "tokens/s/chip"),
                 "vs_baseline": round(tokens_per_s / n_dev / BASELINE_TOKENS_PER_S, 3),
                 "tokens_per_sec": round(tokens_per_s, 1),
                 # 6 digits: CPU smoke shapes under forced multi-device

@@ -30,12 +30,15 @@ host-side composition of contracts that already exist (docs/serving.md
     a reset mid-response, a read timeout — returns an honest 503 and is
     never replayed, because the decode may have happened (the
     "never retry partial responses" rule).
-  - **rolling drain** rides the PR 3 SIGTERM contract end-to-end:
-    `drain()` marks the replica ineligible, signals its pid (from the
-    /healthz ``identity`` block — same-host deploys), and the poller
-    walks it draining -> gone as it answers its admitted work and exits
-    0.  Drain one, redeploy, wait ``serving``, drain the next: that is
-    the whole rolling deploy (runbook in docs/serving.md).
+  - **rolling drain** rides the PR 3 drain contract end-to-end, now
+    CROSS-HOST: `drain()` marks the replica ineligible and POSTs an
+    authenticated ``/admin/drain`` to it (shared ``PFX_ADMIN_TOKEN``
+    bearer token; see :func:`check_admin`) — the replica answers its
+    admitted work and exits 0, and the poller walks it draining ->
+    gone.  A replica that predates ``/admin/drain`` (404) falls back to
+    the old same-host SIGTERM on its identity pid.  Drain one,
+    redeploy, wait ``serving``, drain the next: that is the whole
+    rolling deploy (runbook in docs/serving.md).
   - **disaggregation**: with separate ``prefill`` and ``decode`` pools,
     `generate_disaggregated` runs each prompt's prefill on a prefill
     replica (-> KV-handoff payload, `core/paged_cache.pack_handoff`),
@@ -53,6 +56,7 @@ retries) for ``GET /debug/traces``.
 from __future__ import annotations
 
 import dataclasses
+import hmac
 import http.client
 import json
 import os
@@ -69,6 +73,67 @@ from paddlefleetx_tpu.utils.telemetry import get_registry
 REPLICA_STATES = ("booting", "warm", "serving", "draining", "gone")
 STATE_CODE = {s: i for i, s in enumerate(REPLICA_STATES)}
 
+# ---------------------------------------------------------------------------
+# shared-token admin auth: THE auth rule for every /admin/* and /debug/*
+# endpoint in the serving fleet (tools/serve.py AND tools/router.py), so a
+# remote drain works cross-host without shipping an unauthenticated
+# kill-switch.  One shared token via PFX_ADMIN_TOKEN; token unset means
+# loopback-only, loudly (docs/serving.md "Elastic control plane").
+# ---------------------------------------------------------------------------
+
+ADMIN_TOKEN_ENV = "PFX_ADMIN_TOKEN"
+_LOCAL_ONLY_WARNED = [False]  # once per process, reset by tests
+
+
+def admin_token() -> str:
+    """The fleet-shared admin token (empty = unset)."""
+    return (os.environ.get(ADMIN_TOKEN_ENV) or "").strip()
+
+
+def admin_headers() -> Dict[str, str]:
+    """Outbound auth headers for an /admin call (empty dict when no
+    token is configured — the callee then applies its loopback rule)."""
+    tok = admin_token()
+    return {"Authorization": f"Bearer {tok}"} if tok else {}
+
+
+def check_admin(headers: Any, client_address: Any, *,
+                what: str = "/admin") -> Tuple[bool, Optional[int], Optional[str]]:
+    """Authorize one admin/debug request: ``(ok, http_code, message)``.
+
+    Token set: the request must carry ``Authorization: Bearer <token>``
+    (constant-time compare) — anything else is 401.  Token UNSET: only
+    loopback clients are allowed (403 otherwise), and the first allowed
+    request logs a LOUD warning so an operator who exposed the port
+    beyond localhost knows the admin surface is gated off, not open.
+    ``headers`` is any ``.get()``-able mapping; ``client_address`` is the
+    ``(host, port)`` pair http.server hands a handler."""
+    tok = admin_token()
+    auth = str((headers.get("Authorization") if headers is not None else "") or "")
+    supplied = auth[len("Bearer "):].strip() if auth.startswith("Bearer ") else ""
+    if tok:
+        if supplied and hmac.compare_digest(supplied, tok):
+            return True, None, None
+        return (False, 401,
+                f"{what} requires a valid {ADMIN_TOKEN_ENV} bearer token")
+    host = str(client_address[0]) if client_address else ""
+    # ::ffff:127.x is a genuine loopback client seen through a
+    # dual-stack (--host ::) bind — it must not be locked out
+    if (host == "::1" or host.startswith("127.")
+            or host.startswith("::ffff:127.")):
+        if not _LOCAL_ONLY_WARNED[0]:
+            _LOCAL_ONLY_WARNED[0] = True
+            logger.warning(
+                f"{ADMIN_TOKEN_ENV} is unset: /admin and /debug endpoints "
+                "are LOCALHOST-ONLY.  Set the shared token on every "
+                "replica and router to enable authenticated remote "
+                "drains (docs/serving.md)"
+            )
+        return True, None, None
+    return (False, 403,
+            f"{what} is localhost-only while {ADMIN_TOKEN_ENV} is unset; "
+            "set the shared token to enable remote admin")
+
 
 class NoReplicaAvailable(RuntimeError):
     """No eligible replica for the requested role (HTTP 503)."""
@@ -77,6 +142,13 @@ class NoReplicaAvailable(RuntimeError):
 class ReplicaUnavailable(RuntimeError):
     """Dispatch failed after bytes may have been exchanged — honest 503,
     NEVER retried on another replica (the decode may have happened)."""
+
+
+class RequestNotSent(ReplicaUnavailable):
+    """Transport failed BEFORE the request went out (connect timeout,
+    non-refused OSError): nothing downstream processed anything.  The
+    drain path restores the target to rotation on this class — only a
+    reply lost AFTER the exchange leaves it draining for the poller."""
 
 
 @dataclasses.dataclass
@@ -95,6 +167,8 @@ class Replica:
     healthy: bool = False   # healthz ok (False while degraded)
     depth: int = 0
     busy_s: float = 0.0
+    occupancy: float = 0.0  # continuous-batch rows/capacity (0 otherwise)
+    slo_breach: bool = False  # replica-reported SLO burn-rate breach
     last_poll: float = 0.0
     ok_streak: int = 0
     failures: int = 0
@@ -122,12 +196,24 @@ class Replica:
             "eligible": self.eligible(),
             "depth": self.depth,
             "busy_s": round(self.busy_s, 3),
+            "occupancy": round(self.occupancy, 4),
+            "slo_breach": self.slo_breach,
             "in_flight": self.in_flight,
             "last_latency_s": round(self.last_latency_s, 4),
             "failures": self.failures,
             "role_mismatch": self.role_mismatch,
             "draining": self.drain_requested or self.state == "draining",
         }
+
+
+def _local_url(base_url: str) -> bool:
+    """True when the url's host is THIS host's loopback — the only case
+    where the legacy SIGTERM-by-pid drain fallback is safe (a /healthz
+    identity pid from another host is a valid pid HERE for some
+    unrelated process)."""
+    host = (urlsplit(base_url).hostname or "").lower()
+    return (host == "localhost" or host == "::1"
+            or host.startswith("127.") or host.startswith("::ffff:127."))
 
 
 def _http_request(base_url: str, method: str, path: str, body=None,
@@ -154,7 +240,7 @@ def _http_request(base_url: str, method: str, path: str, body=None,
                 111, 113,  # ECONNREFUSED, EHOSTUNREACH
             ):
                 raise ConnectionRefusedError(str(e)) from e
-            raise ReplicaUnavailable(f"send failed: {e}") from e
+            raise RequestNotSent(f"send failed: {e}") from e
         try:
             resp = conn.getresponse()
             data = resp.read()
@@ -179,8 +265,11 @@ class RouterCore:
                  max_inflight: int = 64, retries: int = 2,
                  poll_interval_s: float = 0.5, poll_timeout_s: float = 2.0,
                  eject_after: int = 3, serve_after: int = 1,
-                 name: str = "router") -> None:
-        if not replicas:
+                 allow_empty: bool = False, name: str = "router") -> None:
+        if not replicas and not allow_empty:
+            # allow_empty is the supervised topology (tools/router.py
+            # --supervise): the controller registers replicas via
+            # add_replica as it spawns them
             raise ValueError("router needs >= 1 replica")
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
@@ -208,20 +297,21 @@ class RouterCore:
             self.replicas[f"r{i}"] = Replica(
                 key=f"r{i}", url=url.rstrip("/"), role=role
             )
+        self._next_slot = len(self.replicas)
         roles = {r.role for r in self.replicas.values()}
         if "monolith" in roles and roles != {"monolith"}:
             raise ValueError(
                 "mixing monolith replicas with prefill/decode pools is not "
                 "supported; run either --replica or --prefill/--decode"
             )
-        if roles != {"monolith"} and not (
+        if roles and roles != {"monolith"} and not (
             "prefill" in roles and "decode" in roles
         ):
             raise ValueError(
                 "disaggregated mode needs BOTH --prefill and --decode "
                 f"replicas (got roles {sorted(roles)})"
             )
-        self.disaggregated = roles != {"monolith"}
+        self.disaggregated = bool(roles) and roles != {"monolith"}
         reg = get_registry()
         self._requests = lambda replica, outcome: reg.counter(
             "pfx_router_requests_total", replica=replica, outcome=outcome
@@ -243,6 +333,29 @@ class RouterCore:
                 rows.append(("pfx_router_replica_state", {"replica": key},
                              float(STATE_CODE[r.state])))
         return rows
+
+    # -- dynamic registration (elastic control plane) --------------------
+    def add_replica(self, url: str, role: str = "monolith") -> str:
+        """Register a replica at runtime (the controller calls this as
+        the supervisor spawns one).  Idempotent on url: re-registering a
+        known url returns its existing key — a respawned process on the
+        same port re-enters the rotation through the normal gone ->
+        warm -> serving walk, it does not get a second slot."""
+        if role not in ("monolith", "prefill", "decode"):
+            raise ValueError(
+                f"unknown replica role {role!r}; "
+                "valid: monolith, prefill, decode"
+            )
+        url = url.rstrip("/")
+        with self._lock:
+            for r in self.replicas.values():
+                if r.url == url:
+                    return r.key
+            key = f"r{self._next_slot}"
+            self._next_slot += 1
+            self.replicas[key] = Replica(key=key, url=url, role=role)
+        logger.info(f"{self.name}: replica {key} registered ({url}, {role})")
+        return key
 
     # -- health polling + lifecycle -------------------------------------
     def poll_replica(self, r: Replica) -> None:
@@ -280,6 +393,10 @@ class RouterCore:
             r.healthy = bool(h.get("ok", False))
             r.depth = int(h.get("queue_depth", 0))
             r.busy_s = float(h.get("busy_s", 0.0))
+            # elastic-control signals (core/controller.py): continuous-
+            # batch occupancy and the replica's own SLO breach verdict
+            r.occupancy = float(h.get("occupancy", 0.0) or 0.0)
+            r.slo_breach = bool((h.get("slo") or {}).get("breach", False))
             ident = h.get("identity") or {}
             old_pid = r.pid
             if ident:
@@ -607,12 +724,19 @@ class RouterCore:
     # -- rolling drain ---------------------------------------------------
     def drain(self, replica_key: Optional[str] = None) -> Dict[str, Any]:
         """Initiate a drain-one-replica deploy step: mark the replica
-        ineligible (no new traffic), send SIGTERM to its pid (from the
-        /healthz identity block — same-host topology), and let the PR 3
-        drain contract finish its admitted work and exit 0; the poller
-        then walks it draining -> gone.  Picks the least-loaded serving
+        ineligible (no new traffic) and POST the authenticated
+        ``/admin/drain`` to it (shared ``PFX_ADMIN_TOKEN`` — the remote
+        transport that makes rolling deploys work CROSS-HOST); the PR 3
+        drain contract finishes its admitted work and exits 0, and the
+        poller walks it draining -> gone.  A replica that predates
+        ``/admin/drain`` (404) falls back to SIGTERM on its identity
+        pid — same-host topologies only.  Picks the least-loaded serving
         replica when none is named.  Raises ValueError when the target
-        does not exist / is already gone / never reported a pid."""
+        does not exist / is already gone, or when the drain provably
+        did NOT land — auth rejected, 404 with no safe local-pid
+        fallback, any other non-200 — in which case the target is first
+        RESTORED to rotation: a failed drain must not blackhole a
+        healthy replica while reporting success."""
         with self._lock:
             if replica_key is None:
                 candidates = [
@@ -636,22 +760,91 @@ class RouterCore:
                     )
             if target.state == "gone":
                 raise ValueError(f"replica {target.key} is already gone")
-            if target.pid is None:
-                raise ValueError(
-                    f"replica {target.key} never reported a pid via its "
-                    "/healthz identity block; cannot signal it"
-                )
+            prev_state = target.state
             target.drain_requested = True
             self._transition(target, "draining", "drain requested")
             pid = target.pid
             key = target.key
-        try:
-            os.kill(pid, signal.SIGTERM)
-        except ProcessLookupError:
+            url = target.url
+        def _restore(why: str) -> None:
+            # a drain that provably did NOT land must put the target
+            # back in rotation — leaving it marked draining would
+            # blackhole a healthy replica while reporting success
             with self._lock:
-                self._transition(target, "gone", "pid already exited")
+                target.drain_requested = False
+                self._transition(target, prev_state, why)
+
+        # the HTTP leg runs OUTSIDE the lock (the poll loop and /metrics
+        # collectors take it; a slow replica must not wedge them)
+        status: Optional[int] = None
+        try:
+            status, body, _ = _http_request(
+                url, "POST", "/admin/drain", body=b"{}",
+                headers={"Content-Type": "application/json",
+                         **admin_headers()},
+                timeout=max(self.poll_timeout_s, 5.0),
+            )
+        except ConnectionRefusedError:
+            with self._lock:
+                self._transition(target, "gone",
+                                 "refused the drain call: already exited")
+        except RequestNotSent as e:
+            # the request never went out (connect stall / send failure):
+            # nothing downstream saw it — back in rotation, loudly
+            _restore("drain POST not sent")
+            raise ValueError(
+                f"drain POST to {key} could not be sent ({e}); the "
+                "replica was left in rotation — retry when the network "
+                "settles"
+            ) from e
+        except ReplicaUnavailable as e:
+            # bytes were exchanged: the drain may have landed — leave the
+            # replica draining and let the poller decide (it walks a
+            # drained process to gone, and a redeploy clears the flag)
+            logger.warning(
+                f"{self.name}: drain POST to {key} lost mid-exchange "
+                f"({e}); leaving it draining for the poller"
+            )
+
+        if status in (401, 403):
+            _restore("drain auth rejected")
+            raise ValueError(
+                f"replica {key} rejected the drain auth (HTTP {status}); "
+                f"set the same {ADMIN_TOKEN_ENV} on the router and every "
+                "replica (docs/serving.md)"
+            )
+        if status == 404:
+            if pid is not None and _local_url(url):
+                # pre-/admin replica on THIS host: the legacy SIGTERM
+                # transport (a pid from another host must never be
+                # signalled here — it names an unrelated local process)
+                logger.warning(
+                    f"{self.name}: {key} has no /admin/drain (404); "
+                    f"falling back to SIGTERM on identity pid {pid} "
+                    "(same-host only)"
+                )
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except ProcessLookupError:
+                    with self._lock:
+                        self._transition(target, "gone",
+                                         "pid already exited")
+            else:
+                _restore("no drain transport")
+                raise ValueError(
+                    f"replica {key} has no /admin/drain (404) and cannot "
+                    f"be signalled (pid {pid}, url {url} "
+                    f"{'local' if _local_url(url) else 'NOT local'}); "
+                    "upgrade the replica or drain it on its own host"
+                )
+        elif status is not None and status != 200:
+            _restore(f"drain refused (HTTP {status})")
+            raise ValueError(
+                f"replica {key} answered the drain POST with HTTP "
+                f"{status}; it was left in rotation"
+            )
         self._drains_ctr.inc()
-        logger.info(f"{self.name}: drain initiated for {key} (pid {pid})")
+        logger.info(f"{self.name}: drain initiated for {key} ({url})")
         return {"replica": key, "pid": pid, "state": target.state}
 
     # -- views -----------------------------------------------------------

@@ -52,6 +52,13 @@ traffic drills in tests/test_serve_drills.py assert the behavior):
                        fires it between steps — a mid-decode stall that
                        carries active rows past their deadlines, driving
                        the eviction drills in tests/test_paged_drills.py)
+  ``boot_crash:K``     hard-exit (os._exit 23) at `tools/serve.py` boot,
+                       right after argument parsing — a replica that can
+                       never come up (bad image, broken config).  Drives
+                       the crash-loop -> supervisor-quarantine drill in
+                       tests/test_elastic_drills.py (the supervisor must
+                       stop restarting it LOUDLY within the flap budget,
+                       docs/serving.md "Elastic control plane")
 
 Data sites (step counts are *sample fetch* indices inside the host data
 loader — ``data/batch_sampler.py`` fires them; the data drills in
@@ -192,7 +199,7 @@ def retry(
 
 FAULT_SITES = (
     "sigterm", "save_crash", "ckpt_truncate", "nan_grads",
-    "gen_crash", "gen_hang", "cb_step_hang",
+    "gen_crash", "gen_hang", "cb_step_hang", "boot_crash",
     "corrupt_sample", "io_stall",
 )
 
@@ -297,6 +304,11 @@ def maybe_fire(site: str, step: int, path: Optional[str] = None) -> bool:
         raise RuntimeError(
             f"PFX_FAULT: injected gen_crash at request {step}"
         )
+    elif site == "boot_crash":
+        # a replica that can never come up: os._exit skips every
+        # finally/atexit, the closest in-process stand-in for a broken
+        # image — the supervisor sees a nonzero exit within seconds
+        os._exit(23)
     elif site in ("gen_hang", "cb_step_hang"):
         time.sleep(_env_float("PFX_FAULT_HANG_S", 3600.0))
     elif site == "corrupt_sample":

@@ -174,6 +174,15 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_router_drains_total": ("counter", "Replica drains initiated through the router"),
     "pfx_router_handoff_bytes_total": ("counter", "KV-handoff payload bytes moved prefill -> decode"),
     "pfx_router_handoff_seconds": ("histogram", "Prefill dispatch + handoff transfer seconds per prompt"),
+    # elastic control plane (core/controller.py + tools/router.py
+    # --supervise; docs/serving.md "Elastic control plane")
+    "pfx_controller_ticks_total": ("counter", "Control-loop evaluations (one decision row each)"),
+    "pfx_controller_scale_ups_total": ("counter", "Replica scale-up decisions executed"),
+    "pfx_controller_scale_downs_total": ("counter", "Replica scale-down (rolling-drain) decisions executed"),
+    "pfx_controller_target_replicas": ("gauge", "Replica count the controller is steering toward"),
+    "pfx_controller_breach": ("gauge", "1 while the controller sees a scale signal breached (SLO burn / depth / occupancy)"),
+    "pfx_replica_restarts_total": ("counter", "Supervisor restarts of managed replicas after unexpected exits (labels: replica; only crashes spend the flap budget)"),
+    "pfx_replica_quarantines_total": ("counter", "Managed replicas quarantined after crash-looping past the flap budget (labels: replica)"),
     # SLO burn rates (telemetry.SLOTracker; labels: objective, window)
     "pfx_slo_objective": ("gauge", "Configured SLO objective value by objective label"),
     "pfx_slo_burn_rate": ("gauge", "Error-budget burn rate over a rolling window (labels: objective, window)"),

@@ -89,7 +89,57 @@ def test_disjoint_metrics_pass_loudly(tmp_path, capsys):
     _write(tmp_path, 1, _row("old_metric", 10.0))
     _write(tmp_path, 2, _row("new_metric", 10.0))
     assert bench_check.main(["--dir", str(tmp_path)]) == 0
-    assert "share no metric" in capsys.readouterr().out
+    out = capsys.readouterr().out
+    assert "disjoint metric" in out and "PASS by default (loudly)" in out
+
+
+def test_platform_change_not_compared(tmp_path, capsys):
+    """SATELLITE (dead-backend fallback): a cpu fallback lap after tpu
+    laps is a platform change, not a 98% regression — the comparison
+    skips it loudly and never flags nonsense."""
+    _write(tmp_path, 1, dict(_row("tp", 18981.0), platform="tpu"))
+    _write(tmp_path, 2, dict(_row("tp", 300.0), platform="cpu"))
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "REGRESSION" not in out
+    assert "platform changed" in out and "tpu -> cpu" in out
+
+
+def test_platform_fallback_compares_same_platform_laps(tmp_path, capsys):
+    """cpu fallback laps compare against the previous cpu lap (walking
+    past an interleaved tpu lap), and a real cpu regression still
+    flags."""
+    _write(tmp_path, 1, dict(_row("tp", 300.0), platform="cpu"))
+    _write(tmp_path, 2, dict(_row("tp", 19000.0), platform="tpu"))
+    _write(tmp_path, 3, dict(_row("tp", 200.0), platform="cpu"))  # -33% vs r1
+    assert bench_check.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "[cpu]" in out
+    assert "r1=300" in out and "r3=200" in out
+
+
+def test_cpu_fallback_unit_suffix_rows_are_comparable(tmp_path, capsys):
+    """The exact row bench.py's dead-backend fallback emits — value > 0
+    with a '(cpu-fallback shape)' unit suffix — must COMPARE against
+    other fallback laps (the parenthetical-skip rule only fires on
+    value 0)."""
+    row = dict(_row("tp", 420.0, unit="tokens/s/chip (cpu-fallback shape)"),
+               platform="cpu")
+    _write(tmp_path, 1, row)
+    _write(tmp_path, 2, dict(row, value=300.0))  # -29%: a real cpu drop
+    assert bench_check.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "SKIP" not in out
+
+
+def test_legacy_rows_without_platform_only_match_each_other(tmp_path, capsys):
+    """Pre-PR 5 rows carry no platform field; a platform-labeled lap
+    must not be compared against them (r1 ran on a real chip but its
+    row cannot prove it)."""
+    _write(tmp_path, 1, _row("tp", 18981.0))  # legacy: no platform
+    _write(tmp_path, 2, dict(_row("tp", 300.0), platform="cpu"))
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    assert "REGRESSION" not in capsys.readouterr().out
 
 
 def test_real_repo_history_is_parseable():

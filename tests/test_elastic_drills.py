@@ -1,0 +1,472 @@
+"""Elastic-control-plane chaos drills through the real CLIs
+(`make test-elastic`, docs/serving.md "Elastic control plane"):
+`tools/router.py --supervise` spawning real `tools/serve.py` replicas.
+
+  remote drain   POST /admin/drain on serve.py IS the SIGTERM drain
+                 contract over authenticated HTTP: 401 without the
+                 fleet token, drain + exit 0 with it; /debug/* rides
+                 the same gate.
+  crash loop     a replica that can never boot (PFX_FAULT=boot_crash)
+                 is restarted with backoff then QUARANTINED loudly
+                 within the flap budget — and the controller decision
+                 log replays to exact agreement with the
+                 pfx_controller_* counters.
+  SIGKILL        a replica killed under flood is restarted by the
+                 supervisor and re-admitted by the router (gone ->
+                 warm -> serving, new pid) with zero dropped admitted
+                 requests — every response an honest 200/503, no hangs.
+  breach         a flood past one replica's capacity burns its
+                 error-rate SLO -> breach -> the controller spawns a
+                 warm-booted replica -> the breach recovers.
+
+Follows tests/test_router_drills.py conventions: `fault`-marked,
+subprocess-driven, tiny synthetic GPT, persistent XLA compile cache
+shared through the environment (tests/conftest.py)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CACHE_DIR = os.environ.get(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+
+TINY = {
+    "Global": {"global_batch_size": 8, "seed": 11},
+    "Engine": {"mix_precision": {"enable": False},
+               "save_load": {"save_steps": 0}},
+    "Model": {
+        "module": "GPTModule",
+        "vocab_size": 96,
+        "hidden_size": 32,
+        "num_layers": 2,
+        "num_attention_heads": 4,
+        "max_position_embeddings": 64,
+        "dtype": "float32",
+    },
+    "Optimizer": {"name": "FusedAdamW",
+                  "lr": {"name": "Constant", "learning_rate": 1e-3}},
+    "Generation": {"max_dec_len": 8, "decode_strategy": "greedy_search",
+                   "pad_to_multiple": 8, "eos_token_id": 95,
+                   "pad_token_id": 0},
+}
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env["PFX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("PFX_FAULT", None)
+    env.pop("PFX_ADMIN_TOKEN", None)
+    env.update(extra or {})
+    return env
+
+
+def _req(port, path, data=None, headers=None, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=None if data is None else json.dumps(data).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _metrics(port, timeout=10):
+    from test_telemetry import parse_prometheus
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=timeout
+    ) as r:
+        metrics, _ = parse_prometheus(r.read().decode())
+    return metrics
+
+
+def _finish(proc, timeout=30):
+    if proc is None:
+        return ""
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+    return proc.stdout.read() if proc.stdout else ""
+
+
+def _serve_cmd(cfg_path, *extra):
+    """A serve.py command TEMPLATE for --replica-cmd ({port} and
+    {replica_id} stay as placeholders for the supervisor)."""
+    return " ".join([
+        sys.executable, os.path.join(REPO, "tools", "serve.py"),
+        "-c", str(cfg_path), "--port", "{port}",
+        "--replica-id", "{replica_id}",
+        "--warmup-buckets", "4", "--warmup-batches", "1",
+        "--deadline", "60", *extra,
+    ])
+
+
+def _spawn_supervised_router(rport, cfg_path, tmp_path, *, serve_extra=(),
+                             router_extra=(), env_extra=None):
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "router.py"),
+         "--port", str(rport), "--poll-interval", "0.2",
+         "--supervise",
+         "--replica-cmd", _serve_cmd(cfg_path, *serve_extra),
+         "--base-port", str(_free_port()),
+         "--compile-cache-dir", CACHE_DIR,
+         "--replica-log-dir", str(tmp_path / "replica-logs"),
+         "--control-interval", "0.5",
+         *router_extra],
+        env=_env(env_extra), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _wait(predicate, timeout, what):
+    end = time.time() + timeout
+    last = None
+    while time.time() < end:
+        try:
+            last = predicate()
+            if last:
+                return last
+        except Exception as e:  # noqa: BLE001 — listener still booting
+            last = e
+        time.sleep(0.3)
+    raise AssertionError(f"timeout waiting for {what}: {last!r}")
+
+
+def _replay_agrees(rport):
+    """Fetch the controller decision log and /metrics until no tick
+    lands between the two reads, then assert the replay contract: the
+    untruncated log reproduces the pfx_controller_* counters EXACTLY."""
+    from paddlefleetx_tpu.core.controller import replay_controller_log
+
+    for _ in range(10):
+        _, dbg = _req(rport, "/debug/controller")
+        m = _metrics(rport)
+        _, dbg2 = _req(rport, "/debug/controller")
+        if len(dbg["decisions"]) != len(dbg2["decisions"]):
+            continue  # a tick landed mid-read; retry
+        replay = replay_controller_log(dbg["decisions"])
+        assert m["pfx_controller_ticks_total"][frozenset()] == replay["ticks"]
+        assert (m.get("pfx_controller_scale_ups_total", {})
+                .get(frozenset(), 0.0) == replay["scale_ups"])
+        assert (m.get("pfx_controller_scale_downs_total", {})
+                .get(frozenset(), 0.0) == replay["scale_downs"])
+        return replay
+    raise AssertionError("controller never quiesced between reads")
+
+
+# ---------------------------------------------------------------------------
+# authenticated remote drain (tools/serve.py /admin + /debug gating)
+# ---------------------------------------------------------------------------
+
+
+def test_remote_drain_is_authenticated_and_honors_drain_contract(tmp_path):
+    """THE remote-drain acceptance drill on one real replica with
+    PFX_ADMIN_TOKEN set: /debug/state and /admin/drain answer 401
+    without the bearer token (even from localhost); with it, /debug
+    serves and /admin/drain runs the PR 3 contract — draining state,
+    admitted work answered, exit 0 — with no signal ever sent."""
+    cfg_path = tmp_path / "tiny.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "-c", str(cfg_path), "--port", str(port),
+         "--warmup-buckets", "4", "--warmup-batches", "1",
+         "--deadline", "60"],
+        env=_env({"PFX_ADMIN_TOKEN": "fleet-secret"}), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    tok = {"Authorization": "Bearer fleet-secret"}
+    try:
+        _wait(lambda: _req(port, "/healthz")[1].get("ok"), 300,
+              "replica healthy")
+        # /healthz and /metrics stay open (the router polls them)
+        code, h = _req(port, "/healthz")
+        assert code == 200 and "occupancy" in h, h
+        # /debug is gated: 401 naked, 200 with the token
+        code, body = _req(port, "/debug/state")
+        assert code == 401 and "PFX_ADMIN_TOKEN" in body["error"], body
+        code, _ = _req(port, "/debug/state", headers=tok)
+        assert code == 200
+        # /admin/drain: 401 naked (the unauthenticated kill-switch must
+        # not exist), wrong token 401 too
+        code, _ = _req(port, "/admin/drain", data={})
+        assert code == 401
+        code, _ = _req(port, "/admin/drain", data={},
+                       headers={"Authorization": "Bearer wrong"})
+        assert code == 401
+        assert _req(port, "/healthz")[1]["state"] == "ok"  # still serving
+        # a request keeps working, then the authenticated drain fires
+        code, ref = _req(port, "/generate",
+                         data={"prompt_ids": [1, 2, 3], "max_tokens": 8})
+        assert code == 200
+        code, body = _req(port, "/admin/drain", data={}, headers=tok)
+        assert code == 200 and body["state"] == "draining", body
+        # the PR 3 contract, remote spelling: exit 0, clean drain
+        assert proc.wait(timeout=60) == 0
+    finally:
+        log = _finish(proc)
+    assert "draining" in log and "drained cleanly" in log, log[-3000:]
+    assert "Traceback" not in log, log[-3000:]
+
+
+# ---------------------------------------------------------------------------
+# crash loop -> flap-budget quarantine (+ decision-log replay agreement)
+# ---------------------------------------------------------------------------
+
+
+def test_crash_loop_replica_is_quarantined_loudly(tmp_path):
+    """THE crash-loop drill: every spawn of the replica dies at boot
+    (PFX_FAULT=boot_crash:0 — a broken image).  The supervisor restarts
+    it with backoff exactly flap-budget times, then QUARANTINES it
+    loudly (ERROR log + pfx_replica_quarantines_total) and never spawns
+    it again; the controller decision log replays to exact agreement
+    with the pfx_controller_* counters."""
+    cfg_path = tmp_path / "tiny.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    rport = _free_port()
+    router = _spawn_supervised_router(
+        rport, cfg_path, tmp_path,
+        router_extra=("--min-replicas", "1", "--max-replicas", "1",
+                      "--flap-budget", "3", "--flap-window", "300",
+                      "--restart-backoff", "0.2"),
+        env_extra={"PFX_FAULT": "boot_crash:0"},
+    )
+    try:
+        quar = _wait(
+            lambda: _req(rport, "/healthz")[1]
+            .get("controller", {}).get("quarantined"),
+            180, "quarantine",
+        )
+        assert quar == 1
+        _, dbg = _req(rport, "/debug/controller")
+        slot = dbg["replicas"][0]
+        assert slot["quarantined"] and not slot["restart_pending"]
+        # quarantine fired WITHIN the flap budget: exactly 3 restarts
+        assert slot["restarts"] == 3, slot
+        assert slot["last_exit_rc"] == 23  # the boot_crash exit code
+        m = _metrics(rport)
+        assert m["pfx_replica_quarantines_total"][
+            frozenset({("replica", "m0")})
+        ] == 1.0
+        assert m["pfx_replica_restarts_total"][
+            frozenset({("replica", "m0")})
+        ] == 3.0
+        # no replica ever served; the fleet is at min and becalmed
+        assert _req(rport, "/healthz")[1]["eligible"] == 0
+        # the decision-log replay contract through the real CLI
+        replay = _replay_agrees(rport)
+        assert replay["scale_ups"] == 0 and replay["scale_downs"] == 0
+        # the crash-looping replica left evidence in its log
+        log_file = tmp_path / "replica-logs" / "m0.log"
+        assert log_file.exists() and "boot_crash" in log_file.read_text()
+        router.send_signal(signal.SIGTERM)
+        assert router.wait(timeout=60) == 0
+    finally:
+        rlog = _finish(router)
+    # LOUD: the quarantine is unmissable in the control-plane log
+    assert "QUARANTINE" in rlog, rlog[-3000:]
+    assert "Traceback" not in rlog, rlog[-3000:]
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL under flood -> supervisor restart + router re-admission
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # ~2 replica boots + flood (~90s warm); the router-side
+# kill/failover contract stays tier-1-drilled by test_router_drills'
+# SIGKILL phase — THIS drill adds the supervisor restart + rejoin on top
+# (still in make test-elastic / test-all)
+def test_sigkill_under_flood_supervisor_restarts_and_router_readmits(
+        tmp_path):
+    """THE supervised-failover drill: SIGKILL a managed replica under
+    flood.  Every in-flight request gets exactly one honest 200/503 (no
+    hangs, no replays), the supervisor restarts the corpse, and the
+    router walks the SAME slot gone -> warm -> serving with a NEW pid —
+    zero dropped admitted requests end to end."""
+    cfg_path = tmp_path / "tiny.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    rport = _free_port()
+    router = _spawn_supervised_router(
+        rport, cfg_path, tmp_path,
+        serve_extra=("--queue-depth", "32"),
+        router_extra=("--min-replicas", "2", "--max-replicas", "2",
+                      "--restart-backoff", "0.2"),
+    )
+    try:
+        _wait(lambda: _req(rport, "/healthz")[1].get("eligible", 0) >= 2,
+              600, "two supervised replicas serving")
+        views = _req(rport, "/replicas")[1]["replicas"]
+        pid_by_key = {v["key"]: v["pid"] for v in views}
+        assert len(set(pid_by_key.values())) == 2
+
+        body = {"prompt_ids": [1, 2, 3], "max_tokens": 8, "deadline_s": 60}
+        code, ref = _req(rport, "/generate", data=body, timeout=90)
+        assert code == 200, ref
+
+        stop = threading.Event()
+        results, lock = [], threading.Lock()
+
+        def flood():
+            while not stop.is_set():
+                c, _r = _req(rport, "/generate", data=body, timeout=90)
+                with lock:
+                    results.append(c)
+
+        threads = [threading.Thread(target=flood) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # requests in flight on both replicas
+        victim_key = "r0"
+        os.kill(pid_by_key[victim_key], signal.SIGKILL)
+
+        # the supervisor restarts the slot and the router re-admits it:
+        # same key, serving again, NEW pid
+        def readmitted():
+            vs = {v["key"]: v for v in
+                  _req(rport, "/replicas")[1]["replicas"]}
+            v = vs[victim_key]
+            return (v["state"] == "serving"
+                    and v["pid"] not in (None, pid_by_key[victim_key]))
+        _wait(readmitted, 300, "victim restarted + re-admitted")
+        time.sleep(1.0)  # post-rejoin traffic lands on the replacement
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "hung connection through the kill"
+        with lock:
+            codes = list(results)
+        # zero dropped admitted requests: every response an honest
+        # 200/503, traffic flowed, and the fleet kept serving
+        assert codes and all(c in (200, 503) for c in codes), codes
+        assert codes.count(200) >= 1, codes
+        for _ in range(3):
+            code, resp = _req(rport, "/generate", data=body, timeout=90)
+            assert code == 200, (code, resp)
+            assert resp["completion_ids"] == ref["completion_ids"]
+
+        m = _metrics(rport)
+        assert m["pfx_replica_restarts_total"][
+            frozenset({("replica", "m0")})
+        ] >= 1.0
+        assert "pfx_replica_quarantines_total" not in m  # one crash != flap
+        router.send_signal(signal.SIGTERM)
+        assert router.wait(timeout=120) == 0
+    finally:
+        rlog = _finish(router)
+    assert "Traceback" not in rlog, rlog[-3000:]
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate breach -> scale-up -> recovery
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # ~2 replica boots + sustained flood (~100s warm); the
+# controller's breach->scale_up decision itself stays tier-1-tested by
+# test_controller.py units (still in make test-elastic / test-all)
+def test_breach_drives_scale_up_and_burn_recovers(tmp_path):
+    """THE autoscale acceptance drill: a flood past one replica's
+    admission capacity (queue depth 1) burns its error-rate SLO ->
+    breach on its /healthz -> the controller spawns a second warm-booted
+    replica -> capacity doubles, the 429s stop, and the breach recovers
+    — with the scale-up recorded in the decision log in exact agreement
+    with pfx_controller_scale_ups_total."""
+    cfg_path = tmp_path / "tiny.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    rport = _free_port()
+    router = _spawn_supervised_router(
+        rport, cfg_path, tmp_path,
+        serve_extra=("--queue-depth", "1",
+                     "--slo-error-rate", "0.05",
+                     "--slo-windows", "4,12"),
+        router_extra=("--min-replicas", "1", "--max-replicas", "2",
+                      "--scale-up-cooldown", "2"),
+    )
+    try:
+        _wait(lambda: _req(rport, "/healthz")[1].get("eligible", 0) >= 1,
+              600, "first replica serving")
+        body = {"prompt_ids": [1, 2, 3], "max_tokens": 8, "deadline_s": 60}
+        code, _ = _req(rport, "/generate", data=body, timeout=90)
+        assert code == 200
+
+        stop = threading.Event()
+        codes, lock = [], threading.Lock()
+
+        def flood():
+            while not stop.is_set():
+                c, _r = _req(rport, "/generate", data=body, timeout=90)
+                with lock:
+                    codes.append(c)
+
+        threads = [threading.Thread(target=flood) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            # breach -> scale_up lands in the decision log, and the
+            # second replica reaches serving (warm boot: seconds)
+            def scaled_up():
+                _, dbg = _req(rport, "/debug/controller")
+                ups = [d for d in dbg["decisions"]
+                       if d["action"] == "scale_up"]
+                return ups if (
+                    ups and _req(rport, "/healthz")[1]["eligible"] >= 2
+                ) else None
+            ups = _wait(scaled_up, 300, "breach-driven scale-up")
+            assert ups[0]["breach"] and "breach" in ups[0]["reason"], ups
+            with lock:
+                assert 429 in codes, "flood never overflowed the queue"
+
+            # recovery: with doubled capacity the 429s stop and the
+            # burn windows drain on every replica
+            def recovered():
+                vs = _req(rport, "/replicas")[1]["replicas"]
+                serving = [v for v in vs if v["state"] == "serving"]
+                return (len(serving) >= 2
+                        and not any(v["slo_breach"] for v in serving))
+            _wait(recovered, 120, "burn-rate recovery after scale-up")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive(), "hung connection through the drill"
+
+        replay = _replay_agrees(rport)
+        assert replay["scale_ups"] >= 1
+        m = _metrics(rport)
+        assert m["pfx_controller_target_replicas"][frozenset()] == 2.0
+        router.send_signal(signal.SIGTERM)
+        assert router.wait(timeout=120) == 0
+    finally:
+        rlog = _finish(router)
+    assert "Traceback" not in rlog, rlog[-3000:]

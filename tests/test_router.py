@@ -28,12 +28,20 @@ from paddlefleetx_tpu.core.router import (
 class StubReplica:
     """A canned tools/serve.py stand-in: /healthz serves a mutable dict,
     /generate|/prefill|/decode record the hit and answer (or abort,
-    under ``fail_mode='reset'``)."""
+    under ``fail_mode='reset'``); /admin/drain mimics the serve.py
+    remote-drain contract (flip /healthz to draining, answer 200) and
+    records the Authorization header it saw.  ``admin_expect`` makes it
+    ENFORCE a bearer token (401 otherwise); ``legacy_admin`` makes it
+    404 the whole /admin surface (a pre-PR 11 replica)."""
 
     def __init__(self, *, role="monolith", ok=True, depth=0,
                  state="ok", pid=None):
         self.hits = []
         self.fail_mode = None
+        self.admin_expect = None   # token string to enforce (None = open)
+        self.legacy_admin = False  # 404 /admin/* (pre-remote-drain serve)
+        self.admin_status = None   # force this status on /admin/* (e.g. 500)
+        self.admin_auth_seen = []
         self.health = {
             "ok": ok, "state": state, "queue_depth": depth, "busy_s": 0.0,
             "identity": {
@@ -65,6 +73,23 @@ class StubReplica:
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
                 stub.hits.append((self.path, body))
+                if self.path.startswith("/admin/"):
+                    if stub.legacy_admin:
+                        return self._json(404, {"error": "unknown path"})
+                    if stub.admin_status is not None:
+                        return self._json(stub.admin_status,
+                                          {"error": "forced"})
+                    stub.admin_auth_seen.append(
+                        self.headers.get("Authorization")
+                    )
+                    if stub.admin_expect is not None:
+                        auth = self.headers.get("Authorization") or ""
+                        if auth != f"Bearer {stub.admin_expect}":
+                            return self._json(401, {"error": "bad token"})
+                    if self.path == "/admin/drain":
+                        stub.health["state"] = "draining"
+                        return self._json(200, {"state": "draining"})
+                    return self._json(404, {"error": "unknown admin path"})
                 if stub.fail_mode == "reset":
                     # accept + read, then die without a response: the
                     # "partial exchange" class that must NOT be retried
@@ -325,36 +350,156 @@ def test_collect_exports_depth_and_state(stub):
 # ---------------------------------------------------------------------------
 
 
-def test_drain_signals_pid_and_walks_to_gone(stub):
-    """drain() rides the identity pid: the target stops receiving
-    traffic immediately, gets SIGTERM, and the poller marks it gone once
-    its port refuses.  A harmless sleeper subprocess stands in for the
-    serve.py process."""
+def test_drain_posts_admin_drain_and_walks_to_gone(stub):
+    """drain() rides the REMOTE transport: the target stops receiving
+    traffic immediately, gets an authenticated POST /admin/drain (no
+    pid/SIGTERM — this is what makes rolling deploys work cross-host),
+    reports draining on its own /healthz, and the poller marks it gone
+    once its port refuses."""
+    core = RouterCore([(stub.url, "monolith")])
+    r = core.replicas["r0"]
+    core.poll_replica(r)
+    core.poll_replica(r)
+    assert r.eligible()
+    out = core.drain()  # unnamed: picks the serving replica
+    assert out["replica"] == "r0"
+    assert r.drain_requested and r.state == "draining"
+    assert not r.eligible()
+    # the drain arrived over HTTP, not a signal
+    assert [p for p, _ in stub.hits] == ["/admin/drain"]
+    assert stub.health["state"] == "draining"
+    with pytest.raises(NoReplicaAvailable):
+        core.pick("monolith", remaining_s=60)
+    stub.stop()  # the real serve.py exits 0 after answering admitted work
+    core.poll_replica(r)
+    assert r.state == "gone"
+    with pytest.raises(ValueError, match="already gone"):
+        core.drain("r0")
+    with pytest.raises(ValueError, match="no serving replica"):
+        core.drain()
+    with pytest.raises(ValueError, match="unknown replica"):
+        core.drain("r9")
+
+
+def test_drain_sends_shared_token_and_auth_reject_restores_rotation(
+        stub, monkeypatch):
+    """With PFX_ADMIN_TOKEN set the drain POST carries the bearer
+    token; a replica that REJECTS the auth (mismatched fleet config)
+    raises loudly AND the target returns to rotation — a misconfigured
+    token must not blackhole a healthy replica."""
+    monkeypatch.setenv("PFX_ADMIN_TOKEN", "fleet-secret")
+    stub.admin_expect = "fleet-secret"
+    core = RouterCore([(stub.url, "monolith")])
+    r = core.replicas["r0"]
+    core.poll_replica(r)
+    core.poll_replica(r)
+    core.drain("r0")
+    assert stub.admin_auth_seen == ["Bearer fleet-secret"]
+    assert r.state == "draining"
+    # second replica, wrong token on the router side
+    stub2 = StubReplica()
+    stub2.admin_expect = "other-secret"
+    try:
+        core2 = RouterCore([(stub2.url, "monolith")])
+        r2 = core2.replicas["r0"]
+        core2.poll_replica(r2)
+        core2.poll_replica(r2)
+        with pytest.raises(ValueError, match="rejected the drain auth"):
+            core2.drain("r0")
+        assert r2.state == "serving" and not r2.drain_requested
+        assert r2.eligible()  # restored to rotation
+    finally:
+        stub2.stop()
+
+
+def test_drain_that_provably_did_not_land_restores_rotation(stub):
+    """A 404 with no safe pid fallback, or any other non-200, means the
+    drain did NOT happen: the target must return to rotation and the
+    caller must hear about it — never a blackholed-but-'drained'
+    replica."""
+    # legacy replica that never reported a pid: no transport at all
+    stub.legacy_admin = True
+    stub.health["identity"]["pid"] = None
+    core = RouterCore([(stub.url, "monolith")])
+    r = core.replicas["r0"]
+    core.poll_replica(r)
+    core.poll_replica(r)
+    with pytest.raises(ValueError, match="cannot be signalled"):
+        core.drain("r0")
+    assert r.state == "serving" and not r.drain_requested and r.eligible()
+    # a replica whose /admin/drain 500s: left in rotation, loudly
+    stub.legacy_admin = False
+    stub.admin_status = 500
+    with pytest.raises(ValueError, match="HTTP 500"):
+        core.drain("r0")
+    assert r.state == "serving" and r.eligible()
+    # and once it behaves, the drain goes through
+    stub.admin_status = None
+    core.drain("r0")
+    assert r.state == "draining"
+
+
+def test_drain_request_not_sent_restores_rotation(stub, monkeypatch):
+    """A connect stall (the request never went out) must NOT blackhole
+    the target: nothing downstream saw the drain, so the replica goes
+    back in rotation and the caller hears the failure — only a reply
+    lost AFTER the exchange leaves it draining for the poller."""
+    import paddlefleetx_tpu.core.router as router_mod
+    from paddlefleetx_tpu.core.router import RequestNotSent
+
+    core = RouterCore([(stub.url, "monolith")])
+    r = core.replicas["r0"]
+    core.poll_replica(r)
+    core.poll_replica(r)
+    real = router_mod._http_request
+
+    def stalled(url, method, path, **kw):
+        if path == "/admin/drain":
+            raise RequestNotSent("send failed: timed out")
+        return real(url, method, path, **kw)
+
+    monkeypatch.setattr(router_mod, "_http_request", stalled)
+    with pytest.raises(ValueError, match="could not be sent"):
+        core.drain("r0")
+    assert r.state == "serving" and not r.drain_requested and r.eligible()
+    monkeypatch.setattr(router_mod, "_http_request", real)
+    core.drain("r0")  # network settled: the drain goes through
+    assert r.state == "draining"
+
+
+def test_local_url_guard():
+    """The SIGTERM-by-pid fallback is only safe for THIS host's
+    loopback — a pid from another host names an unrelated local
+    process."""
+    from paddlefleetx_tpu.core.router import _local_url
+
+    assert _local_url("http://127.0.0.1:8001")
+    assert _local_url("http://localhost:8001")
+    assert _local_url("http://[::1]:8001")
+    assert not _local_url("http://10.0.0.9:8001")
+    assert not _local_url("http://replica-host:8001")
+
+
+def test_drain_falls_back_to_sigterm_for_legacy_replica(stub):
+    """A replica that predates /admin/drain (404s it) still drains via
+    the old same-host SIGTERM on its identity pid — a harmless sleeper
+    subprocess stands in for the old serve.py."""
     proc = subprocess.Popen([sys.executable, "-c",
                              "import time; time.sleep(120)"])
     try:
+        stub.legacy_admin = True
         stub.health["identity"]["pid"] = proc.pid
         core = RouterCore([(stub.url, "monolith")])
         r = core.replicas["r0"]
         core.poll_replica(r)
         core.poll_replica(r)
-        assert r.eligible()
-        out = core.drain()  # unnamed: picks the serving replica
-        assert out["replica"] == "r0" and out["pid"] == proc.pid
-        assert r.drain_requested and r.state == "draining"
-        assert not r.eligible()
-        with pytest.raises(NoReplicaAvailable):
-            core.pick("monolith", remaining_s=60)
+        out = core.drain()
+        assert out["pid"] == proc.pid
         assert proc.wait(timeout=10) == -signal.SIGTERM
-        stub.stop()  # the real serve.py closes its listener on exit
+        assert r.state == "draining"
+        stub.stop()
         core.poll_replica(r)
         assert r.state == "gone"
-        with pytest.raises(ValueError, match="already gone"):
-            core.drain("r0")
-        with pytest.raises(ValueError, match="no serving replica"):
-            core.drain()
-        with pytest.raises(ValueError, match="unknown replica"):
-            core.drain("r9")
     finally:
         if proc.poll() is None:
             proc.kill()
@@ -366,40 +511,32 @@ def test_drained_replica_redeployed_on_same_url_reenters_rotation(stub):
     re-enter via warm -> serving — the drain flag belongs to the old
     process, not the slot (regression: drain_requested was never
     cleared, permanently blackholing the slot)."""
-    proc = subprocess.Popen([sys.executable, "-c",
-                             "import time; time.sleep(120)"])
+    core = RouterCore([(stub.url, "monolith")], serve_after=2)
+    r = core.replicas["r0"]
+    core.poll_replica(r)
+    core.poll_replica(r)
+    core.poll_replica(r)
+    core.drain()
+    stub.stop()
+    core.poll_replica(r)
+    assert r.state == "gone" and r.drain_requested
+    # redeploy: a fresh process (new pid) binds the same port
+    redeployed = StubReplica(pid=os.getpid())
     try:
-        stub.health["identity"]["pid"] = proc.pid
-        core = RouterCore([(stub.url, "monolith")], serve_after=2)
-        r = core.replicas["r0"]
-        core.poll_replica(r)
-        core.poll_replica(r)
-        core.poll_replica(r)
-        core.drain()
-        proc.wait(timeout=10)
-        stub.stop()
-        core.poll_replica(r)
-        assert r.state == "gone" and r.drain_requested
-        # redeploy: a fresh process (new pid) binds the same port
-        redeployed = StubReplica(pid=os.getpid())
-        try:
-            r2 = core.replicas["r0"]
-            r2_url = r2.url
-            # point the slot at the new listener (same-url in production;
-            # the stub can't rebind the exact port portably, so rewrite)
-            r2.url = redeployed.url
-            core.poll_replica(r2)
-            assert not r2.drain_requested, "drain flag survived redeploy"
-            assert r2.state == "warm"
-            core.poll_replica(r2)
-            assert r2.state == "serving" and r2.eligible()
-            assert core.pick("monolith", remaining_s=60).key == "r0"
-            r2.url = r2_url
-        finally:
-            redeployed.stop()
+        r2 = core.replicas["r0"]
+        r2_url = r2.url
+        # point the slot at the new listener (same-url in production;
+        # the stub can't rebind the exact port portably, so rewrite)
+        r2.url = redeployed.url
+        core.poll_replica(r2)
+        assert not r2.drain_requested, "drain flag survived redeploy"
+        assert r2.state == "warm"
+        core.poll_replica(r2)
+        assert r2.state == "serving" and r2.eligible()
+        assert core.pick("monolith", remaining_s=60).key == "r0"
+        r2.url = r2_url
     finally:
-        if proc.poll() is None:
-            proc.kill()
+        redeployed.stop()
 
 
 def test_acquire_never_touches_registry_under_router_lock(stub, monkeypatch):
@@ -456,3 +593,113 @@ def test_pool_configuration_is_validated():
     core = RouterCore([("http://x:1", "prefill"), ("http://x:2", "decode")])
     assert core.disaggregated
     assert not RouterCore([("http://x:1", "monolith")]).disaggregated
+
+
+# ---------------------------------------------------------------------------
+# ejected-replica rejoin (the named lifecycle edge)
+# ---------------------------------------------------------------------------
+
+
+def test_ejected_replica_rejoins_booting_warm_serving(stub):
+    """SATELLITE: a replica that comes back AFTER --eject-after failed
+    polls marked it gone re-registers through the normal walk — gone ->
+    warm -> serving — and receives traffic again (the supervisor's
+    crash-restart path depends on exactly this rejoin)."""
+    core = RouterCore([(stub.url, "monolith")], eject_after=2,
+                      serve_after=2)
+    r = core.replicas["r0"]
+    core.poll_replica(r)
+    core.poll_replica(r)
+    assert r.state == "serving"
+    stub.stop()  # crashed, not draining
+    core.poll_replica(r)
+    core.poll_replica(r)
+    assert r.state == "gone"  # ejected after 2 failed polls
+    assert r.failures >= 2
+    # the replacement process answers on the same slot
+    revived = StubReplica(pid=os.getpid())
+    try:
+        r.url = revived.url  # same-url in production; stub can't rebind
+        core.poll_replica(r)
+        assert r.state == "warm" and not r.eligible()
+        assert r.failures == 0  # the eject counter reset on rejoin
+        core.poll_replica(r)
+        assert r.state == "serving" and r.eligible()
+        # and it takes traffic again
+        status, body, _ = core.dispatch(
+            "POST", "/generate", b"{}", role="monolith", deadline_s=30
+        )
+        assert status == 200
+        assert json.loads(body)["completion_ids"] == [7, 8, 9]
+    finally:
+        revived.stop()
+
+
+# ---------------------------------------------------------------------------
+# admin auth (PFX_ADMIN_TOKEN) + dynamic registration + control signals
+# ---------------------------------------------------------------------------
+
+
+def test_check_admin_token_and_localhost_rules(monkeypatch):
+    from paddlefleetx_tpu.core import router as router_mod
+    from paddlefleetx_tpu.core.router import check_admin
+
+    # token unset: loopback allowed (loudly, once), remote refused 403
+    monkeypatch.delenv("PFX_ADMIN_TOKEN", raising=False)
+    monkeypatch.setattr(router_mod, "_LOCAL_ONLY_WARNED", [False])
+    ok, code, msg = check_admin({}, ("127.0.0.1", 1234))
+    assert ok and code is None
+    ok, code, msg = check_admin({}, ("10.0.0.9", 1234), what="/debug")
+    assert not ok and code == 403 and "localhost-only" in msg
+    # token set: bearer match required regardless of source address
+    monkeypatch.setenv("PFX_ADMIN_TOKEN", "s3cret")
+    ok, code, _ = check_admin({}, ("127.0.0.1", 1234))
+    assert not ok and code == 401
+    ok, code, _ = check_admin(
+        {"Authorization": "Bearer wrong"}, ("127.0.0.1", 1))
+    assert not ok and code == 401
+    ok, code, _ = check_admin(
+        {"Authorization": "Bearer s3cret"}, ("10.0.0.9", 1))
+    assert ok and code is None
+    # a loopback client seen through a dual-stack bind (IPv4-mapped
+    # IPv6) is still localhost when the token is unset
+    monkeypatch.delenv("PFX_ADMIN_TOKEN")
+    ok, _, _ = check_admin({}, ("::ffff:127.0.0.1", 1))
+    assert ok
+
+
+def test_add_replica_is_idempotent_and_polls_in(stub):
+    core = RouterCore([], allow_empty=True)
+    assert core.replicas == {} and not core.disaggregated
+    key = core.add_replica(stub.url)
+    assert key == "r0"
+    assert core.add_replica(stub.url + "/") == "r0"  # idempotent on url
+    other = StubReplica()
+    try:
+        assert core.add_replica(other.url) == "r1"
+        with pytest.raises(ValueError, match="unknown replica role"):
+            core.add_replica("http://x:1", "turbo")
+        r = core.replicas["r0"]
+        core.poll_replica(r)
+        core.poll_replica(r)
+        assert r.state == "serving"
+    finally:
+        other.stop()
+
+
+def test_poll_reads_occupancy_and_slo_breach(stub):
+    """The elastic-control signals ride the existing /healthz poll: the
+    continuous scheduler's occupancy and the replica's own SLO breach
+    verdict land on the replica view the controller consumes."""
+    stub.health["occupancy"] = 0.75
+    stub.health["slo"] = {"breach": True, "reason": "ttft_p99: burn 9x"}
+    core = RouterCore([(stub.url, "monolith")])
+    r = core.replicas["r0"]
+    core.poll_replica(r)
+    assert r.occupancy == 0.75 and r.slo_breach
+    view = core.replica_views()[0]
+    assert view["occupancy"] == 0.75 and view["slo_breach"]
+    # absent fields (coalesce scheduler / SLO off) read as calm
+    del stub.health["occupancy"], stub.health["slo"]
+    core.poll_replica(r)
+    assert r.occupancy == 0.0 and not r.slo_breach

@@ -14,8 +14,14 @@ Each ``BENCH_r<N>.json`` records one bench lap: ``{"n": N, "rc": ...,
     rule is: unit matches the failure regex, OR value == 0 with ANY
     parenthetical annotation.  A dead backend is not a regression, and
     pretending the 0.0 is comparable would flag (or mask) nonsense;
-  - only metrics present in BOTH snapshots are compared (all bench
-    metrics are higher-is-better throughputs);
+  - only metrics present in BOTH snapshots **on the same backend** are
+    compared (all bench metrics are higher-is-better throughputs):
+    bench.py's dead-backend fallback laps carry ``platform: "cpu"``,
+    and a cpu tokens/s is not comparable to a tpu tokens/s — the
+    comparison walks further back to the newest snapshot sharing a
+    same-platform metric, noting every platform change loudly (rows
+    without a ``platform`` field — the pre-PR 5 spelling — only match
+    each other);
   - fewer than two comparable snapshots → rc 0 with a loud note, never
     a silent green.
 
@@ -65,11 +71,12 @@ def load_rows(path: str) -> Tuple[int, List[dict]]:
 
 
 def usable_metrics(rows: List[dict], label: str,
-                   notes: List[str]) -> Dict[str, float]:
-    """metric -> value for the comparable rows; failed-lap rows (the
-    honest-fallback spelling: failure reason in the unit, value 0.0)
-    are skipped loudly."""
-    out: Dict[str, float] = {}
+                   notes: List[str]) -> Dict[str, Tuple[float, str]]:
+    """metric -> (value, platform) for the comparable rows; failed-lap
+    rows (the honest-fallback spelling: failure reason in the unit,
+    value 0.0) are skipped loudly.  ``platform`` is "" for rows that
+    predate the field — those only compare against each other."""
+    out: Dict[str, Tuple[float, str]] = {}
     for row in rows:
         metric = row.get("metric")
         value = row.get("value")
@@ -82,7 +89,7 @@ def usable_metrics(rows: List[dict], label: str,
                 f"({unit!r}) — not comparable"
             )
             continue
-        out[str(metric)] = float(value)
+        out[str(metric)] = (float(value), str(row.get("platform", "")))
     return out
 
 
@@ -106,7 +113,7 @@ def main(argv=None) -> int:
             notes.append(f"SKIP {os.path.basename(p)}: {e}")
             continue
         loaded.append((n, p, rows))
-    usable: List[Tuple[int, str, Dict[str, float]]] = []
+    usable: List[Tuple[int, str, Dict[str, Tuple[float, str]]]] = []
     for n, p, rows in sorted(loaded):
         metrics = usable_metrics(rows, os.path.basename(p), notes)
         if metrics:
@@ -125,24 +132,51 @@ def main(argv=None) -> int:
         )
         return 0
 
-    (n_old, p_old, old), (n_new, p_new, new) = usable[-2], usable[-1]
-    shared = sorted(set(old) & set(new))
-    if not shared:
+    # pick the comparison pair: the newest snapshot against the newest
+    # OLDER one sharing at least one same-platform metric — a cpu
+    # fallback lap after a tpu lap is a platform change, not a 98%
+    # regression, and must not be compared (it falls through to the
+    # previous cpu lap, or passes loudly when there is none)
+    (n_new, p_new, new) = usable[-1]
+    pair = None
+    for n_old, p_old, old in reversed(usable[:-1]):
+        shared = sorted(
+            m for m in set(old) & set(new) if old[m][1] == new[m][1]
+        )
+        if shared:
+            pair = (n_old, p_old, old, shared)
+            break
+        changed = sorted(
+            f"{m}: {old[m][1] or '?'} -> {new[m][1] or '?'}"
+            for m in set(old) & set(new)
+        )
         print(
-            f"bench-check: r{n_old} and r{n_new} share no metric names — "
-            "nothing to compare, PASS by default (loudly)"
+            f"bench-check: r{n_old} shares no same-platform metric with "
+            f"r{n_new}"
+            + (f" (platform changed: {'; '.join(changed)})" if changed
+               else " (disjoint metric names)")
+            + " — looking further back"
+        )
+    if pair is None:
+        print(
+            f"bench-check: no older snapshot comparable with r{n_new} "
+            "(platform change or disjoint metrics) — nothing to "
+            "compare, PASS by default (loudly)"
         )
         return 0
+    n_old, p_old, old, shared = pair
     failures = 0
     for metric in shared:
-        ov, nv = old[metric], new[metric]
+        (ov, plat), (nv, _) = old[metric], new[metric]
         if ov <= 0:
             print(f"bench-check: {metric}: old value {ov} not comparable, skipped")
             continue
         drop = (ov - nv) / ov
         verdict = "REGRESSION" if drop > args.threshold else "ok"
         print(
-            f"bench-check: {metric}: r{n_old}={ov:g} -> r{n_new}={nv:g} "
+            f"bench-check: {metric}"
+            + (f" [{plat}]" if plat else "")
+            + f": r{n_old}={ov:g} -> r{n_new}={nv:g} "
             f"({-drop:+.1%}) {verdict}"
         )
         failures += verdict == "REGRESSION"

@@ -21,6 +21,15 @@ draining -> 503, deadline checked before every dispatch) and mirrors
 the serve.py drain contract: SIGTERM stops admission, in-flight
 requests finish, exit 0; a second signal force-quits.
 
+Elastic control plane (``--supervise``, docs/serving.md "Elastic
+control plane"): the router spawns its replicas itself as MANAGED
+subprocesses (`core/controller.py`) — crash-restart with exponential
+backoff, a flap budget that quarantines a crash-looping replica LOUDLY,
+warm boot off the persistent compile cache — and runs the SLO-driven
+scale controller: breach/depth/occupancy-driven fast scale-up, idle
+scale-down through the authenticated remote-drain primitive, hysteresis
+and min/max bounds, every decision in a bounded replayable log.
+
 Usage:
   # replicated
   python tools/router.py --port 9000 \
@@ -28,17 +37,28 @@ Usage:
   # disaggregated
   python tools/router.py --port 9000 \
       --prefill http://127.0.0.1:8001 --decode http://127.0.0.1:8002
+  # supervised + autoscaled (the elastic control plane)
+  python tools/router.py --port 9000 --supervise \
+      --replica-cmd "python tools/serve.py -c cfg.yaml --port {port} \
+                     --replica-id {replica_id}" \
+      --min-replicas 1 --max-replicas 4 --base-port 8101
   # rolling deploy, one replica at a time (requires the router up):
   python tools/router.py drain --admin http://127.0.0.1:9000 [--replica-id r0]
 
 Endpoints:
-  POST /generate      route one request (token-id modes only in
-                      disaggregated mode — the router has no tokenizer)
-  GET  /healthz       router health + per-replica lifecycle states
-  GET  /metrics       Prometheus exposition (pfx_router_* and friends)
-  GET  /replicas      detailed per-replica view (identity, scores)
-  POST /admin/drain   initiate drain-one-replica (body: {"replica": id})
-  GET  /debug/traces  sampled routing timelines (Perfetto JSON)
+  POST /generate        route one request (token-id modes only in
+                        disaggregated mode — the router has no tokenizer)
+  GET  /healthz         router health + per-replica lifecycle states
+  GET  /metrics         Prometheus exposition (pfx_router_* and friends)
+  GET  /replicas        detailed per-replica view (identity, scores)
+  POST /admin/drain     initiate drain-one-replica (body: {"replica": id})
+  GET  /debug/traces    sampled routing timelines (Perfetto JSON)
+  GET  /debug/controller  scale policy + decision log + supervised slots
+
+/admin/* and /debug/* are gated by the fleet-shared ``PFX_ADMIN_TOKEN``
+bearer token (unset = loopback-only, loudly); drains ride the same
+token to each replica's ``POST /admin/drain``, so rolling deploys work
+cross-host.
 """
 
 import argparse
@@ -56,14 +76,21 @@ def serve_router(args) -> int:
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+    from paddlefleetx_tpu.core.controller import (
+        ElasticController,
+        ReplicaSupervisor,
+        ScalePolicy,
+    )
     from paddlefleetx_tpu.core.request_queue import QueueClosed, QueueFull
     from paddlefleetx_tpu.core.router import (
         NoReplicaAvailable,
         ReplicaUnavailable,
         RouterCore,
         _DownstreamError,
+        check_admin,
     )
     from paddlefleetx_tpu.utils.telemetry import (
+        flight_dir,
         get_flight_recorder,
         get_registry,
     )
@@ -79,7 +106,34 @@ def serve_router(args) -> int:
         poll_interval_s=args.poll_interval,
         eject_after=args.eject_after,
         serve_after=args.serve_after,
+        allow_empty=args.supervise,
     )
+    controller = None
+    if args.supervise:
+        supervisor = ReplicaSupervisor(
+            args.replica_cmd,
+            base_port=args.base_port,
+            max_replicas=args.max_replicas,
+            compile_cache_dir=args.compile_cache_dir,
+            log_dir=args.replica_log_dir
+            or os.path.join(flight_dir(), "replicas"),
+            backoff_base_s=args.restart_backoff,
+            flap_budget=args.flap_budget,
+            flap_window_s=args.flap_window,
+        )
+        controller = ElasticController(
+            core, supervisor,
+            ScalePolicy(
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                high_depth=args.scale_high_depth,
+                low_depth=args.scale_low_depth,
+                up_cooldown_s=args.scale_up_cooldown,
+                down_cooldown_s=args.scale_down_cooldown,
+                idle_s=args.scale_idle,
+                interval_s=args.control_interval,
+            ),
+        )
     reg = get_registry()
     recorder = get_flight_recorder()
     recorder.install_excepthook()
@@ -119,6 +173,17 @@ def serve_router(args) -> int:
             self._send(code, json.dumps(obj).encode(), "application/json",
                        headers)
 
+        def _authorized(self, what: str) -> bool:
+            """Gate /admin and /debug on the shared PFX_ADMIN_TOKEN
+            (core/router.check_admin): token set -> bearer match; unset
+            -> loopback-only, loudly.  Answers 401/403 on failure."""
+            ok, code, msg = check_admin(
+                self.headers, self.client_address, what=what
+            )
+            if not ok:
+                self._json(code, {"error": msg})
+            return ok
+
         def do_GET(self):
             if self.path == "/healthz":
                 states = core.states()
@@ -133,6 +198,13 @@ def serve_router(args) -> int:
                         1 for v in core.replica_views() if v["eligible"]
                     ),
                 }
+                if controller is not None:
+                    body["controller"] = {
+                        "target": controller.target,
+                        "quarantined":
+                            controller.supervisor.quarantined_count(),
+                        "decisions": len(controller.decision_log),
+                    }
                 return self._json(200, body)
             if self.path == "/metrics":
                 return self._send(
@@ -141,8 +213,20 @@ def serve_router(args) -> int:
                 )
             if self.path == "/replicas":
                 return self._json(200, {"replicas": core.replica_views()})
-            if self.path == "/debug/traces":
-                return self._json(200, chrome_trace(trace_buffer.traces()))
+            if self.path.startswith("/debug/"):
+                if not self._authorized("/debug"):
+                    return
+                if self.path == "/debug/traces":
+                    return self._json(
+                        200, chrome_trace(trace_buffer.traces())
+                    )
+                if self.path == "/debug/controller":
+                    if controller is None:
+                        return self._json(404, {
+                            "error": "no controller: run with --supervise"
+                        })
+                    return self._json(200, controller.view())
+                return self._json(404, {"error": "unknown debug path"})
             return self._json(404, {"error": "unknown path"})
 
         def do_POST(self):
@@ -153,6 +237,8 @@ def serve_router(args) -> int:
             return self._generate()
 
         def _admin_drain(self):
+            if not self._authorized("/admin"):
+                return
             n = int(self.headers.get("Content-Length", 0))
             try:
                 req = json.loads(self.rfile.read(n) or b"{}")
@@ -311,23 +397,56 @@ def serve_router(args) -> int:
         orig_handlers[sig] = signal.signal(sig, _on_signal)
 
     core.start()
+    if controller is not None:
+        # spawn min_replicas (registered with the core as they come up)
+        # and start the control loop; the poller walks each replica
+        # booting -> warm -> serving as it answers /healthz
+        controller.start()
     mode = identity["scheduler"]
     print(
         f"router on {args.host}:{args.port} ({mode}; "
         f"{len(core.replicas)} replica(s), max in-flight "
-        f"{args.max_inflight}, retries {args.retries})",
+        f"{args.max_inflight}, retries {args.retries}"
+        + (f"; supervising {args.min_replicas}..{args.max_replicas} "
+           f"replicas from port {args.base_port}"
+           if controller is not None else "")
+        + ")",
         flush=True,
     )
+    def _force_quit(where):
+        # os._exit skips every finally: take the managed children down
+        # HARD so their ports free up for the next boot — orphans
+        # running old code would crash-loop the replacement fleet into
+        # quarantine while still answering /healthz
+        print(f"force-quit on second interrupt ({where})", flush=True)
+        recorder.record({"event": "force_quit"})
+        recorder.dump(reason="force_quit")
+        if controller is not None:
+            controller.supervisor.kill_all()
+        os._exit(130)
+
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
-        print("force-quit on second interrupt", flush=True)
-        recorder.record({"event": "force_quit"})
-        recorder.dump(reason="force_quit")
-        os._exit(130)
+        _force_quit("serving")
     finally:
-        core.stop()
-        httpd.server_close()
+        try:
+            if controller is not None:
+                # stop scaling first, then drain the children
+                # gracefully: each managed replica gets SIGTERM,
+                # answers its admitted work, exits 0 (the PR 3
+                # contract) — the router never leaves orphans behind a
+                # clean shutdown
+                controller.stop()
+                controller.supervisor.stop_all()
+            core.stop()
+            httpd.server_close()
+        except KeyboardInterrupt:
+            # the second signal landed while the graceful teardown was
+            # already underway (a fast drain finishes before a human's
+            # second Ctrl-C): still honor the force-quit contract —
+            # never a traceback, never an orphan
+            _force_quit("teardown")
     if flags["draining"]:
         print("router drained cleanly: all admitted requests answered",
               flush=True)
@@ -343,13 +462,17 @@ def cmd_drain(args) -> int:
     import urllib.error
     import urllib.request
 
+    from paddlefleetx_tpu.core.router import admin_headers
+
     admin = args.admin.rstrip("/")
     req = urllib.request.Request(
         f"{admin}/admin/drain",
         data=json.dumps(
             {"replica": args.replica_id or None}
         ).encode(),
-        headers={"Content-Type": "application/json"},
+        # the shared PFX_ADMIN_TOKEN rides along so the deploy tooling
+        # works against a remote, token-gated router
+        headers={"Content-Type": "application/json", **admin_headers()},
     )
     try:
         with urllib.request.urlopen(req, timeout=10) as r:
@@ -436,6 +559,57 @@ def main(argv=None):
     ap.add_argument("--serve-after", type=int, default=1,
                     help="consecutive healthy polls before a warm "
                     "replica starts receiving traffic")
+    # ---- elastic control plane (--supervise; docs/serving.md) ----
+    ap.add_argument("--supervise", action="store_true",
+                    help="spawn + supervise the replicas as managed "
+                    "subprocesses and run the SLO-driven scale "
+                    "controller (crash-restart with backoff, flap-"
+                    "budget quarantine, warm boot, breach-driven "
+                    "scale-up, idle scale-down via remote drains)")
+    ap.add_argument("--replica-cmd", default="",
+                    help="supervise: replica command template with "
+                    "{port} and {replica_id} placeholders, e.g. "
+                    "'python tools/serve.py -c cfg.yaml --port {port} "
+                    "--replica-id {replica_id}'")
+    ap.add_argument("--min-replicas", type=int, default=1,
+                    help="supervise: replica floor (boot + scale-down "
+                    "bound)")
+    ap.add_argument("--max-replicas", type=int, default=4,
+                    help="supervise: replica ceiling (scale-up bound)")
+    ap.add_argument("--base-port", type=int, default=8101,
+                    help="supervise: slot i listens on base-port + i")
+    ap.add_argument("--compile-cache-dir", default="",
+                    help="supervise: persistent compile cache passed to "
+                    "every spawned replica (--compile-cache-dir on "
+                    "serve.py) — warm boot makes scale-up seconds, not "
+                    "a cold trace")
+    ap.add_argument("--replica-log-dir", default="",
+                    help="supervise: per-replica stdout logs (default "
+                    "<PFX_FLIGHT_DIR>/replicas)")
+    ap.add_argument("--control-interval", type=float, default=1.0,
+                    help="supervise: seconds between control-loop ticks")
+    ap.add_argument("--scale-high-depth", type=float, default=4.0,
+                    help="supervise: scale up when avg waiting depth "
+                    "per serving replica exceeds this")
+    ap.add_argument("--scale-low-depth", type=float, default=0.5,
+                    help="supervise: fleet counts as idle below this "
+                    "avg depth (hysteresis band with --scale-high-depth)")
+    ap.add_argument("--scale-up-cooldown", type=float, default=5.0,
+                    help="supervise: min seconds between scale-ups")
+    ap.add_argument("--scale-down-cooldown", type=float, default=60.0,
+                    help="supervise: min seconds after any scale action "
+                    "before a scale-down")
+    ap.add_argument("--scale-idle", type=float, default=30.0,
+                    help="supervise: sustained idle seconds before a "
+                    "scale-down")
+    ap.add_argument("--flap-budget", type=int, default=5,
+                    help="supervise: crash-restarts inside --flap-window "
+                    "before a replica is quarantined LOUDLY")
+    ap.add_argument("--flap-window", type=float, default=60.0,
+                    help="supervise: flap-budget window seconds")
+    ap.add_argument("--restart-backoff", type=float, default=0.5,
+                    help="supervise: base seconds of the exponential "
+                    "crash-restart backoff")
     ap.add_argument("--router-id", default="",
                     help="identity for this router's /healthz block")
     ap.add_argument("--admin", default="http://127.0.0.1:9000",
@@ -452,8 +626,16 @@ def main(argv=None):
         return cmd_drain(args)
     if not args.port:
         ap.error("serve mode requires --port")
-    if not (args.replica or args.prefill or args.decode):
-        ap.error("need --replica URLs, or --prefill and --decode URLs")
+    if args.supervise:
+        if not args.replica_cmd:
+            ap.error("--supervise requires --replica-cmd (a serve.py "
+                     "command template with {port})")
+        if args.prefill or args.decode:
+            ap.error("--supervise manages monolith replicas only; "
+                     "disaggregated pools are static for now")
+    elif not (args.replica or args.prefill or args.decode):
+        ap.error("need --replica URLs, --prefill and --decode URLs, "
+                 "or --supervise with --replica-cmd")
     return serve_router(args)
 
 

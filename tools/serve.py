@@ -41,6 +41,13 @@ Usage:
       GET  /healthz
       GET  /metrics
       GET  /debug/state | /debug/trace?id=<trace_id> | /debug/traces
+      POST /admin/drain            # authenticated remote drain
+
+/admin/* and /debug/* are gated by the fleet-shared ``PFX_ADMIN_TOKEN``
+bearer token (unset = loopback-only, loudly — core/router.check_admin);
+``POST /admin/drain`` is the remote spelling of the SIGTERM drain
+contract, so rolling deploys work cross-host (docs/serving.md "Elastic
+control plane").
 """
 
 import argparse
@@ -265,6 +272,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         QueueClosed,
         QueueFull,
     )
+    from paddlefleetx_tpu.core.router import check_admin
     from paddlefleetx_tpu.utils.telemetry import (
         SLOTracker,
         get_flight_recorder,
@@ -433,6 +441,10 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                                                  snap=snap)),
                     "busy_s": round(
                         reg.value("pfx_queue_busy_seconds", snap=snap), 3),
+                    # elastic-control signal (core/controller.py): the
+                    # continuous scheduler's rows/capacity (0 elsewhere)
+                    "occupancy": round(float(reg.value(
+                        "pfx_batch_occupancy", snap=snap)), 4),
                     "queue": {
                         k: int(reg.value(m, snap=snap))
                         for k, m in _QUEUE_HEALTH_KEYS.items()
@@ -458,10 +470,26 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             else:
                 self._json(404, {"error": "unknown path"})
 
+        def _authorized(self, what: str) -> bool:
+            """Gate an /admin or /debug request on the shared
+            PFX_ADMIN_TOKEN (core/router.check_admin): token set ->
+            bearer match required; unset -> loopback-only, loudly.
+            Answers 401/403 itself when the check fails."""
+            ok, code, msg = check_admin(
+                self.headers, self.client_address, what=what
+            )
+            if not ok:
+                self._json(code, {"error": msg})
+            return ok
+
         def _debug_get(self):
             """Live introspection (docs/observability.md): read-only,
             lock-consistent snapshots that never block the scheduler
-            thread; prompt/token CONTENTS are never exposed."""
+            thread; prompt/token CONTENTS are never exposed.  Gated by
+            the same PFX_ADMIN_TOKEN rule as /admin/* — introspection
+            must not ship unauthenticated on a non-loopback bind."""
+            if not self._authorized("/debug"):
+                return
             parts = urlsplit(self.path)
             if parts.path == "/debug/state":
                 # one registry snapshot rides along so the debug view and
@@ -554,6 +582,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
 
         def do_POST(self):
             parts = urlsplit(self.path)
+            if parts.path.startswith("/admin/"):
+                return self._admin(parts)
             if parts.path == "/generate":
                 if role == "prefill":
                     # a prefill replica has no decode loop to finish a
@@ -573,6 +603,30 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     return self._json(404, {"error": "not a decode replica"})
                 return self._decode(parts)
             return self._json(404, {"error": "unknown path"})
+
+        def _admin(self, parts):
+            """POST /admin/* — the authenticated operations surface
+            (docs/serving.md "Elastic control plane").  ``/admin/drain``
+            is the remote spelling of SIGTERM: the response is written
+            first (the caller learns the drain STARTED), then admission
+            closes, every admitted request is answered, and the process
+            exits 0 — rolling deploys no longer need to share a host
+            with the replica."""
+            if not self._authorized("/admin"):
+                return
+            if parts.path == "/admin/drain":
+                # response FIRST, then the drain: an idle replica can
+                # finish its drain in milliseconds, and the caller must
+                # learn the drain started before the listener dies
+                already = flags["draining"]
+                self._json(200, {
+                    "state": "draining",
+                    "already_draining": already,
+                    "queued": queue.depth(),
+                })
+                initiate_drain("admin drain")
+                return
+            return self._json(404, {"error": "unknown admin path"})
 
         def _fail(self, code: int, msg: str, fut, t0, retry=None):
             """One failed-request epilogue: span + SLO accounting (400s
@@ -888,21 +942,24 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                       flush=True)
 
     orig_handlers = {}
+    drain_lock = threading.Lock()
 
-    def _on_signal(signum, frame):
-        # mirror the PR 2 engine contract: first signal drains (stop
-        # admitting -> finish admitted work -> exit 0), handlers are
-        # restored immediately so a second signal force-quits
-        for sig, h in orig_handlers.items():
-            signal.signal(sig, h)
-        flags["draining"] = True
+    def initiate_drain(source: str) -> bool:
+        """THE drain initiation, shared by the signal handler and the
+        authenticated ``POST /admin/drain`` (the remote transport that
+        makes rolling deploys work cross-host): close admission, answer
+        every admitted request, exit 0 — the PR 3 contract unchanged.
+        Idempotent: returns False when a drain is already underway."""
+        with drain_lock:
+            if flags["draining"]:
+                return False
+            flags["draining"] = True
         draining_gauge.set(1)
-        recorder.record({"event": "drain_start", "signum": signum,
+        recorder.record({"event": "drain_start", "source": source,
                          "queued": queue.depth()})
         print(
-            f"signal {signum}: draining — admission closed, "
-            f"{queue.depth()} queued request(s) will finish "
-            "(send again to force-quit)",
+            f"{source}: draining — admission closed, "
+            f"{queue.depth()} queued request(s) will finish",
             flush=True,
         )
 
@@ -913,6 +970,16 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
 
         threading.Thread(target=_drain, name="serve-drain",
                          daemon=True).start()
+        return True
+
+    def _on_signal(signum, frame):
+        # mirror the PR 2 engine contract: first signal drains (stop
+        # admitting -> finish admitted work -> exit 0), handlers are
+        # restored immediately so a second signal force-quits
+        for sig, h in orig_handlers.items():
+            signal.signal(sig, h)
+        if initiate_drain(f"signal {signum}"):
+            print("(send again to force-quit)", flush=True)
 
     try:
         for sig in (signal.SIGTERM, signal.SIGINT):
@@ -1060,7 +1127,29 @@ def main(argv=None):
                     help="stable identity for the /healthz identity "
                     "block (default host:port) — how tools/router.py "
                     "and humans tell replicas apart")
+    ap.add_argument("--compile-cache-dir", default="",
+                    help="seed jax's persistent compilation cache from "
+                    "this directory (warm boot: a scale-up replica "
+                    "spawned by the elastic control plane reuses the "
+                    "fleet's compiled artifacts instead of paying a "
+                    "cold trace — docs/serving.md 'Elastic control "
+                    "plane')")
     args = ap.parse_args(argv)
+    # crash-loop fault site (PFX_FAULT=boot_crash:0, docs/
+    # fault_tolerance.md): a replica that can never come up — drives
+    # the supervisor's flap-budget quarantine drill
+    from paddlefleetx_tpu.utils.resilience import maybe_fire
+
+    maybe_fire("boot_crash", 0)
+    if args.compile_cache_dir:
+        import jax
+
+        # same knobs as the test harness: cache even fast compiles so a
+        # warm-booted replica's whole family set comes from disk
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(args.compile_cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     # spec/quant CLI flags become plain config overrides so BOTH
     # schedulers (GenerationServer + PagedDecodeEngine read the same
     # Generation.speculative section) see one source of truth
