@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-spec test-trace test-router test-elastic bench bench-check
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic bench bench-check
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -17,7 +17,8 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_chunked_ce.py tests/test_lint.py \
              tests/test_telemetry.py tests/test_tracing.py \
              tests/test_bench_helpers.py tests/test_bench_cases.py \
-             tests/test_router.py tests/test_controller.py
+             tests/test_router.py tests/test_controller.py \
+             tests/test_prefix_cache.py
 
 # lint runs inside the gate via tests/test_lint.py::test_repo_is_clean
 test-fast:
@@ -94,6 +95,18 @@ test-trace:
 # tests/.jax_cache like every other drill family)
 test-paged:
 	python -m pytest tests/test_paged_cache.py tests/test_continuous_batching.py tests/test_paged_drills.py -q
+
+# shared-prefix KV reuse gate: refcount/radix-index/COW host units, the
+# engine-level reuse + chunked-prefill parity suite (prefix hits, COW
+# divergence, eviction-under-pressure, ArenaReset index rebuild, the
+# decision-log replay contract), the prefix CLI drill, and the
+# prefix-heavy decode-bench A/B contract (docs/serving.md "Prefix
+# cache")
+test-prefix:
+	python -m pytest tests/test_prefix_cache.py -q
+	python -m pytest tests/test_continuous_batching.py -q -k "prefix or chunked or cow or accounting or arena_reset or pressure"
+	python -m pytest "tests/test_paged_drills.py::test_prefix_cache_and_chunked_prefill_through_real_cli" -q
+	python -m pytest tests/test_bench_contract.py -q -k "decode_happy"
 
 # speculative-decoding + KV-quant gate: drafter/accept units, greedy
 # parity (contiguous + paged, incl. full-rejection iterations), int8

@@ -79,6 +79,12 @@ CASES = {
     # coalescer — emits TWO rows (continuous + coalesce) reporting
     # delivered tokens/s and p99 TTFT, the head-of-line-blocking evidence
     "staggered": (None, None, False),
+    # prefix-heavy staggered A/B: N requests sharing one long system
+    # prefix replayed against the continuous scheduler with the
+    # shared-prefix KV cache ON vs OFF — emits TWO rows (cached +
+    # nocache) reporting TTFT percentiles, prefill tokens COMPUTED, and
+    # the hit rate (docs/serving.md "Prefix cache")
+    "prefix": (None, None, False),
 }
 
 # env spellings of the two decode paths (read at trace time).  BOTH are
@@ -104,6 +110,9 @@ def _metrics_for(name: str) -> list:
     if name == "staggered":
         return ["gpt345m_decode_staggered_continuous",
                 "gpt345m_decode_staggered_coalesce"]
+    if name == "prefix":
+        return ["gpt345m_decode_prefix_cached",
+                "gpt345m_decode_prefix_nocache"]
     return [f"gpt345m_decode_{name}"]
 
 
@@ -599,6 +608,124 @@ def run_staggered_case(args) -> list:
     return rows
 
 
+def run_prefix_case(args) -> list:
+    """Shared-prefix cache ON vs OFF under the SAME prefix-heavy
+    staggered trace.
+
+    N greedy requests share one long system prefix (75% of the prompt,
+    distinct tails) and arrive at fixed-seed staggered offsets.  Both
+    sides run the continuous scheduler on identical engines except
+    ``prefix_cache_blocks``; a PRIMER request carrying the bare prefix
+    runs before each timed window (cache-off too — same warm-up work)
+    so the cached side models the steady state where the system prefix
+    is resident.  The cached row reports the hit rate and the prompt
+    tokens actually COMPUTED — strictly fewer than cache-off whenever
+    anything hit — plus TTFT percentiles; output token-identity across
+    the two sides is counted honestly (divergent_rows must be 0 at the
+    f32 contract dtype)."""
+    import jax
+    import numpy as np
+
+    from paddlefleetx_tpu.core.continuous_batching import (
+        ContinuousScheduler,
+        PagedDecodeEngine,
+    )
+
+    from bench import knob_env
+
+    n_req = int(os.environ.get("BENCH_PREFIX_N", 6))
+    gap_frac = float(os.environ.get("BENCH_STAGGER_GAP", 0.5))
+    server = _serving_server(args, greedy=True)
+    rng = np.random.default_rng(3)
+    shared_len = max((args.prompt * 3 // 4), 2)
+    shared = rng.integers(1, 50304, shared_len).tolist()
+    prompts = [
+        shared + rng.integers(1, 50304, args.prompt - shared_len).tolist()
+        if args.prompt > shared_len else list(shared)
+        for _ in range(n_req)
+    ]
+
+    with knob_env(_OVERHAUL_ENV):
+        # calibrate the arrival gaps off one warm single decode
+        server.generate_ids([prompts[0]], max_dec_len=args.dec)
+        t0 = time.perf_counter()
+        server.generate_ids([prompts[0]], max_dec_len=args.dec)
+        t_one = time.perf_counter() - t0
+        offsets = _staggered_trace(n_req, mean_gap_s=gap_frac * t_one)
+
+        sides = {}
+        for label, budget in (("nocache", 0), ("cached", 4096)):
+            engine = PagedDecodeEngine(
+                server, max_batch=max(8, n_req),
+                prefix_cache_blocks=budget,
+            )
+            sched = ContinuousScheduler(engine, max_depth=2 * n_req)
+            sched.warmup([args.prompt])
+            sched.start()
+            # primers, both OUTSIDE the timed window and identical on
+            # both sides: the bare system prefix (on the cached side
+            # this publishes its blocks) and one full prompt (on the
+            # cached side its suffix compiles the chunk family, so the
+            # timed window measures scheduling — not a first-hit
+            # mid-traffic compile)
+            sched.submit([shared], args.dec).result(timeout=600)
+            sched.submit([prompts[0]], args.dec).result(timeout=600)
+            # baselines AFTER the primers: the row reports the timed
+            # window only (cumulative stats would count the second
+            # primer's hit and push hit_rate past 1.0)
+            tok0 = int(engine.stats["prefill_tokens"])
+            pfx = engine.cache.prefix.stats
+            h0, ht0 = int(pfx["hits"]), int(pfx["hit_tokens"])
+            ttft, outs, wall = _drive_staggered(
+                sched.submit, offsets, prompts, args.dec
+            )
+            sched.shutdown(timeout=60)
+            sides[label] = {
+                "ttft": ttft, "outs": outs, "wall": wall,
+                "prefill_tokens": int(engine.stats["prefill_tokens"]) - tok0,
+                "hits": int(pfx["hits"]) - h0,
+                "hit_tokens": int(pfx["hit_tokens"]) - ht0,
+                "traces": int(engine.stats["traces"]),
+            }
+
+    a, b = sides["cached"], sides["nocache"]
+    if [len(o) for o in a["outs"]] != [len(o) for o in b["outs"]]:
+        raise RuntimeError(
+            "prefix-cache DELIVERED COUNTS diverged from cache-off — the "
+            "TTFT/prefill A/B would be unfair"
+        )
+    divergent = sum(1 for x, y in zip(a["outs"], b["outs"]) if x != y)
+    n_dev = jax.device_count()
+    rows = []
+    for label, side in (("cached", a), ("nocache", b)):
+        toks = sum(len(o) for o in side["outs"])
+        rows.append({
+            "metric": f"gpt345m_decode_prefix_{label}",
+            "value": round(toks / side["wall"] / n_dev, 1),
+            "unit": "delivered new tokens/s/chip (prefix-heavy staggered)",
+            "vs_baseline": None,
+            "arrivals": n_req, "prompt_len": args.prompt,
+            "dec_len": args.dec,
+            "shared_prefix_len": shared_len,
+            "mean_gap_s": round(float(gap_frac * t_one), 4),
+            "single_decode_s": round(float(t_one), 4),
+            "p50_ttft_s": round(float(np.quantile(side["ttft"], 0.5)), 4),
+            "p99_ttft_s": round(float(np.quantile(side["ttft"], 0.99)), 4),
+            "prefill_tokens": side["prefill_tokens"],
+            "prefix_hits": side["hits"],
+            "prefix_hit_tokens": side["hit_tokens"],
+            "hit_rate": round(side["hits"] / n_req, 4),
+            "greedy_divergent_rows": divergent,
+            "jit_traces": side["traces"],
+            "strategy": "greedy_search",
+            "decode_path": "overhauled",
+            "scheduler": "continuous",
+            **_mfu_fields(server.module.config, toks / side["wall"] / n_dev),
+            "platform": jax.default_backend(),
+        })
+    return rows
+
+
 def _parent(argv) -> int:
     from bench import run_child_with_honest_fallback
 
@@ -653,6 +780,8 @@ def _child(argv) -> None:
                 rows = [run_serving_case(args)]
             elif name == "staggered":
                 rows = run_staggered_case(args)
+            elif name == "prefix":
+                rows = run_prefix_case(args)
             elif "_spec" in name:
                 rows = [run_spec_case(name, args, params_cache)]
             elif name.endswith("_kvint8"):
@@ -676,7 +805,7 @@ def _argparser():
         "--cases",
         default="b8_greedy,b8_greedy_legacy,b8_topp,b8_topp_legacy,"
                 "b32_greedy,b32_greedy_legacy,b32_topp,b32_topp_legacy,"
-                "b8_greedy_spec4,b8_greedy_kvint8,serving,staggered",
+                "b8_greedy_spec4,b8_greedy_kvint8,serving,staggered,prefix",
     )
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--dec", type=int, default=256)

@@ -128,6 +128,16 @@ class _Row:
     # sampled deep-dive trace context (utils/tracing.py) or None: the
     # engine stamps prefill + per-chunk decode events onto it
     trace: Any = None
+    # prefix reuse / chunked prefill (docs/serving.md): tokens matched
+    # against the prefix index (their KV was mapped shared, never
+    # recomputed), prompt tokens still to prefill, the per-row chunk
+    # width its chunk compiles key on, and whether prefill finished
+    # (only then is the row decode-active and its prefix publishable)
+    prefix_hit: int = 0
+    pending: List[int] = dataclasses.field(default_factory=list)
+    prefill_pos: int = 0
+    chunk: int = 0
+    prefill_done: bool = True
 
 
 @dataclasses.dataclass(eq=False)
@@ -165,7 +175,9 @@ class PagedDecodeEngine:
     """
 
     def __init__(self, server, *, max_batch: int = 8, block: int = 0,
-                 num_blocks: int = 0, spec="auto", kv_dtype: str = "") -> None:
+                 num_blocks: int = 0, spec="auto", kv_dtype: str = "",
+                 prefix_cache_blocks: int = 0,
+                 prefill_chunk: int = 0) -> None:
         from paddlefleetx_tpu.models.gpt.generation import init_paged_pools
         from paddlefleetx_tpu.parallel.mesh import data_parallel_world
 
@@ -201,7 +213,26 @@ class PagedDecodeEngine:
         self.capacity = -(-int(max_batch) // dpw) * dpw
         if num_blocks <= 0:
             num_blocks = self.capacity * self.max_row_blocks + 1
-        self.cache = PagedCacheManager(num_blocks, self.block)
+        # shared-prefix KV reuse + chunked prefill (docs/serving.md):
+        # prefix_cache_blocks > 0 lets finished rows publish their
+        # prompt-prefix blocks into a radix index later admissions map
+        # as SHARED (refcounted) table entries; prefill_chunk > 0
+        # (block-multiple) streams long prompts in chunk-sized pieces,
+        # one per scheduler iteration, interleaved with decode steps
+        if prefix_cache_blocks < 0:
+            raise ValueError(
+                f"prefix_cache_blocks must be >= 0, got {prefix_cache_blocks}"
+            )
+        if prefill_chunk and (prefill_chunk < self.block
+                              or prefill_chunk % self.block):
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} must be 0 or a positive "
+                f"multiple of the KV block size {self.block}"
+            )
+        self.prefill_chunk = int(prefill_chunk)
+        self.cache = PagedCacheManager(
+            num_blocks, self.block, prefix_blocks=prefix_cache_blocks
+        )
         self.pools = init_paged_pools(
             self.mcfg, num_blocks, self.block, kv_dtype=self.kv_dtype
         )
@@ -226,13 +257,19 @@ class PagedDecodeEngine:
         self._compiled_step: Dict = {}
         self._compiled_prefill: Dict = {}
         self._compiled_adopt: Dict = {}
+        self._compiled_chunk: Dict = {}
+        self._compiled_copy = None
         # trace-time entries across the compiled families — the bounded-
         # retrace contract's probe, like GenerationServer.stats["traces"]
-        # ("exports"/"adopts" count disaggregated KV handoffs served)
+        # ("exports"/"adopts" count disaggregated KV handoffs served;
+        # "prefill_tokens" counts prompt tokens actually COMPUTED — a
+        # prefix hit's shared span never enters it, the reuse evidence;
+        # "prefill_chunks" counts chunk dispatches)
         self.stats: Dict[str, Any] = {
             "traces": 0, "steps": 0, "prefills": 0,
             "spec_proposed": 0, "spec_accepted": 0,
             "exports": 0, "adopts": 0,
+            "prefill_tokens": 0, "prefill_chunks": 0,
         }
         # True only inside warmup(): warmup admits/steps are not traffic
         # and must not bump the traffic-facing registry counters (the
@@ -368,15 +405,118 @@ class PagedDecodeEngine:
             get_registry().counter("pfx_serving_traces_total").inc()
         return fn
 
+    def _chunk_fn(self, t: int, M: int):
+        """Compiled chunk-prefill family, keyed (chunk width t, table
+        width bucket M) — bounded like the step family and counted the
+        same way."""
+        key = (self._gen_key, t, M)
+        fn = self._compiled_chunk.get(key)
+        if fn is None:
+            from paddlefleetx_tpu.models.gpt.generation import (
+                PagedPools,
+                paged_chunk_prefill,
+            )
+
+            def traced(p, tokens, pools_t, table, position, n_valid,
+                       last_idx):
+                self.stats["traces"] += 1
+                pools, last = paged_chunk_prefill(
+                    p, tokens, PagedPools(*pools_t), table, position,
+                    n_valid, last_idx, self.mcfg, ctx=self.ctx,
+                )
+                return tuple(x for x in pools if x is not None), last
+
+            fn = self._jax.jit(traced, donate_argnums=(2,))
+            self._compiled_chunk[key] = fn
+            get_registry().counter("pfx_serving_traces_total").inc()
+        return fn
+
+    def _copy_fn(self):
+        """Compiled single-block arena copy (COW: a row diverging
+        mid-block gets a PRIVATE copy of the cached block to overwrite
+        from the divergence slot on).  Block ids are runtime data — one
+        compile, ever."""
+        fn = self._compiled_copy
+        if fn is None:
+            from paddlefleetx_tpu.models.gpt.generation import PagedPools
+
+            def traced(pools_t, src, dst):
+                self.stats["traces"] += 1
+                pools = PagedPools(*pools_t)
+                out = tuple(
+                    x.at[:, dst].set(x[:, src])
+                    for x in pools if x is not None
+                )
+                return out
+
+            fn = self._jax.jit(traced, donate_argnums=(0,))
+            self._compiled_copy = fn
+            get_registry().counter("pfx_serving_traces_total").inc()
+        return fn
+
     # -- row lifecycle --------------------------------------------------
+    @property
+    def prefix_enabled(self) -> bool:
+        return self.cache.prefix.enabled
+
+    def _cache_admit(self, seq_id: int, tokens: int,
+                     shared: Optional[List[int]] = None) -> List[int]:
+        """`PagedCacheManager.admit` with the eviction accounting kept
+        registry-synced: any cached prefixes the admission displaced
+        under pool pressure bump pfx_prefix_evictions_total together
+        with the index stats — EVERY admission spelling (admit / adopt /
+        prefill_export) must route through here or the decision-log
+        replay and /metrics drift apart."""
+        ev0 = self.cache.prefix.stats["evictions"]
+        try:
+            return self.cache.admit(seq_id, tokens, shared=shared)
+        finally:
+            evicted = self.cache.prefix.stats["evictions"] - ev0
+            if evicted and not self._warmup:
+                get_registry().counter(
+                    "pfx_prefix_evictions_total"
+                ).inc(evicted)
+
+    def _dispatch_donating(self, thunk, what: str,
+                           release_seq: Optional[int] = None):
+        """Run one donating dispatch under the arena error contract: any
+        failure means the pools may be donation-invalidated — release a
+        not-yet-slotted row's allocation first (``release_seq``; a row
+        already in ``slots`` is released by :meth:`reset` itself),
+        rebuild the arena, and raise :class:`ArenaReset` carrying the
+        dead rows.  ONE spelling for the COW-copy / monolithic-prefill /
+        chunk dispatches so the recovery contract cannot drift between
+        them."""
+        try:
+            with self.mesh:
+                return thunk()
+        except BaseException as exc:
+            if release_seq is not None:
+                self.cache.release(release_seq)
+            dead = self.reset()
+            raise ArenaReset(
+                f"{what} failed ({type(exc).__name__}: {exc}); arena reset",
+                dead,
+            ) from exc
+
     def admit(self, prompt_ids: Sequence[int], max_new: int,
               entry: Optional[_CBEntry] = None, row_idx: int = 0) -> int:
         """Allocate blocks + a batch slot and prefill the prompt into the
         arena.  Raises :class:`BlockPoolExhausted` / RuntimeError("no
-        free slot") when full — callers check :meth:`can_admit` first."""
+        free slot") when full — callers check :meth:`can_admit` first.
+
+        With the prefix cache on, the radix index is consulted first:
+        the matched span's cached blocks map into the new row's table as
+        SHARED entries (their KV is never recomputed — only the suffix
+        runs through the model), a mid-block divergence gets a private
+        copy-on-write block, and the suffix rides the chunk family.
+        With ``prefill_chunk`` set, a long prompt is admitted
+        mid-prefill: one chunk runs now, the rest stream one per
+        scheduler iteration interleaved with decode steps."""
         from paddlefleetx_tpu.models.gpt.generation import bucket_len
 
         jnp = self._jnp
+        prompt_ids = [int(t) for t in prompt_ids]
         plen = len(prompt_ids)
         if plen < 1:
             raise ValueError("prompt must be non-empty")
@@ -397,65 +537,190 @@ class PagedDecodeEngine:
         slot = next((i for i, r in enumerate(self.slots) if r is None), None)
         if slot is None:
             raise RuntimeError("no free slot in the running batch")
+        # prefix lookup — warmup admissions neither hit nor publish:
+        # their synthetic prompts must not pollute the index, and the
+        # pfx_prefix_* counters stay traffic-only (the decision-log
+        # replay contract)
+        shared: List[int] = []
+        cow = None
+        m = 0
+        if self.prefix_enabled and not self._warmup:
+            shared, cow, m = self.cache.prefix.match(prompt_ids)
         self._seq_counter += 1
         seq_id = self._seq_counter
-        table = self.cache.admit(
-            seq_id, self.row_capacity_tokens(plen, max_new)
+        table = self._cache_admit(
+            seq_id, self.row_capacity_tokens(plen, max_new), shared=shared
         )
-        PB = blocks_for(P, self.block)
-        # prefill scatters PB blocks (bucket width incl. pad junk, which
-        # lands in the row's own blocks — row_capacity_tokens reserves at
-        # least the bucket width, so the table always covers PB)
-        prefill_table = table[:PB]
-        prompt = np.full((1, P), self.gen.pad_token_id, np.int32)
-        prompt[0, :plen] = list(prompt_ids)  # RIGHT-pad (paged rows are unpadded)
-        fn = self._prefill_fn(P, PB)
+        if self.prefix_enabled and not self._warmup:
+            # the admission LANDED: commit the lookup's hit/miss stats
+            # and the registry counters together (a failed allocation
+            # above raised before either moved — index stats and
+            # counters can never desync, the exact-replay contract)
+            self.cache.prefix.record_lookup(m)
+            reg = get_registry()
+            if m:
+                reg.counter("pfx_prefix_hits_total").inc()
+                reg.counter("pfx_prefix_hit_tokens_total").inc(m)
+            else:
+                reg.counter("pfx_prefix_misses_total").inc()
         trace = entry.future.trace if entry is not None else None
-        t_prefill = time.monotonic()
-        try:
-            with self.mesh:
-                pools_t, last, counts = fn(
+        if cow is not None:
+            # copy-on-write: the diverging cached block is copied into
+            # the row's first PRIVATE block; the suffix prefill below
+            # overwrites it from the divergence slot on, so the cached
+            # original (and every row sharing it) is never touched
+            src, _keep = cow
+            dst = table[len(shared)]
+            fn = self._copy_fn()
+            pools_t = self._dispatch_donating(
+                lambda: fn(
+                    self._pools_tuple(), jnp.int32(src), jnp.int32(dst)
+                ),
+                "prefix COW copy", release_seq=seq_id,
+            )
+            from paddlefleetx_tpu.models.gpt.generation import PagedPools
+
+            self.pools = PagedPools(*pools_t)
+
+        if m == 0 and self.prefill_chunk == 0:
+            # no reuse, no chunking: the original monolithic prefill
+            # (contiguous forward + block repack), kept bit-identical
+            PB = blocks_for(P, self.block)
+            # prefill scatters PB blocks (bucket width incl. pad junk,
+            # which lands in the row's own blocks — row_capacity_tokens
+            # reserves at least the bucket width, so the table covers PB)
+            prefill_table = table[:PB]
+            prompt = np.full((1, P), self.gen.pad_token_id, np.int32)
+            prompt[0, :plen] = prompt_ids  # RIGHT-pad (paged rows are unpadded)
+            fn = self._prefill_fn(P, PB)
+            t_prefill = time.monotonic()
+            pools_t, last, counts = self._dispatch_donating(
+                lambda: fn(
                     self.server.params,
                     jnp.asarray(prompt),
                     jnp.int32(plen),
                     self._pools_tuple(),
                     jnp.asarray(prefill_table, jnp.int32),
-                )
-        except BaseException as exc:
-            # pools were fed to a donating dispatch: assume invalidated
-            self.cache.release(seq_id)
-            dead = self.reset()
-            raise ArenaReset(
-                f"prefill failed ({type(exc).__name__}: {exc}); arena reset",
-                dead,
-            ) from exc
-        from paddlefleetx_tpu.models.gpt.generation import PagedPools
+                ),
+                "prefill", release_seq=seq_id,
+            )
+            from paddlefleetx_tpu.models.gpt.generation import PagedPools
 
-        self.pools = PagedPools(*pools_t)
-        self._logits = self._logits.at[slot].set(last)
-        self._counts = self._counts.at[slot].set(counts)
-        self._reject = self._reject.at[slot].set(-1)
-        self.positions[slot] = plen
+            self.pools = PagedPools(*pools_t)
+            self._logits = self._logits.at[slot].set(last)
+            self._counts = self._counts.at[slot].set(counts)
+            self._reject = self._reject.at[slot].set(-1)
+            self.positions[slot] = plen
+            self.gen_steps[slot] = 0
+            self.max_news[slot] = max_new
+            # forced-EOS fires where the COALESCE path fires it: the
+            # bucketed run end of core/serving.plan_decode (min(ceil32(
+            # budget), context room)) — NOT the raw budget, whose step
+            # the contiguous path's trimmed output usually never shows
+            self.forced_steps[slot] = min(-(-max_new // 32) * 32, limit) - 1
+            self.active[slot] = True
+            if trace is not None:
+                trace.span(
+                    "prefill", t0=t_prefill, t1=time.monotonic(),
+                    prompt_len=plen, bucket=P, blocks=len(table), slot=slot,
+                )
+            self.slots[slot] = _Row(
+                seq_id=seq_id, entry=entry, row_idx=row_idx, prompt_len=plen,
+                max_new=max_new, table=table, prompt_ids=prompt_ids,
+                trace=trace,
+            )
+            self.stats["prefills"] += 1
+            self.stats["prefill_tokens"] += plen
+            return slot
+
+        # prefix-hit / chunked path: only the unmatched suffix
+        # [m, plen) ever runs through the model, in chunk-sized pieces
+        # riding the compiled chunk family.  The row sits decode-INACTIVE
+        # until its last chunk lands (a fixed-shape decode step ignores
+        # it), so decode latency stays flat while the prompt streams in.
+        chunk = self.prefill_chunk or bucket_len(plen - m, self.bucket)
+        self.positions[slot] = m
         self.gen_steps[slot] = 0
         self.max_news[slot] = max_new
-        # forced-EOS fires where the COALESCE path fires it: the bucketed
-        # run end of core/serving.plan_decode (min(ceil32(budget), context
-        # room)) — NOT the raw budget, whose step the contiguous path's
-        # trimmed output usually never shows
         self.forced_steps[slot] = min(-(-max_new // 32) * 32, limit) - 1
-        self.active[slot] = True
-        if trace is not None:
-            trace.span(
-                "prefill", t0=t_prefill, t1=time.monotonic(),
-                prompt_len=plen, bucket=P, blocks=len(table), slot=slot,
-            )
+        self.active[slot] = False
         self.slots[slot] = _Row(
             seq_id=seq_id, entry=entry, row_idx=row_idx, prompt_len=plen,
-            max_new=max_new, table=table, prompt_ids=list(prompt_ids),
-            trace=trace,
+            max_new=max_new, table=table, prompt_ids=prompt_ids,
+            trace=trace, prefix_hit=m, pending=prompt_ids[m:],
+            prefill_pos=m, chunk=chunk, prefill_done=False,
         )
         self.stats["prefills"] += 1
+        if trace is not None and m:
+            trace.event(
+                "prefix_hit", slot=slot, hit_tokens=m,
+                shared_blocks=len(shared), cow=cow is not None,
+            )
+        # first chunk runs NOW (admission = work started); the rest ride
+        # step(), one per scheduler iteration, interleaved with decode
+        self._tick_prefill(slot)
         return slot
+
+    def _tick_prefill(self, slot: int) -> None:
+        """Run ONE chunk of a mid-prefill row's prompt suffix.  The
+        final chunk seeds the row's pending logits (last REAL prompt
+        token) + repetition counts and flips it decode-active."""
+        jnp = self._jnp
+        row = self.slots[slot]
+        take = min(row.chunk, len(row.pending))
+        toks = np.full((1, row.chunk), self.gen.pad_token_id, np.int32)
+        toks[0, :take] = row.pending[:take]
+        final = take == len(row.pending)
+        M = min(
+            _pow2_at_least(len(row.table)),
+            _pow2_at_least(self.max_row_blocks),
+        )
+        tbl = np.full((M,), NULL_BLOCK, np.int32)
+        tbl[: len(row.table)] = row.table
+        fn = self._chunk_fn(row.chunk, M)
+        t0 = time.monotonic()
+        # no release_seq: this row already sits in slots, so reset()
+        # releases it with the other dead rows
+        pools_t, last = self._dispatch_donating(
+            lambda: fn(
+                self.server.params,
+                jnp.asarray(toks),
+                self._pools_tuple(),
+                jnp.asarray(tbl),
+                jnp.int32(row.prefill_pos),
+                jnp.int32(take),
+                jnp.int32(max(take - 1, 0)),
+            ),
+            "chunk prefill",
+        )
+        from paddlefleetx_tpu.models.gpt.generation import (
+            PagedPools,
+            prefix_token_counts,
+        )
+
+        self.pools = PagedPools(*pools_t)
+        row.pending = row.pending[take:]
+        row.prefill_pos += take
+        self.positions[slot] = row.prefill_pos
+        self.stats["prefill_chunks"] += 1
+        self.stats["prefill_tokens"] += take
+        if not self._warmup:
+            get_registry().counter("pfx_prefill_chunks_total").inc()
+        if row.trace is not None:
+            row.trace.span(
+                "prefill_chunk", t0=t0, t1=time.monotonic(), slot=slot,
+                tokens=take, position=row.prefill_pos, final=final,
+            )
+        if final:
+            counts = prefix_token_counts(
+                row.prompt_ids, int(self.mcfg.vocab_size)
+            )
+            self._logits = self._logits.at[slot].set(last)
+            self._counts = self._counts.at[slot].set(jnp.asarray(counts))
+            self._reject = self._reject.at[slot].set(-1)
+            self.positions[slot] = row.prompt_len
+            self.active[slot] = True
+            row.prefill_done = True
 
     # -- disaggregated prefill/decode (KV handoff) ----------------------
     def _pool_sig(self) -> List[int]:
@@ -503,29 +768,22 @@ class PagedDecodeEngine:
         seq_id = self._seq_counter
         # reserve ONLY the prompt bucket: the decode budget is the
         # decode replica's to hold
-        table = self.cache.admit(seq_id, P)
+        table = self._cache_admit(seq_id, P)
         prompt = np.full((1, P), self.gen.pad_token_id, np.int32)
         prompt[0, :plen] = list(prompt_ids)
         jnp = self._jnp
         fn = self._prefill_fn(P, PB)
         t0 = time.monotonic()
-        try:
-            with self.mesh:
-                pools_t, last, counts = fn(
-                    self.server.params,
-                    jnp.asarray(prompt),
-                    jnp.int32(plen),
-                    self._pools_tuple(),
-                    jnp.asarray(table, jnp.int32),
-                )
-        except BaseException as exc:
-            self.cache.release(seq_id)
-            dead = self.reset()
-            raise ArenaReset(
-                f"prefill export failed ({type(exc).__name__}: {exc}); "
-                "arena reset",
-                dead,
-            ) from exc
+        pools_t, last, counts = self._dispatch_donating(
+            lambda: fn(
+                self.server.params,
+                jnp.asarray(prompt),
+                jnp.int32(plen),
+                self._pools_tuple(),
+                jnp.asarray(table, jnp.int32),
+            ),
+            "prefill export", release_seq=seq_id,
+        )
         from paddlefleetx_tpu.models.gpt.generation import (
             PagedPools,
             gather_kv_blocks,
@@ -620,7 +878,7 @@ class PagedDecodeEngine:
             raise RuntimeError("no free slot in the running batch")
         self._seq_counter += 1
         seq_id = self._seq_counter
-        table = self.cache.admit(
+        table = self._cache_admit(
             seq_id, self.row_capacity_tokens(plen, max_new)
         )
         # NAMES order (k, v, scales) — _adopt_fn zips the same order
@@ -628,21 +886,14 @@ class PagedDecodeEngine:
         trace = entry.future.trace if entry is not None else None
         t0 = time.monotonic()
         fn = self._adopt_fn(PB)
-        try:
-            with self.mesh:
-                pools_t = fn(
-                    self._pools_tuple(),
-                    jnp.asarray(table[:PB], jnp.int32),
-                    blocks_t,
-                )
-        except BaseException as exc:
-            self.cache.release(seq_id)
-            dead = self.reset()
-            raise ArenaReset(
-                f"handoff adopt failed ({type(exc).__name__}: {exc}); "
-                "arena reset",
-                dead,
-            ) from exc
+        pools_t = self._dispatch_donating(
+            lambda: fn(
+                self._pools_tuple(),
+                jnp.asarray(table[:PB], jnp.int32),
+                blocks_t,
+            ),
+            "handoff adopt", release_seq=seq_id,
+        )
         from paddlefleetx_tpu.models.gpt.generation import PagedPools
 
         self.pools = PagedPools(*pools_t)
@@ -711,6 +962,18 @@ class PagedDecodeEngine:
         returns the slots that finished this step (their tokens are
         complete — release them with :meth:`release`)."""
         jnp = self._jnp
+        # chunked-prefill interleave: at most ONE pending chunk per
+        # iteration, oldest admission first — a long prompt streams in
+        # across iterations while the decode batch below keeps stepping,
+        # so no prefill ever head-of-line-blocks active rows
+        pending = [
+            i for i, r in enumerate(self.slots)
+            if r is not None and not r.prefill_done
+        ]
+        if pending:
+            self._tick_prefill(
+                min(pending, key=lambda i: self.slots[i].seq_id)
+            )
         if not self.active.any():
             return []
         M = self.table_width_bucket()
@@ -793,10 +1056,23 @@ class PagedDecodeEngine:
     def release(self, slot: int) -> None:
         """Return a finished/evicted row's blocks to the pool and clear
         its batch slot (loud on an empty slot — a double release means
-        the caller's bookkeeping aliased two rows)."""
+        the caller's bookkeeping aliased two rows).  With the prefix
+        cache on, the row's PROMPT-prefix blocks are published to the
+        radix index first (the index takes its own references, so the
+        blocks outlive the row under the LRU budget); a row still
+        mid-chunked-prefill never publishes — its blocks are only
+        partially written."""
         row = self.slots[slot]
         if row is None:
             raise ValueError(f"slot {slot} is already empty")
+        if self.prefix_enabled and not self._warmup and row.prefill_done:
+            ev0 = self.cache.prefix.stats["evictions"]
+            self.cache.prefix.publish(row.prompt_ids, row.table)
+            evicted = self.cache.prefix.stats["evictions"] - ev0
+            if evicted:
+                get_registry().counter(
+                    "pfx_prefix_evictions_total"
+                ).inc(evicted)
         self.cache.release(row.seq_id)
         self.slots[slot] = None
         self.active[slot] = False
@@ -815,6 +1091,10 @@ class PagedDecodeEngine:
         dead = [r for r in self.slots if r is not None]
         for r in dead:
             self.cache.release(r.seq_id)
+        # the rebuilt pools hold NONE of the old blocks' KV: every cached
+        # prefix is donation-invalidated and must never resurface as a
+        # hit — drop the whole index (its block references with it)
+        self.cache.prefix.clear()
         self.slots = [None] * self.capacity
         self.active[:] = False
         self.positions[:] = 0
@@ -858,19 +1138,95 @@ class PagedDecodeEngine:
             self._warmup = False
         return per
 
+    def _warm_copy_family(self) -> None:
+        """Compile the COW arena copy (one compile ever): a null-block
+        self-copy is a safe no-op dispatch.  Without it, the first
+        mid-block-divergence hit after boot would pay this compile
+        inside a scheduler iteration."""
+        fn = self._copy_fn()
+        pools_t = self._dispatch_donating(
+            lambda: fn(
+                self._pools_tuple(),
+                self._jnp.int32(NULL_BLOCK), self._jnp.int32(NULL_BLOCK),
+            ),
+            "COW copy warmup",
+        )
+        from paddlefleetx_tpu.models.gpt.generation import PagedPools
+
+        self.pools = PagedPools(*pools_t)
+
+    def _warm_chunk_family(self, n: int) -> None:
+        """Compile the chunk fns a traffic prefix hit at bucket ``n``
+        routes its suffix through (only needed when ``prefill_chunk`` is
+        off — a chunked config's normal warmup admission already rides
+        the chunk path): the SHORT-suffix chunk (one bucket quantum —
+        the hot case, a long cached prefix plus a short new suffix) and
+        the full-bucket chunk, both at the table-width bucket a
+        bucket-``n`` row allocates.  A null-table dispatch with
+        ``n_valid=0`` compiles each without touching the arena.
+        Suffix buckets between those two still compile on first use,
+        and the width bucket follows the DEFAULT decode budget exactly
+        like the warmed step family does (a request with a much smaller
+        max_tokens keys a narrower width and compiles then) — the same
+        partial-coverage contract as the prompt buckets."""
+        from paddlefleetx_tpu.models.gpt.generation import (
+            PagedPools,
+            bucket_len,
+        )
+
+        jnp = self._jnp
+        blocks = blocks_for(
+            self.row_capacity_tokens(int(n), self.gen.max_dec_len),
+            self.block,
+        )
+        M = min(_pow2_at_least(blocks), _pow2_at_least(self.max_row_blocks))
+        for t in sorted({self.bucket, bucket_len(int(n), self.bucket)}):
+            fn = self._chunk_fn(t, M)
+            toks = np.full((1, t), self.gen.pad_token_id, np.int32)
+            tbl = np.full((M,), NULL_BLOCK, np.int32)
+            pools_t, _ = self._dispatch_donating(
+                lambda: fn(
+                    self.server.params, jnp.asarray(toks),
+                    self._pools_tuple(), jnp.asarray(tbl),
+                    jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                ),
+                "chunk warmup",
+            )
+            self.pools = PagedPools(*pools_t)
+
     def warmup(self, prompt_lens: Sequence[int]) -> Dict[str, float]:
         """Compile (prefill, step) for each prompt bucket at the default
         decode budget — the continuous counterpart of
-        `GenerationServer.warmup`; fails loudly naming the bucket."""
+        `GenerationServer.warmup`; fails loudly naming the bucket.  With
+        the prefix cache on, also compiles the chunk + COW-copy families
+        a traffic hit will route through (suffix buckets smaller than
+        the warmed list still compile on first use — the same
+        partial-coverage contract as the prompt buckets themselves)."""
         per: Dict[str, float] = {}
         self._warmup = True  # warmup admits/steps are not traffic
         try:
+            if self.prefix_enabled:
+                self._warm_copy_family()
             for n in prompt_lens:
                 t0 = time.time()
                 try:
+                    if self.prefix_enabled and self.prefill_chunk == 0:
+                        self._warm_chunk_family(int(n))
                     slot = self.admit(
                         [1] * int(n), max_new=self.gen.max_dec_len
                     )
+                    # with chunked prefill on, admission returns
+                    # mid-prefill: drive the remaining chunks so the
+                    # whole chunk family compiles before traffic
+                    guard = 0
+                    while (self.slots[slot] is not None
+                           and not self.slots[slot].prefill_done):
+                        self.step()
+                        guard += 1
+                        if guard > 4096:
+                            raise RuntimeError(
+                                "warmup prefill never completed"
+                            )
                     self.step()
                     if self.slots[slot] is not None:
                         self.release(slot)
@@ -967,9 +1323,13 @@ class ContinuousScheduler:
             ("pfx_kv_blocks_used", {}, float(cstats["kv_blocks_used"])),
             ("pfx_kv_blocks_free", {}, float(cstats["kv_blocks_free"])),
             # live arena payload bytes: used blocks x K+V bytes/block —
-            # int8 halves the per-block bytes, the acceptance evidence
+            # int8 halves the per-block bytes, the acceptance evidence.
+            # kv_blocks_used counts PHYSICAL blocks (refcount-deduped),
+            # so neither gauge can exceed the arena under any sharing
             ("pfx_kv_bytes", {},
              float(cstats["kv_blocks_used"]) * eng.kv_block_bytes()),
+            ("pfx_prefix_cached_blocks", {},
+             float(cstats["prefix_cached_blocks"])),
         ]
         if eng.spec is not None:
             prop = float(eng.stats["spec_proposed"])
@@ -1118,6 +1478,8 @@ class ContinuousScheduler:
                 "tokens_out": len(r.tokens),
                 "blocks": len(r.table),
                 "active": bool(eng.active[i]),
+                "prefix_hit_tokens": r.prefix_hit,
+                "prefill_pending": len(r.pending),
             })
         view: Dict[str, Any] = {
             # which scheduler iteration this view reflects: staleness is
@@ -1136,9 +1498,24 @@ class ContinuousScheduler:
             "compiled": {
                 "prefill_families": len(eng._compiled_prefill),
                 "step_families": len(eng._compiled_step),
+                "chunk_families": len(eng._compiled_chunk),
                 "traces": int(eng.stats["traces"]),
             },
         }
+        if eng.prefix_enabled or eng.prefill_chunk:
+            pfx = eng.cache.prefix
+            view["prefix_cache"] = {
+                "enabled": eng.prefix_enabled,
+                "budget_blocks": pfx.budget,
+                "cached_blocks": pfx.cached_blocks(),
+                "hits": int(pfx.stats["hits"]),
+                "misses": int(pfx.stats["misses"]),
+                "hit_tokens": int(pfx.stats["hit_tokens"]),
+                "evictions": int(pfx.stats["evictions"]),
+                "prefill_chunk": eng.prefill_chunk,
+                "prefill_chunks": int(eng.stats["prefill_chunks"]),
+                "prefill_tokens": int(eng.stats["prefill_tokens"]),
+            }
         if eng.spec is not None:
             prop = int(eng.stats["spec_proposed"])
             acc = int(eng.stats["spec_accepted"])
@@ -1318,6 +1695,11 @@ class ContinuousScheduler:
         evict0 = int(self.stats["evictions"])
         spec_p0 = int(eng.stats["spec_proposed"])
         spec_a0 = int(eng.stats["spec_accepted"])
+        pfx = eng.cache.prefix.stats
+        pfx_h0 = int(pfx["hits"])
+        pfx_t0 = int(pfx["hit_tokens"])
+        pfx_e0 = int(pfx["evictions"])
+        chunks0 = int(eng.stats["prefill_chunks"])
         blocks_free0 = eng.cache.allocator.free_count()
         n_finished = 0
         try:
@@ -1347,6 +1729,13 @@ class ContinuousScheduler:
                         int(eng.stats["spec_proposed"]) - spec_p0,
                     "spec_accepted":
                         int(eng.stats["spec_accepted"]) - spec_a0,
+                    # prefix-reuse + chunked-prefill accounting: hits
+                    # join the exact-replay contract (replay reproduces
+                    # pfx_prefix_hits_total like the admit/evict trio)
+                    "prefix_hits": int(pfx["hits"]) - pfx_h0,
+                    "prefix_hit_tokens": int(pfx["hit_tokens"]) - pfx_t0,
+                    "prefix_evictions": int(pfx["evictions"]) - pfx_e0,
+                    "chunks": int(eng.stats["prefill_chunks"]) - chunks0,
                 }
                 with self._lock:
                     self.decision_log.append(row)
@@ -1393,6 +1782,11 @@ class ContinuousScheduler:
             # capacity stays queued instead of hard-failing at admit()
             free_slots = eng.free_slots()
             free_blocks = eng.cache.allocator.free_count()
+            # cached-prefix blocks only the index references evict on
+            # demand inside admit — add them to the budget LAZILY (the
+            # reclaimable scan is O(cached nodes); an iteration whose
+            # free pool already covers its admissions never pays it)
+            reclaim_counted = False
             while self._entries:
                 head = self._entries[0]
                 if head.future.done():
@@ -1405,6 +1799,9 @@ class ContinuousScheduler:
                 need = blocks_for(
                     eng.row_capacity_tokens(len(p), head.max_new), eng.block
                 )
+                if need > free_blocks and not reclaim_counted:
+                    free_blocks += eng.cache.prefix.reclaimable_blocks()
+                    reclaim_counted = True
                 if free_slots < 1 or need > free_blocks:
                     break
                 free_slots -= 1

@@ -87,6 +87,15 @@ class BlockAllocator:
     list sorted), which keeps live allocations packed toward the front of
     the arena — helpful DMA locality, and `fragmentation()` stays an
     honest metric instead of an artifact of churn order.
+
+    Blocks are REFCOUNTED so one physical block can back the same prefix
+    in many rows' tables (shared-prefix KV reuse, docs/serving.md):
+    ``alloc`` hands blocks out at refcount 1, ``share`` takes one more
+    reference per caller, and ``free`` drops one reference — the block
+    returns to the pool only at refcount 0, so evicting a cached prefix
+    can never reclaim a block a live row still reads.  ``used_count``
+    counts PHYSICAL blocks (each once, regardless of refcount): arena
+    occupancy and byte gauges must never be inflated by sharing.
     """
 
     def __init__(self, num_blocks: int) -> None:
@@ -96,14 +105,24 @@ class BlockAllocator:
             )
         self.num_blocks = int(num_blocks)
         self._free: List[int] = list(range(1, self.num_blocks))
-        self._used: set = set()
+        self._ref: Dict[int, int] = {}
 
     # -- queries --------------------------------------------------------
     def free_count(self) -> int:
         return len(self._free)
 
     def used_count(self) -> int:
-        return len(self._used)
+        """Physical blocks currently allocated — each counted ONCE no
+        matter how many tables reference it."""
+        return len(self._ref)
+
+    def refcount(self, block: int) -> int:
+        """References held on ``block`` (0 = free)."""
+        if not (0 < block < self.num_blocks):
+            raise ValueError(
+                f"block id {block} out of range (1..{self.num_blocks - 1})"
+            )
+        return self._ref.get(block, 0)
 
     def fragmentation(self) -> float:
         """1 - (largest contiguous free run / free blocks): 0.0 when the
@@ -131,14 +150,39 @@ class BlockAllocator:
             )
         self._free.sort()
         out, self._free = self._free[:n], self._free[n:]
-        self._used.update(out)
+        for b in out:
+            self._ref[b] = 1
         return out
 
+    def share(self, blocks) -> None:
+        """Take ONE additional reference on each block (prefix sharing:
+        the caller's table now also points at it).  LOUD on the null
+        block, an out-of-range id, or a block that is not currently
+        allocated — sharing a free block would alias it against the next
+        ``alloc``.  Atomic: a failing call takes no references."""
+        blocks = list(blocks)
+        for b in blocks:
+            if b == NULL_BLOCK:
+                raise ValueError("cannot share the null block (id 0)")
+            if not (0 < b < self.num_blocks):
+                raise ValueError(
+                    f"block id {b} out of range (1..{self.num_blocks - 1})"
+                )
+            if b not in self._ref:
+                raise ValueError(
+                    f"cannot share free block {b} (not currently allocated)"
+                )
+        for b in blocks:
+            self._ref[b] += 1
+
     def free(self, blocks) -> None:
-        """Return blocks to the pool.  LOUD on a double-free, the null
-        block, or an out-of-range id: any of those means two sequences
-        believe they own one block — silent acceptance would corrupt
-        both caches."""
+        """Drop one reference per block; a block returns to the pool only
+        when its last reference drops.  LOUD on an over-free (more frees
+        than references), the null block, or an out-of-range id: any of
+        those means two sequences believe they own one reference —
+        silent acceptance would corrupt both caches.  A duplicate id
+        within ONE call is rejected outright (a single table never holds
+        a block twice, so it is always a bookkeeping bug)."""
         blocks = list(blocks)
         seen: set = set()
         for b in blocks:
@@ -148,14 +192,16 @@ class BlockAllocator:
                 raise ValueError(
                     f"block id {b} out of range (1..{self.num_blocks - 1})"
                 )
-            if b not in self._used or b in seen:
+            if b not in self._ref or b in seen:
                 raise ValueError(
                     f"double free of block {b} (not currently allocated)"
                 )
             seen.add(b)
         for b in blocks:
-            self._used.discard(b)
-            self._free.append(b)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                self._free.append(b)
 
     def defrag(self) -> None:
         """Sort the free list so future allocations are as contiguous as
@@ -163,6 +209,262 @@ class BlockAllocator:
         purely a locality/telemetry nicety — correctness never depends
         on it."""
         self._free.sort()
+
+
+# ---------------------------------------------------------------------------
+# Shared-prefix radix index (prefix KV reuse, docs/serving.md)
+#
+# At serving scale most prompts open with a shared system/few-shot
+# prefix whose KV is bit-identical across requests.  The index maps
+# BLOCK-ALIGNED token runs to the arena blocks that already hold their
+# KV: a radix trie whose edges are one full block's token run apiece
+# (SGLang's RadixAttention idea restated over this arena), plus
+# PARTIAL leaf runs (< block tokens — a prompt's unaligned tail) that a
+# new row can reuse via COPY-ON-WRITE when it diverges mid-block.  The
+# index holds ONE allocator reference per cached block; rows that match
+# take their own reference (`BlockAllocator.share`), so eviction — LRU,
+# leaf-first, under a block budget — only ever drops the index's
+# reference and can never reclaim a block a live row still reads.
+# ---------------------------------------------------------------------------
+
+
+class _PrefixNode:
+    """One cached block: ``tokens`` is the block's token run (len ==
+    block size for trie-edge nodes; shorter for partial leaves, which
+    never have children), ``block_id`` the arena block holding its KV."""
+
+    __slots__ = ("tokens", "block_id", "children", "parent", "last_used")
+
+    def __init__(self, tokens: tuple, block_id: int, parent) -> None:
+        self.tokens = tokens
+        self.block_id = int(block_id)
+        self.children: Dict[tuple, "_PrefixNode"] = {}
+        self.parent = parent
+        self.last_used = 0
+
+
+class PrefixIndex:
+    """Radix prefix index over one :class:`BlockAllocator`.
+
+    ``budget_blocks`` caps how many arena blocks the index may pin
+    (0 disables the index outright: lookups miss, publishes no-op).
+    All methods are host-side bookkeeping; the device-side block COPY a
+    COW match requires is the engine's job
+    (`core/continuous_batching.py`)."""
+
+    def __init__(self, allocator: BlockAllocator, block: int,
+                 budget_blocks: int = 0) -> None:
+        if budget_blocks < 0:
+            raise ValueError(
+                f"prefix budget must be >= 0 blocks, got {budget_blocks}"
+            )
+        self.allocator = allocator
+        self.block = int(block)
+        self.budget = int(budget_blocks)
+        self.root: Dict[tuple, _PrefixNode] = {}
+        # identity set (nodes hash by identity): membership + size only,
+        # never ordered iteration — LRU order lives in last_used
+        self._nodes: set = set()
+        self._tick = 0
+        # authoritative reuse counters (the engine mirrors them into the
+        # pfx_prefix_* registry names and the scheduler's decision log).
+        # hits/misses/hit_tokens move in record_lookup(), which the
+        # engine calls only AFTER the admission actually succeeded — a
+        # match() whose admission then fails allocation must not leave
+        # the stats ahead of the registry counters (the exact-replay
+        # contract)
+        self.stats: Dict[str, int] = {
+            "hits": 0, "misses": 0, "hit_tokens": 0, "evictions": 0,
+        }
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget > 0
+
+    def cached_blocks(self) -> int:
+        """Arena blocks the index currently pins (one per node)."""
+        return len(self._nodes)
+
+    def reclaimable_blocks(self) -> int:
+        """Cached blocks ONLY the index references — evicting the whole
+        index would return exactly these to the pool (blocks also shared
+        by live rows stay allocated until those rows release)."""
+        return sum(
+            1 for n in self._nodes
+            if self.allocator.refcount(n.block_id) == 1
+        )
+
+    def _bump(self, node: _PrefixNode) -> None:
+        self._tick += 1
+        node.last_used = self._tick
+
+    # -- lookup ---------------------------------------------------------
+    def match(self, tokens) -> Tuple[List[int], Optional[Tuple[int, int]], int]:
+        """Longest cached prefix of ``tokens``: returns
+        ``(shared_blocks, cow, matched)`` where ``shared_blocks`` are the
+        full-block ids to map into the new row's table (caller must
+        `share()` them before anything can evict), ``cow`` is an optional
+        ``(src_block_id, matched_tokens_in_block)`` pair for a mid-block
+        divergence — the caller copies ``src`` into a private block and
+        overwrites it from the divergence slot on — and ``matched`` is
+        the total matched token count.  Capped at ``len(tokens) - 1``:
+        at least one suffix token always recomputes, because admission
+        needs the last prompt token's logits.
+
+        Leaves the hit/miss stats UNTOUCHED — the caller invokes
+        :meth:`record_lookup` once the admission actually lands, so an
+        allocation failure between match and admit can never leave the
+        stats ahead of the registry counters (the exact-replay
+        contract)."""
+        tokens = [int(t) for t in tokens]
+        limit = len(tokens) - 1  # leave >= 1 token to recompute
+        children = self.root
+        shared: List[int] = []
+        m = 0
+        while m + self.block <= limit:
+            child = children.get(tuple(tokens[m:m + self.block]))
+            if child is None:
+                break
+            self._bump(child)
+            shared.append(child.block_id)
+            m += self.block
+            children = child.children
+        # mid-block divergence: the best partial overlap among this
+        # node's children (full edges AND partial leaves) is worth a COW
+        # copy — the row reuses `overlap` slots of prefix KV and
+        # overwrites its private copy from the divergence slot on
+        best_j, best_node = 0, None
+        for key, child in children.items():
+            j = 0
+            cap = min(len(key), limit - m)
+            while j < cap and key[j] == tokens[m + j]:
+                j += 1
+            if j > best_j:
+                best_j, best_node = j, child
+        cow = None
+        if best_j > 0:
+            self._bump(best_node)
+            cow = (best_node.block_id, best_j)
+            m += best_j
+        return shared, cow, m
+
+    def record_lookup(self, matched: int) -> None:
+        """Commit one admission's hit/miss accounting (called by the
+        engine AFTER the admission succeeded)."""
+        if matched:
+            self.stats["hits"] += 1
+            self.stats["hit_tokens"] += int(matched)
+        else:
+            self.stats["misses"] += 1
+
+    # -- publish --------------------------------------------------------
+    def publish(self, tokens, table) -> int:
+        """Insert a finished row's prompt prefix into the index:
+        ``table[i]`` holds the KV of tokens ``[i*block, (i+1)*block)``
+        (the row's first blocks — prompt layout is unpadded).  Full
+        blocks become trie edges; an unaligned tail becomes a partial
+        leaf.  Existing nodes are LRU-bumped, new ones take one
+        allocator reference each.  Returns newly cached block count;
+        evicts LRU leaves past ``budget_blocks`` afterwards."""
+        if not self.enabled:
+            return 0
+        tokens = [int(t) for t in tokens]
+        table = list(table)
+        children = self.root
+        parent: Optional[_PrefixNode] = None
+        added = 0
+        nfull = len(tokens) // self.block
+        for i in range(nfull):
+            run = tuple(tokens[i * self.block:(i + 1) * self.block])
+            node = children.get(run)
+            if node is None:
+                node = _PrefixNode(run, table[i], parent)
+                self.allocator.share([node.block_id])
+                children[run] = node
+                self._nodes.add(node)
+                added += 1
+            self._bump(node)
+            children = node.children
+            parent = node
+        tail = tuple(tokens[nfull * self.block:])
+        if tail and nfull < len(table):
+            node = children.get(tail)
+            if node is None:
+                node = _PrefixNode(tail, table[nfull], parent)
+                self.allocator.share([node.block_id])
+                children[tail] = node
+                self._nodes.add(node)
+                added += 1
+            self._bump(node)
+        self.evict_to_budget()
+        return added
+
+    # -- eviction -------------------------------------------------------
+    def _evict_node(self, node: _PrefixNode) -> None:
+        siblings = node.parent.children if node.parent else self.root
+        del siblings[node.tokens]
+        self._nodes.discard(node)
+        self.allocator.free([node.block_id])
+        self.stats["evictions"] += 1
+
+    def _evict_lru_leaves(self, done) -> int:
+        """LRU leaf-first bulk eviction until ``done()``.  One heap over
+        the current leaves + lazy re-push of parents that become leaves:
+        O(evicted · log n), never the O(n²) rescan a full-index pressure
+        eviction would otherwise cost inside the scheduler's admission
+        path.  Single-threaded with its callers, so last_used cannot
+        move mid-walk."""
+        import heapq
+
+        heap = [
+            (n.last_used, id(n), n) for n in self._nodes if not n.children
+        ]
+        heapq.heapify(heap)
+        count = 0
+        while heap and not done():
+            _, _, node = heapq.heappop(heap)
+            if node not in self._nodes or node.children:
+                continue  # stale entry
+            parent = node.parent
+            self._evict_node(node)
+            count += 1
+            if parent is not None and not parent.children \
+                    and parent in self._nodes:
+                heapq.heappush(
+                    heap, (parent.last_used, id(parent), parent)
+                )
+        return count
+
+    def evict_to_budget(self) -> int:
+        """LRU leaf-first eviction down to ``budget_blocks``."""
+        return self._evict_lru_leaves(
+            lambda: len(self._nodes) <= self.budget
+        )
+
+    def evict_for(self, need_free: int) -> int:
+        """Drop LRU cached prefixes until the allocator has
+        ``need_free`` free blocks (or the index is empty) — the
+        admission path calls this BEFORE failing an allocation, so
+        unreferenced cached prefixes never starve live traffic.  Blocks
+        a live row still shares only lose the index's reference (they
+        free later, when the row releases)."""
+        return self._evict_lru_leaves(
+            lambda: self.allocator.free_count() >= need_free
+        )
+
+    def clear(self) -> int:
+        """Drop EVERY cached prefix (ArenaReset: a rebuilt arena's pools
+        never hold the old blocks' KV, so donation-invalidated blocks
+        must never resurface as cache hits).  Not counted as evictions —
+        nothing was displaced by traffic.  Free order does not matter
+        (each node holds exactly one reference), so this is a single
+        O(n) sweep, not the leaf-first eviction walk."""
+        n = len(self._nodes)
+        for node in self._nodes:
+            self.allocator.free([node.block_id])
+        self._nodes = set()
+        self.root = {}
+        return n
 
 
 # ---------------------------------------------------------------------------
@@ -289,21 +591,68 @@ class PagedCacheManager:
     admission: growth never fails mid-decode, the table is static for the
     row's lifetime, and the scheduler's compile-shape bucket (table
     width) only changes at admit/evict boundaries.
+
+    ``prefix_blocks`` > 0 enables the shared-prefix radix index
+    (:class:`PrefixIndex`): admission can map already-cached prefix
+    blocks into a new row's table as SHARED (refcounted) entries, and an
+    allocation that would otherwise fail first evicts unreferenced
+    cached prefixes.
     """
 
-    def __init__(self, num_blocks: int, block: int = 0) -> None:
+    def __init__(self, num_blocks: int, block: int = 0,
+                 prefix_blocks: int = 0) -> None:
         self.block = kv_block_size(block)
         self.allocator = BlockAllocator(num_blocks)
+        self.prefix = PrefixIndex(self.allocator, self.block, prefix_blocks)
         self._tables: Dict[int, List[int]] = {}
 
-    def can_admit(self, tokens: int) -> bool:
-        return blocks_for(tokens, self.block) <= self.allocator.free_count()
+    def available_blocks(self) -> int:
+        """Blocks an admission can actually obtain: free now, plus
+        cached-prefix blocks nothing but the index references (those
+        evict on demand).  O(cached nodes) — callers on the per-
+        iteration hot path should try :meth:`can_admit`'s free-count
+        short-circuit first."""
+        return self.allocator.free_count() + self.prefix.reclaimable_blocks()
 
-    def admit(self, seq_id: int, tokens: int) -> List[int]:
-        """Allocate ``ceil(tokens / block)`` blocks for a new sequence."""
+    def can_admit(self, tokens: int) -> bool:
+        need = blocks_for(tokens, self.block)
+        if need <= self.allocator.free_count():
+            return True  # skip the O(cached-nodes) reclaimable scan
+        return need <= self.available_blocks()
+
+    def admit(self, seq_id: int, tokens: int,
+              shared: Optional[List[int]] = None) -> List[int]:
+        """Allocate ``ceil(tokens / block)`` blocks for a new sequence.
+
+        ``shared`` (prefix-hit admission) lists already-cached blocks to
+        map as the row's FIRST table entries: the row takes one
+        reference on each (so a later index eviction cannot reclaim
+        them) and only the remainder is freshly allocated.  If the free
+        pool cannot cover the remainder, unreferenced cached prefixes
+        are evicted first; :class:`BlockPoolExhausted` only raises once
+        the index has nothing left to give — and then atomically (the
+        shared references are returned)."""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id} already admitted")
-        table = self.allocator.alloc(blocks_for(tokens, self.block))
+        shared = list(shared or [])
+        need = blocks_for(tokens, self.block) - len(shared)
+        if need < 0:
+            raise ValueError(
+                f"{len(shared)} shared blocks exceed the "
+                f"{blocks_for(tokens, self.block)}-block capacity"
+            )
+        # reference the shared blocks FIRST: the evict-for-room pass
+        # below may drop these very nodes from the index, and the row's
+        # reference is what keeps their KV alive through that
+        self.allocator.share(shared)
+        if need > self.allocator.free_count():
+            self.prefix.evict_for(need)
+        try:
+            fresh = self.allocator.alloc(need) if need else []
+        except BlockPoolExhausted:
+            self.allocator.free(shared)
+            raise
+        table = shared + fresh
         self._tables[seq_id] = table
         return list(table)
 
@@ -333,10 +682,14 @@ class PagedCacheManager:
         return len(self._tables)
 
     def stats(self) -> Dict[str, float]:
+        # kv_blocks_used counts PHYSICAL blocks (allocator refcounts
+        # dedupe sharing): occupancy can never exceed the arena no
+        # matter how many rows share a prefix
         return {
             "kv_blocks_used": self.allocator.used_count(),
             "kv_blocks_free": self.allocator.free_count(),
             "kv_block_size": self.block,
             "live_sequences": len(self._tables),
             "fragmentation": round(self.allocator.fragmentation(), 4),
+            "prefix_cached_blocks": self.prefix.cached_blocks(),
         }

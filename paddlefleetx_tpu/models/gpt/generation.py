@@ -903,6 +903,7 @@ def paged_forward_step(
     active: jax.Array,
     cfg: GPTConfig,
     ctx: Optional[ShardingCtx] = None,
+    n_valid: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, PagedPools]:
     """tokens [B] or [B, t] at per-row slots positions..positions+t-1 ->
     (logits [B, t, v] f32, pools).  t = 1 is the plain decode step;
@@ -911,7 +912,12 @@ def paged_forward_step(
     and their logits are garbage the caller ignores.  Chunk slots past a
     row's block-table allocation gather the NULL padding entry, so a
     near-budget verify overrun can never alias another row's blocks
-    (the engine also reserves draft_k slack — belt and braces)."""
+    (the engine also reserves draft_k slack — belt and braces).
+
+    ``n_valid`` [B] (chunked prefill) null-routes each row's chunk slots
+    >= its real token count: a padded tail chunk's junk positions can
+    wrap onto REAL slots of the row's last allocated block after the
+    table-width clamp, so pad K/V must never be written anywhere."""
     if tokens.ndim == 1:
         tokens = tokens[:, None]
     B, t = tokens.shape
@@ -932,6 +938,11 @@ def paged_forward_step(
     blk_log = jnp.clip(pos_t // bs, 0, block_tables.shape[1] - 1)
     blk = jnp.take_along_axis(block_tables, blk_log, axis=1)  # [B, t]
     blk = jnp.where(active[:, None], blk, 0)  # inactive rows -> null block
+    if n_valid is not None:  # pad chunk slots -> null block
+        blk = jnp.where(
+            jnp.arange(t, dtype=jnp.int32)[None, :] < n_valid[:, None],
+            blk, 0,
+        )
     off = pos_t % bs
 
     quant = pools.k_scale is not None
@@ -1032,6 +1043,58 @@ def paged_prefill(
     k_pool = pools.k.at[:, table_row].set(pack(cache.k).astype(pools.k.dtype))
     v_pool = pools.v.at[:, table_row].set(pack(cache.v).astype(pools.v.dtype))
     return PagedPools(k_pool, v_pool), last, counts
+
+
+def paged_chunk_prefill(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    pools: PagedPools,
+    table_row: jax.Array,
+    position: jax.Array,
+    n_valid: jax.Array,
+    last_idx: jax.Array,
+    cfg: GPTConfig,
+    ctx: Optional[ShardingCtx] = None,
+) -> Tuple[PagedPools, jax.Array]:
+    """Prefill ONE row's next chunk of prompt tokens directly against the
+    paged arena: ``tokens`` [1, t] land at slots position..position+t-1
+    of the row's ``table_row`` blocks, attending over everything already
+    in them — which is exactly what makes this the prefix-reuse and
+    chunked-prefill spelling (docs/serving.md): the already-cached
+    prefix (shared blocks) and earlier chunks are simply THERE, so only
+    the unmatched suffix ever runs through the model.  Rides
+    :func:`paged_forward_step`'s multi-token path (the speculative
+    verify chunk machinery), so a chunk admission compiles into the same
+    bounded (t, table-width) family as decode steps — no monolithic
+    full-prompt prefill compile for a prompt that is mostly cached.
+
+    Pad slots past the real chunk (``tokens[0, j]`` for j >= ``n_valid``)
+    NULL-ROUTE their K/V writes outright: a near-capacity tail chunk's
+    pad positions can alias real slots of the row's last block modulo
+    the block size, so unlike `paged_prefill`'s bucket junk they must
+    never land in the row's blocks at all.  Returns (pools, logits of
+    chunk slot ``last_idx`` [v] f32 — the last REAL prompt token's
+    logits on the final chunk)."""
+    logits, pools = paged_forward_step(
+        params, tokens, pools, table_row[None, :], position[None],
+        jnp.ones((1,), bool), cfg, ctx, n_valid=n_valid[None],
+    )
+    last = jax.lax.dynamic_index_in_dim(
+        logits[0], last_idx, axis=0, keepdims=False
+    ).astype(jnp.float32)
+    return pools, last
+
+
+def prefix_token_counts(prompt_ids, vocab_size: int) -> "np.ndarray":
+    """Host-side repetition-penalty seed counts for a prompt — the exact
+    integer bincount `paged_prefill` computes in-graph, computed on host
+    for admissions that skip the monolithic prefill (prefix hits /
+    chunked prompts)."""
+    import numpy as np
+
+    return np.bincount(
+        np.asarray(list(prompt_ids), np.int64), minlength=int(vocab_size)
+    ).astype(np.int32)
 
 
 def gather_kv_blocks(pools: PagedPools, table) -> Dict[str, "np.ndarray"]:

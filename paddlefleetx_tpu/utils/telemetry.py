@@ -112,6 +112,14 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_spec_accepted_total": ("counter", "Draft tokens accepted and committed by the verify step"),
     "pfx_spec_accept_rate": ("gauge", "Lifetime accepted/proposed draft ratio"),
     "pfx_kv_bytes": ("gauge", "Live KV-cache payload bytes (used blocks x K+V bytes per block)"),
+    # shared-prefix KV reuse + chunked prefill (core/paged_cache.py
+    # PrefixIndex, core/continuous_batching.py)
+    "pfx_prefix_hits_total": ("counter", "Admissions that reused cached prefix blocks"),
+    "pfx_prefix_misses_total": ("counter", "Admissions that found no cached prefix (cache enabled)"),
+    "pfx_prefix_hit_tokens_total": ("counter", "Prompt tokens whose KV was reused instead of recomputed"),
+    "pfx_prefix_evictions_total": ("counter", "Cached prefix blocks evicted (LRU budget or allocation pressure)"),
+    "pfx_prefix_cached_blocks": ("gauge", "Arena blocks currently pinned by the prefix index"),
+    "pfx_prefill_chunks_total": ("counter", "Chunked-prefill dispatches (one prompt chunk per scheduler iteration)"),
 
     "pfx_http_requests_in_flight": ("gauge", "In-flight /generate requests"),
     "pfx_http_responses_total": ("counter", "HTTP responses by status code"),

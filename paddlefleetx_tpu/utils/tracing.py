@@ -355,8 +355,9 @@ def replay_decision_log(rows) -> Dict[str, int]:
     """Fold ContinuousScheduler decision-log rows back into the counters
     they must reproduce.  The agreement contract (tested): on a run whose
     log was not truncated, ``prefill_admits`` == pfx_prefill_admits_total,
-    ``evictions`` == pfx_request_evictions_total, and ``spec_accepted`` ==
-    pfx_spec_accepted_total — a trace event silently dropped by the
+    ``evictions`` == pfx_request_evictions_total, ``spec_accepted`` ==
+    pfx_spec_accepted_total, and ``prefix_hits`` ==
+    pfx_prefix_hits_total — a trace event silently dropped by the
     scheduler shows up here as a mismatch."""
     out = {
         "iterations": 0,
@@ -366,6 +367,10 @@ def replay_decision_log(rows) -> Dict[str, int]:
         "finished": 0,
         "spec_proposed": 0,
         "spec_accepted": 0,
+        "prefix_hits": 0,
+        "prefix_hit_tokens": 0,
+        "prefix_evictions": 0,
+        "chunks": 0,
     }
     for row in rows:
         out["iterations"] += 1
@@ -375,4 +380,8 @@ def replay_decision_log(rows) -> Dict[str, int]:
         out["finished"] += int(row.get("finished", 0))
         out["spec_proposed"] += int(row.get("spec_proposed", 0))
         out["spec_accepted"] += int(row.get("spec_accepted", 0))
+        out["prefix_hits"] += int(row.get("prefix_hits", 0))
+        out["prefix_hit_tokens"] += int(row.get("prefix_hit_tokens", 0))
+        out["prefix_evictions"] += int(row.get("prefix_evictions", 0))
+        out["chunks"] += int(row.get("chunks", 0))
     return out

@@ -185,6 +185,27 @@ def test_bench_decode_happy_path_contract(tmp_path):
     # slack for a mixed width during drain)
     assert cont["jit_traces"] <= 3, cont
 
+    # prefix-cache A/B pair: same prefix-heavy staggered trace through
+    # identical continuous engines, cache ON vs OFF.  The contract pins
+    # the reuse evidence — admissions HIT, the cached side computed
+    # STRICTLY fewer prompt tokens, and at the f32 smoke dtype the two
+    # sides' greedy outputs are token-identical (divergence counted,
+    # must be zero).  The TTFT-p99 WIN is chip evidence (CPU tiny shapes
+    # are dispatch-dominated), read off the same keys on a chip row.
+    pc = rows["gpt345m_decode_prefix_cached"]
+    pn = rows["gpt345m_decode_prefix_nocache"]
+    for row in (pc, pn):
+        assert {"p50_ttft_s", "p99_ttft_s", "prefill_tokens", "hit_rate",
+                "shared_prefix_len", "arrivals"} <= set(row), row
+        assert row["p99_ttft_s"] >= row["p50_ttft_s"] > 0, row
+    assert pc["arrivals"] == pn["arrivals"]
+    assert pc["mean_gap_s"] == pn["mean_gap_s"]  # identical trace
+    assert pc["hit_rate"] > 0, pc
+    assert pc["prefix_hit_tokens"] > 0, pc
+    assert pn["hit_rate"] == 0 and pn["prefix_hits"] == 0, pn
+    assert pc["prefill_tokens"] < pn["prefill_tokens"], (pc, pn)
+    assert pc["greedy_divergent_rows"] == 0, pc
+
 
 @pytest.mark.slow
 def test_bench_decode_deadline_emits_honest_zero(tmp_path):

@@ -63,10 +63,12 @@ def _engine(server, **kw):
     return PagedDecodeEngine(server, **kw)
 
 
-def _drain(engine, max_steps=64):
+def _drain(engine, max_steps=96):
     for _ in range(max_steps):
         engine.step()
-        if not engine.active.any():
+        if not engine.active.any() and all(
+            r is None or r.prefill_done for r in engine.slots
+        ):
             return
     raise AssertionError("engine never drained")
 
@@ -330,6 +332,253 @@ def test_arena_reset_fails_live_rows_and_recovers(server, sequential, monkeypatc
     again = sched.submit([PROMPTS[1]], 6, deadline_s=120)
     assert again.result(timeout=300)[0] == sequential[1]
     assert sched.shutdown(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# shared-prefix KV reuse + chunked prefill (docs/serving.md "Prefix
+# cache"): greedy output with the cache ON must stay token-identical
+# (f32 exact) to the cache-off sequential path for prefix hits, COW
+# divergence, and chunked long-prompt admission mid-decode — while
+# prefill-token accounting proves only the unmatched suffix computed.
+# ---------------------------------------------------------------------------
+
+import numpy as _np
+
+_prng = _np.random.default_rng(7)
+PFX_SHARED = _prng.integers(1, 95, 36).tolist()          # 2 full blocks + 4
+LONG_A = PFX_SHARED + _prng.integers(1, 95, 4).tolist()  # 40 tokens
+LONG_B = PFX_SHARED + _prng.integers(1, 95, 6).tolist()  # 42, diverges at 36
+LONG_C = _prng.integers(1, 95, 64).tolist()              # unrelated, 4 chunks
+
+
+def _ref(server, prompt):
+    return server.generate_ids([prompt], max_dec_len=6)[0]
+
+
+def test_prefix_hit_prefills_only_the_suffix_and_parity(server):
+    """THE reuse acceptance: request B shares A's 36-token prefix (two
+    full blocks + 4 tokens into A's partial tail block).  After A
+    publishes, B's admission maps the full blocks SHARED, takes a COW
+    copy of the partial, and computes exactly plen-36 suffix tokens —
+    token-identical to the cache-off path."""
+    eng = _engine(server, prefix_cache_blocks=32)
+    sA = eng.admit(LONG_A, 6)
+    _drain(eng)
+    assert eng.slots[sA].tokens == _ref(server, LONG_A)
+    eng.release(sA)  # publishes 2 full blocks + 1 partial tail
+    assert eng.cache.prefix.cached_blocks() == 3
+    assert eng.cache.stats()["kv_blocks_used"] == 3  # index refs only
+
+    tok0 = eng.stats["prefill_tokens"]
+    sB = eng.admit(LONG_B, 6)
+    assert eng.slots[sB].prefix_hit == 36
+    assert eng.stats["prefill_tokens"] - tok0 == len(LONG_B) - 36
+    _drain(eng)
+    assert eng.slots[sB].tokens == _ref(server, LONG_B)
+    eng.release(sB)
+    assert eng.cache.prefix.stats["hits"] == 1
+    assert eng.cache.prefix.stats["hit_tokens"] == 36
+
+    # a repeat of A itself: full-prompt hit capped at plen-1 (the last
+    # token always recomputes — admission needs its logits)
+    tok0 = eng.stats["prefill_tokens"]
+    sA2 = eng.admit(LONG_A, 6)
+    assert eng.slots[sA2].prefix_hit == len(LONG_A) - 1
+    assert eng.stats["prefill_tokens"] - tok0 == 1
+    _drain(eng)
+    assert eng.slots[sA2].tokens == _ref(server, LONG_A)
+    eng.release(sA2)
+
+
+def test_cow_divergence_never_corrupts_the_cached_prefix(server):
+    """Copy-on-write both ways — inside a partially-filled tail block
+    (LONG_B at token 36) and inside a FULL cached block (divergence at
+    token 20) — and the cached original stays intact: A re-requested
+    AFTER both divergent rows decoded is still token-identical."""
+    eng = _engine(server, prefix_cache_blocks=32)
+    sA = eng.admit(LONG_A, 6)
+    _drain(eng)
+    eng.release(sA)
+
+    s1 = eng.admit(LONG_B, 6)  # diverges inside the partial tail
+    # guaranteed divergence at token 20, inside full block 1
+    div = [(t % 93) + 1 for t in LONG_A[20:26]]
+    mid = LONG_A[:20] + div
+    s2 = eng.admit(mid, 6)
+    assert eng.slots[s1].prefix_hit == 36
+    assert eng.slots[s2].prefix_hit == 20  # block 0 shared + 4-token COW
+    _drain(eng)
+    assert eng.slots[s1].tokens == _ref(server, LONG_B)
+    assert eng.slots[s2].tokens == _ref(server, mid)
+    eng.release(s1)
+    eng.release(s2)
+
+    sA2 = eng.admit(LONG_A, 6)  # the cached blocks must be unmodified
+    _drain(eng)
+    assert eng.slots[sA2].tokens == _ref(server, LONG_A)
+    eng.release(sA2)
+
+
+def test_shared_block_accounting_counts_physical_once(server):
+    """Two live rows sharing one cached prefix: pfx_kv_blocks_used /
+    pfx_kv_bytes count each physical block ONCE (a per-row summation
+    would overstate occupancy and trip the controller's occupancy>0.9
+    scale-up spuriously), and no gauge can exceed the arena."""
+    eng = _engine(server, prefix_cache_blocks=32)
+    sA = eng.admit(LONG_A, 6)
+    _drain(eng)
+    eng.release(sA)  # 3 cached blocks
+
+    s1 = eng.admit(LONG_A, 6)  # shares 2 full + COW of the tail
+    s2 = eng.admit(LONG_A, 6)
+    per_row = len(eng.slots[s1].table)
+    naive = eng.cache.prefix.cached_blocks() + 2 * per_row
+    used = eng.cache.stats()["kv_blocks_used"]
+    # physical: 3 cached + one fresh COW block per row
+    assert used == 5 < naive
+    usable = eng.cache.allocator.num_blocks - 1
+    assert used + eng.cache.stats()["kv_blocks_free"] == usable
+    assert eng.cache.stats()["prefix_cached_blocks"] == 3
+    _drain(eng)
+    assert eng.slots[s1].tokens == _ref(server, LONG_A)
+    assert eng.slots[s2].tokens == _ref(server, LONG_A)
+    eng.release(s1)
+    eng.release(s2)
+    assert eng.cache.stats()["kv_blocks_used"] == \
+        eng.cache.prefix.cached_blocks()
+
+
+def test_chunked_prefill_interleaves_with_decode_and_parity(server):
+    """A 64-token prompt admitted with --prefill-chunk 16 streams in one
+    chunk per step while an already-active row keeps decoding: the
+    decode row's output is untouched, the chunked prompt's output is
+    token-identical, and exactly ceil(64/16) chunks ran."""
+    eng = _engine(server, prefill_chunk=16)
+    s0 = eng.admit(PROMPTS[0], 6)  # short row, starts decoding at once
+    eng.step()
+    pos_before = int(eng.positions[s0])
+    c0 = eng.stats["prefill_chunks"]
+    sC = eng.admit(LONG_C, 6)  # long prompt: mid-prefill on return
+    assert not eng.slots[sC].prefill_done
+    assert not eng.active[sC]
+    eng.step()  # one chunk for C AND one decode step for row 0
+    assert int(eng.positions[s0]) == pos_before + 1  # decode never stalled
+    _drain(eng)
+    assert eng.stats["prefill_chunks"] - c0 == 4
+    assert eng.slots[s0].tokens == _ref(server, PROMPTS[0])
+    assert eng.slots[sC].tokens == _ref(server, LONG_C)
+    eng.release(s0)
+    eng.release(sC)
+
+
+@pytest.mark.slow  # composition coverage: the prefix CLI drill boots
+# --prefix-cache-blocks + --prefill-chunk together and asserts hit +
+# chunk counters with token-identical output, and the hit-side
+# suffix-only accounting stays tier-1 via
+# test_prefix_hit_prefills_only_the_suffix_and_parity; this variant's
+# fresh 64/72-token buckets are the costly part — runs in
+# make test-prefix / test-paged / test-all
+def test_chunked_prefill_with_prefix_hit_computes_suffix_chunks_only(server):
+    """Prefix cache + chunked prefill composed: a prompt extending a
+    cached one chunk-prefills ONLY the unmatched suffix."""
+    eng = _engine(server, prefix_cache_blocks=32, prefill_chunk=16)
+    sC = eng.admit(LONG_C, 6)
+    _drain(eng)
+    assert eng.slots[sC].tokens == _ref(server, LONG_C)
+    eng.release(sC)  # publishes 4 full blocks
+    assert eng.cache.prefix.cached_blocks() == 4
+
+    ext = LONG_C + _prng.integers(1, 95, 8).tolist()  # 72 tokens, hit 64
+    tok0 = eng.stats["prefill_tokens"]
+    sE = eng.admit(ext, 6)
+    assert eng.slots[sE].prefix_hit == 64
+    _drain(eng)
+    assert eng.stats["prefill_tokens"] - tok0 == len(ext) - 64
+    assert eng.slots[sE].tokens == _ref(server, ext)
+    eng.release(sE)
+
+
+def test_arena_reset_rebuilds_prefix_index_empty(server):
+    """ArenaReset invariant: rebuilt pools hold none of the old KV, so
+    donation-invalidated blocks must never resurface as cache hits —
+    the index comes back EMPTY and the next identical request is an
+    honest miss that still decodes token-identically."""
+    eng = _engine(server, prefix_cache_blocks=32)
+    sA = eng.admit(LONG_A, 6)
+    _drain(eng)
+    eng.release(sA)
+    assert eng.cache.prefix.cached_blocks() == 3
+    dead = eng.reset()
+    assert dead == []
+    assert eng.cache.prefix.cached_blocks() == 0
+    assert eng.cache.stats()["kv_blocks_used"] == 0
+    m0 = eng.cache.prefix.stats["misses"]
+    sA2 = eng.admit(LONG_A, 6)
+    assert eng.slots[sA2].prefix_hit == 0
+    assert eng.cache.prefix.stats["misses"] == m0 + 1
+    _drain(eng)
+    assert eng.slots[sA2].tokens == _ref(server, LONG_A)
+    eng.release(sA2)
+
+
+@pytest.mark.slow  # the tiny 8-block arena keys fresh pool-shape
+# compiles; the eviction-never-reclaims-a-live-block contract stays
+# tier-1 via the host units (test_prefix_cache.py: refcounted evict_for
+# + manager evict-on-demand + atomic exhaustion) — this device-parity
+# variant runs in make test-prefix / test-paged / test-all
+def test_allocation_pressure_evicts_cache_but_never_live_blocks(server):
+    """With the pool nearly full of cached prefixes, a new admission
+    evicts unreferenced cached blocks instead of failing — and blocks a
+    live row still shares survive the eviction (its decode stays
+    token-identical)."""
+    # 7 usable blocks: A caches 3, B shares 2 of them + 1 fresh
+    eng = _engine(server, num_blocks=8, prefix_cache_blocks=8)
+    sA = eng.admit(LONG_A, 6)
+    _drain(eng)
+    eng.release(sA)
+    sB = eng.admit(LONG_A, 6)  # holds refs on the 2 shared blocks
+    eng.step()
+    # C needs 4 blocks; free = 7 - 3(cached) - 1(B fresh) = 3 -> must evict
+    big = _prng.integers(1, 95, 52).tolist()
+    ev0 = eng.cache.prefix.stats["evictions"]
+    sC = eng.admit(big, 6)
+    assert eng.cache.prefix.stats["evictions"] > ev0
+    usable = eng.cache.allocator.num_blocks - 1
+    assert eng.cache.stats()["kv_blocks_used"] <= usable
+    _drain(eng)
+    assert eng.slots[sB].tokens == _ref(server, LONG_A)  # survived eviction
+    assert eng.slots[sC].tokens == _ref(server, big)
+    eng.release(sB)
+    eng.release(sC)
+
+
+def test_scheduler_prefix_replay_contract_and_counters(server):
+    """The decision-log replay contract, prefix edition: an untruncated
+    log reproduces pfx_prefix_hits_total exactly alongside the PR 8
+    trio, and the registry counter matches the per-instance stats."""
+    from paddlefleetx_tpu.core.continuous_batching import ContinuousScheduler
+    from paddlefleetx_tpu.utils.telemetry import get_registry
+    from paddlefleetx_tpu.utils.tracing import replay_decision_log
+
+    reg = get_registry()
+    h0 = reg.value("pfx_prefix_hits_total") or 0
+    eng = _engine(server, prefix_cache_blocks=32)
+    sched = ContinuousScheduler(eng, max_depth=8)
+    sched.start()
+    assert sched.submit([LONG_A], 6, deadline_s=120).result(timeout=300)[0] \
+        == _ref(server, LONG_A)
+    assert sched.submit([LONG_B], 6, deadline_s=120).result(timeout=300)[0] \
+        == _ref(server, LONG_B)
+    assert sched.shutdown(timeout=30)
+
+    replay = replay_decision_log(sched.decision_log)
+    assert replay["prefix_hits"] == eng.cache.prefix.stats["hits"] == 1
+    assert replay["prefix_hit_tokens"] == \
+        eng.cache.prefix.stats["hit_tokens"] == 36
+    assert (reg.value("pfx_prefix_hits_total") or 0) - h0 == 1
+    assert replay["prefill_admits"] == sched.stats["prefill_admits"] == 2
+    # chunk rows: LONG_B's suffix rode the chunk family (one dispatch)
+    assert replay["chunks"] == eng.stats["prefill_chunks"] >= 1
 
 
 @pytest.mark.slow  # two fresh sampling-path compiles; tier-1 keeps the

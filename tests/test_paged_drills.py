@@ -246,6 +246,72 @@ def test_continuous_mid_decode_eviction_frees_blocks_token_identical(tmp_path):
     assert "Traceback" not in log, log[-3000:]
 
 
+@pytest.mark.slow  # a second full server boot; the prefix replay/parity
+# contracts stay tier-1 in-process via test_continuous_batching.py
+# (test_scheduler_prefix_replay_contract_and_counters + the parity
+# suite) — this CLI spelling runs in make test-prefix / test-paged /
+# test-all
+def test_prefix_cache_and_chunked_prefill_through_real_cli(tmp_path):
+    """Shared-prefix reuse drill through the real serve.py: with
+    ``--prefix-cache-blocks`` + ``--prefill-chunk`` on, a repeated
+    prompt's second admission HITS the index (counters prove it), its
+    greedy output is token-identical to the first (miss/chunked) pass,
+    physical-block gauges stay deduped, the decision log replays
+    pfx_prefix_hits_total exactly, and SIGTERM drain still exits 0."""
+    proc, port = _start_server(
+        tmp_path, deadline=60.0,
+        extra_args=("--prefix-cache-blocks", "32", "--prefill-chunk", "16"),
+    )
+    try:
+        prompt = [((7 * i) % 89) + 1 for i in range(20)]  # 1 full block + 4
+        body = {"prompt_ids": prompt, "max_tokens": 8, "deadline_s": 60}
+        code1, r1 = _post(port, body, timeout=90)
+        assert code1 == 200, (code1, r1)
+        code2, r2 = _post(port, body, timeout=90)
+        assert code2 == 200, (code2, r2)
+        # THE parity contract through the CLI: the prefix-hit admission
+        # (shared blocks + COW + suffix-only compute) produced exactly
+        # the tokens the cold path produced
+        assert r2["completion_ids"] == r1["completion_ids"]
+
+        m = _metrics(port)
+        assert m["pfx_prefix_hits_total"] >= 1, m
+        assert m["pfx_prefix_hit_tokens_total"] >= 16, m
+        assert m["pfx_prefix_misses_total"] >= 1, m
+        assert m["pfx_prefill_chunks_total"] >= 1, m  # chunked admission ran
+        # rows retired: only the published prefix blocks stay resident,
+        # and the physical accounting closes against the arena
+        assert m["pfx_prefix_cached_blocks"] >= 1, m
+        assert m["pfx_kv_blocks_used"] == m["pfx_prefix_cached_blocks"], m
+        assert m["pfx_batch_occupancy"] == 0, m
+
+        def _get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                assert r.status == 200, path
+                return json.load(r)
+
+        from paddlefleetx_tpu.utils.tracing import replay_decision_log
+
+        dbg = _get("/debug/state")
+        assert dbg["prefix_cache"]["enabled"] is True
+        assert dbg["prefix_cache"]["hits"] == m["pfx_prefix_hits_total"]
+        replay = replay_decision_log(dbg["decisions"])
+        # the exact-replay contract, prefix edition (alongside the PR 8
+        # trio, re-checked here on the same log)
+        assert replay["prefix_hits"] == m["pfx_prefix_hits_total"], (replay, m)
+        assert replay["chunks"] == m["pfx_prefill_chunks_total"], (replay, m)
+        assert replay["prefill_admits"] == m["pfx_prefill_admits_total"]
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        assert rc == 0, rc
+    finally:
+        log = _finish(proc)
+    assert "Traceback" not in log, log[-3000:]
+
+
 @pytest.mark.slow  # a second full server boot; the mid-decode-eviction
 # drill above is the ISSUE acceptance drill and stays in tier-1, this
 # staggered-traffic variant runs in make test-paged / test-all

@@ -1,0 +1,265 @@
+"""Shared-prefix KV reuse units (`core/paged_cache.py`): block
+refcounting on the allocator, the radix prefix index (block-aligned
+trie + partial leaves + copy-on-write matches), LRU/leaf-first
+eviction under a block budget, and the manager's shared admission with
+evict-on-demand.  Pure host bookkeeping — no jax — so the whole file
+rides the fast gate; the device-side parity suite lives in
+tests/test_continuous_batching.py and `make test-prefix` runs both."""
+
+import pytest
+
+from paddlefleetx_tpu.core.paged_cache import (
+    BlockAllocator,
+    BlockPoolExhausted,
+    PagedCacheManager,
+    PrefixIndex,
+)
+
+# ---------------------------------------------------------------------------
+# allocator refcounts
+# ---------------------------------------------------------------------------
+
+
+def test_share_then_free_ordering_keeps_block_alive():
+    a = BlockAllocator(6)
+    (b,) = a.alloc(1)
+    a.share([b])  # second owner (e.g. the prefix index)
+    assert a.refcount(b) == 2
+    a.free([b])  # first owner releases: block must STAY allocated
+    assert a.refcount(b) == 1
+    assert a.used_count() == 1
+    # the block cannot be handed out while referenced
+    assert b not in a.alloc(4)
+    a.free([b])  # last reference: NOW it reclaims
+    assert a.refcount(b) == 0
+    assert b in a.alloc(1) + a._free
+
+
+def test_overfree_past_refcount_is_loud():
+    a = BlockAllocator(4)
+    (b,) = a.alloc(1)
+    a.share([b])
+    a.free([b])
+    a.free([b])
+    with pytest.raises(ValueError, match="double free"):
+        a.free([b])
+
+
+def test_share_free_or_bad_block_is_loud_and_atomic():
+    a = BlockAllocator(6)
+    got = a.alloc(2)
+    with pytest.raises(ValueError, match="cannot share free block"):
+        a.share([got[0], 4])  # 4 was never allocated
+    # atomic: the valid id took no reference either
+    assert a.refcount(got[0]) == 1
+    with pytest.raises(ValueError, match="null block"):
+        a.share([0])
+    with pytest.raises(ValueError, match="out of range"):
+        a.share([99])
+    with pytest.raises(ValueError, match="out of range"):
+        a.refcount(99)
+
+
+def test_used_count_is_physical_not_reference_weighted():
+    """The shared-block accounting contract: occupancy/byte gauges count
+    a physical block ONCE no matter how many tables share it — a naive
+    per-row summation would overstate arena occupancy and trip the
+    controller's occupancy-driven scale-up spuriously."""
+    a = BlockAllocator(8)
+    got = a.alloc(3)
+    for _ in range(4):  # 4 more rows share the same 3 blocks
+        a.share(got)
+    assert a.refcount(got[0]) == 5
+    assert a.used_count() == 3  # physical, not 15
+    assert a.used_count() + a.free_count() == 7  # never exceeds the arena
+
+
+# ---------------------------------------------------------------------------
+# radix prefix index
+# ---------------------------------------------------------------------------
+
+BS = 8  # small block for readable token math
+
+
+def _index(num_blocks=32, budget=16):
+    a = BlockAllocator(num_blocks)
+    return a, PrefixIndex(a, BS, budget)
+
+
+def _seq(n, start=0):
+    return list(range(start, start + n))
+
+
+def test_publish_and_full_block_match():
+    a, idx = _index()
+    table = a.alloc(3)
+    prompt = _seq(20)  # 2 full blocks + 4-token tail
+    assert idx.publish(prompt, table) == 3
+    assert idx.cached_blocks() == 3
+    # index holds one ref on each published block, the row still holds its own
+    assert all(a.refcount(b) == 2 for b in table)
+    a.free(table)  # row finishes: blocks survive via the index refs
+    assert a.used_count() == 3
+
+    shared, cow, m = idx.match(prompt + [99, 98])
+    assert shared == table[:2] and m == 20
+    assert cow == (table[2], 4)  # partial tail reused via COW
+    # match() is pure; the caller commits the accounting once the
+    # admission lands (a failed allocation must not desync the stats)
+    assert idx.stats["hits"] == 0
+    idx.record_lookup(m)
+    assert idx.stats["hits"] == 1 and idx.stats["hit_tokens"] == 20
+
+
+def test_match_always_leaves_one_suffix_token():
+    """A full-prompt match must cap at len-1: admission needs the last
+    prompt token's logits, so at least one token always recomputes."""
+    a, idx = _index()
+    table = a.alloc(2)
+    prompt = _seq(16)  # exactly 2 full blocks
+    idx.publish(prompt, table)
+    shared, cow, m = idx.match(prompt)
+    assert m == 15  # not 16
+    assert shared == table[:1]
+    assert cow == (table[1], 7)
+
+
+def test_cow_divergence_inside_full_block():
+    a, idx = _index()
+    table = a.alloc(2)
+    prompt = _seq(16)
+    idx.publish(prompt, table)
+    # diverges at token 11: block 0 matches whole, block 1 matches 3 tokens
+    other = _seq(11) + [77, 78, 79, 80, 81, 82]
+    shared, cow, m = idx.match(other)
+    assert shared == table[:1]
+    assert cow == (table[1], 3)
+    assert m == 11
+
+
+def test_divergence_inside_first_block_is_cow_only():
+    a, idx = _index()
+    table = a.alloc(1)
+    idx.publish(_seq(8), table)
+    shared, cow, m = idx.match([0, 1, 2, 99, 98, 97])
+    assert shared == [] and cow == (table[0], 3) and m == 3
+
+
+def test_miss_counts_and_no_overlap():
+    a, idx = _index()
+    idx.publish(_seq(8), a.alloc(1))
+    shared, cow, m = idx.match([50, 51, 52, 53])
+    assert (shared, cow, m) == ([], None, 0)
+    idx.record_lookup(m)
+    assert idx.stats["misses"] == 1
+
+
+def test_republish_dedupes_and_bumps_not_duplicates():
+    a, idx = _index()
+    t1 = a.alloc(3)
+    prompt = _seq(20)
+    idx.publish(prompt, t1)
+    t2 = a.alloc(3)  # a second row that computed the same prefix privately
+    assert idx.publish(prompt, t2) == 0  # nothing new cached
+    assert idx.cached_blocks() == 3
+    # the duplicate row's blocks took no index reference
+    assert all(a.refcount(b) == 1 for b in t2)
+
+
+def test_lru_eviction_is_leaf_first_and_budget_bounded():
+    a, idx = _index(budget=3)
+    chain = a.alloc(3)
+    idx.publish(_seq(24), chain)  # 3-node chain, exactly at budget
+    a.free(chain)
+    other = a.alloc(1)
+    idx.publish(_seq(8, start=100), other)  # 4th block: over budget
+    a.free(other)
+    assert idx.cached_blocks() == 3
+    assert idx.stats["evictions"] == 1
+    # the CHAIN's leaf (oldest) went, never an interior node before it:
+    # the surviving chain still matches its first two blocks
+    shared, _, m = idx.match(_seq(24))
+    assert m >= 16
+
+
+def test_eviction_never_reclaims_a_live_rows_block():
+    a, idx = _index(num_blocks=6, budget=4)
+    table = a.alloc(2)
+    idx.publish(_seq(16), table)
+    # a live row shares the cached blocks (refcount 2 each)
+    a.share(table)
+    a.free(table)  # original publisher released
+    # pressure: demand every block in the pool
+    idx.evict_for(need_free=5)
+    assert idx.cached_blocks() == 0  # index dropped its references...
+    assert a.used_count() == 2       # ...but the live row's blocks SURVIVE
+    assert a.free_count() == 3
+    a.free(table)  # live row done: now they reclaim
+    assert a.free_count() == 5
+
+
+def test_clear_empties_index_and_is_not_an_eviction():
+    a, idx = _index()
+    idx.publish(_seq(20), a.alloc(3))
+    ev0 = idx.stats["evictions"]
+    assert idx.clear() == 3
+    assert idx.cached_blocks() == 0 and idx.stats["evictions"] == ev0
+    assert idx.match(_seq(20))[2] == 0  # cleared prefixes never resurface
+
+
+def test_disabled_index_never_caches():
+    a, idx = _index(budget=0)
+    assert not idx.enabled
+    assert idx.publish(_seq(20), a.alloc(3)) == 0
+    assert idx.cached_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
+# manager: shared admission + evict-on-demand
+# ---------------------------------------------------------------------------
+
+
+def test_manager_shared_admit_and_release():
+    m = PagedCacheManager(10, block=16, prefix_blocks=8)
+    t1 = m.admit(1, 40)  # 3 blocks
+    m.prefix.publish(list(range(40)), t1)
+    m.release(1)
+    assert m.stats()["kv_blocks_used"] == 3
+    assert m.stats()["prefix_cached_blocks"] == 3
+    shared, cow, hit = m.prefix.match(list(range(36)) + [99, 98])
+    t2 = m.admit(2, 40, shared=shared)
+    assert t2[: len(shared)] == shared
+    assert len(t2) == 3
+    # physical accounting: 2 shared + 1 fresh + 1 cached partial = 4
+    assert m.stats()["kv_blocks_used"] == 4
+    m.release(2)
+    assert m.stats()["kv_blocks_used"] == 3  # cache refs remain
+
+
+def test_manager_admit_evicts_cached_prefixes_before_failing():
+    m = PagedCacheManager(5, block=16, prefix_blocks=4)  # 4 usable
+    t1 = m.admit(1, 64)  # all 4 blocks
+    m.prefix.publish(list(range(64)), t1)
+    m.release(1)
+    assert m.allocator.free_count() == 0
+    assert m.available_blocks() == 4  # all cached, all reclaimable
+    t2 = m.admit(2, 48, shared=[])  # needs 3: must evict 3 cached blocks
+    assert len(t2) == 3
+    assert m.prefix.stats["evictions"] >= 3
+
+
+def test_manager_admit_exhaustion_returns_shared_refs_atomically():
+    m = PagedCacheManager(4, block=16, prefix_blocks=3)
+    t1 = m.admit(1, 48)  # all 3 usable blocks
+    m.prefix.publish(list(range(40)), t1)
+    # live row 1 still holds everything: nothing is reclaimable
+    shared = [t1[0]]
+    with pytest.raises(BlockPoolExhausted):
+        m.admit(2, 64, shared=shared)
+    # atomic: the failed admission returned its shared reference (the
+    # pressure pass legitimately dropped the INDEX's refs trying to make
+    # room, so only live row 1 holds the blocks now)
+    assert m.allocator.refcount(t1[0]) == 1
+    assert m.prefix.cached_blocks() == 0
+    m.release(1)
+    assert m.stats()["kv_blocks_used"] == 0

@@ -219,6 +219,9 @@ def test_replay_decision_log_sums_rows():
     assert out == {
         "iterations": 2, "prefill_admits": 3, "evictions": 1, "shed": 1,
         "finished": 2, "spec_proposed": 15, "spec_accepted": 6,
+        # prefix-reuse columns (PR 12) default to 0 on legacy rows
+        "prefix_hits": 0, "prefix_hit_tokens": 0, "prefix_evictions": 0,
+        "chunks": 0,
     }
 
 
@@ -428,7 +431,7 @@ def test_debug_state_snapshot_matches_live_engine(server):
     for r in rows:
         assert set(r) == {"slot", "seq_id", "prompt_len", "max_new",
                           "position", "gen_step", "tokens_out", "blocks",
-                          "active"}
+                          "active", "prefix_hit_tokens", "prefill_pending"}
         assert r["position"] >= r["prompt_len"]
     assert dbg["arena"]["kv_blocks_used"] == eng.cache.stats()["kv_blocks_used"]
     assert dbg["batch"]["active_rows"] == eng.active_rows()

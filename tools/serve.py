@@ -187,7 +187,8 @@ def _record_request_span(reg, recorder, t0, fut, code, tokens=None):
 def build_scheduler(server, scheduler: str, *, queue_depth: int,
                     max_coalesce: int, cb_batch: int = 8,
                     kv_blocks: int = 0, name: str = "serve",
-                    role: str = "monolith"):
+                    role: str = "monolith", prefix_cache_blocks: int = 0,
+                    prefill_chunk: int = 0):
     """Construct the serving scheduler behind ``--scheduler``:
 
     - ``coalesce`` (default): the PR 3 `RequestQueue` — same-bucket
@@ -242,7 +243,9 @@ def build_scheduler(server, scheduler: str, *, queue_depth: int,
         )
 
         engine = PagedDecodeEngine(
-            server, max_batch=cb_batch, num_blocks=kv_blocks
+            server, max_batch=cb_batch, num_blocks=kv_blocks,
+            prefix_cache_blocks=prefix_cache_blocks,
+            prefill_chunk=prefill_chunk,
         )
         return ContinuousScheduler(
             engine, max_depth=queue_depth, name=name
@@ -258,7 +261,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                shed_slack_s: float = 2.0,
                watchdog_s: float = 300.0, max_tokens_cap: int = 0,
                scheduler: str = "coalesce", cb_batch: int = 8,
-               kv_blocks: int = 0, cb_warmup=(),
+               kv_blocks: int = 0, prefix_cache_blocks: int = 0,
+               prefill_chunk: int = 0, cb_warmup=(),
                slo_ttft_p99_s: float = 0.0, slo_error_rate: float = 0.0,
                slo_windows_s=(60.0, 600.0),
                role: str = "monolith", replica_id: str = ""):
@@ -331,7 +335,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     queue = build_scheduler(
         server, scheduler, queue_depth=queue_depth,
         max_coalesce=max_coalesce, cb_batch=cb_batch, kv_blocks=kv_blocks,
-        name="serve", role=role,
+        name="serve", role=role, prefix_cache_blocks=prefix_cache_blocks,
+        prefill_chunk=prefill_chunk,
     )
 
     # /healthz identity block (docs/serving.md "Multi-host serving"):
@@ -517,6 +522,10 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     "pfx_kv_bytes", "pfx_prefill_admits_total",
                     "pfx_request_evictions_total", "pfx_spec_accept_rate",
                     "pfx_spec_accepted_total", "pfx_spec_proposed_total",
+                    "pfx_prefix_hits_total", "pfx_prefix_misses_total",
+                    "pfx_prefix_hit_tokens_total",
+                    "pfx_prefix_evictions_total", "pfx_prefix_cached_blocks",
+                    "pfx_prefill_chunks_total",
                 ):
                     if name in snap:
                         gauges[name] = reg.value(name, snap=snap)
@@ -1095,6 +1104,18 @@ def main(argv=None):
                     help="continuous scheduler: total KV arena blocks "
                     "(0 = auto: cb-batch full-context rows + null "
                     "block); block size via PFX_KV_BLOCK")
+    ap.add_argument("--prefix-cache-blocks", type=int, default=0,
+                    help="continuous scheduler: shared-prefix KV cache "
+                    "budget in arena blocks (finished rows publish their "
+                    "prompt-prefix blocks; later admissions reuse them "
+                    "and prefill only the suffix; 0 disables — "
+                    "docs/serving.md)")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="continuous scheduler: admit long prompts in "
+                    "chunks of this many tokens (multiple of "
+                    "PFX_KV_BLOCK), one chunk per scheduler iteration "
+                    "interleaved with decode steps; 0 = monolithic "
+                    "prefill")
     ap.add_argument("--draft-k", type=int, default=-1,
                     help="speculative decoding: draft tokens per verify "
                     "step (overrides Generation.speculative.draft_k; "
@@ -1225,6 +1246,8 @@ def main(argv=None):
             scheduler=args.scheduler,
             cb_batch=args.cb_batch,
             kv_blocks=args.kv_blocks,
+            prefix_cache_blocks=args.prefix_cache_blocks,
+            prefill_chunk=args.prefill_chunk,
             cb_warmup=cb_warmup,
             slo_ttft_p99_s=args.slo_ttft_p99,
             slo_error_rate=args.slo_error_rate,
