@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic bench bench-check
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg bench bench-check
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -134,6 +134,18 @@ test-router:
 # plane")
 test-elastic:
 	python -m pytest tests/test_controller.py tests/test_router.py tests/test_elastic_drills.py -q
+
+# disaggregated-fabric gate: role-aware pool-supervision units +
+# handoff-failover/direct-transfer units (stub replicas, no model), the
+# prefix-on-prefill-export parity suite, the PR 10 proxy parity drill,
+# and the chaos drills through the real CLIs — direct byte-bypass +
+# transport parity, handoff_drop/adopt_crash failover, SIGKILL of both
+# pool corpses under supervised flood (docs/serving.md "Disaggregated
+# operations")
+test-disagg:
+	python -m pytest tests/test_controller.py tests/test_router.py tests/test_kv_handoff.py -q
+	python -m pytest tests/test_disagg_drills.py -q
+	python -m pytest "tests/test_router_drills.py::test_disaggregated_prefill_decode_parity_via_router" -q
 
 bench:
 	python benchmarks/run_benchmark.py

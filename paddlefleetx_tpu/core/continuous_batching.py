@@ -63,7 +63,7 @@ import dataclasses
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -459,6 +459,71 @@ class PagedDecodeEngine:
     def prefix_enabled(self) -> bool:
         return self.cache.prefix.enabled
 
+    def _publish_prefix(self, tokens, table) -> None:
+        """`PrefixIndex.publish` with the budget-eviction accounting
+        kept registry-synced (the release/export publish sites share
+        this so the decision-log replay cannot drift)."""
+        ev0 = self.cache.prefix.stats["evictions"]
+        self.cache.prefix.publish(tokens, table)
+        evicted = self.cache.prefix.stats["evictions"] - ev0
+        if evicted:
+            get_registry().counter(
+                "pfx_prefix_evictions_total"
+            ).inc(evicted)
+
+    def _prefix_admit(self, prompt_ids: List[int], capacity_tokens: int,
+                      label: str = "prefix"
+                      ) -> Tuple[int, List[int], List[int],
+                                 Optional[Tuple[int, int]], int]:
+        """The shared admission prelude of :meth:`admit` and
+        :meth:`prefill_export`: radix-prefix lookup, block reservation,
+        the landed-admission hit/miss accounting, and the copy-on-write
+        block copy for a mid-block divergence.  Returns ``(seq_id,
+        table, shared, cow, m)`` with ``self.pools`` already holding the
+        COW copy.
+
+        Warmup admissions neither hit nor publish: their synthetic
+        prompts must not pollute the index, and the pfx_prefix_*
+        counters stay traffic-only.  Index stats and registry counters
+        commit together AFTER the reservation landed (a failed
+        allocation raises before either moved — stats and counters can
+        never desync, the exact-replay contract)."""
+        shared: List[int] = []
+        cow = None
+        m = 0
+        if self.prefix_enabled and not self._warmup:
+            shared, cow, m = self.cache.prefix.match(prompt_ids)
+        self._seq_counter += 1
+        seq_id = self._seq_counter
+        table = self._cache_admit(seq_id, capacity_tokens, shared=shared)
+        if self.prefix_enabled and not self._warmup:
+            self.cache.prefix.record_lookup(m)
+            reg = get_registry()
+            if m:
+                reg.counter("pfx_prefix_hits_total").inc()
+                reg.counter("pfx_prefix_hit_tokens_total").inc(m)
+            else:
+                reg.counter("pfx_prefix_misses_total").inc()
+        if cow is not None:
+            # copy-on-write: the diverging cached block is copied into
+            # the row's first PRIVATE block; the suffix prefill
+            # overwrites it from the divergence slot on, so the cached
+            # original (and every row sharing it) is never touched
+            src, _keep = cow
+            dst = table[len(shared)]
+            fn = self._copy_fn()
+            jnp = self._jnp
+            pools_t = self._dispatch_donating(
+                lambda: fn(
+                    self._pools_tuple(), jnp.int32(src), jnp.int32(dst)
+                ),
+                f"{label} COW copy", release_seq=seq_id,
+            )
+            from paddlefleetx_tpu.models.gpt.generation import PagedPools
+
+            self.pools = PagedPools(*pools_t)
+        return seq_id, table, shared, cow, m
+
     def _cache_admit(self, seq_id: int, tokens: int,
                      shared: Optional[List[int]] = None) -> List[int]:
         """`PagedCacheManager.admit` with the eviction accounting kept
@@ -537,50 +602,10 @@ class PagedDecodeEngine:
         slot = next((i for i, r in enumerate(self.slots) if r is None), None)
         if slot is None:
             raise RuntimeError("no free slot in the running batch")
-        # prefix lookup — warmup admissions neither hit nor publish:
-        # their synthetic prompts must not pollute the index, and the
-        # pfx_prefix_* counters stay traffic-only (the decision-log
-        # replay contract)
-        shared: List[int] = []
-        cow = None
-        m = 0
-        if self.prefix_enabled and not self._warmup:
-            shared, cow, m = self.cache.prefix.match(prompt_ids)
-        self._seq_counter += 1
-        seq_id = self._seq_counter
-        table = self._cache_admit(
-            seq_id, self.row_capacity_tokens(plen, max_new), shared=shared
+        seq_id, table, shared, cow, m = self._prefix_admit(
+            prompt_ids, self.row_capacity_tokens(plen, max_new)
         )
-        if self.prefix_enabled and not self._warmup:
-            # the admission LANDED: commit the lookup's hit/miss stats
-            # and the registry counters together (a failed allocation
-            # above raised before either moved — index stats and
-            # counters can never desync, the exact-replay contract)
-            self.cache.prefix.record_lookup(m)
-            reg = get_registry()
-            if m:
-                reg.counter("pfx_prefix_hits_total").inc()
-                reg.counter("pfx_prefix_hit_tokens_total").inc(m)
-            else:
-                reg.counter("pfx_prefix_misses_total").inc()
         trace = entry.future.trace if entry is not None else None
-        if cow is not None:
-            # copy-on-write: the diverging cached block is copied into
-            # the row's first PRIVATE block; the suffix prefill below
-            # overwrites it from the divergence slot on, so the cached
-            # original (and every row sharing it) is never touched
-            src, _keep = cow
-            dst = table[len(shared)]
-            fn = self._copy_fn()
-            pools_t = self._dispatch_donating(
-                lambda: fn(
-                    self._pools_tuple(), jnp.int32(src), jnp.int32(dst)
-                ),
-                "prefix COW copy", release_seq=seq_id,
-            )
-            from paddlefleetx_tpu.models.gpt.generation import PagedPools
-
-            self.pools = PagedPools(*pools_t)
 
         if m == 0 and self.prefill_chunk == 0:
             # no reuse, no chunking: the original monolithic prefill
@@ -661,51 +686,72 @@ class PagedDecodeEngine:
         self._tick_prefill(slot)
         return slot
 
-    def _tick_prefill(self, slot: int) -> None:
-        """Run ONE chunk of a mid-prefill row's prompt suffix.  The
-        final chunk seeds the row's pending logits (last REAL prompt
-        token) + repetition counts and flips it decode-active."""
-        jnp = self._jnp
-        row = self.slots[slot]
-        take = min(row.chunk, len(row.pending))
-        toks = np.full((1, row.chunk), self.gen.pad_token_id, np.int32)
-        toks[0, :take] = row.pending[:take]
-        final = take == len(row.pending)
+    def _padded_chunk_table(self, table: List[int]) -> np.ndarray:
+        """Pad a row's block table to the power-of-two width the chunk
+        family is compiled for."""
         M = min(
-            _pow2_at_least(len(row.table)),
+            _pow2_at_least(len(table)),
             _pow2_at_least(self.max_row_blocks),
         )
         tbl = np.full((M,), NULL_BLOCK, np.int32)
-        tbl[: len(row.table)] = row.table
-        fn = self._chunk_fn(row.chunk, M)
-        t0 = time.monotonic()
-        # no release_seq: this row already sits in slots, so reset()
-        # releases it with the other dead rows
+        tbl[: len(table)] = table
+        return tbl
+
+    def _run_prefill_chunk(self, chunk: int, tbl: np.ndarray, pos: int,
+                           pending, *, label: str,
+                           release_seq: Optional[int] = None):
+        """Dispatch ONE compiled prefill chunk — the shared body of the
+        scheduler's :meth:`_tick_prefill` and the export path's suffix
+        loop, so the chunk-call contract and its stats/counter
+        accounting live in exactly one place.  Returns ``(last_logits,
+        take)``."""
+        jnp = self._jnp
+        take = min(chunk, len(pending))
+        toks = np.full((1, chunk), self.gen.pad_token_id, np.int32)
+        toks[0, :take] = pending[:take]
+        fn = self._chunk_fn(chunk, len(tbl))
         pools_t, last = self._dispatch_donating(
             lambda: fn(
                 self.server.params,
                 jnp.asarray(toks),
                 self._pools_tuple(),
                 jnp.asarray(tbl),
-                jnp.int32(row.prefill_pos),
+                jnp.int32(pos),
                 jnp.int32(take),
                 jnp.int32(max(take - 1, 0)),
             ),
-            "chunk prefill",
+            label, release_seq=release_seq,
         )
-        from paddlefleetx_tpu.models.gpt.generation import (
-            PagedPools,
-            prefix_token_counts,
-        )
+        from paddlefleetx_tpu.models.gpt.generation import PagedPools
 
         self.pools = PagedPools(*pools_t)
-        row.pending = row.pending[take:]
-        row.prefill_pos += take
-        self.positions[slot] = row.prefill_pos
         self.stats["prefill_chunks"] += 1
         self.stats["prefill_tokens"] += take
         if not self._warmup:
             get_registry().counter("pfx_prefill_chunks_total").inc()
+        return last, take
+
+    def _tick_prefill(self, slot: int) -> None:
+        """Run ONE chunk of a mid-prefill row's prompt suffix.  The
+        final chunk seeds the row's pending logits (last REAL prompt
+        token) + repetition counts and flips it decode-active."""
+        jnp = self._jnp
+        row = self.slots[slot]
+        final = min(row.chunk, len(row.pending)) == len(row.pending)
+        t0 = time.monotonic()
+        # no release_seq: this row already sits in slots, so reset()
+        # releases it with the other dead rows
+        last, take = self._run_prefill_chunk(
+            row.chunk, self._padded_chunk_table(row.table),
+            row.prefill_pos, row.pending, label="chunk prefill",
+        )
+        from paddlefleetx_tpu.models.gpt.generation import (
+            prefix_token_counts,
+        )
+
+        row.pending = row.pending[take:]
+        row.prefill_pos += take
+        self.positions[slot] = row.prefill_pos
         if row.trace is not None:
             row.trace.span(
                 "prefill_chunk", t0=t0, t1=time.monotonic(), slot=slot,
@@ -761,41 +807,69 @@ class PagedDecodeEngine:
         budgets.  ``meta["max_new"]`` carries the ALREADY-clamped budget;
         the adopting engine re-clamps with the same formula, so the two
         agree whenever the replicas share a Model config (and
-        `check_handoff_meta` has already insisted they do)."""
+        `check_handoff_meta` has already insisted they do).
+
+        With the prefix cache on (``--prefix-cache-blocks`` on a
+        ``--role prefill`` replica), the radix index is consulted
+        exactly like :meth:`admit`: the matched span's cached blocks map
+        SHARED into the export table (a fleet-shared system prefix is
+        computed once per prefill replica, not once per request), a
+        mid-block divergence gets a private copy-on-write block, and
+        ONLY the unmatched suffix runs through the chunk family.  The
+        exported bytes are identical either way — `gather_kv_blocks`
+        copies shared and private blocks alike, and `pack_handoff`'s
+        pool signature already guards cross-replica compatibility."""
+        prompt_ids = [int(t) for t in prompt_ids]
         plen = len(prompt_ids)
         P, PB, _, max_new = self._clamp_budget(plen, int(max_new))
-        self._seq_counter += 1
-        seq_id = self._seq_counter
+        jnp = self._jnp
+        t0 = time.monotonic()
         # reserve ONLY the prompt bucket: the decode budget is the
         # decode replica's to hold
-        table = self._cache_admit(seq_id, P)
-        prompt = np.full((1, P), self.gen.pad_token_id, np.int32)
-        prompt[0, :plen] = list(prompt_ids)
-        jnp = self._jnp
-        fn = self._prefill_fn(P, PB)
-        t0 = time.monotonic()
-        pools_t, last, counts = self._dispatch_donating(
-            lambda: fn(
-                self.server.params,
-                jnp.asarray(prompt),
-                jnp.int32(plen),
-                self._pools_tuple(),
-                jnp.asarray(table, jnp.int32),
-            ),
-            "prefill export", release_seq=seq_id,
+        seq_id, table, _shared, _cow, m = self._prefix_admit(
+            prompt_ids, P, label="export prefix"
         )
-        from paddlefleetx_tpu.models.gpt.generation import (
-            PagedPools,
-            gather_kv_blocks,
-        )
+        if m == 0:
+            prompt = np.full((1, P), self.gen.pad_token_id, np.int32)
+            prompt[0, :plen] = prompt_ids
+            fn = self._prefill_fn(P, PB)
+            pools_t, last, counts = self._dispatch_donating(
+                lambda: fn(
+                    self.server.params,
+                    jnp.asarray(prompt),
+                    jnp.int32(plen),
+                    self._pools_tuple(),
+                    jnp.asarray(table, jnp.int32),
+                ),
+                "prefill export", release_seq=seq_id,
+            )
+            from paddlefleetx_tpu.models.gpt.generation import PagedPools
 
-        self.pools = PagedPools(*pools_t)
+            self.pools = PagedPools(*pools_t)
+            self.stats["prefill_tokens"] += plen
+            counts = np.asarray(counts, np.int32)
+        else:
+            from paddlefleetx_tpu.models.gpt.generation import (
+                prefix_token_counts,
+            )
+
+            last = self._export_suffix_chunks(prompt_ids, m, table, seq_id)
+            counts = np.asarray(
+                prefix_token_counts(prompt_ids, int(self.mcfg.vocab_size)),
+                np.int32,
+            )
+        from paddlefleetx_tpu.models.gpt.generation import gather_kv_blocks
+
         arrays = gather_kv_blocks(self.pools, table)
         arrays["logits"] = np.asarray(last, np.float32)
-        arrays["counts"] = np.asarray(counts, np.int32)
+        arrays["counts"] = counts
+        if self.prefix_enabled and not self._warmup:
+            # publish BEFORE release: the index takes its own refs while
+            # the row's table still pins the blocks
+            self._publish_prefix(prompt_ids, table)
         self.cache.release(seq_id)  # contents copied out; blocks free
         meta = {
-            "prompt_ids": [int(t) for t in prompt_ids],
+            "prompt_ids": prompt_ids,
             "prompt_len": plen,
             "max_new": int(max_new),
             "block": self.block,
@@ -808,8 +882,34 @@ class PagedDecodeEngine:
             get_registry().counter("pfx_handoff_exports_total").inc()
         if trace is not None:
             trace.span("prefill_export", t0=t0, t1=time.monotonic(),
-                       prompt_len=plen, bucket=P, blocks=PB)
+                       prompt_len=plen, bucket=P, blocks=PB,
+                       prefix_hit=m)
         return meta, arrays
+
+    def _export_suffix_chunks(self, prompt_ids: List[int], m: int,
+                              table: List[int], seq_id: int):
+        """Run a prefix-hit export's unmatched suffix ``[m, plen)``
+        through the compiled chunk family, synchronously (an export must
+        return a complete payload — there is no decode loop to
+        interleave with on a prefill replica).  Returns the last REAL
+        prompt token's logits."""
+        from paddlefleetx_tpu.models.gpt.generation import bucket_len
+
+        chunk = self.prefill_chunk or bucket_len(
+            len(prompt_ids) - m, self.bucket
+        )
+        tbl = self._padded_chunk_table(table)
+        pending = prompt_ids[m:]
+        pos = m
+        last = None
+        while pending:
+            last, take = self._run_prefill_chunk(
+                chunk, tbl, pos, pending,
+                label="export suffix chunk", release_seq=seq_id,
+            )
+            pending = pending[take:]
+            pos += take
+        return last
 
     def _adopt_fn(self, PB: int):
         key = (PB,)
@@ -924,6 +1024,11 @@ class PagedDecodeEngine:
         self.stats["adopts"] += 1
         if not self._warmup:
             get_registry().counter("pfx_handoff_adopts_total").inc()
+        # deterministic decode-death drill (docs/fault_tolerance.md
+        # adopt_crash): the Kth adoption hard-exits AFTER the row landed
+        # in the arena — the transport sees the connection die
+        # mid-exchange, driving the router's bounded re-prefill failover
+        maybe_fire("adopt_crash", self.stats["adopts"])
         return slot
 
     def table_width_bucket(self) -> int:
@@ -1066,13 +1171,7 @@ class PagedDecodeEngine:
         if row is None:
             raise ValueError(f"slot {slot} is already empty")
         if self.prefix_enabled and not self._warmup and row.prefill_done:
-            ev0 = self.cache.prefix.stats["evictions"]
-            self.cache.prefix.publish(row.prompt_ids, row.table)
-            evicted = self.cache.prefix.stats["evictions"] - ev0
-            if evicted:
-                get_registry().counter(
-                    "pfx_prefix_evictions_total"
-                ).inc(evicted)
+            self._publish_prefix(row.prompt_ids, row.table)
         self.cache.release(row.seq_id)
         self.slots[slot] = None
         self.active[slot] = False
@@ -1115,13 +1214,25 @@ class PagedDecodeEngine:
         """Prefill-replica warmup: compile the prefill family per prompt
         bucket by running one export end-to-end (the blocks are freed on
         export, so nothing stays allocated).  Warmup exports are not
-        traffic — the handoff counters stay clean."""
+        traffic — the handoff counters stay clean.  With the prefix
+        cache on, the COW-copy and EXPORT-width chunk families a traffic
+        hit routes through compile here too (warmup exports skip the
+        index, so they never exercise — or pollute — the hit path)."""
+        from paddlefleetx_tpu.models.gpt.generation import bucket_len
+
         per: Dict[str, float] = {}
         self._warmup = True
         try:
+            if self.prefix_enabled:
+                self._warm_copy_family()
             for n in prompt_lens:
                 t0 = time.time()
                 try:
+                    if self.prefix_enabled:
+                        self._warm_chunk_family(
+                            int(n),
+                            capacity_tokens=bucket_len(int(n), self.bucket),
+                        )
                     self.prefill_export([1] * int(n), self.gen.max_dec_len)
                 except Exception as exc:
                     raise RuntimeError(
@@ -1155,7 +1266,8 @@ class PagedDecodeEngine:
 
         self.pools = PagedPools(*pools_t)
 
-    def _warm_chunk_family(self, n: int) -> None:
+    def _warm_chunk_family(self, n: int,
+                           capacity_tokens: Optional[int] = None) -> None:
         """Compile the chunk fns a traffic prefix hit at bucket ``n``
         routes its suffix through (only needed when ``prefill_chunk`` is
         off — a chunked config's normal warmup admission already rides
@@ -1168,7 +1280,13 @@ class PagedDecodeEngine:
         and the width bucket follows the DEFAULT decode budget exactly
         like the warmed step family does (a request with a much smaller
         max_tokens keys a narrower width and compiles then) — the same
-        partial-coverage contract as the prompt buckets."""
+        partial-coverage contract as the prompt buckets.
+
+        ``capacity_tokens`` overrides the row capacity the table width
+        is derived from: EXPORT tables cover only the prompt bucket
+        (the decode budget is the decode replica's to hold), so a
+        prefill replica warms a narrower width than a decode-capacity
+        row would."""
         from paddlefleetx_tpu.models.gpt.generation import (
             PagedPools,
             bucket_len,
@@ -1176,11 +1294,14 @@ class PagedDecodeEngine:
 
         jnp = self._jnp
         blocks = blocks_for(
-            self.row_capacity_tokens(int(n), self.gen.max_dec_len),
+            capacity_tokens if capacity_tokens is not None
+            else self.row_capacity_tokens(int(n), self.gen.max_dec_len),
             self.block,
         )
         M = min(_pow2_at_least(blocks), _pow2_at_least(self.max_row_blocks))
-        for t in sorted({self.bucket, bucket_len(int(n), self.bucket)}):
+        chunks = ({self.prefill_chunk} if self.prefill_chunk
+                  else {self.bucket, bucket_len(int(n), self.bucket)})
+        for t in sorted(chunks):
             fn = self._chunk_fn(t, M)
             toks = np.full((1, t), self.gen.pad_token_id, np.int32)
             tbl = np.full((M,), NULL_BLOCK, np.int32)
@@ -1322,6 +1443,12 @@ class ContinuousScheduler:
             ("pfx_batch_occupancy", {}, occ),
             ("pfx_kv_blocks_used", {}, float(cstats["kv_blocks_used"])),
             ("pfx_kv_blocks_free", {}, float(cstats["kv_blocks_free"])),
+            # free + reclaimable cached-prefix blocks: what an admission
+            # can actually obtain — /healthz surfaces it and the decode
+            # pool controller + router scoring read it (a nearly-full
+            # arena must stop attracting adoptions it will bounce)
+            ("pfx_kv_blocks_available", {},
+             float(eng.cache.available_blocks())),
             # live arena payload bytes: used blocks x K+V bytes/block —
             # int8 halves the per-block bytes, the acceptance evidence.
             # kv_blocks_used counts PHYSICAL blocks (refcount-deduped),

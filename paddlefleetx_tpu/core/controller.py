@@ -75,7 +75,22 @@ class ScalePolicy:
     ``up_cooldown_s`` — a spawned replica needs time to reach serving
     before it can relieve anything); scale DOWN only after ``idle_s`` of
     sustained idleness and ``down_cooldown_s`` since the last scale
-    action."""
+    action.
+
+    Disaggregated pools watch DIFFERENT signals (docs/serving.md
+    "Disaggregated operations"): a prefill pool scales on queue depth /
+    TTFT burn (``use_occupancy=False`` — prefill replicas hold no
+    decode arena), a decode pool on arena occupancy and
+    ``available_blocks`` (``use_depth=False``, ``low_blocks`` > 0: any
+    serving replica's admissible-block count at or below it is
+    pressure).  The SLO-breach signal is always live.
+
+    ``count_in_flight=False`` builds the depth signal from replica-
+    reported queue depth ONLY: under the direct handoff transport a
+    prefill replica's router-side in-flight spans the whole
+    prefill->decode relay, so counting it would scale the prefill pool
+    on DECODE duration (tools/router.py sets this for the prefill pool
+    when ``--handoff direct``)."""
 
     min_replicas: int = 1
     max_replicas: int = 4
@@ -87,8 +102,16 @@ class ScalePolicy:
     down_cooldown_s: float = 60.0
     idle_s: float = 30.0
     interval_s: float = 1.0
+    use_depth: bool = True
+    use_occupancy: bool = True
+    low_blocks: int = 0
+    count_in_flight: bool = True
 
     def validate(self) -> "ScalePolicy":
+        if self.low_blocks < 0:
+            raise ValueError(
+                f"low_blocks must be >= 0, got {self.low_blocks}"
+            )
         if self.min_replicas < 1:
             raise ValueError(f"min_replicas must be >= 1, got {self.min_replicas}")
         if self.max_replicas < self.min_replicas:
@@ -110,6 +133,15 @@ class ScalePolicy:
                      "interval_s"):
             if getattr(self, name) <= 0:
                 raise ValueError(f"{name} must be > 0")
+        if (not self.use_depth and not self.use_occupancy
+                and self.low_blocks <= 0):
+            # with every load signal off, pressure is breach-only and
+            # "idle" degenerates to "no breach": a slammed pool would
+            # read as idle and be drained to min_replicas mid-load
+            raise ValueError(
+                "ScalePolicy needs at least one load signal: enable "
+                "use_depth or use_occupancy, or set low_blocks > 0"
+            )
         return self
 
     def view(self) -> Dict[str, Any]:
@@ -126,6 +158,7 @@ class ManagedReplica:
     port: int
     url: str
     cmd: List[str]
+    rid: str = ""        # replica_id: <slot_prefix><slot> (m0, p0, d1...)
     log_path: str = ""
     key: Optional[str] = None            # router registry key
     proc: Optional[subprocess.Popen] = None
@@ -141,6 +174,7 @@ class ManagedReplica:
     def view(self) -> Dict[str, Any]:
         return {
             "slot": self.slot,
+            "replica_id": self.rid,
             "port": self.port,
             "url": self.url,
             "key": self.key,
@@ -175,6 +209,7 @@ class ReplicaSupervisor:
 
     def __init__(self, cmd_template: str, *, base_port: int,
                  max_replicas: int, role: str = "monolith",
+                 slot_prefix: str = "m",
                  compile_cache_dir: str = "", log_dir: str = "",
                  backoff_base_s: float = 0.5, backoff_max_s: float = 30.0,
                  flap_budget: int = 5, flap_window_s: float = 60.0,
@@ -188,6 +223,10 @@ class ReplicaSupervisor:
             )
         if flap_budget < 1:
             raise ValueError(f"flap_budget must be >= 1, got {flap_budget}")
+        # slot_prefix keeps two pools' replica ids distinct (the
+        # disaggregated control plane runs one supervisor per pool:
+        # prefill p<i>, decode d<i>; the monolith fleet keeps m<i>)
+        self.slot_prefix = slot_prefix
         self.cmd_template = cmd_template
         self.base_port = int(base_port)
         self.max_replicas = int(max_replicas)
@@ -214,7 +253,7 @@ class ReplicaSupervisor:
         m = self.slots.get(i)
         if m is None:
             port = self.base_port + i
-            replica_id = f"m{i}"
+            replica_id = f"{self.slot_prefix}{i}"
             cmd = shlex.split(
                 self.cmd_template.format(port=port, replica_id=replica_id)
             )
@@ -224,7 +263,7 @@ class ReplicaSupervisor:
                         if self.log_dir else "")
             m = ManagedReplica(
                 slot=i, port=port, url=f"http://127.0.0.1:{port}",
-                cmd=cmd, log_path=log_path,
+                cmd=cmd, rid=replica_id, log_path=log_path,
             )
             with self._lock:
                 self.slots[i] = m
@@ -253,7 +292,7 @@ class ReplicaSupervisor:
         m.started_t = now
         m.next_restart_t = 0.0
         logger.info(
-            f"supervisor: spawned replica m{m.slot} "
+            f"supervisor: spawned replica {m.rid} "
             f"(pid {m.proc.pid}, port {m.port})"
         )
 
@@ -319,7 +358,7 @@ class ReplicaSupervisor:
                 m.proc = None
                 if not m.desired:
                     logger.info(
-                        f"supervisor: replica m{m.slot} exited rc={rc} "
+                        f"supervisor: replica {m.rid} exited rc={rc} "
                         "(expected: drained)"
                     )
                     continue
@@ -335,7 +374,7 @@ class ReplicaSupervisor:
                     m.flap_exempt = True
                     m.next_restart_t = now + self.backoff_base_s
                     logger.info(
-                        f"supervisor: replica m{m.slot} exited cleanly "
+                        f"supervisor: replica {m.rid} exited cleanly "
                         "(rc=0) while desired — out-of-band drain? "
                         f"respawning in {self.backoff_base_s:.2f}s "
                         "(flap budget not spent)"
@@ -349,10 +388,10 @@ class ReplicaSupervisor:
                     m.next_restart_t = 0.0
                     self._registry.counter(
                         "pfx_replica_quarantines_total",
-                        replica=f"m{m.slot}",
+                        replica=m.rid,
                     ).inc()
                     logger.error(
-                        f"QUARANTINE: replica m{m.slot} (port {m.port}) "
+                        f"QUARANTINE: replica {m.rid} (port {m.port}) "
                         f"crash-looped {len(recent)} time(s) within "
                         f"{self.flap_window_s:g}s (flap budget "
                         f"{self.flap_budget}; last rc={rc}); NOT "
@@ -367,7 +406,7 @@ class ReplicaSupervisor:
                 )
                 m.next_restart_t = now + backoff
                 logger.warning(
-                    f"supervisor: replica m{m.slot} crashed rc={rc}; "
+                    f"supervisor: replica {m.rid} crashed rc={rc}; "
                     f"restart {len(recent) + 1} in {backoff:.2f}s"
                 )
             elif (m.desired and not m.quarantined
@@ -381,7 +420,7 @@ class ReplicaSupervisor:
                 m.flap_exempt = False
                 m.restarts += 1
                 self._registry.counter(
-                    "pfx_replica_restarts_total", replica=f"m{m.slot}"
+                    "pfx_replica_restarts_total", replica=m.rid
                 ).inc()
                 self._spawn(m, now)
 
@@ -426,7 +465,7 @@ class ReplicaSupervisor:
                 m.proc.wait(timeout=left)
             except subprocess.TimeoutExpired:
                 logger.warning(
-                    f"supervisor: replica m{m.slot} ignored SIGTERM for "
+                    f"supervisor: replica {m.rid} ignored SIGTERM for "
                     f"{timeout:g}s; killing"
                 )
                 m.proc.kill()
@@ -452,11 +491,20 @@ class ElasticController:
         self.policy = policy.validate()
         self.role = role
         reg = registry or get_registry()
-        self._ticks = reg.counter("pfx_controller_ticks_total")
-        self._ups = reg.counter("pfx_controller_scale_ups_total")
-        self._downs = reg.counter("pfx_controller_scale_downs_total")
-        self._target_gauge = reg.gauge("pfx_controller_target_replicas")
-        self._breach_gauge = reg.gauge("pfx_controller_breach")
+        # disaggregated pool controllers label their counters with the
+        # pool so prefill/decode decisions replay per pool; the monolith
+        # fleet stays UNLABELED — the PR 11 drill contracts read it that
+        # way, and one monolith controller per process needs no label
+        labels = {} if role == "monolith" else {"pool": role}
+        self._ticks = reg.counter("pfx_controller_ticks_total", **labels)
+        self._ups = reg.counter("pfx_controller_scale_ups_total", **labels)
+        self._downs = reg.counter(
+            "pfx_controller_scale_downs_total", **labels
+        )
+        self._target_gauge = reg.gauge(
+            "pfx_controller_target_replicas", **labels
+        )
+        self._breach_gauge = reg.gauge("pfx_controller_breach", **labels)
         # bounded decision log, the PR 8 replay contract (controller
         # edition): one row per tick; an untruncated log replays to
         # exact agreement with the counters (replay_controller_log)
@@ -485,7 +533,8 @@ class ElasticController:
         if self._thread is None or not self._thread.is_alive():
             self._stop = threading.Event()
             self._thread = threading.Thread(
-                target=self._loop, name="elastic-controller", daemon=True
+                target=self._loop,
+                name=f"elastic-controller-{self.role}", daemon=True,
             )
             self._thread.start()
         return self
@@ -525,17 +574,35 @@ class ElasticController:
                    if v["state"] == "serving" and not v["draining"]]
         coming = [v for v in views if v["state"] in ("booting", "warm")]
         breach = any(v.get("slo_breach") for v in serving)
-        depth_total = sum(v["depth"] + v["in_flight"] for v in serving)
+        depth_total = sum(
+            v["depth"] + (v["in_flight"] if p.count_in_flight else 0)
+            for v in serving
+        )
         avg_depth = depth_total / max(1, len(serving))
         occ = max((v.get("occupancy", 0.0) for v in serving), default=0.0)
-        pressure = (breach or avg_depth > p.high_depth
-                    or occ > p.high_occupancy)
+        # decode-pool signal: the WORST serving replica's admissible
+        # blocks (free + reclaimable, from /healthz) — a pool whose
+        # tightest arena is at/below low_blocks will start bouncing
+        # adoptions; None until a poll carries the field
+        min_blocks = min(
+            (v["available_blocks"] for v in serving
+             if v.get("available_blocks") is not None),
+            default=None,
+        )
+        depth_hot = p.use_depth and avg_depth > p.high_depth
+        occ_hot = p.use_occupancy and occ > p.high_occupancy
+        blocks_hot = (p.low_blocks > 0 and min_blocks is not None
+                      and min_blocks <= p.low_blocks)
+        pressure = breach or depth_hot or occ_hot or blocks_hot
         # zero serving replicas is an OUTAGE, not idleness: with nothing
         # serving, depth/occupancy read 0 by construction, and scaling
         # down mid-outage would retire capacity exactly when the fleet
         # is returning 503s — idle requires at least one serving replica
         idle = (bool(serving) and not pressure
-                and avg_depth <= p.low_depth and occ <= p.low_occupancy)
+                and (not p.use_depth or avg_depth <= p.low_depth)
+                and (not p.use_occupancy or occ <= p.low_occupancy)
+                and (p.low_blocks == 0 or min_blocks is None
+                     or min_blocks > 2 * p.low_blocks))
         self._idle_since = (
             (self._idle_since if self._idle_since is not None else now)
             if idle else None
@@ -545,8 +612,11 @@ class ElasticController:
         if pressure:
             why = ("slo burn-rate breach" if breach
                    else f"avg depth {avg_depth:.2f} > {p.high_depth:g}"
-                   if avg_depth > p.high_depth
-                   else f"occupancy {occ:.2f} > {p.high_occupancy:g}")
+                   if depth_hot
+                   else f"occupancy {occ:.2f} > {p.high_occupancy:g}"
+                   if occ_hot
+                   else f"available blocks {min_blocks} <= "
+                        f"{p.low_blocks} (arena pressure)")
             if self.target >= p.max_replicas:
                 reason = f"{why}, but at max_replicas {p.max_replicas}"
                 if not self._at_max_warned:
@@ -611,6 +681,10 @@ class ElasticController:
         row = {
             "tick": self._seq,
             "t": round(now, 3),
+            # the pool this row belongs to: disaggregated control planes
+            # run one controller per pool, and a per-pool replay must
+            # fold each pool's rows into ITS labeled counters
+            "pool": self.role,
             "action": action,
             "reason": reason,
             "target": self.target,
@@ -619,6 +693,7 @@ class ElasticController:
             "breach": breach,
             "avg_depth": round(avg_depth, 3),
             "occupancy": round(occ, 3),
+            "min_blocks": min_blocks,
             "quarantined": self.supervisor.quarantined_count(),
         }
         with self._log_lock:
@@ -644,15 +719,21 @@ class ElasticController:
         }
 
 
-def replay_controller_log(rows) -> Dict[str, int]:
+def replay_controller_log(rows, pool: Optional[str] = None
+                          ) -> Dict[str, int]:
     """Fold controller decision rows back into the counters they must
     reproduce (the PR 8 replay contract): on a run whose log was not
     truncated, ``ticks`` == pfx_controller_ticks_total, ``scale_ups`` ==
     pfx_controller_scale_ups_total and ``scale_downs`` ==
     pfx_controller_scale_downs_total — a scale action the log cannot
-    explain shows up as a mismatch."""
+    explain shows up as a mismatch.  ``pool`` restricts the fold to one
+    pool's rows (rows predating the field count as monolith), matching
+    the ``pool``-labeled counters a disaggregated control plane keeps
+    per pool."""
     out = {"ticks": 0, "scale_ups": 0, "scale_downs": 0, "holds": 0}
     for row in rows:
+        if pool is not None and row.get("pool", "monolith") != pool:
+            continue
         out["ticks"] += 1
         action = row.get("action")
         if action == "scale_up":

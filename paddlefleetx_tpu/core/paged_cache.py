@@ -288,9 +288,18 @@ class PrefixIndex:
     def reclaimable_blocks(self) -> int:
         """Cached blocks ONLY the index references — evicting the whole
         index would return exactly these to the pool (blocks also shared
-        by live rows stay allocated until those rows release)."""
+        by live rows stay allocated until those rows release).
+
+        Safe to call from metrics/health scrape threads while the
+        scheduler thread publishes/evicts: the ``list()`` snapshot is a
+        single C-level copy (atomic under the GIL — a Python-level
+        generator over the live set would crash on concurrent
+        add/discard), and ``refcount`` reads fall back to 0 for a block
+        freed mid-scan — the count is a momentarily-stale gauge, never
+        an exception."""
+        nodes = list(self._nodes)
         return sum(
-            1 for n in self._nodes
+            1 for n in nodes
             if self.allocator.refcount(n.block_id) == 1
         )
 

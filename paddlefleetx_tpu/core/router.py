@@ -141,7 +141,12 @@ class NoReplicaAvailable(RuntimeError):
 
 class ReplicaUnavailable(RuntimeError):
     """Dispatch failed after bytes may have been exchanged — honest 503,
-    NEVER retried on another replica (the decode may have happened)."""
+    NEVER retried on another replica (the decode may have happened).
+    ``replica_key`` (set by :meth:`RouterCore.dispatch`) names the
+    replica that failed, so the handoff failover ladder can exclude it
+    from a fallback chain without ever replaying AT it."""
+
+    replica_key: Optional[str] = None
 
 
 class RequestNotSent(ReplicaUnavailable):
@@ -168,6 +173,9 @@ class Replica:
     depth: int = 0
     busy_s: float = 0.0
     occupancy: float = 0.0  # continuous-batch rows/capacity (0 otherwise)
+    # paged-arena blocks an admission can actually obtain (decode
+    # replicas report it; None until a poll carries the field)
+    available_blocks: Optional[int] = None
     slo_breach: bool = False  # replica-reported SLO burn-rate breach
     last_poll: float = 0.0
     ok_streak: int = 0
@@ -197,6 +205,7 @@ class Replica:
             "depth": self.depth,
             "busy_s": round(self.busy_s, 3),
             "occupancy": round(self.occupancy, 4),
+            "available_blocks": self.available_blocks,
             "slo_breach": self.slo_breach,
             "in_flight": self.in_flight,
             "last_latency_s": round(self.last_latency_s, 4),
@@ -265,7 +274,8 @@ class RouterCore:
                  max_inflight: int = 64, retries: int = 2,
                  poll_interval_s: float = 0.5, poll_timeout_s: float = 2.0,
                  eject_after: int = 3, serve_after: int = 1,
-                 allow_empty: bool = False, name: str = "router") -> None:
+                 allow_empty: bool = False, name: str = "router",
+                 handoff: str = "proxy") -> None:
         if not replicas and not allow_empty:
             # allow_empty is the supervised topology (tools/router.py
             # --supervise): the controller registers replicas via
@@ -273,6 +283,18 @@ class RouterCore:
             raise ValueError("router needs >= 1 replica")
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        if handoff not in ("proxy", "direct"):
+            raise ValueError(
+                f"unknown handoff transport {handoff!r}; "
+                "valid: proxy, direct"
+            )
+        # disaggregated KV-handoff transport: "direct" issues a
+        # placement ticket and the prefill replica POSTs the payload
+        # straight to the chosen decode replica (handoff bytes never
+        # transit the router); "proxy" carries the payload through this
+        # process — kept as the drilled fallback, and what a direct
+        # transfer degrades to when the send fails
+        self.handoff = handoff
         self.name = name
         self.retries = int(retries)
         self.max_inflight = int(max_inflight)
@@ -320,6 +342,9 @@ class RouterCore:
         self._drains_ctr = reg.counter("pfx_router_drains_total")
         self._handoff_bytes = reg.counter("pfx_router_handoff_bytes_total")
         self._handoff_hist = reg.histogram("pfx_router_handoff_seconds")
+        self._failovers = lambda leg: reg.counter(
+            "pfx_handoff_failovers_total", leg=leg
+        )
         reg.register_collector(self)
 
     # -- telemetry ------------------------------------------------------
@@ -351,9 +376,21 @@ class RouterCore:
             for r in self.replicas.values():
                 if r.url == url:
                     return r.key
+            roles = {r.role for r in self.replicas.values()}
+            if roles and (
+                (role == "monolith") != (roles == {"monolith"})
+            ):
+                raise ValueError(
+                    f"cannot register a {role} replica into a "
+                    f"{'monolith' if roles == {'monolith'} else 'pool'} "
+                    "topology (mixing is not supported)"
+                )
             key = f"r{self._next_slot}"
             self._next_slot += 1
             self.replicas[key] = Replica(key=key, url=url, role=role)
+            # a pool-supervised router boots EMPTY (allow_empty) and
+            # learns its topology from the registrations
+            self.disaggregated = role != "monolith"
         logger.info(f"{self.name}: replica {key} registered ({url}, {role})")
         return key
 
@@ -396,6 +433,8 @@ class RouterCore:
             # elastic-control signals (core/controller.py): continuous-
             # batch occupancy and the replica's own SLO breach verdict
             r.occupancy = float(h.get("occupancy", 0.0) or 0.0)
+            ab = h.get("available_blocks")
+            r.available_blocks = int(ab) if ab is not None else None
             r.slo_breach = bool((h.get("slo") or {}).get("breach", False))
             ident = h.get("identity") or {}
             old_pid = r.pid
@@ -533,10 +572,21 @@ class RouterCore:
         base = reported depth + router-side in-flight; a replica whose
         estimated wait (backlog x recent per-request latency + the
         in-progress decode's age) exceeds the request's remaining
-        deadline is pushed to last resort."""
+        deadline is pushed to last resort.
+
+        DECODE replicas additionally fold their paged-arena pressure in
+        (the /healthz ``occupancy`` + ``available_blocks`` the poller
+        already carries): a shallow queue on a nearly-full arena loses
+        to a slightly deeper one with room, and an arena with NO
+        admissible blocks is pushed near last resort — it would bounce
+        the adoption it attracted."""
         backlog = r.depth + r.in_flight
         est_wait = backlog * max(r.last_latency_s, 0.01) + min(r.busy_s, 60.0)
         score = float(backlog)
+        if r.role == "decode":
+            score += 8.0 * r.occupancy
+            if r.available_blocks is not None and r.available_blocks <= 0:
+                score += 1e5
         if remaining_s > 0 and est_wait > remaining_s:
             score += 1e6  # only if every replica is past the deadline
         return score
@@ -571,15 +621,22 @@ class RouterCore:
 
     def dispatch(self, method: str, path: str, body: Optional[bytes], *,
                  role: str, deadline_s: float, headers=None,
-                 trace=None) -> Tuple[int, bytes, str]:
+                 trace=None, exclude: Optional[set] = None
+                 ) -> Tuple[int, bytes, str]:
         """Route one request: pick -> forward -> account.  Bounded retry
-        on ANOTHER replica only for connection-refused (never after a
-        partial exchange); every attempt's routing decision lands on the
-        request's trace.  Raises :class:`NoReplicaAvailable` /
-        :class:`ReplicaUnavailable` for the transport layer to turn into
-        503."""
+        on ANOTHER replica only for connection-refused and provably-
+        unsent sends (:class:`RequestNotSent` — the transport failed
+        before the request line went out, so nothing downstream saw
+        it); NEVER after a partial exchange; every attempt's routing
+        decision lands on the request's trace.  ``exclude`` seeds the never-pick set (the
+        handoff failover ladder excludes a replica that already failed
+        mid-exchange — a fallback must not replay AT it).  Raises
+        :class:`NoReplicaAvailable` / :class:`ReplicaUnavailable` (the
+        latter carrying ``replica_key``) for the transport layer to turn
+        into 503."""
         deadline_abs = time.monotonic() + float(deadline_s)
-        tried: set = set()
+        seeded: set = set(exclude or ())
+        tried: set = set(seeded)
         attempt = 0
         while True:
             remaining = deadline_abs - time.monotonic()
@@ -590,11 +647,15 @@ class RouterCore:
             try:
                 r = self.pick(role, remaining, exclude=tried)
             except NoReplicaAvailable:
-                if tried:
+                # count only replicas THIS dispatch contacted as
+                # attempts — caller-seeded exclusions were never tried
+                # here, and claiming they refused misleads the operator
+                attempts = sorted(tried - seeded)
+                if attempts or seeded:
                     raise NoReplicaAvailable(
                         f"no eligible {role} replica left after "
-                        f"{len(tried)} refused attempt(s) "
-                        f"(tried {sorted(tried)})"
+                        f"{len(attempts)} failed attempt(s) "
+                        f"(tried {attempts}; excluded {sorted(seeded)})"
                     ) from None
                 raise
             if trace is not None:
@@ -628,12 +689,34 @@ class RouterCore:
                     continue
                 raise NoReplicaAvailable(
                     f"all {role} dispatch attempts refused "
-                    f"(tried {sorted(tried)})"
+                    f"(tried {sorted(tried - seeded)}; "
+                    f"excluded {sorted(seeded)})"
                 ) from None
-            except ReplicaUnavailable:
+            except RequestNotSent as e:
+                # nothing downstream saw the request (the class's own
+                # contract — transport failed BEFORE the request line
+                # went out), so unlike a reply lost mid-exchange a
+                # bounded retry on ANOTHER replica can never replay
+                # anything
+                with self._lock:
+                    r.in_flight -= 1
+                    r.failures += 1
+                self._requests(r.key, "unsent").inc()
+                tried.add(r.key)
+                if attempt < self.retries:
+                    attempt += 1
+                    self._retries_ctr.inc()
+                    if trace is not None:
+                        trace.event("retry", replica=r.key,
+                                    attempt=attempt)
+                    continue
+                e.replica_key = r.key
+                raise
+            except ReplicaUnavailable as e:
                 with self._lock:
                     r.in_flight -= 1
                 self._requests(r.key, "lost").inc()
+                e.replica_key = r.key
                 raise
             dt = time.monotonic() - t0
             with self._lock:
@@ -652,7 +735,177 @@ class RouterCore:
     def _handoff_one(self, prompt: List[int], max_tokens: Optional[int],
                      deadline_abs: float, deadline_s: float,
                      trace=None) -> List[int]:
-        """One prompt's prefill -> handoff -> decode chain."""
+        """One prompt's prefill -> handoff -> decode chain, under the
+        failover ladder (docs/serving.md "Disaggregated operations"):
+
+        - the PREFILL leg is stateless (blocks free on export, nothing
+          client-visible happened), so a prefill replica lost
+          mid-exchange is simply retried on ANOTHER prefill replica —
+          handled inside :meth:`_dispatch_prefill`.  Under the direct
+          transport the lost attempt's decode leg MAY already have run;
+          the retry then duplicates bounded, deterministic decode work
+          (client-correct either way) and prefers a clean decode
+          replica for its fresh ticket.
+        - the DECODE leg is not: after ``adopt`` the row lives in one
+          replica's arena, and a request is NEVER replayed at a replica
+          that saw its bytes (the PR 10 rule).  A decode replica lost
+          after the exchange started triggers ONE bounded re-prefill
+          fallback — the whole chain re-runs through a healthy pair with
+          the dead replica excluded — when the deadline allows; an
+          honest 503 otherwise.  Greedy decode is deterministic, so a
+          fallback that succeeds is token-identical to the answer the
+          dead replica would have given."""
+        excluded: set = set()
+        fellback = False
+        while True:
+            try:
+                return self._handoff_chain(
+                    prompt, max_tokens, deadline_abs, deadline_s,
+                    trace, excluded,
+                )
+            except _DecodeDied as e:
+                if e.replica_key:
+                    excluded.add(e.replica_key)
+                remaining = deadline_abs - time.monotonic()
+                if fellback:
+                    raise ReplicaUnavailable(
+                        f"decode replica lost after adoption and the "
+                        f"re-prefill fallback also failed ({e}); not "
+                        "retried further"
+                    ) from e
+                if remaining <= 0:
+                    raise ReplicaUnavailable(
+                        f"decode replica lost after adoption ({e}); "
+                        f"deadline {deadline_s:g}s leaves no room for a "
+                        "re-prefill fallback"
+                    ) from e
+                with self._lock:
+                    any_decode = any(
+                        r.role == "decode" and r.eligible()
+                        and r.key not in excluded
+                        for r in self.replicas.values()
+                    )
+                if not any_decode:
+                    # the chain's decode pick could only 503 — don't
+                    # burn a full prefill (seconds of compute + an
+                    # arena reservation) proving it
+                    raise NoReplicaAvailable(
+                        f"decode replica lost after adoption ({e}); no "
+                        "eligible decode replica left for the "
+                        "re-prefill fallback"
+                    ) from e
+                fellback = True
+                self._failovers("decode").inc()
+                logger.warning(
+                    f"{self.name}: decode replica "
+                    f"{e.replica_key or '?'} lost after adoption; "
+                    f"re-prefill fallback through a healthy pair "
+                    f"({remaining:.1f}s left)"
+                )
+                if trace is not None:
+                    trace.event("handoff_failover", leg="decode",
+                                excluded=sorted(excluded))
+
+    def _dispatch_prefill(self, req: Dict[str, Any], deadline_abs: float,
+                          deadline_s: float, trace=None,
+                          exclude_decode: Optional[set] = None
+                          ) -> Tuple[int, bytes, str, Optional[str]]:
+        """The prefill leg: dispatch with the STATELESS retry — a
+        prefill replica lost mid-exchange never produced anything a
+        client saw (its export blocks free either way), so unlike
+        /generate and /decode the request is safely re-run on another
+        prefill replica, bounded by ``retries``.
+
+        Under the direct transport a FRESH placement ticket is issued
+        per attempt (returned as the 4th element): a retry must not
+        reuse the previous attempt's ticket — its decode replica may
+        have died or been ejected since, and its deadline budget has
+        burned down with the lost attempt.  A lost attempt's ticket is
+        also POSSIBLY DIRTY: the direct decode leg may have run before
+        the prefill replica died, leaving an orphaned adoption in that
+        decode replica's arena, so the retry prefers a different decode
+        replica when one is eligible (never at the cost of
+        availability — with only the dirty replica left, it is reused:
+        a duplicate adoption is bounded, deterministic, and client-
+        correct, unlike a 503 for a healthy pool)."""
+        lost: set = set()
+        dirty: set = set()
+        while True:
+            remaining = deadline_abs - time.monotonic()
+            if remaining <= 0:
+                raise ReplicaUnavailable(
+                    f"deadline {deadline_s:g}s exhausted during prefill"
+                )
+            req["deadline_s"] = remaining
+            ticket = None
+            if self.handoff == "direct":
+                # placement ticket: the router still makes the routing
+                # decision (it sees every decode replica's queue +
+                # arena), but the payload bytes flow prefill -> decode
+                # directly
+                try:
+                    ticket = self.pick(
+                        "decode", remaining,
+                        exclude=(set(exclude_decode or ()) | dirty)
+                        or None,
+                    )
+                except NoReplicaAvailable:
+                    if not dirty:
+                        raise
+                    ticket = self.pick("decode", remaining,
+                                       exclude=exclude_decode or None)
+                req["forward"] = {"url": ticket.url,
+                                  "deadline_s": remaining}
+                if trace is not None:
+                    trace.event("handoff_ticket", decode=ticket.key)
+            try:
+                status, payload, ctype = self.dispatch(
+                    "POST", "/prefill", json.dumps(req).encode(),
+                    role="prefill", deadline_s=remaining,
+                    headers={"Content-Type": "application/json",
+                             **admin_headers()},
+                    trace=trace, exclude=lost,
+                )
+                return (status, payload, ctype,
+                        ticket.key if ticket is not None else None)
+            except RequestNotSent:
+                # dispatch() already ran the bounded retry-on-another-
+                # replica for provably-unsent sends (the class's own
+                # contract); exhaustion there is final.  Re-looping
+                # here would multiply attempts retries-fold and count
+                # sends that never went out as mid-exchange failovers.
+                raise
+            except ReplicaUnavailable as e:
+                key = e.replica_key
+                if key is None or len(lost) >= self.retries:
+                    raise
+                lost.add(key)
+                if ticket is not None:
+                    # a mid-exchange loss leaves the ticket possibly
+                    # dirty: the direct decode leg may have run before
+                    # the prefill replica died
+                    dirty.add(ticket.key)
+                self._failovers("prefill").inc()
+                logger.warning(
+                    f"{self.name}: prefill replica {key} lost "
+                    "mid-exchange; retrying on another (stateless leg)"
+                )
+                if trace is not None:
+                    trace.event("handoff_failover", leg="prefill",
+                                replica=key)
+            finally:
+                if ticket is not None:
+                    with self._lock:
+                        ticket.in_flight -= 1
+
+    def _handoff_chain(self, prompt: List[int],
+                       max_tokens: Optional[int], deadline_abs: float,
+                       deadline_s: float, trace,
+                       exclude_decode: set) -> List[int]:
+        """One attempt of the prefill -> handoff -> decode chain.
+        Raises :class:`_DecodeDied` when the decode leg was lost after
+        bytes were exchanged (the caller decides on the re-prefill
+        fallback)."""
         remaining = deadline_abs - time.monotonic()
         if remaining <= 0:
             raise ReplicaUnavailable(
@@ -665,13 +918,56 @@ class RouterCore:
         if max_tokens is not None:
             # omitted -> the replica's configured default decides
             req["max_tokens"] = int(max_tokens)
-        status, payload, _ = self.dispatch(
-            "POST", "/prefill", json.dumps(req).encode(),
-            role="prefill", deadline_s=remaining,
-            headers={"Content-Type": "application/json"}, trace=trace,
+        status, payload, ctype, ticket_key = self._dispatch_prefill(
+            req, deadline_abs, deadline_s, trace=trace,
+            exclude_decode=exclude_decode,
         )
+        if ticket_key is not None and ctype.startswith("application/json"):
+            # the prefill replica completed (or definitively failed) the
+            # direct leg: the payload bytes never transited this process
+            try:
+                obj = json.loads(payload or b"{}")
+            except json.JSONDecodeError:
+                obj = {}
+            if status == 200 and "completion_ids" in obj:
+                dt = time.monotonic() - t0
+                self._handoff_hist.observe(dt)
+                # the router never dispatches to the ticketed decode
+                # replica under direct transport, so its deadline-aware
+                # score would otherwise run on the initial-latency
+                # floor forever: stamp the chain duration as a
+                # conservative (whole-chain) upper bound on its
+                # per-request latency
+                with self._lock:
+                    rep = self.replicas.get(ticket_key)
+                    if rep is not None:
+                        rep.last_latency_s = dt
+                if trace is not None:
+                    trace.event("handoff", direct=True)
+                return obj["completion_ids"]
+            if obj.get("handoff_leg") == "decode":
+                # the decode replica died mid-direct-exchange: the row
+                # may be adopted there — never replayed at it
+                raise _DecodeDied(
+                    ticket_key,
+                    obj.get("error", "direct decode leg lost"),
+                )
+            if status == 200:
+                # a 200 relay whose body is unparseable or carries no
+                # completion is NOT a success — relaying it verbatim
+                # would hand the client a silent wrong-200
+                raise _DownstreamError(502, json.dumps({
+                    "error": "malformed direct-transfer relay: 200 "
+                             "without completion_ids",
+                }).encode())
+            # the prefill replica's own verdict (400/429/503/...), or a
+            # decode rejection it relayed — hand it to the client
+            raise _DownstreamError(status, payload)
         if status != 200:
             raise _DownstreamError(status, payload)
+        # octet-stream: the proxy leg — either proxy mode, or a direct
+        # send that failed BEFORE any decode replica read it (refused /
+        # drop / non-200), which is safe to carry to any decode replica
         self._handoff_bytes.inc(len(payload))
         self._handoff_hist.observe(time.monotonic() - t0)
         if trace is not None:
@@ -681,12 +977,51 @@ class RouterCore:
             raise ReplicaUnavailable(
                 f"deadline {deadline_s:g}s exhausted after prefill"
             )
-        status, body, _ = self.dispatch(
-            "POST", f"/decode?deadline_s={remaining:.3f}", payload,
-            role="decode", deadline_s=remaining,
-            headers={"Content-Type": "application/octet-stream"},
-            trace=trace,
-        )
+        excludes = [set(exclude_decode or ())]
+        if ticket_key is not None and ticket_key not in excludes[0]:
+            # the ticketed replica just failed or rejected the direct
+            # send (refused / drop / 429 / 503): prefer ANY other
+            # decode replica for the proxy carry — re-offering the
+            # payload to the replica that just bounced it wastes the
+            # fallback; fall back to it only over 503ing a pool with
+            # nothing else eligible
+            excludes.insert(0, excludes[0] | {ticket_key})
+        for i, exc in enumerate(excludes):
+            try:
+                status, body, _ = self.dispatch(
+                    "POST", f"/decode?deadline_s={remaining:.3f}",
+                    payload,
+                    role="decode", deadline_s=remaining,
+                    headers={"Content-Type": "application/octet-stream",
+                             "X-Handoff-Transport": "proxy",
+                             **admin_headers()},
+                    trace=trace, exclude=exc or None,
+                )
+                break
+            except NoReplicaAvailable:
+                if i + 1 < len(excludes):
+                    continue
+                raise
+            except RequestNotSent:
+                # provably unsent (dispatch already retried other
+                # replicas): no decode replica saw the payload, so this
+                # is an honest 503 — NOT a phantom adoption worth
+                # burning the one re-prefill fallback on
+                raise
+            except ReplicaUnavailable as e:
+                if e.replica_key is None:
+                    # dispatch never completed an exchange with any
+                    # decode replica (deadline ran out between
+                    # attempts): an honest 503, not an adoption claim
+                    raise
+                # deliberate: the payload is still in hand here, but the
+                # fallback re-runs the WHOLE chain (re-prefill) instead
+                # of re-offering these bytes to another decode replica —
+                # one failover rung shared with the direct transport
+                # (where the router never holds the payload) keeps the
+                # ladder and its drill matrix uniform; the extra prefill
+                # only costs on the rare proxy-transport decode death
+                raise _DecodeDied(e.replica_key, str(e)) from e
         if status != 200:
             raise _DownstreamError(status, body)
         return json.loads(body)["completion_ids"]
@@ -865,3 +1200,14 @@ class _DownstreamError(RuntimeError):
         super().__init__(f"downstream {status}")
         self.status = int(status)
         self.body = bytes(body)
+
+
+class _DecodeDied(RuntimeError):
+    """The decode leg was lost AFTER bytes were exchanged — the payload
+    may be adopted (and decoding) in the dead replica's arena, so it is
+    NEVER replayed there.  ``_handoff_one`` answers with one bounded
+    re-prefill fallback through a healthy pair, or an honest 503."""
+
+    def __init__(self, replica_key: Optional[str], msg: str) -> None:
+        super().__init__(msg)
+        self.replica_key = replica_key

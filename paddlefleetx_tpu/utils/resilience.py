@@ -59,6 +59,19 @@ traffic drills in tests/test_serve_drills.py assert the behavior):
                        tests/test_elastic_drills.py (the supervisor must
                        stop restarting it LOUDLY within the flap budget,
                        docs/serving.md "Elastic control plane")
+  ``handoff_drop:K``   drop the Kth DIRECT prefill->decode handoff send
+                       on a prefill replica (`tools/serve.py` checks the
+                       fire and skips the POST — a network drop before
+                       any byte left).  Drives the direct-transfer
+                       retry/proxy-fallback drill in
+                       tests/test_disagg_drills.py
+  ``adopt_crash:K``    hard-exit (os._exit 29) on a decode replica at
+                       its Kth KV-handoff adoption, right after the row
+                       landed in the arena — a decode replica dying
+                       while holding adopted rows (the in-process
+                       stand-in for SIGKILL mid-handoff).  Drives the
+                       router's bounded re-prefill failover drill
+                       (docs/serving.md "Disaggregated operations")
 
 Data sites (step counts are *sample fetch* indices inside the host data
 loader — ``data/batch_sampler.py`` fires them; the data drills in
@@ -200,7 +213,7 @@ def retry(
 FAULT_SITES = (
     "sigterm", "save_crash", "ckpt_truncate", "nan_grads",
     "gen_crash", "gen_hang", "cb_step_hang", "boot_crash",
-    "corrupt_sample", "io_stall",
+    "corrupt_sample", "io_stall", "handoff_drop", "adopt_crash",
 )
 
 
@@ -309,6 +322,14 @@ def maybe_fire(site: str, step: int, path: Optional[str] = None) -> bool:
         # finally/atexit, the closest in-process stand-in for a broken
         # image — the supervisor sees a nonzero exit within seconds
         os._exit(23)
+    elif site == "adopt_crash":
+        # a decode replica dying while holding adopted rows: os._exit
+        # skips every finally/atexit — the transport sees the
+        # connection die mid-exchange, never a clean error response
+        os._exit(29)
+    # handoff_drop carries no behavior here: the prefill replica's
+    # direct-transfer send checks the fire and skips the POST itself
+    # (the drop happens before any byte leaves the process)
     elif site in ("gen_hang", "cb_step_hang"):
         time.sleep(_env_float("PFX_FAULT_HANG_S", 3600.0))
     elif site == "corrupt_sample":

@@ -104,6 +104,7 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_batch_occupancy": ("gauge", "Active rows / capacity of the continuous decode batch"),
     "pfx_kv_blocks_used": ("gauge", "Paged KV arena blocks allocated to live sequences"),
     "pfx_kv_blocks_free": ("gauge", "Paged KV arena blocks available"),
+    "pfx_kv_blocks_available": ("gauge", "Arena blocks admissible right now: free plus reclaimable cached-prefix blocks (the decode-pool scale signal)"),
     "pfx_request_evictions_total": ("counter", "Rows evicted mid-decode (deadline shed frees their blocks)"),
     "pfx_prefill_admits_total": ("counter", "Rows admitted into the running batch (prefill-on-admit)"),
     # speculative decoding + KV quantization (ops/speculative.py,
@@ -170,6 +171,8 @@ METRICS: Dict[str, Tuple[str, str]] = {
     # disaggregated KV handoff (core/continuous_batching.py replica side)
     "pfx_handoff_exports_total": ("counter", "Prefilled rows exported as KV-handoff payloads (prefill replica)"),
     "pfx_handoff_adopts_total": ("counter", "KV-handoff payloads adopted into the arena (decode replica)"),
+    "pfx_handoff_bytes_total": ("counter", "KV-handoff payload bytes through THIS replica (labels: transport=direct|proxy; prefill counts direct sends, decode counts receives)"),
+    "pfx_handoff_direct_total": ("counter", "Direct prefill->decode transfer attempts on the prefill replica (labels: outcome=ok|fallback|rejected|decode_dead)"),
     # multi-host router (core/router.py + tools/router.py; labels noted)
     "pfx_router_requests_total": ("counter", "Requests dispatched by the router (labels: replica, outcome)"),
     "pfx_router_rejected_total": ("counter", "Router admissions rejected before dispatch (labels: reason)"),
@@ -180,15 +183,16 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_router_replica_latency_seconds": ("histogram", "Downstream dispatch latency (labels: replica)"),
     "pfx_router_poll_failures_total": ("counter", "Failed replica health polls (labels: replica)"),
     "pfx_router_drains_total": ("counter", "Replica drains initiated through the router"),
-    "pfx_router_handoff_bytes_total": ("counter", "KV-handoff payload bytes moved prefill -> decode"),
-    "pfx_router_handoff_seconds": ("histogram", "Prefill dispatch + handoff transfer seconds per prompt"),
+    "pfx_router_handoff_bytes_total": ("counter", "KV-handoff payload bytes PROXIED through the router (flat under direct transfer)"),
+    "pfx_router_handoff_seconds": ("histogram", "Prefill dispatch + handoff transfer seconds per prompt (direct transport: the whole prefill->decode relay — the router cannot see the legs separately)"),
+    "pfx_handoff_failovers_total": ("counter", "Handoff legs failed over by the router (labels: leg=prefill|decode)"),
     # elastic control plane (core/controller.py + tools/router.py
     # --supervise; docs/serving.md "Elastic control plane")
-    "pfx_controller_ticks_total": ("counter", "Control-loop evaluations (one decision row each)"),
-    "pfx_controller_scale_ups_total": ("counter", "Replica scale-up decisions executed"),
-    "pfx_controller_scale_downs_total": ("counter", "Replica scale-down (rolling-drain) decisions executed"),
-    "pfx_controller_target_replicas": ("gauge", "Replica count the controller is steering toward"),
-    "pfx_controller_breach": ("gauge", "1 while the controller sees a scale signal breached (SLO burn / depth / occupancy)"),
+    "pfx_controller_ticks_total": ("counter", "Control-loop evaluations, one decision row each (labels: pool on disaggregated pool controllers; unlabeled for the monolith fleet)"),
+    "pfx_controller_scale_ups_total": ("counter", "Replica scale-up decisions executed (labels: pool on disaggregated pool controllers)"),
+    "pfx_controller_scale_downs_total": ("counter", "Replica scale-down (rolling-drain) decisions executed (labels: pool on disaggregated pool controllers)"),
+    "pfx_controller_target_replicas": ("gauge", "Replica count the controller is steering toward (labels: pool on disaggregated pool controllers)"),
+    "pfx_controller_breach": ("gauge", "1 while the controller sees a scale signal breached (SLO burn / depth / occupancy / low blocks; labels: pool on disaggregated pool controllers)"),
     "pfx_replica_restarts_total": ("counter", "Supervisor restarts of managed replicas after unexpected exits (labels: replica; only crashes spend the flap budget)"),
     "pfx_replica_quarantines_total": ("counter", "Managed replicas quarantined after crash-looping past the flap budget (labels: replica)"),
     # SLO burn rates (telemetry.SLOTracker; labels: objective, window)

@@ -472,3 +472,187 @@ def test_managed_replica_view_shape():
                        cmd=["x"])
     v = m.view()
     assert v["slot"] == 0 and v["pid"] is None and not v["quarantined"]
+
+
+# ---------------------------------------------------------------------------
+# role-aware pool supervision (docs/serving.md "Disaggregated operations")
+# ---------------------------------------------------------------------------
+
+
+def _pool_view(key, role, available_blocks=None, **kw):
+    v = _view(key, **kw)
+    v["role"] = role
+    v["available_blocks"] = available_blocks
+    return v
+
+
+def _pool_controller(core, sup, reg, role, **policy_kw):
+    policy_kw.setdefault("min_replicas", 1)
+    policy_kw.setdefault("max_replicas", 3)
+    policy_kw.setdefault("up_cooldown_s", 5.0)
+    policy_kw.setdefault("down_cooldown_s", 60.0)
+    policy_kw.setdefault("idle_s", 30.0)
+    return ElasticController(
+        core, sup, ScalePolicy(**policy_kw), role=role, registry=reg
+    )
+
+
+def test_scale_policy_validates_low_blocks():
+    with pytest.raises(ValueError, match="low_blocks"):
+        ScalePolicy(low_blocks=-1).validate()
+    ScalePolicy(low_blocks=8, use_depth=False).validate()
+
+
+def test_scale_policy_rejects_all_signals_off():
+    """With every load signal disabled, 'idle' degenerates to 'no SLO
+    breach' and a slammed pool would be drained mid-load — a
+    self-contradictory policy is a config error, loudly."""
+    with pytest.raises(ValueError, match="load signal"):
+        ScalePolicy(use_depth=False, use_occupancy=False,
+                    low_blocks=0).validate()
+    # one signal is enough on its own
+    ScalePolicy(use_depth=False, use_occupancy=False,
+                low_blocks=4).validate()
+    ScalePolicy(use_depth=True, use_occupancy=False).validate()
+
+
+def test_decode_pool_scales_on_available_blocks_not_depth():
+    """The decode pool watches arena signals: a deep queue alone never
+    scales it (use_depth=False — decode queues drain at step
+    boundaries), but a serving replica whose admissible blocks fall to
+    the watermark is pressure."""
+    reg, core = Registry(), StubCore()
+    sup = _supervisor(reg, slot_prefix="d", role="decode")
+    ctl = _pool_controller(core, sup, reg, "decode",
+                           use_depth=False, low_blocks=4)
+    # deep queue, healthy arena: hold (depth is not a decode signal)
+    core.views = [_pool_view("r0", "decode", available_blocks=64,
+                             depth=50)]
+    assert ctl.tick(now=10.0)["action"] == "hold"
+    # arena pressure: the WORST serving replica is at the watermark
+    core.views = [
+        _pool_view("r0", "decode", available_blocks=64),
+        _pool_view("r1", "decode", available_blocks=3),
+    ]
+    row = ctl.tick(now=20.0)
+    assert row["action"] == "scale_up", row
+    assert "available blocks" in row["reason"], row
+    assert row["min_blocks"] == 3 and row["pool"] == "decode"
+    # occupancy stays live as a decode signal
+    reg2, core2 = Registry(), StubCore()
+    ctl2 = _pool_controller(core2, _supervisor(reg2), reg2, "decode",
+                            use_depth=False)
+    core2.views = [_pool_view("r0", "decode", occupancy=0.95)]
+    assert ctl2.tick(now=10.0)["action"] == "scale_up"
+
+
+def test_decode_pool_block_pressure_blocks_idle_scale_down():
+    """An arena hovering just above the watermark is not 'idle': the
+    scale-down needs comfortable headroom (> 2x low_blocks)."""
+    reg, core = Registry(), StubCore()
+    sup = _supervisor(reg, slot_prefix="d", role="decode")
+    ctl = _pool_controller(core, sup, reg, "decode",
+                           use_depth=False, low_blocks=4,
+                           idle_s=5.0, down_cooldown_s=5.0)
+    ctl._register(sup.ensure(2, now=0.0))
+    ctl.target = 2
+    core.views = [
+        _pool_view("r0", "decode", available_blocks=7),
+        _pool_view("r1", "decode", available_blocks=64),
+    ]
+    for t in (10.0, 20.0, 40.0):
+        assert ctl.tick(now=t)["action"] == "hold"
+    # headroom restored: the idle dwell may finally run down
+    core.views = [
+        _pool_view("r0", "decode", available_blocks=60),
+        _pool_view("r1", "decode", available_blocks=64),
+    ]
+    ctl.tick(now=50.0)
+    assert ctl.tick(now=56.0)["action"] == "scale_down"
+
+
+def test_prefill_pool_ignores_occupancy_scales_on_depth_and_breach():
+    """The prefill pool watches queue depth + TTFT burn; occupancy is
+    meaningless there (no decode arena) and must not trip it."""
+    reg, core = Registry(), StubCore()
+    sup = _supervisor(reg, slot_prefix="p", role="prefill")
+    ctl = _pool_controller(core, sup, reg, "prefill",
+                           use_occupancy=False, high_depth=4.0)
+    core.views = [_pool_view("r0", "prefill", occupancy=1.0)]
+    assert ctl.tick(now=10.0)["action"] == "hold"
+    core.views = [_pool_view("r0", "prefill", depth=3, in_flight=2)]
+    row = ctl.tick(now=20.0)
+    assert row["action"] == "scale_up" and "depth" in row["reason"]
+    reg2, core2 = Registry(), StubCore()
+    ctl2 = _pool_controller(core2, _supervisor(reg2), reg2, "prefill",
+                            use_occupancy=False)
+    core2.views = [_pool_view("r0", "prefill", breach=True)]
+    assert ctl2.tick(now=10.0)["action"] == "scale_up"
+
+
+def test_prefill_pool_depth_can_exclude_router_inflight():
+    """count_in_flight=False (the direct-transport prefill policy):
+    router-side in-flight spans the whole prefill->decode relay there,
+    so only replica-REPORTED queue depth may trip the scale-up — five
+    slow decodes in relay are not prefill pressure."""
+    reg, core = Registry(), StubCore()
+    sup = _supervisor(reg, slot_prefix="p", role="prefill")
+    ctl = _pool_controller(core, sup, reg, "prefill",
+                           use_occupancy=False, high_depth=4.0,
+                           count_in_flight=False)
+    core.views = [_pool_view("r0", "prefill", depth=0, in_flight=5)]
+    assert ctl.tick(now=10.0)["action"] == "hold"
+    core.views = [_pool_view("r0", "prefill", depth=5, in_flight=0)]
+    assert ctl.tick(now=20.0)["action"] == "scale_up"
+
+
+def test_pool_controllers_keep_labeled_counters_and_per_pool_replay():
+    """Two pool controllers over one registry: each pool's rows replay
+    into ITS pool-labeled pfx_controller_* counters exactly (the PR 11
+    replay contract, per-pool edition), and the monolith spelling stays
+    unlabeled."""
+    reg, core = Registry(), StubCore()
+    pre = _pool_controller(
+        core, _supervisor(reg, slot_prefix="p", role="prefill"), reg,
+        "prefill", use_occupancy=False, up_cooldown_s=1.0,
+    )
+    dec = _pool_controller(
+        core, _supervisor(reg, base_port=9700, slot_prefix="d",
+                          role="decode"), reg,
+        "decode", use_depth=False, low_blocks=4, up_cooldown_s=1.0,
+    )
+    core.views = [
+        _pool_view("r0", "prefill", depth=9),
+        _pool_view("r1", "decode", available_blocks=2),
+    ]
+    pre.tick(now=10.0)   # prefill scale_up (depth)
+    dec.tick(now=10.0)   # decode scale_up (blocks)
+    core.views = [
+        _pool_view("r0", "prefill", depth=9),
+        _pool_view("r2", "prefill", state="booting"),
+        _pool_view("r1", "decode", available_blocks=50),
+        _pool_view("r3", "decode", state="booting"),
+    ]
+    pre.tick(now=11.0)   # hold: warming
+    dec.tick(now=11.0)   # hold
+    rows = list(pre.decision_log) + list(dec.decision_log)
+    for pool, ctl in (("prefill", pre), ("decode", dec)):
+        replay = replay_controller_log(rows, pool=pool)
+        assert replay["ticks"] == 2
+        assert replay["scale_ups"] == 1
+        assert reg.value("pfx_controller_ticks_total",
+                         pool=pool) == replay["ticks"]
+        assert reg.value("pfx_controller_scale_ups_total",
+                         pool=pool) == replay["scale_ups"]
+        assert reg.value("pfx_controller_target_replicas",
+                         pool=pool) == ctl.target
+    # the monolith spelling stays UNLABELED (PR 11 drill contract)
+    assert reg.value("pfx_controller_ticks_total") == 0.0
+
+
+def test_supervisor_slot_prefix_names_pool_replicas():
+    reg = Registry()
+    sup = _supervisor(reg, slot_prefix="d", role="decode")
+    sup.ensure(2, now=0.0)
+    assert [m.rid for m in sup._snapshot()] == ["d0", "d1"]
+    assert sup.views()[0]["replica_id"] == "d0"

@@ -304,9 +304,11 @@ def test_crash_loop_replica_is_quarantined_loudly(tmp_path):
 
 
 @pytest.mark.slow  # ~2 replica boots + flood (~90s warm); the router-side
-# kill/failover contract stays tier-1-drilled by test_router_drills'
-# SIGKILL phase — THIS drill adds the supervisor restart + rejoin on top
-# (still in make test-elastic / test-all)
+# kill/failover contract stays tier-1-drilled by the disaggregated
+# adopt_crash drill (tests/test_disagg_drills.py: replica death under
+# traffic -> honest 200/503, no hangs, corpse ejected) — THIS drill
+# adds the supervisor restart + rejoin on top (still in
+# make test-elastic / test-all)
 def test_sigkill_under_flood_supervisor_restarts_and_router_readmits(
         tmp_path):
     """THE supervised-failover drill: SIGKILL a managed replica under

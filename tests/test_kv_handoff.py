@@ -285,3 +285,58 @@ def test_scheduler_submit_handoff_end_to_end(server, sequential):
         assert sched.depth() == 0
     finally:
         sched.shutdown(drain=False, timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# prefix reuse on the disaggregated prefill pool (ROADMAP remainder)
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_export_prefix_reuse_computes_suffix_only(server):
+    """With ``prefix_cache_blocks`` on a prefill-pool engine, a shared
+    system prefix is computed ONCE per replica: the first export
+    publishes its prompt blocks into the radix index, the second
+    same-prefix export maps the matched span SHARED (plus a COW block
+    for the mid-block divergence) and runs only the suffix through the
+    chunk family — with the adopted decode still token-identical to the
+    cache-off path."""
+    from paddlefleetx_tpu.core.paged_cache import pack_handoff, unpack_handoff
+
+    sys_prefix = list(range(1, 35))             # 34 tokens: 2 full blocks + tail
+    p1 = sys_prefix + [40, 41, 42]              # 37 tokens
+    p2 = sys_prefix + [50, 51]                  # 36 tokens, diverges mid-block
+    ref = [server.generate_ids([p], max_dec_len=6)[0] for p in (p1, p2)]
+
+    exporter = _engine(server, prefix_cache_blocks=16)
+    decoder = _engine(server)
+
+    def handoff(p):
+        meta, arrays = exporter.prefill_export(p, 6)
+        meta2, arrays2 = unpack_handoff(pack_handoff(meta, arrays))
+        return decoder.adopt(meta2, arrays2)
+
+    t0 = exporter.stats["prefill_tokens"]
+    s1 = handoff(p1)
+    assert exporter.stats["prefill_tokens"] - t0 == len(p1)  # full compute
+    assert exporter.cache.prefix.stats["misses"] == 1
+    assert exporter.cache.prefix.cached_blocks() > 0  # published
+
+    t1 = exporter.stats["prefill_tokens"]
+    c1 = exporter.stats["prefill_chunks"]
+    s2 = handoff(p2)
+    # the shared 34-token span (2 full blocks + a 2-token COW overlap)
+    # was NOT recomputed: only the 2-token suffix ran, via the chunk fn
+    assert exporter.cache.prefix.stats["hits"] == 1
+    assert exporter.cache.prefix.stats["hit_tokens"] == 34
+    assert exporter.stats["prefill_tokens"] - t1 == len(p2) - 34
+    assert exporter.stats["prefill_chunks"] > c1
+
+    _drain(decoder)
+    got = [decoder.slots[s].tokens for s in (s1, s2)]
+    assert got == ref  # f32 exact, COW never corrupted the cached copy
+    for s in (s1, s2):
+        decoder.release(s)
+    assert decoder.cache.stats()["kv_blocks_used"] == 0
+    # the exporter's remaining allocation is exactly the cached index
+    assert (exporter.cache.stats()["kv_blocks_used"]
+            == exporter.cache.prefix.cached_blocks())

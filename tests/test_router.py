@@ -37,6 +37,7 @@ class StubReplica:
     def __init__(self, *, role="monolith", ok=True, depth=0,
                  state="ok", pid=None):
         self.hits = []
+        self.post_headers = []  # one {header: value} dict per POST
         self.fail_mode = None
         self.admin_expect = None   # token string to enforce (None = open)
         self.legacy_admin = False  # 404 /admin/* (pre-remote-drain serve)
@@ -73,6 +74,7 @@ class StubReplica:
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
                 stub.hits.append((self.path, body))
+                stub.post_headers.append(dict(self.headers.items()))
                 if self.path.startswith("/admin/"):
                     if stub.legacy_admin:
                         return self._json(404, {"error": "unknown path"})
@@ -279,7 +281,7 @@ def test_dispatch_retries_refused_on_another_replica(stub):
 def test_dispatch_refused_everywhere_raises(stub):
     core = RouterCore([(_dead_url(), "monolith")], retries=2)
     core.replicas["r0"].state, core.replicas["r0"].healthy = "serving", True
-    with pytest.raises(NoReplicaAvailable, match="refused"):
+    with pytest.raises(NoReplicaAvailable, match="failed attempt"):
         core.dispatch("POST", "/generate", b"{}", role="monolith",
                       deadline_s=10)
 
@@ -595,6 +597,35 @@ def test_pool_configuration_is_validated():
     assert not RouterCore([("http://x:1", "monolith")]).disaggregated
 
 
+def test_pool_port_ranges_must_not_overlap(capsys):
+    """Overlapping slot port ranges (or a router --port inside one)
+    are a config error at argparse time — NOT a bind-failure crash
+    loop that burns the flap budget into a misleading quarantine."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "router_cli_under_test", os.path.join(repo, "tools", "router.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+
+    base = ["--port", "9000", "--supervise",
+            "--prefill-cmd", "serve {port} {replica_id}",
+            "--decode-cmd", "serve {port} {replica_id}"]
+    # prefill slots 8300..8303 swallow the decode base port
+    with pytest.raises(SystemExit):
+        cli.main(base + ["--prefill-base-port", "8300",
+                         "--max-prefill", "4",
+                         "--decode-base-port", "8301"])
+    assert "overlap" in capsys.readouterr().err
+    # the router's own listen port inside the decode range
+    with pytest.raises(SystemExit):
+        cli.main(base + ["--prefill-base-port", "8200",
+                         "--decode-base-port", "8990",
+                         "--max-decode", "16"])
+    assert "falls inside" in capsys.readouterr().err
+
+
 # ---------------------------------------------------------------------------
 # ejected-replica rejoin (the named lifecycle edge)
 # ---------------------------------------------------------------------------
@@ -703,3 +734,403 @@ def test_poll_reads_occupancy_and_slo_breach(stub):
     del stub.health["occupancy"], stub.health["slo"]
     core.poll_replica(r)
     assert r.occupancy == 0.0 and not r.slo_breach
+
+
+# ---------------------------------------------------------------------------
+# disaggregated fabric: decode-aware scoring, handoff failover, direct
+# prefill->decode transfer (docs/serving.md "Disaggregated operations")
+# ---------------------------------------------------------------------------
+
+
+def _ctr(name, **labels):
+    from paddlefleetx_tpu.utils.telemetry import get_registry
+
+    return get_registry().value(name, **labels)
+
+
+def _all_serving(core):
+    for r in core.replicas.values():
+        r.state, r.healthy = "serving", True
+
+
+def test_handoff_transport_validated():
+    with pytest.raises(ValueError, match="handoff"):
+        RouterCore([("http://x:1", "monolith")], handoff="carrier-pigeon")
+
+
+def test_add_replica_learns_pool_topology():
+    """A pool-supervised router boots EMPTY and learns disaggregation
+    from the registrations; mixing stays rejected dynamically."""
+    core = RouterCore([], allow_empty=True)
+    assert not core.disaggregated
+    core.add_replica("http://127.0.0.1:7997", "prefill")
+    core.add_replica("http://127.0.0.1:7998", "decode")
+    assert core.disaggregated
+    with pytest.raises(ValueError, match="mixing"):
+        core.add_replica("http://127.0.0.1:7999", "monolith")
+
+
+def test_decode_score_folds_arena_pressure():
+    """Decode replicas are no longer scored by queue depth alone: at
+    equal depth the emptier arena wins, and an arena with NO admissible
+    blocks goes near last resort — it would bounce the adoption."""
+    pre = StubReplica(role="prefill")
+    d1, d2 = StubReplica(role="decode"), StubReplica(role="decode")
+    core = RouterCore([(pre.url, "prefill"), (d1.url, "decode"),
+                       (d2.url, "decode")])
+    try:
+        _all_serving(core)
+        r1, r2 = core.replicas["r1"], core.replicas["r2"]
+        r1.depth = r2.depth = 1
+        r1.occupancy, r1.available_blocks = 0.95, 2
+        r2.occupancy, r2.available_blocks = 0.10, 60
+        assert core.pick("decode", remaining_s=60).key == "r2"
+        r2.in_flight = 0
+        # full arena: even a deeper queue with room beats it
+        r1.depth, r1.occupancy, r1.available_blocks = 0, 0.5, 0
+        r2.depth, r2.occupancy, r2.available_blocks = 3, 0.5, 40
+        assert core.pick("decode", remaining_s=60).key == "r2"
+    finally:
+        pre.stop(), d1.stop(), d2.stop()
+
+
+def test_prefill_lost_mid_exchange_fails_over_stateless():
+    """The prefill leg is stateless (blocks free on export): a prefill
+    replica lost MID-exchange is retried on another — unlike /generate,
+    where a partial exchange is never replayed."""
+    bad, good = StubReplica(role="prefill"), StubReplica(role="prefill")
+    dec = StubReplica(role="decode")
+    bad.fail_mode = "reset"
+    core = RouterCore([(bad.url, "prefill"), (good.url, "prefill"),
+                       (dec.url, "decode")])
+    try:
+        _all_serving(core)
+        core.replicas["r1"].depth = 9  # the doomed replica picked first
+        f0 = _ctr("pfx_handoff_failovers_total", leg="prefill")
+        out = core.generate_disaggregated([[1, 2, 3]], 4, 30.0)
+        assert out == [[7, 8, 9]]
+        assert len(bad.hits) == 1 and len(good.hits) == 1
+        assert _ctr("pfx_handoff_failovers_total", leg="prefill") == f0 + 1
+    finally:
+        bad.stop(), good.stop(), dec.stop()
+
+
+def test_prefill_unsent_exhaustion_is_final_not_a_failover(monkeypatch):
+    """RequestNotSent exhaustion inside dispatch() is FINAL: dispatch
+    already ran the bounded retry-on-another-replica for provably-
+    unsent sends, so the prefill failover ladder must not re-loop it
+    (attempt multiplication) nor count sends that never went out as
+    mid-exchange failovers."""
+    from paddlefleetx_tpu.core import router as router_mod
+    from paddlefleetx_tpu.core.router import RequestNotSent
+
+    pre1, pre2 = StubReplica(role="prefill"), StubReplica(role="prefill")
+    dec = StubReplica(role="decode")
+    core = RouterCore([(pre1.url, "prefill"), (pre2.url, "prefill"),
+                       (dec.url, "decode")], retries=1)
+    sends = []
+    real = router_mod._http_request
+
+    def flaky(url, method, path, **kw):
+        if path.startswith("/prefill"):
+            sends.append(url)
+            raise RequestNotSent("send failed: injected")
+        return real(url, method, path, **kw)
+
+    monkeypatch.setattr(router_mod, "_http_request", flaky)
+    try:
+        _all_serving(core)
+        f0 = _ctr("pfx_handoff_failovers_total", leg="prefill")
+        with pytest.raises(RequestNotSent):
+            core.generate_disaggregated([[1, 2, 3]], 4, 30.0)
+        # dispatch's own bounded retry only: retries + 1 attempts total
+        assert len(sends) == 2, sends
+        assert _ctr("pfx_handoff_failovers_total", leg="prefill") == f0
+    finally:
+        pre1.stop(), pre2.stop(), dec.stop()
+
+
+def test_decode_death_triggers_bounded_reprefill_fallback():
+    """A decode replica lost after the exchange started is NEVER
+    replayed at (the PR 10 rule) — the whole chain re-runs ONCE through
+    a healthy pair with the corpse excluded."""
+    pre = StubReplica(role="prefill")
+    bad, good = StubReplica(role="decode"), StubReplica(role="decode")
+    bad.fail_mode = "reset"
+    core = RouterCore([(pre.url, "prefill"), (bad.url, "decode"),
+                       (good.url, "decode")])
+    try:
+        _all_serving(core)
+        core.replicas["r2"].depth = 9  # the doomed decode picked first
+        f0 = _ctr("pfx_handoff_failovers_total", leg="decode")
+        out = core.generate_disaggregated([[1, 2, 3]], 4, 30.0)
+        assert out == [[7, 8, 9]]
+        # the chain re-ran end to end: prefill served twice, the corpse
+        # saw exactly ONE /decode (no replay), the survivor one
+        assert len(pre.hits) == 2
+        assert len(bad.hits) == 1 and len(good.hits) == 1
+        assert _ctr("pfx_handoff_failovers_total", leg="decode") == f0 + 1
+    finally:
+        pre.stop(), bad.stop(), good.stop()
+
+
+def test_decode_death_fallback_exhaustion_is_honest_503():
+    """With no healthy decode replica left for the fallback, the chain
+    ends in an honest NoReplicaAvailable (HTTP 503) — the corpse saw
+    exactly one exchange, and NO second prefill is burned proving the
+    doomed decode pick (the eligibility pre-check fires first)."""
+    pre = StubReplica(role="prefill")
+    bad = StubReplica(role="decode")
+    bad.fail_mode = "reset"
+    core = RouterCore([(pre.url, "prefill"), (bad.url, "decode")])
+    try:
+        _all_serving(core)
+        with pytest.raises(NoReplicaAvailable):
+            core.generate_disaggregated([[1, 2, 3]], 4, 30.0)
+        decode_hits = [h for h in bad.hits if h[0].startswith("/decode")]
+        assert len(decode_hits) == 1
+        prefill_hits = [h for h in pre.hits
+                        if h[0].startswith("/prefill")]
+        assert len(prefill_hits) == 1
+    finally:
+        pre.stop(), bad.stop()
+
+
+class DirectPrefillStub:
+    """A prefill replica that understands the direct-transfer placement
+    ticket: on /prefill with a ``forward`` ticket it POSTs a payload
+    STRAIGHT to the decode url and relays the JSON completion.
+    ``script`` overrides responses per call: ``"fallback"`` returns the
+    payload octet-stream (a direct send that degraded to the proxy
+    leg), ``"decode_dead"`` reports a mid-exchange decode loss the way
+    tools/serve.py does (structured 502 naming the leg)."""
+
+    PAYLOAD = b"PFXH1-STUB-PAYLOAD"
+
+    def __init__(self):
+        self.hits = []
+        self.script = []
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body, ctype, headers=None):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                body = json.dumps({
+                    "ok": True, "state": "ok", "queue_depth": 0,
+                    "busy_s": 0.0,
+                    "identity": {"replica_id": "dp0", "role": "prefill",
+                                 "scheduler": "queue", "listen": "stub",
+                                 "pid": os.getpid()},
+                }).encode()
+                return self._send(200, body, "application/json")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                stub.hits.append(req)
+                mode = stub.script.pop(0) if stub.script else "direct"
+                if mode == "decode_dead":
+                    return self._send(502, json.dumps({
+                        "error": "injected decode death",
+                        "handoff_leg": "decode",
+                    }).encode(), "application/json")
+                if mode == "fallback":
+                    return self._send(
+                        200, stub.PAYLOAD, "application/octet-stream",
+                        {"X-Direct-Error": "injected drop"},
+                    )
+                if mode == "garbage":
+                    # a 200 relay that carries no completion (truncated
+                    # or corrupted body)
+                    return self._send(200, b"not json",
+                                      "application/json")
+                fwd = req.get("forward")
+                assert fwd, "direct mode request carried no ticket"
+                import http.client as hc
+                from urllib.parse import urlsplit
+                u = urlsplit(fwd["url"])
+                conn = hc.HTTPConnection(u.hostname, u.port, timeout=10)
+                conn.request(
+                    "POST", "/decode?deadline_s=5", body=stub.PAYLOAD,
+                    headers={
+                        "Content-Type": "application/octet-stream",
+                        "X-Handoff-Transport": "direct",
+                    },
+                )
+                resp = conn.getresponse()
+                data = resp.read()
+                conn.close()
+                return self._send(resp.status, data, "application/json")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_direct_handoff_bytes_bypass_router():
+    """Direct transfer: the payload flows prefill -> decode while the
+    router's handoff byte counter stays FLAT (the acceptance evidence),
+    and the placement ticket's reservation is released."""
+    pre, dec = DirectPrefillStub(), StubReplica(role="decode")
+    core = RouterCore([(pre.url, "prefill"), (dec.url, "decode")],
+                      handoff="direct")
+    try:
+        _all_serving(core)
+        core.replicas["r1"].last_latency_s = 0.0
+        b0 = _ctr("pfx_router_handoff_bytes_total")
+        out = core.generate_disaggregated([[1, 2, 3]], 4, 30.0)
+        assert out == [[7, 8, 9]]
+        assert pre.hits[0]["forward"]["url"] == dec.url
+        path, body = dec.hits[0]
+        assert path.startswith("/decode") and body == pre.PAYLOAD
+        assert dec.post_headers[0].get("X-Handoff-Transport") == "direct"
+        assert _ctr("pfx_router_handoff_bytes_total") == b0
+        assert core.replicas["r1"].in_flight == 0
+        # the ticketed replica is never dispatched to under direct
+        # transport: the chain stamps its latency so deadline-aware
+        # scoring doesn't run on the initial floor forever
+        assert core.replicas["r1"].last_latency_s > 0.0
+    finally:
+        pre.stop(), dec.stop()
+
+
+def test_direct_malformed_200_relay_is_honest_502():
+    """A direct-transport 200 relay whose body is unparseable (or has
+    no completion_ids) must surface as a loud 502, never a silent
+    wrong-success 200."""
+    from paddlefleetx_tpu.core.router import _DownstreamError
+
+    pre, dec = DirectPrefillStub(), StubReplica(role="decode")
+    pre.script = ["garbage"]
+    core = RouterCore([(pre.url, "prefill"), (dec.url, "decode")],
+                      handoff="direct")
+    try:
+        _all_serving(core)
+        with pytest.raises(_DownstreamError) as ei:
+            core.generate_disaggregated([[1, 2, 3]], 4, 30.0)
+        assert ei.value.status == 502
+        assert b"completion_ids" in ei.value.body
+    finally:
+        pre.stop(), dec.stop()
+
+
+def test_direct_handoff_degrades_to_proxy_leg():
+    """A direct send that failed before the decode replica read it
+    returns the payload to the router, which carries it itself — the
+    drilled proxy fallback."""
+    pre, dec = DirectPrefillStub(), StubReplica(role="decode")
+    pre.script = ["fallback"]
+    core = RouterCore([(pre.url, "prefill"), (dec.url, "decode")],
+                      handoff="direct")
+    try:
+        _all_serving(core)
+        b0 = _ctr("pfx_router_handoff_bytes_total")
+        out = core.generate_disaggregated([[1, 2, 3]], 4, 30.0)
+        assert out == [[7, 8, 9]]
+        assert _ctr("pfx_router_handoff_bytes_total") == b0 + len(
+            pre.PAYLOAD
+        )
+        assert dec.post_headers[0].get("X-Handoff-Transport") == "proxy"
+    finally:
+        pre.stop(), dec.stop()
+
+
+def test_direct_decode_death_report_runs_reprefill_failover():
+    """The prefill replica's structured decode-death report triggers
+    the same bounded re-prefill fallback as a proxy-leg loss — the
+    second attempt's ticket excludes the dead replica."""
+    pre = DirectPrefillStub()
+    pre.script = ["decode_dead"]
+    d1, d2 = StubReplica(role="decode"), StubReplica(role="decode")
+    core = RouterCore([(pre.url, "prefill"), (d1.url, "decode"),
+                       (d2.url, "decode")], handoff="direct")
+    try:
+        _all_serving(core)
+        core.replicas["r2"].depth = 9  # d1 gets the first ticket
+        f0 = _ctr("pfx_handoff_failovers_total", leg="decode")
+        out = core.generate_disaggregated([[1, 2, 3]], 4, 30.0)
+        assert out == [[7, 8, 9]]
+        assert _ctr("pfx_handoff_failovers_total", leg="decode") == f0 + 1
+        assert len(pre.hits) == 2
+        assert pre.hits[0]["forward"]["url"] == d1.url
+        assert pre.hits[1]["forward"]["url"] == d2.url
+        # the "dead" replica never saw a byte; the survivor saw one
+        assert d1.hits == [] and len(d2.hits) == 1
+    finally:
+        pre.stop(), d1.stop(), d2.stop()
+
+
+def test_prefill_retry_reissues_ticket_preferring_clean_decode():
+    """A prefill replica lost mid-exchange may have already run its
+    direct decode leg, so the retry's FRESH ticket prefers a decode
+    replica the lost attempt was not pointed at — but never at the
+    cost of availability: with only the dirty replica left, it is
+    reused."""
+    bad, good = StubReplica(role="prefill"), DirectPrefillStub()
+    bad.fail_mode = "reset"
+    d1, d2 = StubReplica(role="decode"), StubReplica(role="decode")
+    core = RouterCore([(bad.url, "prefill"), (good.url, "prefill"),
+                       (d1.url, "decode"), (d2.url, "decode")],
+                      handoff="direct")
+    try:
+        _all_serving(core)
+        core.replicas["r1"].depth = 9  # the doomed prefill picked first
+        core.replicas["r3"].depth = 9  # d1 preferred for tickets
+        out = core.generate_disaggregated([[1, 2, 3]], 4, 30.0)
+        assert out == [[7, 8, 9]]
+        # the retry went out with a ticket for the CLEAN replica d2,
+        # even though d1 scores better
+        assert good.hits[0]["forward"]["url"] == d2.url
+        assert len(d2.hits) == 1 and d1.hits == []
+        # every ticket reservation was released
+        assert core.replicas["r2"].in_flight == 0
+        assert core.replicas["r3"].in_flight == 0
+    finally:
+        bad.stop(), good.stop(), d1.stop(), d2.stop()
+
+    # single-decode pool: the dirty replica is reused rather than 503ing
+    bad, good = StubReplica(role="prefill"), DirectPrefillStub()
+    bad.fail_mode = "reset"
+    d1 = StubReplica(role="decode")
+    core = RouterCore([(bad.url, "prefill"), (good.url, "prefill"),
+                       (d1.url, "decode")], handoff="direct")
+    try:
+        _all_serving(core)
+        core.replicas["r1"].depth = 9
+        out = core.generate_disaggregated([[1, 2, 3]], 4, 30.0)
+        assert out == [[7, 8, 9]]
+        assert good.hits[0]["forward"]["url"] == d1.url
+    finally:
+        bad.stop(), good.stop(), d1.stop()
+
+
+def test_poll_reads_available_blocks(stub):
+    """The decode-pool scale/routing signal rides the existing poll."""
+    stub.health["available_blocks"] = 17
+    core = RouterCore([(stub.url, "monolith")])
+    r = core.replicas["r0"]
+    core.poll_replica(r)
+    assert r.available_blocks == 17
+    assert core.replica_views()[0]["available_blocks"] == 17
+    del stub.health["available_blocks"]
+    core.poll_replica(r)
+    assert r.available_blocks is None

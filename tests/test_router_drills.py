@@ -171,6 +171,14 @@ def _finish(proc, timeout=30):
     return proc.stdout.read()
 
 
+@pytest.mark.slow  # ~55s warm: redundant tier-1 coverage funding the
+# PR 13 disaggregated drills (still in make test-router/test-all).
+# Replacement coverage: the drain contract stays tier-1-drilled by the
+# elastic authenticated-remote-drain drill + the router drain units;
+# SIGKILL-death failover through the real CLIs stays tier-1 via
+# tests/test_disagg_drills.py (adopt_crash decode death + honest
+# 200/503 accounting); never-retry-partial stays unit-proven in
+# tests/test_router.py.
 def test_rolling_drain_then_replica_kill_under_flood(tmp_path):
     """THE multi-host acceptance drill, one 3-replica topology, two
     phases:
@@ -352,6 +360,11 @@ def test_disaggregated_prefill_decode_parity_via_router(tmp_path):
             rport,
             "--prefill", f"http://127.0.0.1:{pre_p}",
             "--decode", f"http://127.0.0.1:{dec_p}",
+            # the PROXY transport is this drill's subject (the direct
+            # topology has its own drill in tests/test_disagg_drills.py;
+            # proxy stays the drilled fallback a failed direct send
+            # degrades to, and its byte accounting is asserted below)
+            "--handoff", "proxy",
         )
         h = _wait_eligible(rport, 2)
         assert h["mode"] == "disaggregated", h

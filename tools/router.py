@@ -7,14 +7,25 @@ Two topologies (docs/serving.md "Multi-host serving"):
 
   replicated     N monolith replicas; each POST /generate is forwarded
                  to the least-loaded serving replica (bounded retry on
-                 connection-refused ONLY — a partial exchange returns an
-                 honest 503, never a replay).
+                 connection-refused and provably-unsent sends only — a
+                 partial exchange returns an honest 503, never a
+                 replay).
   disaggregated  separate --prefill and --decode pools: the router runs
-                 each prompt's prefill on a prefill replica, carries the
-                 KV-handoff payload to a decode replica, and returns the
-                 continued decode — long prompts stop head-of-line-
+                 each prompt's prefill on a prefill replica and a decode
+                 replica continues it — long prompts stop head-of-line-
                  blocking decode steps (greedy output token-identical to
-                 the single-process continuous path; drilled).
+                 the single-process continuous path; drilled).  Under
+                 ``--handoff direct`` (default) the router issues a
+                 placement ticket and the prefill replica POSTs the
+                 KV-handoff payload STRAIGHT to the chosen decode
+                 replica — payload bytes never transit the router;
+                 ``--handoff proxy`` carries them through the router
+                 (the drilled fallback).  Failover ladder: a prefill
+                 replica lost mid-exchange is retried on another
+                 (stateless); a decode replica lost after adoption
+                 triggers ONE re-prefill fallback through a healthy
+                 pair when the deadline allows, an honest 503 otherwise
+                 — never a replay at a replica that saw the bytes.
 
 The router owns front-door admission (bounded in-flight -> 429,
 draining -> 503, deadline checked before every dispatch) and mirrors
@@ -42,6 +53,14 @@ Usage:
       --replica-cmd "python tools/serve.py -c cfg.yaml --port {port} \
                      --replica-id {replica_id}" \
       --min-replicas 1 --max-replicas 4 --base-port 8101
+  # supervised DISAGGREGATED pools (role-aware: prefill scales on
+  # depth/TTFT burn, decode on arena occupancy/available_blocks)
+  python tools/router.py --port 9000 --supervise \
+      --prefill-cmd "python tools/serve.py -c cfg.yaml --role prefill \
+                     --port {port} --replica-id {replica_id}" \
+      --decode-cmd "python tools/serve.py -c cfg.yaml --role decode \
+                    --port {port} --replica-id {replica_id}" \
+      --min-prefill 1 --max-prefill 4 --min-decode 1 --max-decode 4
   # rolling deploy, one replica at a time (requires the router up):
   python tools/router.py drain --admin http://127.0.0.1:9000 [--replica-id r0]
 
@@ -99,6 +118,7 @@ def serve_router(args) -> int:
     replicas = [(u, "monolith") for u in args.replica]
     replicas += [(u, "prefill") for u in args.prefill]
     replicas += [(u, "decode") for u in args.decode]
+    pool_supervise = bool(args.supervise and args.prefill_cmd)
     core = RouterCore(
         replicas,
         max_inflight=args.max_inflight,
@@ -107,33 +127,77 @@ def serve_router(args) -> int:
         eject_after=args.eject_after,
         serve_after=args.serve_after,
         allow_empty=args.supervise,
+        handoff=args.handoff,
     )
-    controller = None
-    if args.supervise:
+    if pool_supervise:
+        # the supervised pools register as they spawn; pin the topology
+        # now so the first /generate routes disaggregated (add_replica
+        # keeps it consistent from then on)
+        core.disaggregated = True
+    log_dir = args.replica_log_dir or os.path.join(flight_dir(), "replicas")
+    shared_policy = dict(
+        high_depth=args.scale_high_depth,
+        low_depth=args.scale_low_depth,
+        up_cooldown_s=args.scale_up_cooldown,
+        down_cooldown_s=args.scale_down_cooldown,
+        idle_s=args.scale_idle,
+        interval_s=args.control_interval,
+    )
+    shared_sup = dict(
+        compile_cache_dir=args.compile_cache_dir,
+        log_dir=log_dir,
+        backoff_base_s=args.restart_backoff,
+        flap_budget=args.flap_budget,
+        flap_window_s=args.flap_window,
+    )
+    controllers = []
+    if pool_supervise:
+        # role-aware pool supervision (docs/serving.md "Disaggregated
+        # operations"): one supervisor + controller per pool, each on
+        # its own port range and replica-id prefix, with pool-specific
+        # scale signals — prefill watches queue depth + TTFT burn (its
+        # replicas hold no decode arena), decode watches arena
+        # occupancy + available_blocks (its queue drains at step
+        # boundaries; the arena is what actually bounces adoptions)
+        specs = (
+            ("prefill", args.prefill_cmd, args.prefill_base_port,
+             args.min_prefill, args.max_prefill, "p",
+             # under the direct transport a prefill dispatch stays
+             # in-flight through the whole prefill->decode relay, so
+             # router-side in-flight would scale the prefill pool on
+             # DECODE duration — count replica-reported queue depth only
+             dict(use_occupancy=False,
+                  count_in_flight=args.handoff != "direct")),
+            ("decode", args.decode_cmd, args.decode_base_port,
+             args.min_decode, args.max_decode, "d",
+             dict(use_depth=False, low_blocks=args.decode_low_blocks)),
+        )
+        for role, cmd, base_port, mn, mx, prefix, signals in specs:
+            supervisor = ReplicaSupervisor(
+                cmd, base_port=base_port, max_replicas=mx, role=role,
+                slot_prefix=prefix, **shared_sup,
+            )
+            controllers.append(ElasticController(
+                core, supervisor,
+                ScalePolicy(min_replicas=mn, max_replicas=mx,
+                            **shared_policy, **signals),
+                role=role,
+            ))
+    elif args.supervise:
         supervisor = ReplicaSupervisor(
             args.replica_cmd,
             base_port=args.base_port,
             max_replicas=args.max_replicas,
-            compile_cache_dir=args.compile_cache_dir,
-            log_dir=args.replica_log_dir
-            or os.path.join(flight_dir(), "replicas"),
-            backoff_base_s=args.restart_backoff,
-            flap_budget=args.flap_budget,
-            flap_window_s=args.flap_window,
+            **shared_sup,
         )
-        controller = ElasticController(
+        controllers.append(ElasticController(
             core, supervisor,
             ScalePolicy(
                 min_replicas=args.min_replicas,
                 max_replicas=args.max_replicas,
-                high_depth=args.scale_high_depth,
-                low_depth=args.scale_low_depth,
-                up_cooldown_s=args.scale_up_cooldown,
-                down_cooldown_s=args.scale_down_cooldown,
-                idle_s=args.scale_idle,
-                interval_s=args.control_interval,
+                **shared_policy,
             ),
-        )
+        ))
     reg = get_registry()
     recorder = get_flight_recorder()
     recorder.install_excepthook()
@@ -198,13 +262,23 @@ def serve_router(args) -> int:
                         1 for v in core.replica_views() if v["eligible"]
                     ),
                 }
-                if controller is not None:
+                if len(controllers) == 1:
+                    c = controllers[0]
                     body["controller"] = {
-                        "target": controller.target,
-                        "quarantined":
-                            controller.supervisor.quarantined_count(),
-                        "decisions": len(controller.decision_log),
+                        "target": c.target,
+                        "quarantined": c.supervisor.quarantined_count(),
+                        "decisions": len(c.decision_log),
                     }
+                elif controllers:
+                    body["controller"] = {"pools": {
+                        c.role: {
+                            "target": c.target,
+                            "quarantined":
+                                c.supervisor.quarantined_count(),
+                            "decisions": len(c.decision_log),
+                        }
+                        for c in controllers
+                    }}
                 return self._json(200, body)
             if self.path == "/metrics":
                 return self._send(
@@ -221,11 +295,15 @@ def serve_router(args) -> int:
                         200, chrome_trace(trace_buffer.traces())
                     )
                 if self.path == "/debug/controller":
-                    if controller is None:
+                    if not controllers:
                         return self._json(404, {
                             "error": "no controller: run with --supervise"
                         })
-                    return self._json(200, controller.view())
+                    if len(controllers) == 1:
+                        return self._json(200, controllers[0].view())
+                    return self._json(200, {"pools": {
+                        c.role: c.view() for c in controllers
+                    }})
                 return self._json(404, {"error": "unknown debug path"})
             return self._json(404, {"error": "unknown path"})
 
@@ -397,20 +475,30 @@ def serve_router(args) -> int:
         orig_handlers[sig] = signal.signal(sig, _on_signal)
 
     core.start()
-    if controller is not None:
+    for ctl in controllers:
         # spawn min_replicas (registered with the core as they come up)
-        # and start the control loop; the poller walks each replica
+        # and start each control loop; the poller walks each replica
         # booting -> warm -> serving as it answers /healthz
-        controller.start()
+        ctl.start()
     mode = identity["scheduler"]
+    supervising = ""
+    if pool_supervise:
+        supervising = (
+            f"; supervising prefill {args.min_prefill}.."
+            f"{args.max_prefill} from port {args.prefill_base_port}, "
+            f"decode {args.min_decode}..{args.max_decode} from port "
+            f"{args.decode_base_port}"
+        )
+    elif controllers:
+        supervising = (
+            f"; supervising {args.min_replicas}..{args.max_replicas} "
+            f"replicas from port {args.base_port}"
+        )
     print(
         f"router on {args.host}:{args.port} ({mode}; "
         f"{len(core.replicas)} replica(s), max in-flight "
-        f"{args.max_inflight}, retries {args.retries}"
-        + (f"; supervising {args.min_replicas}..{args.max_replicas} "
-           f"replicas from port {args.base_port}"
-           if controller is not None else "")
-        + ")",
+        f"{args.max_inflight}, retries {args.retries}, "
+        f"handoff {args.handoff}" + supervising + ")",
         flush=True,
     )
     def _force_quit(where):
@@ -421,8 +509,8 @@ def serve_router(args) -> int:
         print(f"force-quit on second interrupt ({where})", flush=True)
         recorder.record({"event": "force_quit"})
         recorder.dump(reason="force_quit")
-        if controller is not None:
-            controller.supervisor.kill_all()
+        for ctl in controllers:
+            ctl.supervisor.kill_all()
         os._exit(130)
 
     try:
@@ -431,14 +519,15 @@ def serve_router(args) -> int:
         _force_quit("serving")
     finally:
         try:
-            if controller is not None:
+            for ctl in controllers:
                 # stop scaling first, then drain the children
                 # gracefully: each managed replica gets SIGTERM,
                 # answers its admitted work, exits 0 (the PR 3
                 # contract) — the router never leaves orphans behind a
                 # clean shutdown
-                controller.stop()
-                controller.supervisor.stop_all()
+                ctl.stop()
+            for ctl in controllers:
+                ctl.supervisor.stop_all()
             core.stop()
             httpd.server_close()
         except KeyboardInterrupt:
@@ -547,6 +636,15 @@ def main(argv=None):
     ap.add_argument("--retries", type=int, default=2,
                     help="max retries on ANOTHER replica after "
                     "connection-refused (partial responses never retry)")
+    ap.add_argument("--handoff", choices=("direct", "proxy"),
+                    default="direct",
+                    help="disaggregated KV-handoff transport: 'direct' "
+                    "(default) issues a placement ticket and the "
+                    "prefill replica POSTs the payload straight to the "
+                    "chosen decode replica — handoff bytes never "
+                    "transit the router; 'proxy' carries the payload "
+                    "through the router (the drilled fallback a failed "
+                    "direct send degrades to)")
     ap.add_argument("--deadline", type=float, default=120.0,
                     help="default per-request routing deadline seconds")
     ap.add_argument("--max-deadline", type=float, default=600.0,
@@ -610,6 +708,33 @@ def main(argv=None):
     ap.add_argument("--restart-backoff", type=float, default=0.5,
                     help="supervise: base seconds of the exponential "
                     "crash-restart backoff")
+    # ---- disaggregated pool supervision (--supervise with pool cmds;
+    # docs/serving.md "Disaggregated operations") ----
+    ap.add_argument("--prefill-cmd", default="",
+                    help="supervise the PREFILL pool: serve.py command "
+                    "template with {port}/{replica_id} placeholders "
+                    "(must include --role prefill); requires "
+                    "--decode-cmd too")
+    ap.add_argument("--decode-cmd", default="",
+                    help="supervise the DECODE pool: serve.py command "
+                    "template (must include --role decode)")
+    ap.add_argument("--min-prefill", type=int, default=1,
+                    help="prefill-pool replica floor")
+    ap.add_argument("--max-prefill", type=int, default=4,
+                    help="prefill-pool replica ceiling")
+    ap.add_argument("--min-decode", type=int, default=1,
+                    help="decode-pool replica floor")
+    ap.add_argument("--max-decode", type=int, default=4,
+                    help="decode-pool replica ceiling")
+    ap.add_argument("--prefill-base-port", type=int, default=8201,
+                    help="prefill slot i listens on this + i")
+    ap.add_argument("--decode-base-port", type=int, default=8301,
+                    help="decode slot i listens on this + i")
+    ap.add_argument("--decode-low-blocks", type=int, default=0,
+                    help="decode-pool scale-up watermark: any serving "
+                    "decode replica reporting available_blocks at or "
+                    "below this is arena pressure (0 = occupancy/"
+                    "breach signals only)")
     ap.add_argument("--router-id", default="",
                     help="identity for this router's /healthz block")
     ap.add_argument("--admin", default="http://127.0.0.1:9000",
@@ -627,15 +752,48 @@ def main(argv=None):
     if not args.port:
         ap.error("serve mode requires --port")
     if args.supervise:
-        if not args.replica_cmd:
-            ap.error("--supervise requires --replica-cmd (a serve.py "
-                     "command template with {port})")
-        if args.prefill or args.decode:
-            ap.error("--supervise manages monolith replicas only; "
-                     "disaggregated pools are static for now")
+        if bool(args.prefill_cmd) != bool(args.decode_cmd):
+            ap.error("disaggregated pool supervision needs BOTH "
+                     "--prefill-cmd and --decode-cmd")
+        if args.prefill_cmd and args.replica_cmd:
+            ap.error("--replica-cmd (monolith fleet) and --prefill-cmd/"
+                     "--decode-cmd (pool fleet) are mutually exclusive")
+        if not (args.replica_cmd or args.prefill_cmd):
+            ap.error("--supervise requires --replica-cmd (monolith "
+                     "fleet) or --prefill-cmd + --decode-cmd "
+                     "(disaggregated pools), each a serve.py command "
+                     "template with {port}")
+        if args.replica or args.prefill or args.decode:
+            ap.error("--supervise manages its own replicas; static "
+                     "--replica/--prefill/--decode URLs are exclusive "
+                     "with it")
+        if args.prefill_cmd:
+            # overlapping slot port ranges would surface as bind-failure
+            # crash loops and a misleading flap-budget quarantine — make
+            # the misconfiguration a config error instead
+            pools = [
+                ("prefill", args.prefill_base_port, args.max_prefill),
+                ("decode", args.decode_base_port, args.max_decode),
+            ]
+            ranges = [(n, b, b + mx - 1) for n, b, mx in pools]
+            (na, alo, ahi), (nb, blo, bhi) = ranges
+            if alo <= bhi and blo <= ahi:
+                ap.error(
+                    f"slot port ranges overlap: {na} {alo}..{ahi} vs "
+                    f"{nb} {blo}..{bhi} — replicas would fight for the "
+                    "same port and crash-loop into quarantine; move "
+                    f"--{nb}-base-port past the {na} pool's "
+                    f"--max-{na} slots"
+                )
+            for name, lo, hi in ranges:
+                if lo <= args.port <= hi:
+                    ap.error(
+                        f"--port {args.port} falls inside the {name} "
+                        f"slot range {lo}..{hi}; the router and a "
+                        f"{name} replica would fight for it")
     elif not (args.replica or args.prefill or args.decode):
         ap.error("need --replica URLs, --prefill and --decode URLs, "
-                 "or --supervise with --replica-cmd")
+                 "or --supervise with a replica command template")
     return serve_router(args)
 
 

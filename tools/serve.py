@@ -217,7 +217,13 @@ def build_scheduler(server, scheduler: str, *, queue_depth: int,
         )
 
         engine = PagedDecodeEngine(
-            server, max_batch=cb_batch, num_blocks=kv_blocks
+            server, max_batch=cb_batch, num_blocks=kv_blocks,
+            # prefix reuse on the prefill pool: a shared system prefix
+            # is computed once per prefill replica — prefill_export
+            # consults/publishes the radix index (docs/serving.md
+            # "Disaggregated operations")
+            prefix_cache_blocks=prefix_cache_blocks,
+            prefill_chunk=prefill_chunk,
         )
 
         def prefill_runner(prompts, max_new):
@@ -366,6 +372,120 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     flags = {"draining": False, "degraded": False}
     stop_event = threading.Event()
 
+    # direct prefill->decode transfer (docs/serving.md "Disaggregated
+    # operations"): one process-wide send counter so
+    # PFX_FAULT=handoff_drop:K targets the Kth direct send exactly —
+    # locked, because handler threads increment it concurrently
+    direct_state = {"n": 0}
+    direct_lock = threading.Lock()
+
+    def _direct_handoff(payload: bytes, url: str, fwd_deadline: float):
+        """POST one KV-handoff payload straight to the ticketed decode
+        replica (auth via the fleet PFX_ADMIN_TOKEN rule, bounded
+        timeout, ONE retry for sends that provably never arrived).
+        Returns ``(code, body, content_type, headers)`` for the
+        /prefill response:
+
+          - decode answered 200 -> relay its JSON completion (the
+            payload bytes never transit the router);
+          - send never arrived (refused / injected drop / not sent),
+            twice, or decode answered 429/503 (capacity/draining) or
+            401/403 (this replica's admin token rejected — the router
+            authenticates the proxy leg itself) -> PROXY FALLBACK:
+            return the payload octet-stream for the router to carry —
+            any decode replica can take it, nothing was adopted;
+          - any other non-200 -> relay the decode replica's verdict
+            (a 400 payload rejection repeats at every pool member);
+          - lost MID-exchange -> structured 502 naming the decode leg:
+            the row may be adopted there, so the router must run its
+            re-prefill failover through a healthy pair instead of ever
+            replaying at that replica."""
+        from paddlefleetx_tpu.core.router import (
+            ReplicaUnavailable,
+            RequestNotSent,
+            _http_request,
+            admin_headers,
+        )
+        from paddlefleetx_tpu.utils.resilience import maybe_fire
+
+        with direct_lock:
+            direct_state["n"] += 1
+            seq = direct_state["n"]
+        last_err = "send failed"
+        t_send = time.monotonic()
+        for _attempt in range(2):  # the send + one retry
+            # the ticket budget keeps burning across attempts: a retry
+            # after a stalled first send must not offer /decode the
+            # full budget again (the router's clock expired with the
+            # stall — a doomed decode would just pin arena blocks)
+            left = fwd_deadline - (time.monotonic() - t_send)
+            if left <= 0:
+                last_err = (f"{last_err}; ticket budget spent before "
+                            "retry")
+                break
+            if maybe_fire("handoff_drop", seq):
+                # deterministic drop drill: this send never goes out
+                last_err = "injected handoff_drop"
+                continue
+            try:
+                status, body, _ = _http_request(
+                    url, "POST",
+                    f"/decode?deadline_s={left:.3f}",
+                    body=payload,
+                    headers={
+                        "Content-Type": "application/octet-stream",
+                        "X-Handoff-Transport": "direct",
+                        **admin_headers(),
+                    },
+                    # the remaining ticket budget is bounded by the
+                    # router's --max-deadline: give the socket the same
+                    # grace the proxy leg gets — a cap below the
+                    # deadline would misclassify a slow but legitimate
+                    # decode as a dead replica
+                    timeout=left + 5.0,
+                )
+            except ConnectionRefusedError as e:
+                last_err = f"refused: {e}"
+                continue
+            except RequestNotSent as e:
+                last_err = str(e)
+                continue
+            except ReplicaUnavailable as e:
+                reg.counter("pfx_handoff_direct_total",
+                            outcome="decode_dead").inc()
+                return (502, json.dumps({
+                    "error": f"direct decode leg lost mid-exchange ({e})",
+                    "handoff_leg": "decode",
+                }).encode(), "application/json", None)
+            if status == 200:
+                reg.counter("pfx_handoff_bytes_total",
+                            transport="direct").inc(len(payload))
+                reg.counter("pfx_handoff_direct_total",
+                            outcome="ok").inc()
+                return (200, body, "application/json", None)
+            if status in (401, 403, 429, 503):
+                # 429/503: capacity/draining — any pool member can take
+                # the payload off the router's proxy leg. 401/403: the
+                # decode pool rejected THIS replica's admin token; the
+                # router authenticates the proxy leg with its OWN
+                # credentials, so a prefill-side token misconfiguration
+                # must degrade to the carry, not surface as a
+                # transport-specific client error
+                last_err = f"decode answered HTTP {status}"
+                break
+            reg.counter("pfx_handoff_direct_total",
+                        outcome="rejected").inc()
+            return (status, body, "application/json", None)
+        reg.counter("pfx_handoff_direct_total", outcome="fallback").inc()
+        # loud on the replica, not just a response header the router
+        # consumes: a PERSISTENT degradation (token misconfiguration,
+        # firewalled decode pool) defeats the direct transport's whole
+        # point while every request still succeeds via the proxy carry
+        print(f"DIRECT-TRANSFER DEGRADED to proxy carry "
+              f"(send #{seq}): {last_err}", flush=True)
+        return (200, payload, "application/octet-stream",
+                {"X-Direct-Error": last_err})
+
     class Handler(BaseHTTPRequestHandler):
         timeout = 120  # a silent client can't pin a handler thread forever
 
@@ -450,6 +570,12 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     # continuous scheduler's rows/capacity (0 elsewhere)
                     "occupancy": round(float(reg.value(
                         "pfx_batch_occupancy", snap=snap)), 4),
+                    # decode-pool scale + routing signal: arena blocks
+                    # an admission can actually obtain (continuous
+                    # scheduler replicas only; absent elsewhere)
+                    **({"available_blocks": int(reg.value(
+                        "pfx_kv_blocks_available", snap=snap))}
+                       if "pfx_kv_blocks_available" in snap else {}),
                     "queue": {
                         k: int(reg.value(m, snap=snap))
                         for k, m in _QUEUE_HEALTH_KEYS.items()
@@ -606,10 +732,18 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             if parts.path == "/prefill":
                 if role != "prefill":
                     return self._json(404, {"error": "not a prefill replica"})
+                # fabric-internal endpoint: the fleet PFX_ADMIN_TOKEN
+                # rule applies (token set -> bearer match; unset ->
+                # loopback-only, loudly) — a KV-handoff surface must not
+                # ship unauthenticated on a non-loopback bind
+                if not self._authorized("/prefill"):
+                    return
                 return self._prefill()
             if parts.path == "/decode":
                 if role != "decode":
                     return self._json(404, {"error": "not a decode replica"})
+                if not self._authorized("/decode"):
+                    return
                 return self._decode(parts)
             return self._json(404, {"error": "unknown path"})
 
@@ -793,7 +927,19 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             prefill and answer with the binary KV-handoff payload the
             router hands to a decode replica.  Same admission surface
             as /generate: bounded queue (429), deadlines (503 shed),
-            graceful drain."""
+            graceful drain.
+
+            With a ``forward`` placement ticket in the request (the
+            router's direct-transfer topology), the payload is POSTed
+            STRAIGHT to the named decode replica instead — handoff
+            bytes never transit the router — and the decode replica's
+            JSON completion is relayed back.  A send that provably
+            failed before the decode replica read it degrades to the
+            proxy leg (the payload is returned, octet-stream, for the
+            router to carry); a send lost MID-exchange answers a
+            structured 502 naming the decode leg, so the router can run
+            its re-prefill failover without ever replaying at the dead
+            replica."""
             from paddlefleetx_tpu.core.paged_cache import pack_handoff
 
             in_flight_gauge.add(1)
@@ -816,7 +962,14 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     deadline_s = self._read_deadline(
                         req.get("deadline_s", default_deadline_s)
                     )
-                except (ValueError, TypeError) as e:
+                    fwd = req.get("forward") or None
+                    fwd_url = fwd_deadline = None
+                    if fwd is not None:
+                        fwd_url = str(fwd["url"])
+                        fwd_deadline = self._read_deadline(
+                            fwd.get("deadline_s", deadline_s)
+                        )
+                except (KeyError, ValueError, TypeError) as e:
                     return self._json(400, {"error": str(e)})
                 fut = self._submit_guarded(
                     lambda: queue.submit(
@@ -831,6 +984,37 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 if exports is None:
                     return
                 payload = pack_handoff(*exports[0])
+                if fwd_url is not None:
+                    # the ticket's deadline burns down with queue wait
+                    # and prefill compute: hand the decode replica only
+                    # what is LEFT, and shed honestly when the export
+                    # itself spent the budget — nothing was adopted
+                    # anywhere, and the router has given up on its own
+                    # clock already
+                    fwd_left = fwd_deadline - (time.monotonic() - t0)
+                    if fwd_left <= 0:
+                        _record_request_span(reg, recorder, t0, fut, 503)
+                        _slo_observe(503, fut, t0)
+                        return self._json(503, {
+                            "error": "deadline exhausted after prefill "
+                                     "export (forward ticket spent)",
+                        })
+                    code, body, ctype, headers = _direct_handoff(
+                        payload, fwd_url, fwd_left
+                    )
+                    latency_hist.observe(time.monotonic() - t0)
+                    _record_request_span(reg, recorder, t0, fut, code)
+                    # every 5xx here is a DECODE-side verdict (a death
+                    # report or a relayed decode error; this replica's
+                    # own failures take the generic 500 path below) and
+                    # must not spend the PREFILL SLO budget: the breach
+                    # signal is always live, and burning it here would
+                    # scale the prefill pool on decode-pool failures
+                    _slo_observe(200 if code >= 500 else code, fut, t0)
+                    if fut.trace is not None:
+                        headers = dict(headers or {})
+                        headers["X-Trace-Id"] = fut.trace.trace_id
+                    return self._send(code, body, ctype, headers)
                 latency_hist.observe(time.monotonic() - t0)
                 _record_request_span(reg, recorder, t0, fut, 200)
                 _slo_observe(200, fut, t0)
@@ -862,6 +1046,16 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
+                # handoff bytes through THIS replica, by transport: the
+                # direct-transfer acceptance evidence (router-side byte
+                # counters stay flat while these account the payload)
+                transport = (self.headers.get("X-Handoff-Transport")
+                             or "proxy")
+                reg.counter(
+                    "pfx_handoff_bytes_total",
+                    transport="direct" if transport == "direct"
+                    else "proxy",
+                ).inc(len(body))
                 try:
                     raw = (parse_qs(parts.query).get("deadline_s")
                            or [default_deadline_s])[0]
