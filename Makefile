@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg bench bench-check
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel bench bench-check
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -18,7 +18,7 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_telemetry.py tests/test_tracing.py \
              tests/test_bench_helpers.py tests/test_bench_cases.py \
              tests/test_router.py tests/test_controller.py \
-             tests/test_prefix_cache.py
+             tests/test_prefix_cache.py tests/test_shard_map_compat.py
 
 # lint runs inside the gate via tests/test_lint.py::test_repo_is_clean
 test-fast:
@@ -146,6 +146,18 @@ test-disagg:
 	python -m pytest tests/test_controller.py tests/test_router.py tests/test_kv_handoff.py -q
 	python -m pytest tests/test_disagg_drills.py -q
 	python -m pytest "tests/test_router_drills.py::test_disaggregated_prefill_decode_parity_via_router" -q
+
+# multi-chip parallelism gate: the shard_map-port surface in one run —
+# compat-adapter units, 1F1B pipeline parity (loss+grads, virtual
+# stages, bf16), ring/zigzag long-context parity (incl. the nested
+# pp2 x sep2 subprocess case), sharding-rule/ZeRO families, the
+# six-layout engine parity sweep, the 2-process jax.distributed e2e,
+# and every golden-doc walkthrough incl. the slow-marked ones
+# (docs/parallelism.md)
+test-parallel:
+	python -m pytest tests/test_shard_map_compat.py tests/test_pipeline.py tests/test_long_context.py tests/test_mesh_sharding.py tests/test_distributed.py -q
+	python -m pytest "tests/test_engine.py::test_layout_loss_parity_first_step" -q
+	python -m pytest tests/test_golden_docs.py -q
 
 bench:
 	python benchmarks/run_benchmark.py

@@ -26,6 +26,13 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_TOKENS_PER_S = 16260.0
 METRIC = "gpt345m_pretrain_throughput_per_chip"
 
+# long-context ring-attention row (shard_map-port PR): seq >= 4096 through
+# parallel/ring_attention.py with the zigzag causal layout on a sep-axis
+# ring over every local device.  No published reference number exists (the
+# reference has no context-parallel path at all — SURVEY §5.7, max trained
+# context 1024), so the row reports an absolute rate with vs_baseline null.
+RING_METRIC = "ring_attention_seq4096_throughput_per_chip"
+
 
 def _backend_alive(timeout_s: float = None) -> bool:
     """Probe jax backend init in a subprocess: the axon TPU tunnel can hang
@@ -181,20 +188,45 @@ def _honest_row(reason: str) -> dict:
     }
 
 
+def _honest_ring_row(reason: str) -> dict:
+    # vs_baseline null: no published reference number for long-context CP
+    return {
+        "metric": RING_METRIC,
+        "value": 0.0,
+        "unit": f"tokens/s/chip ({reason})",
+        "vs_baseline": None,
+    }
+
+
+# the ring case's cpu-fallback shrink: the SEQUENCE stays >= 4096 (that is
+# the case — long context), only heads/dim/steps shrink so a 1-core lap
+# finishes inside the deadline; identical across laps for bench_check
+RING_CPU_FALLBACK_SHAPE = {
+    "BENCH_RING_HEADS": "4",
+    "BENCH_RING_DIM": "32",
+    "BENCH_RING_STEPS": "2",
+}
+
+
 # ----------------------------------------------------------------------
 # Parent harness: spawn the child benchmark, relay its JSON lines, and
 # guarantee the expected metric rows come out even on SIGTERM / deadline.
 # Shared by bench.py and benchmarks/bench_extra.py (which imports it).
-def run_child_with_honest_fallback(child_argv, deadline_s, emit_missing) -> int:
+def run_child_with_honest_fallback(
+    child_argv, deadline_s, emit_missing, env=None, on_row=None
+) -> int:
     """Run `child_argv`, relaying its stdout.  `emit_missing(seen, reason)`
     is called with the set of metric names the child DID print whenever the
     run ends abnormally (signal, deadline, bad exit, no output) and must
     print honest fallback rows for everything still missing.  The parent
     never imports jax, so it stays responsive to the driver's SIGTERM no
-    matter what the axon tunnel does."""
+    matter what the axon tunnel does.  ``on_row`` (optional) sees every
+    parsed metric row — bench.py's parent uses it to learn the first
+    child's fallback platform so the ring child can skip a duplicate
+    dead-TPU probe window."""
     seen: set = set()
 
-    child = subprocess.Popen(child_argv, stdout=subprocess.PIPE, text=True)
+    child = subprocess.Popen(child_argv, stdout=subprocess.PIPE, text=True, env=env)
 
     def _reader():
         # relay the child's stdout as it streams; remember metric rows
@@ -206,6 +238,8 @@ def run_child_with_honest_fallback(child_argv, deadline_s, emit_missing) -> int:
                 row = json.loads(line)
                 if isinstance(row, dict) and "metric" in row:
                     seen.add(row["metric"])
+                    if on_row is not None:
+                        on_row(row)
             except ValueError:
                 pass
             print(line, flush=True)
@@ -261,11 +295,44 @@ def _parent() -> int:
         if METRIC not in seen:
             print(json.dumps(_honest_row(reason)), flush=True)
 
-    return run_child_with_honest_fallback(
+    child_platform = {}
+
+    def on_row(row):
+        if row.get("platform"):
+            child_platform["seen"] = row["platform"]
+
+    rc = run_child_with_honest_fallback(
         [sys.executable, os.path.abspath(__file__), "--child"],
         float(os.environ.get("BENCH_DEADLINE_S", 600)),
         emit_missing,
+        on_row=on_row,
     )
+
+    if os.environ.get("BENCH_RING", "1") != "1":
+        return rc
+
+    def emit_missing_ring(seen, reason):
+        if RING_METRIC not in seen:
+            print(json.dumps(_honest_ring_row(reason)), flush=True)
+
+    # if the headline child already fell back to cpu (dead TPU), pin the
+    # ring child there too so it skips a second full probe window —
+    # ensure_backend_or_fallback never probes an explicitly-pinned non-TPU
+    # platform
+    ring_env = None
+    if child_platform.get("seen") == "cpu" and os.environ.get(
+        "PFX_PLATFORM", ""
+    ).lower() in ("", "tpu", "axon"):
+        ring_env = dict(os.environ)
+        ring_env["PFX_PLATFORM"] = "cpu"
+
+    rc_ring = run_child_with_honest_fallback(
+        [sys.executable, os.path.abspath(__file__), "--child-ring"],
+        float(os.environ.get("BENCH_RING_DEADLINE_S", 600)),
+        emit_missing_ring,
+        env=ring_env,
+    )
+    return rc or rc_ring
 
 
 # ----------------------------------------------------------------------
@@ -434,7 +501,137 @@ def _child() -> None:
     )
 
 
+def _child_ring() -> None:
+    """Long-context ring-attention case: fwd+bwd of
+    parallel/ring_attention.py at BENCH_RING_SEQ (>= 4096) rows, zigzag
+    causal layout, K/V rotating a sep-axis ring over every local device.
+
+    Multi-device gated: a ring of one is dense attention, not the ported
+    collective path — a 1-device backend emits an honest platform-labeled
+    zero row naming the gate instead of a dishonest dense number.  On an
+    unreachable TPU the case follows the ensure_backend_or_fallback
+    contract: repoint at the cpu backend, force a virtual 4-device host
+    (the flag must land before jax initializes), shrink heads/dim — never
+    the sequence — and label the row."""
+    from paddlefleetx_tpu.utils.device import apply_platform_env
+
+    apply_platform_env()
+    fallback = ensure_backend_or_fallback()
+    platform = os.environ.get("PFX_PLATFORM", "").lower()
+    if platform == "cpu":
+        # a cpu lap (fallback or pinned smoke) has one real device: the
+        # ring needs a sep axis, so force virtual host devices BEFORE the
+        # first in-process jax import (no-op when the caller already did)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            )
+        for knob, val in RING_CPU_FALLBACK_SHAPE.items():
+            os.environ.setdefault(knob, val)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddlefleetx_tpu.parallel.mesh import MeshConfig, build_mesh
+    from paddlefleetx_tpu.parallel.ring_attention import (
+        ring_attention,
+        zigzag_permutation,
+    )
+
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        print(
+            json.dumps(
+                {
+                    **_honest_ring_row("needs >= 2 devices for the sep ring"),
+                    "platform": jax.default_backend(),
+                }
+            ),
+            flush=True,
+        )
+        return
+
+    seq = int(os.environ.get("BENCH_RING_SEQ", 4096))
+    heads = int(os.environ.get("BENCH_RING_HEADS", 16))
+    dim = int(os.environ.get("BENCH_RING_DIM", 64))
+    batch = int(os.environ.get("BENCH_RING_BATCH", 1))
+    steps = int(os.environ.get("BENCH_RING_STEPS", 4))
+    chunk = int(os.environ.get("BENCH_RING_CHUNK", 1024))
+    # ring = every local device on the sep axis; zigzag needs 2*ring | seq
+    ring = n_dev
+    while ring > 1 and seq % (2 * ring):
+        ring //= 2
+    if ring < 2:
+        print(
+            json.dumps(
+                {
+                    **_honest_ring_row(
+                        f"no ring >= 2 divides seq {seq} on {n_dev} devices"
+                    ),
+                    "platform": jax.default_backend(),
+                }
+            ),
+            flush=True,
+        )
+        return
+    dtype = jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16
+
+    mesh = build_mesh(MeshConfig(sep_degree=ring), jax.devices()[:ring])
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (batch, seq, heads, dim), dtype)
+    k = jax.random.normal(jax.random.fold_in(key, 1), q.shape, dtype)
+    v = jax.random.normal(jax.random.fold_in(key, 2), q.shape, dtype)
+    perm = zigzag_permutation(seq, ring)
+    qz, kz, vz = q[:, perm], k[:, perm], v[:, perm]
+
+    def loss(q, k, v):
+        out = ring_attention(
+            q, k, v, mesh, causal=True, chunk_k=chunk, positions=perm
+        )
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    step = jax.jit(jax.grad(loss, (0, 1, 2)))
+    with mesh:
+        host_fence(step(qz, kz, vz))  # compile + warmup
+        t0 = time.time()
+        for _ in range(steps):
+            grads = step(qz, kz, vz)
+        host_fence(grads)
+        dt = time.time() - t0
+
+    tokens_per_s = batch * seq * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": RING_METRIC,
+                "value": round(tokens_per_s / ring, 1),
+                "unit": (
+                    "tokens/s/chip (cpu-fallback shape)"
+                    if fallback or jax.default_backend() == "cpu"
+                    else "tokens/s/chip"
+                ),
+                "vs_baseline": None,
+                "platform": jax.default_backend(),
+                "seq": seq,
+                "ring": ring,
+                "heads": heads,
+                "note": (
+                    "fwd+bwd ring attention (zigzag causal layout), "
+                    "K/V rotating the sep ring; no published reference "
+                    "number (the reference has no context-parallel path)"
+                ),
+            }
+        ),
+        flush=True,
+    )
+
+
 def main():
+    if "--child-ring" in sys.argv:
+        _child_ring()
+        return
     if "--child" in sys.argv:
         _child()
         return

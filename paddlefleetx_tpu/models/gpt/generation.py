@@ -1422,6 +1422,23 @@ def beam_search(
         (jnp.arange(K) % Kg) == 0, 0.0, NEG
     )[None].repeat(b, 0)  # [b, K]
 
+    def _pin_beam(x, logical):
+        """Pin beam bookkeeping to batch-sharded/replicated-elsewhere.
+
+        jax-0.4.37 GSPMD mis-partitions the beam scan under TP: the scan
+        carry's bookkeeping arrays (derived from vocab-sharded logits via
+        top_k/gather chains) can leave the loop marked partial-over-`model`
+        while each shard actually holds the full value, and the consumer's
+        combining all-reduce then multiplies token ids by mp_degree
+        (observed: every emitted token exactly 2x under mp=2; the same
+        ops unrolled OUTSIDE lax.scan partition correctly).  Explicitly
+        constraining the carry each step keeps the sharding the partitioner
+        propagates identical to what the values actually are.  These are
+        [b, K]-sized arrays — replication is free."""
+        if ctx is None:
+            return x
+        return ctx.constrain(x, logical)
+
     class Beams(NamedTuple):
         cache: KVCache
         logits: jax.Array  # [b*K, v]
@@ -1513,10 +1530,10 @@ def beam_search(
         return Beams(
             cache=cache,
             logits=new_logits[:, -1, :].astype(jnp.float32),
-            scores=new_scores,
-            seqs=new_seqs,
-            fin_scores=fin_scores,
-            fin_seqs=fin_seqs,
+            scores=_pin_beam(new_scores, ("batch", None)),
+            seqs=_pin_beam(new_seqs, ("batch", None, None)),
+            fin_scores=_pin_beam(fin_scores, ("batch", None)),
+            fin_seqs=_pin_beam(fin_seqs, ("batch", None, None)),
             pos=st.pos + 1,
         ), None
 

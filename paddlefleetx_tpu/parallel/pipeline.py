@@ -5,9 +5,16 @@ TPU-native replacement for the reference's ``PipelineLayer`` runtime
 1F1B schedule, ``num_virtual_pipeline_stages`` interleaving, p2p send/recv
 between pp ranks, tied embeddings via SharedLayerDesc): layers are stacked
 on a leading axis and sharded over ``stages``; schedules run inside a
-*partially-manual* ``jax.shard_map`` — manual over ``stages`` (explicit
-``ppermute`` hops between neighbour stages, riding ICI), auto everywhere
-else (TP/FSDP/DP keep flowing through GSPMD inside each stage).
+``stages``-manual ``shard_map`` (explicit ``ppermute`` hops between
+neighbour stages, riding ICI) through the version-split adapter
+``parallel/shard_map_compat.py``: on jax >= 0.9 the map is *partially
+manual* (TP/FSDP/DP keep flowing through GSPMD inside each stage); on jax
+0.4.x — where partial-auto lowering is broken (PartitionId / SPMD CHECK,
+see shard_map_compat docstring) — the same body runs *full-manual*, with
+non-stage axes replicated at the map boundary (in-body activation
+constraints naming them are dropped by ``sharding.with_logical_constraint``)
+and ring attention nesting via ambient manual collectives instead of an
+inner map.
 
 Two schedules:
 
@@ -53,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from paddlefleetx_tpu.parallel import shard_map_compat
 from paddlefleetx_tpu.parallel.mesh import AXIS_STAGES
 
 
@@ -142,13 +150,12 @@ def pipelined_stack(
         out = jax.lax.psum(out.astype(seam_dtype), AXIS_STAGES)
         return out.reshape(x.shape)
 
-    out = jax.shard_map(
+    out = shard_map_compat.shard_map(
         pipe,
-        mesh=mesh,
+        mesh,
         in_specs=(P(AXIS_STAGES), P()),
         out_specs=P(),
-        axis_names={AXIS_STAGES},
-        check_vma=False,
+        manual_axes={AXIS_STAGES},
     )(layers_params, x.astype(seam_dtype))
     return out.astype(in_dtype)
 
@@ -327,13 +334,12 @@ def _run_1f1b(fns, pcfg: PipelineConfig, mesh, params, batch):
         )
         return numer, ge, gl, gh
 
-    numer, ge, gl, gh = jax.shard_map(
+    numer, ge, gl, gh = shard_map_compat.shard_map(
         pipe,
-        mesh=mesh,
+        mesh,
         in_specs=(P(), P(AXIS_STAGES), P(), P()),
         out_specs=(P(AXIS_STAGES), P(AXIS_STAGES), P(AXIS_STAGES), P(AXIS_STAGES)),
-        axis_names={AXIS_STAGES},
-        check_vma=False,
+        manual_axes={AXIS_STAGES},
     )(eparams, layers, hparams, batch)
     numer = numer.sum(0)
     ge = jax.tree.map(lambda a: a.sum(0), ge)

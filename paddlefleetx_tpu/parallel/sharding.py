@@ -192,13 +192,40 @@ def _ambient_abstract_mesh():
     return mesh if getattr(mesh, "axis_names", None) else None
 
 
+def _strip_manual_axes(spec: P, manual) -> P:
+    """Drop mesh axes in ``manual`` from a PartitionSpec (constraints may
+    not name Manual axes inside a shard_map body)."""
+    entries = []
+    for entry in spec:
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(a for a in axes if a is not None and a not in manual)
+        entries.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    return P(*entries)
+
+
 def with_logical_constraint(x: jax.Array, logical_axes, rules, mesh: Mesh):
     """`lax.with_sharding_constraint` via logical names (activation sharding).
 
     Inside an active mesh context (incl. partially-manual shard_map bodies,
     where some axes are Manual) the bare PartitionSpec form must be used —
-    a NamedSharding would pin the all-Auto outer mesh and mismatch."""
+    a NamedSharding would pin the all-Auto outer mesh and mismatch.
+
+    Inside a *manual* mapped region (shard_map_compat), axes that are
+    Manual must not appear in the constraint at all: 0.4.x full-manual
+    shard_map rejects them outright, and on 0.9 they are meaningless (the
+    body already holds the per-shard block).  Such axes are stripped; a
+    constraint with nothing left is a no-op — the sharding moves to the
+    in_specs/out_specs boundary of the enclosing map, which is the 0.4.x
+    port contract (docs/parallelism.md)."""
     spec = logical_to_spec(logical_axes, rules)
+    from paddlefleetx_tpu.parallel.shard_map_compat import current_manual_axes
+
+    manual = current_manual_axes()
+    if manual:
+        spec = _strip_manual_axes(spec, manual)
+        if all(entry is None for entry in spec):
+            return x
+        return jax.lax.with_sharding_constraint(x, spec)
     if _ambient_abstract_mesh() is not None:
         return jax.lax.with_sharding_constraint(x, spec)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

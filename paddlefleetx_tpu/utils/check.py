@@ -10,7 +10,8 @@ MIN_JAX_VERSION = (0, 4, 30)
 
 
 def check_version() -> None:
-    """Fail fast on a jax too old for shard_map/partial-auto meshes."""
+    """Fail fast on a jax too old for the shard_map schedules (0.4.30+:
+    the floor of parallel/shard_map_compat.py's full-manual branch)."""
     import re
 
     import jax

@@ -27,6 +27,15 @@ def main() -> None:
 
     # env var alone does not survive the axon sitecustomize: pin in-process
     jax.config.update("jax_platforms", "cpu")
+    # jax 0.4.x: cross-process computations on the CPU backend need the
+    # gloo collectives implementation selected BEFORE backend init (the
+    # default errors "Multiprocess computations aren't implemented on the
+    # CPU backend"); >= 0.9 wires cross-process CPU by default and drops
+    # the knob, hence the guard
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError:
+        pass
     os.environ["PFX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
     os.environ["PFX_NUM_PROCESSES"] = str(nproc)
     os.environ["PFX_PROCESS_ID"] = str(proc_id)

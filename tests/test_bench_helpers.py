@@ -7,6 +7,7 @@ so all timed loops fence with a device->host fetch instead).
 """
 
 import sys
+import pytest
 import os
 
 import jax
@@ -80,3 +81,53 @@ def test_backend_fallback_noop_when_reachable_or_pinned(monkeypatch):
         lambda: (_ for _ in ()).throw(AssertionError("probed a pinned cpu")),
     )
     assert bench.ensure_backend_or_fallback() == ""
+
+
+def test_ring_row_contract():
+    """SATELLITE (shard_map-port PR): the long-context ring case's row
+    contract — null vs_baseline (no published CP reference), a fallback
+    shape that shrinks heads/dim/steps but NEVER the sequence (seq >= 4096
+    IS the case), and honest rows that parse."""
+    import bench
+
+    row = bench._honest_ring_row("some reason")
+    assert row["metric"] == bench.RING_METRIC
+    assert row["value"] == 0.0
+    assert row["vs_baseline"] is None
+    assert "some reason" in row["unit"]
+    # the cpu fallback may shrink everything BUT the sequence
+    assert "BENCH_RING_SEQ" not in bench.RING_CPU_FALLBACK_SHAPE
+    assert set(bench.RING_CPU_FALLBACK_SHAPE) <= {
+        "BENCH_RING_HEADS", "BENCH_RING_DIM", "BENCH_RING_STEPS",
+        "BENCH_RING_BATCH", "BENCH_RING_CHUNK",
+    }
+
+
+@pytest.mark.slow  # ~60s: full seq-4096 ring fwd+bwd on a forced 4-device
+# CPU mesh in a fresh subprocess; the row-shape contract stays tier-1 via
+# test_ring_row_contract
+def test_ring_bench_cpu_smoke_emits_platform_labeled_row():
+    import json as _json
+    import subprocess
+
+    env = dict(os.environ)
+    env["PFX_PLATFORM"] = "cpu"
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = ""  # the child forces its own 4-device host
+    env.update({"BENCH_RING_STEPS": "1", "BENCH_RING_HEADS": "2",
+                "BENCH_RING_DIM": "16"})
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--child-ring"],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=540,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    row = _json.loads(out.stdout.strip().splitlines()[-1])
+    import bench
+
+    assert row["metric"] == bench.RING_METRIC
+    assert row["platform"] == "cpu"
+    assert row["seq"] >= 4096
+    assert row["ring"] >= 2
+    assert row["value"] > 0.0
+    assert "cpu" in row["unit"]  # labeled, never reads as chip evidence

@@ -103,7 +103,6 @@ def test_logit_scale_clamped():
     assert float(jnp.abs(g)) > 0.0
 
 
-@pytest.mark.requires_jax09
 def test_module_and_dp_engine(devices8, tmp_path):
     from paddlefleetx_tpu.core.engine import Engine
     from paddlefleetx_tpu.core.module import build_module

@@ -115,6 +115,12 @@ def test_corrupt_sample_skip_and_parity(corpus, tmp_path):
     assert streams[0] == streams[1]  # skip parity: same fault, same stream
 
 
+@pytest.mark.slow  # ~7s CLI boot; tier-1 budget funding for the
+# shard_map-port tests.  Replacement coverage: the loud max_skips budget
+# exhaustion (RuntimeError naming data.max_skips) stays tier-1 via the
+# test_data.py skip-budget units; the corrupt-sample CLI parity drill was
+# already slow-marked (PR 7) on the same grounds; still in
+# make test-data-drill / test-all.
 def test_corrupt_sample_budget_exceeded_fails_loudly(corpus, tmp_path):
     """Three corrupt fetches in a row against max_skips=1: the run must
     fail (non-zero exit) naming the data.max_skips budget."""
@@ -127,6 +133,12 @@ def test_corrupt_sample_budget_exceeded_fails_loudly(corpus, tmp_path):
     assert "data.max_skips" in run.stderr, run.stderr[-2000:]
 
 
+@pytest.mark.slow  # ~10s 12-step CLI run; tier-1 budget funding for the
+# shard_map-port tests.  Replacement coverage: io_stall seconds-parse
+# stays tier-1 via test_fault_tolerance, and the prefetch starvation
+# watchdog + data_wait_s accounting stay tier-1 via the test_data.py
+# PrefetchLoader stats/stall units; still in make test-data-drill /
+# test-all.
 def test_io_stall_watchdog_and_wait_accounting(corpus, tmp_path):
     """A 1.5s storage stall in a late sample fetch of a 12-step run
     (early stalls hide behind the first-step compile — prefetch doing its

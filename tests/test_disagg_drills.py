@@ -313,6 +313,13 @@ def test_direct_transfer_bypasses_router_and_matches_proxy(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~18s; tier-1 budget funding for the shard_map-port
+# tests.  Replacement coverage: the failover ladder (stateless prefill
+# retry, dirty-ticket avoidance, bounded re-prefill, never-replay-after-
+# bytes) stays tier-1 via the test_router unit suite, and the direct
+# transport's byte-bypass + parity stays tier-1-drilled by
+# test_direct_transfer_bypasses_router_and_matches_proxy; still in
+# make test-disagg / test-all.
 def test_handoff_drop_and_adopt_crash_failover_token_identical(tmp_path):
     """Every failure leg of the direct topology, deterministically:
 

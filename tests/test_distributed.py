@@ -5,9 +5,9 @@ cases — the only way to exercise process_count()>1 branches without a pod."""
 
 import os
 import socket
+import pytest
 import subprocess
 import sys
-import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "distributed_worker.py")
@@ -19,7 +19,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.requires_jax09
+@pytest.mark.slow  # ~42s (two fresh jax processes, gloo bootstrap, 4
+# virtual devices each); tier-1 budget funding for the shard_map-port
+# tests that re-opened this very file on jax 0.4.37.  Replacement
+# coverage: every collective/mesh schedule it exercises runs tier-1 on
+# the 8-virtual-device single-process harness (pipeline/ring/layout
+# parity, zero-offload), and distributed orbax save/restore rides the
+# single-process ckpt suites; the jax.distributed bootstrap + cross-
+# process gloo path itself has no cheaper spelling, so this exact test
+# runs in `make test-parallel` and test-all.
 def test_two_process_train_check_ckpt(tmp_path):
     port = _free_port()
     nproc = 2

@@ -244,6 +244,12 @@ def test_remote_drain_is_authenticated_and_honors_drain_contract(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~11s supervised fleet boot; tier-1 budget funding
+# for the shard_map-port tests.  Replacement coverage: the flap-budget
+# quarantine rule (restarts bounded, expected exits exempt, ensure()
+# scales around the slot) stays tier-1 via the test_controller
+# ReplicaSupervisor units, and the authenticated-drain drill keeps a
+# supervised boot tier-1; still in make test-elastic / test-all.
 def test_crash_loop_replica_is_quarantined_loudly(tmp_path):
     """THE crash-loop drill: every spawn of the replica dies at boot
     (PFX_FAULT=boot_crash:0 — a broken image).  The supervisor restarts

@@ -89,7 +89,15 @@ def test_train_loss_decreases(tmp_path, devices8):
     assert np.mean(losses[-3:]) < np.mean(losses[:3]) - 0.2
 
 
-@pytest.mark.requires_jax09
+@pytest.mark.slow  # ~18s (six engine boots); tier-1 budget funding for
+# the shard_map-port tests that re-opened this test on jax 0.4.37.
+# Replacement coverage: cross-layout LOSS parity stays tier-1 via
+# test_gpt_model::test_layout_parity (model-level, same layout family),
+# and every layout is engine-exercised tier-1 somewhere — pp via the
+# zigzag pp2xsep2 worker (Engine.train_step), fsdp via zero-offload,
+# sep via the ring suite, dp/mp via serving/TP parity; this exact
+# six-layout engine sweep runs in `make test-parallel` / test-mid /
+# test-all.
 def test_layout_loss_parity_first_step(tmp_path, devices8):
     """Same data+seed, different layouts -> same first-step loss (the
     reference's cross-layout precision-validation contract)."""

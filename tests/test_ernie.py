@@ -1,7 +1,6 @@
 """ERNIE family tests: model numerics, TP parity, dataset invariants."""
 
 import os
-import pytest
 
 import jax
 import jax.numpy as jnp
@@ -185,7 +184,6 @@ def test_ernie_module_registered():
     assert np.isfinite(float(loss))
 
 
-@pytest.mark.requires_jax09
 def test_pipeline_pretrain_parity(devices8):
     """pp2 x mp2 1F1B pretrain loss matches the single-device value
     (reference ErnieForPretrainingPipe capability, hybrid_model.py:796)."""

@@ -69,20 +69,35 @@ def _run_doc(path, timeout):
     )
 
 
+# Tier-1 budget (shard_map-port PR, which un-skipped this family on jax
+# 0.4.37): the cheap walkthroughs (ViT ~9s, GLUE ~15s warm, plus the
+# generation doc below) run tier-1 and keep the doc-freshness machinery +
+# the shared engine/config/logging stack gated on every run; the expensive
+# ones (T5 ~117s, ERNIE ~85s, DebertaV2 ~70s, HelixFold ~55s, Imagen ~26s,
+# CLIP ~13s warm) are slow-marked with replacement coverage: each family's
+# OWN tier-1 suite (test_t5, test_ernie incl. pipeline-pretrain parity,
+# test_rigid/protein units, test_vision, test_clip) exercises the same
+# model/engine paths directly, and walkthrough drift would surface first
+# in the tier-1-gated cases through the shared stack — the same argument
+# the module docstring already makes for the 1.3B/sep4096/MoCo
+# walkthroughs.  All six still run in `make test-parallel` and `make
+# test-all`.
+_SLOW = pytest.mark.slow
+
+
 @pytest.mark.parametrize(
     "doc,timeout",
     [
         ("projects/vit/docs/synthetic_ci.md", 600),
-        ("projects/ernie/docs/pretrain_base.md", 900),
-        ("projects/t5/docs/pretrain_base.md", 900),
-        ("projects/debertav2/docs/pretrain_base.md", 900),
-        ("projects/protein_folding/docs/tiny_smoke.md", 900),
-        ("projects/imagen/docs/text2im_smoke.md", 900),
-        ("projects/clip/docs/synthetic_smoke.md", 900),
+        pytest.param("projects/ernie/docs/pretrain_base.md", 900, marks=_SLOW),
+        pytest.param("projects/t5/docs/pretrain_base.md", 900, marks=_SLOW),
+        pytest.param("projects/debertav2/docs/pretrain_base.md", 900, marks=_SLOW),
+        pytest.param("projects/protein_folding/docs/tiny_smoke.md", 900, marks=_SLOW),
+        pytest.param("projects/imagen/docs/text2im_smoke.md", 900, marks=_SLOW),
+        pytest.param("projects/clip/docs/synthetic_smoke.md", 900, marks=_SLOW),
         ("projects/gpt/docs/finetune_glue.md", 900),
     ],
 )
-@pytest.mark.requires_jax09
 def test_doc_walkthrough_matches_fresh_run(doc, timeout):
     _run_doc(os.path.join(REPO, doc), timeout)
 
@@ -97,7 +112,6 @@ def test_flagship_345m_doc_matches_fresh_run():
     _run_doc(os.path.join(REPO, "projects/gpt/docs/single_card.md"), 1200)
 
 
-@pytest.mark.requires_jax09
 def test_generation_doc_matches_fresh_run():
     """The generation walkthrough's sampled ids are seed-deterministic;
     a drifted sampler/processor stack changes them."""

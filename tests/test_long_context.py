@@ -27,7 +27,6 @@ TINY = GPTConfig(
 )
 
 
-@pytest.mark.requires_jax09
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_xla(devices8, causal):
     mesh = build_mesh(MeshConfig(sep_degree=4, dp_degree=2), devices8)
@@ -42,7 +41,6 @@ def test_ring_attention_matches_xla(devices8, causal):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.requires_jax09
 def test_ring_attention_grads_match(devices8):
     mesh = build_mesh(MeshConfig(sep_degree=4, dp_degree=2), devices8)
     b, s, n, d = 1, 32, 2, 16
@@ -84,7 +82,6 @@ def test_ulysses_layout_loss_parity(devices8):
     np.testing.assert_allclose(got, ref, rtol=2e-5)
 
 
-@pytest.mark.requires_jax09
 def test_ring_model_loss_parity(devices8):
     """attn_impl='ring' over sep mesh == single-device xla attention model."""
     cfg_ring = GPTConfig(**{**TINY.__dict__, "attn_impl": "ring"})
@@ -106,7 +103,6 @@ def test_ring_model_loss_parity(devices8):
     np.testing.assert_allclose(got, ref, rtol=2e-5)
 
 
-@pytest.mark.requires_jax09
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_chunked_parity(devices8, causal):
     """chunk_k bounds the per-ring-step score buffer; values and grads
@@ -138,7 +134,6 @@ def test_ring_attention_chunked_parity(devices8, causal):
     np.testing.assert_allclose(np.asarray(fb), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.requires_jax09
 def test_ring_attention_zigzag_positions_parity(devices8):
     """Permuted (zigzag) feeds with explicit positions produce exactly the
     contiguous result, just reordered: out_zz[:, inv] == out for both the
@@ -181,7 +176,12 @@ def test_zigzag_permutation_structure():
         zigzag_permutation(10, 4)
 
 
-@pytest.mark.requires_jax09
+@pytest.mark.slow  # ~9s (two engine boots); tier-1 budget funding for
+# the shard_map-port tests.  Replacement coverage: the engine's zigzag
+# install + ring positions-masking stays tier-1 via the STRICTLY HARDER
+# pp2 x sep2 composition (test_engine_zigzag_pp_loss_parity, which also
+# asserts the non-parity negative control) and the ring zigzag-positions
+# parity test above; still in make test-parallel / test-mid / test-all.
 def test_engine_zigzag_loss_parity(devices8, tmp_path):
     """Distributed.sep_zigzag: the engine permutes the batch, ring masks by
     true positions, and the loss matches the contiguous sep layout."""
@@ -236,7 +236,6 @@ def test_engine_zigzag_loss_parity(devices8, tmp_path):
     np.testing.assert_allclose(zz, ref, rtol=2e-4)
 
 
-@pytest.mark.requires_jax09
 def test_engine_zigzag_pp_loss_parity():
     """sep_zigzag composes with pipeline parallelism: ctx.attn_positions
     rides into the 1F1B chunk fns as a stage-replicated constant and ring
@@ -271,3 +270,50 @@ def test_engine_zigzag_pp_loss_parity():
     # wrong (storage-order) masking must NOT be parity -- guards against
     # the positions constant silently dropping out of the pipeline path
     assert abs(bad - ref) > 2e-5, (bad, ref)
+
+
+def test_pipeline_sep_ring_1f1b_grads_match(devices8):
+    """1F1B pipeline COMPOSED with nested ring attention (pp2 x sep2 x
+    dp2): loss AND per-parameter grads match the single-device reference.
+
+    Regression for the 0.4.x nested-manual backward (code review of the
+    shard_map-port PR): the naive all_gather/slice seams left gradients
+    sep-rank-varying (own block doubled, other blocks zero — worst rel
+    err ~1.2e3) while the LOSS was exact, so a loss-only assertion
+    (zigzag_pp_worker's) passed.  The frame-seam custom VJPs in
+    ring_attention (_enter_replicated / _gather_replicated) are what this
+    test pins — it must assert GRADS, not just loss."""
+    from paddlefleetx_tpu.parallel.pipeline import PipelineConfig
+
+    # 2 layers = 1 per stage: the smallest shape that runs both stages'
+    # chunk bodies through the nested ring (the bug reproduced identically
+    # at any depth; 4 layers only added compile time to tier-1)
+    cfg = GPTConfig(**{**TINY.__dict__, "attn_impl": "ring"})
+    params = gpt.init(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, 32), 0, cfg.vocab_size)
+    batch = {
+        "tokens": tokens,
+        "labels": jnp.roll(tokens, -1, 1),
+        "loss_mask": jnp.ones((8, 32), jnp.float32),
+    }
+    ref_loss, g_ref = jax.value_and_grad(
+        lambda p: gpt.loss_fn(p, batch, cfg, train=True)
+    )(params)
+
+    mesh = build_mesh(
+        MeshConfig(dp_degree=2, pp_degree=2, sep_degree=2), devices8
+    )
+    rules = make_rules()
+    ctx = gpt.ShardingCtx(mesh, rules, pipeline=PipelineConfig(2, 2))
+    shardings = tree_logical_to_sharding(gpt.gpt_logical_axes(cfg), mesh, rules)
+    with mesh:
+        loss, g = jax.jit(
+            jax.value_and_grad(
+                lambda p, b: gpt.loss_fn(p, b, cfg, ctx=ctx, train=True)
+            )
+        )(jax.device_put(params, shardings), batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+    for a, b_ in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g)):
+        np.testing.assert_allclose(
+            np.asarray(b_), np.asarray(a), rtol=5e-4, atol=1e-5
+        )

@@ -211,7 +211,6 @@ def test_drop_small_fsdp_threshold():
     assert out["mixed"].spec == P("data", None)  # fsdp removed, data kept
 
 
-@pytest.mark.requires_jax09
 def test_zero_offload_host_memory_and_step(devices8):
     """offload=True: pinned-host moments where the backend can compile the
     placement (TPU), graceful device fallback where it cannot (XLA CPU's

@@ -41,7 +41,6 @@ def _ctx(devices, pp, extra=None, microbatches=None):
     (4, {"dp_degree": 2}),
     (2, {"mp_degree": 2, "dp_degree": 2}),
 ])
-@pytest.mark.requires_jax09
 def test_pipeline_loss_matches_scan(devices8, pp, extra):
     params = gpt.init(TINY, jax.random.key(0))
     tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, TINY.vocab_size)
@@ -65,7 +64,6 @@ def test_pipeline_loss_matches_scan(devices8, pp, extra):
     np.testing.assert_allclose(got, ref, rtol=2e-5)
 
 
-@pytest.mark.requires_jax09
 def test_pipeline_grads_match_scan(devices8):
     params = gpt.init(TINY, jax.random.key(0))
     tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, TINY.vocab_size)
@@ -90,7 +88,6 @@ def test_pipeline_grads_match_scan(devices8):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=5e-4, atol=1e-5)
 
 
-@pytest.mark.requires_jax09
 def test_pipeline_more_microbatches(devices8):
     """M > S exercises the fill/steady/drain phases properly."""
     params = gpt.init(TINY, jax.random.key(0))
@@ -119,7 +116,6 @@ def test_pipeline_more_microbatches(devices8):
     (2, {"mp_degree": 2, "dp_degree": 2}, 2, 1),   # TP inside stages
     (2, {"dp_degree": 4}, 4, 2),          # interleaved virtual stages
 ])
-@pytest.mark.requires_jax09
 def test_pipeline_1f1b_train_loss_and_grads(devices8, pp, extra, mb, vpp):
     """Training path: 1F1B schedule (grads computed inside the forward
     schedule via custom_vjp) matches single-device loss AND grads."""
@@ -151,7 +147,6 @@ def test_pipeline_1f1b_train_loss_and_grads(devices8, pp, extra, mb, vpp):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=5e-4, atol=1e-5)
 
 
-@pytest.mark.requires_jax09
 def test_pipeline_1f1b_bf16_params_grads(devices8):
     """bf16 params (multi_precision=False pairing): the 1F1B schedule must
     return bf16 cotangents matching the param dtype — the fp32 liveness
@@ -187,7 +182,6 @@ def test_pipeline_1f1b_bf16_params_grads(devices8):
         assert bool(jnp.isfinite(leaf.astype(jnp.float32)).all())
 
 
-@pytest.mark.requires_jax09
 def test_pipeline_1f1b_masked_loss(devices8):
     """Partial loss_mask: the in-schedule numerator / global denominator
     decomposition must reproduce the global masked mean."""

@@ -325,6 +325,13 @@ def test_rolling_drain_then_replica_kill_under_flood(tmp_path):
         assert "Traceback" not in log, log[-3000:]
 
 
+@pytest.mark.slow  # ~17s three-process boot; tier-1 budget funding for
+# the shard_map-port tests.  Replacement coverage: disaggregated
+# prefill->decode parity vs single-process continuous stays tier-1 via
+# test_disagg_drills::test_direct_transfer_bypasses_router_and_matches_proxy
+# (asserts BOTH transports token-identical to single-process) and the
+# in-process test_kv_handoff export->adopt parity suite; still in
+# make test-router / test-disagg / test-all.
 def test_disaggregated_prefill_decode_parity_via_router(tmp_path):
     """THE disaggregation acceptance drill: the same prompts through
     (a) one single-process `--scheduler continuous` replica and

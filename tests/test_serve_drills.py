@@ -143,6 +143,13 @@ def _finish(proc, timeout=30):
     return proc.stdout.read()
 
 
+@pytest.mark.slow  # ~18s flood boot; tier-1 budget funding for the
+# shard_map-port tests.  Replacement coverage: the 429-on-full / 503 /
+# exactly-one-response admission contract stays tier-1 via the
+# test_request_queue units (QueueFull/QueueClosed/deadline shed) and the
+# serving coalesce/warmup tests; the server still boots under traffic
+# tier-1 in the metrics-exposition and gen_hang drills; still in
+# make test-serve-drill / test-all.
 def test_flood_every_request_answered_or_honestly_shed(tmp_path):
     """Concurrent flood against a depth-3 queue: exactly one response per
     request, each in {200, 429, 503}, each within deadline + slack; the
@@ -313,6 +320,12 @@ def test_metrics_exposition_parses_and_agrees_with_healthz(tmp_path):
     assert "Traceback" not in log, log[-3000:]
 
 
+@pytest.mark.slow  # ~17s; tier-1 budget funding for the shard_map-port
+# tests.  Replacement coverage: multi-window burn-rate/breach/recovery
+# logic stays tier-1 via the telemetry SLOTracker units, and the wedged-
+# decode path (gen_hang -> degraded /healthz -> shed -> force-quit) stays
+# tier-1-drilled by test_gen_hang_watchdog_degrades_sheds_and_force_quits;
+# still in make test-serve-drill / test-all.
 def test_slo_breach_flips_on_wedged_decode_and_recovers(tmp_path):
     """The SLO acceptance drill: with a 0.2s p99-TTFT objective over
     short rolling windows, a decode wedged for ~2s (gen_hang, shorter
@@ -479,6 +492,12 @@ def test_gen_crash_returns_500_server_keeps_serving(tmp_path):
     assert "Traceback" not in log, log[-3000:]
 
 
+@pytest.mark.slow  # ~16s; tier-1 budget funding for the shard_map-port
+# tests.  Replacement coverage: deadline shed + busy_seconds wedge-probe
+# logic stays tier-1 via the test_request_queue units, and the drill
+# itself still runs on every `make test-obs` (the -k "metrics or
+# gen_hang" line selects it regardless of marker) plus
+# make test-serve-drill / test-all.
 def test_gen_hang_watchdog_degrades_sheds_and_force_quits(tmp_path):
     """PFX_FAULT=gen_hang:2 wedges the scheduler: the hanging client is
     shed at its deadline (no hung connection), the watchdog flips

@@ -1,0 +1,200 @@
+"""Unit tests for the version-split shard_map adapter (shard_map_compat).
+
+These pin the 0.4.x full-manual branch so a future jax bump cannot
+silently break either routing: the adapter must (a) run manual bodies
+whose collectives match the equivalent pjit/GSPMD computation, (b) expose
+the manual axis set to in-body code via the thread-local, and (c) strip
+manual axes from logical sharding constraints instead of tripping the
+0.4.x "axis also found in manual_axes" error."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddlefleetx_tpu.parallel import shard_map_compat as smc
+from paddlefleetx_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_SEP,
+    AXIS_STAGES,
+    MeshConfig,
+    build_mesh,
+)
+
+
+def _mesh(devices8, **kw):
+    return build_mesh(MeshConfig(**kw), devices8)
+
+
+def test_branch_detection_matches_installed_jax():
+    """The adapter and the conftest gate must agree on which jax this is."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        assert not smc.HAS_JAX09_SHARD_MAP
+    else:
+        import inspect
+
+        assert smc.HAS_JAX09_SHARD_MAP == (
+            "check_vma" in inspect.signature(fn).parameters
+        )
+
+
+def test_manual_axes_thread_local_scoping(devices8):
+    """current_manual_axes(): empty outside, the body's set inside (all
+    mesh axes on the 0.4.x full-manual branch), restored after."""
+    mesh = _mesh(devices8, pp_degree=2, dp_degree=4)
+    seen = {}
+
+    def body(x):
+        seen["inside"] = smc.current_manual_axes()
+        return x
+
+    assert smc.current_manual_axes() == frozenset()
+    f = smc.shard_map(body, mesh, P(AXIS_STAGES), P(AXIS_STAGES), {AXIS_STAGES})
+    with mesh:
+        jax.jit(f)(jnp.arange(8.0).reshape(2, 4))
+    if smc.HAS_JAX09_SHARD_MAP:
+        assert seen["inside"] == frozenset({AXIS_STAGES})
+    else:
+        assert seen["inside"] == frozenset(mesh.axis_names)
+    assert smc.current_manual_axes() == frozenset()
+
+
+def test_unknown_manual_axis_raises(devices8):
+    mesh = _mesh(devices8, pp_degree=2, dp_degree=4)
+    with pytest.raises(ValueError, match="not in mesh axes"):
+        smc.shard_map(lambda x: x, mesh, P(), P(), {"nonexistent"})
+
+
+def test_ppermute_psum_body_matches_pjit(devices8):
+    """A manual ring-shift + psum body must equal the same computation
+    spelled as plain (pjit-able) array ops on the global view."""
+    mesh = _mesh(devices8, pp_degree=4, dp_degree=2)
+    S = 4
+    x = jnp.arange(4.0 * 6).reshape(4, 6) + 1.0
+
+    def body(xs):  # xs: [1, 6] local stage shard
+        s = jax.lax.axis_index(AXIS_STAGES)
+        y = xs * (s + 1).astype(xs.dtype)
+        y = jax.lax.ppermute(y, AXIS_STAGES, [(i, (i + 1) % S) for i in range(S)])
+        total = jax.lax.psum(y, AXIS_STAGES)
+        return y + 0.25 * total
+
+    f = smc.shard_map(body, mesh, P(AXIS_STAGES), P(AXIS_STAGES), {AXIS_STAGES})
+    with mesh:
+        got = jax.jit(f)(x)
+
+    # global-view reference: scale row i by (i+1), roll rows by one, add
+    # a quarter of the row-sum broadcast
+    y = x * jnp.arange(1.0, S + 1)[:, None]
+    y = jnp.roll(y, 1, axis=0)
+    ref = y + 0.25 * y.sum(axis=0, keepdims=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
+
+
+def test_grad_through_manual_body_matches_pjit(devices8):
+    mesh = _mesh(devices8, pp_degree=2, dp_degree=4)
+    x = jnp.arange(8.0).reshape(2, 4)
+
+    def body(xs):
+        y = jnp.sin(xs)
+        y = jax.lax.ppermute(y, AXIS_STAGES, [(i, (i + 1) % 2) for i in range(2)])
+        return y * 3.0
+
+    f = smc.shard_map(body, mesh, P(AXIS_STAGES), P(AXIS_STAGES), {AXIS_STAGES})
+    ref_g = jax.grad(lambda x: jnp.sum(jnp.roll(jnp.sin(x), 1, 0) * 3.0))(x)
+    with mesh:
+        got_g = jax.jit(jax.grad(lambda x: jnp.sum(f(x))))(x)
+    np.testing.assert_allclose(np.asarray(got_g), np.asarray(ref_g), rtol=1e-6)
+
+
+@pytest.mark.skipif(
+    smc.HAS_JAX09_SHARD_MAP, reason="full_specs is a 0.4.x-branch feature"
+)
+def test_full_specs_keep_extra_axes_sharded(devices8):
+    """On the full-manual branch, full_specs may shard axes the body is
+    elementwise-independent over; numerics must be unchanged and the
+    output must land sharded along them."""
+    mesh = _mesh(devices8, sep_degree=2, dp_degree=4)
+    x = jnp.arange(8.0 * 6).reshape(8, 6)
+
+    def body(xs):
+        y = jax.lax.ppermute(xs, AXIS_SEP, [(i, (i + 1) % 2) for i in range(2)])
+        return y + xs
+
+    base = smc.shard_map(body, mesh, P(None, AXIS_SEP), P(None, AXIS_SEP), {AXIS_SEP})
+    rich = smc.shard_map(
+        body,
+        mesh,
+        P(None, AXIS_SEP),
+        P(None, AXIS_SEP),
+        {AXIS_SEP},
+        full_specs=(P(AXIS_DATA, AXIS_SEP), P(AXIS_DATA, AXIS_SEP)),
+    )
+    with mesh:
+        a = jax.jit(base)(x)
+        b = jax.jit(rich)(x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    assert AXIS_DATA in str(b.sharding.spec)
+
+
+def test_logical_constraint_stripped_inside_manual_region(devices8):
+    """with_logical_constraint inside a manual body must not name manual
+    axes (0.4.x rejects them); the constraint is stripped/no-op'd and the
+    values flow through unchanged."""
+    from paddlefleetx_tpu.parallel.sharding import make_rules, with_logical_constraint
+
+    mesh = _mesh(devices8, pp_degree=2, mp_degree=2, dp_degree=2)
+    rules = make_rules()
+    x = jnp.arange(8.0 * 4).reshape(8, 4)
+
+    def body(xs):
+        y = with_logical_constraint(xs, ("batch", "mlp"), rules, mesh)
+        return jax.lax.ppermute(y, AXIS_STAGES, [(i, (i + 1) % 2) for i in range(2)])
+
+    f = smc.shard_map(body, mesh, P(AXIS_STAGES), P(AXIS_STAGES), {AXIS_STAGES})
+    with mesh:
+        got = jax.jit(f)(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(jnp.roll(x, 4, 0)), rtol=1e-6)
+
+
+def test_strip_manual_axes_keeps_free_axes():
+    from paddlefleetx_tpu.parallel.sharding import _strip_manual_axes
+
+    spec = P((AXIS_DATA, AXIS_SEP), AXIS_MODEL, None)
+    out = _strip_manual_axes(spec, {AXIS_SEP})
+    assert tuple(out) == (AXIS_DATA, AXIS_MODEL, None)
+    out = _strip_manual_axes(spec, {AXIS_DATA, AXIS_SEP, AXIS_MODEL})
+    assert all(e is None for e in out)
+
+
+def test_pytree_specs_and_multiple_outputs(devices8):
+    """Tuple in_specs/out_specs over a pytree of args round-trip (the
+    1F1B signature shape)."""
+    mesh = _mesh(devices8, pp_degree=2, dp_degree=4)
+    params = {"w": jnp.arange(4.0), "b": jnp.ones((2, 2))}
+    x = jnp.arange(8.0).reshape(2, 4)
+
+    def body(p, xs):
+        y = xs + p["w"]
+        partial = jnp.sum(y) + jnp.sum(p["b"])
+        return y, partial[None]
+
+    f = smc.shard_map(
+        body,
+        mesh,
+        in_specs=(P(), P(AXIS_STAGES)),
+        out_specs=(P(AXIS_STAGES), P(AXIS_STAGES)),
+        manual_axes={AXIS_STAGES},
+    )
+    with mesh:
+        y, partials = jax.jit(f)(params, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x + params["w"]), rtol=1e-6)
+    # stage partials concatenate on the stage axis; their sum is the total
+    np.testing.assert_allclose(
+        float(jnp.sum(partials)),
+        float(jnp.sum(x + params["w"]) + 2 * jnp.sum(params["b"])),
+        rtol=1e-6,
+    )
