@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel bench bench-check
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel test-fleet-obs bench bench-check
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -18,7 +18,8 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_telemetry.py tests/test_tracing.py \
              tests/test_bench_helpers.py tests/test_bench_cases.py \
              tests/test_router.py tests/test_controller.py \
-             tests/test_prefix_cache.py tests/test_shard_map_compat.py
+             tests/test_prefix_cache.py tests/test_shard_map_compat.py \
+             tests/test_fleet_obs.py
 
 # lint runs inside the gate via tests/test_lint.py::test_repo_is_clean
 test-fast:
@@ -87,6 +88,16 @@ test-trace:
 	python -m pytest tests/test_tracing.py tests/test_telemetry.py -q -m "not slow"
 	python -m pytest tests/test_serve_drills.py -q -k "metrics or slo"
 	python -m pytest "tests/test_paged_drills.py::test_continuous_mid_decode_eviction_frees_blocks_token_identical" -q
+
+# fleet-observability gate: wall-clock-anchor/span-summary/federation/
+# fleet-report units, the cross-process stitch + federation-agreement
+# drill through the real router+prefill+decode CLIs, and the lint
+# E10/E11/E12 tables (docs/observability.md "Fleet tracing" +
+# "Fleet metrics federation")
+test-fleet-obs:
+	python -m pytest tests/test_fleet_obs.py tests/test_tracing.py tests/test_lint.py -q -m "not slow"
+	python -m pytest tests/test_fleet_obs_drills.py -q
+	python tools/lint.py
 
 # paged-serving gate: block allocator + paged-attention kernel units,
 # the continuous-batching engine/scheduler parity + eviction suite, and

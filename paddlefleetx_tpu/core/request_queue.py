@@ -157,6 +157,14 @@ class RequestQueue:
         self.max_depth = int(max_depth)
         self.max_coalesce = int(max_coalesce)
         self.name = name
+        # per-prompt trace contexts of the batch CURRENTLY inside the
+        # runner (row order matches the runner's prompts; None when
+        # untraced).  Set by the scheduler thread right before the
+        # runner call and cleared after — a runner that stamps its own
+        # fine-grained spans (the prefill replica's prefill_export)
+        # reads it to land them on the right request timeline.  Only
+        # meaningful DURING a runner call, on the scheduler thread.
+        self.batch_traces: List[Any] = []
         self._entries: deque = deque()
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -421,6 +429,9 @@ class RequestQueue:
                 f"({len(prompts)} prompts) into one batch"
             )
         t_decode = time.monotonic()
+        self.batch_traces = [
+            e.future.trace for e in batch for _ in e.prompts
+        ]
         try:
             rows = self._runner(prompts, max_new)
         except BaseException as exc:  # noqa: BLE001 — fan the error out
@@ -436,6 +447,8 @@ class RequestQueue:
                 f"{len(batch)} request(s): {type(exc).__name__}: {exc}"
             )
             return
+        finally:
+            self.batch_traces = []
         rows = list(rows)
         if len(rows) != len(prompts):
             exc = RuntimeError(

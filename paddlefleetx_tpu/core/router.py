@@ -68,7 +68,17 @@ from urllib.parse import urlsplit
 
 from paddlefleetx_tpu.core.request_queue import QueueClosed, QueueFull
 from paddlefleetx_tpu.utils.log import logger
-from paddlefleetx_tpu.utils.telemetry import get_registry
+from paddlefleetx_tpu.utils.telemetry import (
+    _env_int,
+    get_registry,
+    parse_exposition,
+)
+from paddlefleetx_tpu.utils.tracing import (
+    SPAN_SUMMARY_HEADER,
+    get_trace_buffer,
+    outbound_trace_headers,
+    parse_span_summaries,
+)
 
 REPLICA_STATES = ("booting", "warm", "serving", "draining", "gone")
 STATE_CODE = {s: i for i, s in enumerate(REPLICA_STATES)}
@@ -177,6 +187,10 @@ class Replica:
     # replicas report it; None until a poll carries the field)
     available_blocks: Optional[int] = None
     slo_breach: bool = False  # replica-reported SLO burn-rate breach
+    # latency/TTFT view off the same /healthz snapshot (fleet-log fields)
+    latency_p50_s: float = 0.0
+    latency_p99_s: float = 0.0
+    ttft_p99_s: float = 0.0
     last_poll: float = 0.0
     ok_streak: int = 0
     failures: int = 0
@@ -207,6 +221,9 @@ class Replica:
             "occupancy": round(self.occupancy, 4),
             "available_blocks": self.available_blocks,
             "slo_breach": self.slo_breach,
+            "latency_p50_s": self.latency_p50_s,
+            "latency_p99_s": self.latency_p99_s,
+            "ttft_p99_s": self.ttft_p99_s,
             "in_flight": self.in_flight,
             "last_latency_s": round(self.last_latency_s, 4),
             "failures": self.failures,
@@ -227,12 +244,14 @@ def _local_url(base_url: str) -> bool:
 
 def _http_request(base_url: str, method: str, path: str, body=None,
                   headers=None, timeout: float = 30.0
-                  ) -> Tuple[int, bytes, str]:
-    """One downstream HTTP exchange.  ``ConnectionRefusedError``
-    propagates untouched (the retryable class: no process listened, so
-    nothing was processed); every other transport failure raises
+                  ) -> Tuple[int, bytes, str, Dict[str, str]]:
+    """One downstream HTTP exchange -> ``(status, body, content_type,
+    response_headers)``.  ``ConnectionRefusedError`` propagates
+    untouched (the retryable class: no process listened, so nothing was
+    processed); every other transport failure raises
     :class:`ReplicaUnavailable` (bytes may have been exchanged — never
-    replay)."""
+    replay).  Response headers ride back for the trace-stitching layer
+    (the callee's ``X-Span-Summary`` envelope)."""
     u = urlsplit(base_url)
     conn = http.client.HTTPConnection(
         u.hostname, u.port or 80, timeout=timeout
@@ -259,9 +278,229 @@ def _http_request(base_url: str, method: str, path: str, body=None,
                 "not retried — the decode may have run"
             ) from e
         return (resp.status, data,
-                resp.getheader("Content-Type") or "application/json")
+                resp.getheader("Content-Type") or "application/json",
+                dict(resp.getheaders()))
     finally:
         conn.close()
+
+
+class FleetFederation:
+    """Fleet metrics federation: one scrape of the router answers for
+    the whole serving fabric (docs/observability.md "Fleet metrics
+    federation").
+
+    The router's /healthz poll loop feeds each replica's own
+    ``/metrics`` exposition (carried on the SAME ``/healthz?metrics=1``
+    response — one replica-side registry snapshot produces both the
+    scoring fields and the federated samples, so routing decisions and
+    exported fleet metrics can never tell two stories) into
+    :meth:`ingest`; registered as a registry collector, every router
+    snapshot then re-exports the stored samples as
+    ``pfx_fleet_metric{replica=,pool=,name=<original sample>}`` rows —
+    all from ONE locked registry snapshot, like every other collector.
+
+    Guard rails: a per-replica staleness gauge
+    (``pfx_fleet_scrape_age_seconds``) says how old each replica's view
+    is, and a LABEL-CARDINALITY CAP (``PFX_FLEET_SERIES_CAP``, default
+    4096 total series) drops the excess LOUDLY (one warning naming the
+    count + ``pfx_fleet_series_dropped``) instead of letting the
+    router's exposition grow unbounded as the supervisor churns slots.
+    """
+
+    def __init__(self, series_cap: Optional[int] = None) -> None:
+        self.series_cap = (
+            _env_int("PFX_FLEET_SERIES_CAP", 4096)
+            if series_cap is None else int(series_cap)
+        )
+        self._lock = threading.Lock()
+        # replica key -> {"pool", "rows": [(name, labels, value)],
+        #                 "t": monotonic of last SUCCESSFUL ingest}
+        self._replicas: Dict[str, Dict[str, Any]] = {}
+        self._cap_warned = False
+        reg = get_registry()
+        self._scrapes = lambda replica, outcome: reg.counter(
+            "pfx_fleet_scrapes_total", replica=replica, outcome=outcome
+        )
+
+    def ingest(self, replica_key: str, pool: str, text: str) -> int:
+        """Store one replica's exposition text (parsed); returns the
+        number of federated samples kept for it.  Only ``pfx_*`` names
+        federate, and a replica's own ``pfx_fleet_*`` rows (a router
+        polled as a replica) are excluded — federation must not recurse."""
+        rows = [
+            (name, labels, value)
+            for name, labels, value in parse_exposition(text)
+            if name.startswith("pfx_")
+            and not name.startswith("pfx_fleet_")  # noqa — prefix, not a metric name
+        ]
+        with self._lock:
+            self._replicas[replica_key] = {
+                "pool": pool, "rows": rows, "t": time.monotonic(),
+            }
+        self._scrapes(replica_key, "ok").inc()
+        return len(rows)
+
+    def note_miss(self, replica_key: str, outcome: str) -> None:
+        """Count a poll that produced no federated samples: ``missing``
+        (the replica answered /healthz without a metrics_text — an old
+        build) or ``error`` (the poll itself failed).  The stored rows
+        stay as-is; the staleness gauge carries the age."""
+        self._scrapes(replica_key, outcome).inc()
+
+    def forget(self, replica_key: str) -> None:
+        """Drop a replica's stored samples (the slot was re-registered
+        or permanently removed) so its stale series leave /metrics."""
+        with self._lock:
+            self._replicas.pop(replica_key, None)
+
+    def value(self, replica_key: str, name: str,
+              **labels: str) -> Optional[float]:
+        """Read one stored sample for a replica (None when absent) —
+        the fleet log's accessor."""
+        want = {str(k): str(v) for k, v in labels.items()}
+        with self._lock:
+            rec = self._replicas.get(replica_key)
+            if rec is None:
+                return None
+            for n, lab, v in rec["rows"]:
+                if n == name and lab == want:
+                    return v
+        return None
+
+    def collect(self):
+        """Registry-collector protocol: staleness per replica + every
+        stored sample under the ``pfx_fleet_metric`` family, bounded by
+        the series cap (replicas in sorted order, each replica's rows
+        in scrape order — deterministic about WHICH series drop)."""
+        now = time.monotonic()
+        with self._lock:
+            snap = {
+                k: (rec["pool"], list(rec["rows"]), rec["t"])
+                for k, rec in sorted(self._replicas.items())
+            }
+        out: List[Tuple[str, Dict[str, str], float]] = []
+        kept = dropped = 0
+        for key, (pool, rows, t) in snap.items():
+            out.append((
+                "pfx_fleet_scrape_age_seconds", {"replica": key},
+                round(now - t, 3),
+            ))
+            for name, labels, value in rows:
+                if kept >= self.series_cap:
+                    dropped += 1
+                    continue
+                kept += 1
+                merged = {"replica": key, "pool": pool, "name": name}
+                for k, v in labels.items():
+                    # an original label that collides with a federation
+                    # label is preserved under a src_ prefix, never
+                    # silently overwritten
+                    merged[f"src_{k}" if k in merged else k] = v
+                out.append(("pfx_fleet_metric", merged, value))
+        out.append(("pfx_fleet_series", {}, float(kept)))
+        out.append(("pfx_fleet_series_dropped", {}, float(dropped)))
+        if dropped and not self._cap_warned:
+            self._cap_warned = True
+            logger.warning(
+                f"fleet federation: series cap PFX_FLEET_SERIES_CAP="
+                f"{self.series_cap} dropped {dropped} series — the fleet "
+                "scrape no longer covers every replica sample; raise the "
+                "cap or shrink the fleet's label space "
+                "(pfx_fleet_series_dropped tracks the live count)"
+            )
+        return out
+
+
+class FleetLog:
+    """Append-only fleet-observability artifact
+    (``<PFX_FLIGHT_DIR>/fleet_metrics.jsonl``): one sample row per
+    replica per cadence (plus one for the router itself) and one row
+    per controller scale event — what ``tools/report.py --fleet``
+    renders, crash-tolerant by construction (every line is a complete
+    JSON object; a torn tail line is skipped by the loader)."""
+
+    def __init__(self, path: str, min_interval_s: float = 1.0) -> None:
+        self.path = path
+        self.min_interval_s = float(min_interval_s)
+        self._lock = threading.Lock()
+        self._last_sample = 0.0
+        self._warned = False
+
+    def _append(self, rows: List[Dict[str, Any]]) -> None:
+        try:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            with open(self.path, "a") as f:
+                for row in rows:
+                    f.write(json.dumps(row, default=str) + "\n")
+        except OSError as e:
+            if not self._warned:
+                self._warned = True
+                logger.warning(f"fleet log write to {self.path} failed: {e}")
+
+    def event(self, row: Dict[str, Any]) -> None:
+        """Append one event row immediately (controller scale events)."""
+        with self._lock:
+            self._append([{"ts": time.time(), **row}])
+
+    def due(self) -> bool:
+        """Whether :meth:`sample` would write now — callers use it to
+        skip building the (snapshot-priced) sample inputs off-cadence."""
+        with self._lock:
+            return time.monotonic() - self._last_sample >= self.min_interval_s
+
+    def sample(self, views: List[Dict[str, Any]],
+               federation: Optional[FleetFederation] = None,
+               router_extra: Optional[Dict[str, Any]] = None) -> bool:
+        """Append one sample row per replica (rate-limited to
+        ``min_interval_s``) + a router self-row; returns whether a
+        sample landed."""
+        with self._lock:
+            now = time.monotonic()
+            if now - self._last_sample < self.min_interval_s:
+                return False
+            self._last_sample = now
+            ts = time.time()
+            rows = []
+            for v in views:
+                row = {
+                    "ts": ts, "event": "replica_sample",
+                    "replica": v["key"], "pool": v["role"],
+                    "state": v["state"],
+                    "depth": v["depth"],
+                    "occupancy": v["occupancy"],
+                    "in_flight": v["in_flight"],
+                    "ttft_p99_s": v.get("ttft_p99_s", 0.0),
+                    "latency_p50_s": v.get("latency_p50_s", 0.0),
+                    "latency_p99_s": v.get("latency_p99_s", 0.0),
+                }
+                if federation is not None:
+                    for field, (name, labels) in _FLEET_SAMPLE_FIELDS.items():
+                        val = federation.value(v["key"], name, **labels)
+                        if val is not None:
+                            row[field] = val
+                rows.append(row)
+            rows.append({
+                "ts": ts, "event": "router_sample",
+                **(router_extra or {}),
+            })
+            self._append(rows)
+        return True
+
+
+# federated samples copied onto each replica's fleet-log row (the
+# report's handoff/arena breakdown): field -> (sample name, labels)
+_FLEET_SAMPLE_FIELDS = {
+    "kv_blocks_used": ("pfx_kv_blocks_used", {}),
+    "kv_blocks_available": ("pfx_kv_blocks_available", {}),
+    "tokens_out_total": ("pfx_serving_tokens_out_total", {}),
+    "handoff_bytes_direct": ("pfx_handoff_bytes_total",
+                             {"transport": "direct"}),
+    "handoff_bytes_proxy": ("pfx_handoff_bytes_total",
+                            {"transport": "proxy"}),
+    "handoff_exports_total": ("pfx_handoff_exports_total", {}),
+    "handoff_adopts_total": ("pfx_handoff_adopts_total", {}),
+}
 
 
 class RouterCore:
@@ -346,6 +585,14 @@ class RouterCore:
             "pfx_handoff_failovers_total", leg=leg
         )
         reg.register_collector(self)
+        # fleet metrics federation: the poll loop feeds each replica's
+        # /metrics view (same snapshot as its scoring fields) in here;
+        # one scrape of the router then answers for the whole fleet
+        self.federation = FleetFederation()
+        reg.register_collector(self.federation)
+        # optional fleet-observability artifact (tools/router.py wires
+        # it in serve mode; library users opt in by assigning one)
+        self.fleet_log: Optional[FleetLog] = None
 
     # -- telemetry ------------------------------------------------------
     def collect(self):
@@ -396,12 +643,27 @@ class RouterCore:
 
     # -- health polling + lifecycle -------------------------------------
     def poll_replica(self, r: Replica) -> None:
-        """One /healthz poll, driving the state machine (called by the
-        poll loop; tests call it directly for determinism)."""
+        """One poll, driving the state machine (called by the poll
+        loop; tests call it directly for determinism).  The poll GETs
+        ``/healthz?metrics=1``: the replica renders its health JSON AND
+        its full /metrics exposition from ONE registry snapshot, so the
+        scoring fields this poll stores (depth, busy, occupancy) and
+        the federated samples it ingests can never disagree mid-scrape
+        — routing decisions and exported fleet metrics tell one story."""
         try:
-            status, body, _ = _http_request(
-                r.url, "GET", "/healthz", timeout=self.poll_timeout_s
+            status, body, _, _ = _http_request(
+                r.url, "GET", "/healthz?metrics=1",
+                timeout=self.poll_timeout_s,
             )
+            if status == 404:
+                # a pre-federation replica may match /healthz by EXACT
+                # path and 404 the query spelling: a healthy old build
+                # in a mixed-version rolling upgrade must keep polling
+                # fine (scrape outcome counts "missing" below), never
+                # accumulate failures toward ejection
+                status, body, _, _ = _http_request(
+                    r.url, "GET", "/healthz", timeout=self.poll_timeout_s,
+                )
             if status != 200:
                 raise ReplicaUnavailable(f"/healthz returned {status}")
             h = json.loads(body)
@@ -423,13 +685,25 @@ class RouterCore:
             get_registry().counter(
                 "pfx_router_poll_failures_total", replica=r.key
             ).inc()
+            self.federation.note_miss(r.key, "error")
             return
+        mt = h.get("metrics_text")
+        if isinstance(mt, str) and mt:
+            self.federation.ingest(r.key, r.role, mt)
+        else:
+            # a pre-federation replica answers /healthz without the
+            # field: counted, never fatal — the staleness gauge carries
+            # how old (or absent) its federated view is
+            self.federation.note_miss(r.key, "missing")
         with self._lock:
             r.failures = 0
             r.last_poll = time.monotonic()
             r.healthy = bool(h.get("ok", False))
             r.depth = int(h.get("queue_depth", 0))
             r.busy_s = float(h.get("busy_s", 0.0))
+            r.latency_p50_s = float(h.get("latency_p50_s", 0.0) or 0.0)
+            r.latency_p99_s = float(h.get("latency_p99_s", 0.0) or 0.0)
+            r.ttft_p99_s = float(h.get("ttft_p99_s", 0.0) or 0.0)
             # elastic-control signals (core/controller.py): continuous-
             # batch occupancy and the replica's own SLO breach verdict
             r.occupancy = float(h.get("occupancy", 0.0) or 0.0)
@@ -486,6 +760,15 @@ class RouterCore:
                 f"{r.state} -> {state}: {why}"
             )
             r.state = state
+            if state == "gone":
+                # a gone replica's federated series leave the scrape
+                # (they would otherwise re-export forever with growing
+                # staleness and, under supervisor churn, crowd LIVE
+                # replicas out of the series cap); a redeploy that walks
+                # gone -> warm -> serving repopulates on its next poll.
+                # Lock order: self._lock (held) -> federation._lock —
+                # nothing takes them in the other order
+                self.federation.forget(r.key)
 
     def _poll_loop(self) -> None:
         # gone replicas keep getting polled (cheap): a redeployed process
@@ -493,6 +776,29 @@ class RouterCore:
         while not self._stop.wait(self.poll_interval_s):
             for r in list(self.replicas.values()):
                 self.poll_replica(r)
+            self._fleet_sample()
+
+    def _fleet_sample(self) -> None:
+        """One fleet-log sample after a poll sweep (rate-limited inside
+        FleetLog; no-op when no log is wired)."""
+        log = self.fleet_log
+        if log is None or not log.due():
+            return
+        reg = get_registry()
+        snap = reg.snapshot()
+        hand = reg.value("pfx_router_handoff_seconds",
+                         default={"count": 0, "sum": 0.0}, snap=snap)
+        log.sample(
+            self.replica_views(), self.federation,
+            router_extra={
+                "in_flight": self.depth(),
+                "handoff_bytes_proxied": reg.value(
+                    "pfx_router_handoff_bytes_total", snap=snap),
+                "handoff_count": hand.get("count", 0),
+                "handoff_seconds_sum": hand.get("sum", 0.0),
+                "fleet_series": reg.value("pfx_fleet_series", snap=snap),
+            },
+        )
 
     def start(self) -> "RouterCore":
         if self._poll_thread is None or not self._poll_thread.is_alive():
@@ -665,8 +971,13 @@ class RouterCore:
                 )
             t0 = time.monotonic()
             try:
-                status, data, ctype = _http_request(
-                    r.url, method, path, body=body, headers=headers,
+                status, data, ctype, resp_headers = _http_request(
+                    r.url, method, path, body=body,
+                    # the propagation headers (X-Trace-Id/X-Parent-Span)
+                    # make the callee force-sample its leg and return a
+                    # span summary for the stitched timeline
+                    headers={**(headers or {}),
+                             **outbound_trace_headers(trace, path)},
                     timeout=remaining + 5.0,
                 )
             except ConnectionRefusedError:
@@ -729,6 +1040,16 @@ class RouterCore:
             if trace is not None:
                 trace.event("routed", replica=r.key, code=status,
                             seconds=round(dt, 4))
+                # stitch the callee's span summaries (possibly a relay
+                # chain: prefill appends its own to the decode leg's)
+                # into the timeline, skew-bounded by THIS exchange's
+                # request/response envelope (the tracing.py skew rule)
+                raw = resp_headers.get(SPAN_SUMMARY_HEADER)
+                if raw:
+                    t_recv = time.monotonic()
+                    for s in parse_span_summaries(raw):
+                        trace.add_remote_summary(s, t_send=t0,
+                                                 t_recv=t_recv)
             return status, data, ctype
 
     # -- disaggregated prefill -> decode --------------------------------
@@ -1110,22 +1431,34 @@ class RouterCore:
                 self._transition(target, prev_state, why)
 
         # the HTTP leg runs OUTSIDE the lock (the poll loop and /metrics
-        # collectors take it; a slow replica must not wedge them)
+        # collectors take it; a slow replica must not wedge them).  The
+        # drain hop rides the fleet propagation headers like every other
+        # inter-process hop: a sampled "drain" trace records who was
+        # asked and what came back, and the replica can tie its
+        # drain_start flight event to the operator action that caused it
         status: Optional[int] = None
+        drain_trace = get_trace_buffer().maybe_start(
+            "drain", replica=key, url=url,
+        )
+        outcome = "answered"
         try:
-            status, body, _ = _http_request(
+            status, body, _, _ = _http_request(
                 url, "POST", "/admin/drain", body=b"{}",
                 headers={"Content-Type": "application/json",
-                         **admin_headers()},
+                         **admin_headers(),
+                         **outbound_trace_headers(drain_trace,
+                                                  "/admin/drain")},
                 timeout=max(self.poll_timeout_s, 5.0),
             )
         except ConnectionRefusedError:
+            outcome = "refused"
             with self._lock:
                 self._transition(target, "gone",
                                  "refused the drain call: already exited")
         except RequestNotSent as e:
             # the request never went out (connect stall / send failure):
             # nothing downstream saw it — back in rotation, loudly
+            outcome = "not_sent"
             _restore("drain POST not sent")
             raise ValueError(
                 f"drain POST to {key} could not be sent ({e}); the "
@@ -1136,10 +1469,18 @@ class RouterCore:
             # bytes were exchanged: the drain may have landed — leave the
             # replica draining and let the poller decide (it walks a
             # drained process to gone, and a redeploy clears the flag)
+            outcome = "lost_mid_exchange"
             logger.warning(
                 f"{self.name}: drain POST to {key} lost mid-exchange "
                 f"({e}); leaving it draining for the poller"
             )
+        finally:
+            # the outcome lands on the trace on EVERY path — a failed
+            # drain is exactly when the postmortem trail matters
+            if drain_trace is not None:
+                drain_trace.event("drain_answered", code=status,
+                                  outcome=outcome)
+                drain_trace.finish()
 
         if status in (401, 403):
             _restore("drain auth rejected")

@@ -168,6 +168,17 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_profiler_trace_seconds": ("gauge", "Wall seconds of the last trace window"),
     # deep-dive tracing (utils/tracing.py)
     "pfx_trace_sampled_total": ("counter", "Requests/runs sampled into the trace buffer"),
+    # fleet metrics federation (core/router.py FleetFederation): the
+    # router re-exports every replica's own pfx_* samples from its scrape
+    # under ONE generic family — the original sample name rides the
+    # `name` label (histogram _bucket/_sum/_count samples federate as
+    # their flat spellings), original labels ride along, and counters
+    # re-export as their current value (Prometheus-federation style)
+    "pfx_fleet_metric": ("gauge", "Federated replica sample re-exported by the router (labels: replica, pool, name=original sample name + the original labels)"),
+    "pfx_fleet_scrape_age_seconds": ("gauge", "Seconds since the replica's last successful federation scrape (labels: replica) — the staleness gauge"),
+    "pfx_fleet_scrapes_total": ("counter", "Federation scrape attempts (labels: replica, outcome=ok|missing|error)"),
+    "pfx_fleet_series": ("gauge", "Federated series currently re-exported (after the cardinality cap)"),
+    "pfx_fleet_series_dropped": ("gauge", "Federated series dropped by the PFX_FLEET_SERIES_CAP label-cardinality cap (warned loudly; 0 when everything fits)"),
     # disaggregated KV handoff (core/continuous_batching.py replica side)
     "pfx_handoff_exports_total": ("counter", "Prefilled rows exported as KV-handoff payloads (prefill replica)"),
     "pfx_handoff_adopts_total": ("counter", "KV-handoff payloads adopted into the arena (decode replica)"),
@@ -534,6 +545,83 @@ def _fmt(v: float) -> str:
     if f == int(f) and abs(f) < 1e15:
         return str(int(f))
     return repr(f)
+
+
+_SAMPLE_LINE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^}]*\})?\s+(?P<value>[^\s]+)\s*$"
+)
+_LABEL_PAIR_RE = re.compile(r'\s*(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\.)*)"\s*$')
+
+
+def parse_exposition(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """Parse Prometheus text exposition into ``(name, labels, value)``
+    sample rows, order preserved — the federation scrape's reader
+    (core/router.py).  Tolerant the way a scraper must be: comment and
+    blank lines skip, a malformed sample line skips (counted into the
+    scrape outcome by the caller via the returned rows being fewer, not
+    by raising mid-scrape), label escapes (\\\\, \\", \\n) unescape."""
+    rows: List[Tuple[str, Dict[str, str], float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_LINE_RE.match(line)
+        if not m:
+            continue
+        labels: Dict[str, str] = {}
+        raw = (m.group("labels") or "{}")[1:-1]
+        ok = True
+        for part in _split_label_pairs(raw):
+            lm = _LABEL_PAIR_RE.match(part)
+            if not lm:
+                ok = False
+                break
+            # single left-to-right pass: sequential .replace calls
+            # would corrupt values containing literal backslashes
+            # (\\n must decode to backslash+n, not newline)
+            labels[lm.group("k")] = re.sub(
+                r"\\(.)",
+                lambda m: {"n": "\n"}.get(m.group(1), m.group(1)),
+                lm.group("v"),
+            )
+        if not ok:
+            continue
+        try:
+            val = float(m.group("value").replace("+Inf", "inf")
+                        .replace("Inf", "inf"))
+        except ValueError:
+            continue
+        rows.append((m.group("name"), labels, val))
+    return rows
+
+
+def _split_label_pairs(raw: str) -> List[str]:
+    """Split ``k="v",k2="v2"`` on commas OUTSIDE quoted values."""
+    if not raw.strip():
+        return []
+    parts, buf, in_q, esc = [], [], False, False
+    for ch in raw:
+        if esc:
+            buf.append(ch)
+            esc = False
+            continue
+        if ch == "\\":
+            buf.append(ch)
+            esc = True
+            continue
+        if ch == '"':
+            in_q = not in_q
+            buf.append(ch)
+            continue
+        if ch == "," and not in_q:
+            parts.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    if buf:
+        parts.append("".join(buf))
+    return parts
 
 
 def _render_labels(labels: Dict[str, str]) -> str:

@@ -40,6 +40,36 @@ Serving wiring (tools/serve.py, docs/observability.md): every
 None); both schedulers stamp their phases onto it; ``GET /debug/trace``
 returns one timeline and ``GET /debug/traces`` the recent window as
 Perfetto-loadable JSON.
+
+Cross-process tracing (PR 15, docs/observability.md "Fleet tracing"):
+
+  - **wall-clock anchoring**: every process captures ONE monotonic <->
+    epoch anchor pair at first use (:func:`clock_anchor`); span stamps
+    stay monotonic in memory, but anything that crosses a process
+    boundary — the Chrome-trace export's ``ts`` values, the span
+    summaries below — is converted through the anchor so spans from
+    different processes land on one comparable wall-clock axis.
+  - **span summaries**: :func:`span_summary` renders a trace as a
+    BOUNDED envelope (``SPAN_SUMMARY_CAP`` spans, repeated per-step
+    instants aggregated with their numeric args summed; counts and
+    timings only, never contents) that a replica returns in the
+    ``X-Span-Summary`` response header of a fabric-internal hop.
+  - **propagation**: an inter-process hop carries ``X-Trace-Id`` +
+    ``X-Parent-Span`` request headers (:func:`outbound_trace_headers`);
+    the callee binds them via the :func:`remote_parent` context so
+    ``attach_request_trace`` FORCE-samples the child trace (a stitched
+    timeline must not lose a leg to the child's own sampler; sample=0
+    still disables everything).
+  - **stitching + the skew rule**: the caller folds returned summaries
+    into its own trace with :meth:`TraceContext.add_remote_summary`.
+    Clocks across hosts drift, so each hop's spans are trusted only up
+    to the REQUEST/RESPONSE ENVELOPE the caller observed on its own
+    clock: if the anchored child window starts before the request was
+    sent (or ends after the response arrived), every span of that hop
+    is shifted by the minimal constant that pulls it inside the
+    envelope, and the applied ``skew_s`` is recorded on the hop bar.
+    Per-hop skew is therefore bounded by the envelope width; relative
+    order WITHIN a hop is always preserved.
 """
 
 from __future__ import annotations
@@ -66,6 +96,79 @@ from paddlefleetx_tpu.utils.telemetry import (
 # one step_window span per logged window for its whole life — without a
 # ring, a million-step run pins tens of MB on one context
 TRACE_EVENT_CAP = 4096
+
+# spans per cross-process summary (the X-Span-Summary response header):
+# bounded so a long decode cannot grow an unbounded HTTP header — dense
+# per-step instants aggregate first, then middle spans drop (first/last
+# kept, `dropped` counted honestly)
+SPAN_SUMMARY_CAP = 48
+# per-name aggregation threshold inside a summary: more than this many
+# events of one name (decode_chunk instants) collapse into ONE span
+# covering their window, numeric args summed, `count` recorded
+SPAN_AGG_THRESHOLD = 4
+
+
+# ---------------------------------------------------------------------------
+# wall-clock anchoring: ONE monotonic <-> epoch pair per process
+# ---------------------------------------------------------------------------
+
+_anchor_lock = threading.Lock()
+_anchor: Optional[tuple] = None
+
+
+def clock_anchor() -> tuple:
+    """This process's ``(monotonic, epoch)`` anchor, captured ONCE at
+    first use: every cross-process timestamp conversion in this process
+    goes through the same pair, so the conversion is a constant offset
+    (jitter between the two clock reads lands in the per-hop envelope
+    bound, not in span-relative ordering)."""
+    global _anchor
+    if _anchor is None:
+        with _anchor_lock:
+            if _anchor is None:
+                _anchor = (time.monotonic(), time.time())
+    return _anchor
+
+
+def mono_to_epoch(t: float) -> float:
+    """Monotonic seconds -> epoch seconds through this process's anchor."""
+    mono, epoch = clock_anchor()
+    return float(t) - mono + epoch
+
+
+def epoch_to_mono(t: float) -> float:
+    """Epoch seconds -> this process's monotonic frame (the inverse of
+    :func:`mono_to_epoch`; remote spans are stored in the LOCAL
+    monotonic frame so timeline/export code paths stay uniform)."""
+    mono, epoch = clock_anchor()
+    return float(t) - epoch + mono
+
+
+# ---------------------------------------------------------------------------
+# process identity: who stamped a span (serving processes set replica
+# id + role at boot; defaults keep single-process exports working)
+# ---------------------------------------------------------------------------
+
+_proc_identity: Dict[str, Any] = {}
+
+
+def set_process_identity(**fields: Any) -> None:
+    """Label this process's spans (``replica_id=``, ``role=``) for
+    cross-process exports; tools/serve.py and tools/router.py call it
+    at boot."""
+    _proc_identity.update({k: v for k, v in fields.items() if v})
+
+
+def process_identity() -> Dict[str, Any]:
+    """``{"pid", "replica_id"?, "role"?}`` — carried in span summaries
+    and used to name Perfetto pid lanes."""
+    return {"pid": os.getpid(), **_proc_identity}
+
+
+def _proc_label(proc: Dict[str, Any]) -> str:
+    rid = proc.get("replica_id") or f"pid {proc.get('pid', '?')}"
+    role = proc.get("role")
+    return f"{rid} ({role})" if role else str(rid)
 
 
 class TraceContext:
@@ -119,6 +222,66 @@ class TraceContext:
         with self._lock:
             self._events.append(ev)
 
+    def add_remote_summary(self, summary: Dict[str, Any],
+                           t_send: float, t_recv: float) -> float:
+        """Stitch one hop's span summary (:func:`span_summary`, parsed
+        off the callee's ``X-Span-Summary`` response header) into this
+        trace, applying THE SKEW RULE: the hop's anchored spans are
+        converted into this process's monotonic frame and then shifted
+        by the minimal constant that pulls the whole hop window inside
+        the ``[t_send, t_recv]`` request/response envelope observed on
+        THIS process's clock — per-hop skew is bounded by the envelope,
+        and relative order within the hop is preserved.  Returns the
+        applied skew in seconds (0.0 for well-synced clocks).
+
+        Each remote span lands as an event carrying the hop process's
+        ``pid``/``proc`` identity, so the exporter gives every process
+        its own Perfetto lane; an enclosing hop bar (named after the
+        remote process) is added for valid nesting in that lane."""
+        proc = dict(summary.get("proc") or {})
+        spans = list(summary.get("spans") or [])[:SPAN_SUMMARY_CAP]
+        if not spans:
+            return 0.0
+        local = []
+        for s in spans:
+            t0 = epoch_to_mono(float(s.get("t0", 0.0)))
+            dur = max(0.0, float(s.get("dur", 0.0)))
+            local.append((t0, dur, s))
+        w0 = min(t0 for t0, _, _ in local)
+        w1 = max(t0 + dur for t0, dur, _ in local)
+        skew = 0.0
+        if w0 < t_send:
+            skew = t_send - w0
+        elif w1 > t_recv:
+            # shift back, but never past the send stamp: a hop window
+            # wider than its own envelope (should not happen — the hop
+            # ran inside it) pins to the send edge rather than lying
+            # about the request's start
+            skew = max(t_send - w0, t_recv - w1)
+        pid = proc.get("pid")
+        label = _proc_label(proc)
+        bar = {
+            "name": label, "ph": "X",
+            "t": w0 + skew, "dur": max(0.0, w1 - w0),
+            "args": {
+                "trace_id": summary.get("trace_id"),
+                "skew_s": round(skew, 6),
+                "dropped": int(summary.get("dropped", 0)),
+            },
+            "pid": pid, "proc": proc,
+        }
+        evs = [bar]
+        for t0, dur, s in local:
+            evs.append({
+                "name": str(s.get("name", "?")), "ph": "X",
+                "t": t0 + skew, "dur": dur,
+                "args": dict(s.get("args") or {}),
+                "pid": pid, "proc": proc,
+            })
+        with self._lock:
+            self._events.extend(evs)
+        return skew
+
     def finish(self, t: Optional[float] = None) -> None:
         """Stamp the end of the whole trace (idempotent: first wins)."""
         with self._lock:
@@ -157,6 +320,10 @@ class TraceContext:
                     "at_s": round(e["t"] - self.t0, 6),
                     "dur_s": round(e["dur"], 6),
                     "args": e["args"],
+                    # stitched remote spans name their process; local
+                    # events omit the key (the common single-process
+                    # timeline shape is unchanged)
+                    **({"proc": e["proc"]} if e.get("proc") else {}),
                 }
                 for e in self.events()
             ],
@@ -194,6 +361,24 @@ class TraceBuffer:
     def enabled(self) -> bool:
         return self.sample > 0.0
 
+    def _start_locked(self, name: str, t0: Optional[float],
+                      meta: Dict[str, Any]) -> TraceContext:
+        # caller holds self._lock
+        self._seq += 1
+        trace_id = f"{os.getpid():x}-{self._seq:08x}"
+        tc = TraceContext(trace_id, name, t0=t0, **meta)
+        self._traces[trace_id] = tc
+        while len(self._traces) > self.cap:
+            self._traces.popitem(last=False)  # evict oldest
+        return tc
+
+    def _count_sampled(self) -> None:
+        counter = self._sampled_counter
+        if counter is None:
+            counter = get_registry().counter("pfx_trace_sampled_total")
+            self._sampled_counter = counter
+        counter.inc()
+
     def maybe_start(self, name: str, t0: Optional[float] = None,
                     **meta: Any) -> Optional[TraceContext]:
         """Start a trace if the sampler picks this request; None
@@ -205,17 +390,24 @@ class TraceBuffer:
             if self._acc < 1.0:
                 return None
             self._acc -= 1.0
-            self._seq += 1
-            trace_id = f"{os.getpid():x}-{self._seq:08x}"
-            tc = TraceContext(trace_id, name, t0=t0, **meta)
-            self._traces[trace_id] = tc
-            while len(self._traces) > self.cap:
-                self._traces.popitem(last=False)  # evict oldest
-            counter = self._sampled_counter
-        if counter is None:
-            counter = get_registry().counter("pfx_trace_sampled_total")
-            self._sampled_counter = counter
-        counter.inc()
+            tc = self._start_locked(name, t0, meta)
+        self._count_sampled()
+        return tc
+
+    def start(self, name: str, t0: Optional[float] = None,
+              **meta: Any) -> Optional[TraceContext]:
+        """Start a trace UNCONDITIONALLY (bypassing the sampling
+        accumulator) — the remote-parent path: a request that arrived
+        carrying ``X-Trace-Id`` is already part of a sampled timeline
+        at its caller, and losing the child leg to this process's own
+        sampler would leave a hole in every stitched trace.  Still None
+        when tracing is disabled outright (sample=0: the zero-work
+        contract wins over stitching)."""
+        if self.sample <= 0.0:
+            return None
+        with self._lock:
+            tc = self._start_locked(name, t0, meta)
+        self._count_sampled()
         return tc
 
     def get(self, trace_id: str) -> Optional[TraceContext]:
@@ -235,6 +427,148 @@ class TraceBuffer:
             return list(self._traces.values())
 
 
+# ---------------------------------------------------------------------------
+# cross-process propagation: request headers + the remote-parent binding
+# ---------------------------------------------------------------------------
+
+TRACE_ID_HEADER = "X-Trace-Id"
+PARENT_SPAN_HEADER = "X-Parent-Span"
+SPAN_SUMMARY_HEADER = "X-Span-Summary"
+
+_remote_tls = threading.local()
+
+
+def outbound_trace_headers(trace, span: str) -> Dict[str, str]:
+    """Request headers for one inter-process hop: the caller's trace id
+    plus the hop name the callee's spans nest under.  Empty when the
+    request is untraced (the callee then applies its own sampler)."""
+    if trace is None:
+        return {}
+    return {TRACE_ID_HEADER: trace.trace_id, PARENT_SPAN_HEADER: str(span)}
+
+
+def remote_parent_from_headers(headers: Any) -> Optional[Dict[str, str]]:
+    """Parse the propagation headers off an incoming request (any
+    ``.get()``-able mapping); None when the hop is untraced."""
+    tid = str((headers.get(TRACE_ID_HEADER) if headers is not None else "")
+              or "").strip()
+    if not tid:
+        return None
+    return {
+        "trace_id": tid,
+        "span": str(headers.get(PARENT_SPAN_HEADER) or "").strip(),
+    }
+
+
+class remote_parent:
+    """Bind an incoming hop's parent identity for the duration of the
+    ``submit`` call (thread-local; the HTTP handler submits on its own
+    thread, synchronously): ``attach_request_trace`` then FORCE-samples
+    the trace and records the parent ids.  ``parent=None`` is a no-op
+    so call sites stay unconditional."""
+
+    def __init__(self, parent: Optional[Dict[str, str]]) -> None:
+        self._parent = parent
+
+    def __enter__(self) -> "remote_parent":
+        if self._parent is not None:
+            self._prev = getattr(_remote_tls, "parent", None)
+            _remote_tls.parent = self._parent
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._parent is not None:
+            _remote_tls.parent = self._prev
+
+
+def current_remote_parent() -> Optional[Dict[str, str]]:
+    return getattr(_remote_tls, "parent", None)
+
+
+def _scalar_args(args: Dict[str, Any]) -> Dict[str, Any]:
+    """Counts/timings only (the redaction contract, applied again at
+    the process boundary): keep numeric/bool/short-string values, drop
+    anything structured."""
+    out = {}
+    for k, v in args.items():
+        if isinstance(v, bool) or isinstance(v, (int, float)):
+            out[k] = v
+        elif isinstance(v, str) and len(v) <= 64:
+            out[k] = v
+    return out
+
+
+def span_summary(trace: TraceContext,
+                 cap: int = SPAN_SUMMARY_CAP) -> Dict[str, Any]:
+    """Render a trace as the bounded cross-process envelope a replica
+    returns in its ``X-Span-Summary`` response header: spans on the
+    wall-clock axis (epoch seconds through this process's anchor), this
+    process's identity, scalar args only.  Dense repeated instants (one
+    ``decode_chunk`` per iteration) aggregate into one span with their
+    numeric args summed and ``count`` recorded; past ``cap`` spans the
+    middle drops (first/last kept) and ``dropped`` says how many."""
+    evs = [e for e in trace.events() if not e.get("proc")]
+    by_name: Dict[str, int] = {}
+    for e in evs:
+        by_name[e["name"]] = by_name.get(e["name"], 0) + 1
+    agg: Dict[str, Dict[str, Any]] = {}
+    spans: List[Dict[str, Any]] = []
+    for e in evs:
+        name = e["name"]
+        if by_name[name] > SPAN_AGG_THRESHOLD:
+            a = agg.get(name)
+            if a is None:
+                a = agg[name] = {
+                    "name": name, "t0": e["t"], "end": e["t"] + e["dur"],
+                    "args": {"count": 0},
+                }
+                spans.append(a)
+            a["t0"] = min(a["t0"], e["t"])
+            a["end"] = max(a["end"], e["t"] + e["dur"])
+            a["args"]["count"] += 1
+            for k, v in e["args"].items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                a["args"][k] = a["args"].get(k, 0) + v
+        else:
+            spans.append({
+                "name": name, "t0": e["t"], "end": e["t"] + e["dur"],
+                "args": _scalar_args(e["args"]),
+            })
+    dropped = 0
+    if len(spans) > cap:
+        dropped = len(spans) - cap
+        spans = spans[:cap - 1] + [spans[-1]]
+    return {
+        "trace_id": trace.trace_id,
+        "proc": process_identity(),
+        "spans": [
+            {
+                "name": s["name"],
+                "t0": round(mono_to_epoch(s["t0"]), 6),
+                "dur": round(max(0.0, s["end"] - s["t0"]), 6),
+                "args": s["args"],
+            }
+            for s in spans
+        ],
+        "dropped": dropped,
+    }
+
+
+def parse_span_summaries(raw: str) -> List[Dict[str, Any]]:
+    """Parse an ``X-Span-Summary`` header value (a JSON LIST of
+    summaries — a relay hop appends its own to the ones it carried).
+    Malformed input returns [] (a broken header must never fail the
+    request it rode on)."""
+    try:
+        doc = json.loads(raw)
+    except (ValueError, TypeError):
+        return []
+    if isinstance(doc, dict):
+        doc = [doc]
+    return [s for s in doc if isinstance(s, dict)] if isinstance(doc, list) else []
+
+
 def attach_request_trace(future, *, t0: float, scheduler: str,
                          prompts: int, max_new: int) -> None:
     """THE scheduler-side request-trace attach recipe (both
@@ -242,10 +576,22 @@ def attach_request_trace(future, *, t0: float, scheduler: str,
     the admission-event shape cannot drift between schedulers): sample
     a trace, hang it on the future BEFORE the entry becomes visible to
     the scheduler thread, stamp the admission instant.  No-op when
-    sampled out."""
-    tr = get_trace_buffer().maybe_start(
-        "request", t0=t0, scheduler=scheduler,
-    )
+    sampled out.
+
+    A request that arrived on a traced inter-process hop (the handler
+    bound :class:`remote_parent` around submit) is FORCE-sampled with
+    the parent ids on its meta — the caller's stitched timeline must
+    not lose this leg to the local sampler."""
+    parent = current_remote_parent()
+    buf = get_trace_buffer()
+    if parent is not None:
+        tr = buf.start(
+            "request", t0=t0, scheduler=scheduler,
+            parent_trace=parent["trace_id"],
+            parent_span=parent.get("span", ""),
+        )
+    else:
+        tr = buf.maybe_start("request", t0=t0, scheduler=scheduler)
     if tr is not None:
         future.trace = tr
         tr.event("admission", t=t0, prompts=prompts, max_new=max_new)
@@ -283,12 +629,21 @@ def get_trace_buffer() -> TraceBuffer:
 def chrome_trace(traces: List[TraceContext]) -> Dict[str, Any]:
     """Render traces as a Chrome trace-event document (Perfetto- and
     chrome://tracing-loadable).  Every event is a ``ph="X"`` complete
-    span carrying ``ts``/``dur`` in microseconds, ``pid`` (this
-    process), ``tid`` (one lane per trace), and ``name``; each trace
-    additionally gets an enclosing span named after the trace so the
-    phase rows nest under one bar per request."""
+    span carrying ``ts``/``dur`` in microseconds, ``pid`` (the process
+    that stamped it — stitched remote spans keep their own pid, so each
+    process gets its own Perfetto lane), ``tid`` (one lane per trace),
+    and ``name``; each trace additionally gets an enclosing span named
+    after the trace so the phase rows nest under one bar per request.
+
+    WALL-CLOCK ANCHORED: ``ts`` is epoch microseconds through this
+    process's :func:`clock_anchor`, not raw monotonic — two processes'
+    exports (or one stitched export) overlay on one comparable axis.
+    Monotonic exports could never be overlaid at all (each process's
+    zero is its own boot).  ``ph="M"`` ``process_name`` metadata rows
+    label the pid lanes."""
     pid = os.getpid()
     events: List[Dict[str, Any]] = []
+    proc_names: Dict[int, str] = {pid: _proc_label(process_identity())}
     for tid, tc in enumerate(traces, start=1):
         # ONE event-list snapshot per trace, and the enclosing bar's end
         # derived from that SAME snapshot: an in-flight trace (scraped
@@ -303,7 +658,7 @@ def chrome_trace(traces: List[TraceContext]) -> Dict[str, Any]:
         bar_end = max(tc.t0, t_end)
         events.append({
             "ph": "X",
-            "ts": round(tc.t0 * 1e6, 3),
+            "ts": round(mono_to_epoch(tc.t0) * 1e6, 3),
             "dur": round((bar_end - tc.t0) * 1e6, 3),
             "pid": pid,
             "tid": tid,
@@ -316,17 +671,25 @@ def chrome_trace(traces: List[TraceContext]) -> Dict[str, Any]:
             # valid even when a stamp lands after finish()
             t0 = max(tc.t0, ev["t"])
             dur = min(ev["dur"], max(0.0, bar_end - t0))
+            ev_pid = ev.get("pid") or pid
+            if ev_pid not in proc_names and ev.get("proc"):
+                proc_names[ev_pid] = _proc_label(ev["proc"])
             events.append({
                 "ph": "X",
-                "ts": round(t0 * 1e6, 3),
+                "ts": round(mono_to_epoch(t0) * 1e6, 3),
                 "dur": round(dur * 1e6, 3),
-                "pid": pid,
+                "pid": ev_pid,
                 "tid": tid,
                 "name": ev["name"],
                 "cat": tc.name,
                 "args": dict(ev["args"]),
             })
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    meta = [
+        {"ph": "M", "pid": p, "tid": 0, "name": "process_name",
+         "args": {"name": label}}
+        for p, label in sorted(proc_names.items())
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
 def export_chrome_trace(path: Optional[str] = None,

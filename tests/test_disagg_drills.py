@@ -211,6 +211,15 @@ def _serve_cmd(cfg_path, *extra):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~15s warm; tier-1 budget funding for the PR 15
+# fleet-observability drill.  Replacement coverage: the byte-bypass
+# (router pfx_router_handoff_bytes_total flat + replica-side direct
+# bytes accounted), export/adopt counter accounting, the 3-process
+# direct-topology boot, and repeat-request token-identical determinism
+# all stay tier-1-drilled by tests/test_fleet_obs_drills.py (same
+# replicas, same transport, plus the stitched-trace + federation
+# agreement asserts); the direct-vs-proxy transport PARITY and prefill
+# prefix reuse remain covered here in make test-disagg / test-all.
 def test_direct_transfer_bypasses_router_and_matches_proxy(tmp_path):
     """THE direct-transfer acceptance drill: under ``--handoff direct``
     the payload provably does not transit the router (its byte counter

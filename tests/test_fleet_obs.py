@@ -1,0 +1,590 @@
+"""Fleet observability units (`make test-fleet-obs`): wall-clock anchor
+math, span summaries + the envelope skew rule, remote-parent forced
+sampling, the exposition parser, the federation store (staleness +
+cardinality cap), the fleet log, and the `--fleet` report renderer —
+all host-only, no jax (the cross-process stitch itself is drilled
+through the real CLIs in tests/test_fleet_obs_drills.py)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddlefleetx_tpu.utils import tracing as TR
+from paddlefleetx_tpu.utils.telemetry import (
+    Registry,
+    get_registry,
+    parse_exposition,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# wall-clock anchor math
+# ---------------------------------------------------------------------------
+
+
+def test_anchor_roundtrip_and_constant_offset():
+    m = time.monotonic()
+    # float64 at epoch scale resolves ~0.2us — the documented precision
+    assert abs(TR.epoch_to_mono(TR.mono_to_epoch(m)) - m) < 1e-5
+    # ONE anchor per process: the conversion is a constant offset, so
+    # span-relative durations survive the epoch trip exactly
+    d1 = TR.mono_to_epoch(m + 1.25) - TR.mono_to_epoch(m)
+    assert d1 == pytest.approx(1.25, abs=1e-5)
+    a1 = TR.clock_anchor()
+    assert TR.clock_anchor() is a1  # captured once
+
+
+def test_anchored_epoch_is_near_wall_clock():
+    now_m, now_e = time.monotonic(), time.time()
+    assert abs(TR.mono_to_epoch(now_m) - now_e) < 1.0
+
+
+# ---------------------------------------------------------------------------
+# span summaries: bounding, aggregation, redaction-at-the-boundary
+# ---------------------------------------------------------------------------
+
+
+def _request_trace(t0, chunks=10):
+    tc = TR.TraceContext("t-sum", "request", t0=t0, scheduler="unit")
+    tc.span("queue_wait", t0=t0, t1=t0 + 0.1)
+    tc.span("prefill", t0=t0 + 0.1, t1=t0 + 0.2, prompt_len=8)
+    for i in range(chunks):
+        tc.event("decode_chunk", t=t0 + 0.2 + 0.01 * i,
+                 committed=2, accepted=1)
+    tc.event("respond", t=t0 + 0.5, code=200,
+             structured={"not": "scalar"}, note="x" * 100)
+    tc.finish(t=t0 + 0.5)
+    return tc
+
+
+def test_span_summary_aggregates_dense_instants_and_bounds():
+    t0 = time.monotonic()
+    s = TR.span_summary(_request_trace(t0))
+    names = [x["name"] for x in s["spans"]]
+    # dense decode_chunk instants collapsed into ONE span...
+    assert names.count("decode_chunk") == 1
+    dc = next(x for x in s["spans"] if x["name"] == "decode_chunk")
+    # ...with count + numeric args SUMMED and the window covered
+    assert dc["args"]["count"] == 10
+    assert dc["args"]["committed"] == 20 and dc["args"]["accepted"] == 10
+    assert dc["dur"] == pytest.approx(0.09, abs=1e-4)
+    # sparse spans survive individually, epoch-anchored
+    pf = next(x for x in s["spans"] if x["name"] == "prefill")
+    assert pf["t0"] == pytest.approx(TR.mono_to_epoch(t0 + 0.1), abs=1e-4)
+    assert pf["dur"] == pytest.approx(0.1, abs=1e-4)
+    # redaction at the boundary: non-scalar / long-string args dropped
+    resp = next(x for x in s["spans"] if x["name"] == "respond")
+    assert "structured" not in resp["args"] and "note" not in resp["args"]
+    assert resp["args"]["code"] == 200
+    assert s["dropped"] == 0 and s["proc"]["pid"] == os.getpid()
+
+
+def test_span_summary_cap_drops_middle_keeps_last():
+    t0 = time.monotonic()
+    tc = TR.TraceContext("t-cap", "request", t0=t0)
+    for i in range(20):
+        tc.span(f"phase_{i}", t0=t0 + i, t1=t0 + i + 0.5)
+    s = TR.span_summary(tc, cap=8)
+    assert len(s["spans"]) == 8 and s["dropped"] == 12
+    assert s["spans"][-1]["name"] == "phase_19"  # last kept
+
+
+def test_parse_span_summaries_tolerates_garbage():
+    assert TR.parse_span_summaries("not json") == []
+    assert TR.parse_span_summaries("[1, 2]") == []
+    assert TR.parse_span_summaries(json.dumps({"spans": []})) != []
+    two = json.dumps([{"trace_id": "a"}, {"trace_id": "b"}])
+    assert len(TR.parse_span_summaries(two)) == 2
+
+
+# ---------------------------------------------------------------------------
+# the skew rule: remote spans bounded by the request/response envelope
+# ---------------------------------------------------------------------------
+
+
+def _summary(spans, proc=None):
+    return {
+        "trace_id": "child-1",
+        "proc": proc or {"pid": 4242, "replica_id": "d0", "role": "decode"},
+        "spans": spans,
+        "dropped": 0,
+    }
+
+
+def test_remote_summary_synced_clocks_zero_skew():
+    t0 = time.monotonic()
+    parent = TR.TraceContext("p", "route", t0=t0)
+    spans = [{"name": "decode", "t0": TR.mono_to_epoch(t0 + 0.2),
+              "dur": 0.3, "args": {"tokens": 6}}]
+    skew = parent.add_remote_summary(_summary(spans),
+                                     t_send=t0 + 0.1, t_recv=t0 + 0.6)
+    assert skew == pytest.approx(0.0, abs=1e-4)
+    evs = parent.timeline()["events"]
+    remote = [e for e in evs if e.get("proc")]
+    # an enclosing hop bar (named after the process) + the span
+    assert {e["name"] for e in remote} == {"d0 (decode)", "decode"}
+    assert all(e["proc"]["pid"] == 4242 for e in remote)
+
+
+def test_remote_summary_skew_clamps_into_envelope_preserving_order():
+    t0 = time.monotonic()
+    parent = TR.TraceContext("p", "route", t0=t0)
+    # a child whose clock runs 100s BEHIND: its anchored spans land
+    # before the request was even sent
+    spans = [
+        {"name": "a", "t0": TR.mono_to_epoch(t0 - 100.0), "dur": 0.1,
+         "args": {}},
+        {"name": "b", "t0": TR.mono_to_epoch(t0 - 99.8), "dur": 0.1,
+         "args": {}},
+    ]
+    skew = parent.add_remote_summary(_summary(spans),
+                                     t_send=t0 + 0.1, t_recv=t0 + 1.0)
+    assert skew == pytest.approx(100.1, abs=1e-3)
+    remote = [e for e in parent.timeline()["events"]
+              if e.get("proc") and e["name"] in ("a", "b")]
+    ats = {e["name"]: e["at_s"] for e in remote}
+    # pulled inside the envelope, relative order + spacing preserved
+    assert ats["a"] >= 0.1 - 1e-3
+    assert ats["b"] - ats["a"] == pytest.approx(0.2, abs=1e-3)
+
+    # a child whose clock runs AHEAD shifts backward, bounded at t_send
+    parent2 = TR.TraceContext("p2", "route", t0=t0)
+    spans2 = [{"name": "c", "t0": TR.mono_to_epoch(t0 + 50.0),
+               "dur": 0.2, "args": {}}]
+    skew2 = parent2.add_remote_summary(_summary(spans2),
+                                       t_send=t0 + 0.1, t_recv=t0 + 0.9)
+    assert skew2 < 0
+    ev = next(e for e in parent2.timeline()["events"] if e["name"] == "c")
+    assert t0 + ev["at_s"] + ev["dur_s"] <= t0 + 0.9 + 1e-3
+
+
+def test_remote_summary_empty_is_noop():
+    parent = TR.TraceContext("p", "route", t0=1.0)
+    assert parent.add_remote_summary(_summary([]), 1.0, 2.0) == 0.0
+    assert parent.timeline()["events"] == []
+
+
+def test_chrome_trace_gives_remote_spans_their_own_pid_lane():
+    t0 = time.monotonic()
+    parent = TR.TraceContext("p", "route", t0=t0)
+    parent.event("route", t=t0 + 0.01, replica="r0")
+    spans = [{"name": "decode", "t0": TR.mono_to_epoch(t0 + 0.2),
+              "dur": 0.3, "args": {}}]
+    parent.add_remote_summary(_summary(spans), t_send=t0 + 0.1,
+                              t_recv=t0 + 0.6)
+    parent.finish(t=t0 + 0.7)
+    from test_tracing import validate_chrome_trace
+
+    doc = TR.chrome_trace([parent])
+    lanes = validate_chrome_trace(doc)
+    pids = {pid for pid, _ in lanes}
+    assert {os.getpid(), 4242} <= pids  # one lane per process
+    metas = {e["pid"]: e["args"]["name"]
+             for e in doc["traceEvents"] if e["ph"] == "M"}
+    assert metas[4242] == "d0 (decode)"
+    # wall-clock anchored: ts is epoch us, not monotonic us
+    first_x = next(e for e in doc["traceEvents"] if e["ph"] == "X")
+    assert abs(first_x["ts"] / 1e6 - time.time()) < 60.0
+
+
+# ---------------------------------------------------------------------------
+# propagation: headers + remote-parent forced sampling
+# ---------------------------------------------------------------------------
+
+
+def test_outbound_and_parse_headers_roundtrip():
+    tc = TR.TraceContext("abc-1", "route")
+    h = TR.outbound_trace_headers(tc, "/generate")
+    assert h == {"X-Trace-Id": "abc-1", "X-Parent-Span": "/generate"}
+    parent = TR.remote_parent_from_headers(h)
+    assert parent == {"trace_id": "abc-1", "span": "/generate"}
+    assert TR.outbound_trace_headers(None, "x") == {}
+    assert TR.remote_parent_from_headers({}) is None
+
+
+def test_remote_parent_forces_sampling_past_the_accumulator():
+    buf = TR.TraceBuffer(sample=0.001, cap=16)
+    assert buf.maybe_start("request") is None  # sampler skips
+    tc = buf.start("request", parent_trace="abc-1")
+    assert tc is not None and buf.get(tc.trace_id) is tc
+    # sample=0 still disables everything (zero-work outranks stitching)
+    off = TR.TraceBuffer(sample=0.0)
+    assert off.start("request") is None
+
+
+def test_attach_request_trace_binds_parent_meta(monkeypatch):
+    from paddlefleetx_tpu.core.request_queue import RequestFuture
+
+    monkeypatch.setattr(TR, "_buffer", TR.TraceBuffer(sample=0.001, cap=16))
+    fut = RequestFuture()
+    with TR.remote_parent({"trace_id": "rt-9", "span": "/prefill"}):
+        TR.attach_request_trace(fut, t0=time.monotonic(),
+                                scheduler="unit", prompts=1, max_new=4)
+    assert fut.trace is not None, "remote-parent hops must force-sample"
+    assert fut.trace.meta["parent_trace"] == "rt-9"
+    assert fut.trace.meta["parent_span"] == "/prefill"
+    # without a parent the 0.001 sampler skips as before
+    fut2 = RequestFuture()
+    TR.attach_request_trace(fut2, t0=time.monotonic(),
+                            scheduler="unit", prompts=1, max_new=4)
+    assert fut2.trace is None
+    # the binding is scoped: no leak into later submits
+    assert TR.current_remote_parent() is None
+
+
+# ---------------------------------------------------------------------------
+# exposition parser
+# ---------------------------------------------------------------------------
+
+
+def test_parse_exposition_names_labels_escapes():
+    text = (
+        "# HELP pfx_x_total help text\n"
+        "# TYPE pfx_x_total counter\n"
+        "pfx_x_total 3\n"  # noqa — fixture exposition, not a registry name
+        'pfx_y{code="200",msg="a\\"b,c"} 1.5\n'  # noqa — fixture
+        'pfx_hist_bucket{le="+Inf"} 7\n'  # noqa — fixture
+        "malformed line !!\n"
+        "pfx_bad_value nope\n"
+    )
+    rows = parse_exposition(text)
+    d = {(n, tuple(sorted(l.items()))): v for n, l, v in rows}
+    assert d[("pfx_x_total", ())] == 3.0  # noqa — fixture name
+    assert d[("pfx_y", (("code", "200"), ("msg", 'a"b,c')))] == 1.5  # noqa
+    assert d[("pfx_hist_bucket", (("le", "+Inf"),))] == 7.0  # noqa
+    assert len(rows) == 3  # malformed lines skipped, never raised
+
+
+def test_parse_exposition_roundtrips_the_real_renderer():
+    reg = Registry()
+    reg.counter("pfx_http_responses_total", code="200").inc(2)
+    reg.histogram("pfx_request_latency_seconds").observe(0.05)
+    # label values with backslash-letter sequences must survive the
+    # escape round trip: a sequential \n-then-\\ unescape would turn
+    # the rendered 'C:\\new' back into backslash+newline, not 'C:\new'
+    reg.counter("pfx_http_responses_total", code="C:\\new").inc()
+    reg.counter("pfx_http_responses_total", code="a\nb").inc()
+    rows = parse_exposition(reg.render_prometheus())
+    names = {n for n, _, _ in rows}
+    assert "pfx_http_responses_total" in names
+    assert "pfx_request_latency_seconds_bucket" in names
+    assert "pfx_request_latency_seconds_count" in names
+    codes = {l["code"] for n, l, _ in rows
+             if n == "pfx_http_responses_total"}
+    assert "C:\\new" in codes and "a\nb" in codes, codes
+
+
+# ---------------------------------------------------------------------------
+# federation store
+# ---------------------------------------------------------------------------
+
+
+def _exposition(n_extra=0, value=3.0):
+    lines = [
+        "# TYPE pfx_serving_tokens_out_total counter",
+        f"pfx_serving_tokens_out_total {value}",
+        'pfx_http_responses_total{code="200"} 5',
+        # a replica-side label that collides with a federation label
+        'pfx_router_replica_depth{replica="inner"} 2',
+        # federation must not recurse
+        'pfx_fleet_series 99',
+        # non-pfx samples are not federated
+        "python_gc_collections_total 7",
+    ]
+    for i in range(n_extra):
+        lines.append(f"pfx_x_{i} 1")  # noqa — fixture exposition name
+    return "\n".join(lines) + "\n"
+
+
+def test_federation_ingest_collect_and_agreement():
+    from paddlefleetx_tpu.core.router import FleetFederation
+
+    fed = FleetFederation(series_cap=100)
+    kept = fed.ingest("r0", "decode", _exposition())
+    assert kept == 3  # pfx_* only, pfx_fleet_* and foreign names excluded
+    rows = fed.collect()
+    by = {}
+    for name, labels, value in rows:
+        by.setdefault(name, []).append((labels, value))
+    # the agreement contract: re-export == the replica's own sample
+    fleet = {
+        (l["name"], tuple(sorted(
+            (k, v) for k, v in l.items()
+            if k not in ("replica", "pool", "name")
+        ))): v
+        for l, v in by["pfx_fleet_metric"]
+    }
+    assert fleet[("pfx_serving_tokens_out_total", ())] == 3.0
+    assert fleet[("pfx_http_responses_total", (("code", "200"),))] == 5.0
+    # label collision preserved under src_, never overwritten
+    assert fleet[("pfx_router_replica_depth",
+                  (("src_replica", "inner"),))] == 2.0
+    assert all(l["replica"] == "r0" and l["pool"] == "decode"
+               for l, _ in by["pfx_fleet_metric"])
+    assert by["pfx_fleet_series"][0][1] == 3.0
+    assert by["pfx_fleet_series_dropped"][0][1] == 0.0
+    # value() accessor (the fleet log's reader)
+    assert fed.value("r0", "pfx_serving_tokens_out_total") == 3.0
+    assert fed.value("r0", "pfx_http_responses_total", code="200") == 5.0
+    assert fed.value("nope", "pfx_serving_tokens_out_total") is None
+
+
+def test_federation_staleness_gauge_grows_until_next_scrape():
+    from paddlefleetx_tpu.core.router import FleetFederation
+
+    fed = FleetFederation(series_cap=100)
+    fed.ingest("r0", "monolith", _exposition())
+    age0 = dict(
+        ((n, l.get("replica")), v) for n, l, v in fed.collect()
+    )[("pfx_fleet_scrape_age_seconds", "r0")]
+    time.sleep(0.05)
+    age1 = dict(
+        ((n, l.get("replica")), v) for n, l, v in fed.collect()
+    )[("pfx_fleet_scrape_age_seconds", "r0")]
+    assert age1 > age0
+    fed.ingest("r0", "monolith", _exposition(value=4.0))
+    age2 = dict(
+        ((n, l.get("replica")), v) for n, l, v in fed.collect()
+    )[("pfx_fleet_scrape_age_seconds", "r0")]
+    assert age2 < age1
+    # the newest scrape's value won
+    assert fed.value("r0", "pfx_serving_tokens_out_total") == 4.0
+    fed.forget("r0")
+    assert fed.value("r0", "pfx_serving_tokens_out_total") is None
+
+
+def test_federation_cardinality_cap_warns_and_counts(caplog):
+    from paddlefleetx_tpu.core.router import FleetFederation
+
+    fed = FleetFederation(series_cap=4)
+    fed.ingest("r0", "decode", _exposition(n_extra=10))
+    rows = fed.collect()
+    fleet = [r for r in rows if r[0] == "pfx_fleet_metric"]
+    dropped = next(v for n, _, v in rows if n == "pfx_fleet_series_dropped")
+    kept = next(v for n, _, v in rows if n == "pfx_fleet_series")
+    assert len(fleet) == 4 and kept == 4.0
+    assert dropped == 9.0  # 13 pfx samples - 4 kept
+    # the loud warning names the cap, once
+    fed.collect()
+    # deterministic: the SAME series survive across collects
+    assert [r[1]["name"] for r in fleet] == [
+        r[1]["name"] for r in fed.collect() if r[0] == "pfx_fleet_metric"
+    ]
+
+
+def test_federation_scrape_outcome_counters():
+    from paddlefleetx_tpu.core.router import FleetFederation
+
+    reg = get_registry()
+    base_ok = reg.value("pfx_fleet_scrapes_total",
+                        replica="ru-1", outcome="ok")
+    base_miss = reg.value("pfx_fleet_scrapes_total",
+                          replica="ru-1", outcome="missing")
+    fed = FleetFederation(series_cap=10)
+    fed.ingest("ru-1", "monolith", _exposition())
+    fed.note_miss("ru-1", "missing")
+    assert reg.value("pfx_fleet_scrapes_total", replica="ru-1",
+                     outcome="ok") == base_ok + 1
+    assert reg.value("pfx_fleet_scrapes_total", replica="ru-1",
+                     outcome="missing") == base_miss + 1
+
+
+def test_gone_replica_series_leave_the_federated_scrape():
+    """A replica ejected to `gone` must not keep re-exporting its last
+    samples forever (under supervisor churn the stale series would
+    crowd LIVE replicas out of the cardinality cap); a redeploy that
+    re-enters via warm -> serving repopulates on its next poll."""
+    from test_router import StubReplica
+
+    from paddlefleetx_tpu.core.router import RouterCore
+
+    stub = StubReplica(depth=1)
+    stub.health["metrics_text"] = "pfx_serving_tokens_out_total 7\n"
+    try:
+        core = RouterCore([(stub.url, "monolith")], poll_interval_s=60,
+                          eject_after=2)
+        r = core.replicas["r0"]
+        core.poll_replica(r)
+        assert core.federation.value(
+            "r0", "pfx_serving_tokens_out_total") == 7.0
+        stub.stop()
+        for _ in range(2):
+            core.poll_replica(r)
+        assert r.state == "gone"
+        assert core.federation.value(
+            "r0", "pfx_serving_tokens_out_total") is None
+    finally:
+        stub.stop()
+
+
+def test_router_poll_ingests_metrics_text_from_one_healthz(monkeypatch):
+    """The satellite contract end-to-end at the unit level: ONE
+    /healthz?metrics=1 response feeds both the scoring fields and the
+    federated samples — the router's stored depth and its re-exported
+    queue-depth sample come from the same replica snapshot."""
+    from test_router import StubReplica
+
+    from paddlefleetx_tpu.core.router import RouterCore
+
+    stub = StubReplica(depth=3)
+    stub.health["metrics_text"] = (
+        "pfx_queue_depth 3\npfx_serving_tokens_out_total 12\n"
+    )
+    stub.health["ttft_p99_s"] = 0.25
+    try:
+        core = RouterCore([(stub.url, "monolith")], poll_interval_s=60)
+        r = core.replicas["r0"]
+        core.poll_replica(r)
+        assert r.depth == 3 and r.ttft_p99_s == 0.25
+        assert core.federation.value("r0", "pfx_queue_depth") == 3.0
+        assert core.federation.value(
+            "r0", "pfx_serving_tokens_out_total") == 12.0
+        # a pre-federation replica (no metrics_text) still polls fine
+        del stub.health["metrics_text"]
+        core.poll_replica(r)
+        assert r.healthy
+    finally:
+        stub.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet log + report renderer
+# ---------------------------------------------------------------------------
+
+
+def _views(i):
+    return [{
+        "key": k, "role": pool, "state": "serving", "depth": i % 3,
+        "occupancy": 0.1 * i, "in_flight": 1, "ttft_p99_s": 0.05 + 0.01 * i,
+        "latency_p50_s": 0.1, "latency_p99_s": 0.3,
+    } for k, pool in (("p0", "prefill"), ("d0", "decode"))]
+
+
+def test_fleet_log_rate_limit_and_row_shape(tmp_path):
+    from paddlefleetx_tpu.core.router import FleetLog
+
+    path = tmp_path / "fleet_metrics.jsonl"
+    log = FleetLog(str(path), min_interval_s=30.0)
+    assert log.sample(_views(1), None, router_extra={"in_flight": 2})
+    assert not log.sample(_views(2), None)  # rate-limited
+    log.event({"event": "scale", "pool": "decode", "action": "scale_up",
+               "reason": "occupancy", "target": 2})
+    rows = [json.loads(ln) for ln in path.read_text().splitlines()]
+    kinds = [r["event"] for r in rows]
+    assert kinds == ["replica_sample", "replica_sample", "router_sample",
+                     "scale"]
+    assert rows[0]["replica"] == "p0" and rows[0]["pool"] == "prefill"
+    assert rows[2]["in_flight"] == 2
+    assert all("ts" in r for r in rows)
+
+
+def test_fleet_log_copies_federated_handoff_fields(tmp_path):
+    from paddlefleetx_tpu.core.router import FleetFederation, FleetLog
+
+    fed = FleetFederation(series_cap=100)
+    fed.ingest("d0", "decode", (
+        'pfx_handoff_bytes_total{transport="direct"} 4096\n'
+        "pfx_handoff_adopts_total 3\npfx_kv_blocks_used 7\n"
+    ))
+    path = tmp_path / "f.jsonl"
+    FleetLog(str(path), min_interval_s=0.0).sample(_views(1), fed)
+    d0 = next(json.loads(ln) for ln in path.read_text().splitlines()
+              if json.loads(ln).get("replica") == "d0")
+    assert d0["handoff_bytes_direct"] == 4096
+    assert d0["handoff_adopts_total"] == 3 and d0["kv_blocks_used"] == 7
+
+
+def _synthetic_fleet(tmp_path, torn=True):
+    path = tmp_path / "fleet_metrics.jsonl"
+    t = time.time()
+    with open(path, "w") as f:
+        for i in range(6):
+            for rep, pool in (("p0", "prefill"), ("d0", "decode")):
+                f.write(json.dumps({
+                    "ts": t + i, "event": "replica_sample", "replica": rep,
+                    "pool": pool, "state": "serving", "depth": i % 3,
+                    "occupancy": 0.1 * i, "in_flight": 1,
+                    "ttft_p99_s": 0.05 + 0.01 * i, "latency_p50_s": 0.1,
+                    "latency_p99_s": 0.3, "kv_blocks_used": 4 + i,
+                    "handoff_bytes_direct": 1000 * i,
+                    "handoff_exports_total": i, "handoff_adopts_total": i,
+                }) + "\n")
+            f.write(json.dumps({
+                "ts": t + i, "event": "router_sample", "in_flight": 2,
+                "handoff_bytes_proxied": 0, "handoff_count": i,
+                "handoff_seconds_sum": 0.2 * i,
+            }) + "\n")
+        f.write(json.dumps({
+            "ts": t + 3, "event": "scale", "pool": "decode",
+            "action": "scale_up", "reason": "occupancy 0.95", "target": 2,
+        }) + "\n")
+        # a SECOND pool scaling in the same tick: both markers must
+        # render (a time-keyed marker dict kept only one)
+        f.write(json.dumps({
+            "ts": t + 3, "event": "scale", "pool": "prefill",
+            "action": "scale_up", "reason": "depth 6.0", "target": 2,
+        }) + "\n")
+        if torn:
+            f.write('{"ts": 1, "event": "replica_sam')  # crashed mid-append
+    return path
+
+
+def test_fleet_report_renders_validated_html_from_torn_artifact(tmp_path):
+    from test_model_stats import _validate_html
+
+    import report
+
+    path = _synthetic_fleet(tmp_path)
+    out = tmp_path / "fleet.html"
+    assert report.main(["--fleet", str(path), "-o", str(out)]) == 0
+    doc = out.read_text()
+    _validate_html(doc)
+    assert "TTFT p99" in doc and "scale_up" in doc
+    assert "unparseable" in doc or "partial" in doc  # the torn-tail note
+    # per-replica curves name both replicas; markers carry the reason,
+    # and BOTH same-tick scale events render (not last-writer-wins)
+    assert "p0" in doc and "d0" in doc and "occupancy 0.95" in doc
+    assert "depth 6.0" in doc
+
+
+def test_fleet_report_markdown_and_run_dir_scan(tmp_path):
+    import report
+
+    _synthetic_fleet(tmp_path, torn=False)
+    out = tmp_path / "fleet.md"
+    # --fleet with no path scans --run-dir for the conventional name
+    assert report.main(["--fleet", "--run-dir", str(tmp_path),
+                        "-o", str(out), "--format", "md"]) == 0
+    doc = out.read_text()
+    assert "| p0 |" in doc and "scale_up" in doc
+
+
+def test_fleet_report_absent_artifact_is_rc2(tmp_path, capsys):
+    import report
+
+    rc = report.main(["--fleet", str(tmp_path / "nope.jsonl"),
+                      "-o", str(tmp_path / "x.html")])
+    assert rc == 2
+    assert "no readable fleet artifact" in capsys.readouterr().err
+
+
+def test_fleet_report_cli_subprocess(tmp_path):
+    """The exact operator command line works end-to-end (stdlib-only,
+    no jax import — it must run on a laptop off CI artifacts)."""
+    path = _synthetic_fleet(tmp_path, torn=False)
+    out = tmp_path / "fleet.html"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "report.py"),
+         "--fleet", str(path), "-o", str(out)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert out.exists() and "replica samples" in r.stdout

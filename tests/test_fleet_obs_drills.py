@@ -1,0 +1,256 @@
+"""THE fleet-observability acceptance drill (`make test-fleet-obs`):
+one request driven through router -> prefill -> direct handoff ->
+decode through the REAL CLIs yields
+
+  - ONE stitched, Perfetto-loadable timeline at the router containing
+    spans from all three processes, correctly ordered after wall-clock
+    anchoring (remote spans inside the request window, prefill leg
+    before decode leg, per-lane nesting strict-validated);
+  - a federated `pfx_fleet_*` scrape on the router that agrees EXACTLY
+    with each replica's own `/metrics` for spot-checked counters, with
+    a live staleness gauge per replica;
+  - a `tools/report.py --fleet` render off the router's artifacts alone.
+
+Reuses tests/test_disagg_drills' tiny config + helpers so the jax
+compiles ride the shared persistent cache."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+pytestmark = pytest.mark.fault
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_disagg_drills import (  # noqa: E402 — shared drill helpers
+    SYS,
+    TINY,
+    _env,
+    _finish,
+    _free_port,
+    _get,
+    _lab,
+    _metrics,
+    _post,
+    _spawn_replica,
+    _wait_eligible,
+    _wait_healthy,
+)
+from test_tracing import validate_chrome_trace  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_router(port, *args, env_extra=None):
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "router.py"),
+         "--port", str(port), "--poll-interval", "0.2",
+         "--eject-after", "3", *args],
+        env=_env(env_extra), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _fed_value(m, sample_name, **labels):
+    """One federated sample off a parsed router /metrics dump (the
+    original sample name rides the `name` label)."""
+    want = frozenset(
+        [("name", sample_name)]
+        + [(k, str(v)) for k, v in labels.items()]
+    )
+    return m.get("pfx_fleet_metric", {}).get(want, 0.0)
+
+
+def test_stitched_trace_and_federated_scrape_through_real_clis(tmp_path):
+    cfg_path = tmp_path / "tiny_fleet.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    pre_p, dec_p = _free_port(), _free_port()
+    pre = _spawn_replica(cfg_path, pre_p, "--role", "prefill",
+                         "--replica-id", "pre0")
+    dec = _spawn_replica(cfg_path, dec_p, "--role", "decode",
+                         "--cb-batch", "4", "--replica-id", "dec0")
+    rport = _free_port()
+    flight_dir = tmp_path / "router-artifacts"
+    router = None
+    try:
+        _wait_healthy([(pre_p, pre), (dec_p, dec)])
+        # satellite: /healthz?metrics=1 renders the exposition from the
+        # SAME snapshot as the scoring fields — and the TTFT/latency
+        # fields the fleet log records are on the plain view too
+        h = _get(dec_p, "/healthz?metrics=1")
+        assert "metrics_text" in h and "pfx_queue_depth" in h["metrics_text"]
+        assert "ttft_p99_s" in h and "latency_p99_s" in h
+        assert "metrics_text" not in _get(dec_p, "/healthz")
+
+        router = _spawn_router(
+            rport,
+            "--prefill", f"http://127.0.0.1:{pre_p}",
+            "--decode", f"http://127.0.0.1:{dec_p}",
+            "--handoff", "direct",
+            env_extra={"PFX_FLIGHT_DIR": str(flight_dir)},
+        )
+        _wait_eligible(rport, 2, proc=router)
+
+        body = {"prompt_ids": SYS + [40, 41, 42], "max_tokens": 6,
+                "deadline_s": 60}
+        code, resp = _post(rport, body)
+        assert code == 200, resp
+        trace_id = resp.get("trace_id")
+        assert trace_id, "the router's 200 must carry the stitched handle"
+        # direct-transfer determinism stays tier-1-drilled here (the
+        # disagg byte-bypass drill is slow-marked against this one):
+        # a repeat request through the same chain is token-identical
+        code2, repeat = _post(rport, body)
+        assert code2 == 200
+        assert repeat["completion_ids"] == resp["completion_ids"]
+
+        # ---- ONE stitched timeline with spans from all three
+        # processes, ordered after wall-clock anchoring ----
+        tl = _get(rport, f"/debug/trace?id={trace_id}")
+        assert tl["trace_id"] == trace_id
+        names = [e["name"] for e in tl["events"]]
+        assert "route" in names and "routed" in names  # the router's leg
+        by_role = {}
+        for e in tl["events"]:
+            proc = e.get("proc")
+            if proc:
+                by_role.setdefault(proc["role"], []).append(e)
+        assert set(by_role) == {"prefill", "decode"}, names
+        assert by_role["prefill"][0]["proc"]["replica_id"] == "pre0"
+        assert by_role["decode"][0]["proc"]["replica_id"] == "dec0"
+        # distinct real pids: three processes on one timeline
+        pids = {e["proc"]["pid"] for evs in by_role.values() for e in evs}
+        assert len(pids) == 2 and os.getpid() not in pids
+        # anchored ordering: every remote span inside the request
+        # window (the envelope skew rule's guarantee)...
+        total = tl["total_s"]
+        for evs in by_role.values():
+            for e in evs:
+                assert -1e-3 <= e["at_s"], e
+                assert e["at_s"] + e["dur_s"] <= total + 1e-3, (e, total)
+        # ...and the prefill leg STARTS before the decode leg (the
+        # direct handoff hands off after the export)
+        t_pre = min(e["at_s"] for e in by_role["prefill"])
+        t_dec = min(e["at_s"] for e in by_role["decode"])
+        assert t_pre <= t_dec, (t_pre, t_dec)
+        # the four runbook questions are answerable off this ONE
+        # timeline: queue-at-router (router route gap), prefill compute
+        # (the export span), handoff transfer (the lane gap), decode
+        # adoption + chunks (adopt span + chunk instants)
+        pre_names = {e["name"] for e in by_role["prefill"]}
+        dec_names = {e["name"] for e in by_role["decode"]}
+        assert "queue_wait" in pre_names and "prefill_export" in pre_names
+        assert "adopt" in dec_names, dec_names
+        assert "decode_chunk" in dec_names, dec_names
+        # summaries are bounded + aggregated: dense chunk instants
+        # arrive aggregated (count + summed committed) past the
+        # threshold, individual below it — either way the committed
+        # sum covers every delivered token
+        chunks = [e for e in by_role["decode"]
+                  if e["name"] == "decode_chunk"]
+        committed = sum(e["args"].get("committed", 0) for e in chunks)
+        assert committed >= len(resp["completion_ids"]), (
+            committed, len(resp["completion_ids"]), chunks,
+        )
+
+        # the whole window is Perfetto-loadable with one pid lane per
+        # process (per-lane nesting strict-validated)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rport}/debug/traces")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            doc = json.load(r)
+        lanes = validate_chrome_trace(doc)
+        span_pids = {pid for pid, _ in lanes}
+        assert len(span_pids) >= 3, span_pids  # router + both replicas
+        meta_names = {e["args"]["name"] for e in doc["traceEvents"]
+                      if e["ph"] == "M"}
+        assert any("pre0" in n for n in meta_names), meta_names
+        assert any("dec0" in n for n in meta_names), meta_names
+
+        # ---- federation agreement: the router's pfx_fleet_* scrape
+        # == each replica's own /metrics for spot-checked counters ----
+        views = _get(rport, "/replicas")["replicas"]
+        key_by_role = {v["role"]: v["key"] for v in views}
+        deadline = time.time() + 30
+        while True:
+            rm = _metrics(rport)
+            pre_m, dec_m = _metrics(pre_p), _metrics(dec_p)
+            want = [
+                (key_by_role["prefill"], "prefill",
+                 "pfx_handoff_exports_total",
+                 pre_m.get("pfx_handoff_exports_total",
+                           {}).get(frozenset(), 0.0)),
+                (key_by_role["decode"], "decode",
+                 "pfx_handoff_adopts_total",
+                 dec_m.get("pfx_handoff_adopts_total",
+                           {}).get(frozenset(), 0.0)),
+                (key_by_role["decode"], "decode",
+                 "pfx_serving_tokens_out_total",
+                 dec_m.get("pfx_serving_tokens_out_total",
+                           {}).get(frozenset(), 0.0)),
+            ]
+            if all(
+                _fed_value(rm, name, replica=key, pool=pool) == own
+                for key, pool, name, own in want
+            ):
+                break
+            assert time.time() < deadline, (
+                "federated scrape never agreed with the replicas",
+                want,
+                {k: v for k, v in rm.get("pfx_fleet_metric", {}).items()
+                 if dict(k).get("name", "").startswith("pfx_handoff")},  # noqa — prefix filter, not a metric name
+            )
+            time.sleep(0.3)
+        assert want[0][3] >= 1.0 and want[1][3] >= 1.0  # non-vacuous
+        # staleness gauge: fresh for both replicas; scrape outcomes ok
+        for key in key_by_role.values():
+            age = _lab(rm, "pfx_fleet_scrape_age_seconds", replica=key)
+            assert 0.0 <= age < 10.0, (key, age)
+            assert _lab(rm, "pfx_fleet_scrapes_total",
+                        replica=key, outcome="ok") >= 1.0
+        # the cap did not bite at this fleet size
+        assert rm["pfx_fleet_series_dropped"][frozenset()] == 0.0
+        assert rm["pfx_fleet_series"][frozenset()] > 50.0
+        # direct transport cross-check off the SAME scrape: the payload
+        # provably bypassed the router (its own byte counter flat, the
+        # replicas' direct-transport bytes federated non-zero)
+        assert rm["pfx_router_handoff_bytes_total"][frozenset()] == 0.0
+        assert _fed_value(
+            rm, "pfx_handoff_bytes_total",
+            replica=key_by_role["decode"], pool="decode",
+            transport="direct",
+        ) > 0.0
+
+        # ---- fleet report renders from the router's artifacts alone ----
+        fleet_jsonl = flight_dir / "fleet_metrics.jsonl"
+        deadline = time.time() + 15
+        while not fleet_jsonl.exists() and time.time() < deadline:
+            time.sleep(0.3)
+        assert fleet_jsonl.exists(), list(flight_dir.glob("*"))
+        out = tmp_path / "fleet.html"
+        rep = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "report.py"),
+             "--fleet", str(fleet_jsonl), "-o", str(out)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert rep.returncode == 0, rep.stderr
+        doc = out.read_text()
+        assert key_by_role["prefill"] in doc and key_by_role["decode"] in doc
+        assert "TTFT p99" in doc
+
+        for proc in (router, pre, dec):
+            proc.send_signal(signal.SIGTERM)
+        for proc in (router, pre, dec):
+            assert proc.wait(timeout=60) == 0
+    finally:
+        logs = [_finish(p) for p in (pre, dec)]
+        logs += [_finish(router)]
+    for log in logs:
+        assert "Traceback" not in log, log[-3000:]

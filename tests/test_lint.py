@@ -183,3 +183,55 @@ def test_metrics_docs_drift_is_detected(tmp_path, monkeypatch):
         assert len(missing) == 1 and "missing" in missing[0][3]
     finally:
         _lint._declared_metrics = ...  # drop the tmp-repo cache
+
+
+def test_env_knob_docs_agree_on_the_real_repo():
+    """E12 happy path: every PFX_* knob referenced in package source has
+    a docs table row and no documented knob is stale (the repo-clean
+    test covers this too; this one names the check)."""
+    import lint as _lint
+
+    knobs = _lint.source_env_knobs()
+    assert "PFX_TRACE_SAMPLE" in knobs and "PFX_FAULT" in knobs
+    documented, where = _lint.documented_env_knobs()
+    assert set(knobs) <= documented
+    assert _lint.check_env_knob_docs() == []
+
+
+def test_env_knob_docs_drift_is_detected(tmp_path, monkeypatch):
+    """E12 both directions, hermetically: an undocumented source knob
+    and a stale doc row each produce a finding; prefix building blocks
+    (trailing underscore) and prose mentions don't count."""
+    import lint as _lint
+
+    pkg = tmp_path / "paddlefleetx_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "knobs.py").write_text(
+        '"""k."""\nimport os\n'
+        'A = os.environ.get("PFX_REAL_KNOB")\n'
+        'B = "PFX_PREFIX_"  # building block, not a knob\n'
+    )
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "anydoc.md").write_text(
+        "# d\n\nprose mention of `PFX_PROSE_ONLY` does not count\n\n"
+        "| knob | default | meaning |\n|---|---|---|\n"
+        "| `PFX_STALE_KNOB` | 1 | gone |\n"
+    )
+    monkeypatch.setattr(_lint, "REPO", str(tmp_path))
+    findings = _lint.check_env_knob_docs()
+    codes = {(code, msg.split("'")[1]) for _, _, code, msg in findings}
+    assert ("E12", "PFX_REAL_KNOB") in codes
+    assert ("E12", "PFX_STALE_KNOB") in codes
+    assert len(findings) == 2  # PFX_PREFIX_ and PFX_PROSE_ONLY ignored
+    # findings point at real locations
+    src = next(f for f in findings if "PFX_REAL_KNOB" in f[3])
+    assert src[0].endswith("knobs.py") and src[1] == 3
+    stale = next(f for f in findings if "PFX_STALE_KNOB" in f[3])
+    assert stale[0].endswith("anydoc.md") and stale[1] > 1
+    # documenting the knob clears the source-side finding
+    (docs / "anydoc.md").write_text(
+        "| knob | default | meaning |\n|---|---|---|\n"
+        "| `PFX_REAL_KNOB` | unset | real |\n"
+    )
+    assert _lint.check_env_knob_docs() == []

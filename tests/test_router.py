@@ -66,7 +66,10 @@ class StubReplica:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                # the poll loop GETs /healthz?metrics=1 (federation);
+                # a stub without metrics_text is the pre-federation
+                # replica case — the poller must still parse the health
+                if self.path.split("?", 1)[0] == "/healthz":
                     return self._json(200, stub.health)
                 return self._json(404, {"error": "nope"})
 

@@ -28,17 +28,25 @@ from paddlefleetx_tpu.utils import tracing as TR
 
 def validate_chrome_trace(doc):
     """Assert `doc` is a loadable Chrome trace-event document: a
-    ``traceEvents`` list whose every event carries ph/ts/dur/pid/tid/
-    name, with non-negative numeric ts/dur, and — per (pid, tid) lane —
-    valid nesting: any two spans are either disjoint or one strictly
-    contains the other (Perfetto renders partial overlap as garbage).
-    Returns the events grouped per lane."""
+    ``traceEvents`` list whose spans (``ph="X"``) carry ph/ts/dur/pid/
+    tid/name with non-negative numeric ts/dur, whose metadata rows
+    (``ph="M"``, the pid-lane labels the wall-clock-anchored exporter
+    emits) carry pid + a known metadata name, and — per (pid, tid)
+    lane — valid nesting: any two spans are either disjoint or one
+    strictly contains the other (Perfetto renders partial overlap as
+    garbage).  Returns the span events grouped per lane."""
     assert isinstance(doc, dict) and isinstance(doc.get("traceEvents"), list), doc
     lanes = {}
     for i, ev in enumerate(doc["traceEvents"]):
+        assert ev.get("ph") in ("X", "M"), f"event {i}: unknown ph: {ev}"
+        if ev["ph"] == "M":
+            # process/thread metadata label rows (no ts/dur)
+            assert ev.get("name") in ("process_name", "thread_name"), ev
+            assert isinstance(ev.get("pid"), int), ev
+            assert isinstance(ev.get("args", {}).get("name"), str), ev
+            continue
         for key in ("ph", "ts", "dur", "pid", "tid", "name"):
             assert key in ev, f"event {i} missing {key!r}: {ev}"
-        assert ev["ph"] == "X", f"event {i}: only complete spans: {ev['ph']}"
         assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0, ev
         assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0, ev
         assert isinstance(ev["name"], str) and ev["name"], ev

@@ -28,6 +28,15 @@ actually bite:
       docs/observability.md, and every row there must name a declared
       metric — the doc drifted from the table twice before this gate.
       (Repo-level check: runs once per invocation, not per file.)
+  E12 env-knob docs agreement (two-way, like E11): every `PFX_*` env
+      knob referenced in PACKAGE source (paddlefleetx_tpu/, tools/,
+      benchmarks/, bench.py — tests excluded: a test-only helper knob
+      is not an operator surface) must appear in a docs knob TABLE row
+      (any docs/*.md markdown table line carrying the backticked name),
+      and every documented knob must still exist in source — an
+      operator reading the tracing/telemetry/serving/fault knob tables
+      sees every knob that exists and no knob that does not.
+      (Repo-level check: runs once per invocation, not per file.)
 
 Suppress a finding with `# noqa` on the offending line.
 Usage: python tools/lint.py [paths...]   (default: the whole repo)
@@ -143,6 +152,95 @@ def check_metrics_docs():
             doc_path, linenos.get(name, 1), "E11",
             f"documented metric '{name}' is not declared in "
             "telemetry.METRICS (stale doc row?)",
+        ))
+    return findings
+
+
+# E12: env-knob docs agreement.  A knob is a FULL name (no trailing
+# underscore: `f"PFX_RETRY_{field}"`-style prefixes are building blocks,
+# not knobs); the docs side accepts any markdown table row in docs/*.md
+# carrying the backticked name.
+_ENV_KNOB_RE = re.compile(r"^PFX_[A-Z0-9]+(_[A-Z0-9]+)*$")
+# source scope: operator-facing code only (tests set knobs too, but a
+# test-only helper name is not an operator surface)
+_ENV_KNOB_DIRS = ["paddlefleetx_tpu", "tools", "benchmarks"]
+_ENV_KNOB_FILES = ["bench.py"]
+
+
+def source_env_knobs():
+    """name -> (file, lineno) of every PFX_* string literal in package
+    source (first sighting wins)."""
+    knobs = {}
+    paths = (
+        [os.path.join(REPO, d) for d in _ENV_KNOB_DIRS]
+        + [os.path.join(REPO, f) for f in _ENV_KNOB_FILES]
+    )
+    for path in iter_py_files(paths):
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue  # E1 reports it
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _ENV_KNOB_RE.match(node.value)
+            ):
+                knobs.setdefault(node.value, (path, node.lineno))
+    return knobs
+
+
+def documented_env_knobs():
+    """(names, first-sighting {name: (file, lineno)}) for every
+    backticked PFX_* name on a markdown TABLE row in docs/*.md."""
+    names, where = set(), {}
+    docs_dir = os.path.join(REPO, "docs")
+    try:
+        files = sorted(os.listdir(docs_dir))
+    except OSError:
+        return names, where
+    row_re = re.compile(r"`(PFX_[A-Z0-9_]+)`")
+    for fn in files:
+        if not fn.endswith(".md"):
+            continue
+        path = os.path.join(docs_dir, fn)
+        try:
+            with open(path) as f:
+                lines = f.read().split("\n")
+        except OSError:
+            continue
+        for i, ln in enumerate(lines, 1):
+            if not ln.lstrip().startswith("|"):
+                continue  # knob TABLE rows only, not prose mentions
+            for m in row_re.finditer(ln):
+                name = m.group(1)
+                if _ENV_KNOB_RE.match(name):
+                    names.add(name)
+                    where.setdefault(name, (path, i))
+    return names, where
+
+
+def check_env_knob_docs():
+    """E12 (repo-level, once per run): PFX_* knobs in source <-> docs
+    knob tables, both directions."""
+    knobs = source_env_knobs()
+    documented, where = documented_env_knobs()
+    findings = []
+    for name in sorted(set(knobs) - documented):
+        path, lineno = knobs[name]
+        findings.append((
+            path, lineno, "E12",
+            f"env knob '{name}' is referenced in source but has no row "
+            "in any docs/*.md knob table — document it "
+            "(tracing/telemetry/serving/fault docs)",
+        ))
+    for name in sorted(documented - set(knobs)):
+        path, lineno = where[name]
+        findings.append((
+            path, lineno, "E12",
+            f"documented env knob '{name}' is not referenced anywhere "
+            "in source (stale doc row?)",
         ))
     return findings
 
@@ -355,9 +453,10 @@ def main(argv=None):
     for path in iter_py_files(paths):
         n_files += 1
         all_findings.extend(check_file(path))
-    # E11 is a repo-level invariant (code table <-> doc table), checked
-    # once per run rather than per file
+    # E11/E12 are repo-level invariants (code table <-> doc table),
+    # checked once per run rather than per file
     all_findings.extend(check_metrics_docs())
+    all_findings.extend(check_env_knob_docs())
     for path, lineno, code, msg in sorted(all_findings):
         rel = os.path.relpath(path, REPO)
         print(f"{rel}:{lineno}: {code} {msg}")

@@ -25,6 +25,18 @@ an annotated event table.  Usage::
 
 ``--run-dir`` scans for the conventional file names.  Exit is nonzero
 only when NO input artifact could be read.
+
+Fleet mode (``--fleet [fleet_metrics.jsonl]``, docs/observability.md
+"Fleet metrics federation"): renders the FLEET view from the router's
+own append-only artifact (`core/router.FleetLog` — per-replica samples
+every poll cadence + controller scale events), with the same
+crash-tolerance contract: per-replica TTFT/latency/occupancy/depth
+curves with scale events as markers, the handoff byte/time breakdown by
+transport, and a last-known per-replica state table.  With no path the
+conventional locations are scanned (``--run-dir``, then
+``$PFX_FLIGHT_DIR``/``./artifacts``)::
+
+    python tools/report.py --fleet artifacts/fleet_metrics.jsonl -o fleet.html
 """
 
 import argparse
@@ -203,6 +215,84 @@ def _first_existing(*paths: str) -> Optional[str]:
 
 
 # ---------------------------------------------------------------------------
+# fleet artifact (core/router.FleetLog JSONL)
+# ---------------------------------------------------------------------------
+
+
+class FleetData:
+    """The router's fleet_metrics.jsonl, parsed: per-replica sample rows
+    (time-ordered), router self-samples, and controller scale events —
+    whatever subset a crashed router managed to append (torn tail lines
+    land as ``unparseable`` and are skipped loudly in the notes)."""
+
+    def __init__(self) -> None:
+        self.sources: List[str] = []
+        self.notes: List[str] = []
+        self.samples: Dict[str, List[Dict[str, Any]]] = {}  # replica -> rows
+        self.router_rows: List[Dict[str, Any]] = []
+        self.scale_events: List[Dict[str, Any]] = []
+        self.t0: Optional[float] = None
+
+    def add(self, path: str) -> None:
+        bad = 0
+        for row in load_jsonl(path):
+            kind = row.get("event")
+            ts = row.get("ts")
+            if kind == "unparseable" or not isinstance(ts, (int, float)):
+                bad += 1
+                continue
+            if self.t0 is None or ts < self.t0:
+                self.t0 = ts
+            if kind == "replica_sample" and row.get("replica"):
+                self.samples.setdefault(str(row["replica"]), []).append(row)
+            elif kind == "router_sample":
+                self.router_rows.append(row)
+            elif kind == "scale":
+                self.scale_events.append(row)
+        for rows in self.samples.values():
+            rows.sort(key=lambda r: r["ts"])
+        self.router_rows.sort(key=lambda r: r["ts"])
+        if bad:
+            self.notes.append(
+                f"{bad} unparseable/partial line(s) skipped in {path} "
+                "(a crashed run's torn tail is expected)"
+            )
+        self.sources.append(f"fleet: {path}")
+
+    def rel(self, ts: float) -> float:
+        return round(ts - (self.t0 or 0.0), 1)
+
+    def series(self, replica: str, key: str) -> List[Tuple[float, float]]:
+        out = []
+        for r in self.samples.get(replica, []):
+            v = r.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out.append((self.rel(r["ts"]), float(v)))
+        return out
+
+    def last(self, replica: str) -> Dict[str, Any]:
+        rows = self.samples.get(replica, [])
+        return rows[-1] if rows else {}
+
+    def replicas(self) -> List[str]:
+        return sorted(self.samples)
+
+    def markers(self) -> List[Tuple[float, str, str]]:
+        """Scale events as ``(x, color, label)`` chart markers (x =
+        relative seconds; a LIST — two pools scaling in the same tick
+        must both render, a time-keyed dict would keep only one)."""
+        out: List[Tuple[float, str, str]] = []
+        for e in self.scale_events:
+            color = "#dc2626" if e.get("action") == "scale_down" else "#059669"
+            out.append((
+                self.rel(e["ts"]), color,
+                f"{e.get('pool', 'fleet')} {e.get('action', '?')}: "
+                f"{e.get('reason', '')}",
+            ))
+        return out
+
+
+# ---------------------------------------------------------------------------
 # SVG primitives (hand-rolled: self-contained, no chart deps)
 # ---------------------------------------------------------------------------
 
@@ -263,6 +353,70 @@ def svg_line(
     parts += [
         f'<text x="{PAD}" y="{H - 6}" class="ax">{_fmt(xlo)}</text>',
         f'<text x="{W - 8}" y="{H - 6}" text-anchor="end" class="ax">{_fmt(xhi)}</text>',
+        f'<text x="{PAD - 4}" y="{H - 20}" text-anchor="end" class="ax">{_fmt(ylo)}</text>',
+        f'<text x="{PAD - 4}" y="16" text-anchor="end" class="ax">{_fmt(yhi)}</text>',
+        "</svg>",
+    ]
+    return (
+        f'<div class="chart"><h3>{html.escape(title)}</h3>' + "".join(parts) + "</div>"
+    )
+
+
+_SERIES_PALETTE = (
+    "#2563eb", "#d97706", "#059669", "#dc2626", "#7c3aed",
+    "#0891b2", "#be123c", "#4d7c0f",
+)
+
+
+def svg_multi_line(
+    title: str,
+    series_by_label: Dict[str, Sequence[Tuple[float, float]]],
+    markers: Optional[Sequence[Tuple[float, str, str]]] = None,
+) -> str:
+    """One chart, one polyline per labeled series (per-replica fleet
+    curves), shared axes, inline legend; ``markers`` is a list of
+    ``(x, color, label)`` vertical annotation lines (a list, not a
+    dict keyed by x — coincident events must all render)."""
+    series_by_label = {k: list(v) for k, v in series_by_label.items() if v}
+    if not series_by_label:
+        return (
+            f'<div class="chart"><h3>{html.escape(title)}</h3>'
+            "<p class='note'>no data</p></div>"
+        )
+    xs = [x for s in series_by_label.values() for x, _ in s]
+    ys = [y for s in series_by_label.values() for _, y in s]
+    fx, xlo, xhi = _scale(xs, PAD, W - 8)
+    fy, ylo, yhi = _scale(ys, H - 20, 12)
+    parts = [
+        f'<svg viewBox="0 0 {W} {H}" role="img" aria-label="{html.escape(title)}">',
+        f'<rect x="0" y="0" width="{W}" height="{H}" fill="#fafafa"/>',
+        f'<line x1="{PAD}" y1="{H - 20}" x2="{W - 8}" y2="{H - 20}" stroke="#999"/>',
+        f'<line x1="{PAD}" y1="12" x2="{PAD}" y2="{H - 20}" stroke="#999"/>',
+    ]
+    for x, mcolor, label in sorted(markers or []):
+        if xlo <= x <= xhi:
+            parts.append(
+                f'<line x1="{fx(x):.1f}" y1="12" x2="{fx(x):.1f}" '
+                f'y2="{H - 20}" stroke="{mcolor}" stroke-dasharray="3,2">'
+                f"<title>{html.escape(label)} @ {x:g}s</title></line>"
+            )
+    legend = []
+    for i, (label, series) in enumerate(sorted(series_by_label.items())):
+        color = _SERIES_PALETTE[i % len(_SERIES_PALETTE)]
+        pts = " ".join(f"{fx(x):.1f},{fy(y):.1f}" for x, y in series)
+        parts.append(
+            f'<polyline fill="none" stroke="{color}" stroke-width="1.5" '
+            f'points="{pts}"><title>{html.escape(label)}</title></polyline>'
+        )
+        lx = PAD + 6 + 90 * i
+        legend.append(
+            f'<rect x="{lx}" y="2" width="8" height="8" fill="{color}"/>'
+            f'<text x="{lx + 11}" y="10" class="ax">{html.escape(label)}</text>'
+        )
+    parts += legend
+    parts += [
+        f'<text x="{PAD}" y="{H - 6}" class="ax">{_fmt(xlo)}s</text>',
+        f'<text x="{W - 8}" y="{H - 6}" text-anchor="end" class="ax">{_fmt(xhi)}s</text>',
         f'<text x="{PAD - 4}" y="{H - 20}" text-anchor="end" class="ax">{_fmt(ylo)}</text>',
         f'<text x="{PAD - 4}" y="16" text-anchor="end" class="ax">{_fmt(yhi)}</text>',
         "</svg>",
@@ -543,6 +697,183 @@ def render_html(data: RunData, title: str) -> str:
     return "\n".join(out) + "\n"
 
 
+def fleet_summary(data: FleetData) -> List[Tuple[str, Any]]:
+    reps = data.replicas()
+    span = 0.0
+    all_ts = [r["ts"] for rows in data.samples.values() for r in rows]
+    all_ts += [r["ts"] for r in data.router_rows]
+    if all_ts:
+        span = max(all_ts) - min(all_ts)
+    pools = sorted({data.last(r).get("pool", "?") for r in reps})
+    proxied = max(
+        (r.get("handoff_bytes_proxied", 0) or 0 for r in data.router_rows),
+        default=0,
+    )
+    direct = sum(
+        data.last(r).get("handoff_bytes_direct", 0) or 0 for r in reps
+    )
+    ups = sum(1 for e in data.scale_events if e.get("action") == "scale_up")
+    downs = sum(
+        1 for e in data.scale_events if e.get("action") == "scale_down"
+    )
+    return [
+        ("replicas seen", f"{len(reps)} ({', '.join(reps)})" if reps else "0"),
+        ("pools", ", ".join(pools) if pools else "n/a"),
+        ("window", f"{span:.1f}s of samples"),
+        ("scale events", f"{ups} up / {downs} down"),
+        ("handoff bytes", f"{_bytes(direct)} direct / "
+                          f"{_bytes(proxied)} proxied via router"),
+        ("router samples", len(data.router_rows)),
+    ]
+
+
+_FLEET_CURVES = (
+    ("ttft_p99_s", "TTFT p99 (s) per replica"),
+    ("latency_p99_s", "latency p99 (s) per replica"),
+    ("occupancy", "continuous-batch occupancy per replica"),
+    ("depth", "reported queue depth per replica"),
+    ("kv_blocks_used", "KV arena blocks used per replica"),
+)
+
+_FLEET_STATE_COLS = (
+    "pool", "state", "depth", "occupancy", "ttft_p99_s", "latency_p99_s",
+    "kv_blocks_used", "kv_blocks_available", "tokens_out_total",
+    "handoff_exports_total", "handoff_adopts_total",
+)
+
+
+def render_fleet_html(data: FleetData, title: str) -> str:
+    markers = data.markers()
+    out = [
+        "<!doctype html>",
+        '<html lang="en"><head><meta charset="utf-8">',
+        f"<title>{html.escape(title)}</title>",
+        f"<style>{CSS}</style></head><body>",
+        f"<h1>{html.escape(title)}</h1>",
+        "<p>" + " · ".join(html.escape(s) for s in data.sources) + "</p>",
+    ]
+    for n in data.notes:
+        out.append(f'<p class="note">{html.escape(n)}</p>')
+    out.append("<h2>Summary</h2><table>")
+    for k, v in fleet_summary(data):
+        out.append(
+            f"<tr><th>{html.escape(str(k))}</th><td>{html.escape(str(v))}</td></tr>"
+        )
+    out.append("</table>")
+
+    out.append("<h2>Per-replica curves</h2>")
+    for key, label in _FLEET_CURVES:
+        out.append(svg_multi_line(
+            label,
+            {r: data.series(r, key) for r in data.replicas()},
+            markers,
+        ))
+
+    out.append("<h2>Handoff breakdown</h2>")
+    out.append("<table><tr><th>replica</th><th>pool</th>"
+               "<th>direct bytes</th><th>proxy bytes</th>"
+               "<th>exports</th><th>adopts</th></tr>")
+    for r in data.replicas():
+        last = data.last(r)
+        out.append(
+            "<tr>" + "".join(
+                f"<td>{html.escape(str(c))}</td>" for c in (
+                    r, last.get("pool", "?"),
+                    _bytes(last.get("handoff_bytes_direct", 0) or 0),
+                    _bytes(last.get("handoff_bytes_proxy", 0) or 0),
+                    int(last.get("handoff_exports_total", 0) or 0),
+                    int(last.get("handoff_adopts_total", 0) or 0),
+                )
+            ) + "</tr>"
+        )
+    if data.router_rows:
+        rr = data.router_rows[-1]
+        out.append(
+            "<tr>" + "".join(
+                f"<td>{html.escape(str(c))}</td>" for c in (
+                    "(router)", "front door",
+                    "—", _bytes(rr.get("handoff_bytes_proxied", 0) or 0),
+                    f"{int(rr.get('handoff_count', 0) or 0)} chains",
+                    f"{(rr.get('handoff_seconds_sum', 0) or 0):.2f}s total",
+                )
+            ) + "</tr>"
+        )
+    out.append("</table>")
+
+    out.append("<h2>Last known per-replica state</h2>")
+    out.append("<table><tr><th>replica</th>" + "".join(
+        f"<th>{c}</th>" for c in _FLEET_STATE_COLS) + "</tr>")
+    for r in data.replicas():
+        last = data.last(r)
+        out.append("<tr><td>" + html.escape(r) + "</td>" + "".join(
+            f"<td>{html.escape(str(last.get(c, '')))}</td>"
+            for c in _FLEET_STATE_COLS
+        ) + "</tr>")
+    out.append("</table>")
+
+    if data.scale_events:
+        out.append("<h2>Scale events</h2>")
+        out.append("<table><tr><th>t (s)</th><th>pool</th><th>action</th>"
+                   "<th>target</th><th>reason</th></tr>")
+        for e in data.scale_events:
+            out.append("<tr>" + "".join(
+                f"<td>{html.escape(str(c))}</td>" for c in (
+                    f"{data.rel(e['ts']):g}", e.get("pool", "fleet"),
+                    e.get("action", "?"), e.get("target", ""),
+                    str(e.get("reason", ""))[:160],
+                )
+            ) + "</tr>")
+        out.append("</table>")
+    out.append("</body></html>")
+    return "\n".join(out) + "\n"
+
+
+def render_fleet_markdown(data: FleetData, title: str) -> str:
+    lines = [f"# {title}", "", "sources: " + "; ".join(data.sources), ""]
+    for n in data.notes:
+        lines.append(f"> NOTE: {n}")
+    lines += ["", "## Summary", "", "| key | value |", "|---|---|"]
+    for k, v in fleet_summary(data):
+        lines.append(f"| {k} | {v} |")
+    lines += ["", "## Last known per-replica state", "",
+              "| replica | " + " | ".join(_FLEET_STATE_COLS) + " |",
+              "|" + "---|" * (len(_FLEET_STATE_COLS) + 1)]
+    for r in data.replicas():
+        last = data.last(r)
+        lines.append("| " + " | ".join(
+            [r] + [str(last.get(c, "")) for c in _FLEET_STATE_COLS]
+        ) + " |")
+    if data.scale_events:
+        lines += ["", "## Scale events", "",
+                  "| t (s) | pool | action | reason |", "|---|---|---|---|"]
+        for e in data.scale_events:
+            lines.append(
+                f"| {data.rel(e['ts']):g} | {e.get('pool', 'fleet')} | "
+                f"{e.get('action', '?')} | "
+                f"{str(e.get('reason', ''))[:120]} |"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def find_fleet_artifact(args) -> Optional[str]:
+    """Resolve the fleet JSONL: an explicit ``--fleet PATH`` wins, then
+    the conventional names under ``--run-dir``, ``$PFX_FLIGHT_DIR``, and
+    ``./artifacts``."""
+    if args.fleet and args.fleet != "auto":
+        return args.fleet
+    candidates = []
+    if args.run_dir:
+        candidates += [
+            os.path.join(args.run_dir, "fleet_metrics.jsonl"),
+            os.path.join(args.run_dir, "artifacts", "fleet_metrics.jsonl"),
+        ]
+    candidates.append(os.path.join(
+        os.environ.get("PFX_FLIGHT_DIR") or "artifacts",
+        "fleet_metrics.jsonl",
+    ))
+    return _first_existing(*candidates)
+
+
 def render_markdown(data: RunData, title: str) -> str:
     lines = [f"# {title}", "", "sources: " + "; ".join(data.sources), ""]
     for n in data.notes:
@@ -583,12 +914,40 @@ def main(argv=None) -> int:
     ap.add_argument("--flight", help="flight_recorder.jsonl dump")
     ap.add_argument("--trace", help="Chrome-trace JSON export")
     ap.add_argument("--run-dir", help="directory to scan for the conventional names")
+    ap.add_argument("--fleet", nargs="?", const="auto", default=None,
+                    help="render the FLEET report from the router's "
+                    "fleet_metrics.jsonl instead of a training run "
+                    "(optional path; default scans --run-dir / "
+                    "$PFX_FLIGHT_DIR / ./artifacts)")
     ap.add_argument("-o", "--out", default="report.html",
                     help="output path ('-' = stdout)")
     ap.add_argument("--format", choices=("html", "md"), default=None,
                     help="default: by --out extension (html unless .md)")
     ap.add_argument("--title", default="PaddleFleetX-TPU run report")
     args = ap.parse_args(argv)
+
+    fmt = args.format or ("md" if args.out.endswith(".md") else "html")
+    if args.fleet is not None:
+        path = find_fleet_artifact(args)
+        data = FleetData()
+        if path:
+            try:
+                data.add(path)
+            except OSError as e:
+                data.notes.append(f"could not read fleet artifact {path}: {e!r}")
+        if not data.sources:
+            print("report.py: no readable fleet artifact (give --fleet "
+                  "PATH or point --run-dir/$PFX_FLIGHT_DIR at the "
+                  "router's artifacts)", file=sys.stderr)
+            return 2
+        if args.title == "PaddleFleetX-TPU run report":
+            args.title = "PaddleFleetX-TPU fleet report"
+        doc = (render_fleet_markdown if fmt == "md"
+               else render_fleet_html)(data, args.title)
+        return _emit(doc, args, fmt, what=(
+            f"{sum(len(v) for v in data.samples.values())} replica "
+            f"samples, {len(data.scale_events)} scale events"
+        ))
 
     data = find_artifacts(args)
     if not data.sources:
@@ -597,17 +956,21 @@ def main(argv=None) -> int:
         for n in data.notes:
             print(f"  {n}", file=sys.stderr)
         return 2
-    fmt = args.format or ("md" if args.out.endswith(".md") else "html")
     doc = (render_markdown if fmt == "md" else render_html)(data, args.title)
+    return _emit(doc, args, fmt, what=(
+        f"{len(data.records)} step records, {len(data.events)} events, "
+        f"{len(data.compiles)} compiles"
+    ))
+
+
+def _emit(doc: str, args, fmt: str, what: str) -> int:
     if args.out == "-":
         sys.stdout.write(doc)
     else:
         with open(args.out, "w") as f:
             f.write(doc)
         kind = "markdown" if fmt == "md" else "self-contained HTML"
-        print(f"report.py: wrote {kind} report to {args.out} "
-              f"({len(data.records)} step records, {len(data.events)} events, "
-              f"{len(data.compiles)} compiles)")
+        print(f"report.py: wrote {kind} report to {args.out} ({what})")
     return 0
 
 

@@ -100,12 +100,16 @@ def serve_router(args) -> int:
         ReplicaSupervisor,
         ScalePolicy,
     )
+    from urllib.parse import parse_qs, urlsplit
+
     from paddlefleetx_tpu.core.request_queue import QueueClosed, QueueFull
     from paddlefleetx_tpu.core.router import (
+        FleetLog,
         NoReplicaAvailable,
         ReplicaUnavailable,
         RouterCore,
         _DownstreamError,
+        admin_headers,
         check_admin,
     )
     from paddlefleetx_tpu.utils.telemetry import (
@@ -113,6 +117,7 @@ def serve_router(args) -> int:
         get_flight_recorder,
         get_registry,
     )
+    from paddlefleetx_tpu.utils import tracing
     from paddlefleetx_tpu.utils.tracing import chrome_trace, get_trace_buffer
 
     replicas = [(u, "monolith") for u in args.replica]
@@ -209,6 +214,15 @@ def serve_router(args) -> int:
         "listen": f"{args.host}:{args.port}",
         "pid": os.getpid(),
     }
+    tracing.set_process_identity(
+        replica_id=identity["replica_id"], role="router",
+    )
+    # fleet observability artifact: one sample row per replica per poll
+    # cadence + controller scale events — what tools/report.py --fleet
+    # renders from the router's artifacts alone (crash-tolerant JSONL)
+    core.fleet_log = FleetLog(
+        os.path.join(flight_dir(), "fleet_metrics.jsonl")
+    )
     flags = {"draining": False}
     default_deadline = float(args.deadline)
     max_deadline = float(args.max_deadline)
@@ -294,6 +308,24 @@ def serve_router(args) -> int:
                     return self._json(
                         200, chrome_trace(trace_buffer.traces())
                     )
+                parts = urlsplit(self.path)
+                if parts.path == "/debug/trace":
+                    # ONE stitched timeline: the router's own routing
+                    # events plus every hop's remote spans (each naming
+                    # its process) on one wall-clock-anchored axis —
+                    # the fleet "why is this request slow" entry point
+                    tid = (parse_qs(parts.query).get("id") or [""])[0]
+                    if not tid:
+                        return self._json(
+                            400, {"error": "need ?id=<trace_id>"})
+                    tc = trace_buffer.get(tid)
+                    if tc is None:
+                        return self._json(404, {
+                            "error": f"trace {tid!r} not in the sampled "
+                                     f"window (cap {trace_buffer.cap}, "
+                                     f"sample {trace_buffer.sample:g})"
+                        })
+                    return self._json(200, tc.timeline())
                 if self.path == "/debug/controller":
                     if not controllers:
                         return self._json(404, {
@@ -368,7 +400,12 @@ def serve_router(args) -> int:
                     status, data, ctype = core.dispatch(
                         "POST", "/generate", body,
                         role="monolith", deadline_s=deadline_s,
-                        headers={"Content-Type": "application/json"},
+                        # the fleet token rides along so a token-gated
+                        # replica honors the trace-propagation headers
+                        # (serve.py accepts them only from callers that
+                        # pass the admin rule)
+                        headers={"Content-Type": "application/json",
+                                 **admin_headers()},
                         trace=trace,
                     )
                 except NoReplicaAvailable as e:
@@ -480,6 +517,40 @@ def serve_router(args) -> int:
         # and start each control loop; the poller walks each replica
         # booting -> warm -> serving as it answers /healthz
         ctl.start()
+
+    stop_scale_log = threading.Event()
+
+    def _scale_event_log():
+        # mirror controller scale decisions into the fleet log so the
+        # offline fleet report can mark them on the curves — only NEW
+        # non-hold rows are appended, tracked by each row's monotonic
+        # `tick` (a LENGTH high-water mark would stall forever once the
+        # bounded deque reaches maxlen and len() stops growing)
+        seen = {id(c): 0 for c in controllers}
+        while not stop_scale_log.wait(1.0):
+            for ctl in controllers:
+                last = seen[id(ctl)]
+                # view() copies the log under the controller's own
+                # lock — iterating the live deque would race tick()'s
+                # append ("deque mutated during iteration" would kill
+                # this thread and silently end scale-event mirroring)
+                for row in ctl.view().get("decisions", []):
+                    tick = int(row.get("tick", 0))
+                    if tick <= last:
+                        continue
+                    seen[id(ctl)] = max(seen[id(ctl)], tick)
+                    if row.get("action") not in (None, "hold"):
+                        core.fleet_log.event({
+                            "event": "scale",
+                            "pool": ctl.role or "fleet",
+                            "action": row.get("action"),
+                            "reason": row.get("reason", ""),
+                            "target": row.get("target"),
+                        })
+
+    if controllers:
+        threading.Thread(target=_scale_event_log,
+                         name="router-scale-log", daemon=True).start()
     mode = identity["scheduler"]
     supervising = ""
     if pool_supervise:
@@ -519,6 +590,7 @@ def serve_router(args) -> int:
         _force_quit("serving")
     finally:
         try:
+            stop_scale_log.set()
             for ctl in controllers:
                 # stop scaling first, then drain the children
                 # gracefully: each managed replica gets SIGTERM,

@@ -227,7 +227,17 @@ def build_scheduler(server, scheduler: str, *, queue_depth: int,
         )
 
         def prefill_runner(prompts, max_new):
-            return [engine.prefill_export(p, max_new) for p in prompts]
+            # per-prompt traces ride RequestQueue.batch_traces (set by
+            # the scheduler thread for the duration of this call), so
+            # the export's fine-grained prefill_export span lands on
+            # the request's own timeline — the prefill leg a stitched
+            # fleet trace shows is the real export window, not just
+            # the queue's coarse decode envelope
+            traces = queue.batch_traces or [None] * len(prompts)
+            return [
+                engine.prefill_export(p, max_new, trace=tr)
+                for p, tr in zip(prompts, traces)
+            ]
 
         queue = RequestQueue(
             prefill_runner, max_depth=queue_depth, max_coalesce=1,
@@ -288,7 +298,16 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         get_flight_recorder,
         get_registry,
     )
-    from paddlefleetx_tpu.utils.tracing import chrome_trace, get_trace_buffer
+    from paddlefleetx_tpu.utils import tracing
+    from paddlefleetx_tpu.utils.tracing import (
+        SPAN_SUMMARY_HEADER,
+        chrome_trace,
+        get_trace_buffer,
+        parse_span_summaries,
+        remote_parent,
+        remote_parent_from_headers,
+        span_summary,
+    )
 
     reg = get_registry()
     recorder = get_flight_recorder()
@@ -356,6 +375,11 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         "listen": f"{host}:{port}",
         "pid": os.getpid(),
     }
+    # label this process's spans for cross-process exports: the fleet's
+    # stitched timelines name their Perfetto lanes off this identity
+    tracing.set_process_identity(
+        replica_id=identity["replica_id"], role=role,
+    )
 
     # in-flight /generate requests (admission + wait + response write);
     # /healthz surfaces it so an operator tells "busy" from "wedged".
@@ -379,7 +403,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     direct_state = {"n": 0}
     direct_lock = threading.Lock()
 
-    def _direct_handoff(payload: bytes, url: str, fwd_deadline: float):
+    def _direct_handoff(payload: bytes, url: str, fwd_deadline: float,
+                        parent=None):
         """POST one KV-handoff payload straight to the ticketed decode
         replica (auth via the fleet PFX_ADMIN_TOKEN rule, bounded
         timeout, ONE retry for sends that provably never arrived).
@@ -411,6 +436,13 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         with direct_lock:
             direct_state["n"] += 1
             seq = direct_state["n"]
+        # the direct hop carries the ROUTER's trace identity onward so
+        # the decode leg's spans stitch under the same fleet timeline
+        # (prefill -> decode is the one hop the router never sees)
+        fwd_trace = dict(parent and {
+            tracing.TRACE_ID_HEADER: parent["trace_id"],
+            tracing.PARENT_SPAN_HEADER: "handoff_direct",
+        } or {})
         last_err = "send failed"
         t_send = time.monotonic()
         for _attempt in range(2):  # the send + one retry
@@ -428,7 +460,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 last_err = "injected handoff_drop"
                 continue
             try:
-                status, body, _ = _http_request(
+                status, body, _, hdrs = _http_request(
                     url, "POST",
                     f"/decode?deadline_s={left:.3f}",
                     body=payload,
@@ -436,6 +468,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                         "Content-Type": "application/octet-stream",
                         "X-Handoff-Transport": "direct",
                         **admin_headers(),
+                        **fwd_trace,
                     },
                     # the remaining ticket budget is bounded by the
                     # router's --max-deadline: give the socket the same
@@ -462,7 +495,12 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                             transport="direct").inc(len(payload))
                 reg.counter("pfx_handoff_direct_total",
                             outcome="ok").inc()
-                return (200, body, "application/json", None)
+                # the decode replica's span summary rides the relay back
+                # (the /prefill response appends this replica's own, so
+                # the router stitches both legs off one hop)
+                child = hdrs.get(SPAN_SUMMARY_HEADER)
+                return (200, body, "application/json",
+                        {SPAN_SUMMARY_HEADER: child} if child else None)
             if status in (401, 403, 429, 503):
                 # 429/503: capacity/draining — any pool member can take
                 # the payload off the router's proxy leg. 401/403: the
@@ -516,7 +554,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                        headers)
 
         def do_GET(self):
-            if self.path == "/healthz":
+            parts = urlsplit(self.path)
+            if parts.path == "/healthz":
                 # ONE registry snapshot renders the whole health view —
                 # the same snapshot function /metrics exposes, so the two
                 # endpoints agree and no field is read outside a lock
@@ -533,6 +572,10 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     counts["client_gone"] = gone
                 lat = reg.value(
                     "pfx_request_latency_seconds",
+                    default={"p50": 0.0, "p99": 0.0}, snap=snap,
+                )
+                ttft = reg.value(
+                    "pfx_request_ttft_seconds",
                     default={"p50": 0.0, "p99": 0.0}, snap=snap,
                 )
                 # serving numerics come from the SAME snapshot (not a
@@ -583,20 +626,32 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     "counters": counts,
                     "latency_p50_s": round(lat["p50"], 4),
                     "latency_p99_s": round(lat["p99"], 4),
+                    "ttft_p50_s": round(ttft["p50"], 4),
+                    "ttft_p99_s": round(ttft["p99"], 4),
                     **serving_view,
                 }
                 if slo.enabled:
                     # burn-rate view with the breach reason: an operator
                     # reads WHY /healthz is angry without a dashboard
                     body["slo"] = slo.evaluate()
+                if parse_qs(parts.query).get("metrics", ["0"])[0] not in (
+                    "0", "",
+                ):
+                    # fleet federation source (core/router.py): the FULL
+                    # Prometheus exposition rendered from the SAME
+                    # snapshot the health fields above came from — the
+                    # router's poll loop scores routing on these fields
+                    # and re-exports these samples, and because both
+                    # ride one snapshot they can never tell two stories
+                    body["metrics_text"] = reg.render_prometheus(snap)
                 self._json(200, body)
-            elif self.path == "/metrics":
+            elif parts.path == "/metrics":
                 # Prometheus text exposition of the same registry snapshot
                 self._send(
                     200, reg.render_prometheus().encode(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
-            elif self.path.startswith("/debug/"):
+            elif parts.path.startswith("/debug/"):
                 self._debug_get()
             else:
                 self._json(404, {"error": "unknown path"})
@@ -767,7 +822,15 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     "already_draining": already,
                     "queued": queue.depth(),
                 })
-                initiate_drain("admin drain")
+                # a drain initiated over a traced hop names the caller's
+                # trace in the postmortem, so an operator can tie this
+                # replica's drain_start to the router action behind it
+                parent = remote_parent_from_headers(self.headers)
+                initiate_drain(
+                    "admin drain" + (
+                        f" (trace {parent['trace_id']})" if parent else ""
+                    )
+                )
                 return
             return self._json(404, {"error": "unknown admin path"})
 
@@ -840,11 +903,44 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 self._json(400, {"error": str(e)})
             return None
 
+        def _remote_parent_authed(self):
+            """Parse the trace-propagation headers, honored only when
+            the request passes the fleet admin rule (token set ->
+            bearer match; unset -> loopback-only): an unauthenticated
+            client must not force-sample traces past the accumulator
+            or receive internal span summaries.  Degrades to untraced
+            (no 401 — propagation is fabric plumbing, not a client
+            API).  /prefill and /decode parse the headers directly:
+            those surfaces are already behind ``_authorized``."""
+            parent = remote_parent_from_headers(self.headers)
+            if parent is None:
+                return None
+            ok, _, _ = check_admin(self.headers, self.client_address,
+                                   what="trace propagation")
+            return parent if ok else None
+
+        def _span_headers(self, fut, parent, carried=None):
+            """Fabric-internal response headers for a traced hop: this
+            process's span summary (appended to any ``carried`` header
+            value a downstream leg returned) + the local trace id.
+            None for plain client traffic — summaries ride only hops
+            that arrived with propagation headers."""
+            if fut is None or fut.trace is None:
+                return None
+            headers = {"X-Trace-Id": fut.trace.trace_id}
+            if parent is not None:
+                summaries = (parse_span_summaries(carried)
+                             if carried else [])
+                summaries.append(span_summary(fut.trace))
+                headers[SPAN_SUMMARY_HEADER] = json.dumps(summaries)
+            return headers
+
         def _generate(self):
             in_flight_gauge.add(1)
             t0 = time.monotonic()
             fut = None
             observed = False  # span + SLO recorded for this request
+            parent = self._remote_parent_authed()
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 try:
@@ -872,14 +968,17 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     )
                 except (ValueError, TypeError) as e:
                     return self._json(400, {"error": str(e)})
-                # ---- admission control ----
-                fut = self._submit_guarded(
-                    lambda: queue.submit(
-                        prompts_ids, trim,
-                        coalesce_key=key, deadline_s=deadline_s,
-                    ),
-                    t0,
-                )
+                # ---- admission control ---- (a hop that arrived with
+                # X-Trace-Id binds its parent so the attached trace is
+                # force-sampled into the caller's stitched timeline)
+                with remote_parent(parent):
+                    fut = self._submit_guarded(
+                        lambda: queue.submit(
+                            prompts_ids, trim,
+                            coalesce_key=key, deadline_s=deadline_s,
+                        ),
+                        t0,
+                    )
                 if fut is None:
                     observed = True  # _submit_guarded answered + spent SLO
                     return
@@ -908,7 +1007,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 )
                 _slo_observe(200, fut, t0)
                 observed = True
-                return self._json(200, payload)
+                return self._json(200, payload,
+                                  headers=self._span_headers(fut, parent))
             except Exception as e:  # noqa: BLE001 — last-resort guard
                 # a failure AFTER decode (tokenizer decode, payload
                 # build) is still a failed request: it must spend SLO
@@ -945,6 +1045,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             in_flight_gauge.add(1)
             t0 = time.monotonic()
             fut = None
+            parent = remote_parent_from_headers(self.headers)
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 try:
@@ -971,13 +1072,14 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                         )
                 except (KeyError, ValueError, TypeError) as e:
                     return self._json(400, {"error": str(e)})
-                fut = self._submit_guarded(
-                    lambda: queue.submit(
-                        [prompt_ids], max_toks,
-                        coalesce_key=None, deadline_s=deadline_s,
-                    ),
-                    t0,
-                )
+                with remote_parent(parent):
+                    fut = self._submit_guarded(
+                        lambda: queue.submit(
+                            [prompt_ids], max_toks,
+                            coalesce_key=None, deadline_s=deadline_s,
+                        ),
+                        t0,
+                    )
                 if fut is None:
                     return
                 exports = self._await_result(fut, deadline_s, t0)
@@ -1000,7 +1102,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                                      "export (forward ticket spent)",
                         })
                     code, body, ctype, headers = _direct_handoff(
-                        payload, fwd_url, fwd_left
+                        payload, fwd_url, fwd_left, parent=parent
                     )
                     latency_hist.observe(time.monotonic() - t0)
                     _record_request_span(reg, recorder, t0, fut, code)
@@ -1011,19 +1113,20 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     # signal is always live, and burning it here would
                     # scale the prefill pool on decode-pool failures
                     _slo_observe(200 if code >= 500 else code, fut, t0)
-                    if fut.trace is not None:
-                        headers = dict(headers or {})
-                        headers["X-Trace-Id"] = fut.trace.trace_id
+                    # append THIS replica's summary to the decode leg's
+                    # (carried back by _direct_handoff): one relayed
+                    # header stitches both legs at the router
+                    carried = (headers or {}).get(SPAN_SUMMARY_HEADER)
+                    span_h = self._span_headers(fut, parent, carried)
+                    if span_h or headers:
+                        headers = {**(headers or {}), **(span_h or {})}
                     return self._send(code, body, ctype, headers)
                 latency_hist.observe(time.monotonic() - t0)
                 _record_request_span(reg, recorder, t0, fut, 200)
                 _slo_observe(200, fut, t0)
                 return self._send(
                     200, payload, "application/octet-stream",
-                    headers=(
-                        {"X-Trace-Id": fut.trace.trace_id}
-                        if fut.trace is not None else None
-                    ),
+                    headers=self._span_headers(fut, parent),
                 )
             except Exception as e:  # noqa: BLE001 — last-resort guard
                 _record_request_span(reg, recorder, t0, fut, 500)
@@ -1043,6 +1146,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             in_flight_gauge.add(1)
             t0 = time.monotonic()
             fut = None
+            parent = remote_parent_from_headers(self.headers)
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
@@ -1063,12 +1167,13 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     meta, arrays = unpack_handoff(body)
                 except (ValueError, TypeError) as e:
                     return self._json(400, {"error": str(e)})
-                fut = self._submit_guarded(
-                    lambda: queue.submit_handoff(
-                        meta, arrays, deadline_s=deadline_s
-                    ),
-                    t0,
-                )
+                with remote_parent(parent):
+                    fut = self._submit_guarded(
+                        lambda: queue.submit_handoff(
+                            meta, arrays, deadline_s=deadline_s
+                        ),
+                        t0,
+                    )
                 if fut is None:
                     return
                 rows = self._await_result(fut, deadline_s, t0)
@@ -1082,7 +1187,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     reg, recorder, t0, fut, 200, tokens=len(rows[0])
                 )
                 _slo_observe(200, fut, t0)
-                return self._json(200, payload)
+                return self._json(200, payload,
+                                  headers=self._span_headers(fut, parent))
             except Exception as e:  # noqa: BLE001 — last-resort guard
                 _record_request_span(reg, recorder, t0, fut, 500)
                 _slo_observe(500, fut, t0)
