@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel test-fleet-obs bench bench-check
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel test-fleet-obs test-decode-overlap bench bench-check
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -106,6 +106,14 @@ test-fleet-obs:
 # tests/.jax_cache like every other drill family)
 test-paged:
 	python -m pytest tests/test_paged_cache.py tests/test_continuous_batching.py tests/test_paged_drills.py -q
+
+# dispatch-ahead decode overlap gate (docs/decode_path.md
+# "Dispatch-ahead decode"): the decision-log replay-equality +
+# mid-overlap ArenaReset units, then the two-process serve+router drill
+# asserting a streamed /generate arrives in >= 2 flushes with monotone
+# token indices and an intact stitched trace
+test-decode-overlap:
+	python -m pytest tests/test_decode_overlap.py -q
 
 # shared-prefix KV reuse gate: refcount/radix-index/COW host units, the
 # engine-level reuse + chunked-prefill parity suite (prefix hits, COW

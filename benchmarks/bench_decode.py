@@ -85,6 +85,12 @@ CASES = {
     # nocache) reporting TTFT percentiles, prefill tokens COMPUTED, and
     # the hit rate (docs/serving.md "Prefix cache")
     "prefix": (None, None, False),
+    # dispatch-ahead A/B: the SAME greedy batch through two continuous
+    # schedulers differing only in ``dispatch_ahead`` — emits TWO rows
+    # (ahead + sync) reporting delivered tokens/s and ``host_gap_ms``,
+    # the per-device-step host gap the overlap exists to hide
+    # (docs/decode_path.md "Dispatch-ahead decode")
+    "overlap": (None, None, False),
 }
 
 # env spellings of the two decode paths (read at trace time).  BOTH are
@@ -113,6 +119,9 @@ def _metrics_for(name: str) -> list:
     if name == "prefix":
         return ["gpt345m_decode_prefix_cached",
                 "gpt345m_decode_prefix_nocache"]
+    if name == "overlap":
+        return ["gpt345m_decode_overlap_ahead",
+                "gpt345m_decode_overlap_sync"]
     return [f"gpt345m_decode_{name}"]
 
 
@@ -726,6 +735,93 @@ def run_prefix_case(args) -> list:
     return rows
 
 
+def run_overlap_case(args) -> list:
+    """Dispatch-ahead ON vs OFF under the SAME greedy batch.
+
+    One batched submission of N prompts through two continuous
+    schedulers on identical engines, differing only in
+    ``dispatch_ahead``.  Each side reports delivered tokens/s plus
+    ``host_gap_ms`` — mean host time per device step spent with NO step
+    in flight (the engine's ``host_gap_s``/``steps`` accounting).  The
+    synchronous side pays the full commit-processing + scheduler-scan
+    gap on EVERY step; the overlapped side only pays it on admission
+    boundaries (chained dispatches land while the previous step is
+    still in flight, gap zero by construction), so its ``host_gap_ms``
+    must come out strictly lower — the contract test pins that.
+    Greedy output token-identity across the sides is counted
+    (``greedy_divergent_rows`` must be 0 at the f32 contract dtype)."""
+    import jax
+    import numpy as np
+
+    from paddlefleetx_tpu.core.continuous_batching import (
+        ContinuousScheduler,
+        PagedDecodeEngine,
+    )
+
+    from bench import knob_env
+
+    n_req = int(os.environ.get("BENCH_OVERLAP_N", 8))
+    server = _serving_server(args, greedy=True)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 50304, args.prompt).tolist()
+               for _ in range(n_req)]
+
+    with knob_env(_OVERHAUL_ENV):
+        sides = {}
+        for label, ahead in (("sync", False), ("ahead", True)):
+            engine = PagedDecodeEngine(server, max_batch=n_req)
+            sched = ContinuousScheduler(engine, max_depth=2 * n_req,
+                                        dispatch_ahead=ahead)
+            sched.warmup([args.prompt])
+            sched.start()
+            # primer OUTSIDE the timed window: compiles the decode
+            # chunk family so the window measures stepping, not traces
+            sched.submit([prompts[0]], args.dec).result(timeout=600)
+            g0 = float(engine.stats["host_gap_s"])
+            n0 = int(engine.stats["gap_steps"])
+            s0 = int(engine.stats["steps"])
+            t0 = time.perf_counter()
+            outs = sched.submit(prompts, args.dec).result(timeout=600)
+            wall = time.perf_counter() - t0
+            sides[label] = {
+                "outs": outs, "wall": wall,
+                "host_gap_s": float(engine.stats["host_gap_s"]) - g0,
+                "gap_steps": int(engine.stats["gap_steps"]) - n0,
+                "steps": max(1, int(engine.stats["steps"]) - s0),
+                "traces": int(engine.stats["traces"]),
+            }
+            sched.shutdown(timeout=60)
+
+    a, b = sides["ahead"], sides["sync"]
+    divergent = sum(1 for x, y in zip(a["outs"], b["outs"]) if x != y)
+    n_dev = jax.device_count()
+    rows = []
+    for label, side in (("ahead", a), ("sync", b)):
+        toks = sum(len(o) for o in side["outs"])
+        rows.append({
+            "metric": f"gpt345m_decode_overlap_{label}",
+            "value": round(toks / side["wall"] / n_dev, 1),
+            "unit": "delivered new tokens/s/chip (dispatch-ahead A/B)",
+            "vs_baseline": None,
+            "dispatch_ahead": label == "ahead",
+            "host_gap_ms": round(
+                side["host_gap_s"] * 1000.0 / side["steps"], 4),
+            "gap_steps": side["gap_steps"],
+            "device_steps": side["steps"],
+            "batch": n_req, "prompt_len": args.prompt,
+            "dec_len": args.dec,
+            "greedy_divergent_rows": divergent,
+            "jit_traces": side["traces"],
+            "strategy": "greedy_search",
+            "decode_path": "overhauled",
+            "scheduler": "continuous",
+            **_mfu_fields(server.module.config,
+                          toks / side["wall"] / n_dev),
+            "platform": jax.default_backend(),
+        })
+    return rows
+
+
 def _parent(argv) -> int:
     from bench import run_child_with_honest_fallback
 
@@ -782,6 +878,8 @@ def _child(argv) -> None:
                 rows = run_staggered_case(args)
             elif name == "prefix":
                 rows = run_prefix_case(args)
+            elif name == "overlap":
+                rows = run_overlap_case(args)
             elif "_spec" in name:
                 rows = [run_spec_case(name, args, params_cache)]
             elif name.endswith("_kvint8"):
@@ -805,7 +903,8 @@ def _argparser():
         "--cases",
         default="b8_greedy,b8_greedy_legacy,b8_topp,b8_topp_legacy,"
                 "b32_greedy,b32_greedy_legacy,b32_topp,b32_topp_legacy,"
-                "b8_greedy_spec4,b8_greedy_kvint8,serving,staggered,prefix",
+                "b8_greedy_spec4,b8_greedy_kvint8,serving,staggered,prefix,"
+                "overlap",
     )
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--dec", type=int, default=256)

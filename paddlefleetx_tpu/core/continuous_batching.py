@@ -47,6 +47,18 @@ per-row positions/budgets, arena occupancy, compile-key families) that
 `tools/serve.py` exposes as ``GET /debug/state`` without ever blocking
 this thread.
 
+Dispatch-ahead decode (docs/decode_path.md): with
+``PFX_DISPATCH_AHEAD=1`` (the scheduler default) the engine leaves each
+dispatched step IN FLIGHT and fetches its sampled tokens one call
+later, chaining the next dispatch on device-resident row state — the
+host's scheduling work (and the ``PFX_SCHED_QUANTUM``-amortized
+admission/eviction scans) runs in the device's shadow instead of on the
+decode critical path.  Committed tokens can stream to a per-request
+sink as they land (``submit(..., stream=...)``).  Decision-log rows
+account every event in COMMIT order, so ``replay_decision_log`` folds
+to identical totals with overlap on or off; ``PFX_DISPATCH_AHEAD=0`` is
+the loud fallback to fully-synchronous stepping.
+
 Greedy outputs are token-identical to the sequential/coalesced path
 (same logits-processor chain per row, per-row positions equal to the
 contiguous path's real-token positions); sampling rows draw from a
@@ -156,6 +168,13 @@ class _CBEntry:
     # of a prompt to prefill — the admission loop ADOPTS the exported
     # blocks (engine.adopt) rather than running paged_prefill
     handoff: Optional[tuple] = None
+    # token streaming (docs/serving.md): a callable
+    # ``stream(row_idx, start, tokens)`` invoked on the SCHEDULER thread
+    # as each step's commits land (start = index of tokens[0] in the
+    # row's output so far).  Sinks must be fast and never raise into the
+    # batch — the engine logs and drops a failing sink's push, the
+    # tokens are already committed either way.
+    stream: Optional[Any] = None
 
     def __post_init__(self) -> None:
         self.results = [None] * len(self.prompts)
@@ -265,11 +284,15 @@ class PagedDecodeEngine:
         # "prefill_tokens" counts prompt tokens actually COMPUTED — a
         # prefix hit's shared span never enters it, the reuse evidence;
         # "prefill_chunks" counts chunk dispatches)
+        # ("host_gap_s"/"gap_steps" measure host time the device sat
+        # idle between consuming one step's results and receiving the
+        # next dispatch — benchmarks/bench_decode.py's host_gap_ms)
         self.stats: Dict[str, Any] = {
             "traces": 0, "steps": 0, "prefills": 0,
             "spec_proposed": 0, "spec_accepted": 0,
             "exports": 0, "adopts": 0,
             "prefill_tokens": 0, "prefill_chunks": 0,
+            "host_gap_s": 0.0, "gap_steps": 0,
         }
         # True only inside warmup(): warmup admits/steps are not traffic
         # and must not bump the traffic-facing registry counters (the
@@ -282,6 +305,15 @@ class PagedDecodeEngine:
         # decode_step never reads max_dec_len (budgets are per-row DATA):
         # normalize it out of the compile key
         self._gen_key = dataclasses.replace(self.gen, max_dec_len=0)
+        # dispatch-ahead decode (docs/decode_path.md): when True, step()
+        # leaves the dispatched step IN FLIGHT and fetches its sampled
+        # tokens on the NEXT call (or at flush()), so host scheduling
+        # work runs in the device's shadow.  Defaults to synchronous —
+        # direct drivers (tests, benches) see tokens after every call;
+        # ContinuousScheduler flips it from PFX_DISPATCH_AHEAD.
+        self.dispatch_ahead = False
+        self._inflight: Optional[Dict[str, Any]] = None
+        self._t_results: Optional[float] = None
 
     # -- capacity queries ----------------------------------------------
     def row_capacity_tokens(self, prompt_len: int, max_new: int) -> int:
@@ -580,6 +612,10 @@ class PagedDecodeEngine:
         scheduler iteration interleaved with decode steps."""
         from paddlefleetx_tpu.models.gpt.generation import bucket_len
 
+        # an admission legitimately sits between a commit and the next
+        # decode dispatch (prefill is device work) — drop the gap timer
+        # so host_gap_s measures only decode-loop scheduling gaps
+        self._t_results = None
         jnp = self._jnp
         prompt_ids = [int(t) for t in prompt_ids]
         plen = len(prompt_ids)
@@ -736,6 +772,7 @@ class PagedDecodeEngine:
         final chunk seeds the row's pending logits (last REAL prompt
         token) + repetition counts and flips it decode-active."""
         jnp = self._jnp
+        self._t_results = None  # prefill chunk between commit and dispatch
         row = self.slots[slot]
         final = min(row.chunk, len(row.pending)) == len(row.pending)
         t0 = time.monotonic()
@@ -956,6 +993,7 @@ class PagedDecodeEngine:
             )
         P, PB, limit, max_new = self._clamp_budget(plen, int(meta["max_new"]))
         jnp = self._jnp
+        self._t_results = None  # adoption is an admission for gap accounting
         vocab = int(self.mcfg.vocab_size)
         for name, want in (("logits", (vocab,)), ("counts", (vocab,))):
             got = tuple(np.shape(arrays.get(name)))
@@ -1064,50 +1102,123 @@ class PagedDecodeEngine:
     def step(self) -> List[int]:
         """Run ONE decode step (speculative: one draft-verify iteration,
         committing 1..draft_k+1 tokens per row) for every active row;
-        returns the slots that finished this step (their tokens are
-        complete — release them with :meth:`release`)."""
+        returns the slots that finished (their tokens are complete —
+        release them with :meth:`release`).
+
+        Synchronous mode (default): dispatch and commit in one call.
+        Dispatch-ahead mode (``dispatch_ahead=True``): the NEXT step is
+        dispatched before the in-flight step's sampled tokens are
+        fetched — when possible it chains directly on the in-flight
+        step's device-resident row state, so the readback barrier
+        overlaps the chained step's compute and the host scheduling
+        work between calls runs in the device's shadow.  The finished
+        slots returned are those of the COMMITTED (previous) step.
+        Callers that mutate row membership or host row state
+        (admit/adopt/release/evict) between steps must :meth:`flush`
+        first."""
         jnp = self._jnp
-        # chunked-prefill interleave: at most ONE pending chunk per
-        # iteration, oldest admission first — a long prompt streams in
-        # across iterations while the decode batch below keeps stepping,
-        # so no prefill ever head-of-line-blocks active rows
         pending = [
             i for i, r in enumerate(self.slots)
             if r is not None and not r.prefill_done
         ]
+        # dispatch-ahead fast path: chain the next step on the in-flight
+        # step's device-side outputs (positions/gen_steps/active are
+        # async futures with the same avals as the host mirrors — and
+        # NOT donated, so the commit below can still read them).  The
+        # chained dispatch reaches the device queue before the host
+        # fetches a single token.  Speculation needs the committed
+        # tokens to draft from and a pending chunked prefill needs the
+        # host tick, so both take the commit-first ordering below
+        # instead (the readback then only waits for whatever compute
+        # the prior dispatch has not finished yet).
+        if (self.dispatch_ahead and self._inflight is not None
+                and self.spec is None and not pending and self.active.any()):
+            prev, self._inflight = self._inflight, None
+            nxt = self._dispatch(
+                prev["positions"], prev["gen_steps"], prev["active"],
+                overlapped=True,
+            )
+            # stash BEFORE the commit barrier: a commit failure resets
+            # the arena, and reset() must drop the chained dispatch too
+            # (its pools chain on the poisoned step)
+            self._inflight = nxt
+            finished = self._commit(prev)
+            # the chained step's dispatch-time active view IS the
+            # committed step's output actives (merged on the host now);
+            # rows the commit finished are excluded, so a later commit
+            # of the chained step can never re-finish a released slot
+            nxt["was_active"] = self.active.copy()
+            return finished
+        finished = self.flush()
+        # chunked-prefill interleave: at most ONE pending chunk per
+        # iteration, oldest admission first — a long prompt streams in
+        # across iterations while the decode batch below keeps stepping,
+        # so no prefill ever head-of-line-blocks active rows
         if pending:
             self._tick_prefill(
                 min(pending, key=lambda i: self.slots[i].seq_id)
             )
         if not self.active.any():
-            return []
+            return finished
+        fl = self._dispatch(
+            jnp.asarray(self.positions), jnp.asarray(self.gen_steps),
+            jnp.asarray(self.active), overlapped=False,
+        )
+        fl["was_active"] = self.active.copy()
+        self._inflight = fl
+        if self.dispatch_ahead:
+            return finished
+        return finished + self.flush()
+
+    @property
+    def has_inflight(self) -> bool:
+        """True while a dispatched step's results are not yet fetched
+        (dispatch-ahead mode only; always False when synchronous)."""
+        return self._inflight is not None
+
+    def _dispatch(self, positions, gen_steps, active, *,
+                  overlapped: bool) -> Dict[str, Any]:
+        """Dispatch one decode step and ADOPT its device-side outputs
+        immediately: pools/logits/counts/reject are async futures, so a
+        later dispatch (prefill chunk, COW copy, the next step) queues
+        behind this one on device instead of ever touching the
+        donation-invalidated inputs.  Returns the in-flight record
+        whose window/ncommit/row-state handles :meth:`_commit` fetches;
+        the caller fills ``was_active`` with its dispatch-time view."""
+        jnp = self._jnp
         M = self.table_width_bucket()
         tables = np.full((self.capacity, M), NULL_BLOCK, np.int32)
         for i, r in enumerate(self.slots):
             if r is not None:
                 tables[i, : len(r.table)] = r.table
         self._key, sub = self._jax.random.split(self._key)
-        was_active = self.active.copy()
         k = self.spec.draft_k if self.spec else 0
         drafts = (
             self._host_drafts() if self.spec
             else np.zeros((self.capacity, 1), np.int32)
         )
         fn = self._step_fn(M)
+        # host-gap accounting (bench_decode's host_gap_ms): host time
+        # between consuming one step's results and handing the device
+        # its next dispatch.  A chained dispatch lands while the
+        # previous step is still in flight — the device never waits on
+        # the host, so its gap is zero by construction.
+        if self._t_results is not None and not overlapped:
+            self.stats["host_gap_s"] += max(
+                0.0, time.monotonic() - self._t_results
+            )
+            self.stats["gap_steps"] += 1
         try:
             with self.mesh:
-                (window, ncommit, pools_t, logits, counts, positions,
-                 gen_steps, active, reject) = fn(
+                (window, ncommit, pools_t, logits, counts, positions_t,
+                 gen_steps_t, active_t, reject) = fn(
                     self.server.params, self._pools_tuple(),
                     jnp.asarray(tables), self._logits, self._counts,
-                    jnp.asarray(self.positions), jnp.asarray(self.gen_steps),
-                    jnp.asarray(self.max_news), jnp.asarray(self.active),
+                    positions, gen_steps,
+                    jnp.asarray(self.max_news), active,
                     jnp.asarray(self.forced_steps), self._reject,
                     jnp.asarray(drafts), sub,
                 )
-            window = np.array(window)
-            ncommit = np.array(ncommit)
-            new_active = np.array(active)
         except BaseException as exc:
             dead = self.reset()
             raise ArenaReset(
@@ -1120,22 +1231,80 @@ class PagedDecodeEngine:
         self.pools = PagedPools(*pools_t)
         self._logits, self._counts = logits, counts
         self._reject = reject
-        # np.array (not asarray): device-array views can be read-only and
-        # admit/release mutate these in place
-        self.positions = np.array(positions)
-        self.gen_steps = np.array(gen_steps)
-        self.active = new_active
+        return {
+            "window": window, "ncommit": ncommit,
+            "positions": positions_t, "gen_steps": gen_steps_t,
+            "active": active_t, "rows": list(self.slots), "k": k,
+            "was_active": None,
+        }
+
+    def flush(self) -> List[int]:
+        """Commit the in-flight dispatched step, if any (no-op when
+        synchronous or nothing is in flight); returns the slots it
+        finished.  This is the flush the dispatch-ahead contract
+        requires before any row-membership or host-row-state mutation:
+        the commit merge only protects rows that join or leave AFTER
+        the dispatch it is committing."""
+        if self._inflight is None:
+            return []
+        prev, self._inflight = self._inflight, None
+        return self._commit(prev)
+
+    def _commit(self, fl: Dict[str, Any]) -> List[int]:
+        """Fetch one dispatched step's sampled window and fold it into
+        host state — the ONLY host-device barrier on the decode path.
+        The dispatched computation's errors materialize here: any
+        failure resets the arena exactly like a synchronous step
+        failure, and the ArenaReset carries every live row — INCLUDING
+        rows admitted while the step was in flight, whose pools chained
+        onto the poisoned dispatch."""
+        try:
+            maybe_fire("cb_commit_crash", int(self.stats["steps"]) + 1)
+            window = np.array(fl["window"])
+            ncommit = np.array(fl["ncommit"])
+            new_active = np.array(fl["active"])
+            positions = np.array(fl["positions"])
+            gen_steps = np.array(fl["gen_steps"])
+        except BaseException as exc:
+            dead = self.reset()
+            raise ArenaReset(
+                f"decode step failed ({type(exc).__name__}: {exc}); "
+                "arena reset",
+                dead,
+            ) from exc
+        self._t_results = time.monotonic()
+        was_active = fl["was_active"]
+        # merge, never overwrite: slots that joined (admit/adopt) or
+        # left (release/evict) after the dispatch were not part of it —
+        # the step carried their stale state through, and their fresh
+        # host values must win over its outputs
+        self.positions[was_active] = positions[was_active]
+        self.gen_steps[was_active] = gen_steps[was_active]
+        self.active[was_active] = new_active[was_active]
         self.stats["steps"] += 1
         finished: List[int] = []
         n_act = int(was_active.sum())
         t_chunk = time.monotonic()
-        for i, r in enumerate(self.slots):
+        for i, r in enumerate(fl["rows"]):
             if r is None or not was_active[i]:
                 continue
             committed = int(ncommit[i])
+            start = len(r.tokens)
             for tok in window[i, :committed].tolist():
                 if tok != self.gen.eos_token_id:
                     r.tokens.append(int(tok))
+            if (len(r.tokens) > start and not self._warmup
+                    and r.entry is not None and r.entry.stream is not None):
+                # token streaming: push this step's commits as they
+                # land.  A broken sink must never kill the batch — the
+                # tokens are committed either way.
+                try:
+                    r.entry.stream(r.row_idx, start, r.tokens[start:])
+                except Exception as sink_exc:
+                    logger.warning(
+                        f"stream sink failed for seq {r.seq_id}: "
+                        f"{type(sink_exc).__name__}: {sink_exc}"
+                    )
             if r.trace is not None:
                 # per-chunk decode timeline: one event per iteration the
                 # row decoded in, carrying its commit + spec-accept
@@ -1149,7 +1318,7 @@ class PagedDecodeEngine:
             if not new_active[i]:
                 finished.append(i)
         if self.spec and n_act and not self._warmup:
-            proposed = k * n_act
+            proposed = fl["k"] * n_act
             accepted = int(ncommit[was_active].sum()) - n_act
             self.stats["spec_proposed"] += proposed
             self.stats["spec_accepted"] += accepted
@@ -1188,6 +1357,10 @@ class PagedDecodeEngine:
         from paddlefleetx_tpu.models.gpt.generation import init_paged_pools
 
         dead = [r for r in self.slots if r is not None]
+        # any in-flight dispatched step chains on the poisoned pools:
+        # drop its handles, its results must never be committed
+        self._inflight = None
+        self._t_results = None
         for r in dead:
             self.cache.release(r.seq_id)
         # the rebuilt pools hold NONE of the old blocks' KV: every cached
@@ -1325,6 +1498,10 @@ class PagedDecodeEngine:
         partial-coverage contract as the prompt buckets themselves)."""
         per: Dict[str, float] = {}
         self._warmup = True  # warmup admits/steps are not traffic
+        # warmup drives step()/release() with synchronous expectations
+        # (step, then inspect/release the slot): force the synchronous
+        # path for its duration regardless of the dispatch-ahead knob
+        ahead, self.dispatch_ahead = self.dispatch_ahead, False
         try:
             if self.prefix_enabled:
                 self._warm_copy_family()
@@ -1364,6 +1541,7 @@ class PagedDecodeEngine:
                 )
         finally:
             self._warmup = False
+            self.dispatch_ahead = ahead
         return per
 
 
@@ -1378,12 +1556,39 @@ class ContinuousScheduler:
     """
 
     def __init__(self, engine: PagedDecodeEngine, *, max_depth: int = 64,
-                 name: str = "serve-cb") -> None:
+                 name: str = "serve-cb",
+                 dispatch_ahead: Optional[bool] = None,
+                 quantum: Optional[int] = None) -> None:
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         self.engine = engine
         self.max_depth = int(max_depth)
         self.name = name
+        # dispatch-ahead decode + k-step scheduling quantum
+        # (docs/decode_path.md).  PFX_DISPATCH_AHEAD=0 is the loud
+        # fallback to fully-synchronous stepping; the scheduler (not
+        # the engine ctor) owns the knob because direct engine drivers
+        # need the synchronous default.  PFX_SCHED_QUANTUM=k runs the
+        # admission/eviction/shed scans every k-th iteration only,
+        # amortizing the host bookkeeping across k decode steps.
+        if dispatch_ahead is None:
+            dispatch_ahead = _env_int("PFX_DISPATCH_AHEAD", 1) != 0
+        self.dispatch_ahead = bool(dispatch_ahead)
+        engine.dispatch_ahead = self.dispatch_ahead
+        if not self.dispatch_ahead:
+            logger.warning(
+                f"{name}: PFX_DISPATCH_AHEAD=0 — synchronous decode "
+                "stepping; host scheduling no longer overlaps device "
+                "compute"
+            )
+        self.quantum = (
+            _env_int("PFX_SCHED_QUANTUM", 1)
+            if quantum is None else int(quantum)
+        )
+        if self.quantum < 1:
+            raise ValueError(
+                f"PFX_SCHED_QUANTUM must be >= 1, got {self.quantum}"
+            )
         self._entries: List[_CBEntry] = []
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -1468,8 +1673,12 @@ class ContinuousScheduler:
 
     # -- admission (RequestQueue-compatible surface) --------------------
     def submit(self, prompts: Sequence[Any], max_new_tokens: int, *,
-               coalesce_key=None, deadline_s: Optional[float] = None
-               ) -> RequestFuture:
+               coalesce_key=None, deadline_s: Optional[float] = None,
+               stream=None) -> RequestFuture:
+        """``stream`` (optional): a ``stream(row_idx, start, tokens)``
+        callable invoked on the scheduler thread as tokens commit —
+        the token-streaming hook tools/serve.py's SSE path plugs in
+        (see :class:`_CBEntry`)."""
         if not prompts:
             raise ValueError("prompts must be non-empty")
         for p in prompts:
@@ -1481,6 +1690,7 @@ class ContinuousScheduler:
             if deadline_s is not None else None,
             future=RequestFuture(),
             enqueued_at=time.monotonic(),
+            stream=stream,
         )
         entry.future.times["enqueued"] = entry.enqueued_at
         # deep-dive tracing (sampled; no-op at PFX_TRACE_SAMPLE=0):
@@ -1622,6 +1832,13 @@ class ContinuousScheduler:
                 "rows": rows,
             },
             "arena": eng.cache.stats(),
+            "overlap": {
+                "dispatch_ahead": bool(eng.dispatch_ahead),
+                "quantum": self.quantum,
+                "inflight": eng.has_inflight,
+                "host_gap_s": round(float(eng.stats["host_gap_s"]), 6),
+                "gap_steps": int(eng.stats["gap_steps"]),
+            },
             "compiled": {
                 "prefill_families": len(eng._compiled_prefill),
                 "step_families": len(eng._compiled_step),
@@ -1872,6 +2089,21 @@ class ContinuousScheduler:
     def _iterate_inner(self):
         eng = self.engine
         now = time.monotonic()
+        n_finished = 0
+
+        # k-step scheduling quantum (PFX_SCHED_QUANTUM, default 1 =
+        # every iteration): the shed/evict/admission scans below run on
+        # quantum boundaries only, amortizing the host bookkeeping over
+        # k decode steps.  An iteration with no live rows always takes
+        # the boundary path — waiting entries must admit NOW, never
+        # after k empty spins.
+        boundary = (
+            self.quantum <= 1
+            or self._iter_counter % self.quantum == 0
+            or not self._has_live_rows()
+        )
+        if not boundary:
+            return self._step_batch()
 
         admitted: List[tuple] = []
         expired_partial: List[_CBEntry] = []
@@ -1899,8 +2131,23 @@ class ContinuousScheduler:
                 e = r.entry
                 if e.deadline is not None and now > e.deadline:
                     expired.add(e)
+        if expired:
+            # row membership is about to change: commit the in-flight
+            # dispatched step first (dispatch-ahead), so evicted rows'
+            # final state is folded in before their blocks return
+            n_finished += self._flush_engine()
         for e in expired:
+            if e.future.done():
+                continue  # the in-flight step completed it first
             self._evict_entry(e, "mid-decode")
+
+        with self._wake:
+            waiting = bool(self._entries)
+        if waiting:
+            # admission capacity (free slots/blocks) must reflect rows
+            # the in-flight step just finished — the synchronous path
+            # admits with exactly this view
+            n_finished += self._flush_engine()
 
         with self._wake:
             # FCFS admission from the head: pull rows while they fit.
@@ -1988,19 +2235,47 @@ class ContinuousScheduler:
                 )
 
         if not self._has_live_rows():
-            return 0
+            return n_finished
+        return n_finished + self._step_batch()
 
-        # one iteration-level decode step
+    def _step_batch(self) -> int:
+        """One iteration-level decode step: dispatch (and, synchronous
+        or commit-first, fetch) via engine.step(), then resolve the rows
+        it finished.  Under dispatch-ahead the finished rows are the
+        PREVIOUS step's — commit order, which is exactly the order the
+        decision log accounts them in."""
+        if not self._has_live_rows():
+            return 0
         self._step_counter += 1
         maybe_fire("cb_step_hang", self._step_counter)
         try:
-            finished = eng.step()
+            finished = self.engine.step()
         except ArenaReset as exc:
             self.stats["gen_errors"] += 1
             self._fail_rows(exc.dead_rows, exc)
             logger.warning(f"{self.name}: {exc}")
             return 0
         self.stats["batches"] += 1
+        return self._finish_rows(finished)
+
+    def _flush_engine(self) -> int:
+        """Commit the engine's in-flight dispatched step (no-op when
+        synchronous or idle) and resolve the rows it finished.  Must
+        run before anything that mutates row membership — eviction and
+        admission — per the engine's dispatch-ahead flush contract."""
+        if not self.engine.has_inflight:
+            return 0
+        try:
+            finished = self.engine.flush()
+        except ArenaReset as exc:
+            self.stats["gen_errors"] += 1
+            self._fail_rows(exc.dead_rows, exc)
+            logger.warning(f"{self.name}: {exc}")
+            return 0
+        return self._finish_rows(finished)
+
+    def _finish_rows(self, finished: List[int]) -> int:
+        eng = self.engine
         reg = get_registry()
         for slot in finished:
             row = eng.slots[slot]

@@ -191,6 +191,7 @@ class Replica:
     latency_p50_s: float = 0.0
     latency_p99_s: float = 0.0
     ttft_p99_s: float = 0.0
+    itl_p99_s: float = 0.0
     last_poll: float = 0.0
     ok_streak: int = 0
     failures: int = 0
@@ -224,6 +225,7 @@ class Replica:
             "latency_p50_s": self.latency_p50_s,
             "latency_p99_s": self.latency_p99_s,
             "ttft_p99_s": self.ttft_p99_s,
+            "itl_p99_s": self.itl_p99_s,
             "in_flight": self.in_flight,
             "last_latency_s": round(self.last_latency_s, 4),
             "failures": self.failures,
@@ -243,7 +245,7 @@ def _local_url(base_url: str) -> bool:
 
 
 def _http_request(base_url: str, method: str, path: str, body=None,
-                  headers=None, timeout: float = 30.0
+                  headers=None, timeout: float = 30.0, sink=None
                   ) -> Tuple[int, bytes, str, Dict[str, str]]:
     """One downstream HTTP exchange -> ``(status, body, content_type,
     response_headers)``.  ``ConnectionRefusedError`` propagates
@@ -251,7 +253,17 @@ def _http_request(base_url: str, method: str, path: str, body=None,
     processed); every other transport failure raises
     :class:`ReplicaUnavailable` (bytes may have been exchanged — never
     replay).  Response headers ride back for the trace-stitching layer
-    (the callee's ``X-Span-Summary`` envelope)."""
+    (the callee's ``X-Span-Summary`` envelope).
+
+    ``sink`` (optional, streamed relay): a ``sink(chunk: bytes)``
+    callable — a 200 response's body is forwarded chunk-by-chunk as it
+    arrives (``read1`` returns whatever is available instead of
+    blocking for a full buffer, so token flushes propagate unbuffered)
+    and only a bounded rolling TAIL is returned as ``body``, enough
+    for the caller to parse the stream's terminal summary frame.  The
+    sink must not raise — swallow client-side write failures and keep
+    accepting (the upstream read is then just drained).  Non-200
+    responses are returned whole so error bodies stay parseable."""
     u = urlsplit(base_url)
     conn = http.client.HTTPConnection(
         u.hostname, u.port or 80, timeout=timeout
@@ -271,7 +283,17 @@ def _http_request(base_url: str, method: str, path: str, body=None,
             raise RequestNotSent(f"send failed: {e}") from e
         try:
             resp = conn.getresponse()
-            data = resp.read()
+            if sink is not None and resp.status == 200:
+                tail = b""
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    sink(chunk)
+                    tail = (tail + chunk)[-8192:]
+                data = tail
+            else:
+                data = resp.read()
         except (OSError, http.client.HTTPException) as e:
             raise ReplicaUnavailable(
                 f"reply lost mid-request ({type(e).__name__}: {e}); "
@@ -282,6 +304,27 @@ def _http_request(base_url: str, method: str, path: str, body=None,
                 dict(resp.getheaders()))
     finally:
         conn.close()
+
+
+def stream_summary(tail: bytes) -> Dict[str, Any]:
+    """Parse the terminal ``event: summary`` frame out of a streamed
+    SSE body (or its rolling tail): the streamed stand-in for the
+    ``X-Span-Summary`` response header, which cannot be complete
+    before the body starts (tools/serve.py writes the frame last).
+    Returns ``{}`` when absent or torn — a stream that failed
+    mid-flight has no summary, honestly."""
+    idx = tail.rfind(b"event: summary")
+    if idx < 0:
+        return {}
+    for line in tail[idx:].split(b"\n"):
+        if line.startswith(b"data: "):
+            try:
+                return json.loads(line[len(b"data: "):].decode(
+                    "utf-8", "replace"
+                ))
+            except ValueError:
+                return {}
+    return {}
 
 
 class FleetFederation:
@@ -471,6 +514,7 @@ class FleetLog:
                     "occupancy": v["occupancy"],
                     "in_flight": v["in_flight"],
                     "ttft_p99_s": v.get("ttft_p99_s", 0.0),
+                    "itl_p99_s": v.get("itl_p99_s", 0.0),
                     "latency_p50_s": v.get("latency_p50_s", 0.0),
                     "latency_p99_s": v.get("latency_p99_s", 0.0),
                 }
@@ -704,6 +748,7 @@ class RouterCore:
             r.latency_p50_s = float(h.get("latency_p50_s", 0.0) or 0.0)
             r.latency_p99_s = float(h.get("latency_p99_s", 0.0) or 0.0)
             r.ttft_p99_s = float(h.get("ttft_p99_s", 0.0) or 0.0)
+            r.itl_p99_s = float(h.get("itl_p99_s", 0.0) or 0.0)
             # elastic-control signals (core/controller.py): continuous-
             # batch occupancy and the replica's own SLO breach verdict
             r.occupancy = float(h.get("occupancy", 0.0) or 0.0)
@@ -927,7 +972,7 @@ class RouterCore:
 
     def dispatch(self, method: str, path: str, body: Optional[bytes], *,
                  role: str, deadline_s: float, headers=None,
-                 trace=None, exclude: Optional[set] = None
+                 trace=None, exclude: Optional[set] = None, sink=None
                  ) -> Tuple[int, bytes, str]:
         """Route one request: pick -> forward -> account.  Bounded retry
         on ANOTHER replica only for connection-refused and provably-
@@ -939,7 +984,16 @@ class RouterCore:
         mid-exchange — a fallback must not replay AT it).  Raises
         :class:`NoReplicaAvailable` / :class:`ReplicaUnavailable` (the
         latter carrying ``replica_key``) for the transport layer to turn
-        into 503."""
+        into 503.
+
+        ``sink`` streams a 200 body through unbuffered (see
+        :func:`_http_request`); the retry ladder is unaffected because
+        both retryable classes fail before any body byte flows.  The
+        callee's span summaries then ride the stream's terminal
+        ``event: summary`` frame instead of the ``X-Span-Summary``
+        header (which is already on the wire before the spans close)
+        and are stitched from :func:`stream_summary` of the returned
+        tail."""
         deadline_abs = time.monotonic() + float(deadline_s)
         seeded: set = set(exclude or ())
         tried: set = set(seeded)
@@ -978,7 +1032,7 @@ class RouterCore:
                     # span summary for the stitched timeline
                     headers={**(headers or {}),
                              **outbound_trace_headers(trace, path)},
-                    timeout=remaining + 5.0,
+                    timeout=remaining + 5.0, sink=sink,
                 )
             except ConnectionRefusedError:
                 with self._lock:
@@ -1048,6 +1102,13 @@ class RouterCore:
                 if raw:
                     t_recv = time.monotonic()
                     for s in parse_span_summaries(raw):
+                        trace.add_remote_summary(s, t_send=t0,
+                                                 t_recv=t_recv)
+                elif sink is not None and status == 200:
+                    # streamed leg: summaries arrive in-band, in the
+                    # terminal summary frame retained in the tail
+                    t_recv = time.monotonic()
+                    for s in stream_summary(data).get("spans") or []:
                         trace.add_remote_summary(s, t_send=t0,
                                                  t_recv=t_recv)
             return status, data, ctype

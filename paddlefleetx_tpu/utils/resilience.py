@@ -214,6 +214,7 @@ FAULT_SITES = (
     "sigterm", "save_crash", "ckpt_truncate", "nan_grads",
     "gen_crash", "gen_hang", "cb_step_hang", "boot_crash",
     "corrupt_sample", "io_stall", "handoff_drop", "adopt_crash",
+    "cb_commit_crash",
 )
 
 
@@ -316,6 +317,14 @@ def maybe_fire(site: str, step: int, path: Optional[str] = None) -> bool:
     elif site == "gen_crash":
         raise RuntimeError(
             f"PFX_FAULT: injected gen_crash at request {step}"
+        )
+    elif site == "cb_commit_crash":
+        # a dispatched decode step whose results never materialize: the
+        # injection sits inside the engine's commit readback, so an
+        # IN-FLIGHT dispatch-ahead step fails exactly where a real
+        # device error would surface — the ArenaReset drill's hook
+        raise RuntimeError(
+            f"PFX_FAULT: injected cb_commit_crash at step {step}"
         )
     elif site == "boot_crash":
         # a replica that can never come up: os._exit skips every

@@ -126,7 +126,8 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_http_responses_total": ("counter", "HTTP responses by status code"),
     "pfx_http_client_gone_total": ("counter", "Responses lost to client disconnects"),
     "pfx_request_latency_seconds": ("histogram", "End-to-end /generate latency"),
-    "pfx_request_ttft_seconds": ("histogram", "Time to first token (request receipt to decode done)"),
+    "pfx_request_ttft_seconds": ("histogram", "Time to first token (request receipt to first flush; non-streamed: decode done)"),
+    "pfx_request_itl_seconds": ("histogram", "Inter-token latency: gap between consecutive streamed token flushes"),
     "pfx_request_queue_wait_seconds": ("histogram", "Admission to scheduler pickup"),
     "pfx_request_decode_seconds": ("histogram", "Scheduler pickup to decode completion"),
     "pfx_request_per_token_seconds": ("histogram", "Decode seconds per delivered token"),

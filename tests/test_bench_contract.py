@@ -206,6 +206,27 @@ def test_bench_decode_happy_path_contract(tmp_path):
     assert pc["prefill_tokens"] < pn["prefill_tokens"], (pc, pn)
     assert pc["greedy_divergent_rows"] == 0, pc
 
+    # dispatch-ahead A/B pair: the SAME greedy batch through two
+    # continuous schedulers differing only in dispatch_ahead.  The
+    # overlapped side only pays a host gap on admission boundaries
+    # (chained dispatches land while the previous step is in flight),
+    # so its per-step host_gap_ms must be STRICTLY below the
+    # synchronous side's even on CPU — host-side bookkeeping is what
+    # the gap measures, not device speed.  Token identity at f32.
+    oa = rows["gpt345m_decode_overlap_ahead"]
+    os_ = rows["gpt345m_decode_overlap_sync"]
+    for row in (oa, os_):
+        assert {"host_gap_ms", "gap_steps", "device_steps",
+                "dispatch_ahead", "batch"} <= set(row), row
+        assert row["device_steps"] > 0, row
+    assert oa["dispatch_ahead"] is True and os_["dispatch_ahead"] is False
+    assert oa["batch"] == os_["batch"]  # identical traffic
+    assert oa["host_gap_ms"] < os_["host_gap_ms"], (oa, os_)
+    # the sync side pays the gap on (nearly) every step; the ahead side
+    # skips it on every chained dispatch
+    assert oa["gap_steps"] < os_["gap_steps"], (oa, os_)
+    assert oa["greedy_divergent_rows"] == 0, oa
+
 
 @pytest.mark.slow
 def test_bench_decode_deadline_emits_honest_zero(tmp_path):

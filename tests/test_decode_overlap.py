@@ -1,0 +1,273 @@
+"""Dispatch-ahead decode overlap acceptance (`make test-decode-overlap`).
+
+  replay equality   the SAME seeded traffic (admissions, a pre-expired
+                    shed, speculative commits) through
+                    ``dispatch_ahead=True`` vs ``False`` folds to
+                    IDENTICAL `replay_decision_log` totals and
+                    token-identical greedy output — the exact-replay
+                    contract the commit-order decision-log landing
+                    exists to keep;
+  ArenaReset drill  an injected crash (PFX_FAULT=cb_commit_crash) in
+                    the commit readback of an IN-FLIGHT dispatched step
+                    resets cleanly: exactly the live seq_ids die, the
+                    stale in-flight handle is dropped, and the rebuilt
+                    arena decodes token-identically;
+  streamed drill    (slow) POST /generate?stream=1 through the REAL
+                    router + replica CLIs yields >= 2 SSE token flushes
+                    with per-row monotone token indices, ITL
+                    percentiles on the replica's /metrics, and an
+                    intact stitched trace at the router.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_continuous_batching import PROMPTS, TINY  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# repetitive prompt: the n-gram self-draft's best case, so the
+# speculative side actually ACCEPTS drafts and the replay-equality
+# assertion covers a non-zero pfx_spec_accepted_total
+REP = [5, 6] * 8
+
+
+@pytest.fixture(scope="module")
+def server():
+    import jax
+
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.core.serving import GenerationServer
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    cfg = AttrDict.from_nested(TINY)
+    cfg = process_configs(cfg, num_devices=jax.device_count())
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    return GenerationServer(cfg, mesh, module)
+
+
+def _run_seeded_traffic(server, ahead: bool):
+    """One deterministic traffic mix through a fresh engine+scheduler:
+    4 plain admissions, 1 speculative-friendly repetitive prompt, and
+    1 pre-expired request (shed before admission on both sides)."""
+    from paddlefleetx_tpu.core.continuous_batching import (
+        ContinuousScheduler,
+        PagedDecodeEngine,
+    )
+    from paddlefleetx_tpu.core.request_queue import DeadlineExceeded
+    from paddlefleetx_tpu.ops.speculative import SpecConfig
+    from paddlefleetx_tpu.utils.tracing import replay_decision_log
+
+    eng = PagedDecodeEngine(server, max_batch=4,
+                            spec=SpecConfig(draft_k=3))
+    sched = ContinuousScheduler(eng, max_depth=16, dispatch_ahead=ahead)
+    doomed = sched.submit([PROMPTS[0]], 6, deadline_s=0.01)
+    time.sleep(0.05)  # expired BEFORE the scheduler thread starts
+    sched.start()
+    futs = [sched.submit([p], 6, deadline_s=120)
+            for p in PROMPTS + [REP]]
+    outs = [f.result(timeout=300)[0] for f in futs]
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=60)
+    assert sched.shutdown(timeout=30)
+    replay = replay_decision_log(sched.decision_log)
+    return outs, replay, dict(sched.stats)
+
+
+def test_replay_equality_dispatch_ahead_on_vs_off(server):
+    """THE overlap acceptance: identical seeded traffic folds to the
+    same decision-log totals with dispatch-ahead on or off, and the
+    greedy outputs are token-identical (f32)."""
+    outs_a, replay_a, stats_a = _run_seeded_traffic(server, ahead=True)
+    outs_s, replay_s, stats_s = _run_seeded_traffic(server, ahead=False)
+    assert outs_a == outs_s
+    # iteration COUNT is wall-clock (idle iterations append all-zero
+    # rows); every event total must agree exactly
+    fold_a = {k: v for k, v in replay_a.items() if k != "iterations"}
+    fold_s = {k: v for k, v in replay_s.items() if k != "iterations"}
+    assert fold_a == fold_s, (fold_a, fold_s)
+    assert fold_a["prefill_admits"] == len(PROMPTS) + 1
+    assert fold_a["shed"] == 1
+    assert fold_a["evictions"] == replay_s["evictions"]
+    # the repetitive prompt made speculation commit real tokens, so the
+    # equality above covers the spec counters non-trivially
+    assert fold_a["spec_accepted"] > 0
+    for k in ("prefill_admits", "completed", "evictions", "shed_deadline"):
+        assert stats_a[k] == stats_s[k], (k, stats_a[k], stats_s[k])
+
+
+def test_arena_reset_mid_overlap_kills_exactly_the_live_rows(
+    server, monkeypatch
+):
+    """An in-flight dispatched step whose commit readback crashes
+    resets the arena cleanly: the ArenaReset carries exactly the live
+    seq_ids, the poisoned in-flight handle is dropped, and the rebuilt
+    arena decodes token-identically."""
+    from paddlefleetx_tpu.core.continuous_batching import (
+        ArenaReset,
+        PagedDecodeEngine,
+    )
+    from paddlefleetx_tpu.utils import resilience
+
+    ref = server.generate_ids([PROMPTS[0]], max_dec_len=6)[0]
+    eng = PagedDecodeEngine(server, max_batch=4)
+    eng.dispatch_ahead = True
+    s0 = eng.admit(PROMPTS[0], 6)
+    s1 = eng.admit(PROMPTS[1], 6)
+    eng.step()  # dispatches step 1 and leaves it IN FLIGHT
+    assert eng.has_inflight
+    live = {eng.slots[s].seq_id for s in (s0, s1)}
+    resilience.reset_fault_state()
+    monkeypatch.setenv("PFX_FAULT", "cb_commit_crash:1")
+    try:
+        # chains step 2 on the in-flight handles, then commits step 1 —
+        # where the injected readback crash fires
+        with pytest.raises(ArenaReset) as ei:
+            eng.step()
+    finally:
+        monkeypatch.delenv("PFX_FAULT")
+        resilience.reset_fault_state()
+    assert {r.seq_id for r in ei.value.dead_rows} == live
+    assert not eng.has_inflight  # the chained step died with the arena
+    assert not eng.active.any()
+    # fresh pools: an identical request decodes token-identically
+    s2 = eng.admit(PROMPTS[0], 6)
+    for _ in range(96):
+        eng.step()
+        if not eng.active.any():
+            break
+    eng.flush()
+    assert eng.slots[s2].tokens == ref
+
+
+# ---------------------------------------------------------------------------
+# two-process streamed drill: real serve.py + router.py CLIs
+# ---------------------------------------------------------------------------
+
+
+def _parse_sse(body: str):
+    """SSE body -> ordered [(event, data_obj)] pairs."""
+    out = []
+    for frame in body.split("\n\n"):
+        event, data = None, None
+        for line in frame.split("\n"):
+            if line.startswith("event: "):
+                event = line[len("event: "):]
+            elif line.startswith("data: "):
+                data = json.loads(line[len("data: "):])
+        if event is not None:
+            out.append((event, data))
+    return out
+
+
+@pytest.mark.fault
+@pytest.mark.slow  # two jax boots; gated by make test-decode-overlap
+def test_streamed_generate_through_router_two_process(tmp_path):
+    import urllib.request
+
+    import yaml
+
+    from test_disagg_drills import (
+        _finish,
+        _free_port,
+        _get,
+        _metrics,
+        _spawn_replica,
+        _spawn_router,
+        _wait_eligible,
+        _wait_healthy,
+        SYS,
+        TINY as DRILL_TINY,
+    )
+
+    cfg_path = tmp_path / "tiny_stream.yaml"
+    cfg_path.write_text(yaml.safe_dump(DRILL_TINY))
+    sport, rport = _free_port(), _free_port()
+    replica = _spawn_replica(
+        cfg_path, sport, "--scheduler", "continuous", "--cb-batch", "4",
+        "--replica-id", "s0",
+    )
+    router = None
+    try:
+        _wait_healthy([(sport, replica)])
+        router = _spawn_router(rport, "--replica",
+                               f"http://127.0.0.1:{sport}")
+        _wait_eligible(rport, 1, proc=router)
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rport}/generate?stream=1",
+            data=json.dumps({
+                "prompt_ids": SYS + [40, 41, 42], "max_tokens": 6,
+                "deadline_s": 60,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+            assert "text/event-stream" in r.headers.get("Content-Type", "")
+            trace_id = r.headers.get("X-Trace-Id")
+            # incremental arrival: the close-delimited body lands in
+            # multiple reads because each flush leaves the replica (and
+            # transits the router) the moment its step commits
+            chunks = []
+            while True:
+                c = r.read1(65536)
+                if not c:
+                    break
+                chunks.append(c)
+        frames = _parse_sse(b"".join(chunks).decode())
+        tokens = [d for e, d in frames if e == "token"]
+        summaries = [d for e, d in frames if e == "summary"]
+        assert not [d for e, d in frames if e == "error"], frames
+        # >= 2 flushes, each a separate wire chunk end-to-end
+        assert len(tokens) >= 2, frames
+        assert len(chunks) >= 2, [len(c) for c in chunks]
+        # per-row monotone token indices with no gaps
+        seen = {}
+        for d in tokens:
+            assert d["index"] == seen.get(d["row"], 0), tokens
+            seen[d["row"]] = d["index"] + len(d["tokens"])
+        assert summaries, frames
+        total = sum(len(d["tokens"]) for d in tokens)
+        assert summaries[-1]["usage"]["tokens"] == total == sum(
+            seen.values()
+        )
+        assert summaries[-1]["flushes"] == len(tokens)
+
+        # the streamed leg still stitches: the router timeline carries
+        # its own routing events AND the replica's remote spans (which
+        # rode the stream's terminal summary frame, not a header)
+        assert trace_id
+        tl = _get(rport, f"/debug/trace?id={trace_id}")
+        names = [e["name"] for e in tl["events"]]
+        assert "route" in names and "routed" in names
+        remote = [e for e in tl["events"] if e.get("proc")]
+        assert remote, names
+        assert {e["proc"]["replica_id"] for e in remote} == {"s0"}
+        assert "decode_chunk" in {e["name"] for e in remote}
+
+        # streamed accounting: TTFT observed at first flush and ITL
+        # per-gap — the replica's /metrics carries both histograms
+        m = _metrics(sport)
+        itl_n = m.get("pfx_request_itl_seconds_count", {}).get(
+            frozenset(), 0)
+        assert itl_n == len(tokens) - 1, (itl_n, len(tokens))
+        assert m.get("pfx_request_ttft_seconds_count", {}).get(
+            frozenset(), 0) >= 1
+        # and the fleet plumb: the router's healthz poll view carries
+        # the replica's itl_p99_s field
+        views = _get(rport, "/replicas")["replicas"]
+        assert all("itl_p99_s" in v for v in views), views
+    finally:
+        out_r = _finish(router)
+        out_s = _finish(replica)
+        assert "Traceback" not in out_s, out_s[-3000:]
+        assert "Traceback" not in out_r, out_r[-3000:]

@@ -729,6 +729,7 @@ def fleet_summary(data: FleetData) -> List[Tuple[str, Any]]:
 
 _FLEET_CURVES = (
     ("ttft_p99_s", "TTFT p99 (s) per replica"),
+    ("itl_p99_s", "ITL p99 (s) per replica"),
     ("latency_p99_s", "latency p99 (s) per replica"),
     ("occupancy", "continuous-batch occupancy per replica"),
     ("depth", "reported queue depth per replica"),
@@ -736,7 +737,8 @@ _FLEET_CURVES = (
 )
 
 _FLEET_STATE_COLS = (
-    "pool", "state", "depth", "occupancy", "ttft_p99_s", "latency_p99_s",
+    "pool", "state", "depth", "occupancy", "ttft_p99_s", "itl_p99_s",
+    "latency_p99_s",
     "kv_blocks_used", "kv_blocks_available", "tokens_out_total",
     "handoff_exports_total", "handoff_adopts_total",
 )

@@ -340,11 +340,18 @@ def serve_router(args) -> int:
             return self._json(404, {"error": "unknown path"})
 
         def do_POST(self):
-            if self.path == "/admin/drain":
+            parts = urlsplit(self.path)
+            if parts.path == "/admin/drain":
                 return self._admin_drain()
-            if self.path != "/generate":
+            if parts.path != "/generate":
                 return self._json(404, {"error": "unknown path"})
-            return self._generate()
+            return self._generate(parts)
+
+        def _wants_stream(self, parts) -> bool:
+            qs = parse_qs(parts.query or "")
+            if (qs.get("stream") or [""])[0] not in ("", "0"):
+                return True
+            return "text/event-stream" in (self.headers.get("Accept") or "")
 
         def _admin_drain(self):
             if not self._authorized("/admin"):
@@ -360,7 +367,7 @@ def serve_router(args) -> int:
                 return self._json(409, {"error": str(e)})
             return self._json(200, out)
 
-        def _generate(self):
+        def _generate(self, parts=None):
             t0 = time.monotonic()
             try:
                 core.acquire()
@@ -396,9 +403,40 @@ def serve_router(args) -> int:
                     return self._json(400, {"error": str(e)})
                 if core.disaggregated:
                     return self._generate_disagg(req, deadline_s, trace)
+                streaming = parts is not None and self._wants_stream(parts)
+                relay = {"started": False, "lost": False}
+
+                def relay_sink(chunk: bytes) -> None:
+                    # unbuffered proxy: forward each replica flush the
+                    # moment it lands.  Must not raise back into the
+                    # dispatch (the _http_request sink contract) — a
+                    # gone client just drains the rest of the stream.
+                    if relay["lost"]:
+                        return
+                    try:
+                        if not relay["started"]:
+                            relay["started"] = True
+                            self.send_response(200)
+                            self.send_header("Content-Type",
+                                             "text/event-stream")
+                            self.send_header("Cache-Control", "no-cache")
+                            self.send_header("Connection", "close")
+                            if trace is not None:
+                                self.send_header("X-Trace-Id",
+                                                 trace.trace_id)
+                            self.end_headers()
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError,
+                            TimeoutError, OSError):
+                        relay["lost"] = True
+                        reg.counter("pfx_http_client_gone_total").inc()
+
                 try:
                     status, data, ctype = core.dispatch(
-                        "POST", "/generate", body,
+                        "POST",
+                        "/generate?stream=1" if streaming else "/generate",
+                        body,
                         role="monolith", deadline_s=deadline_s,
                         # the fleet token rides along so a token-gated
                         # replica honors the trace-propagation headers
@@ -407,6 +445,7 @@ def serve_router(args) -> int:
                         headers={"Content-Type": "application/json",
                                  **admin_headers()},
                         trace=trace,
+                        sink=relay_sink if streaming else None,
                     )
                 except NoReplicaAvailable as e:
                     return self._json(
@@ -414,10 +453,20 @@ def serve_router(args) -> int:
                         headers={"Retry-After": "2"},
                     )
                 except ReplicaUnavailable as e:
+                    if relay["started"]:
+                        # stream torn mid-relay: the status line is
+                        # already on the close-delimited wire, so the
+                        # truncated stream IS the client's error signal
+                        return
                     return self._json(
                         503, {"error": str(e)},
                         headers={"Retry-After": "1"},
                     )
+                if relay["started"]:
+                    # the relay sink already wrote the whole response
+                    reg.counter("pfx_http_responses_total",
+                                code="200").inc()
+                    return
                 headers = (
                     {"Retry-After": "1"} if status in (429, 503) else None
                 )

@@ -135,7 +135,8 @@ _QUEUE_HEALTH_KEYS = {
 }
 
 
-def _record_request_span(reg, recorder, t0, fut, code, tokens=None):
+def _record_request_span(reg, recorder, t0, fut, code, tokens=None,
+                         streamed=False):
     """Turn one /generate lifecycle into telemetry: span phases
     (admission -> queue_wait -> decode -> respond) from the queue's
     monotonic stamps, TTFT + per-token histograms, and a flight-recorder
@@ -171,13 +172,17 @@ def _record_request_span(reg, recorder, t0, fut, code, tokens=None):
             reg.histogram("pfx_request_per_token_seconds").observe(
                 phases["decode"] / max(1, tokens)
             )
-    if "resolved" in times and code == 200:
-        # non-streaming decode: the whole completion lands at once, so
-        # first-token time IS resolution time (an upper bound once a
-        # streaming path exists).  Success-only, like the latency
-        # histogram: a shed request's ~deadline wait is not a "time to
-        # first token" — it delivered none, and letting it in would turn
-        # TTFT p99 into the shed deadline exactly when operators alert
+    if "resolved" in times and code == 200 and not streamed:
+        # non-streamed decode: the whole completion lands at once, so
+        # first-token time IS resolution time.  STREAMED requests
+        # (POST /generate?stream=1, the SSE path) observe their own
+        # TTFT at the FIRST token flush and their total latency at
+        # stream close — this branch skips them (``streamed``) so
+        # nothing double-counts.  Success-only either way, like the
+        # latency histogram: a shed request's ~deadline wait is not a
+        # "time to first token" — it delivered none, and letting it in
+        # would turn TTFT p99 into the shed deadline exactly when
+        # operators alert
         reg.histogram("pfx_request_ttft_seconds").observe(
             max(0.0, times["resolved"] - t0)
         )
@@ -285,6 +290,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     import signal
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from queue import Empty as SinkEmpty, Queue as SinkQueue
     from urllib.parse import parse_qs, urlsplit
 
     from paddlefleetx_tpu.core.request_queue import (
@@ -363,6 +369,12 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         name="serve", role=role, prefix_cache_blocks=prefix_cache_blocks,
         prefill_chunk=prefill_chunk,
     )
+    # token streaming (docs/serving.md "Token streaming"): only the
+    # continuous scheduler has a per-step commit hook (submit(stream=));
+    # the coalesce scheduler resolves whole completions, so its streamed
+    # responses degrade to a single flush at completion — still SSE, so
+    # clients need one code path
+    stream_capable = scheduler == "continuous" and role != "prefill"
 
     # /healthz identity block (docs/serving.md "Multi-host serving"):
     # the router (and a human with curl) can tell replicas apart, and
@@ -578,6 +590,10 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     "pfx_request_ttft_seconds",
                     default={"p50": 0.0, "p99": 0.0}, snap=snap,
                 )
+                itl = reg.value(
+                    "pfx_request_itl_seconds",
+                    default={"p50": 0.0, "p99": 0.0}, snap=snap,
+                )
                 # serving numerics come from the SAME snapshot (not a
                 # second read of server.stats) so /healthz and /metrics
                 # can never disagree; instance-local extras (last_error,
@@ -628,6 +644,11 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     "latency_p99_s": round(lat["p99"], 4),
                     "ttft_p50_s": round(ttft["p50"], 4),
                     "ttft_p99_s": round(ttft["p99"], 4),
+                    # inter-token latency (streamed /generate flushes):
+                    # first-class next to TTFT — the fleet log + report
+                    # panels read these per replica
+                    "itl_p50_s": round(itl["p50"], 4),
+                    "itl_p99_s": round(itl["p99"], 4),
                     **serving_view,
                 }
                 if slo.enabled:
@@ -783,7 +804,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                                  "only (disaggregated topology; see "
                                  "docs/serving.md)"
                     })
-                return self._generate()
+                return self._generate(parts)
             if parts.path == "/prefill":
                 if role != "prefill":
                     return self._json(404, {"error": "not a prefill replica"})
@@ -935,7 +956,18 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 headers[SPAN_SUMMARY_HEADER] = json.dumps(summaries)
             return headers
 
-        def _generate(self):
+        def _wants_stream(self, parts) -> bool:
+            """Streamed response requested: ``POST /generate?stream=1``
+            or ``Accept: text/event-stream`` (docs/serving.md)."""
+            if parts is not None and parse_qs(parts.query).get(
+                "stream", ["0"]
+            )[0] not in ("0", ""):
+                return True
+            return "text/event-stream" in (
+                self.headers.get("Accept") or ""
+            )
+
+        def _generate(self, parts=None):
             in_flight_gauge.add(1)
             t0 = time.monotonic()
             fut = None
@@ -968,6 +1000,13 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     )
                 except (ValueError, TypeError) as e:
                     return self._json(400, {"error": str(e)})
+                if self._wants_stream(parts):
+                    self._generate_stream(
+                        prompts_ids, mode, trim, key, deadline_s,
+                        parent, t0,
+                    )
+                    observed = True  # the stream path did its accounting
+                    return
                 # ---- admission control ---- (a hop that arrived with
                 # X-Trace-Id binds its parent so the attached trace is
                 # force-sampled into the caller's stitched timeline)
@@ -1021,6 +1060,169 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 return self._json(500, {"error": str(e)})
             finally:
                 in_flight_gauge.add(-1)
+
+        def _generate_stream(self, prompts_ids, mode, trim, key,
+                             deadline_s, parent, t0):
+            """SSE token streaming (docs/serving.md "Token streaming"):
+            tokens leave the box as the engine commits them instead of
+            when the row finishes.  The body is HTTP/1.0
+            close-delimited (no Content-Length): ``event: token``
+            frames carry ``{"row", "index", "tokens"}`` with per-row
+            monotone indices, and a terminal ``event: summary`` frame
+            carries usage plus — on authed traced hops — the span
+            summaries the router stitches (the streamed stand-in for
+            the X-Span-Summary header, which cannot be complete before
+            the body starts).  Accounting: TTFT at the FIRST flush,
+            per-gap ITL at every later flush, total latency at stream
+            close; success-only, like the non-streamed path.  The
+            coalesce scheduler has no per-step commit hook, so its
+            stream degrades to a single flush at completion (same SSE
+            framing either way)."""
+            sink = SinkQueue()
+            submit_kw = {"coalesce_key": key, "deadline_s": deadline_s}
+            if stream_capable:
+                submit_kw["stream"] = (
+                    lambda row, start, toks: sink.put((row, start, toks))
+                )
+            with remote_parent(parent):
+                fut = self._submit_guarded(
+                    lambda: queue.submit(prompts_ids, trim, **submit_kw),
+                    t0,
+                )
+            if fut is None:
+                return  # 429/503/400 answered + accounted
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                if fut.trace is not None:
+                    self.send_header("X-Trace-Id", fut.trace.trace_id)
+                self.end_headers()
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, TimeoutError):
+                client_gone.inc()
+                queue.try_remove(fut)
+                return
+            itl_hist = reg.histogram("pfx_request_itl_seconds")
+            ttft_hist = reg.histogram("pfx_request_ttft_seconds")
+            first_flush = None
+            last_flush = None
+            flushes = 0
+            sent_tokens = 0
+            client_lost = False
+            stream_err = None
+            code = 200
+            hard_deadline = t0 + deadline_s + shed_slack_s
+
+            def emit(event, obj):
+                nonlocal client_lost
+                if client_lost:
+                    return False
+                frame = (f"event: {event}\n"
+                         f"data: {json.dumps(obj)}\n\n").encode()
+                try:
+                    self.wfile.write(frame)
+                    self.wfile.flush()
+                    return True
+                except (BrokenPipeError, ConnectionResetError,
+                        TimeoutError):
+                    client_gone.inc()
+                    client_lost = True
+                    return False
+
+            def flush_tokens(row, start, toks):
+                nonlocal first_flush, last_flush, flushes, sent_tokens
+                now = time.monotonic()
+                if first_flush is None:
+                    # TTFT at the moment bytes actually leave for the
+                    # client — not at future resolution
+                    first_flush = now
+                    ttft_hist.observe(max(0.0, now - t0))
+                else:
+                    itl_hist.observe(max(0.0, now - last_flush))
+                last_flush = now
+                flushes += 1
+                sent_tokens += len(toks)
+                obj = {"row": row, "index": start, "tokens": toks}
+                if mode in ("prompt", "prompts"):
+                    obj["text"] = server.tokenizer.decode(toks)
+                return emit("token", obj)
+
+            while not (fut.done() and sink.empty()):
+                try:
+                    row, start, toks = sink.get(timeout=0.05)
+                except SinkEmpty:
+                    if time.monotonic() > hard_deadline and not fut.done():
+                        queue.try_remove(fut)  # shed it if still queued
+                        code = 503
+                        stream_err = f"deadline {deadline_s:g}s exceeded"
+                        break
+                    continue
+                if not flush_tokens(row, start, toks):
+                    break  # client hung up: stop draining, decode finishes
+            rows = None
+            if stream_err is None:
+                try:
+                    rows = fut.result(timeout=deadline_s + shed_slack_s)
+                except DeadlineExceeded as e:
+                    code, stream_err = 503, str(e)
+                except QueueClosed as e:
+                    code, stream_err = 503, str(e)
+                except TimeoutError:
+                    queue.try_remove(fut)
+                    code = 503
+                    stream_err = f"deadline {deadline_s:g}s exceeded"
+                except ValueError as e:
+                    code, stream_err = 400, str(e)
+                except Exception as e:  # noqa: BLE001 — report, keep serving
+                    code, stream_err = 500, str(e)
+            if stream_err is not None:
+                # mid-stream failure: an honest terminal error frame
+                # (the status line already said 200 — SSE's reality)
+                emit("error", {"error": stream_err, "code": code})
+                _record_request_span(reg, recorder, t0, fut, code,
+                                     tokens=sent_tokens or None,
+                                     streamed=True)
+                if code != 400:
+                    _slo_observe(code, fut, t0)
+                return
+            if flushes == 0 and not client_lost:
+                # single-flush degradation (coalesce scheduler, or a
+                # zero-token completion): everything arrives at once,
+                # in the same frame shape
+                for i, r in enumerate(rows):
+                    if not flush_tokens(i, 0, list(r)):
+                        break
+            # success epilogue: total latency at stream CLOSE (the
+            # non-streamed path observes at response build — same
+            # success-only rule), span + SLO with the first-flush TTFT
+            latency_hist.observe(time.monotonic() - t0)
+            _record_request_span(
+                reg, recorder, t0, fut, 200,
+                tokens=sum(len(r) for r in rows), streamed=True,
+            )
+            if slo.enabled:
+                slo.observe_request(
+                    ttft_s=(max(0.0, first_flush - t0)
+                            if first_flush is not None else None),
+                    ok=True,
+                )
+            summary = {
+                "usage": {
+                    "prompts": len(rows),
+                    "tokens": sum(len(r) for r in rows),
+                },
+                "flushes": flushes,
+            }
+            if fut.trace is not None:
+                summary["trace_id"] = fut.trace.trace_id
+                if parent is not None:
+                    # computed AFTER _record_request_span finished the
+                    # trace, exactly like _span_headers on the
+                    # non-streamed path
+                    summary["spans"] = [span_summary(fut.trace)]
+            emit("summary", summary)
 
         def _prefill(self):
             """POST /prefill (role=prefill): run one prompt's paged
