@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel test-fleet-obs test-decode-overlap bench bench-check
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel test-fleet-obs test-decode-overlap test-kv-tier bench bench-check
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -125,6 +125,18 @@ test-prefix:
 	python -m pytest tests/test_prefix_cache.py -q
 	python -m pytest tests/test_continuous_batching.py -q -k "prefix or chunked or cow or accounting or arena_reset or pressure"
 	python -m pytest "tests/test_paged_drills.py::test_prefix_cache_and_chunked_prefill_through_real_cli" -q
+	python -m pytest tests/test_bench_contract.py -q -k "decode_happy"
+
+# fleet KV-durability gate: the host-RAM spill tier (store units,
+# spill -> readmit parity, spill_corrupt degrade-to-recompute,
+# ArenaReset invalidation, exact decision-log replay), peer-to-peer
+# prefix migration (export/adopt cross-engine, torn-payload whole
+# rejection, the PFXH1 truncation fuzz), prefix-affinity routing units,
+# and the slow+fault rolling-drain CLI drills — migrate-under-stall
+# adoption and the wedged-receiver drain-deadline floor — plus the
+# spill decode-bench A/B contract (docs/serving.md "KV lifecycle")
+test-kv-tier:
+	python -m pytest tests/test_kv_tier.py tests/test_kv_handoff.py -q
 	python -m pytest tests/test_bench_contract.py -q -k "decode_happy"
 
 # speculative-decoding + KV-quant gate: drafter/accept units, greedy

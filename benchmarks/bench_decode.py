@@ -91,6 +91,13 @@ CASES = {
     # the per-device-step host gap the overlap exists to hide
     # (docs/decode_path.md "Dispatch-ahead decode")
     "overlap": (None, None, False),
+    # host-RAM spill tier A/B: the SAME prefix-heavy staggered trace
+    # against a prefix budget too small to keep both prefix families
+    # resident, with the spill tier ON vs OFF — emits TWO rows (on +
+    # off) reporting readmits, prefill tokens COMPUTED (strictly fewer
+    # with spill ON when anything readmitted), and honest greedy
+    # divergence (docs/serving.md "KV lifecycle")
+    "spill": (None, None, False),
 }
 
 # env spellings of the two decode paths (read at trace time).  BOTH are
@@ -122,6 +129,9 @@ def _metrics_for(name: str) -> list:
     if name == "overlap":
         return ["gpt345m_decode_overlap_ahead",
                 "gpt345m_decode_overlap_sync"]
+    if name == "spill":
+        return ["gpt345m_decode_spill_on",
+                "gpt345m_decode_spill_off"]
     return [f"gpt345m_decode_{name}"]
 
 
@@ -822,6 +832,147 @@ def run_overlap_case(args) -> list:
     return rows
 
 
+def run_spill_case(args) -> list:
+    """Host-RAM spill tier ON vs OFF under the SAME prefix-heavy
+    staggered trace with an arena prefix budget too small for the
+    traffic.
+
+    Two prefix families (A and B, one full KV block each) alternate at
+    fixed-seed staggered offsets against a prefix budget of ONE block:
+    every publication of one family evicts the other, so with the spill
+    tier OFF each arrival recomputes its full prompt, while with the
+    tier ON (``prefix_spill_bytes``) the evicted prefix demotes to host
+    RAM and the next arrival of its family READMITS it instead.  Both
+    sides run identical engines except ``prefix_spill_bytes`` and the
+    same primers (bare prefixes publish the blocks, one full prompt per
+    family compiles the post-hit suffix family outside the timed
+    window).  The ON row reports readmits and the prompt tokens
+    actually COMPUTED — strictly fewer than OFF whenever anything
+    readmitted — and greedy output token-identity across the sides is
+    counted honestly (``greedy_divergent_rows`` must be 0 at the f32
+    contract dtype: a readmitted block is the bit-exact KV that was
+    evicted)."""
+    import jax
+    import numpy as np
+
+    from paddlefleetx_tpu.core.continuous_batching import (
+        ContinuousScheduler,
+        PagedDecodeEngine,
+    )
+
+    from bench import knob_env
+
+    n_req = int(os.environ.get("BENCH_SPILL_N", 6))
+    gap_frac = float(os.environ.get("BENCH_STAGGER_GAP", 0.5))
+    block = 8  # small block so a tiny --prompt still carries full blocks
+    server = _serving_server(args, greedy=True)
+    rng = np.random.default_rng(13)
+    shared_len = block
+    tail_len = max(args.prompt - shared_len, block)
+    fams = ("A", "B")
+    pref = {f: rng.integers(1, 50304, shared_len).tolist() for f in fams}
+    # primer tails are DISTINCT from the timed prompts: the timed
+    # window must exercise prefix readmission, not whole-prompt reuse
+    primer = {f: pref[f] + rng.integers(1, 50304, tail_len).tolist()
+              for f in fams}
+    prompts = [
+        pref[fams[i % 2]] + rng.integers(1, 50304, tail_len).tolist()
+        for i in range(n_req)
+    ]
+
+    with knob_env(_OVERHAUL_ENV):
+        # calibrate the arrival gaps off one warm single decode
+        server.generate_ids([prompts[0]], max_dec_len=args.dec)
+        t0 = time.perf_counter()
+        server.generate_ids([prompts[0]], max_dec_len=args.dec)
+        t_one = time.perf_counter() - t0
+        offsets = _staggered_trace(n_req, mean_gap_s=gap_frac * t_one)
+
+        sides = {}
+        for label, spill_bytes in (("off", 0), ("on", 64 << 20)):
+            engine = PagedDecodeEngine(
+                server, max_batch=max(8, n_req), block=block,
+                # ONE block of prefix budget: publishing either family
+                # evicts the other — the churn the spill tier survives
+                prefix_cache_blocks=1,
+                prefix_spill_bytes=spill_bytes,
+            )
+            sched = ContinuousScheduler(engine, max_depth=2 * n_req)
+            sched.warmup([shared_len + tail_len])
+            sched.start()
+            # primers, identical on both sides and OUTSIDE the timed
+            # window: bare prefixes publish each family's block; full
+            # prompts compile the post-hit suffix prefill family.  After
+            # family B's primers, family A's block is evicted — spilled
+            # on the ON side, gone on the OFF side
+            for f in fams:
+                sched.submit([list(pref[f])], args.dec).result(timeout=600)
+                sched.submit([list(primer[f])], args.dec).result(timeout=600)
+            tok0 = int(engine.stats["prefill_tokens"])
+            pfx = engine.cache.prefix.stats
+            h0, ht0 = int(pfx["hits"]), int(pfx["hit_tokens"])
+            sp = engine.cache.spill.stats
+            sp0, rd0, dc0 = (int(sp["spills"]), int(sp["readmits"]),
+                             int(sp["discards"]))
+            ttft, outs, wall = _drive_staggered(
+                sched.submit, offsets, prompts, args.dec
+            )
+            sched.shutdown(timeout=60)
+            sides[label] = {
+                "ttft": ttft, "outs": outs, "wall": wall,
+                "prefill_tokens": int(engine.stats["prefill_tokens"]) - tok0,
+                "hits": int(pfx["hits"]) - h0,
+                "hit_tokens": int(pfx["hit_tokens"]) - ht0,
+                "spills": int(sp["spills"]) - sp0,
+                "readmits": int(sp["readmits"]) - rd0,
+                "spill_discards": int(sp["discards"]) - dc0,
+                "traces": int(engine.stats["traces"]),
+            }
+
+    a, b = sides["on"], sides["off"]
+    if [len(o) for o in a["outs"]] != [len(o) for o in b["outs"]]:
+        raise RuntimeError(
+            "spill-tier DELIVERED COUNTS diverged from spill-off — the "
+            "prefill/readmit A/B would be unfair"
+        )
+    divergent = sum(1 for x, y in zip(a["outs"], b["outs"]) if x != y)
+    n_dev = jax.device_count()
+    rows = []
+    for label, side, budget in (("on", a, 64 << 20), ("off", b, 0)):
+        toks = sum(len(o) for o in side["outs"])
+        rows.append({
+            "metric": f"gpt345m_decode_spill_{label}",
+            "value": round(toks / side["wall"] / n_dev, 1),
+            "unit": "delivered new tokens/s/chip "
+                    "(prefix-heavy staggered, spill A/B)",
+            "vs_baseline": None,
+            "arrivals": n_req, "prompt_len": shared_len + tail_len,
+            "dec_len": args.dec,
+            "shared_prefix_len": shared_len,
+            "kv_block": block,
+            "prefix_budget_blocks": 1,
+            "spill_budget_bytes": budget,
+            "mean_gap_s": round(float(gap_frac * t_one), 4),
+            "p50_ttft_s": round(float(np.quantile(side["ttft"], 0.5)), 4),
+            "p99_ttft_s": round(float(np.quantile(side["ttft"], 0.99)), 4),
+            "prefill_tokens": side["prefill_tokens"],
+            "prefix_hits": side["hits"],
+            "prefix_hit_tokens": side["hit_tokens"],
+            "spills": side["spills"],
+            "readmits": side["readmits"],
+            "spill_discards": side["spill_discards"],
+            "readmit_hit_rate": round(side["readmits"] / n_req, 4),
+            "greedy_divergent_rows": divergent,
+            "jit_traces": side["traces"],
+            "strategy": "greedy_search",
+            "decode_path": "overhauled",
+            "scheduler": "continuous",
+            **_mfu_fields(server.module.config, toks / side["wall"] / n_dev),
+            "platform": jax.default_backend(),
+        })
+    return rows
+
+
 def _parent(argv) -> int:
     from bench import run_child_with_honest_fallback
 
@@ -880,6 +1031,8 @@ def _child(argv) -> None:
                 rows = run_prefix_case(args)
             elif name == "overlap":
                 rows = run_overlap_case(args)
+            elif name == "spill":
+                rows = run_spill_case(args)
             elif "_spec" in name:
                 rows = [run_spec_case(name, args, params_cache)]
             elif name.endswith("_kvint8"):
@@ -904,7 +1057,7 @@ def _argparser():
         default="b8_greedy,b8_greedy_legacy,b8_topp,b8_topp_legacy,"
                 "b32_greedy,b32_greedy_legacy,b32_topp,b32_topp_legacy,"
                 "b8_greedy_spec4,b8_greedy_kvint8,serving,staggered,prefix,"
-                "overlap",
+                "overlap,spill",
     )
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--dec", type=int, default=256)

@@ -196,7 +196,8 @@ class PagedDecodeEngine:
     def __init__(self, server, *, max_batch: int = 8, block: int = 0,
                  num_blocks: int = 0, spec="auto", kv_dtype: str = "",
                  prefix_cache_blocks: int = 0,
-                 prefill_chunk: int = 0) -> None:
+                 prefill_chunk: int = 0,
+                 prefix_spill_bytes: int = 0) -> None:
         from paddlefleetx_tpu.models.gpt.generation import init_paged_pools
         from paddlefleetx_tpu.parallel.mesh import data_parallel_world
 
@@ -249,9 +250,22 @@ class PagedDecodeEngine:
                 f"multiple of the KV block size {self.block}"
             )
         self.prefill_chunk = int(prefill_chunk)
+        # host-RAM spill tier (docs/serving.md "KV lifecycle"): evicted
+        # prefix blocks demote to a bounded host store and readmit on a
+        # later match instead of recomputing.  Spilling without an index
+        # to evict FROM is a config error, loudly
+        if prefix_spill_bytes and not prefix_cache_blocks:
+            raise ValueError(
+                "prefix_spill_bytes requires prefix_cache_blocks > 0 "
+                "(the spill tier shadows the radix index)"
+            )
         self.cache = PagedCacheManager(
-            num_blocks, self.block, prefix_blocks=prefix_cache_blocks
+            num_blocks, self.block, prefix_blocks=prefix_cache_blocks,
+            spill_bytes=prefix_spill_bytes,
         )
+        if self.cache.spill.enabled:
+            self.cache.prefix.spill_hook = self._spill_block
+        self._spill_probes = 0
         self.pools = init_paged_pools(
             self.mcfg, num_blocks, self.block, kv_dtype=self.kv_dtype
         )
@@ -293,6 +307,7 @@ class PagedDecodeEngine:
             "exports": 0, "adopts": 0,
             "prefill_tokens": 0, "prefill_chunks": 0,
             "host_gap_s": 0.0, "gap_steps": 0,
+            "migrate_adopted": 0,
         }
         # True only inside warmup(): warmup admits/steps are not traffic
         # and must not bump the traffic-facing registry counters (the
@@ -503,6 +518,111 @@ class PagedDecodeEngine:
                 "pfx_prefix_evictions_total"
             ).inc(evicted)
 
+    def _spill_block(self, path: tuple, block_id: int) -> None:
+        """PrefixIndex eviction hook: demote one evicted FULL block's KV
+        to the host-RAM spill store before its arena reference drops.
+        Runs inside ``_evict_node`` — the gather reads a block whose
+        reference is still held, and ``clear()`` (ArenaReset) never
+        routes through here, so a dead arena's blocks cannot spill.
+        Warmup evictions never spill either (synthetic KV must not
+        readmit into traffic).  Any failure degrades to a plain
+        eviction behind the discard counter — the graceful-degradation
+        contract: spilling is an optimization, never a failure mode."""
+        spill = self.cache.spill
+        if self._warmup or not spill.enabled:
+            return
+        from paddlefleetx_tpu.models.gpt.generation import gather_kv_blocks
+
+        sp0 = spill.stats["spills"]
+        dc0 = spill.stats["discards"]
+        try:
+            spill.put(path, gather_kv_blocks(self.pools, [int(block_id)]))
+        except Exception as exc:  # noqa: BLE001 — degrade, never block
+            logger.warning(                       # the eviction
+                f"prefix spill failed ({type(exc).__name__}: {exc}); "
+                "block evicted without a host copy"
+            )
+            spill.stats["discards"] += 1
+        reg = get_registry()
+        d = spill.stats["spills"] - sp0
+        if d:
+            reg.counter("pfx_prefix_spills_total").inc(d)
+        d = spill.stats["discards"] - dc0
+        if d:
+            reg.counter("pfx_prefix_spill_discards_total").inc(d)
+
+    def _readmit_spilled(self, prompt_ids: List[int], m: int) -> int:
+        """Promote spilled host copies of this prompt's next full blocks
+        back into the arena, extending the radix match from ``m`` tokens
+        on.  Each hit allocates one block, scatters the host copy in
+        (the one-compile-ever ``_adopt_fn(1)`` family), and inserts the
+        node — the caller re-runs ``match()`` so the readmitted blocks
+        flow through the normal shared-admission and exact-replay hit
+        accounting.  Every failure — checksum mismatch, the
+        ``spill_corrupt`` drill, pool pressure — degrades to recompute
+        behind the discard counter; only :class:`ArenaReset` propagates
+        (a donated dispatch died, the engine-wide contract)."""
+        spill = self.cache.spill
+        limit = len(prompt_ids) - 1  # match's cap: >= 1 token recomputes
+        readmitted = 0
+        rd0 = spill.stats["readmits"]
+        dc0 = spill.stats["discards"]
+        jnp = self._jnp
+        try:
+            while m + self.block <= limit:
+                key = tuple(int(t) for t in prompt_ids[:m + self.block])
+                self._spill_probes += 1
+                # deterministic corruption drill (docs/fault_tolerance.md
+                # spill_corrupt): the Kth probe treats the entry as torn —
+                # discarded loudly, the request recomputes and succeeds
+                if maybe_fire("spill_corrupt", self._spill_probes):
+                    spill.discard(key)
+                    break
+                arrays = spill.get(key)  # checksum-verified; None = miss
+                if arrays is None:
+                    break
+                try:
+                    fresh = self.cache.allocator.alloc(1)
+                except BlockPoolExhausted:
+                    break  # recompute; the entry waits for calmer pressure
+                names = ("k", "v", "k_scale", "v_scale")
+                blocks_t = tuple(
+                    jnp.asarray(arrays[n]) for n in names if n in arrays
+                )
+                fn = self._adopt_fn(1)
+                try:
+                    pools_t = self._dispatch_donating(
+                        lambda: fn(
+                            self._pools_tuple(),
+                            jnp.asarray(fresh, jnp.int32),
+                            blocks_t,
+                        ),
+                        "spill readmit",
+                    )
+                except ArenaReset:
+                    # reset() released every row and cleared the index,
+                    # but this orphan allocation is ours to return
+                    self.cache.allocator.free(fresh)
+                    raise
+                from paddlefleetx_tpu.models.gpt.generation import PagedPools
+
+                self.pools = PagedPools(*pools_t)
+                self.cache.prefix.insert_block(key, fresh[0])
+                spill.pop(key)  # back on device; counted as a readmit
+                readmitted += 1
+                m += self.block
+            if readmitted:
+                self.cache.prefix.evict_to_budget()
+        finally:
+            reg = get_registry()
+            d = spill.stats["readmits"] - rd0
+            if d:
+                reg.counter("pfx_prefix_readmits_total").inc(d)
+            d = spill.stats["discards"] - dc0
+            if d:
+                reg.counter("pfx_prefix_spill_discards_total").inc(d)
+        return readmitted
+
     def _prefix_admit(self, prompt_ids: List[int], capacity_tokens: int,
                       label: str = "prefix"
                       ) -> Tuple[int, List[int], List[int],
@@ -525,6 +645,14 @@ class PagedDecodeEngine:
         m = 0
         if self.prefix_enabled and not self._warmup:
             shared, cow, m = self.cache.prefix.match(prompt_ids)
+            # spill-tier readmit: when the on-device trie runs dry at a
+            # block boundary (no COW divergence), promote spilled host
+            # copies of the NEXT blocks, then re-match so shared/m flow
+            # through the one hit-accounting path below
+            if (self.cache.spill.enabled and cow is None
+                    and len(self.cache.spill)
+                    and self._readmit_spilled(prompt_ids, m)):
+                shared, cow, m = self.cache.prefix.match(prompt_ids)
         self._seq_counter += 1
         seq_id = self._seq_counter
         table = self._cache_admit(seq_id, capacity_tokens, shared=shared)
@@ -1069,6 +1197,144 @@ class PagedDecodeEngine:
         maybe_fire("adopt_crash", self.stats["adopts"])
         return slot
 
+    # -- peer-to-peer prefix migration (drain/scale-down survival) -----
+    def export_hot_prefixes(self, max_blocks: int = 0
+                            ) -> Optional[Tuple[Dict[str, Any],
+                                                Dict[str, np.ndarray]]]:
+        """Snapshot the hottest published prefix blocks as ONE handoff
+        payload ``(meta, arrays)`` for peer adoption on drain.  The
+        top-``max_blocks`` most-recently-used FULL blocks are taken
+        together with their ancestor chains (a child's KV is unmatchable
+        without its parents), shortest path first, so the receiver can
+        adopt in order and stop cleanly at any boundary.  Returns None
+        when nothing is cached.  Called on the drain path AFTER the
+        scheduler thread exited — the index walk is single-threaded."""
+        if not self.prefix_enabled:
+            return None
+        pfx = self.cache.prefix
+        nodes = [
+            n for n in list(pfx._nodes) if len(n.tokens) == self.block
+        ]
+        if not nodes:
+            return None
+        nodes.sort(key=lambda n: n.last_used, reverse=True)
+        picked = nodes[:max_blocks] if max_blocks > 0 else nodes
+        chosen: set = set()
+        for n in picked:
+            while n is not None and n not in chosen:
+                if len(n.tokens) == self.block:
+                    chosen.add(n)
+                n = n.parent
+        order = sorted(chosen, key=lambda n: len(pfx.node_path(n)))
+        from paddlefleetx_tpu.models.gpt.generation import gather_kv_blocks
+
+        arrays = gather_kv_blocks(self.pools, [n.block_id for n in order])
+        meta = {
+            "kind": "prefixes",
+            "prefixes": [list(pfx.node_path(n)) for n in order],
+            "block": self.block,
+            "kv_dtype": self.kv_dtype,
+            "pool_sig": self._pool_sig(),
+        }
+        return meta, arrays
+
+    def validate_prefix_payload(self, meta: Dict[str, Any],
+                                arrays: Dict[str, Any]) -> int:
+        """LOUD structural validation of a migration payload — run in
+        full BEFORE anything touches the arena (the adopt rule: a torn
+        or incompatible transfer is rejected whole, never half-adopted).
+        Returns the block count."""
+        check_handoff_meta(
+            meta, block=self.block, kv_dtype=self.kv_dtype,
+            pool_sig=self._pool_sig(),
+        )
+        prefixes = meta.get("prefixes")
+        if not isinstance(prefixes, list) or not prefixes:
+            raise ValueError("prefix payload carries no prefixes")
+        for p in prefixes:
+            if not isinstance(p, (list, tuple)) or not p \
+                    or len(p) % self.block:
+                raise ValueError(
+                    "prefix path is not a token list of positive "
+                    f"block-{self.block}-multiple length: {p!r:.60}"
+                )
+        names = ("k", "v", "k_scale", "v_scale")
+        need = set(names[: 4 if self.kv_dtype == "int8" else 2])
+        if not need <= set(arrays):
+            raise ValueError(
+                f"prefix payload missing arrays "
+                f"{sorted(need - set(arrays))} (has {sorted(arrays)})"
+            )
+        nb = len(prefixes)
+        for name in sorted(need):
+            got = tuple(np.shape(arrays[name]))
+            if len(got) != 5 or got[1] != nb:
+                raise ValueError(
+                    f"prefix payload array {name!r} shape {got} does "
+                    f"not carry {nb} blocks"
+                )
+        return nb
+
+    def adopt_prefixes(self, meta: Dict[str, Any],
+                       arrays: Dict[str, Any]) -> int:
+        """Migration-receiver half: adopt a draining peer's exported
+        prefix blocks into this arena's radix index.  Entries land
+        shortest-path-first so ancestor chains always precede children;
+        pool pressure stops the adoption cleanly at a block boundary
+        (what landed is a valid prefix, the rest is dropped — never
+        half-adopted), and an already-cached path is skipped (an
+        idempotent re-send only bumps LRU).  Returns adopted count."""
+        nb = self.validate_prefix_payload(meta, arrays)
+        if not self.prefix_enabled:
+            return 0
+        prefixes = meta["prefixes"]
+        names = ("k", "v", "k_scale", "v_scale")
+        need = set(names[: 4 if self.kv_dtype == "int8" else 2])
+        order = sorted(range(nb), key=lambda i: len(prefixes[i]))
+        jnp = self._jnp
+        adopted = 0
+        for i in order:
+            path = [int(t) for t in prefixes[i]]
+            if self.cache.prefix.has_path(path):
+                continue
+            if len(path) > self.block and not self.cache.prefix.has_path(
+                    path[:-self.block]):
+                continue  # its parent never landed (pressure): skip child
+            try:
+                fresh = self.cache.allocator.alloc(1)
+            except BlockPoolExhausted:
+                break  # prefix-closed stop: everything adopted so far holds
+            blocks_t = tuple(
+                jnp.asarray(np.ascontiguousarray(arrays[n][:, i:i + 1]))
+                for n in names if n in need
+            )
+            fn = self._adopt_fn(1)
+            try:
+                pools_t = self._dispatch_donating(
+                    lambda: fn(
+                        self._pools_tuple(),
+                        jnp.asarray(fresh, jnp.int32),
+                        blocks_t,
+                    ),
+                    "prefix adopt",
+                )
+            except ArenaReset:
+                self.cache.allocator.free(fresh)  # orphan: ours to return
+                raise
+            from paddlefleetx_tpu.models.gpt.generation import PagedPools
+
+            self.pools = PagedPools(*pools_t)
+            self.cache.prefix.insert_block(path, fresh[0])
+            adopted += 1
+        if adopted:
+            self.cache.prefix.evict_to_budget()
+            self.stats["migrate_adopted"] += adopted
+            if not self._warmup:
+                get_registry().counter(
+                    "pfx_migrate_adopted_total"
+                ).inc(adopted)
+        return adopted
+
     def table_width_bucket(self) -> int:
         widest = max(
             (len(r.table) for r in self.slots if r is not None), default=1
@@ -1365,8 +1631,13 @@ class PagedDecodeEngine:
             self.cache.release(r.seq_id)
         # the rebuilt pools hold NONE of the old blocks' KV: every cached
         # prefix is donation-invalidated and must never resurface as a
-        # hit — drop the whole index (its block references with it)
+        # hit — drop the whole index (its block references with it) AND
+        # the spill store in the same breath: a host copy of a dead
+        # arena's block must never readmit (the ArenaReset atomicity
+        # half of the spill contract; clear() frees directly, never
+        # through _evict_node, so nothing re-spills here either)
         self.cache.prefix.clear()
+        self.cache.spill.clear()
         self.slots = [None] * self.capacity
         self.active[:] = False
         self.positions[:] = 0
@@ -1590,6 +1861,11 @@ class ContinuousScheduler:
                 f"PFX_SCHED_QUANTUM must be >= 1, got {self.quantum}"
             )
         self._entries: List[_CBEntry] = []
+        # peer prefix adoptions (POST /admin/adopt_prefixes) queued for
+        # the scheduler thread: (meta, arrays, future) triples, drained
+        # at iteration boundaries so donated dispatches stay
+        # single-threaded with every other arena touch
+        self._admin_tasks: List[tuple] = []
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._closed = False
@@ -1662,6 +1938,13 @@ class ContinuousScheduler:
              float(cstats["kv_blocks_used"]) * eng.kv_block_bytes()),
             ("pfx_prefix_cached_blocks", {},
              float(cstats["prefix_cached_blocks"])),
+            # host-RAM spill tier occupancy (0 when --prefix-spill-bytes
+            # is off; the spills/readmits/discards counters live in the
+            # engine's readmit/spill sites)
+            ("pfx_prefix_spill_bytes", {},
+             float(cstats["prefix_spill_bytes"])),
+            ("pfx_prefix_spill_entries", {},
+             float(cstats["prefix_spill_entries"])),
         ]
         if eng.spec is not None:
             prop = float(eng.stats["spec_proposed"])
@@ -1767,6 +2050,24 @@ class ContinuousScheduler:
             raise
         return entry.future
 
+    def submit_prefix_adoption(self, meta: Dict[str, Any],
+                               arrays: Dict[str, Any]) -> RequestFuture:
+        """Queue a draining peer's exported prefix payload for adoption
+        on the scheduler thread (POST /admin/adopt_prefixes).  The FULL
+        structural validation runs here, pre-queue — a torn or
+        incompatible payload raises ``ValueError`` now (HTTP 400) and
+        never reaches a donated dispatch (the adopt rule).  The future
+        resolves with the adopted-block count once the scheduler folds
+        the payload in at an iteration boundary."""
+        self.engine.validate_prefix_payload(meta, arrays)
+        fut = RequestFuture()
+        with self._wake:
+            if self._closed:
+                raise QueueClosed(f"{self.name} queue is draining")
+            self._admin_tasks.append((meta, arrays, fut))
+            self._wake.notify_all()
+        return fut
+
     def depth(self) -> int:
         with self._lock:
             return len(self._entries)
@@ -1859,6 +2160,13 @@ class ContinuousScheduler:
                 "prefill_chunk": eng.prefill_chunk,
                 "prefill_chunks": int(eng.stats["prefill_chunks"]),
                 "prefill_tokens": int(eng.stats["prefill_tokens"]),
+                "spill_budget_bytes": eng.cache.spill.budget,
+                "spill_bytes": eng.cache.spill.bytes_used(),
+                "spill_entries": len(eng.cache.spill),
+                "spills": int(eng.cache.spill.stats["spills"]),
+                "readmits": int(eng.cache.spill.stats["readmits"]),
+                "spill_discards": int(eng.cache.spill.stats["discards"]),
+                "migrate_adopted": int(eng.stats["migrate_adopted"]),
             }
         if eng.spec is not None:
             prop = int(eng.stats["spec_proposed"])
@@ -1970,7 +2278,8 @@ class ContinuousScheduler:
     def _run(self) -> None:
         while True:
             with self._wake:
-                while not self._entries and not self._has_live_rows():
+                while (not self._entries and not self._admin_tasks
+                       and not self._has_live_rows()):
                     if self._closed:
                         return  # drained
                     self._wake.wait()
@@ -2044,6 +2353,11 @@ class ContinuousScheduler:
         pfx_t0 = int(pfx["hit_tokens"])
         pfx_e0 = int(pfx["evictions"])
         chunks0 = int(eng.stats["prefill_chunks"])
+        spill = eng.cache.spill.stats
+        spill_s0 = int(spill["spills"])
+        spill_r0 = int(spill["readmits"])
+        spill_d0 = int(spill["discards"])
+        mig_a0 = int(eng.stats["migrate_adopted"])
         blocks_free0 = eng.cache.allocator.free_count()
         n_finished = 0
         try:
@@ -2080,6 +2394,16 @@ class ContinuousScheduler:
                     "prefix_hit_tokens": int(pfx["hit_tokens"]) - pfx_t0,
                     "prefix_evictions": int(pfx["evictions"]) - pfx_e0,
                     "chunks": int(eng.stats["prefill_chunks"]) - chunks0,
+                    # spill-tier + migration deltas: every site moves
+                    # the store stats and registry counters together,
+                    # so the replay fold reproduces pfx_prefix_spills/
+                    # readmits/spill_discards and pfx_migrate_adopted
+                    # exactly (the PR 8/12 contract extended)
+                    "spills": int(spill["spills"]) - spill_s0,
+                    "readmits": int(spill["readmits"]) - spill_r0,
+                    "spill_discards": int(spill["discards"]) - spill_d0,
+                    "migrate_adopted":
+                        int(eng.stats["migrate_adopted"]) - mig_a0,
                 }
                 with self._lock:
                     self.decision_log.append(row)
@@ -2104,6 +2428,31 @@ class ContinuousScheduler:
         )
         if not boundary:
             return self._step_batch()
+
+        # peer prefix adoptions (drain-migration receiver): folded in at
+        # a boundary, BEFORE this iteration's admissions, so a migrated
+        # prefix is hittable by the very next admit.  Each payload was
+        # fully validated at submit time; adoption failures fail only
+        # their own future — except an ArenaReset, which fails every
+        # live row exactly like a prefill dispatch death
+        with self._wake:
+            tasks, self._admin_tasks = self._admin_tasks, []
+        for meta, arrays, fut in tasks:
+            try:
+                fut.set_result(eng.adopt_prefixes(meta, arrays))
+            except ArenaReset as exc:
+                self.stats["gen_errors"] += 1
+                self._fail_rows(exc.dead_rows, exc)
+                if not fut.done():
+                    fut.set_exception(exc)
+                logger.warning(f"{self.name}: {exc}")
+            except Exception as exc:  # noqa: BLE001 — fail this payload
+                if not fut.done():    # alone, keep serving
+                    fut.set_exception(exc)
+                logger.warning(
+                    f"{self.name}: prefix adoption failed: "
+                    f"{type(exc).__name__}: {exc}"
+                )
 
         admitted: List[tuple] = []
         expired_partial: List[_CBEntry] = []

@@ -35,10 +35,12 @@ Knobs (loud-parse like PFX_DECODE_BLOCK):
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import struct
-from typing import Any, Dict, List, Optional, Tuple
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -276,6 +278,14 @@ class PrefixIndex:
         self.stats: Dict[str, int] = {
             "hits": 0, "misses": 0, "hit_tokens": 0, "evictions": 0,
         }
+        # spill tier hook (docs/serving.md "KV lifecycle"): when set, an
+        # LRU eviction of a FULL block offers (full_token_path, block_id)
+        # to the hook BEFORE the allocator reference drops, so the owner
+        # can demote the block's KV to host RAM instead of losing it.
+        # The hook must never veto the eviction — graceful degradation
+        # is the contract, so a failing hook is swallowed here (the
+        # engine counts its own discards loudly).
+        self.spill_hook: Optional[Callable[[tuple, int], None]] = None
 
     @property
     def enabled(self) -> bool:
@@ -408,11 +418,99 @@ class PrefixIndex:
         self.evict_to_budget()
         return added
 
+    # -- structural inserts (spill readmit / migration adoption) --------
+    @staticmethod
+    def node_path(node: _PrefixNode) -> tuple:
+        """Full token path from the root down to (and including) ``node``
+        — the spill/migration key for the block it pins."""
+        runs = []
+        while node is not None:
+            runs.append(node.tokens)
+            node = node.parent
+        return tuple(t for run in reversed(runs) for t in run)
+
+    def insert_block(self, path_tokens, block_id: int) -> None:
+        """Insert ONE full cached block whose token path is
+        ``path_tokens`` (length a positive multiple of ``block``),
+        TAKING OVER the caller's allocator reference on ``block_id`` —
+        unlike :meth:`publish`, no extra ``share`` happens, so the
+        caller must hand in a block it owns (freshly allocated and
+        scattered by the spill-readmit / migration-adoption paths).
+        LOUD when the ancestor chain is not cached or the path is
+        already present: either means the caller raced its own
+        bookkeeping, and silently adopting would leak the reference."""
+        tokens = tuple(int(t) for t in path_tokens)
+        if not tokens or len(tokens) % self.block:
+            raise ValueError(
+                f"insert_block path length {len(tokens)} is not a "
+                f"positive multiple of block {self.block}"
+            )
+        children = self.root
+        parent: Optional[_PrefixNode] = None
+        depth = len(tokens) // self.block
+        for i in range(depth - 1):
+            run = tuple(tokens[i * self.block:(i + 1) * self.block])
+            node = children.get(run)
+            if node is None:
+                raise ValueError(
+                    "insert_block ancestor chain not cached at depth "
+                    f"{i} (insert parents first)"
+                )
+            children = node.children
+            parent = node
+        run = tuple(tokens[(depth - 1) * self.block:])
+        if run in children:
+            raise ValueError("insert_block path already cached")
+        node = _PrefixNode(run, block_id, parent)
+        children[run] = node
+        self._nodes.add(node)
+        self._bump(node)
+
+    def has_path(self, path_tokens) -> bool:
+        """True when the exact full-block path is already cached (the
+        migration receiver's idempotence check); bumps LRU on hit."""
+        tokens = tuple(int(t) for t in path_tokens)
+        if not tokens or len(tokens) % self.block:
+            return False
+        children = self.root
+        node = None
+        for i in range(len(tokens) // self.block):
+            node = children.get(tuple(tokens[i * self.block:(i + 1) * self.block]))
+            if node is None:
+                return False
+            children = node.children
+        self._bump(node)
+        return True
+
+    def digest(self, top: int = 32) -> List[int]:
+        """Compact advertisement of the hottest cached prefixes: crc32
+        path hashes of the most-recently-used full-block nodes, newest
+        first (prefix-affinity routing reads this off /healthz).  Safe
+        from scrape threads for the same reason as
+        :meth:`reclaimable_blocks` — the ``list()`` snapshot is atomic
+        and parent chains on a node evicted mid-walk stay readable (a
+        momentarily-stale hash, never an exception)."""
+        nodes = list(self._nodes)
+        nodes.sort(key=lambda n: n.last_used, reverse=True)
+        out: List[int] = []
+        for n in nodes:
+            if len(n.tokens) != self.block:
+                continue  # partial leaves are COW material, not routable
+            out.append(prefix_path_hash(self.node_path(n)))
+            if len(out) >= top:
+                break
+        return out
+
     # -- eviction -------------------------------------------------------
     def _evict_node(self, node: _PrefixNode) -> None:
         siblings = node.parent.children if node.parent else self.root
         del siblings[node.tokens]
         self._nodes.discard(node)
+        if self.spill_hook is not None and len(node.tokens) == self.block:
+            try:
+                self.spill_hook(self.node_path(node), node.block_id)
+            except Exception:  # noqa: BLE001 — spill failure never blocks
+                pass           # eviction; the engine counts discards
         self.allocator.free([node.block_id])
         self.stats["evictions"] += 1
 
@@ -473,6 +571,154 @@ class PrefixIndex:
             self.allocator.free([node.block_id])
         self._nodes = set()
         self.root = {}
+        return n
+
+
+# ---------------------------------------------------------------------------
+# Host-RAM spill tier + prefix digests (docs/serving.md "KV lifecycle")
+#
+# When the radix index evicts a block under LRU pressure, the KV it
+# holds is still bit-correct — recomputing it later burns prefill FLOPs
+# for nothing.  The spill store keeps a bounded host-RAM copy (gathered
+# off-device by the engine via `gather_kv_blocks`, int8 scale planes
+# included) keyed by the block's FULL token path; a later prefix match
+# that runs past the on-device trie readmits from here instead of
+# recomputing.  Graceful degradation is the contract: a checksum
+# mismatch, budget pressure, or any readmit failure silently falls back
+# to recompute behind a loud counter — never a failed request.
+# ---------------------------------------------------------------------------
+
+
+def prefix_path_hash(tokens) -> int:
+    """Stable crc32 of a token path — the unit of the prefix digest
+    `/healthz` advertises and the router matches against.  uint32
+    little-endian byte layout so every replica and the router agree."""
+    return zlib.crc32(
+        np.asarray(list(tokens), dtype=np.uint32).tobytes()
+    )
+
+
+def prefix_digest_hashes(tokens, block: int) -> List[int]:
+    """All block-aligned prefix hashes of a prompt, shortest first —
+    what the router computes for an incoming request and intersects
+    with each replica's advertised :meth:`PrefixIndex.digest`."""
+    tokens = [int(t) for t in tokens]
+    return [
+        prefix_path_hash(tokens[:j * block])
+        for j in range(1, len(tokens) // block + 1)
+    ]
+
+
+class PrefixSpillStore:
+    """Bounded host-RAM store of evicted prefix blocks.
+
+    Entries are keyed by the block's full token path and carry the
+    block's gathered arrays (k/v, plus int8 scale planes when the arena
+    quantizes) with a crc32 over the raw bytes; :meth:`get` verifies the
+    checksum on every read and drops a torn entry rather than ever
+    handing corrupt KV back to the arena.  ``budget_bytes`` caps the
+    store (0 disables it); admission past the budget LRU-evicts, and an
+    entry that alone exceeds the budget is refused outright — both
+    counted in ``stats['discards']`` (the loud half of the graceful-
+    degradation contract).  Single-threaded with the scheduler like the
+    index it shadows."""
+
+    def __init__(self, budget_bytes: int = 0) -> None:
+        if budget_bytes < 0:
+            raise ValueError(
+                f"spill budget must be >= 0 bytes, got {budget_bytes}"
+            )
+        self.budget = int(budget_bytes)
+        self._entries: "collections.OrderedDict[tuple, Dict[str, Any]]" = (
+            collections.OrderedDict()
+        )
+        self._bytes = 0
+        self.stats: Dict[str, int] = {
+            "spills": 0, "readmits": 0, "discards": 0,
+        }
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget > 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    @staticmethod
+    def _crc(arrays: Dict[str, np.ndarray]) -> int:
+        crc = 0
+        for name in sorted(arrays):
+            crc = zlib.crc32(arrays[name].tobytes(), crc)
+        return crc
+
+    def put(self, key, arrays: Dict[str, np.ndarray]) -> bool:
+        """Admit one evicted block's host copy; returns True when the
+        entry landed.  A re-put of an existing key replaces it."""
+        if not self.enabled:
+            return False
+        key = tuple(int(t) for t in key)
+        arrs = {n: np.ascontiguousarray(a) for n, a in arrays.items()}
+        nbytes = int(sum(a.nbytes for a in arrs.values()))
+        if nbytes > self.budget:
+            self.stats["discards"] += 1
+            return False
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._bytes -= old["nbytes"]
+        while self._bytes + nbytes > self.budget and self._entries:
+            _, lru = self._entries.popitem(last=False)
+            self._bytes -= lru["nbytes"]
+            self.stats["discards"] += 1
+        self._entries[key] = {
+            "arrays": arrs, "nbytes": nbytes, "crc": self._crc(arrs),
+        }
+        self._bytes += nbytes
+        self.stats["spills"] += 1
+        return True
+
+    def get(self, key) -> Optional[Dict[str, np.ndarray]]:
+        """Checksum-verified read; a corrupt entry is dropped (counted)
+        and ``None`` returned — the caller recomputes.  A hit bumps
+        LRU but leaves the entry resident (``pop`` removes it once the
+        block is back on device)."""
+        key = tuple(int(t) for t in key)
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
+        if self._crc(entry["arrays"]) != entry["crc"]:
+            self.discard(key)
+            return None
+        self._entries.move_to_end(key)
+        return entry["arrays"]
+
+    def pop(self, key) -> None:
+        """Remove a successfully-readmitted entry (counted as a
+        readmit, not a discard)."""
+        key = tuple(int(t) for t in key)
+        entry = self._entries.pop(key, None)
+        if entry is not None:
+            self._bytes -= entry["nbytes"]
+            self.stats["readmits"] += 1
+
+    def discard(self, key) -> None:
+        """Drop an entry that failed verification or whose readmit
+        failed — the loud-counter half of graceful degradation."""
+        key = tuple(int(t) for t in key)
+        entry = self._entries.pop(key, None)
+        if entry is not None:
+            self._bytes -= entry["nbytes"]
+            self.stats["discards"] += 1
+
+    def clear(self) -> int:
+        """Invalidate EVERYTHING (ArenaReset: spilled copies of a dead
+        arena's blocks must never readmit).  Not counted as discards —
+        nothing was displaced by pressure."""
+        n = len(self._entries)
+        self._entries.clear()
+        self._bytes = 0
         return n
 
 
@@ -572,15 +818,31 @@ def check_handoff_meta(meta: Dict[str, Any], *, block: int, kv_dtype: str,
     [layers, heads, block, head_dim] (the arena shape minus the
     num_blocks dim, which may legitimately differ between replicas)."""
     problems = []
-    if int(meta.get("block", -1)) != int(block):
+    # every field coerces under its own guard: a malformed value (a
+    # string block size, a pool_sig of dicts) must land as a NAMED
+    # problem in the one incompatibility error, never escape as a bare
+    # TypeError that hides which field was wrong
+    try:
+        if int(meta.get("block", -1)) != int(block):
+            problems.append(
+                f"block size {meta.get('block')} != arena block {block}"
+            )
+    except (TypeError, ValueError):
         problems.append(
-            f"block size {meta.get('block')} != arena block {block}"
+            f"block size {meta.get('block')!r} is not an integer"
         )
     if str(meta.get("kv_dtype", "")) != str(kv_dtype):
         problems.append(
             f"kv dtype {meta.get('kv_dtype')!r} != arena dtype {kv_dtype!r}"
         )
-    if [int(x) for x in meta.get("pool_sig", [])] != [int(x) for x in pool_sig]:
+    try:
+        sig = [int(x) for x in meta.get("pool_sig", [])]
+    except (TypeError, ValueError):
+        sig = None
+        problems.append(
+            f"pool_sig {meta.get('pool_sig')!r} is not a list of integers"
+        )
+    if sig is not None and sig != [int(x) for x in pool_sig]:
         problems.append(
             f"pool shape {meta.get('pool_sig')} != arena {list(pool_sig)}"
         )
@@ -609,10 +871,14 @@ class PagedCacheManager:
     """
 
     def __init__(self, num_blocks: int, block: int = 0,
-                 prefix_blocks: int = 0) -> None:
+                 prefix_blocks: int = 0, spill_bytes: int = 0) -> None:
         self.block = kv_block_size(block)
         self.allocator = BlockAllocator(num_blocks)
         self.prefix = PrefixIndex(self.allocator, self.block, prefix_blocks)
+        # host-RAM demotion tier for LRU-evicted prefix blocks
+        # (--prefix-spill-bytes; 0 = off).  The engine wires
+        # prefix.spill_hook to feed it and owns the readmit path.
+        self.spill = PrefixSpillStore(spill_bytes)
         self._tables: Dict[int, List[int]] = {}
 
     def available_blocks(self) -> int:
@@ -701,4 +967,6 @@ class PagedCacheManager:
             "live_sequences": len(self._tables),
             "fragmentation": round(self.allocator.fragmentation(), 4),
             "prefix_cached_blocks": self.prefix.cached_blocks(),
+            "prefix_spill_bytes": self.spill.bytes_used(),
+            "prefix_spill_entries": len(self.spill),
         }

@@ -186,6 +186,14 @@ class Replica:
     # paged-arena blocks an admission can actually obtain (decode
     # replicas report it; None until a poll carries the field)
     available_blocks: Optional[int] = None
+    # prefix-affinity advertisement (tools/serve.py /healthz): published
+    # shared-prefix blocks, the replica's KV block size, and crc32 path
+    # hashes of its hottest cached prefixes — `pick` scores a request
+    # toward the replica already holding its prefill (None/empty until
+    # a poll carries the fields; absent when the prefix cache is off)
+    prefix_cached_blocks: Optional[int] = None
+    prefix_block: int = 0
+    prefix_hashes: frozenset = frozenset()
     slo_breach: bool = False  # replica-reported SLO burn-rate breach
     # latency/TTFT view off the same /healthz snapshot (fleet-log fields)
     latency_p50_s: float = 0.0
@@ -221,6 +229,8 @@ class Replica:
             "busy_s": round(self.busy_s, 3),
             "occupancy": round(self.occupancy, 4),
             "available_blocks": self.available_blocks,
+            "prefix_cached_blocks": self.prefix_cached_blocks,
+            "prefix_hashes_advertised": len(self.prefix_hashes),
             "slo_breach": self.slo_breach,
             "latency_p50_s": self.latency_p50_s,
             "latency_p99_s": self.latency_p99_s,
@@ -544,7 +554,24 @@ _FLEET_SAMPLE_FIELDS = {
                             {"transport": "proxy"}),
     "handoff_exports_total": ("pfx_handoff_exports_total", {}),
     "handoff_adopts_total": ("pfx_handoff_adopts_total", {}),
+    # KV-durability view (docs/serving.md "KV lifecycle"): published
+    # prefix blocks + the spill tier + drain-time migration outcomes —
+    # tools/report.py --fleet renders the cache-survival curves off
+    # these per-replica series
+    "prefix_cached_blocks": ("pfx_prefix_cached_blocks", {}),
+    "prefix_spill_entries": ("pfx_prefix_spill_entries", {}),
+    "prefix_spills_total": ("pfx_prefix_spills_total", {}),
+    "prefix_readmits_total": ("pfx_prefix_readmits_total", {}),
+    "migrate_sent_total": ("pfx_migrate_sent_total", {}),
+    "migrate_adopted_total": ("pfx_migrate_adopted_total", {}),
+    "migrate_failed_total": ("pfx_migrate_failed_total", {}),
 }
+
+# prefix affinity is worth at most this many backlog units in `_score`:
+# enough to break a near-tie toward a warm cache, never enough to beat
+# a meaningfully shorter queue — and 5 orders of magnitude under the
+# blocks-exhausted / deadline-infeasible penalties it must never mask
+_AFFINITY_CAP = 4.0
 
 
 class RouterCore:
@@ -754,6 +781,17 @@ class RouterCore:
             r.occupancy = float(h.get("occupancy", 0.0) or 0.0)
             ab = h.get("available_blocks")
             r.available_blocks = int(ab) if ab is not None else None
+            # prefix-affinity advertisement (absent on replicas without
+            # a prefix cache — affinity then scores 0, never an error)
+            pcb = h.get("prefix_cached_blocks")
+            r.prefix_cached_blocks = int(pcb) if pcb is not None else None
+            r.prefix_block = int(h.get("prefix_block", 0) or 0)
+            try:
+                r.prefix_hashes = frozenset(
+                    int(x) for x in (h.get("prefix_hashes") or ())
+                )
+            except (TypeError, ValueError):
+                r.prefix_hashes = frozenset()  # malformed: no affinity
             r.slo_breach = bool((h.get("slo") or {}).get("breach", False))
             ident = h.get("identity") or {}
             old_pid = r.pid
@@ -918,7 +956,8 @@ class RouterCore:
             return self._in_flight_total
 
     # -- scoring + dispatch ---------------------------------------------
-    def _score(self, r: Replica, remaining_s: float) -> float:
+    def _score(self, r: Replica, remaining_s: float,
+               affinity: float = 0.0) -> float:
         """Queue-depth/deadline-aware least-loaded score (lower wins):
         base = reported depth + router-side in-flight; a replica whose
         estimated wait (backlog x recent per-request latency + the
@@ -930,10 +969,18 @@ class RouterCore:
         already carries): a shallow queue on a nearly-full arena loses
         to a slightly deeper one with room, and an arena with NO
         admissible blocks is pushed near last resort — it would bounce
-        the adoption it attracted."""
+        the adoption it attracted.
+
+        ``affinity`` (cached-prefix blocks this replica already holds
+        for THIS request — `_affinity`) is a CAPPED subtraction: worth
+        at most ``_AFFINITY_CAP`` backlog units, so a warm cache can
+        break a near-tie but can NEVER override the blocks-exhausted or
+        deadline-infeasible penalties (1e5/1e6 — a replica that cannot
+        answer in time loses regardless of what it has cached)."""
         backlog = r.depth + r.in_flight
         est_wait = backlog * max(r.last_latency_s, 0.01) + min(r.busy_s, 60.0)
         score = float(backlog)
+        score -= min(max(0.0, float(affinity)), _AFFINITY_CAP)
         if r.role == "decode":
             score += 8.0 * r.occupancy
             if r.available_blocks is not None and r.available_blocks <= 0:
@@ -942,11 +989,42 @@ class RouterCore:
             score += 1e6  # only if every replica is past the deadline
         return score
 
+    @staticmethod
+    def _affinity(r: Replica, prefix_tokens, hash_cache: dict) -> float:
+        """Cached-prefix overlap between one request and one replica:
+        the number of CONTIGUOUS-from-the-root block-aligned prefix
+        hashes of ``prefix_tokens`` present in the replica's advertised
+        digest (``/healthz prefix_hashes``).  Contiguity is the cache's
+        own usability rule — a cached block is only reachable under its
+        ancestors — so the count is the prefill this replica would
+        actually skip.  Hashes are computed per advertised block size
+        and memoised in ``hash_cache`` across the pool walk."""
+        if not prefix_tokens or not r.prefix_hashes or r.prefix_block <= 0:
+            return 0.0
+        if r.prefix_block not in hash_cache:
+            from .paged_cache import prefix_digest_hashes
+
+            hash_cache[r.prefix_block] = prefix_digest_hashes(
+                prefix_tokens, r.prefix_block
+            )
+        overlap = 0
+        for hx in hash_cache[r.prefix_block]:
+            if hx not in r.prefix_hashes:
+                break
+            overlap += 1
+        return float(overlap)
+
     def pick(self, role: str, remaining_s: float,
-             exclude: Optional[set] = None) -> Replica:
+             exclude: Optional[set] = None,
+             prefix_tokens=None) -> Replica:
         """The routing decision: least-loaded eligible replica of the
-        pool (round-robin tiebreak).  Raises :class:`NoReplicaAvailable`
-        when the pool has no eligible member."""
+        pool (round-robin tiebreak).  ``prefix_tokens`` (the request's
+        prompt ids, when the front door has them) folds prefix affinity
+        into the score — capped, so it steers ties toward the replica
+        already holding the prefill and never outweighs load or
+        deadline feasibility.  Raises :class:`NoReplicaAvailable` when
+        the pool has no eligible member."""
+        hash_cache: dict = {}
         with self._lock:
             pool = [
                 r for r in self.replicas.values()
@@ -963,7 +1041,12 @@ class RouterCore:
             best = min(
                 enumerate(pool),
                 key=lambda ir: (
-                    self._score(ir[1], remaining_s),
+                    self._score(
+                        ir[1], remaining_s,
+                        affinity=self._affinity(
+                            ir[1], prefix_tokens, hash_cache
+                        ),
+                    ),
                     (ir[0] + rr) % len(pool),
                 ),
             )[1]
@@ -972,8 +1055,8 @@ class RouterCore:
 
     def dispatch(self, method: str, path: str, body: Optional[bytes], *,
                  role: str, deadline_s: float, headers=None,
-                 trace=None, exclude: Optional[set] = None, sink=None
-                 ) -> Tuple[int, bytes, str]:
+                 trace=None, exclude: Optional[set] = None, sink=None,
+                 prefix_tokens=None) -> Tuple[int, bytes, str]:
         """Route one request: pick -> forward -> account.  Bounded retry
         on ANOTHER replica only for connection-refused and provably-
         unsent sends (:class:`RequestNotSent` — the transport failed
@@ -1005,7 +1088,8 @@ class RouterCore:
                     f"deadline {deadline_s:g}s exhausted before dispatch"
                 )
             try:
-                r = self.pick(role, remaining, exclude=tried)
+                r = self.pick(role, remaining, exclude=tried,
+                              prefix_tokens=prefix_tokens)
             except NoReplicaAvailable:
                 # count only replicas THIS dispatch contacted as
                 # attempts — caller-seeded exclusions were never tried
@@ -1483,6 +1567,20 @@ class RouterCore:
             pid = target.pid
             key = target.key
             url = target.url
+            # surviving same-pool peers, least-loaded first: the drain
+            # body names them so the draining replica can ship its
+            # hottest cached prefixes to one before exiting (KV
+            # migration, docs/serving.md "KV lifecycle").  Best-effort
+            # on the replica side — an empty list just skips migration.
+            survivors = sorted(
+                (
+                    r for r in self.replicas.values()
+                    if r.key != target.key and r.role == target.role
+                    and r.state == "serving" and not r.drain_requested
+                ),
+                key=lambda r: r.depth + r.in_flight,
+            )
+            migrate_to = [r.url for r in survivors]
         def _restore(why: str) -> None:
             # a drain that provably did NOT land must put the target
             # back in rotation — leaving it marked draining would
@@ -1504,7 +1602,8 @@ class RouterCore:
         outcome = "answered"
         try:
             status, body, _, _ = _http_request(
-                url, "POST", "/admin/drain", body=b"{}",
+                url, "POST", "/admin/drain",
+                body=json.dumps({"migrate_to": migrate_to}).encode(),
                 headers={"Content-Type": "application/json",
                          **admin_headers(),
                          **outbound_trace_headers(drain_trace,

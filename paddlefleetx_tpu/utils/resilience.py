@@ -72,6 +72,22 @@ traffic drills in tests/test_serve_drills.py assert the behavior):
                        stand-in for SIGKILL mid-handoff).  Drives the
                        router's bounded re-prefill failover drill
                        (docs/serving.md "Disaggregated operations")
+  ``spill_corrupt:K[:N]``  treat the Kth (.. K+N-1th) spill-readmit
+                       probe as a torn host entry (`core/
+                       continuous_batching` checks the fire and
+                       discards the entry itself — no behavior here).
+                       The request recomputes the prefix and SUCCEEDS;
+                       pfx_prefix_spill_discards_total counts the loss
+                       (docs/serving.md "KV lifecycle" graceful
+                       degradation, drilled in tests/test_kv_tier.py)
+  ``migrate_stall:K``  sleep PFX_FAULT_HANG_S (default 3600) seconds
+                       inside the Kth drain-time prefix-migration send
+                       (`tools/serve.py` caps the sleep at its
+                       remaining migration deadline) — a wedged
+                       receiver; the drain must STILL exit 0 within
+                       PFX_MIGRATE_DEADLINE_S with the migration
+                       counted failed, never stall the PR 3/11 drain
+                       contract (tests/test_kv_tier.py)
 
 Data sites (step counts are *sample fetch* indices inside the host data
 loader — ``data/batch_sampler.py`` fires them; the data drills in
@@ -214,7 +230,7 @@ FAULT_SITES = (
     "sigterm", "save_crash", "ckpt_truncate", "nan_grads",
     "gen_crash", "gen_hang", "cb_step_hang", "boot_crash",
     "corrupt_sample", "io_stall", "handoff_drop", "adopt_crash",
-    "cb_commit_crash",
+    "cb_commit_crash", "spill_corrupt", "migrate_stall",
 )
 
 
@@ -338,7 +354,12 @@ def maybe_fire(site: str, step: int, path: Optional[str] = None) -> bool:
         os._exit(29)
     # handoff_drop carries no behavior here: the prefill replica's
     # direct-transfer send checks the fire and skips the POST itself
-    # (the drop happens before any byte leaves the process)
+    # (the drop happens before any byte leaves the process).
+    # spill_corrupt carries no behavior either: the engine's readmit
+    # probe checks the fire and discards the host entry itself.
+    # migrate_stall's sleep lives at the serve.py send site, where the
+    # remaining migration deadline caps it — an uncapped sleep here
+    # would outlive the very contract the drill proves.
     elif site in ("gen_hang", "cb_step_hang"):
         time.sleep(_env_float("PFX_FAULT_HANG_S", 3600.0))
     elif site == "corrupt_sample":

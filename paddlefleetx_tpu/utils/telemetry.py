@@ -121,6 +121,13 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_prefix_evictions_total": ("counter", "Cached prefix blocks evicted (LRU budget or allocation pressure)"),
     "pfx_prefix_cached_blocks": ("gauge", "Arena blocks currently pinned by the prefix index"),
     "pfx_prefill_chunks_total": ("counter", "Chunked-prefill dispatches (one prompt chunk per scheduler iteration)"),
+    # host-RAM spill tier (core/paged_cache.py PrefixSpillStore,
+    # core/continuous_batching.py spill/readmit sites)
+    "pfx_prefix_spill_bytes": ("gauge", "Host-RAM bytes held by spilled prefix blocks (--prefix-spill-bytes tier)"),
+    "pfx_prefix_spill_entries": ("gauge", "Prefix blocks currently resident in the host-RAM spill store"),
+    "pfx_prefix_spills_total": ("counter", "Evicted prefix blocks demoted to the host-RAM spill store"),
+    "pfx_prefix_readmits_total": ("counter", "Spilled prefix blocks promoted back into the arena on a prefix match"),
+    "pfx_prefix_spill_discards_total": ("counter", "Spilled entries lost instead of readmitted (checksum/corruption, budget pressure, failed spill or readmit) — the graceful-degradation counter"),
 
     "pfx_http_requests_in_flight": ("gauge", "In-flight /generate requests"),
     "pfx_http_responses_total": ("counter", "HTTP responses by status code"),
@@ -185,6 +192,11 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_handoff_adopts_total": ("counter", "KV-handoff payloads adopted into the arena (decode replica)"),
     "pfx_handoff_bytes_total": ("counter", "KV-handoff payload bytes through THIS replica (labels: transport=direct|proxy; prefill counts direct sends, decode counts receives)"),
     "pfx_handoff_direct_total": ("counter", "Direct prefill->decode transfer attempts on the prefill replica (labels: outcome=ok|fallback|rejected|decode_dead)"),
+    # drain-time prefix migration (tools/serve.py donor send,
+    # core/continuous_batching.py adopt_prefixes receiver)
+    "pfx_migrate_sent_total": ("counter", "Prefix-migration payloads accepted by a surviving peer during this replica's drain"),
+    "pfx_migrate_adopted_total": ("counter", "Prefix blocks adopted into this arena from a draining peer's migration payload"),
+    "pfx_migrate_failed_total": ("counter", "Prefix-migration sends abandoned (retries exhausted or the PFX_MIGRATE_DEADLINE_S ladder expired) — the drain exits 0 regardless"),
     # multi-host router (core/router.py + tools/router.py; labels noted)
     "pfx_router_requests_total": ("counter", "Requests dispatched by the router (labels: replica, outcome)"),
     "pfx_router_rejected_total": ("counter", "Router admissions rejected before dispatch (labels: reason)"),

@@ -719,8 +719,11 @@ def replay_decision_log(rows) -> Dict[str, int]:
     they must reproduce.  The agreement contract (tested): on a run whose
     log was not truncated, ``prefill_admits`` == pfx_prefill_admits_total,
     ``evictions`` == pfx_request_evictions_total, ``spec_accepted`` ==
-    pfx_spec_accepted_total, and ``prefix_hits`` ==
-    pfx_prefix_hits_total — a trace event silently dropped by the
+    pfx_spec_accepted_total, ``prefix_hits`` == pfx_prefix_hits_total,
+    and the spill/migration quartet ``spills`` / ``readmits`` /
+    ``spill_discards`` / ``migrate_adopted`` == pfx_prefix_spills_total
+    / pfx_prefix_readmits_total / pfx_prefix_spill_discards_total /
+    pfx_migrate_adopted_total — a trace event silently dropped by the
     scheduler shows up here as a mismatch."""
     out = {
         "iterations": 0,
@@ -734,6 +737,10 @@ def replay_decision_log(rows) -> Dict[str, int]:
         "prefix_hit_tokens": 0,
         "prefix_evictions": 0,
         "chunks": 0,
+        "spills": 0,
+        "readmits": 0,
+        "spill_discards": 0,
+        "migrate_adopted": 0,
     }
     for row in rows:
         out["iterations"] += 1
@@ -747,4 +754,8 @@ def replay_decision_log(rows) -> Dict[str, int]:
         out["prefix_hit_tokens"] += int(row.get("prefix_hit_tokens", 0))
         out["prefix_evictions"] += int(row.get("prefix_evictions", 0))
         out["chunks"] += int(row.get("chunks", 0))
+        out["spills"] += int(row.get("spills", 0))
+        out["readmits"] += int(row.get("readmits", 0))
+        out["spill_discards"] += int(row.get("spill_discards", 0))
+        out["migrate_adopted"] += int(row.get("migrate_adopted", 0))
     return out

@@ -227,6 +227,30 @@ def test_bench_decode_happy_path_contract(tmp_path):
     assert oa["gap_steps"] < os_["gap_steps"], (oa, os_)
     assert oa["greedy_divergent_rows"] == 0, oa
 
+    # spill-tier A/B pair: the SAME prefix-heavy staggered trace with a
+    # prefix budget too small for two prefix families, host-RAM spill
+    # ON vs OFF.  The contract pins the durability evidence — the ON
+    # side READMITTED evicted prefixes from host RAM (readmit hit rate
+    # above zero) and therefore computed STRICTLY fewer prompt tokens,
+    # while the OFF side recomputed everything; a readmitted block is
+    # the bit-exact KV that was evicted, so greedy outputs must be
+    # token-identical across the sides at the f32 smoke dtype.
+    so = rows["gpt345m_decode_spill_on"]
+    sf = rows["gpt345m_decode_spill_off"]
+    for row in (so, sf):
+        assert {"p50_ttft_s", "p99_ttft_s", "prefill_tokens", "spills",
+                "readmits", "readmit_hit_rate", "spill_budget_bytes",
+                "arrivals"} <= set(row), row
+        assert row["p99_ttft_s"] >= row["p50_ttft_s"] > 0, row
+    assert so["arrivals"] == sf["arrivals"]
+    assert so["mean_gap_s"] == sf["mean_gap_s"]  # identical trace
+    assert so["spill_budget_bytes"] > 0 and sf["spill_budget_bytes"] == 0
+    assert so["spills"] > 0 and so["readmits"] > 0, so
+    assert so["readmit_hit_rate"] > 0, so
+    assert sf["spills"] == 0 and sf["readmits"] == 0, sf
+    assert so["prefill_tokens"] < sf["prefill_tokens"], (so, sf)
+    assert so["greedy_divergent_rows"] == 0, so
+
 
 @pytest.mark.slow
 def test_bench_decode_deadline_emits_honest_zero(tmp_path):

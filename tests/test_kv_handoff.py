@@ -127,6 +127,89 @@ def test_unpack_rejects_corruption_loudly():
         unpack_handoff(payload + b"xx")
 
 
+def test_unpack_truncation_at_every_section_boundary():
+    """Cut the payload at EVERY section boundary — inside the magic, at
+    the header-length word, inside the JSON header, at each inter-array
+    boundary, mid-buffer, and past the end — and demand a ValueError
+    that names what is missing.  A migration receiver sees exactly these
+    shapes when a drain-time transfer is torn mid-flight."""
+    import struct
+
+    from paddlefleetx_tpu.core.paged_cache import (
+        HANDOFF_MAGIC,
+        pack_handoff,
+        unpack_handoff,
+    )
+
+    rng = np.random.default_rng(7)
+    arrays = {
+        "k": rng.standard_normal((2, 3, 4)).astype(np.float32),
+        "v": rng.standard_normal((2, 3, 4)).astype(np.float32),
+    }
+    payload = pack_handoff({"block": 16, "kv_dtype": "bf16"}, arrays)
+    (hlen,) = struct.unpack("<I", payload[5:9])
+    first_end = 9 + hlen + arrays["k"].nbytes  # end of first buffer
+
+    cuts = {
+        0: "magic",                  # empty payload
+        3: "magic",                  # inside the magic
+        5: "header length",          # magic only, no length word
+        7: "header length",          # torn uint32
+        9: "header wants",           # length word, zero header bytes
+        9 + hlen // 2: "header wants",          # mid-JSON
+        9 + hlen: "truncated",       # header complete, zero array bytes
+        first_end - 2: "truncated",  # mid first buffer
+        first_end: "'v' wants",      # exactly between the two buffers
+        first_end + 2: "truncated",  # mid second buffer
+    }
+    for cut, needle in cuts.items():
+        with pytest.raises(ValueError, match=needle):
+            unpack_handoff(payload[:cut])
+        # prefix-of-garbage variant: same cut with trailing junk bytes
+        # must not be accepted either (the length checks are per-section)
+    with pytest.raises(ValueError, match="trailing"):
+        unpack_handoff(payload + b"\x00")
+    # sanity: the intact payload still round-trips after all that
+    meta2, arrays2 = unpack_handoff(payload)
+    assert arrays2["v"].tobytes() == arrays["v"].tobytes()
+
+
+def test_unpack_rejects_future_codec_version():
+    """A PFXH2 payload (future codec rev) is refused with the magic
+    error, not misparsed: mixed-version fleets during a rolling upgrade
+    degrade to recompute instead of adopting bytes they cannot read."""
+    from paddlefleetx_tpu.core.paged_cache import pack_handoff, unpack_handoff
+
+    payload = pack_handoff({"block": 16}, {"k": np.ones((2, 2), np.float32)})
+    bumped = b"PFXH2" + payload[5:]
+    with pytest.raises(ValueError, match="PFXH1"):
+        unpack_handoff(bumped)
+
+
+def test_check_handoff_meta_names_malformed_fields():
+    """A malformed signature value (string block size, pool_sig of
+    dicts) lands as a NAMED problem in the incompatibility error — never
+    a bare TypeError that hides which field was wrong."""
+    from paddlefleetx_tpu.core.paged_cache import check_handoff_meta
+
+    with pytest.raises(ValueError, match="block size 'sixteen' is not"):
+        check_handoff_meta(
+            {"block": "sixteen", "kv_dtype": "bf16",
+             "pool_sig": [2, 4, 16, 8]},
+            block=16, kv_dtype="bf16", pool_sig=[2, 4, 16, 8])
+    with pytest.raises(ValueError, match="pool_sig .* not a list of int"):
+        check_handoff_meta(
+            {"block": 16, "kv_dtype": "bf16", "pool_sig": [{"layers": 2}]},
+            block=16, kv_dtype="bf16", pool_sig=[2, 4, 16, 8])
+    # several problems at once: ALL named in the one error
+    with pytest.raises(ValueError) as ei:
+        check_handoff_meta(
+            {"block": None, "kv_dtype": "int8", "pool_sig": "nope"},
+            block=16, kv_dtype="bf16", pool_sig=[2, 4, 16, 8])
+    msg = str(ei.value)
+    assert "block size" in msg and "kv dtype" in msg and "pool_sig" in msg
+
+
 def test_check_handoff_meta_names_every_mismatch():
     from paddlefleetx_tpu.core.paged_cache import check_handoff_meta
 

@@ -1,0 +1,785 @@
+"""Fleet KV durability: the host-RAM spill tier, peer-to-peer prefix
+migration, and prefix-affinity routing (`make test-kv-tier`,
+docs/serving.md "KV lifecycle").
+
+Cached KV must survive the three events that used to destroy it:
+
+  spill tier     an LRU-evicted radix node demotes its block to a
+                 bounded pinned-host store; a later prefix match
+                 READMITS it instead of recomputing — checksum-verified,
+                 degrade-to-recompute on every failure mode
+                 (spill_corrupt, pool pressure, budget), ArenaReset
+                 invalidates the whole store atomically;
+  migration      a draining replica ships its hottest published
+                 prefixes to a surviving peer (PFXH1 over
+                 POST /admin/adopt_prefixes); the receiver validates
+                 the payload in FULL before anything touches its arena
+                 and never half-adopts; a wedged receiver can NEVER
+                 stall the drain contract (hard PFX_MIGRATE_DEADLINE_S,
+                 exit 0 regardless);
+  affinity       the router folds cached-prefix overlap into the
+                 least-loaded score — capped, so a warm cache breaks
+                 ties but never overrides a deadline-infeasible or
+                 blocks-exhausted replica.
+
+In-process tests stay tier-1; the multi-process CLI drills are
+slow+fault-marked (subprocess-driven, tests/test_router_drills.py
+conventions)."""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = {
+    "Global": {"global_batch_size": 8, "seed": 7},
+    "Engine": {"mix_precision": {"enable": False},
+               "save_load": {"save_steps": 0}},
+    "Model": {
+        "module": "GPTModule",
+        "vocab_size": 96,
+        "hidden_size": 32,
+        "num_layers": 2,
+        "num_attention_heads": 4,
+        "max_position_embeddings": 128,
+        "dtype": "float32",
+    },
+    "Distributed": {},
+    "Optimizer": {"name": "FusedAdamW",
+                  "lr": {"name": "Constant", "learning_rate": 1e-3}},
+    "Generation": {"max_dec_len": 8, "decode_strategy": "greedy_search",
+                   "pad_to_multiple": 8, "eos_token_id": 95,
+                   "pad_token_id": 0},
+}
+
+# block=8 geometry: one-full-block families whose prefixes evict each
+# other under a ONE-block index budget — the smallest trace that forces
+# spill -> readmit (match() caps at len-1, so prompts exceed the block)
+BLK = 8
+PFX_A = list(range(1, 9))     # family A's shared full block
+PFX_B = list(range(10, 18))   # family B's — evicts A under budget 1
+A1 = PFX_A + [40, 41, 42]
+A2 = PFX_A + [50, 51]
+B1 = PFX_B + [60, 61, 62]
+
+
+@pytest.fixture(scope="module")
+def server():
+    import jax
+
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.core.serving import GenerationServer
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    cfg = AttrDict.from_nested(TINY)
+    cfg = process_configs(cfg, num_devices=jax.device_count())
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    return GenerationServer(cfg, mesh, module)
+
+
+def _engine(server, **kw):
+    from paddlefleetx_tpu.core.continuous_batching import PagedDecodeEngine
+
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("block", BLK)
+    return PagedDecodeEngine(server, **kw)
+
+
+def _drain_engine(engine, max_steps=64):
+    for _ in range(max_steps):
+        engine.step()
+        if not engine.active.any():
+            return
+    raise AssertionError("engine never drained")
+
+
+def _serve_release(engine, prompt, max_new=6):
+    """One request start-to-finish: admit -> decode -> release (release
+    publishes the prompt's full blocks to the radix index)."""
+    slot = engine.admit(prompt, max_new)
+    _drain_engine(engine)
+    tokens = engine.slots[slot].tokens
+    engine.release(slot)
+    return tokens
+
+
+@pytest.fixture(scope="module")
+def refs(server):
+    """Greedy coalesce-path references — every cached/spilled/migrated
+    path below must reproduce these EXACTLY (f32)."""
+    return {tuple(p): server.generate_ids([p], max_dec_len=6)[0]
+            for p in (A1, A2, B1)}
+
+
+# ---------------------------------------------------------------------------
+# PrefixSpillStore units (pure host)
+# ---------------------------------------------------------------------------
+
+
+def _arrs(rng, n=64):
+    return {"k": rng.standard_normal((2, 1, 4, BLK, n)).astype(np.float32),
+            "v": rng.standard_normal((2, 1, 4, BLK, n)).astype(np.float32)}
+
+
+def test_spill_store_budget_lru_and_checksum():
+    from paddlefleetx_tpu.core.paged_cache import PrefixSpillStore
+
+    rng = np.random.default_rng(0)
+    one = sum(a.nbytes for a in _arrs(rng).values())
+    store = PrefixSpillStore(budget_bytes=2 * one)
+    a0, a1, a2 = _arrs(rng), _arrs(rng), _arrs(rng)
+
+    assert store.put((1,), a0) and store.put((2,), a1)
+    assert store.bytes_used() == 2 * one and len(store) == 2
+    # bit-exact round trip
+    got = store.get((1,))
+    assert got["k"].tobytes() == a0["k"].tobytes()
+    # the get bumped (1,) most-recent: admitting a third LRU-evicts (2,)
+    assert store.put((3,), a2)
+    assert store.get((2,)) is None
+    assert store.get((1,)) is not None
+    assert store.stats["discards"] == 1  # the LRU eviction, counted
+    # pop == successful readmit
+    store.pop((1,))
+    assert len(store) == 1 and store.stats["readmits"] == 1
+    # checksum: a torn entry is dropped, never handed back
+    store._entries[(3,)]["arrays"]["k"][0, 0, 0, 0, 0] += 1.0
+    assert store.get((3,)) is None
+    assert store.stats["discards"] == 2
+    assert len(store) == 0 and store.bytes_used() == 0
+
+
+def test_spill_store_disabled_oversize_and_clear():
+    from paddlefleetx_tpu.core.paged_cache import PrefixSpillStore
+
+    rng = np.random.default_rng(1)
+    with pytest.raises(ValueError, match=">= 0"):
+        PrefixSpillStore(budget_bytes=-1)
+    off = PrefixSpillStore(budget_bytes=0)
+    assert not off.enabled and not off.put((1,), _arrs(rng))
+    # an entry that alone exceeds the budget is refused outright (loud)
+    tiny = PrefixSpillStore(budget_bytes=16)
+    assert not tiny.put((1,), _arrs(rng))
+    assert tiny.stats["discards"] == 1 and len(tiny) == 0
+    # clear() (ArenaReset) empties without counting pressure discards
+    store = PrefixSpillStore(budget_bytes=1 << 30)
+    store.put((1,), _arrs(rng))
+    store.put((2,), _arrs(rng))
+    d0 = store.stats["discards"]
+    assert store.clear() == 2
+    assert len(store) == 0 and store.bytes_used() == 0
+    assert store.stats["discards"] == d0
+    assert store.get((1,)) is None
+
+
+# ---------------------------------------------------------------------------
+# spill -> readmit on a live engine
+# ---------------------------------------------------------------------------
+
+
+def _spilled_engine(server, refs, **kw):
+    """Build a spill-enabled engine and run the A -> B eviction trace:
+    returns it with family A's full block demoted to the host store."""
+    kw.setdefault("prefix_cache_blocks", 1)
+    kw.setdefault("prefix_spill_bytes", 64 << 20)
+    eng = _engine(server, **kw)
+    assert _serve_release(eng, A1) == refs[tuple(A1)]  # publishes PFX_A
+    assert _serve_release(eng, B1) == refs[tuple(B1)]  # evicts -> spills
+    assert eng.cache.spill.stats["spills"] >= 1
+    assert len(eng.cache.spill) >= 1
+    return eng
+
+
+def test_spill_then_readmit_round_trip(server, refs):
+    """The tentpole contract: an evicted prefix comes back from host
+    RAM — the readmitted request hits (prefill = suffix only) and its
+    tokens are IDENTICAL to the uncached reference."""
+    from paddlefleetx_tpu.utils.telemetry import get_registry
+
+    reg = get_registry()
+    eng = _spilled_engine(server, refs)
+    sp0 = reg.value("pfx_prefix_spills_total") or 0
+    rd0 = reg.value("pfx_prefix_readmits_total") or 0
+    t0 = eng.stats["prefill_tokens"]
+    h0 = eng.cache.prefix.stats["hits"]
+    ht0 = eng.cache.prefix.stats["hit_tokens"]
+
+    assert _serve_release(eng, A2) == refs[tuple(A2)]
+
+    assert eng.cache.spill.stats["readmits"] == 1
+    assert eng.cache.prefix.stats["hits"] - h0 == 1
+    assert eng.cache.prefix.stats["hit_tokens"] - ht0 == BLK
+    # only the 2-token suffix prefilled — the block came back from host
+    assert eng.stats["prefill_tokens"] - t0 == len(A2) - BLK
+    # registry counters moved in lockstep with the store's own stats
+    assert (reg.value("pfx_prefix_readmits_total") or 0) - rd0 == 1
+    assert (reg.value("pfx_prefix_spills_total") or 0) >= sp0
+    # spill gauges report the store truthfully
+    st = eng.cache.stats()
+    assert st["prefix_spill_entries"] == len(eng.cache.spill)
+    assert st["prefix_spill_bytes"] == eng.cache.spill.bytes_used()
+
+
+def test_spill_corrupt_degrades_to_recompute(server, refs, monkeypatch):
+    """docs/fault_tolerance.md spill_corrupt: a torn host entry is
+    discarded LOUDLY and the request recomputes and SUCCEEDS — graceful
+    degradation, never a failed request."""
+    from paddlefleetx_tpu.utils.resilience import reset_fault_state
+    from paddlefleetx_tpu.utils.telemetry import get_registry
+
+    reg = get_registry()
+    eng = _spilled_engine(server, refs)
+    monkeypatch.setenv("PFX_FAULT", "spill_corrupt:1")
+    reset_fault_state()
+    try:
+        dc0 = eng.cache.spill.stats["discards"]
+        dcr0 = reg.value("pfx_prefix_spill_discards_total") or 0
+        t0 = eng.stats["prefill_tokens"]
+        h0 = eng.cache.prefix.stats["hits"]
+
+        assert _serve_release(eng, A2) == refs[tuple(A2)]  # still right
+
+        assert eng.cache.spill.stats["readmits"] == 0
+        assert eng.cache.spill.stats["discards"] - dc0 == 1
+        assert (reg.value("pfx_prefix_spill_discards_total") or 0) \
+            - dcr0 == 1
+        # full recompute: no hit, the whole prompt prefilled
+        assert eng.cache.prefix.stats["hits"] == h0
+        assert eng.stats["prefill_tokens"] - t0 == len(A2)
+    finally:
+        monkeypatch.delenv("PFX_FAULT", raising=False)
+        reset_fault_state()
+
+
+def test_arena_reset_invalidates_spilled_entries(server, refs):
+    """ArenaReset atomicity: reset() drops the radix index AND the
+    spill store in the same breath — a host copy of a dead arena's
+    block must never readmit."""
+    eng = _spilled_engine(server, refs)
+    assert len(eng.cache.spill) >= 1
+    eng.reset()
+    assert len(eng.cache.spill) == 0
+    assert eng.cache.spill.bytes_used() == 0
+    assert eng.cache.prefix.cached_blocks() == 0
+    # the rebuilt arena serves correctly and nothing stale resurfaces
+    rd0 = eng.cache.spill.stats["readmits"]
+    t0 = eng.stats["prefill_tokens"]
+    assert _serve_release(eng, A2) == refs[tuple(A2)]
+    assert eng.cache.spill.stats["readmits"] == rd0
+    assert eng.stats["prefill_tokens"] - t0 == len(A2)  # full recompute
+
+
+def test_spill_counters_replay_exactly(server, refs):
+    """The exact-replay contract, spill edition: an untruncated
+    decision log folds to the same spill/readmit totals the store and
+    the registry report."""
+    from paddlefleetx_tpu.core.continuous_batching import ContinuousScheduler
+    from paddlefleetx_tpu.utils.telemetry import get_registry
+    from paddlefleetx_tpu.utils.tracing import replay_decision_log
+
+    reg = get_registry()
+    sp0 = reg.value("pfx_prefix_spills_total") or 0
+    rd0 = reg.value("pfx_prefix_readmits_total") or 0
+    eng = _engine(server, prefix_cache_blocks=1,
+                  prefix_spill_bytes=64 << 20)
+    sched = ContinuousScheduler(eng, max_depth=8, name="kv-tier-test")
+    sched.start()
+    try:
+        # sequential (result() between submits): publish order must be
+        # A -> B(evicts A) -> A(readmits) for the trace to spill
+        for p in (A1, B1, A2):
+            assert sched.submit([p], 6, deadline_s=120).result(
+                timeout=300)[0] == refs[tuple(p)]
+    finally:
+        assert sched.shutdown(timeout=30)
+
+    replay = replay_decision_log(sched.decision_log)
+    assert eng.cache.spill.stats["readmits"] == 1
+    assert replay["spills"] == eng.cache.spill.stats["spills"] >= 1
+    assert replay["readmits"] == 1
+    assert replay["spill_discards"] == eng.cache.spill.stats["discards"]
+    assert (reg.value("pfx_prefix_spills_total") or 0) - sp0 \
+        == replay["spills"]
+    assert (reg.value("pfx_prefix_readmits_total") or 0) - rd0 == 1
+
+
+# ---------------------------------------------------------------------------
+# peer-to-peer prefix migration (in-process halves)
+# ---------------------------------------------------------------------------
+
+
+def test_export_adopt_prefixes_cross_engine(server, refs):
+    """Donor export -> PFXH1 bytes -> receiver adoption: the survivor
+    answers the donor's traffic with HITS, token-identically; a re-send
+    is idempotent."""
+    from paddlefleetx_tpu.core.paged_cache import pack_handoff, unpack_handoff
+    from paddlefleetx_tpu.utils.telemetry import get_registry
+
+    reg = get_registry()
+    donor = _engine(server, prefix_cache_blocks=8)
+    assert _serve_release(donor, A1) == refs[tuple(A1)]
+    assert _serve_release(donor, B1) == refs[tuple(B1)]
+
+    export = donor.export_hot_prefixes(64)
+    assert export is not None
+    meta, arrays = unpack_handoff(pack_handoff(*export))
+    paths = {tuple(p) for p in meta["prefixes"]}
+    assert tuple(PFX_A) in paths and tuple(PFX_B) in paths
+
+    receiver = _engine(server, prefix_cache_blocks=8)
+    ad0 = reg.value("pfx_migrate_adopted_total") or 0
+    n = receiver.adopt_prefixes(meta, arrays)
+    assert n == len(meta["prefixes"]) >= 2
+    assert receiver.cache.prefix.cached_blocks() == n
+    assert receiver.stats["migrate_adopted"] == n
+    assert (reg.value("pfx_migrate_adopted_total") or 0) - ad0 == n
+    # idempotent: an already-cached path only bumps LRU
+    assert receiver.adopt_prefixes(meta, arrays) == 0
+
+    # the adopted KV is the real thing: hit-path decode == reference
+    t0 = receiver.stats["prefill_tokens"]
+    h0 = receiver.cache.prefix.stats["hits"]
+    assert _serve_release(receiver, A2) == refs[tuple(A2)]
+    assert receiver.cache.prefix.stats["hits"] - h0 == 1
+    assert receiver.stats["prefill_tokens"] - t0 == len(A2) - BLK
+
+
+def test_export_is_ancestor_closed_and_ordered(server, refs):
+    """A deep chain exports parents-before-children (shortest path
+    first) so the receiver can stop cleanly at ANY boundary and still
+    hold a valid prefix."""
+    deep = list(range(1, 17))  # 2 chained full blocks
+    donor = _engine(server, prefix_cache_blocks=8)
+    _serve_release(donor, deep + [40, 41])
+    meta, _arrays = donor.export_hot_prefixes(1)  # ask for ONE block
+    paths = [list(p) for p in meta["prefixes"]]
+    # the hottest block is the 16-deep child: its 8-deep ancestor came
+    # along, ordered first
+    assert paths == [deep[:8], deep]
+
+
+def test_adopt_rejects_torn_payload_whole(server, refs):
+    """The adopt rule: a torn or incompatible migration payload is
+    rejected WHOLE before anything touches the arena — never
+    half-adopted."""
+    from paddlefleetx_tpu.core.paged_cache import pack_handoff, unpack_handoff
+
+    donor = _engine(server, prefix_cache_blocks=8)
+    _serve_release(donor, A1)
+    meta, arrays = unpack_handoff(pack_handoff(*donor.export_hot_prefixes(64)))
+
+    receiver = _engine(server, prefix_cache_blocks=8)
+
+    def untouched():
+        assert receiver.cache.stats()["kv_blocks_used"] == 0
+        assert receiver.cache.prefix.cached_blocks() == 0
+        assert receiver.stats["migrate_adopted"] == 0
+
+    missing = {n: a for n, a in arrays.items() if n != "v"}
+    with pytest.raises(ValueError, match="missing arrays"):
+        receiver.adopt_prefixes(meta, missing)
+    untouched()
+
+    torn = dict(arrays)
+    torn["k"] = arrays["k"][:, :0]  # right dtype, zero blocks
+    with pytest.raises(ValueError, match="does not carry"):
+        receiver.adopt_prefixes(meta, torn)
+    untouched()
+
+    bad_meta = dict(meta)
+    bad_meta["block"] = BLK * 2
+    with pytest.raises(ValueError, match="block size"):
+        receiver.adopt_prefixes(bad_meta, arrays)
+    untouched()
+
+    ragged = dict(meta)
+    ragged["prefixes"] = [PFX_A[:5]]  # not a block multiple
+    with pytest.raises(ValueError, match="multiple"):
+        receiver.adopt_prefixes(ragged, arrays)
+    untouched()
+
+    empty = dict(meta)
+    empty["prefixes"] = []
+    with pytest.raises(ValueError, match="no prefixes"):
+        receiver.adopt_prefixes(empty, arrays)
+    untouched()
+
+
+# ---------------------------------------------------------------------------
+# prefix-affinity routing (stub replicas, no model)
+# ---------------------------------------------------------------------------
+
+
+def _replica(key="r0", role="monolith", **kw):
+    from paddlefleetx_tpu.core.router import Replica
+
+    r = Replica(key=key, url=f"http://x/{key}", role=role,
+                state="serving")
+    r.healthy = True
+    for k, v in kw.items():
+        setattr(r, k, v)
+    return r
+
+
+def test_affinity_counts_contiguous_overlap_only():
+    from paddlefleetx_tpu.core.paged_cache import prefix_digest_hashes
+    from paddlefleetx_tpu.core.router import RouterCore
+
+    tokens = list(range(1, 25))  # 3 full 8-blocks
+    hashes = prefix_digest_hashes(tokens, BLK)
+    assert len(hashes) == 3
+
+    warm = _replica(prefix_block=BLK, prefix_hashes=frozenset(hashes))
+    cache = {}
+    assert RouterCore._affinity(warm, tokens, cache) == 3.0
+    assert BLK in cache  # memoised per advertised block size
+    assert RouterCore._affinity(warm, tokens, cache) == 3.0
+
+    partial = _replica(prefix_block=BLK,
+                       prefix_hashes=frozenset(hashes[:2]))
+    assert RouterCore._affinity(partial, tokens, {}) == 2.0
+    # contiguity is the usability rule: a child block without its
+    # ancestors is unreachable — missing root means ZERO overlap
+    orphan = _replica(prefix_block=BLK,
+                      prefix_hashes=frozenset(hashes[1:]))
+    assert RouterCore._affinity(orphan, tokens, {}) == 0.0
+
+    # degenerate advertisements never crash or score
+    assert RouterCore._affinity(_replica(), tokens, {}) == 0.0
+    assert RouterCore._affinity(
+        _replica(prefix_block=0, prefix_hashes=frozenset(hashes)),
+        tokens, {}) == 0.0
+    assert RouterCore._affinity(warm, None, {}) == 0.0
+    assert RouterCore._affinity(warm, [], {}) == 0.0
+    assert RouterCore._affinity(
+        _replica(prefix_block=BLK, prefix_hashes=frozenset({1, 2, 3})),
+        tokens, {}) == 0.0
+
+
+def test_affinity_is_capped_and_never_overrides_penalties():
+    from paddlefleetx_tpu.core.router import _AFFINITY_CAP, RouterCore
+
+    core = RouterCore([("http://127.0.0.1:1", "monolith")])
+    r = _replica(depth=6)
+    base = core._score(r, 60.0)
+    # capped subtraction: a mile-deep warm cache is worth at most CAP
+    assert core._score(r, 60.0, affinity=1e9) \
+        == core._score(r, 60.0, affinity=_AFFINITY_CAP) \
+        == base - _AFFINITY_CAP
+    assert core._score(r, 60.0, affinity=-5.0) == base  # never a bonus
+
+    # blocks-exhausted decode replica: affinity cannot buy it back
+    ok = _replica("r1", role="decode", available_blocks=4)
+    dry = _replica("r2", role="decode", available_blocks=0)
+    assert core._score(dry, 60.0, affinity=1e9) \
+        > core._score(ok, 60.0) + 1e4
+    # deadline-infeasible: est wait >> remaining loses regardless
+    late = _replica("r3", depth=100, last_latency_s=10.0)
+    assert core._score(late, 5.0, affinity=1e9) \
+        > core._score(_replica("r4"), 5.0) + 1e5
+
+
+def test_pick_steers_ties_to_the_warm_replica():
+    from paddlefleetx_tpu.core.paged_cache import prefix_digest_hashes
+    from paddlefleetx_tpu.core.router import RouterCore
+
+    tokens = list(range(1, 25))
+    core = RouterCore([("http://127.0.0.1:1", "monolith"),
+                       ("http://127.0.0.1:2", "monolith")])
+    cold, warm = core.replicas["r0"], core.replicas["r1"]
+    for r in (cold, warm):
+        r.state, r.healthy = "serving", True
+    warm.prefix_block = BLK
+    warm.prefix_hashes = frozenset(prefix_digest_hashes(tokens, BLK))
+
+    # equal load: affinity breaks the tie toward the warm replica,
+    # beating the round-robin cursor every time
+    for _ in range(4):
+        picked = core.pick("monolith", 60.0, prefix_tokens=tokens)
+        assert picked.key == "r1"
+        picked.in_flight = 0
+    # no prompt ids -> plain least-loaded (round-robin alternates)
+    seen = set()
+    for _ in range(4):
+        p = core.pick("monolith", 60.0)
+        seen.add(p.key)
+        p.in_flight = 0
+    assert seen == {"r0", "r1"}
+    # a deadline-infeasible warm replica loses to the cold one: the cap
+    # holds through pick(), not just _score()
+    warm.depth, warm.last_latency_s = 100, 10.0
+    assert core.pick("monolith", 5.0,
+                     prefix_tokens=tokens).key == "r0"
+
+
+# ---------------------------------------------------------------------------
+# the rolling-drain CLI drills (slow+fault: make test-kv-tier)
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env["PFX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("PFX_FAULT", None)
+    env.update(extra or {})
+    return env
+
+
+def _post(port, body, timeout=90, path="/generate"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return json.load(r)
+
+
+def _metrics(port, timeout=10):
+    from test_telemetry import parse_prometheus
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=timeout
+    ) as r:
+        metrics, _ = parse_prometheus(r.read().decode())
+    return metrics
+
+
+def _mval(metrics, name):
+    return metrics.get(name, {}).get(frozenset(), 0.0)
+
+
+def _spawn_replica(cfg_path, port, rid, extra_env=None, *extra):
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "-c", str(cfg_path), "--port", str(port),
+         "--scheduler", "continuous", "--cb-batch", "4",
+         "--queue-depth", "32", "--deadline", "60",
+         "--warmup-buckets", "4",
+         "--prefix-cache-blocks", "32",
+         "--prefix-spill-bytes", str(8 << 20),
+         "--replica-id", rid],
+        env=_env(extra_env), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _wait_healthy(procs_ports, timeout=300):
+    end = time.time() + timeout
+    pending = dict(procs_ports)
+    while pending and time.time() < end:
+        for port, proc in list(pending.items()):
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"replica on {port} died at boot: "
+                    f"{proc.stdout.read()[-3000:]}"
+                )
+            try:
+                if _get(port, "/healthz", timeout=5).get("ok"):
+                    del pending[port]
+            except Exception:
+                pass
+        time.sleep(0.3)
+    assert not pending, f"never healthy: {sorted(pending)}"
+
+
+def _finish(proc, timeout=30):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+    return proc.stdout.read()
+
+
+DRILL_PFX = list(range(1, 17))  # 2 full 8-blocks shared by the family
+
+
+def _family(tail):
+    return {"prompt_ids": DRILL_PFX + tail, "max_tokens": 6,
+            "deadline_s": 60}
+
+
+@pytest.mark.fault
+@pytest.mark.slow  # ~2 CLI replica boots + router; make test-kv-tier
+def test_drain_migrates_prefixes_to_survivor_under_stall(tmp_path):
+    """THE KV-durability acceptance drill through the real CLIs: two
+    prefix-cached replicas behind the router, sticky prefix-heavy
+    traffic warm on r0; `router.py drain r0` under migrate_stall —
+
+      - the drain exits 0 (the stall burns budget, never the contract),
+      - the survivor adopts the donor's prefixes (zero half-adopted:
+        every shipped block landed),
+      - the survivor's post-drain hit rate on the donor's family beats
+        its pre-drain baseline (cold: zero), token-identically."""
+    cfg_path = tmp_path / "tiny_kv_tier.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    p0, p1 = _free_port(), _free_port()
+    # the donor's receiver wedges ONCE at the send site, for 2s of a
+    # 30s migration budget: delayed, then delivered
+    r0 = _spawn_replica(cfg_path, p0, "rep0",
+                        {"PFX_FAULT": "migrate_stall:1",
+                         "PFX_FAULT_HANG_S": "2",
+                         "PFX_MIGRATE_DEADLINE_S": "30"})
+    r1 = _spawn_replica(cfg_path, p1, "rep1")
+    rport = _free_port()
+    router = None
+    try:
+        _wait_healthy([(p0, r0), (p1, r1)])
+        router = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tools", "router.py"),
+             "--port", str(rport), "--poll-interval", "0.2",
+             "--replica", f"http://127.0.0.1:{p0}",
+             "--replica", f"http://127.0.0.1:{p1}"],
+            env=_env(), cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        end = time.time() + 30
+        while time.time() < end:
+            try:
+                if _get(rport, "/healthz").get("eligible", 0) >= 2:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+
+        # warm the family on r0 DIRECTLY (publishes its 2 full blocks)
+        code, ref = _post(p0, _family([40, 41, 42]))
+        assert code == 200, ref
+        code, hit = _post(p0, _family([40, 41, 42]))
+        assert code == 200 and hit["completion_ids"] == ref["completion_ids"]
+        m0 = _metrics(p0)
+        assert _mval(m0, "pfx_prefix_hits_total") >= 1
+
+        # the router polls r0's digest advertisement...
+        end = time.time() + 20
+        adv = 0
+        while time.time() < end:
+            views = _get(rport, "/replicas")["replicas"]
+            adv = max(v.get("prefix_hashes_advertised", 0)
+                      for v in views)
+            if adv:
+                break
+            time.sleep(0.3)
+        assert adv >= 1, views
+        # ...and affinity steers the family to the warm replica: r0
+        # hits again, the cold survivor sees none of it
+        h0_pre = _mval(_metrics(p0), "pfx_prefix_hits_total")
+        code, via = _post(rport, _family([40, 41, 42]))
+        assert code == 200 and via["completion_ids"] == ref["completion_ids"]
+        assert _mval(_metrics(p0), "pfx_prefix_hits_total") > h0_pre
+        m1_pre = _metrics(p1)
+        survivor_pre_hits = _mval(m1_pre, "pfx_prefix_hits_total")
+        assert _mval(m1_pre, "pfx_migrate_adopted_total") == 0
+
+        # drain the warm replica through the real CLI (the router hands
+        # it the survivor list; the stall fires at the send site)
+        drain = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "router.py"),
+             "drain", "--admin", f"http://127.0.0.1:{rport}",
+             "--replica-id", "r0", "--timeout", "120"],
+            env=_env(), cwd=REPO, capture_output=True, text=True,
+            timeout=180,
+        )
+        assert drain.returncode == 0, (drain.stdout, drain.stderr)
+        assert r0.wait(timeout=60) == 0  # exit 0 despite the stall
+        out0 = r0.stdout.read()
+        m = re.search(r"adopted (\d+) of (\d+) prefix block", out0)
+        assert m, out0[-2000:]
+        # zero half-adopted: every block the donor shipped landed
+        # (the 16-token family prefix is ONE default-block-16 block)
+        assert int(m.group(1)) == int(m.group(2)) >= 1, out0[-2000:]
+        m1 = _metrics(p1)
+        assert _mval(m1, "pfx_migrate_adopted_total") \
+            == int(m.group(1))
+
+        # the survivor answers the dead replica's traffic with HITS:
+        # post-drain hit rate beats the pre-drain baseline (cold), and
+        # greedy tokens are IDENTICAL to the donor's (f32)
+        code, after = _post(rport, _family([40, 41, 42]))
+        assert code == 200, after
+        assert after["completion_ids"] == ref["completion_ids"]
+        m1_post = _metrics(p1)
+        assert _mval(m1_post, "pfx_prefix_hits_total") \
+            > survivor_pre_hits
+        assert _mval(m1_post, "pfx_prefix_hit_tokens_total") \
+            >= len(DRILL_PFX)
+    finally:
+        for proc in (router, r0, r1):
+            if proc is not None:
+                _finish(proc)
+
+
+@pytest.mark.fault
+@pytest.mark.slow  # 2 CLI replica boots; make test-kv-tier
+def test_wedged_receiver_never_stalls_the_drain(tmp_path):
+    """The failover ladder's hard floor: with the receiver wedged on
+    EVERY attempt and a 3s migration deadline, the drain still
+    completes and exits 0 promptly; the survivor adopted NOTHING (zero
+    half-adopted prefixes) and keeps serving."""
+    cfg_path = tmp_path / "tiny_kv_tier.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    p0, p1 = _free_port(), _free_port()
+    r0 = _spawn_replica(cfg_path, p0, "rep0",
+                        {"PFX_FAULT": "migrate_stall:1:99",
+                         "PFX_FAULT_HANG_S": "60",
+                         "PFX_MIGRATE_DEADLINE_S": "3"})
+    r1 = _spawn_replica(cfg_path, p1, "rep1")
+    try:
+        _wait_healthy([(p0, r0), (p1, r1)])
+        code, ref = _post(p0, _family([40, 41, 42]))
+        assert code == 200, ref
+
+        t0 = time.time()
+        code, body = _post(
+            p0, {"migrate_to": [f"http://127.0.0.1:{p1}"]},
+            path="/admin/drain",
+        )
+        assert code == 200, body
+        assert r0.wait(timeout=60) == 0
+        # the whole drain (incl. the burned 3s migration budget) stayed
+        # well inside the stall duration the fault asked for (60s)
+        assert time.time() - t0 < 45
+        out0 = r0.stdout.read()
+        assert "no surviving peer adopted" in out0, out0[-2000:]
+
+        m1 = _metrics(p1)
+        assert _mval(m1, "pfx_migrate_adopted_total") == 0
+        assert _mval(m1, "pfx_prefix_cached_blocks") == 0
+        code, resp = _post(p1, _family([40, 41, 42]))
+        assert code == 200 and resp["completion_ids"] == ref["completion_ids"]
+    finally:
+        for proc in (r0, r1):
+            _finish(proc)

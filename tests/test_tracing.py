@@ -230,6 +230,9 @@ def test_replay_decision_log_sums_rows():
         # prefix-reuse columns (PR 12) default to 0 on legacy rows
         "prefix_hits": 0, "prefix_hit_tokens": 0, "prefix_evictions": 0,
         "chunks": 0,
+        # spill/migration columns (PR 17) default to 0 on legacy rows
+        "spills": 0, "readmits": 0, "spill_discards": 0,
+        "migrate_adopted": 0,
     }
 
 

@@ -716,6 +716,15 @@ def fleet_summary(data: FleetData) -> List[Tuple[str, Any]]:
     downs = sum(
         1 for e in data.scale_events if e.get("action") == "scale_down"
     )
+    mig_sent = sum(
+        int(data.last(r).get("migrate_sent_total", 0) or 0) for r in reps
+    )
+    mig_adopted = sum(
+        int(data.last(r).get("migrate_adopted_total", 0) or 0) for r in reps
+    )
+    mig_failed = sum(
+        int(data.last(r).get("migrate_failed_total", 0) or 0) for r in reps
+    )
     return [
         ("replicas seen", f"{len(reps)} ({', '.join(reps)})" if reps else "0"),
         ("pools", ", ".join(pools) if pools else "n/a"),
@@ -723,6 +732,8 @@ def fleet_summary(data: FleetData) -> List[Tuple[str, Any]]:
         ("scale events", f"{ups} up / {downs} down"),
         ("handoff bytes", f"{_bytes(direct)} direct / "
                           f"{_bytes(proxied)} proxied via router"),
+        ("prefix migrations", f"{mig_sent} sent / {mig_adopted} blocks "
+                              f"adopted / {mig_failed} failed"),
         ("router samples", len(data.router_rows)),
     ]
 
@@ -734,6 +745,14 @@ _FLEET_CURVES = (
     ("occupancy", "continuous-batch occupancy per replica"),
     ("depth", "reported queue depth per replica"),
     ("kv_blocks_used", "KV arena blocks used per replica"),
+    # cache-survival view (docs/serving.md "KV lifecycle"): published
+    # prefix blocks per replica across drains/migrations — a survivor
+    # adopting a drained peer's prefixes shows as a step UP here while
+    # the drained replica's curve ends — plus the spill tier's traffic
+    ("prefix_cached_blocks", "prefix-cache survival: published prefix "
+                             "blocks per replica"),
+    ("prefix_spill_entries", "host-RAM spill tier entries per replica"),
+    ("prefix_readmits_total", "spill readmits (cumulative) per replica"),
 )
 
 _FLEET_STATE_COLS = (
@@ -741,6 +760,9 @@ _FLEET_STATE_COLS = (
     "latency_p99_s",
     "kv_blocks_used", "kv_blocks_available", "tokens_out_total",
     "handoff_exports_total", "handoff_adopts_total",
+    "prefix_cached_blocks", "prefix_spill_entries",
+    "prefix_spills_total", "prefix_readmits_total",
+    "migrate_sent_total", "migrate_adopted_total", "migrate_failed_total",
 )
 
 

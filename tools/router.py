@@ -403,6 +403,21 @@ def serve_router(args) -> int:
                     return self._json(400, {"error": str(e)})
                 if core.disaggregated:
                     return self._generate_disagg(req, deadline_s, trace)
+                # prefix-affinity signal: the request's prompt ids (when
+                # the body carries ids — text prompts would need the
+                # replica's tokenizer) steer `pick` toward the replica
+                # already holding the cached prefill.  Malformed ids are
+                # ignored here: the replica answers the 400, affinity
+                # just scores 0
+                ids = req.get("prompt_ids") or next(
+                    iter(req.get("prompts_ids") or []), None
+                )
+                try:
+                    prefix_tokens = ([int(t) for t in ids]
+                                     if isinstance(ids, list) and ids
+                                     else None)
+                except (TypeError, ValueError):
+                    prefix_tokens = None
                 streaming = parts is not None and self._wants_stream(parts)
                 relay = {"started": False, "lost": False}
 
@@ -446,6 +461,7 @@ def serve_router(args) -> int:
                                  **admin_headers()},
                         trace=trace,
                         sink=relay_sink if streaming else None,
+                        prefix_tokens=prefix_tokens,
                     )
                 except NoReplicaAvailable as e:
                     return self._json(

@@ -42,6 +42,7 @@ Usage:
       GET  /metrics
       GET  /debug/state | /debug/trace?id=<trace_id> | /debug/traces
       POST /admin/drain            # authenticated remote drain
+      POST /admin/adopt_prefixes   # migration receiver (PFXH1 body)
 
 /admin/* and /debug/* are gated by the fleet-shared ``PFX_ADMIN_TOKEN``
 bearer token (unset = loopback-only, loudly — core/router.check_admin);
@@ -193,7 +194,7 @@ def build_scheduler(server, scheduler: str, *, queue_depth: int,
                     max_coalesce: int, cb_batch: int = 8,
                     kv_blocks: int = 0, name: str = "serve",
                     role: str = "monolith", prefix_cache_blocks: int = 0,
-                    prefill_chunk: int = 0):
+                    prefill_chunk: int = 0, prefix_spill_bytes: int = 0):
     """Construct the serving scheduler behind ``--scheduler``:
 
     - ``coalesce`` (default): the PR 3 `RequestQueue` — same-bucket
@@ -229,6 +230,7 @@ def build_scheduler(server, scheduler: str, *, queue_depth: int,
             # "Disaggregated operations")
             prefix_cache_blocks=prefix_cache_blocks,
             prefill_chunk=prefill_chunk,
+            prefix_spill_bytes=prefix_spill_bytes,
         )
 
         def prefill_runner(prompts, max_new):
@@ -267,6 +269,7 @@ def build_scheduler(server, scheduler: str, *, queue_depth: int,
             server, max_batch=cb_batch, num_blocks=kv_blocks,
             prefix_cache_blocks=prefix_cache_blocks,
             prefill_chunk=prefill_chunk,
+            prefix_spill_bytes=prefix_spill_bytes,
         )
         return ContinuousScheduler(
             engine, max_depth=queue_depth, name=name
@@ -283,7 +286,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                watchdog_s: float = 300.0, max_tokens_cap: int = 0,
                scheduler: str = "coalesce", cb_batch: int = 8,
                kv_blocks: int = 0, prefix_cache_blocks: int = 0,
-               prefill_chunk: int = 0, cb_warmup=(),
+               prefill_chunk: int = 0, prefix_spill_bytes: int = 0,
+               cb_warmup=(),
                slo_ttft_p99_s: float = 0.0, slo_error_rate: float = 0.0,
                slo_windows_s=(60.0, 600.0),
                role: str = "monolith", replica_id: str = ""):
@@ -367,8 +371,12 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         server, scheduler, queue_depth=queue_depth,
         max_coalesce=max_coalesce, cb_batch=cb_batch, kv_blocks=kv_blocks,
         name="serve", role=role, prefix_cache_blocks=prefix_cache_blocks,
-        prefill_chunk=prefill_chunk,
+        prefill_chunk=prefill_chunk, prefix_spill_bytes=prefix_spill_bytes,
     )
+    # the paged engine behind the scheduler (None on the coalesce path):
+    # the /healthz prefix-affinity advertisement and the drain-time
+    # prefix migration read it directly
+    engine = getattr(queue, "engine", None)
     # token streaming (docs/serving.md "Token streaming"): only the
     # continuous scheduler has a per-step commit hook (submit(stream=));
     # the coalesce scheduler resolves whole completions, so its streamed
@@ -635,6 +643,21 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     **({"available_blocks": int(reg.value(
                         "pfx_kv_blocks_available", snap=snap))}
                        if "pfx_kv_blocks_available" in snap else {}),
+                    # prefix-affinity routing signal (core/router.py):
+                    # how many shared-prefix blocks this replica has
+                    # published, plus a compact digest of the hottest
+                    # cached prefixes (crc32 path hashes) — the router
+                    # scores requests toward the replica already
+                    # holding their prefill (absent when the prefix
+                    # cache is off)
+                    **({"prefix_cached_blocks": int(reg.value(
+                        "pfx_prefix_cached_blocks", snap=snap))}
+                       if "pfx_prefix_cached_blocks" in snap else {}),
+                    **({"prefix_hashes": engine.cache.prefix.digest(),
+                        "prefix_block": int(engine.block)}
+                       if engine is not None
+                       and getattr(engine, "prefix_enabled", False)
+                       else {}),
                     "queue": {
                         k: int(reg.value(m, snap=snap))
                         for k, m in _QUEUE_HEALTH_KEYS.items()
@@ -728,6 +751,11 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     "pfx_prefix_hit_tokens_total",
                     "pfx_prefix_evictions_total", "pfx_prefix_cached_blocks",
                     "pfx_prefill_chunks_total",
+                    "pfx_prefix_spill_bytes", "pfx_prefix_spill_entries",
+                    "pfx_prefix_spills_total", "pfx_prefix_readmits_total",
+                    "pfx_prefix_spill_discards_total",
+                    "pfx_migrate_sent_total", "pfx_migrate_adopted_total",
+                    "pfx_migrate_failed_total",
                 ):
                     if name in snap:
                         gauges[name] = reg.value(name, snap=snap)
@@ -834,6 +862,19 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             if not self._authorized("/admin"):
                 return
             if parts.path == "/admin/drain":
+                # optional JSON body: {"migrate_to": [peer_url, ...]}
+                # names surviving peers to ship the hottest published
+                # prefixes to before the listener dies (KV migration,
+                # docs/serving.md "KV lifecycle").  Read BEFORE the
+                # response — the body is gone once we answer.
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError:
+                    req = {}  # a bare drain must keep working
+                peers = tuple(
+                    str(u) for u in (req.get("migrate_to") or []) if u
+                )
                 # response FIRST, then the drain: an idle replica can
                 # finish its drain in milliseconds, and the caller must
                 # learn the drain started before the listener dies
@@ -850,10 +891,55 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 initiate_drain(
                     "admin drain" + (
                         f" (trace {parent['trace_id']})" if parent else ""
-                    )
+                    ),
+                    migrate_to=peers,
                 )
                 return
+            if parts.path == "/admin/adopt_prefixes":
+                return self._adopt_prefixes()
             return self._json(404, {"error": "unknown admin path"})
+
+        def _adopt_prefixes(self):
+            """POST /admin/adopt_prefixes — the migration-receiver half
+            of KV durability (docs/serving.md "KV lifecycle"): a
+            draining peer's exported prefix payload (PFXH1 binary body)
+            is validated IN FULL before anything touches the arena,
+            then folded in on the scheduler thread at an iteration
+            boundary.  A torn or incompatible payload gets an honest
+            400 and nothing is half-adopted; a draining/closed replica
+            answers 503 so the sender's failover ladder moves on."""
+            from paddlefleetx_tpu.core.paged_cache import unpack_handoff
+
+            if not hasattr(queue, "submit_prefix_adoption"):
+                return self._json(400, {
+                    "error": "prefix adoption requires --scheduler "
+                             "continuous (paged KV arena)"
+                })
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            try:
+                meta, arrays = unpack_handoff(body)
+                fut = queue.submit_prefix_adoption(meta, arrays)
+            except ValueError as e:
+                # torn payload / wrong block size / pool-shape mismatch:
+                # rejected whole, before any arena mutation
+                return self._json(400, {"error": str(e)})
+            except QueueClosed:
+                return self._json(
+                    503, {"error": "draining: not adopting prefixes"},
+                    headers={"Retry-After": "5"},
+                )
+            try:
+                adopted = fut.result(timeout=default_deadline_s)
+            except TimeoutError:
+                return self._json(
+                    503, {"error": "adoption still pending; scheduler "
+                                   "busy"},
+                    headers={"Retry-After": "1"},
+                )
+            except Exception as e:  # noqa: BLE001 — arena reset et al.
+                return self._json(500, {"error": str(e)})
+            return self._json(200, {"adopted_blocks": int(adopted)})
 
         def _fail(self, code: int, msg: str, fut, t0, retry=None):
             """One failed-request epilogue: span + SLO accounting (400s
@@ -1455,11 +1541,102 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     orig_handlers = {}
     drain_lock = threading.Lock()
 
-    def initiate_drain(source: str) -> bool:
+    def _migrate_prefixes(peers) -> None:
+        """Drain-time KV migration (docs/serving.md "KV lifecycle"):
+        ship the hottest published prefixes to the first surviving peer
+        that will take them.  STRICTLY best-effort and deadline-bounded
+        — runs AFTER queue.join() (the scheduler thread has exited, so
+        the index walk is single-threaded) and BEFORE httpd.shutdown(),
+        and NO failure mode here may stall the drain contract: every
+        send is capped by what remains of ``PFX_MIGRATE_DEADLINE_S``,
+        a wedged receiver (PFX_FAULT=migrate_stall) burns the budget
+        and the drain proceeds, and any exception is caught by the
+        caller.  Counters: pfx_migrate_sent_total on the accepted send,
+        pfx_migrate_failed_total when no peer adopted."""
+        import urllib.request
+
+        from paddlefleetx_tpu.core.paged_cache import pack_handoff
+        from paddlefleetx_tpu.core.router import admin_headers
+        from paddlefleetx_tpu.utils.resilience import maybe_fire
+
+        deadline_s = float(os.environ.get("PFX_MIGRATE_DEADLINE_S",
+                                          "10") or 10)
+        top = int(os.environ.get("PFX_MIGRATE_TOP", "64") or 64)
+        t_end = time.monotonic() + max(0.0, deadline_s)
+        export = engine.export_hot_prefixes(top)
+        if export is None:
+            return  # nothing cached — nothing to migrate
+        payload = pack_handoff(*export)
+        nblocks = len(export[0]["prefixes"])
+        attempts = 0
+        for peer in peers:
+            url = peer.rstrip("/") + "/admin/adopt_prefixes"
+            backoff = 0.2
+            for _ in range(2):  # bounded retry per peer
+                left = t_end - time.monotonic()
+                if left <= 0:
+                    break
+                attempts += 1
+                if maybe_fire("migrate_stall", attempts):
+                    # a wedged receiver, modeled here at the send site:
+                    # the hang is capped at the REMAINING migration
+                    # budget, so the drain deadline holds no matter
+                    # what PFX_FAULT_HANG_S says
+                    hang = float(os.environ.get("PFX_FAULT_HANG_S",
+                                                "30") or 30)
+                    time.sleep(min(hang,
+                                   max(0.0, t_end - time.monotonic())))
+                    left = t_end - time.monotonic()
+                    if left <= 0:
+                        break
+                try:
+                    req = urllib.request.Request(
+                        url, data=payload, method="POST",
+                        headers={
+                            "Content-Type": "application/octet-stream",
+                            **admin_headers(),
+                        },
+                    )
+                    with urllib.request.urlopen(
+                        req, timeout=max(0.1, left)
+                    ) as resp:
+                        body = json.loads(resp.read() or b"{}")
+                    adopted = int(body.get("adopted_blocks", 0))
+                    reg.counter("pfx_migrate_sent_total").inc()
+                    recorder.record({
+                        "event": "migrate_sent", "peer": peer,
+                        "blocks": nblocks, "adopted_blocks": adopted,
+                    })
+                    print(
+                        f"migrate: {peer} adopted {adopted} of "
+                        f"{nblocks} prefix block(s)", flush=True,
+                    )
+                    return
+                except Exception as e:  # noqa: BLE001 — ladder moves on
+                    print(f"migrate: send to {peer} failed ({e})",
+                          flush=True)
+                    time.sleep(min(backoff,
+                                   max(0.0,
+                                       t_end - time.monotonic())))
+                    backoff *= 2
+        reg.counter("pfx_migrate_failed_total").inc()
+        recorder.record({"event": "migrate_failed",
+                         "peers": list(peers), "blocks": nblocks})
+        print(
+            f"migrate: no surviving peer adopted within "
+            f"{deadline_s:g}s; {nblocks} prefix block(s) will be "
+            f"recomputed on demand", flush=True,
+        )
+
+    def initiate_drain(source: str, migrate_to=()) -> bool:
         """THE drain initiation, shared by the signal handler and the
         authenticated ``POST /admin/drain`` (the remote transport that
         makes rolling deploys work cross-host): close admission, answer
         every admitted request, exit 0 — the PR 3 contract unchanged.
+        ``migrate_to`` (surviving-peer base URLs from the drain body)
+        additionally ships the hottest published prefixes to a peer
+        before the listener dies — best-effort, hard-bounded by
+        PFX_MIGRATE_DEADLINE_S, and NEVER able to fail the drain.
         Idempotent: returns False when a drain is already underway."""
         with drain_lock:
             if flags["draining"]:
@@ -1467,7 +1644,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             flags["draining"] = True
         draining_gauge.set(1)
         recorder.record({"event": "drain_start", "source": source,
-                         "queued": queue.depth()})
+                         "queued": queue.depth(),
+                         "migrate_to": list(migrate_to)})
         print(
             f"{source}: draining — admission closed, "
             f"{queue.depth()} queued request(s) will finish",
@@ -1477,6 +1655,13 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         def _drain():
             queue.close()
             queue.join()
+            if migrate_to and engine is not None:
+                try:
+                    _migrate_prefixes(migrate_to)
+                except Exception as e:  # noqa: BLE001 — drain wins
+                    reg.counter("pfx_migrate_failed_total").inc()
+                    print(f"migrate: failed ({e}); drain continues",
+                          flush=True)
             httpd.shutdown()
 
         threading.Thread(target=_drain, name="serve-drain",
@@ -1612,6 +1797,13 @@ def main(argv=None):
                     "prompt-prefix blocks; later admissions reuse them "
                     "and prefill only the suffix; 0 disables — "
                     "docs/serving.md)")
+    ap.add_argument("--prefix-spill-bytes", type=int, default=0,
+                    help="continuous scheduler: host-RAM budget (bytes) "
+                    "for the prefix-spill tier — LRU-evicted prefix "
+                    "blocks demote to pinned host memory and readmit "
+                    "on a later prefix match instead of recomputing "
+                    "(requires --prefix-cache-blocks; 0 disables — "
+                    "docs/serving.md 'KV lifecycle')")
     ap.add_argument("--prefill-chunk", type=int, default=0,
                     help="continuous scheduler: admit long prompts in "
                     "chunks of this many tokens (multiple of "
@@ -1750,6 +1942,7 @@ def main(argv=None):
             kv_blocks=args.kv_blocks,
             prefix_cache_blocks=args.prefix_cache_blocks,
             prefill_chunk=args.prefill_chunk,
+            prefix_spill_bytes=args.prefix_spill_bytes,
             cb_warmup=cb_warmup,
             slo_ttft_p99_s=args.slo_ttft_p99,
             slo_error_rate=args.slo_error_rate,
