@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel test-fleet-obs test-decode-overlap test-kv-tier bench bench-check
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel test-fleet-obs test-decode-overlap test-kv-tier test-tenant bench bench-check
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -19,7 +19,7 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_bench_helpers.py tests/test_bench_cases.py \
              tests/test_router.py tests/test_controller.py \
              tests/test_prefix_cache.py tests/test_shard_map_compat.py \
-             tests/test_fleet_obs.py
+             tests/test_fleet_obs.py tests/test_tenancy.py
 
 # lint runs inside the gate via tests/test_lint.py::test_repo_is_clean
 test-fast:
@@ -36,7 +36,7 @@ MID_EXTRA = tests/test_engine.py tests/test_generation.py tests/test_moe.py \
             tests/test_compression_profiler.py tests/test_hf_convert.py \
             tests/test_long_context.py tests/test_paged_cache.py \
             tests/test_continuous_batching.py tests/test_speculative.py \
-            tests/test_kv_handoff.py
+            tests/test_kv_handoff.py tests/test_tenant_sched.py
 test-mid:
 	python -m pytest $(FAST_FILES) $(MID_EXTRA) -q -m "not slow" -x
 	python -m pytest "tests/test_pipeline.py::test_pipeline_1f1b_train_loss_and_grads[2-extra1-4-1]" -q
@@ -138,6 +138,14 @@ test-prefix:
 test-kv-tier:
 	python -m pytest tests/test_kv_tier.py tests/test_kv_handoff.py -q
 	python -m pytest tests/test_bench_contract.py -q -k "decode_happy"
+
+# multi-tenant isolation gate: tenancy units (quotas/DRR/label cap/header
+# propagation), scheduler fairness + preemption parity, then the real-CLI
+# drills (two-tenant flood, preempt-storm token identity, SSE honest
+# close) — docs/serving.md "Multi-tenant isolation"
+test-tenant:
+	python -m pytest tests/test_tenancy.py tests/test_tenant_sched.py -q
+	python -m pytest tests/test_tenant_drills.py -q
 
 # speculative-decoding + KV-quant gate: drafter/accept units, greedy
 # parity (contiguous + paged, incl. full-rejection iterations), int8

@@ -98,6 +98,13 @@ CASES = {
     # with spill ON when anything readmitted), and honest greedy
     # divergence (docs/serving.md "KV lifecycle")
     "spill": (None, None, False),
+    # two-tenant isolation A/B: a flood tenant bursts at t=0 while a
+    # trickle tenant arrives staggered into the backlog, replayed
+    # through a slot-starved continuous scheduler with weighted-fair
+    # DRR ON vs single-class FCFS — emits TWO rows (fair + fcfs)
+    # reporting per-tenant TTFT percentiles, the isolation evidence
+    # (docs/serving.md "Multi-tenant isolation")
+    "tenant": (None, None, False),
 }
 
 # env spellings of the two decode paths (read at trace time).  BOTH are
@@ -132,6 +139,9 @@ def _metrics_for(name: str) -> list:
     if name == "spill":
         return ["gpt345m_decode_spill_on",
                 "gpt345m_decode_spill_off"]
+    if name == "tenant":
+        return ["gpt345m_decode_tenant_fair",
+                "gpt345m_decode_tenant_fcfs"]
     return [f"gpt345m_decode_{name}"]
 
 
@@ -472,11 +482,14 @@ def _staggered_trace(n: int, mean_gap_s: float):
     return np.cumsum(gaps)
 
 
-def _drive_staggered(submit, offsets, prompts, max_new):
+def _drive_staggered(submit, offsets, prompts, max_new, tenants=None):
     """Replay one arrival trace against a scheduler ``submit`` callable;
     returns (per-request TTFT seconds, per-request output rows, wall
     seconds).  TTFT here is submit->resolved: the serving definition for
-    a non-streaming decode (tools/serve.py span semantics)."""
+    a non-streaming decode (tools/serve.py span semantics).  ``tenants``
+    (optional, per-request labels) is forwarded as the ``tenant=``
+    keyword — the multi-tenant case's fair side; ``None`` keeps the
+    single-class submit shape every other case uses."""
     import threading
 
     n = len(prompts)
@@ -489,7 +502,10 @@ def _drive_staggered(submit, offsets, prompts, max_new):
         time.sleep(max(0.0, offsets[i] - (time.perf_counter() - t0)))
         t_sub = time.perf_counter()
         try:
-            fut = submit([prompts[i]], max_new)
+            if tenants is None:
+                fut = submit([prompts[i]], max_new)
+            else:
+                fut = submit([prompts[i]], max_new, tenant=tenants[i])
             rows = fut.result(timeout=600)
             ttft[i] = time.perf_counter() - t_sub
             outs[i] = rows[0]
@@ -625,6 +641,123 @@ def run_staggered_case(args) -> list:
         {"scheduler": "coalesce"},
     ))
     return rows
+
+
+def run_tenant_case(args) -> list:
+    """Weighted-fair DRR vs single-class FCFS under the SAME two-tenant
+    arrival trace (docs/serving.md "Multi-tenant isolation").
+
+    A flood tenant bursts every request at t=0 into a deliberately
+    slot-starved continuous engine (max_batch=2: the backlog is the
+    point); a trickle tenant's requests land staggered INSIDE that
+    backlog window.  The fair side labels submissions and weights the
+    trickle tenant 8:1, so DRR hands it the next free slot ahead of the
+    flood's queue; the FCFS side replays the identical trace through
+    the same scheduler with every request in one class, so the trickle
+    waits behind the whole burst.  Per-tenant TTFT percentiles are the
+    row payload — the contract pins fair trickle-p99 <= fcfs
+    trickle-p99 and exact greedy token identity at the f32 smoke dtype
+    (both sides decode the same rows on the same engine; bf16 chip rows
+    count near-tie argmax flips honestly instead)."""
+    import jax
+    import numpy as np
+
+    from paddlefleetx_tpu.core.continuous_batching import (
+        ContinuousScheduler,
+        PagedDecodeEngine,
+    )
+    from paddlefleetx_tpu.core.tenancy import TenantConfig
+
+    from bench import knob_env
+
+    n_flood = int(os.environ.get("BENCH_TENANT_FLOOD", 6))
+    n_trickle = int(os.environ.get("BENCH_TENANT_TRICKLE", 3))
+    n_req = n_flood + n_trickle
+    server = _serving_server(args, greedy=True)
+    rng = np.random.default_rng(3)
+    prompts = [
+        rng.integers(1, 50304, args.prompt).tolist() for _ in range(n_req)
+    ]
+    tenants = ["flood"] * n_flood + ["trickle"] * n_trickle
+
+    with knob_env(_OVERHAUL_ENV):
+        # calibrate: one warm single-request decode bounds the gap scale
+        server.generate_ids([prompts[0]], max_dec_len=args.dec)
+        t0 = time.perf_counter()
+        ref = [server.generate_ids([p], max_dec_len=args.dec)[0]
+               for p in prompts]
+        t_one = (time.perf_counter() - t0) / n_req
+        # flood burst at t=0; trickle arrivals start a quarter-decode in
+        # and stagger from there — all inside the ~(n_flood/2)*t_one
+        # backlog the burst creates on a 2-slot engine
+        gap = 0.5 * t_one
+        trickle_off = 0.25 * t_one + _staggered_trace(n_trickle, gap)
+        offsets = np.concatenate([np.zeros(n_flood), trickle_off])
+
+        def side(tenant_cfg, labels):
+            engine = PagedDecodeEngine(server, max_batch=2)
+            sched = ContinuousScheduler(
+                engine, max_depth=2 * n_req, tenant_config=tenant_cfg
+            )
+            sched.warmup([args.prompt])
+            sched.start()
+            ttft, outs, wall = _drive_staggered(
+                sched.submit, offsets, prompts, args.dec, tenants=labels
+            )
+            sched.shutdown(timeout=120)
+            if [len(o) for o in outs] != [len(r) for r in ref]:
+                raise RuntimeError(
+                    "tenant-case DELIVERED COUNTS diverged from the "
+                    "sequential reference — the TTFT A/B would be unfair"
+                )
+            divergent = sum(1 for a, b in zip(outs, ref) if a != b)
+            return ttft, sum(len(o) for o in outs), wall, divergent
+
+        fair_cfg = TenantConfig.from_obj(
+            {"tenants": {"flood": {"weight": 1}, "trickle": {"weight": 8}}},
+            where="bench tenant case",
+        )
+        fair = side(fair_cfg, tenants)
+        # FCFS control: same trace, same engine shape, one class — a
+        # single tenant queue degenerates to exactly the old FCFS pull
+        fcfs = side(None, None)
+
+    n_dev = jax.device_count()
+
+    def row(metric, scheduler, res, extra):
+        ttft, toks, wall, divergent = res
+        flood_t = ttft[:n_flood]
+        trickle_t = ttft[n_flood:]
+        r = {
+            "metric": metric, "value": round(toks / wall / n_dev, 1),
+            "unit": "delivered new tokens/s/chip (two-tenant trace)",
+            "vs_baseline": None,
+            "arrivals": n_req, "flood_arrivals": n_flood,
+            "trickle_arrivals": n_trickle,
+            "prompt_len": args.prompt, "dec_len": args.dec,
+            "mean_gap_s": round(float(gap), 4),
+            "single_decode_s": round(float(t_one), 4),
+            "scheduler": scheduler,
+            "p50_ttft_s": round(float(np.quantile(ttft, 0.5)), 4),
+            "p99_ttft_s": round(float(np.quantile(ttft, 0.99)), 4),
+            "flood_p50_ttft_s": round(float(np.quantile(flood_t, 0.5)), 4),
+            "flood_p99_ttft_s": round(float(np.quantile(flood_t, 0.99)), 4),
+            "trickle_p50_ttft_s": round(float(np.quantile(trickle_t, 0.5)), 4),
+            "trickle_p99_ttft_s": round(float(np.quantile(trickle_t, 0.99)), 4),
+            "greedy_divergent_rows": divergent,
+            "strategy": "greedy_search",
+            "decode_path": "overhauled",
+            **_mfu_fields(server.module.config, toks / wall / n_dev),
+            "platform": jax.default_backend(),
+        }
+        r.update(extra)
+        return r
+
+    return [
+        row("gpt345m_decode_tenant_fair", "fair-drr", fair,
+            {"weights": {"flood": 1, "trickle": 8}}),
+        row("gpt345m_decode_tenant_fcfs", "fcfs", fcfs, {}),
+    ]
 
 
 def run_prefix_case(args) -> list:
@@ -1033,6 +1166,8 @@ def _child(argv) -> None:
                 rows = run_overlap_case(args)
             elif name == "spill":
                 rows = run_spill_case(args)
+            elif name == "tenant":
+                rows = run_tenant_case(args)
             elif "_spec" in name:
                 rows = [run_spec_case(name, args, params_cache)]
             elif name.endswith("_kvint8"):
@@ -1057,7 +1192,7 @@ def _argparser():
         default="b8_greedy,b8_greedy_legacy,b8_topp,b8_topp_legacy,"
                 "b32_greedy,b32_greedy_legacy,b32_topp,b32_topp_legacy,"
                 "b8_greedy_spec4,b8_greedy_kvint8,serving,staggered,prefix,"
-                "overlap,spill",
+                "overlap,spill,tenant",
     )
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--dec", type=int, default=256)

@@ -97,6 +97,13 @@ from paddlefleetx_tpu.ops.decode_attention import kv_cache_dtype
 from paddlefleetx_tpu.ops.speculative import SpecConfig, ngram_propose_host
 from paddlefleetx_tpu.utils.log import logger
 from paddlefleetx_tpu.utils.resilience import maybe_fire
+from paddlefleetx_tpu.core.tenancy import (
+    DEFAULT_TENANT,
+    DeficitRoundRobin,
+    TenantConfig,
+    TenantLabelCap,
+    normalize_tenant,
+)
 from paddlefleetx_tpu.utils.telemetry import StatsView, _env_int, get_registry
 from paddlefleetx_tpu.utils.tracing import (
     attach_request_trace,
@@ -175,9 +182,35 @@ class _CBEntry:
     # batch — the engine logs and drops a failing sink's push, the
     # tokens are already committed either way.
     stream: Optional[Any] = None
+    # multi-tenant isolation (core/tenancy.py): the fair-share queue the
+    # entry waits in and its priority class (higher may preempt lower)
+    tenant: str = DEFAULT_TENANT
+    priority: int = 0
+    # preempt-resume state: tokens a preempted row had already committed
+    # (row_idx -> tokens), and the row indices waiting to re-enter the
+    # batch as re-prefill continuations.  The continuation's prompt is
+    # ``prompts[row_idx] + row_prefill[row_idx]`` with the max_new
+    # budget reduced by the committed count, so the resumed greedy
+    # decode continues the undisturbed token stream exactly; results and
+    # stream offsets are rebased onto the committed prefix below.
+    row_prefill: Dict[int, List[int]] = dataclasses.field(default_factory=dict)
+    requeue_rows: List[int] = dataclasses.field(default_factory=list)
 
     def __post_init__(self) -> None:
         self.results = [None] * len(self.prompts)
+
+    def emit_stream(self, row_idx: int, start: int, tokens: List[int]) -> None:
+        """Engine-side streaming hook: rebases ``start`` past any tokens
+        this row streamed BEFORE a preemption, so SSE clients see one
+        monotone token index across a preempt-resume."""
+        base = len(self.row_prefill.get(row_idx, ()))
+        self.stream(row_idx, base + start, tokens)
+
+    def finished_tokens(self, row_idx: int, tokens: List[int]) -> List[int]:
+        """The row's full output: preempt-committed prefix + the tokens
+        decoded since the (last) resume."""
+        pre = self.row_prefill.get(row_idx)
+        return (pre + tokens) if pre else tokens
 
 
 class PagedDecodeEngine:
@@ -1565,7 +1598,7 @@ class PagedDecodeEngine:
                 # land.  A broken sink must never kill the batch — the
                 # tokens are committed either way.
                 try:
-                    r.entry.stream(r.row_idx, start, r.tokens[start:])
+                    r.entry.emit_stream(r.row_idx, start, r.tokens[start:])
                 except Exception as sink_exc:
                     logger.warning(
                         f"stream sink failed for seq {r.seq_id}: "
@@ -1614,6 +1647,41 @@ class PagedDecodeEngine:
         self.gen_steps[slot] = 0
         self.max_news[slot] = 0
         self.forced_steps[slot] = 0
+
+    def preempt_row(self, slot: int) -> List[int]:
+        """Evict an ACTIVE row mid-decode for a priority preemption and
+        return the tokens it had committed — the scheduler requeues the
+        row as a re-prefill continuation, so the request is paused, not
+        killed.  The KV-valid prefix (prompt plus the committed tokens
+        whose KV has been written: ``positions - prompt_len`` of them;
+        the LAST sampled token's KV does not exist yet) is published to
+        the radix index first, so the continuation's prefill is a prefix
+        hit riding the drilled token-identical reuse path — with the
+        spill tier as the backstop when the live blocks get evicted
+        before the resume lands.  Caller must ``flush()`` first
+        (row-membership mutation, the dispatch-ahead contract)."""
+        row = self.slots[slot]
+        if row is None:
+            raise ValueError(f"slot {slot} is empty")
+        if not row.prefill_done:
+            raise ValueError(
+                f"slot {slot} is mid-chunked-prefill; only decode-active "
+                "rows are preemptible"
+            )
+        committed = list(row.tokens)
+        if self.prefix_enabled and not self._warmup:
+            kv_valid = max(0, int(self.positions[slot]) - row.prompt_len)
+            self._publish_prefix(
+                row.prompt_ids + committed[:kv_valid], row.table
+            )
+        self.cache.release(row.seq_id)
+        self.slots[slot] = None
+        self.active[slot] = False
+        self.positions[slot] = 0
+        self.gen_steps[slot] = 0
+        self.max_news[slot] = 0
+        self.forced_steps[slot] = 0
+        return committed
 
     def reset(self) -> List["_Row"]:
         """Rebuild the arena after a failed donating dispatch: the old
@@ -1829,12 +1897,39 @@ class ContinuousScheduler:
     def __init__(self, engine: PagedDecodeEngine, *, max_depth: int = 64,
                  name: str = "serve-cb",
                  dispatch_ahead: Optional[bool] = None,
-                 quantum: Optional[int] = None) -> None:
+                 quantum: Optional[int] = None,
+                 tenant_config: Optional[TenantConfig] = None,
+                 preempt_min_tokens: int = 8) -> None:
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        if preempt_min_tokens < 1:
+            raise ValueError(
+                f"preempt_min_tokens must be >= 1, got {preempt_min_tokens}"
+            )
         self.engine = engine
         self.max_depth = int(max_depth)
         self.name = name
+        # multi-tenant isolation (docs/serving.md "Multi-tenant
+        # isolation"): the admission pull is a deficit round-robin
+        # across tenant queues (weights from the config; FCFS within a
+        # tenant — one tenant degenerates to exactly the old FCFS), and
+        # a high-priority arrival that cannot fit may preempt the
+        # lowest-priority active row once it has committed at least
+        # preempt_min_tokens since its (last) admission — the
+        # minimum-progress floor that makes preemption thrash-free.
+        self.tenant_config = tenant_config or TenantConfig()
+        self._fair = DeficitRoundRobin(self.tenant_config.weight)
+        self._tenant_labels = TenantLabelCap(
+            seed=self.tenant_config.known_tenants()
+        )
+        self.preempt_min_tokens = int(preempt_min_tokens)
+        # cumulative per-tenant-label row counts (scheduler thread only;
+        # the decision log diffs them per iteration and the same sites
+        # bump the labeled registry counters, so replaying an
+        # untruncated log reproduces pfx_tenant_admitted_total /
+        # pfx_tenant_preemptions_total exactly)
+        self._tenant_admitted: Dict[str, int] = {}
+        self._tenant_preempted: Dict[str, int] = {}
         # dispatch-ahead decode + k-step scheduling quantum
         # (docs/decode_path.md).  PFX_DISPATCH_AHEAD=0 is the loud
         # fallback to fully-synchronous stepping; the scheduler (not
@@ -1910,6 +2005,9 @@ class ContinuousScheduler:
                 "gen_errors": "pfx_queue_gen_errors_total",
                 "evictions": "pfx_request_evictions_total",
                 "prefill_admits": "pfx_prefill_admits_total",
+                # instance-local: the per-tenant labeled counter
+                # (pfx_tenant_preemptions_total) is the exported form
+                "preemptions": None,
             }
         )
         get_registry().register_collector(self)
@@ -1952,16 +2050,25 @@ class ContinuousScheduler:
                 "pfx_spec_accept_rate", {},
                 float(eng.stats["spec_accepted"]) / prop if prop else 0.0,
             ))
+        per_tenant: Dict[str, int] = {}
+        with self._lock:
+            for e in self._entries:
+                lab = self._tenant_labels.label(e.tenant)
+                per_tenant[lab] = per_tenant.get(lab, 0) + 1
+        for lab, n in sorted(per_tenant.items()):
+            out.append(("pfx_tenant_queue_depth", {"tenant": lab}, float(n)))
         return out
 
     # -- admission (RequestQueue-compatible surface) --------------------
     def submit(self, prompts: Sequence[Any], max_new_tokens: int, *,
                coalesce_key=None, deadline_s: Optional[float] = None,
-               stream=None) -> RequestFuture:
+               stream=None, tenant: Optional[str] = None,
+               priority: int = 0) -> RequestFuture:
         """``stream`` (optional): a ``stream(row_idx, start, tokens)``
         callable invoked on the scheduler thread as tokens commit —
         the token-streaming hook tools/serve.py's SSE path plugs in
-        (see :class:`_CBEntry`)."""
+        (see :class:`_CBEntry`).  ``tenant``/``priority`` place the
+        entry in its weighted-fair tenant queue and priority class."""
         if not prompts:
             raise ValueError("prompts must be non-empty")
         for p in prompts:
@@ -1974,6 +2081,8 @@ class ContinuousScheduler:
             future=RequestFuture(),
             enqueued_at=time.monotonic(),
             stream=stream,
+            tenant=normalize_tenant(tenant),
+            priority=int(priority),
         )
         entry.future.times["enqueued"] = entry.enqueued_at
         # deep-dive tracing (sampled; no-op at PFX_TRACE_SAMPLE=0):
@@ -2002,7 +2111,8 @@ class ContinuousScheduler:
         return entry.future
 
     def submit_handoff(self, meta: Dict[str, Any], arrays: Dict[str, Any],
-                       *, deadline_s: Optional[float] = None
+                       *, deadline_s: Optional[float] = None,
+                       tenant: Optional[str] = None, priority: int = 0
                        ) -> RequestFuture:
         """Admit a disaggregated KV-handoff payload (one prefilled row
         from a prefill replica): same bounded-queue/deadline surface as
@@ -2026,6 +2136,8 @@ class ContinuousScheduler:
             future=RequestFuture(),
             enqueued_at=time.monotonic(),
             handoff=(meta, arrays),
+            tenant=normalize_tenant(tenant),
+            priority=int(priority),
         )
         entry.future.times["enqueued"] = entry.enqueued_at
         attach_request_trace(
@@ -2209,9 +2321,14 @@ class ContinuousScheduler:
                         round(e.deadline - now, 4)
                         if e.deadline is not None else None
                     ),
+                    "tenant": e.tenant,
+                    "priority": e.priority,
+                    "requeued_rows": len(e.requeue_rows),
                 }
                 for e in self._entries
             ]
+            tenant_admitted = dict(self._tenant_admitted)
+            tenant_preempted = dict(self._tenant_preempted)
             closed = self._closed
             busy = (
                 now - self._busy_since if self._busy_since is not None else 0.0
@@ -2222,10 +2339,24 @@ class ContinuousScheduler:
                 # view (O(capacity) dict build — microseconds; the next
                 # iteration can't start until we release this lock)
                 self._publish_debug()
+        # aggregate per LABEL (the top-k fold) so the keys line up with
+        # the admitted/preempted counters; raw names stay on the
+        # per-entry waiting rows above
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for w in waiting:
+            lab = self._tenant_labels.label(w["tenant"])
+            t = tenants.setdefault(lab, {"waiting": 0, "admitted_rows": 0})
+            t["waiting"] += 1
+        for lab, n in tenant_admitted.items():
+            tenants.setdefault(lab, {"waiting": 0})["admitted_rows"] = n
+        for lab, n in tenant_preempted.items():
+            tenants.setdefault(lab, {"waiting": 0})["preempted_rows"] = n
         return {
             "scheduler": "continuous",
             "depth": len(waiting),
             "waiting": waiting,
+            "tenants": tenants,
+            "preempt_min_tokens": self.preempt_min_tokens,
             "busy_s": round(busy, 4),
             "closed": closed,
             "iterations": self._iter_counter,
@@ -2359,6 +2490,8 @@ class ContinuousScheduler:
         spill_d0 = int(spill["discards"])
         mig_a0 = int(eng.stats["migrate_adopted"])
         blocks_free0 = eng.cache.allocator.free_count()
+        tadmit0 = dict(self._tenant_admitted)
+        tpre0 = dict(self._tenant_preempted)
         n_finished = 0
         try:
             n_finished = self._iterate_inner()
@@ -2405,6 +2538,25 @@ class ContinuousScheduler:
                     "migrate_adopted":
                         int(eng.stats["migrate_adopted"]) - mig_a0,
                 }
+                # multi-tenant columns (same baseline-diff discipline):
+                # per-tenant-label admitted/preempted row counts — the
+                # replay fold reproduces pfx_tenant_admitted_total and
+                # pfx_tenant_preemptions_total exactly from these
+                tenants_row = {
+                    lab: n - tadmit0.get(lab, 0)
+                    for lab, n in self._tenant_admitted.items()
+                    if n - tadmit0.get(lab, 0)
+                }
+                preempted_row = {
+                    lab: n - tpre0.get(lab, 0)
+                    for lab, n in self._tenant_preempted.items()
+                    if n - tpre0.get(lab, 0)
+                }
+                row["preempted"] = sum(preempted_row.values())
+                if tenants_row:
+                    row["tenants"] = tenants_row
+                if preempted_row:
+                    row["preempted_tenants"] = preempted_row
                 with self._lock:
                     self.decision_log.append(row)
             if get_trace_buffer().enabled or self._debug_requested:
@@ -2498,8 +2650,15 @@ class ContinuousScheduler:
             # admits with exactly this view
             n_finished += self._flush_engine()
 
+        reserved_blocks = 0
+        blocked: Optional[tuple] = None
         with self._wake:
-            # FCFS admission from the head: pull rows while they fit.
+            # weighted-fair admission: a deficit round-robin across
+            # tenant queues replaces the old global-FCFS head pull.
+            # Each pick serves the chosen tenant's OLDEST admissible
+            # unit (a preempted row waiting to resume before any fresh
+            # row) and charges one deficit — FCFS within a tenant, one
+            # tenant degenerates to exactly the old FCFS order.
             # Nothing is allocated until the prefill loop below, so the
             # pull accounts for its OWN picks — a burst larger than free
             # capacity stays queued instead of hard-failing at admit()
@@ -2510,55 +2669,154 @@ class ContinuousScheduler:
             # reclaimable scan is O(cached nodes); an iteration whose
             # free pool already covers its admissions never pays it)
             reclaim_counted = False
+            # already-failed entries (e.g. an earlier row died in an
+            # ArenaReset) must neither reserve capacity nor spend a
+            # tenant's turn
+            self._entries = [e for e in self._entries if not e.future.done()]
             while self._entries:
-                head = self._entries[0]
-                if head.future.done():
-                    # already failed (e.g. an earlier row died in an
-                    # ArenaReset): its remaining rows must never start,
-                    # and must not reserve capacity others could use
-                    self._entries.pop(0)
-                    continue
-                p = head.prompts[head.next_row]
+                backlog: Dict[str, int] = {}
+                for e in self._entries:
+                    backlog[e.tenant] = backlog.get(e.tenant, 0) + 1
+                pick = self._fair.pick(backlog)
+                head = next(e for e in self._entries if e.tenant == pick)
+                row_idx, p, mx, resumed = self._next_unit(head)
                 need = blocks_for(
-                    eng.row_capacity_tokens(len(p), head.max_new), eng.block
+                    eng.row_capacity_tokens(len(p), mx), eng.block
                 )
                 if need > free_blocks and not reclaim_counted:
                     free_blocks += eng.cache.prefix.reclaimable_blocks()
                     reclaim_counted = True
                 if free_slots < 1 or need > free_blocks:
+                    # head-of-line blocked (same backpressure as the old
+                    # FCFS pull) — remembered as the priority-preemption
+                    # candidate below
+                    blocked = (head, row_idx, p, mx, resumed, need)
                     break
                 free_slots -= 1
                 free_blocks -= need
+                reserved_blocks += need
+                self._fair.charge(head.tenant)
                 t_pick = time.monotonic()
                 head.future.times.setdefault("picked", t_pick)
-                if head.future.trace is not None and head.next_row == 0:
+                if (head.future.trace is not None and head.next_row == 0
+                        and not resumed):
                     head.future.trace.span(
                         "queue_wait", t0=head.enqueued_at, t1=t_pick,
                     )
-                admitted.append((head, head.next_row, p))
-                head.next_row += 1
-                if head.next_row >= len(head.prompts):
-                    self._entries.pop(0)
+                admitted.append((head, row_idx, p, mx, resumed))
+                if resumed:
+                    head.requeue_rows.pop(0)
+                else:
+                    head.next_row += 1
+                if (head.next_row >= len(head.prompts)
+                        and not head.requeue_rows):
+                    self._entries.remove(head)
+
+        # priority preemption (outside the lock: engine work).  A
+        # blocked arrival with strictly higher priority than the
+        # lowest-priority active row may seat itself by preempting that
+        # row; preempt_storm:K forces one preemption per fire with no
+        # arrival needed (the deterministic drill hook).  Victims must
+        # be past the protected minimum-progress floor
+        # (preempt_min_tokens committed since their last admission), so
+        # a priority storm cannot livelock the batch — and a preempted
+        # row is requeued as a re-prefill continuation, never killed.
+        storm = maybe_fire("preempt_storm", self._iter_counter + 1)
+        want = None
+        if blocked is not None and not blocked[0].future.done():
+            want = blocked
+        if want is not None or storm:
+            flushed = False
+            fits = False
+            for _ in range(eng.capacity + 1):
+                if want is not None:
+                    head, row_idx, p, mx, resumed, need = want
+                    free_s = eng.free_slots() - len(admitted)
+                    free_b = (eng.cache.allocator.free_count()
+                              - reserved_blocks)
+                    if need > free_b:
+                        free_b += eng.cache.prefix.reclaimable_blocks()
+                    if free_s >= 1 and need <= free_b:
+                        fits = True
+                        break
+                victim = self._pick_victim(
+                    below_priority=(
+                        want[0].priority if want is not None else None
+                    ),
+                    # before the flush a row's committed count lags the
+                    # in-flight step by one token — give the pre-flush
+                    # probe that slack, so the flush (which costs the
+                    # dispatch-ahead overlap) only runs when a victim
+                    # is at least plausibly eligible
+                    progress_slack=0 if flushed else 1,
+                )
+                if victim is None:
+                    break
+                if not flushed:
+                    # row membership is about to change: commit the
+                    # in-flight dispatched step first (the engine's
+                    # dispatch-ahead flush contract)
+                    n_finished += self._flush_engine()
+                    # the flush may have finished the victim — re-pick
+                    # against the committed state, strictly
+                    flushed = True
+                    continue
+                self._preempt_slot(victim)
+                if want is None:
+                    break  # storm fire: exactly one forced preemption
+            if want is not None and fits:
+                # seat the preemptor NOW: it earned the freed capacity
+                # (no second fair-pick — priority cuts across fairness
+                # by design, and its tenant's deficit is still charged)
+                head, row_idx, p, mx, resumed, need = want
+                with self._wake:
+                    if not head.future.done():
+                        reserved_blocks += need
+                        self._fair.charge(head.tenant)
+                        t_pick = time.monotonic()
+                        head.future.times.setdefault("picked", t_pick)
+                        if (head.future.trace is not None
+                                and head.next_row == 0 and not resumed):
+                            head.future.trace.span(
+                                "queue_wait", t0=head.enqueued_at,
+                                t1=t_pick,
+                            )
+                        admitted.append((head, row_idx, p, mx, resumed))
+                        if resumed:
+                            head.requeue_rows.pop(0)
+                        else:
+                            head.next_row += 1
+                        if (head.next_row >= len(head.prompts)
+                                and not head.requeue_rows
+                                and head in self._entries):
+                            self._entries.remove(head)
 
         # prefill-on-admit (outside the lock: device work)
-        for entry, row_idx, prompt in admitted:
+        for entry, row_idx, prompt, mx, resumed in admitted:
             if entry.future.done():
                 continue  # an earlier row of this entry already failed
             self._req_counter += 1
             try:
                 maybe_fire("gen_crash", self._req_counter)
-                if entry.handoff is not None:
+                if entry.handoff is not None and not resumed:
                     # disaggregated: adopt the prefill replica's exported
                     # blocks instead of running paged_prefill.  Counted in
                     # prefill_admits too — it IS a row admission, and the
-                    # decision-log replay contract stays exact
+                    # decision-log replay contract stays exact.  (A
+                    # RESUMED handoff row re-prefills below instead: its
+                    # arrays were consumed at the original adoption.)
                     meta, arrays = entry.handoff
                     eng.adopt(meta, arrays, entry=entry, row_idx=row_idx)
                 else:
-                    eng.admit(
-                        prompt, entry.max_new, entry=entry, row_idx=row_idx
-                    )
+                    eng.admit(prompt, mx, entry=entry, row_idx=row_idx)
                 self.stats["prefill_admits"] += 1
+                lab = self._tenant_labels.label(entry.tenant)
+                self._tenant_admitted[lab] = (
+                    self._tenant_admitted.get(lab, 0) + 1
+                )
+                get_registry().counter(
+                    "pfx_tenant_admitted_total", tenant=lab
+                ).inc()
             except ArenaReset as exc:
                 # the donating prefill dispatch failed: every live row
                 # died with the arena — fail them all, keep serving on
@@ -2623,6 +2881,92 @@ class ContinuousScheduler:
             return 0
         return self._finish_rows(finished)
 
+    def _next_unit(self, head: "_CBEntry") -> tuple:
+        """The entry's next admissible unit.  A preempted row waiting to
+        resume goes before any fresh row: its prompt is the original
+        prompt plus every committed token (the last sampled token needs
+        no KV yet, so the whole committed prefix re-prefills — mostly as
+        a radix-index prefix hit), and its budget is what remains.
+        Returns ``(row_idx, prompt, max_new, resumed)``."""
+        if head.requeue_rows:
+            row_idx = head.requeue_rows[0]
+            committed = head.row_prefill.get(row_idx, [])
+            return (
+                row_idx,
+                head.prompts[row_idx] + committed,
+                head.max_new - len(committed),
+                True,
+            )
+        return head.next_row, head.prompts[head.next_row], head.max_new, False
+
+    def _pick_victim(self, below_priority: Optional[int],
+                     progress_slack: int = 0) -> Optional[int]:
+        """The slot of the lowest-priority active row eligible for
+        preemption, or None.  Eligible means: decode-active with prefill
+        done, its entry still live, past the protected minimum-progress
+        floor (``preempt_min_tokens`` committed since its last
+        admission — the anti-livelock guard: a resumed victim must
+        re-earn eligibility before it can be preempted again), and —
+        unless ``below_priority`` is None (the preempt_storm drill) —
+        strictly below the preemptor's priority.  Deterministic
+        tie-break: lowest slot index."""
+        eng = self.engine
+        best: Optional[int] = None
+        for i, r in enumerate(eng.slots):
+            if r is None or r.entry is None or r.entry.future.done():
+                continue
+            if not r.prefill_done or not bool(eng.active[i]):
+                continue
+            if len(r.tokens) + progress_slack < self.preempt_min_tokens:
+                continue
+            if below_priority is not None and r.entry.priority >= below_priority:
+                continue
+            if best is None or (
+                (r.entry.priority, i)
+                < (eng.slots[best].entry.priority, best)
+            ):
+                best = i
+        return best
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Evict one active row mid-decode and requeue it as a
+        re-prefill continuation: the engine publishes its KV-valid
+        prefix to the radix index and frees the slot, the committed
+        tokens are folded into the entry's resume state, and the entry
+        re-enters the queue at the FRONT (it already waited its turn).
+        The caller must have flushed the engine first."""
+        eng = self.engine
+        row = eng.slots[slot]
+        entry = row.entry
+        committed = eng.preempt_row(slot)
+        prev = entry.row_prefill.get(row.row_idx)
+        entry.row_prefill[row.row_idx] = (
+            prev + committed if prev else committed
+        )
+        entry.requeue_rows.append(row.row_idx)
+        self.stats["preemptions"] += 1
+        lab = self._tenant_labels.label(entry.tenant)
+        self._tenant_preempted[lab] = self._tenant_preempted.get(lab, 0) + 1
+        get_registry().counter(
+            "pfx_tenant_preemptions_total", tenant=lab
+        ).inc()
+        if row.trace is not None:
+            row.trace.event(
+                "preempted",
+                slot=slot,
+                committed=len(committed),
+                total_committed=len(entry.row_prefill[row.row_idx]),
+            )
+        logger.info(
+            f"{self.name}: preempted slot {slot} (tenant {entry.tenant}, "
+            f"priority {entry.priority}) after {len(committed)} committed "
+            "token(s); requeued as a re-prefill continuation"
+        )
+        with self._wake:
+            if entry not in self._entries:
+                self._entries.insert(0, entry)
+            self._wake.notify_all()
+
     def _finish_rows(self, finished: List[int]) -> int:
         eng = self.engine
         reg = get_registry()
@@ -2632,7 +2976,9 @@ class ContinuousScheduler:
             eng.release(slot)
             if entry is None:
                 continue
-            entry.results[row.row_idx] = row.tokens
+            entry.results[row.row_idx] = entry.finished_tokens(
+                row.row_idx, row.tokens
+            )
             entry.done_rows += 1
             if entry.done_rows == len(entry.prompts):
                 entry.future.set_result(list(entry.results))

@@ -46,6 +46,13 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
 
+from paddlefleetx_tpu.core.tenancy import (
+    DEFAULT_TENANT,
+    DeficitRoundRobin,
+    TenantConfig,
+    TenantLabelCap,
+    normalize_tenant,
+)
 from paddlefleetx_tpu.utils.log import logger
 from paddlefleetx_tpu.utils.telemetry import StatsView, get_registry
 from paddlefleetx_tpu.utils.tracing import (
@@ -126,6 +133,8 @@ class _Entry:
     deadline: Optional[float]  # absolute time.monotonic(), None = no deadline
     future: RequestFuture
     enqueued_at: float
+    tenant: str = DEFAULT_TENANT
+    priority: int = 0
 
 
 class RequestQueue:
@@ -139,6 +148,13 @@ class RequestQueue:
     Coalescing pulls *later* same-key entries forward to join the oldest
     entry's batch; entries with different keys keep their relative FIFO
     order.  ``coalesce_key=None`` opts an entry out entirely.
+
+    With a ``tenant_config``, the head pick is a deficit round-robin
+    across tenant queues (weights from the config) instead of global
+    FCFS — FCFS order is preserved WITHIN a tenant, and coalescing only
+    merges same-tenant entries so one tenant's batch never grows on
+    another's flood.  Without a config (or when every request is the
+    default tenant) the pick degenerates to exactly the old FCFS.
     """
 
     def __init__(
@@ -148,6 +164,7 @@ class RequestQueue:
         max_depth: int = 64,
         max_coalesce: int = 8,
         name: str = "serve",
+        tenant_config: Optional[TenantConfig] = None,
     ) -> None:
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
@@ -157,6 +174,11 @@ class RequestQueue:
         self.max_depth = int(max_depth)
         self.max_coalesce = int(max_coalesce)
         self.name = name
+        self.tenant_config = tenant_config or TenantConfig()
+        self._fair = DeficitRoundRobin(self.tenant_config.weight)
+        self._tenant_labels = TenantLabelCap(
+            seed=self.tenant_config.known_tenants()
+        )
         # per-prompt trace contexts of the batch CURRENTLY inside the
         # runner (row order matches the runner's prompts; None when
         # untraced).  Set by the scheduler thread right before the
@@ -192,11 +214,20 @@ class RequestQueue:
 
     def collect(self):
         """Telemetry collector: live queue depth + runner busy seconds
-        (the watchdog's wedge probe) in every registry snapshot."""
-        return [
+        (the watchdog's wedge probe) in every registry snapshot, plus
+        per-tenant waiting depth (labels folded by the top-k cap)."""
+        per_tenant: Dict[str, int] = {}
+        with self._lock:
+            for e in self._entries:
+                lab = self._tenant_labels.label(e.tenant)
+                per_tenant[lab] = per_tenant.get(lab, 0) + 1
+        rows = [
             ("pfx_queue_depth", {}, float(self.depth())),
             ("pfx_queue_busy_seconds", {}, self.busy_seconds()),
         ]
+        for lab, n in sorted(per_tenant.items()):
+            rows.append(("pfx_tenant_queue_depth", {"tenant": lab}, float(n)))
+        return rows
 
     # -- admission ------------------------------------------------------
     def submit(
@@ -206,6 +237,8 @@ class RequestQueue:
         *,
         coalesce_key: Optional[Hashable] = None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: int = 0,
     ) -> RequestFuture:
         """Admit a request; returns its future.  Raises ``QueueClosed``
         when draining and ``QueueFull`` at capacity — admission control
@@ -221,6 +254,8 @@ class RequestQueue:
             if deadline_s is not None else None,
             future=RequestFuture(),
             enqueued_at=time.monotonic(),
+            tenant=normalize_tenant(tenant),
+            priority=int(priority),
         )
         entry.future.times["enqueued"] = entry.enqueued_at
         # deep-dive tracing (sampled; no-op at PFX_TRACE_SAMPLE=0):
@@ -295,6 +330,8 @@ class RequestQueue:
                         round(e.deadline - now, 4)
                         if e.deadline is not None else None
                     ),
+                    "tenant": e.tenant,
+                    "priority": e.priority,
                 }
                 for e in self._entries
             ]
@@ -302,10 +339,14 @@ class RequestQueue:
             busy = (
                 now - self._busy_since if self._busy_since is not None else 0.0
             )
+        tenants: Dict[str, int] = {}
+        for w in waiting:
+            tenants[w["tenant"]] = tenants.get(w["tenant"], 0) + 1
         return {
             "scheduler": "coalesce",
             "depth": len(waiting),
             "waiting": waiting,
+            "tenants": tenants,
             "busy_s": round(busy, 4),
             "closed": closed,
         }
@@ -364,25 +405,40 @@ class RequestQueue:
         )
 
     def _take_batch_locked(self) -> Optional[List[_Entry]]:
-        """Pop the oldest live entry plus every compatible waiting entry
-        (same coalesce_key, combined prompt count <= max_coalesce).
-        Expired entries found along the way are shed.  Returns None when
-        the queue is empty."""
+        """Pop the next entry by weighted-fair tenant pick (oldest entry
+        of the deficit-round-robin-chosen tenant — plain FCFS when only
+        one tenant waits) plus every compatible waiting entry of the
+        SAME tenant (same coalesce_key, combined prompt count <=
+        max_coalesce).  Expired entries found along the way are shed.
+        Returns None when the queue is empty."""
         now = time.monotonic()
         while self._entries:
-            head = self._entries.popleft()
-            if head.deadline is not None and now > head.deadline:
-                self._shed_locked(head)
-                continue
+            # shed expired entries first so the fair pick never spends a
+            # tenant's turn on a request nobody is waiting for
+            live: List[_Entry] = []
+            for e in self._entries:
+                if e.deadline is not None and now > e.deadline:
+                    self._shed_locked(e)
+                else:
+                    live.append(e)
+            self._entries = deque(live)
+            if not self._entries:
+                return None
+            backlog: Dict[str, int] = {}
+            for e in self._entries:
+                backlog[e.tenant] = backlog.get(e.tenant, 0) + 1
+            pick = self._fair.pick(backlog)
+            head = next(e for e in self._entries if e.tenant == pick)
+            self._entries.remove(head)
+            self._fair.charge(pick)
             batch = [head]
             n = len(head.prompts)
             if head.coalesce_key is not None and self.max_coalesce > n:
                 keep: List[_Entry] = []
                 for e in self._entries:
-                    if e.deadline is not None and now > e.deadline:
-                        self._shed_locked(e)
-                    elif (
-                        e.coalesce_key == head.coalesce_key
+                    if (
+                        e.tenant == head.tenant
+                        and e.coalesce_key == head.coalesce_key
                         and n + len(e.prompts) <= self.max_coalesce
                     ):
                         batch.append(e)

@@ -67,6 +67,12 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from urllib.parse import urlsplit
 
 from paddlefleetx_tpu.core.request_queue import QueueClosed, QueueFull
+from paddlefleetx_tpu.core.tenancy import (
+    TenantAdmission,
+    TenantConfig,
+    TenantLabelCap,
+    normalize_tenant,
+)
 from paddlefleetx_tpu.utils.log import logger
 from paddlefleetx_tpu.utils.telemetry import (
     _env_int,
@@ -143,6 +149,22 @@ def check_admin(headers: Any, client_address: Any, *,
     return (False, 403,
             f"{what} is localhost-only while {ADMIN_TOKEN_ENV} is unset; "
             "set the shared token to enable remote admin")
+
+
+class TenantQuotaExceeded(RuntimeError):
+    """A tenant hit its configured quota at the front door (HTTP 429).
+    ``retry_after_s`` is HONEST: for a rate rejection it is the token
+    bucket's actual refill time, not a constant."""
+
+    def __init__(self, tenant: str, reason: str,
+                 retry_after_s: float) -> None:
+        super().__init__(
+            f"tenant {tenant!r} over {reason} quota; "
+            f"retry after {retry_after_s:.3f}s"
+        )
+        self.tenant = tenant
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
 
 
 class NoReplicaAvailable(RuntimeError):
@@ -585,7 +607,8 @@ class RouterCore:
                  poll_interval_s: float = 0.5, poll_timeout_s: float = 2.0,
                  eject_after: int = 3, serve_after: int = 1,
                  allow_empty: bool = False, name: str = "router",
-                 handoff: str = "proxy") -> None:
+                 handoff: str = "proxy",
+                 tenant_config: Optional[TenantConfig] = None) -> None:
         if not replicas and not allow_empty:
             # allow_empty is the supervised topology (tools/router.py
             # --supervise): the controller registers replicas via
@@ -616,6 +639,14 @@ class RouterCore:
         self._idle = threading.Condition(self._lock)
         self._closed = False
         self._in_flight_total = 0
+        # per-tenant edge quotas (docs/serving.md "Multi-tenant
+        # isolation"): rate buckets + in-flight caps ahead of the global
+        # in-flight gate; the default config admits everything
+        self.tenant_config = tenant_config or TenantConfig()
+        self._tenant_admission = TenantAdmission(self.tenant_config)
+        self._tenant_labels = TenantLabelCap(
+            seed=self.tenant_config.known_tenants()
+        )
         self._stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
         self._rr = 0  # round-robin tiebreak cursor
@@ -675,6 +706,15 @@ class RouterCore:
                              float(r.depth)))
                 rows.append(("pfx_router_replica_state", {"replica": key},
                              float(STATE_CODE[r.state])))
+        # per-tenant in-flight (TenantAdmission holds its own lock; the
+        # label cap keeps cardinality at top-k + overflow)
+        folded: Dict[str, float] = {}
+        for tn, n in self._tenant_admission.inflight_snapshot().items():
+            lab = self._tenant_labels.label(tn)
+            folded[lab] = folded.get(lab, 0.0) + float(n)
+        for lab in sorted(folded):
+            rows.append(("pfx_tenant_in_flight", {"tenant": lab},
+                         folded[lab]))
         return rows
 
     # -- dynamic registration (elastic control plane) --------------------
@@ -880,6 +920,7 @@ class RouterCore:
                 "handoff_count": hand.get("count", 0),
                 "handoff_seconds_sum": hand.get("sum", 0.0),
                 "fleet_series": reg.value("pfx_fleet_series", snap=snap),
+                "tenants": self.tenant_snapshot(),
             },
         )
 
@@ -900,8 +941,10 @@ class RouterCore:
             self._poll_thread.join(timeout=5)
 
     # -- admission (the RequestQueue surface, router-level) -------------
-    def acquire(self) -> None:
-        """Admit one request into the router.  ``QueueFull`` -> 429,
+    def acquire(self, tenant: Optional[str] = None) -> None:
+        """Admit one request into the router.  Per-tenant quota first
+        (``TenantQuotaExceeded`` -> 429 with the bucket's HONEST
+        retry-after), then the global gate: ``QueueFull`` -> 429,
         ``QueueClosed`` (draining) -> 503 — the PR 3 admission contract
         applied at the front door.
 
@@ -910,6 +953,14 @@ class RouterCore:
         here may touch the registry while holding ``self._lock`` — the
         rejection counters are bumped AFTER release or a concurrent
         /metrics scrape deadlocks the router."""
+        tn = normalize_tenant(tenant)
+        ok, why, retry = self._tenant_admission.admit(tn)
+        if not ok:
+            get_registry().counter(
+                "pfx_tenant_rejected_total",
+                tenant=self._tenant_labels.label(tn), reason=why,
+            ).inc()
+            raise TenantQuotaExceeded(tn, why, retry)
         reason = None
         with self._lock:
             if self._closed:
@@ -919,6 +970,9 @@ class RouterCore:
             else:
                 self._in_flight_total += 1
         if reason is not None:
+            # the tenant slot was provisional: give it back before
+            # rejecting so a global 429/503 never leaks tenant in-flight
+            self._tenant_admission.release(tn)
             get_registry().counter(
                 "pfx_router_rejected_total", reason=reason
             ).inc()
@@ -928,7 +982,8 @@ class RouterCore:
                 f"{self.name} at capacity ({self.max_inflight} in flight)"
             )
 
-    def release(self) -> None:
+    def release(self, tenant: Optional[str] = None) -> None:
+        self._tenant_admission.release(normalize_tenant(tenant))
         with self._idle:
             self._in_flight_total -= 1
             if self._in_flight_total == 0:
@@ -1200,7 +1255,9 @@ class RouterCore:
     # -- disaggregated prefill -> decode --------------------------------
     def _handoff_one(self, prompt: List[int], max_tokens: Optional[int],
                      deadline_abs: float, deadline_s: float,
-                     trace=None) -> List[int]:
+                     trace=None,
+                     extra_headers: Optional[Dict[str, str]] = None
+                     ) -> List[int]:
         """One prompt's prefill -> handoff -> decode chain, under the
         failover ladder (docs/serving.md "Disaggregated operations"):
 
@@ -1227,7 +1284,7 @@ class RouterCore:
             try:
                 return self._handoff_chain(
                     prompt, max_tokens, deadline_abs, deadline_s,
-                    trace, excluded,
+                    trace, excluded, extra_headers=extra_headers,
                 )
             except _DecodeDied as e:
                 if e.replica_key:
@@ -1274,7 +1331,8 @@ class RouterCore:
 
     def _dispatch_prefill(self, req: Dict[str, Any], deadline_abs: float,
                           deadline_s: float, trace=None,
-                          exclude_decode: Optional[set] = None
+                          exclude_decode: Optional[set] = None,
+                          extra_headers: Optional[Dict[str, str]] = None
                           ) -> Tuple[int, bytes, str, Optional[str]]:
         """The prefill leg: dispatch with the STATELESS retry — a
         prefill replica lost mid-exchange never produced anything a
@@ -1328,7 +1386,10 @@ class RouterCore:
                 status, payload, ctype = self.dispatch(
                     "POST", "/prefill", json.dumps(req).encode(),
                     role="prefill", deadline_s=remaining,
+                    # extra_headers carries tenant/priority VERBATIM on
+                    # every retry attempt of this stateless leg
                     headers={"Content-Type": "application/json",
+                             **(extra_headers or {}),
                              **admin_headers()},
                     trace=trace, exclude=lost,
                 )
@@ -1367,7 +1428,9 @@ class RouterCore:
     def _handoff_chain(self, prompt: List[int],
                        max_tokens: Optional[int], deadline_abs: float,
                        deadline_s: float, trace,
-                       exclude_decode: set) -> List[int]:
+                       exclude_decode: set,
+                       extra_headers: Optional[Dict[str, str]] = None
+                       ) -> List[int]:
         """One attempt of the prefill -> handoff -> decode chain.
         Raises :class:`_DecodeDied` when the decode leg was lost after
         bytes were exchanged (the caller decides on the re-prefill
@@ -1386,7 +1449,7 @@ class RouterCore:
             req["max_tokens"] = int(max_tokens)
         status, payload, ctype, ticket_key = self._dispatch_prefill(
             req, deadline_abs, deadline_s, trace=trace,
-            exclude_decode=exclude_decode,
+            exclude_decode=exclude_decode, extra_headers=extra_headers,
         )
         if ticket_key is not None and ctype.startswith("application/json"):
             # the prefill replica completed (or definitively failed) the
@@ -1460,6 +1523,7 @@ class RouterCore:
                     role="decode", deadline_s=remaining,
                     headers={"Content-Type": "application/octet-stream",
                              "X-Handoff-Transport": "proxy",
+                             **(extra_headers or {}),
                              **admin_headers()},
                     trace=trace, exclude=exc or None,
                 )
@@ -1494,7 +1558,9 @@ class RouterCore:
 
     def generate_disaggregated(self, prompts_ids: List[List[int]],
                                max_tokens: Optional[int], deadline_s: float,
-                               trace=None) -> List[List[int]]:
+                               trace=None,
+                               extra_headers: Optional[Dict[str, str]] = None
+                               ) -> List[List[int]]:
         """Serve one request through the split pools: per prompt, a
         prefill replica exports the KV-handoff payload and a decode
         replica adopts it and decodes.  A plural request runs its
@@ -1507,7 +1573,7 @@ class RouterCore:
         if len(prompts_ids) == 1:
             return [self._handoff_one(
                 prompts_ids[0], max_tokens, deadline_abs, deadline_s,
-                trace=trace,
+                trace=trace, extra_headers=extra_headers,
             )]
         from concurrent.futures import ThreadPoolExecutor
 
@@ -1517,7 +1583,8 @@ class RouterCore:
         ) as pool:
             futs = [
                 pool.submit(self._handoff_one, p, max_tokens,
-                            deadline_abs, deadline_s, trace)
+                            deadline_abs, deadline_s, trace,
+                            extra_headers)
                 for p in prompts_ids
             ]
             return [f.result() for f in futs]
@@ -1691,6 +1758,27 @@ class RouterCore:
     def states(self) -> Dict[str, str]:
         with self._lock:
             return {k: r.state for k, r in self.replicas.items()}
+
+    def tenant_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant front-door view for /replicas and the fleet log:
+        label-folded in-flight plus the configured quota knobs (None =
+        unlimited).  Config-declared tenants always appear, so a quiet
+        gold tenant is visible as quiet rather than absent."""
+        rows: Dict[str, Dict[str, Any]] = {}
+        for tn in self.tenant_config.known_tenants():
+            lab = self._tenant_labels.label(tn)
+            pol = self.tenant_config.policy(tn)
+            rows[lab] = {
+                "in_flight": 0,
+                "weight": pol.weight,
+                "rps": pol.rps,
+                "max_inflight": pol.max_inflight,
+            }
+        for tn, n in self._tenant_admission.inflight_snapshot().items():
+            lab = self._tenant_labels.label(tn)
+            row = rows.setdefault(lab, {"in_flight": 0})
+            row["in_flight"] = int(row.get("in_flight", 0)) + int(n)
+        return rows
 
 
 class _DownstreamError(RuntimeError):

@@ -88,6 +88,16 @@ traffic drills in tests/test_serve_drills.py assert the behavior):
                        PFX_MIGRATE_DEADLINE_S with the migration
                        counted failed, never stall the PR 3/11 drain
                        contract (tests/test_kv_tier.py)
+  ``preempt_storm:K[:N]``  force N priority preemptions starting at
+                       continuous-scheduler iteration K: the scheduler
+                       checks the fire at an iteration boundary and
+                       preempts the lowest-priority eligible active row
+                       itself (no behavior here) — the deterministic
+                       preempt -> republish -> requeue -> resume drill;
+                       the preempted request's final greedy output must
+                       stay token-identical to its undisturbed run
+                       (docs/serving.md "Multi-tenant isolation",
+                       drilled in tests/test_tenant_drills.py)
 
 Data sites (step counts are *sample fetch* indices inside the host data
 loader — ``data/batch_sampler.py`` fires them; the data drills in
@@ -231,6 +241,7 @@ FAULT_SITES = (
     "gen_crash", "gen_hang", "cb_step_hang", "boot_crash",
     "corrupt_sample", "io_stall", "handoff_drop", "adopt_crash",
     "cb_commit_crash", "spill_corrupt", "migrate_stall",
+    "preempt_storm",
 )
 
 
@@ -360,6 +371,11 @@ def maybe_fire(site: str, step: int, path: Optional[str] = None) -> bool:
     # migrate_stall's sleep lives at the serve.py send site, where the
     # remaining migration deadline caps it — an uncapped sleep here
     # would outlive the very contract the drill proves.
+    # preempt_storm carries no behavior here either: the continuous
+    # scheduler checks the fire at an iteration boundary and forcibly
+    # preempts the lowest-priority eligible active row itself — a
+    # deterministic preemption-pressure drill (preempt -> republish ->
+    # requeue -> resume) without needing real capacity contention.
     elif site in ("gen_hang", "cb_step_hang"):
         time.sleep(_env_float("PFX_FAULT_HANG_S", 3600.0))
     elif site == "corrupt_sample":

@@ -224,6 +224,19 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_slo_burn_rate": ("gauge", "Error-budget burn rate over a rolling window (labels: objective, window)"),
     "pfx_slo_breach": ("gauge", "1 while the labeled objective burns >threshold on every window"),
     "pfx_slo_ttft_p99_seconds": ("gauge", "Rolling short-window p99 TTFT seen by the SLO tracker"),
+    # multi-tenant isolation (core/tenancy.py vocabulary; emitted by
+    # core/router.py, core/continuous_batching.py, tools/serve.py.
+    # Every `tenant` label is pre-folded through TenantLabelCap: the
+    # first PFX_TENANT_LABEL_TOPK distinct tenants keep their name,
+    # later ones share the `__other__` overflow bucket — cardinality
+    # is bounded even though tenants are not)
+    "pfx_tenant_admitted_total": ("counter", "Rows admitted by the weighted-fair scheduler pull (labels: tenant)"),
+    "pfx_tenant_preemptions_total": ("counter", "Active rows preempted mid-decode by a higher-priority arrival and requeued as re-prefill continuations (labels: tenant = the victim's)"),
+    "pfx_tenant_rejected_total": ("counter", "Router front-door admissions rejected by a tenant quota (labels: tenant, reason=rate|inflight)"),
+    "pfx_tenant_in_flight": ("gauge", "Requests currently inside the router per tenant (labels: tenant)"),
+    "pfx_tenant_queue_depth": ("gauge", "Entries waiting in the scheduler's admission queue per tenant (labels: tenant)"),
+    "pfx_tenant_ttft_seconds": ("histogram", "Time to first token per tenant (labels: tenant)"),
+    "pfx_tenant_slo_burn_rate": ("gauge", "Short-window SLO burn rate per tenant (labels: tenant, objective)"),
 }
 
 # latency-shaped default buckets (seconds): sub-ms to minutes, exponential-ish
@@ -920,7 +933,7 @@ class SLOTracker:
 
     def __init__(self, *, ttft_p99_s: float = 0.0, error_rate: float = 0.0,
                  windows_s=(60.0, 600.0), burn_threshold: float = 1.0,
-                 cap: int = 131072) -> None:
+                 cap: int = 131072, tenant_label_fn=None) -> None:
         if ttft_p99_s < 0 or error_rate < 0:
             raise ValueError("SLO objectives must be >= 0 (0 disables)")
         ws = tuple(float(w) for w in windows_s)
@@ -941,27 +954,44 @@ class SLOTracker:
         self._events: deque = deque()
         self._lock = threading.Lock()
         self._memo: Optional[Tuple[float, Dict[str, Any]]] = None
+        # per-tenant burn: events carry a pre-folded tenant label.  The
+        # fold fn is injected (tools/serve.py shares ONE TenantLabelCap
+        # across SLO/metrics/debug surfaces); when absent, a private
+        # cap is built lazily on the first labeled observation so the
+        # gauge cardinality is bounded either way
+        self._tenant_label_fn = tenant_label_fn
 
     @property
     def enabled(self) -> bool:
         return self.ttft_p99_s > 0.0 or self.error_rate > 0.0
 
+    def _tenant_label(self, tenant: str) -> str:
+        if self._tenant_label_fn is None:
+            from paddlefleetx_tpu.core.tenancy import TenantLabelCap
+            self._tenant_label_fn = TenantLabelCap().label
+        return self._tenant_label_fn(tenant)
+
     def observe_request(self, *, ttft_s: Optional[float] = None,
-                        ok: bool = True, t: Optional[float] = None) -> None:
+                        ok: bool = True, t: Optional[float] = None,
+                        tenant: Optional[str] = None) -> None:
         """One served request: ``ok`` means the server answered within
         contract (200); a shed/error (500, 503, 429) is budget spend.
         ``ttft_s`` is set only for requests that delivered tokens — a
         failed request (no first token ever) counts as a TTFT violation
-        in :meth:`evaluate`, not as a missing sample."""
+        in :meth:`evaluate`, not as a missing sample.  ``tenant`` (when
+        set) joins the event pre-folded through the label cap and feeds
+        the per-tenant short-window burn gauges."""
         if not self.enabled:
             return
         now = time.monotonic() if t is None else float(t)
         horizon = self.windows_s[-1]
+        label = None if tenant is None else self._tenant_label(tenant)
         with self._lock:
             self._events.append((
                 now,
                 None if ttft_s is None else float(ttft_s),
                 bool(ok),
+                label,
             ))
             while self._events and self._events[0][0] < now - horizon:
                 self._events.popleft()
@@ -1069,6 +1099,32 @@ class SLOTracker:
                     f"{'/'.join(str(b) for b in burns.values())}x over the "
                     f"{self.error_rate:g} objective"
                 )
+        # per-tenant short-window burn (labels arrive pre-folded through
+        # the TenantLabelCap, so this block is bounded at top-k + 1
+        # tenants no matter how many distinct callers exist)
+        tenant_labels = sorted({e[3] for e in events if len(e) > 3 and e[3]})
+        if tenant_labels:
+            short_t0 = now - short
+            tview: Dict[str, Any] = {}
+            for tn in tenant_labels:
+                tev = [e for e in events
+                       if len(e) > 3 and e[3] == tn and e[0] >= short_t0]
+                row: Dict[str, Any] = {"requests": len(tev)}
+                if self.ttft_p99_s > 0:
+                    ttfts = [e[1] for e in tev if e[1] is not None]
+                    failed = sum(1 for e in tev if e[1] is None and not e[2])
+                    total = len(ttfts) + failed
+                    bad = sum(1 for v in ttfts if v > self.ttft_p99_s) + failed
+                    row["ttft_p99"] = round(
+                        (bad / total if total else 0.0) / 0.01, 3
+                    )
+                if self.error_rate > 0:
+                    bad = sum(1 for e in tev if not e[2])
+                    row["error_rate"] = round(
+                        (bad / len(tev) if tev else 0.0) / self.error_rate, 3
+                    )
+                tview[tn] = row
+            out["tenants"] = tview
         if reasons:
             out["breach"] = True
             out["reason"] = "; ".join(reasons)
@@ -1097,6 +1153,14 @@ class SLOTracker:
             ))
         if "ttft_p99_s" in ev:
             rows.append(("pfx_slo_ttft_p99_seconds", {}, ev["ttft_p99_s"]))
+        for tn, row in ev.get("tenants", {}).items():
+            for obj in ("ttft_p99", "error_rate"):
+                if obj in row:
+                    rows.append((
+                        "pfx_tenant_slo_burn_rate",
+                        {"tenant": tn, "objective": obj},
+                        row[obj],
+                    ))
         return rows
 
 

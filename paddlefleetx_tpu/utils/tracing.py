@@ -714,18 +714,20 @@ def export_chrome_trace(path: Optional[str] = None,
 # ---------------------------------------------------------------------------
 
 
-def replay_decision_log(rows) -> Dict[str, int]:
+def replay_decision_log(rows) -> Dict[str, Any]:
     """Fold ContinuousScheduler decision-log rows back into the counters
     they must reproduce.  The agreement contract (tested): on a run whose
     log was not truncated, ``prefill_admits`` == pfx_prefill_admits_total,
     ``evictions`` == pfx_request_evictions_total, ``spec_accepted`` ==
     pfx_spec_accepted_total, ``prefix_hits`` == pfx_prefix_hits_total,
-    and the spill/migration quartet ``spills`` / ``readmits`` /
+    the spill/migration quartet ``spills`` / ``readmits`` /
     ``spill_discards`` / ``migrate_adopted`` == pfx_prefix_spills_total
     / pfx_prefix_readmits_total / pfx_prefix_spill_discards_total /
-    pfx_migrate_adopted_total — a trace event silently dropped by the
-    scheduler shows up here as a mismatch."""
-    out = {
+    pfx_migrate_adopted_total, and the tenancy trio: ``preempted`` and
+    per-label ``preempted_tenants`` == pfx_tenant_preemptions_total,
+    per-label ``tenants`` == pfx_tenant_admitted_total — a trace event
+    silently dropped by the scheduler shows up here as a mismatch."""
+    out: Dict[str, Any] = {
         "iterations": 0,
         "prefill_admits": 0,
         "evictions": 0,
@@ -741,6 +743,9 @@ def replay_decision_log(rows) -> Dict[str, int]:
         "readmits": 0,
         "spill_discards": 0,
         "migrate_adopted": 0,
+        "preempted": 0,
+        "tenants": {},
+        "preempted_tenants": {},
     }
     for row in rows:
         out["iterations"] += 1
@@ -758,4 +763,11 @@ def replay_decision_log(rows) -> Dict[str, int]:
         out["readmits"] += int(row.get("readmits", 0))
         out["spill_discards"] += int(row.get("spill_discards", 0))
         out["migrate_adopted"] += int(row.get("migrate_adopted", 0))
+        out["preempted"] += int(row.get("preempted", 0))
+        for tn, n in (row.get("tenants") or {}).items():
+            out["tenants"][tn] = out["tenants"].get(tn, 0) + int(n)
+        for tn, n in (row.get("preempted_tenants") or {}).items():
+            out["preempted_tenants"][tn] = (
+                out["preempted_tenants"].get(tn, 0) + int(n)
+            )
     return out

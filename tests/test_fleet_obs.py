@@ -521,6 +521,14 @@ def _synthetic_fleet(tmp_path, torn=True):
                 "ts": t + i, "event": "router_sample", "in_flight": 2,
                 "handoff_bytes_proxied": 0, "handoff_count": i,
                 "handoff_seconds_sum": 0.2 * i,
+                # per-tenant front-door snapshot (core/router.py
+                # tenant_snapshot): the --fleet renderers table this
+                "tenants": {
+                    "gold": {"weight": 4.0, "rps": None,
+                             "max_inflight": 8, "in_flight": i % 2},
+                    "bulk": {"weight": 1.0, "rps": 2.0,
+                             "max_inflight": None, "in_flight": 1},
+                },
             }) + "\n")
         f.write(json.dumps({
             "ts": t + 3, "event": "scale", "pool": "decode",
@@ -553,6 +561,10 @@ def test_fleet_report_renders_validated_html_from_torn_artifact(tmp_path):
     # and BOTH same-tick scale events render (not last-writer-wins)
     assert "p0" in doc and "d0" in doc and "occupancy 0.95" in doc
     assert "depth 6.0" in doc
+    # per-tenant front-door table off the last router sample: declared
+    # quota knobs render, None renders as unlimited (not a blank cell)
+    assert "Tenants (front door)" in doc
+    assert "gold" in doc and "bulk" in doc and "unlimited" in doc
 
 
 def test_fleet_report_markdown_and_run_dir_scan(tmp_path):
@@ -565,6 +577,7 @@ def test_fleet_report_markdown_and_run_dir_scan(tmp_path):
                         "-o", str(out), "--format", "md"]) == 0
     doc = out.read_text()
     assert "| p0 |" in doc and "scale_up" in doc
+    assert "| gold | 4.0 | unlimited | 8 |" in doc
 
 
 def test_fleet_report_absent_artifact_is_rc2(tmp_path, capsys):

@@ -1,0 +1,438 @@
+"""Multi-tenant substrate units (`core/tenancy.py`) plus the router
+front door's quota surface (`core/router.py`): token-bucket math with
+honest Retry-After, loud config parsing, the top-k label fold, deficit
+round-robin fairness/starvation-freedom, and the regression that tenant
+and priority headers ride every dispatch retry and disaggregated leg
+VERBATIM.  Pure-python + stub HTTP replicas — no jax, no model; the
+end-to-end flood/preemption drills live in tests/test_tenant_drills.py.
+"""
+
+import json
+import threading
+
+import pytest
+
+from paddlefleetx_tpu.core.router import RouterCore, TenantQuotaExceeded
+from paddlefleetx_tpu.core.tenancy import (
+    DEFAULT_TENANT,
+    DeficitRoundRobin,
+    OVERFLOW_TENANT,
+    PRIORITY_HEADER,
+    TENANT_HEADER,
+    TenantAdmission,
+    TenantConfig,
+    TenantLabelCap,
+    TokenBucket,
+    normalize_tenant,
+    parse_priority,
+)
+from tests.test_router import StubReplica, _all_serving, _ctr
+
+
+@pytest.fixture
+def stub():
+    s = StubReplica()
+    yield s
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+# labels
+# ---------------------------------------------------------------------------
+
+
+def test_normalize_tenant_bounded_alphabet():
+    assert normalize_tenant(None) == DEFAULT_TENANT
+    assert normalize_tenant("") == DEFAULT_TENANT
+    assert normalize_tenant("  ") == DEFAULT_TENANT
+    assert normalize_tenant("gold") == "gold"
+    assert normalize_tenant("team:alpha-1.2_x") == "team:alpha-1.2_x"
+    # unsafe bytes fold to '_' — the label stays metrics-safe
+    assert normalize_tenant("a b\nc{d}") == "a_b_c_d_"
+    # bounded length: a hostile 4k header cannot mint a 4k label
+    assert len(normalize_tenant("x" * 5000)) == 64
+
+
+def test_parse_priority_clamped_and_garbage_safe():
+    assert parse_priority(None) == 0
+    assert parse_priority("") == 0
+    assert parse_priority("not-a-number") == 0  # never a 500
+    assert parse_priority("7") == 7
+    assert parse_priority("  -3 ") == -3
+    assert parse_priority("9999") == 100
+    assert parse_priority("-9999") == -100
+
+
+def test_label_cap_topk_then_overflow_stable():
+    cap = TenantLabelCap(topk=2)
+    assert cap.label("a") == "a"
+    assert cap.label("b") == "b"
+    assert cap.label("c") == OVERFLOW_TENANT
+    # stable: earlier tenants never fold once assigned, later tenants
+    # never un-fold — per-label counters stay monotonic
+    assert cap.label("a") == "a"
+    assert cap.label("c") == OVERFLOW_TENANT
+    assert cap.labels() == ["a", "b"]
+
+
+def test_label_cap_seeds_declared_tenants_first():
+    cap = TenantLabelCap(topk=2, seed=["gold", "silver", "bronze"])
+    # an interloper arriving first cannot displace a declared tenant
+    assert cap.label("flood") == OVERFLOW_TENANT
+    assert cap.label("gold") == "gold"
+    assert cap.label("silver") == "silver"
+
+
+def test_label_cap_env_knob_loud_parse(monkeypatch):
+    monkeypatch.setenv("PFX_TENANT_LABEL_TOPK", "3")
+    assert TenantLabelCap().topk == 3
+    monkeypatch.setenv("PFX_TENANT_LABEL_TOPK", "zero")
+    with pytest.raises(ValueError, match="PFX_TENANT_LABEL_TOPK"):
+        TenantLabelCap()
+    monkeypatch.setenv("PFX_TENANT_LABEL_TOPK", "0")
+    with pytest.raises(ValueError, match=">= 1"):
+        TenantLabelCap()
+
+
+# ---------------------------------------------------------------------------
+# config (loud parse)
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_config_defaults_admit_everything():
+    cfg = TenantConfig()
+    pol = cfg.policy("anyone")
+    assert pol.weight == 1.0
+    assert pol.rps is None and pol.max_inflight is None
+    ok, why, retry = TenantAdmission(cfg).admit("anyone")
+    assert ok and why == "" and retry == 0.0
+
+
+def test_tenant_config_from_obj_and_weights():
+    cfg = TenantConfig.from_obj({
+        "default": {"weight": 1},
+        "tenants": {"gold": {"weight": 4, "rps": 50, "burst": 100,
+                             "max_inflight": 32}},
+    })
+    assert cfg.weight("gold") == 4
+    assert cfg.weight("stranger") == 1
+    assert cfg.policy("gold").max_inflight == 32
+    assert cfg.known_tenants() == ["gold"]
+
+
+@pytest.mark.parametrize("obj,match", [
+    ([], "top level"),
+    ({"defualt": {}}, "unknown top-level keys"),
+    ({"default": {"wieght": 2}}, "unknown keys"),
+    ({"default": {"weight": 0}}, "weight must be > 0"),
+    ({"tenants": {"a": {"rps": -1}}}, "rps must be > 0"),
+    ({"tenants": {"a": {"burst": 0.5}}}, "burst must be >= 1"),
+    ({"tenants": {"a": {"max_inflight": 0}}}, "max_inflight must be >= 1"),
+    ({"tenants": {"bad name": {}}}, "label-safe"),
+])
+def test_tenant_config_parse_errors_are_loud(obj, match):
+    with pytest.raises(ValueError, match=match):
+        TenantConfig.from_obj(obj)
+
+
+def test_tenant_config_from_file_loud_on_bad_file(tmp_path):
+    with pytest.raises(ValueError, match="tenants config"):
+        TenantConfig.from_file(str(tmp_path / "absent.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    with pytest.raises(ValueError, match="invalid JSON"):
+        TenantConfig.from_file(str(bad))
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"tenants": {"gold": {"weight": 2}}}))
+    assert TenantConfig.from_file(str(good)).weight("gold") == 2
+
+
+# ---------------------------------------------------------------------------
+# token bucket / admission
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_honest_retry_after():
+    b = TokenBucket(rate=2.0, burst=1.0)
+    ok, retry = b.try_acquire(now=100.0)
+    assert ok and retry == 0.0
+    ok, retry = b.try_acquire(now=100.0)
+    assert not ok
+    # the bucket is empty and refills at 2/s: the next whole token is
+    # 0.5s away — THAT is the Retry-After, not a made-up constant
+    assert retry == pytest.approx(0.5)
+    # half the refill elapsed -> half the wait remains
+    ok, retry = b.try_acquire(now=100.25)
+    assert not ok and retry == pytest.approx(0.25)
+    ok, retry = b.try_acquire(now=100.5)
+    assert ok
+
+
+def test_token_bucket_burst_caps_idle_credit():
+    b = TokenBucket(rate=10.0, burst=3.0)
+    b.try_acquire(now=0.0)
+    # an hour idle does NOT bank 36000 tokens — burst caps the credit
+    granted = sum(1 for _ in range(100) if b.try_acquire(now=3600.0)[0])
+    assert granted == 3
+
+
+def test_admission_inflight_cap_and_release():
+    cfg = TenantConfig.from_obj({"tenants": {"a": {"max_inflight": 2}}})
+    adm = TenantAdmission(cfg)
+    assert adm.admit("a")[0] and adm.admit("a")[0]
+    ok, why, retry = adm.admit("a")
+    assert not ok and why == "inflight" and retry > 0
+    # unlimited tenants are unaffected by a's cap
+    assert adm.admit("b")[0]
+    adm.release("a")
+    assert adm.admit("a")[0]
+    assert adm.inflight_snapshot() == {"a": 2, "b": 1}
+
+
+def test_admission_rate_uses_fake_clock():
+    cfg = TenantConfig.from_obj({"tenants": {"a": {"rps": 1, "burst": 1}}})
+    t = [1000.0]
+    adm = TenantAdmission(cfg, clock=lambda: t[0])
+    assert adm.admit("a")[0]
+    adm.release("a")
+    ok, why, retry = adm.admit("a")
+    assert not ok and why == "rate" and retry == pytest.approx(1.0)
+    t[0] += 1.0
+    assert adm.admit("a")[0]
+
+
+# ---------------------------------------------------------------------------
+# deficit round-robin
+# ---------------------------------------------------------------------------
+
+
+def _drr_run(weights, backlog, picks):
+    drr = DeficitRoundRobin(weight_fn=lambda t: weights.get(t, 1.0))
+    served = {t: 0 for t in backlog}
+    b = dict(backlog)
+    for _ in range(picks):
+        t = drr.pick(b)
+        assert t is not None and b[t] > 0
+        drr.charge(t)
+        served[t] += 1
+        b[t] -= 1
+        b[t] = max(b[t], backlog[t])  # refill: sustained backlog
+    return served
+
+
+def test_drr_splits_by_weight():
+    served = _drr_run({"gold": 4.0, "brz": 1.0},
+                      {"gold": 10, "brz": 10}, picks=100)
+    # 4:1 weights -> ~80/20 split under sustained backlog
+    assert 70 <= served["gold"] <= 90
+    assert served["brz"] >= 10
+
+
+def test_drr_starvation_free_under_flood():
+    # a 99:1 weight ratio still serves the light tenant regularly
+    served = _drr_run({"flood": 99.0, "tiny": 1.0},
+                      {"flood": 1000, "tiny": 1000}, picks=500)
+    assert served["tiny"] >= 3
+
+
+def test_drr_single_tenant_degenerates_to_fcfs():
+    drr = DeficitRoundRobin()
+    for _ in range(10):
+        assert drr.pick({"only": 5}) == "only"
+        drr.charge("only")
+    assert drr.pick({}) is None
+
+
+def test_drr_idle_tenant_does_not_bank_credit():
+    drr = DeficitRoundRobin(weight_fn=lambda t: 1.0)
+    # 'idle' waits out 50 picks with no backlog, then shows up: its
+    # deficit was reset, so it cannot burst past 'busy' on stored credit
+    for _ in range(50):
+        assert drr.pick({"busy": 1, "idle": 0}) == "busy"
+        drr.charge("busy")
+    first = [None, None]
+    for i in range(2):
+        first[i] = drr.pick({"busy": 1, "idle": 1})
+        drr.charge(first[i])
+    assert sorted(first) == ["busy", "idle"]  # alternation, not a burst
+
+
+# ---------------------------------------------------------------------------
+# router front door
+# ---------------------------------------------------------------------------
+
+
+def _quota_core(stub, tenants_obj):
+    return RouterCore([(stub.url, "monolith")],
+                      tenant_config=TenantConfig.from_obj(tenants_obj))
+
+
+def test_router_quota_429_with_honest_retry_after(stub):
+    core = _quota_core(stub, {"tenants": {"a": {"rps": 1, "burst": 1}}})
+    r0 = _ctr("pfx_tenant_rejected_total", tenant="a", reason="rate")
+    core.acquire(tenant="a")
+    with pytest.raises(TenantQuotaExceeded) as exc:
+        core.acquire(tenant="a")
+    assert exc.value.tenant == "a" and exc.value.reason == "rate"
+    assert 0.0 < exc.value.retry_after_s <= 1.0
+    assert _ctr("pfx_tenant_rejected_total", tenant="a", reason="rate") == r0 + 1
+    # the rejected request holds no slot; the admitted one does
+    core.release(tenant="a")
+    assert core.tenant_snapshot().get("a", {}).get("in_flight", 0) == 0
+
+
+def test_router_quota_inflight_cap_scoped_per_tenant(stub):
+    core = _quota_core(stub, {"tenants": {"a": {"max_inflight": 1}}})
+    core.acquire(tenant="a")
+    with pytest.raises(TenantQuotaExceeded) as exc:
+        core.acquire(tenant="a")
+    assert exc.value.reason == "inflight"
+    core.acquire(tenant="b")  # unlimited neighbour unaffected
+    core.release(tenant="b")
+    core.release(tenant="a")
+    core.acquire(tenant="a")
+    core.release(tenant="a")
+
+
+def test_router_global_reject_rolls_back_tenant_slot(stub):
+    from paddlefleetx_tpu.core.request_queue import QueueFull
+
+    core = RouterCore(
+        [(stub.url, "monolith")], max_inflight=1,
+        tenant_config=TenantConfig.from_obj(
+            {"tenants": {"a": {"max_inflight": 5}}}
+        ),
+    )
+    core.acquire(tenant="b")
+    with pytest.raises(QueueFull):
+        core.acquire(tenant="a")
+    # the global 429 must not leak a's provisional in-flight slot
+    assert core.tenant_snapshot()["a"]["in_flight"] == 0
+    core.release(tenant="b")
+
+
+def test_tenant_snapshot_lists_declared_tenants_when_idle(stub):
+    core = _quota_core(
+        stub, {"tenants": {"gold": {"weight": 4, "rps": 50}}}
+    )
+    snap = core.tenant_snapshot()
+    # declared tenants appear even with zero traffic — the operator's
+    # /replicas view shows the configured universe, not just the active
+    assert snap["gold"]["in_flight"] == 0
+    assert snap["gold"]["weight"] == 4
+    assert snap["gold"]["rps"] == 50
+    core.acquire(tenant="gold")
+    assert core.tenant_snapshot()["gold"]["in_flight"] == 1
+    core.release(tenant="gold")
+
+
+def test_collect_exports_tenant_in_flight(stub):
+    core = _quota_core(stub, {"tenants": {"gold": {"weight": 2}}})
+    core.acquire(tenant="gold")
+    core.acquire(tenant="gold")
+    rows = [r for r in core.collect()
+            if r[0] == "pfx_tenant_in_flight" and r[1].get("tenant") == "gold"]
+    assert rows and rows[0][2] == 2
+    core.release(tenant="gold")
+    core.release(tenant="gold")
+
+
+def test_acquire_concurrent_under_quota_is_exact(stub):
+    # 32 threads race a max_inflight=8 cap: exactly 8 win
+    core = _quota_core(stub, {"tenants": {"a": {"max_inflight": 8}}})
+    wins, errs = [], []
+
+    def go():
+        try:
+            core.acquire(tenant="a")
+            wins.append(1)
+        except TenantQuotaExceeded:
+            errs.append(1)
+
+    ts = [threading.Thread(target=go) for _ in range(32)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(wins) == 8 and len(errs) == 24
+    for _ in wins:
+        core.release(tenant="a")
+
+
+# ---------------------------------------------------------------------------
+# header propagation (satellite b): tenant/priority ride EVERY hop
+# ---------------------------------------------------------------------------
+
+_TEN_HDRS = {TENANT_HEADER: "gold", PRIORITY_HEADER: "7"}
+
+
+def _assert_tenant_headers(seen):
+    assert seen.get(TENANT_HEADER.lower(), seen.get(TENANT_HEADER)) == "gold"
+    assert seen.get(PRIORITY_HEADER.lower(), seen.get(PRIORITY_HEADER)) == "7"
+
+
+def _hdr(seen, name):
+    # BaseHTTPRequestHandler preserves case; be tolerant anyway
+    for k, v in seen.items():
+        if k.lower() == name.lower():
+            return v
+    return None
+
+
+def test_dispatch_retry_carries_tenant_headers_verbatim(stub):
+    """A connection-refused retry re-sends on ANOTHER replica: the
+    tenant/priority headers must ride the second attempt too."""
+    import socket as _socket
+
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead = f"http://127.0.0.1:{s.getsockname()[1]}"
+    core = RouterCore([(dead, "monolith"), (stub.url, "monolith")])
+    _all_serving(core)
+    core.replicas["r1"].depth = 9  # the dead replica is picked first
+    status, _, _ = core.dispatch(
+        "POST", "/generate", b"{}", role="monolith", deadline_s=30,
+        headers=dict(_TEN_HDRS),
+    )
+    assert status == 200
+    assert _hdr(stub.post_headers[0], TENANT_HEADER) == "gold"
+    assert _hdr(stub.post_headers[0], PRIORITY_HEADER) == "7"
+
+
+def test_disagg_legs_carry_tenant_headers_verbatim():
+    """extra_headers flows through _dispatch_prefill AND the decode
+    proxy leg — both hops of a disaggregated request see the labels."""
+    pre, dec = StubReplica(role="prefill"), StubReplica(role="decode")
+    core = RouterCore([(pre.url, "prefill"), (dec.url, "decode")])
+    try:
+        _all_serving(core)
+        out = core.generate_disaggregated(
+            [[1, 2, 3]], 4, 30.0, extra_headers=dict(_TEN_HDRS)
+        )
+        assert out == [[7, 8, 9]]
+        for seen in (pre.post_headers[0], dec.post_headers[0]):
+            assert _hdr(seen, TENANT_HEADER) == "gold"
+            assert _hdr(seen, PRIORITY_HEADER) == "7"
+    finally:
+        pre.stop(), dec.stop()
+
+
+def test_prefill_failover_re_sends_tenant_headers():
+    """The stateless prefill failover leg rebuilds the request on the
+    next replica — the rebuilt attempt must carry the labels verbatim,
+    not drop them with the dead connection."""
+    bad, good = StubReplica(role="prefill"), StubReplica(role="prefill")
+    dec = StubReplica(role="decode")
+    bad.fail_mode = "reset"
+    core = RouterCore([(bad.url, "prefill"), (good.url, "prefill"),
+                       (dec.url, "decode")])
+    try:
+        _all_serving(core)
+        core.replicas["r1"].depth = 9  # the doomed replica picked first
+        out = core.generate_disaggregated(
+            [[1, 2, 3]], 4, 30.0, extra_headers=dict(_TEN_HDRS)
+        )
+        assert out == [[7, 8, 9]]
+        assert len(good.hits) == 1
+        assert _hdr(good.post_headers[0], TENANT_HEADER) == "gold"
+        assert _hdr(good.post_headers[0], PRIORITY_HEADER) == "7"
+    finally:
+        bad.stop(), good.stop(), dec.stop()

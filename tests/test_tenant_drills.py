@@ -1,0 +1,407 @@
+"""Multi-tenant isolation drills through the real CLIs
+(`make test-tenant`): tools/serve.py (continuous scheduler) behind
+tools/router.py with a --tenants quota/weight file, driven as real
+subprocesses over HTTP:
+
+  flood        tenant A floods at ~10x its configured rate quota while
+               tenant B trickles: B's latency stays within slack of its
+               solo baseline, A's overage is refused with 429s carrying
+               the token bucket's HONEST finite Retry-After, headers
+               reach the replica (per-tenant TTFT series exist), and a
+               SIGTERM drain exits 0 on every process
+  storm        PFX_FAULT=preempt_storm:K force-preempts a mid-decode row
+               on the live server; the victim resumes as a re-prefill
+               continuation and every response is TOKEN-IDENTICAL to the
+               same server's undisturbed sequential answers (f32 exact)
+  sse-evict    an SSE stream whose row is wedged past its deadline
+               mid-decode closes with the honest terminal error frame
+               (status + tokens_committed == tokens on the wire), never
+               a silent hang — and the server keeps serving after
+
+Follows tests/test_serve_drills.py conventions: `fault`-marked,
+subprocess-driven, one synthetic tiny-GPT config, persistent XLA compile
+cache shared through the environment (tests/conftest.py)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = {
+    "Global": {"global_batch_size": 8, "seed": 11},
+    "Engine": {"mix_precision": {"enable": False},
+               "save_load": {"save_steps": 0}},
+    "Model": {
+        "module": "GPTModule",
+        "vocab_size": 96,
+        "hidden_size": 32,
+        "num_layers": 2,
+        "num_attention_heads": 4,
+        "max_position_embeddings": 64,
+        "dtype": "float32",
+    },
+    "Optimizer": {"name": "FusedAdamW",
+                  "lr": {"name": "Constant", "learning_rate": 1e-3}},
+    "Generation": {"max_dec_len": 8, "decode_strategy": "greedy_search",
+                   "pad_to_multiple": 8, "eos_token_id": 95,
+                   "pad_token_id": 0},
+}
+
+TENANTS = {
+    "default": {"weight": 1.0},
+    "tenants": {
+        "flood": {"weight": 1, "rps": 2, "burst": 2, "max_inflight": 2},
+        "prio": {"weight": 4},
+    },
+}
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env["PFX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("PFX_FAULT", None)
+    env.update(extra or {})
+    return env
+
+
+def _post(port, body, *, headers=None, timeout=90, path="/generate"):
+    """POST returning (status, parsed body, response headers)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.load(r), dict(r.headers.items())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers.items())
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return json.load(r)
+
+
+def _metrics(port, timeout=10):
+    from test_telemetry import parse_prometheus
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=timeout
+    ) as r:
+        metrics, _ = parse_prometheus(r.read().decode())
+    return metrics
+
+
+def _spawn_replica(cfg_path, port, *extra, extra_env=None):
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "-c", str(cfg_path), "--port", str(port),
+         "--queue-depth", "32", "--deadline", "60",
+         "--warmup-buckets", "4", "--warmup-batches", "1",
+         "--scheduler", "continuous", "--cb-batch", "4",
+         "--kv-blocks", "16", *extra],
+        env=_env(extra_env), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _wait_healthy(procs_ports, timeout=300):
+    end = time.time() + timeout
+    pending = dict(procs_ports)
+    while pending and time.time() < end:
+        for port, proc in list(pending.items()):
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"process on {port} died at boot: "
+                    f"{proc.stdout.read()[-3000:]}"
+                )
+            try:
+                if _get(port, "/healthz", timeout=5).get("ok"):
+                    del pending[port]
+            except Exception:
+                pass
+        time.sleep(0.3)
+    assert not pending, f"never healthy: {sorted(pending)}"
+
+
+def _finish(proc, timeout=30):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+    return proc.stdout.read()
+
+
+def _write_cfgs(tmp_path):
+    cfg_path = tmp_path / "tiny_serve.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    ten_path = tmp_path / "tenants.json"
+    ten_path.write_text(json.dumps(TENANTS))
+    return cfg_path, ten_path
+
+
+def test_two_tenant_flood_isolation_and_drain(tmp_path):
+    """THE isolation acceptance drill: tenant `flood` fires ~10x its
+    2 rps / 2-burst / 2-in-flight quota at the router while tenant
+    `prio` trickles sequential requests.  The trickle's latency stays
+    within slack of its solo baseline (the flood's backlog lives in the
+    flood's own bucket, not in front of everyone), the overage is
+    refused with 429 + the bucket's finite Retry-After, the labels
+    provably reached the replica (per-tenant TTFT series), and SIGTERM
+    drains both processes to exit 0."""
+    cfg_path, ten_path = _write_cfgs(tmp_path)
+    sport, rport = _free_port(), _free_port()
+    replica = _spawn_replica(cfg_path, sport,
+                             "--tenants", str(ten_path))
+    router = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "router.py"),
+         "--port", str(rport), "--poll-interval", "0.2",
+         "--replica", f"http://127.0.0.1:{sport}",
+         "--tenants", str(ten_path)],
+        env=_env(), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        _wait_healthy({sport: replica, rport: router})
+        end = time.time() + 30
+        while time.time() < end:
+            if _get(rport, "/healthz").get("eligible", 0) >= 1:
+                break
+            time.sleep(0.2)
+
+        def prio_request(timeout=60):
+            t0 = time.monotonic()
+            code, body, _ = _post(
+                rport, {"prompt_ids": [9, 10, 11], "max_tokens": 4},
+                headers={"X-Tenant": "prio", "X-Priority": "5"},
+                timeout=timeout,
+            )
+            return code, time.monotonic() - t0, body
+
+        # solo baseline: the trickle tenant alone on the fabric
+        solo = []
+        for _ in range(5):
+            code, dt, _body = prio_request()
+            assert code == 200
+            solo.append(dt)
+        solo_p99 = max(solo)
+
+        # the flood: 20 concurrent requests ~at once against rps=2
+        flood_results = [None] * 20
+
+        def flood_worker(i):
+            flood_results[i] = _post(
+                rport, {"prompt_ids": [1, 2, 3], "max_tokens": 4},
+                headers={"X-Tenant": "flood"}, timeout=90,
+            )
+
+        threads = [threading.Thread(target=flood_worker, args=(i,))
+                   for i in range(len(flood_results))]
+        for t in threads:
+            t.start()
+        trickle = []
+        for _ in range(5):
+            code, dt, _body = prio_request(timeout=90)
+            assert code == 200, "trickle tenant starved by the flood"
+            trickle.append(dt)
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "hung flood connection"
+
+        # isolation: the trickle's worst latency under flood stays
+        # within slack of its solo p99 (generous bound — CPU CI jitter
+        # dwarfs scheduling effects; the contract is "bounded", not
+        # "identical")
+        assert max(trickle) <= solo_p99 * 5.0 + 2.0, (solo, trickle)
+
+        codes = [c for c, _b, _h in flood_results]
+        assert all(c in (200, 429) for c in codes), codes
+        assert codes.count(200) >= 1, codes   # under-quota traffic served
+        assert codes.count(429) >= 10, codes  # the overage was refused
+        for code, body, hdrs in flood_results:
+            if code != 429:
+                continue
+            # honest Retry-After: finite, positive, from the bucket
+            retry = float(hdrs.get("Retry-After"))
+            assert 0.0 < retry <= 30.0, hdrs
+            assert body["tenant"] == "flood", body
+            assert body["reason"] in ("rate", "inflight"), body
+            assert body["retry_after_s"] > 0.0, body
+
+        # the router's own accounting: rejected counter + tenant view
+        m = _metrics(rport)
+        rej = sum(v for k, v in m["pfx_tenant_rejected_total"].items()
+                  if ("tenant", "flood") in k)
+        assert rej >= 10, m["pfx_tenant_rejected_total"]
+        snap = _get(rport, "/replicas")
+        assert snap["tenants"]["flood"]["in_flight"] == 0, snap
+        assert snap["tenants"]["prio"]["weight"] == 4, snap
+
+        # the labels crossed the hop: the REPLICA observed per-tenant
+        # TTFT for both tenants (satellite: headers ride every leg)
+        rm = _metrics(sport)
+        ttft_tenants = {dict(k).get("tenant")
+                        for k in rm["pfx_request_ttft_seconds_count"]
+                        } if "pfx_request_ttft_seconds_count" in rm else set()
+        tt = {dict(k).get("tenant")
+              for k in rm.get("pfx_tenant_ttft_seconds_count", {})}
+        assert {"flood", "prio"} <= tt, (tt, ttft_tenants)
+        # label cardinality stayed bounded: every tenant label on the
+        # replica is a declared tenant, anon, or the overflow bucket
+        assert tt <= {"flood", "prio", "anon", "__other__"}, tt
+    finally:
+        # graceful drain: ONE SIGTERM each (a second would force-quit
+        # the router mid-drain), both must exit 0
+        router.send_signal(signal.SIGTERM)
+        replica.send_signal(signal.SIGTERM)
+        try:
+            router.wait(timeout=30)
+            replica.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+        rlog = _finish(router)
+        slog = _finish(replica)
+    assert router.returncode == 0, rlog[-3000:]
+    assert replica.returncode == 0, slog[-3000:]
+    assert "Traceback" not in slog, slog[-3000:]
+
+
+def test_preempt_storm_cli_token_identity(tmp_path):
+    """Preempt-resume parity through the real CLI: `preempt_storm:6`
+    force-preempts one mid-decode row at scheduler iteration 6 (warmup
+    never touches the continuous scheduler, so the threshold lands
+    inside the first traffic wave deterministically).  The preempted
+    row re-enters as a re-prefill continuation and EVERY concurrent
+    response must equal the same server's sequential answers after the
+    storm is spent — greedy f32 token-identity end-to-end."""
+    cfg_path, _ = _write_cfgs(tmp_path)
+    sport = _free_port()
+    replica = _spawn_replica(
+        cfg_path, sport, "--preempt-min-tokens", "2",
+        extra_env={"PFX_FAULT": "preempt_storm:6"},
+    )
+    try:
+        _wait_healthy({sport: replica})
+        prompts = [[1, 2, 3], [4, 5, 6, 7, 8], [9, 10]]
+        results = [None] * len(prompts)
+
+        def worker(i):
+            results[i] = _post(
+                sport, {"prompt_ids": prompts[i], "max_tokens": 16},
+                timeout=120,
+            )
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=150)
+            assert not t.is_alive(), "request hung across the storm"
+        assert all(r is not None and r[0] == 200 for r in results), results
+
+        # the storm really fired (never a green test off a dud drill)
+        m = _metrics(sport)
+        pre = sum(m.get("pfx_tenant_preemptions_total", {}).values())
+        assert pre == 1, m.get("pfx_tenant_preemptions_total")
+
+        # sequential references from the SAME live server (storm spent:
+        # count=1) — preempt-resume must be invisible in the tokens
+        for i, p in enumerate(prompts):
+            code, body, _ = _post(
+                sport, {"prompt_ids": p, "max_tokens": 16}, timeout=120
+            )
+            assert code == 200
+            assert results[i][1]["completion_ids"] == body["completion_ids"], (
+                f"prompt {i}: preempt-resume diverged from the "
+                f"undisturbed decode"
+            )
+    finally:
+        log = _finish(replica)
+    assert replica.returncode == 0, log[-3000:]
+    assert "Traceback" not in log, log[-3000:]
+
+
+def test_sse_evicted_stream_closes_with_honest_frame(tmp_path):
+    """Satellite (a): an SSE client whose row is shed past its deadline
+    MID-decode gets a terminal ``event: error`` frame carrying the
+    status and exactly the token count already put on the wire, then a
+    closed connection — never a silent hang.  `cb_step_hang:10` wedges
+    the decode after ~9 streamed steps (warmup bypasses the scheduler,
+    so the step counter is all traffic), the 2s deadline + 1s slack
+    expires inside the 8s wedge, and the server keeps serving after."""
+    cfg_path, _ = _write_cfgs(tmp_path)
+    sport = _free_port()
+    replica = _spawn_replica(
+        cfg_path, sport, "--shed-slack", "1",
+        extra_env={"PFX_FAULT": "cb_step_hang:10",
+                   "PFX_FAULT_HANG_S": "8.0"},
+    )
+    try:
+        _wait_healthy({sport: replica})
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{sport}/generate?stream=1",
+            data=json.dumps({"prompt_ids": [1, 2, 3], "max_tokens": 40,
+                             "deadline_s": 2.0}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        t0 = time.monotonic()
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200  # SSE reality: status line says 200
+            raw = r.read().decode()  # blocks until the server CLOSES
+        elapsed = time.monotonic() - t0
+        # closed promptly after deadline+slack, not after the 8s wedge
+        # (generous bound: boot-adjacent CPU scheduling jitter)
+        assert elapsed < 30.0, elapsed
+
+        frames = [f for f in raw.split("\n\n") if f.strip()]
+        events = []
+        for f in frames:
+            lines = dict(
+                ln.split(": ", 1) for ln in f.splitlines() if ": " in ln
+            )
+            events.append((lines["event"], json.loads(lines["data"])))
+        streamed = sum(len(d["tokens"]) for ev, d in events
+                       if ev == "token")
+        assert streamed >= 1, raw  # it WAS mid-decode, tokens flowed
+        ev, data = events[-1]
+        assert ev == "error", events
+        assert data["code"] == 503, data
+        assert data["tokens_committed"] == streamed, (data, streamed)
+
+        # the wedge was the row's problem, not the server's: next
+        # request (after the hang drains) answers 200
+        code, body, _ = _post(
+            sport, {"prompt_ids": [4, 5], "max_tokens": 4}, timeout=120
+        )
+        assert code == 200 and body["completion_ids"], body
+    finally:
+        log = _finish(replica)
+    assert replica.returncode == 0, log[-3000:]
+    assert "Traceback" not in log, log[-3000:]

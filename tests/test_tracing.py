@@ -233,6 +233,8 @@ def test_replay_decision_log_sums_rows():
         # spill/migration columns (PR 17) default to 0 on legacy rows
         "spills": 0, "readmits": 0, "spill_discards": 0,
         "migrate_adopted": 0,
+        # multi-tenant columns default to empty/0 on legacy rows
+        "tenants": {}, "preempted": 0, "preempted_tenants": {},
     }
 
 

@@ -738,6 +738,33 @@ def fleet_summary(data: FleetData) -> List[Tuple[str, Any]]:
     ]
 
 
+def tenant_rows(data: FleetData) -> List[List[str]]:
+    """Per-tenant front-door rows off the LAST router sample.  The
+    router folds tenant labels through its top-k cardinality cap before
+    logging (docs/serving.md "Multi-tenant isolation"), so this table is
+    bounded no matter how many tenant names traffic invents; a None
+    quota knob renders as unlimited."""
+    if not data.router_rows:
+        return []
+    tenants = data.router_rows[-1].get("tenants") or {}
+    rows = []
+    for name in sorted(tenants):
+        t = tenants[name] or {}
+
+        def knob(k):
+            v = t.get(k)
+            return "unlimited" if v is None else str(v)
+
+        rows.append([
+            str(name), str(t.get("weight", "")), knob("rps"),
+            knob("max_inflight"), str(int(t.get("in_flight", 0) or 0)),
+        ])
+    return rows
+
+
+_TENANT_COLS = ("tenant", "weight", "rps", "max in-flight", "in flight")
+
+
 _FLEET_CURVES = (
     ("ttft_p99_s", "TTFT p99 (s) per replica"),
     ("itl_p99_s", "ITL p99 (s) per replica"),
@@ -824,6 +851,16 @@ def render_fleet_html(data: FleetData, title: str) -> str:
         )
     out.append("</table>")
 
+    trs = tenant_rows(data)
+    if trs:
+        out.append("<h2>Tenants (front door)</h2>")
+        out.append("<table><tr>" + "".join(
+            f"<th>{c}</th>" for c in _TENANT_COLS) + "</tr>")
+        for tr in trs:
+            out.append("<tr>" + "".join(
+                f"<td>{html.escape(c)}</td>" for c in tr) + "</tr>")
+        out.append("</table>")
+
     out.append("<h2>Last known per-replica state</h2>")
     out.append("<table><tr><th>replica</th>" + "".join(
         f"<th>{c}</th>" for c in _FLEET_STATE_COLS) + "</tr>")
@@ -859,6 +896,13 @@ def render_fleet_markdown(data: FleetData, title: str) -> str:
     lines += ["", "## Summary", "", "| key | value |", "|---|---|"]
     for k, v in fleet_summary(data):
         lines.append(f"| {k} | {v} |")
+    trs = tenant_rows(data)
+    if trs:
+        lines += ["", "## Tenants (front door)", "",
+                  "| " + " | ".join(_TENANT_COLS) + " |",
+                  "|" + "---|" * len(_TENANT_COLS)]
+        for tr in trs:
+            lines.append("| " + " | ".join(tr) + " |")
     lines += ["", "## Last known per-replica state", "",
               "| replica | " + " | ".join(_FLEET_STATE_COLS) + " |",
               "|" + "---|" * (len(_FLEET_STATE_COLS) + 1)]

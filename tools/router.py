@@ -108,9 +108,15 @@ def serve_router(args) -> int:
         NoReplicaAvailable,
         ReplicaUnavailable,
         RouterCore,
+        TenantQuotaExceeded,
         _DownstreamError,
         admin_headers,
         check_admin,
+    )
+    from paddlefleetx_tpu.core.tenancy import (
+        PRIORITY_HEADER,
+        TENANT_HEADER,
+        TenantConfig,
     )
     from paddlefleetx_tpu.utils.telemetry import (
         flight_dir,
@@ -124,6 +130,10 @@ def serve_router(args) -> int:
     replicas += [(u, "prefill") for u in args.prefill]
     replicas += [(u, "decode") for u in args.decode]
     pool_supervise = bool(args.supervise and args.prefill_cmd)
+    tenant_config = None
+    if getattr(args, "tenants", ""):
+        # a bad quota file must fail the boot, not silently admit all
+        tenant_config = TenantConfig.from_file(args.tenants)
     core = RouterCore(
         replicas,
         max_inflight=args.max_inflight,
@@ -133,6 +143,7 @@ def serve_router(args) -> int:
         serve_after=args.serve_after,
         allow_empty=args.supervise,
         handoff=args.handoff,
+        tenant_config=tenant_config,
     )
     if pool_supervise:
         # the supervised pools register as they spawn; pin the topology
@@ -300,7 +311,10 @@ def serve_router(args) -> int:
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
             if self.path == "/replicas":
-                return self._json(200, {"replicas": core.replica_views()})
+                return self._json(200, {
+                    "replicas": core.replica_views(),
+                    "tenants": core.tenant_snapshot(),
+                })
             if self.path.startswith("/debug/"):
                 if not self._authorized("/debug"):
                     return
@@ -367,10 +381,32 @@ def serve_router(args) -> int:
                 return self._json(409, {"error": str(e)})
             return self._json(200, out)
 
+        def _tenant_headers(self):
+            """The request's tenant/priority headers, VERBATIM, for
+            forwarding on every downstream hop (including retry and
+            re-prefill failover legs)."""
+            fwd = {}
+            for h in (TENANT_HEADER, PRIORITY_HEADER):
+                v = self.headers.get(h)
+                if v:
+                    fwd[h] = v
+            return fwd
+
         def _generate(self, parts=None):
             t0 = time.monotonic()
+            tenant = self.headers.get(TENANT_HEADER)
             try:
-                core.acquire()
+                core.acquire(tenant)
+            except TenantQuotaExceeded as e:
+                # HONEST Retry-After: the tenant's own bucket refill
+                # time (plus the machine-precise value in the body)
+                retry = max(0.001, e.retry_after_s)
+                return self._json(
+                    429,
+                    {"error": str(e), "tenant": e.tenant,
+                     "reason": e.reason, "retry_after_s": retry},
+                    headers={"Retry-After": f"{retry:.3f}"},
+                )
             except QueueFull:
                 return self._json(
                     429,
@@ -458,6 +494,7 @@ def serve_router(args) -> int:
                         # (serve.py accepts them only from callers that
                         # pass the admin rule)
                         headers={"Content-Type": "application/json",
+                                 **self._tenant_headers(),
                                  **admin_headers()},
                         trace=trace,
                         sink=relay_sink if streaming else None,
@@ -493,7 +530,7 @@ def serve_router(args) -> int:
                 if trace is not None:
                     trace.event("respond")
                     trace.finish()
-                core.release()
+                core.release(tenant)
 
         def _generate_disagg(self, req, deadline_s, trace):
             if "prompt_ids" in req:
@@ -515,6 +552,7 @@ def serve_router(args) -> int:
                 rows = core.generate_disaggregated(
                     prompts, None if mt is None else int(mt),
                     deadline_s, trace=trace,
+                    extra_headers=self._tenant_headers(),
                 )
             except _DownstreamError as e:
                 try:
@@ -794,6 +832,10 @@ def main(argv=None):
     ap.add_argument("--serve-after", type=int, default=1,
                     help="consecutive healthy polls before a warm "
                     "replica starts receiving traffic")
+    ap.add_argument("--tenants", default="",
+                    help="per-tenant quota/weight config JSON "
+                    "(docs/serving.md 'Multi-tenant isolation'); "
+                    "unset = one anonymous tenant, no limits")
     # ---- elastic control plane (--supervise; docs/serving.md) ----
     ap.add_argument("--supervise", action="store_true",
                     help="spawn + supervise the replicas as managed "

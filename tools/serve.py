@@ -194,7 +194,8 @@ def build_scheduler(server, scheduler: str, *, queue_depth: int,
                     max_coalesce: int, cb_batch: int = 8,
                     kv_blocks: int = 0, name: str = "serve",
                     role: str = "monolith", prefix_cache_blocks: int = 0,
-                    prefill_chunk: int = 0, prefix_spill_bytes: int = 0):
+                    prefill_chunk: int = 0, prefix_spill_bytes: int = 0,
+                    tenant_config=None, preempt_min_tokens: int = 8):
     """Construct the serving scheduler behind ``--scheduler``:
 
     - ``coalesce`` (default): the PR 3 `RequestQueue` — same-bucket
@@ -248,7 +249,7 @@ def build_scheduler(server, scheduler: str, *, queue_depth: int,
 
         queue = RequestQueue(
             prefill_runner, max_depth=queue_depth, max_coalesce=1,
-            name=name,
+            name=name, tenant_config=tenant_config,
         )
         queue.engine = engine  # warmup + /debug introspection
         return queue
@@ -258,6 +259,7 @@ def build_scheduler(server, scheduler: str, *, queue_depth: int,
                 prompts, max_dec_len=max_new
             ),
             max_depth=queue_depth, max_coalesce=max_coalesce, name=name,
+            tenant_config=tenant_config,
         )
     if scheduler == "continuous":
         from paddlefleetx_tpu.core.continuous_batching import (
@@ -272,7 +274,9 @@ def build_scheduler(server, scheduler: str, *, queue_depth: int,
             prefix_spill_bytes=prefix_spill_bytes,
         )
         return ContinuousScheduler(
-            engine, max_depth=queue_depth, name=name
+            engine, max_depth=queue_depth, name=name,
+            tenant_config=tenant_config,
+            preempt_min_tokens=preempt_min_tokens,
         )
     raise ValueError(
         f"unknown scheduler {scheduler!r}; valid: coalesce, continuous"
@@ -290,7 +294,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                cb_warmup=(),
                slo_ttft_p99_s: float = 0.0, slo_error_rate: float = 0.0,
                slo_windows_s=(60.0, 600.0),
-               role: str = "monolith", replica_id: str = ""):
+               role: str = "monolith", replica_id: str = "",
+               tenants_path: str = "", preempt_min_tokens: int = 8):
     import signal
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -303,6 +308,14 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         QueueFull,
     )
     from paddlefleetx_tpu.core.router import check_admin
+    from paddlefleetx_tpu.core.tenancy import (
+        PRIORITY_HEADER,
+        TENANT_HEADER,
+        TenantConfig,
+        TenantLabelCap,
+        normalize_tenant,
+        parse_priority,
+    )
     from paddlefleetx_tpu.utils.telemetry import (
         SLOTracker,
         get_flight_recorder,
@@ -335,25 +348,37 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     # over rolling multi-window burn rates, exported as pfx_slo_* gauges
     # and surfaced as the /healthz "slo" block.  Observed per RESPONSE
     # in the HTTP layer — the decode hot path never touches it.
+    # multi-tenant isolation (docs/serving.md): quota/weight config +
+    # the process-wide label fold the per-tenant series ride
+    tenant_config = (TenantConfig.from_file(tenants_path)
+                     if tenants_path else TenantConfig())
+    tenant_labels = TenantLabelCap(seed=tenant_config.known_tenants())
     slo = SLOTracker(
         ttft_p99_s=slo_ttft_p99_s, error_rate=slo_error_rate,
-        windows_s=slo_windows_s,
+        windows_s=slo_windows_s, tenant_label_fn=tenant_labels.label,
     )
     if slo.enabled:
         reg.register_collector(slo)
 
-    def _slo_observe(code, fut, t0):
+    def _slo_observe(code, fut, t0, tenant=None):
+        # per-tenant TTFT is observed regardless of SLO objectives: the
+        # flood drill reads isolation off this histogram
+        ttft = None
+        times = getattr(fut, "times", {}) if fut is not None else {}
+        if code == 200 and "resolved" in times:
+            ttft = max(0.0, times["resolved"] - t0)
+        if ttft is not None:
+            reg.histogram(
+                "pfx_tenant_ttft_seconds",
+                tenant=tenant_labels.label(normalize_tenant(tenant)),
+            ).observe(ttft)
         if not slo.enabled:
             return
         # contract outcomes: 200 is budget-neutral; 429/500/503 spend the
         # error budget; 400/404 are the client's fault and observe nothing
         if code in (400, 404):
             return
-        ttft = None
-        times = getattr(fut, "times", {}) if fut is not None else {}
-        if code == 200 and "resolved" in times:
-            ttft = max(0.0, times["resolved"] - t0)
-        slo.observe_request(ttft_s=ttft, ok=code == 200)
+        slo.observe_request(ttft_s=ttft, ok=code == 200, tenant=tenant)
 
     cap = max_tokens_cap or int(
         server.cfg.get("Generation", {}).get("max_tokens_cap", 0) or 0
@@ -372,6 +397,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         max_coalesce=max_coalesce, cb_batch=cb_batch, kv_blocks=kv_blocks,
         name="serve", role=role, prefix_cache_blocks=prefix_cache_blocks,
         prefill_chunk=prefill_chunk, prefix_spill_bytes=prefix_spill_bytes,
+        tenant_config=tenant_config, preempt_min_tokens=preempt_min_tokens,
     )
     # the paged engine behind the scheduler (None on the coalesce path):
     # the /healthz prefix-affinity advertisement and the drain-time
@@ -424,7 +450,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     direct_lock = threading.Lock()
 
     def _direct_handoff(payload: bytes, url: str, fwd_deadline: float,
-                        parent=None):
+                        parent=None, extra_headers=None):
         """POST one KV-handoff payload straight to the ticketed decode
         replica (auth via the fleet PFX_ADMIN_TOKEN rule, bounded
         timeout, ONE retry for sends that provably never arrived).
@@ -487,6 +513,9 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     headers={
                         "Content-Type": "application/octet-stream",
                         "X-Handoff-Transport": "direct",
+                        # tenant/priority ride the prefill->decode hop
+                        # verbatim (the one hop the router never sees)
+                        **(extra_headers or {}),
                         **admin_headers(),
                         **fwd_trace,
                     },
@@ -1042,6 +1071,15 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 headers[SPAN_SUMMARY_HEADER] = json.dumps(summaries)
             return headers
 
+        def _tenant_of(self):
+            """The request's tenant label + clamped priority, from the
+            X-Tenant / X-Priority headers (absent -> the anonymous
+            tenant at priority 0).  The RAW header value also rides
+            back out on forwarded hops, verbatim."""
+            raw = self.headers.get(TENANT_HEADER)
+            return (normalize_tenant(raw),
+                    parse_priority(self.headers.get(PRIORITY_HEADER)))
+
         def _wants_stream(self, parts) -> bool:
             """Streamed response requested: ``POST /generate?stream=1``
             or ``Accept: text/event-stream`` (docs/serving.md)."""
@@ -1059,6 +1097,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             fut = None
             observed = False  # span + SLO recorded for this request
             parent = self._remote_parent_authed()
+            tenant, priority = self._tenant_of()
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 try:
@@ -1089,7 +1128,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 if self._wants_stream(parts):
                     self._generate_stream(
                         prompts_ids, mode, trim, key, deadline_s,
-                        parent, t0,
+                        parent, t0, tenant, priority,
                     )
                     observed = True  # the stream path did its accounting
                     return
@@ -1101,6 +1140,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                         lambda: queue.submit(
                             prompts_ids, trim,
                             coalesce_key=key, deadline_s=deadline_s,
+                            tenant=tenant, priority=priority,
                         ),
                         t0,
                     )
@@ -1130,7 +1170,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     reg, recorder, t0, fut, 200,
                     tokens=sum(len(r) for r in rows),
                 )
-                _slo_observe(200, fut, t0)
+                _slo_observe(200, fut, t0, tenant=tenant)
                 observed = True
                 return self._json(200, payload,
                                   headers=self._span_headers(fut, parent))
@@ -1142,13 +1182,14 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 # wedged-503 blind spot
                 if not observed:
                     _record_request_span(reg, recorder, t0, fut, 500)
-                    _slo_observe(500, fut, t0)
+                    _slo_observe(500, fut, t0, tenant=tenant)
                 return self._json(500, {"error": str(e)})
             finally:
                 in_flight_gauge.add(-1)
 
         def _generate_stream(self, prompts_ids, mode, trim, key,
-                             deadline_s, parent, t0):
+                             deadline_s, parent, t0,
+                             tenant=None, priority=0):
             """SSE token streaming (docs/serving.md "Token streaming"):
             tokens leave the box as the engine commits them instead of
             when the row finishes.  The body is HTTP/1.0
@@ -1165,7 +1206,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             stream degrades to a single flush at completion (same SSE
             framing either way)."""
             sink = SinkQueue()
-            submit_kw = {"coalesce_key": key, "deadline_s": deadline_s}
+            submit_kw = {"coalesce_key": key, "deadline_s": deadline_s,
+                         "tenant": tenant, "priority": priority}
             if stream_capable:
                 submit_kw["stream"] = (
                     lambda row, start, toks: sink.put((row, start, toks))
@@ -1264,14 +1306,19 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 except Exception as e:  # noqa: BLE001 — report, keep serving
                     code, stream_err = 500, str(e)
             if stream_err is not None:
-                # mid-stream failure: an honest terminal error frame
+                # mid-stream failure (deadline shed, eviction, drain):
+                # an honest terminal error frame — status PLUS how many
+                # tokens were already committed to the wire, so a
+                # client whose row was evicted mid-decode always sees a
+                # closed stream with an accounting, never a silent hang
                 # (the status line already said 200 — SSE's reality)
-                emit("error", {"error": stream_err, "code": code})
+                emit("error", {"error": stream_err, "code": code,
+                               "tokens_committed": sent_tokens})
                 _record_request_span(reg, recorder, t0, fut, code,
                                      tokens=sent_tokens or None,
                                      streamed=True)
                 if code != 400:
-                    _slo_observe(code, fut, t0)
+                    _slo_observe(code, fut, t0, tenant=tenant)
                 return
             if flushes == 0 and not client_lost:
                 # single-flush degradation (coalesce scheduler, or a
@@ -1288,11 +1335,16 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 reg, recorder, t0, fut, 200,
                 tokens=sum(len(r) for r in rows), streamed=True,
             )
+            if first_flush is not None:
+                reg.histogram(
+                    "pfx_tenant_ttft_seconds",
+                    tenant=tenant_labels.label(normalize_tenant(tenant)),
+                ).observe(max(0.0, first_flush - t0))
             if slo.enabled:
                 slo.observe_request(
                     ttft_s=(max(0.0, first_flush - t0)
                             if first_flush is not None else None),
-                    ok=True,
+                    ok=True, tenant=tenant,
                 )
             summary = {
                 "usage": {
@@ -1334,6 +1386,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             t0 = time.monotonic()
             fut = None
             parent = remote_parent_from_headers(self.headers)
+            tenant, priority = self._tenant_of()
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 try:
@@ -1365,6 +1418,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                         lambda: queue.submit(
                             [prompt_ids], max_toks,
                             coalesce_key=None, deadline_s=deadline_s,
+                            tenant=tenant, priority=priority,
                         ),
                         t0,
                     )
@@ -1384,13 +1438,22 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     fwd_left = fwd_deadline - (time.monotonic() - t0)
                     if fwd_left <= 0:
                         _record_request_span(reg, recorder, t0, fut, 503)
-                        _slo_observe(503, fut, t0)
+                        _slo_observe(503, fut, t0, tenant=tenant)
                         return self._json(503, {
                             "error": "deadline exhausted after prefill "
                                      "export (forward ticket spent)",
                         })
+                    fwd_tenant = {
+                        h: v for h, v in (
+                            (TENANT_HEADER,
+                             self.headers.get(TENANT_HEADER)),
+                            (PRIORITY_HEADER,
+                             self.headers.get(PRIORITY_HEADER)),
+                        ) if v
+                    }
                     code, body, ctype, headers = _direct_handoff(
-                        payload, fwd_url, fwd_left, parent=parent
+                        payload, fwd_url, fwd_left, parent=parent,
+                        extra_headers=fwd_tenant,
                     )
                     latency_hist.observe(time.monotonic() - t0)
                     _record_request_span(reg, recorder, t0, fut, code)
@@ -1400,7 +1463,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     # must not spend the PREFILL SLO budget: the breach
                     # signal is always live, and burning it here would
                     # scale the prefill pool on decode-pool failures
-                    _slo_observe(200 if code >= 500 else code, fut, t0)
+                    _slo_observe(200 if code >= 500 else code, fut, t0,
+                                 tenant=tenant)
                     # append THIS replica's summary to the decode leg's
                     # (carried back by _direct_handoff): one relayed
                     # header stitches both legs at the router
@@ -1411,14 +1475,14 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     return self._send(code, body, ctype, headers)
                 latency_hist.observe(time.monotonic() - t0)
                 _record_request_span(reg, recorder, t0, fut, 200)
-                _slo_observe(200, fut, t0)
+                _slo_observe(200, fut, t0, tenant=tenant)
                 return self._send(
                     200, payload, "application/octet-stream",
                     headers=self._span_headers(fut, parent),
                 )
             except Exception as e:  # noqa: BLE001 — last-resort guard
                 _record_request_span(reg, recorder, t0, fut, 500)
-                _slo_observe(500, fut, t0)
+                _slo_observe(500, fut, t0, tenant=tenant)
                 return self._json(500, {"error": str(e)})
             finally:
                 in_flight_gauge.add(-1)
@@ -1435,6 +1499,7 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             t0 = time.monotonic()
             fut = None
             parent = remote_parent_from_headers(self.headers)
+            tenant, priority = self._tenant_of()
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
@@ -1458,7 +1523,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 with remote_parent(parent):
                     fut = self._submit_guarded(
                         lambda: queue.submit_handoff(
-                            meta, arrays, deadline_s=deadline_s
+                            meta, arrays, deadline_s=deadline_s,
+                            tenant=tenant, priority=priority,
                         ),
                         t0,
                     )
@@ -1474,12 +1540,12 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 _record_request_span(
                     reg, recorder, t0, fut, 200, tokens=len(rows[0])
                 )
-                _slo_observe(200, fut, t0)
+                _slo_observe(200, fut, t0, tenant=tenant)
                 return self._json(200, payload,
                                   headers=self._span_headers(fut, parent))
             except Exception as e:  # noqa: BLE001 — last-resort guard
                 _record_request_span(reg, recorder, t0, fut, 500)
-                _slo_observe(500, fut, t0)
+                _slo_observe(500, fut, t0, tenant=tenant)
                 return self._json(500, {"error": str(e)})
             finally:
                 in_flight_gauge.add(-1)
@@ -1842,6 +1908,16 @@ def main(argv=None):
                     help="stable identity for the /healthz identity "
                     "block (default host:port) — how tools/router.py "
                     "and humans tell replicas apart")
+    ap.add_argument("--tenants", default="",
+                    help="per-tenant weight/quota config JSON "
+                    "(docs/serving.md 'Multi-tenant isolation'); the "
+                    "scheduler serves tenants deficit-round-robin by "
+                    "weight; unset = one anonymous tenant, FCFS")
+    ap.add_argument("--preempt-min-tokens", type=int, default=8,
+                    help="protected minimum progress: an active row "
+                    "must have committed at least this many tokens "
+                    "since its last admission before a higher-priority "
+                    "arrival may preempt it")
     ap.add_argument("--compile-cache-dir", default="",
                     help="seed jax's persistent compilation cache from "
                     "this directory (warm boot: a scale-up replica "
@@ -1951,6 +2027,8 @@ def main(argv=None):
             ),
             role=args.role,
             replica_id=args.replica_id,
+            tenants_path=args.tenants,
+            preempt_min_tokens=args.preempt_min_tokens,
         )
 
     # REPL: one prompt per line -> completion (ids mode when no tokenizer)
