@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel test-fleet-obs test-decode-overlap test-kv-tier test-tenant bench bench-check
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel test-fleet-obs test-decode-overlap test-kv-tier test-tenant test-ha bench bench-check
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -19,7 +19,8 @@ FAST_FILES = tests/test_config.py tests/test_tokenizer.py tests/test_data.py \
              tests/test_bench_helpers.py tests/test_bench_cases.py \
              tests/test_router.py tests/test_controller.py \
              tests/test_prefix_cache.py tests/test_shard_map_compat.py \
-             tests/test_fleet_obs.py tests/test_tenancy.py
+             tests/test_fleet_obs.py tests/test_tenancy.py \
+             tests/test_fleet_journal.py
 
 # lint runs inside the gate via tests/test_lint.py::test_repo_is_clean
 test-fast:
@@ -173,6 +174,14 @@ test-router:
 # plane")
 test-elastic:
 	python -m pytest tests/test_controller.py tests/test_router.py tests/test_elastic_drills.py -q
+
+# control-plane survivability: fleet-journal units (torn-tail fuzz,
+# replay exact-fold, adoption identity, tenant bucket restore) + the
+# SIGKILL-the-router / journal-loss chaos drills
+# (docs/serving.md "Control-plane recovery")
+test-ha:
+	python -m pytest tests/test_fleet_journal.py -q
+	python -m pytest tests/test_ha_drills.py -q
 
 # disaggregated-fabric gate: role-aware pool-supervision units +
 # handoff-failover/direct-transfer units (stub replicas, no model), the

@@ -45,8 +45,11 @@ scale-up -> recovery, crash-loop -> loud quarantine.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 import os
 import shlex
+import signal
 import subprocess
 import threading
 import time
@@ -61,6 +64,42 @@ from paddlefleetx_tpu.utils.telemetry import (
 )
 
 CONTROLLER_LOG_CAP_ENV = "PFX_CONTROLLER_LOG_CAP"
+
+
+def _cmd_hash(cmd: List[str]) -> str:
+    """Short stable hash of a spawn command — the fleet journal records
+    it per slot so re-adoption can recognize OUR replica build in
+    /proc/<pid>/cmdline (corpse reaping) without journaling the full
+    command line."""
+    return hashlib.sha256(" ".join(cmd).encode()).hexdigest()[:12]
+
+
+def _pid_alive(pid: int) -> bool:
+    """Signal-0 liveness probe (PermissionError means alive but not
+    ours — treated alive: we must never respawn onto its port)."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
+
+
+def _proc_cmd_hash(pid: int) -> Optional[str]:
+    """The live process's spawn-command hash via /proc (None when the
+    process is gone or the platform has no /proc) — the only safe way
+    to recognize a journaled pid after the parent died: pid alone may
+    have been recycled by an unrelated process."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            raw = f.read()
+    except OSError:
+        return None
+    parts = [p.decode("utf-8", "replace") for p in raw.split(b"\0") if p]
+    return _cmd_hash(parts) if parts else None
 
 
 @dataclasses.dataclass
@@ -170,6 +209,17 @@ class ManagedReplica:
     flap_exempt: bool = False            # pending respawn spends no flap
     last_exit_rc: Optional[int] = None
     started_t: float = 0.0
+    # re-adoption (docs/serving.md "Control-plane recovery"): a replica
+    # spawned by a PREVIOUS router incarnation and re-adopted at boot is
+    # not our child — no Popen handle, so liveness is signal-0 on the
+    # pid and identity is the /healthz boot_id captured at adoption
+    adopted_pid: Optional[int] = None
+    adopted_boot_id: Optional[str] = None
+
+    def pid(self) -> Optional[int]:
+        if self.proc is not None:
+            return self.proc.pid
+        return self.adopted_pid
 
     def view(self) -> Dict[str, Any]:
         return {
@@ -178,7 +228,9 @@ class ManagedReplica:
             "port": self.port,
             "url": self.url,
             "key": self.key,
-            "pid": self.proc.pid if self.proc is not None else None,
+            "pid": self.pid(),
+            "cmd_hash": _cmd_hash(self.cmd),
+            "adopted": self.adopted_pid is not None,
             "desired": self.desired,
             "quarantined": self.quarantined,
             "restarts": self.restarts,
@@ -240,6 +292,11 @@ class ReplicaSupervisor:
         self.env = dict(env) if env is not None else None
         self._spawn_fn = spawn_fn
         self._registry = registry or get_registry()
+        # optional control-plane journal (core.router.FleetJournal —
+        # tools/router.py wires one): slot facts land in it BEFORE the
+        # child process exists, so there is no window where a spawned
+        # replica is untracked and unadoptable
+        self.journal: Optional[Any] = None
         self.slots: Dict[int, ManagedReplica] = {}
         # guards the slots DICT (inserted by the control thread, read
         # by HTTP handler threads via views()/counts — an unguarded
@@ -273,7 +330,24 @@ class ReplicaSupervisor:
         with self._lock:
             return [m for _, m in sorted(self.slots.items())]
 
+    def _journal_slot(self, m: ManagedReplica, phase: str,
+                      pid: Optional[int], boot_id: Optional[str] = None
+                      ) -> None:
+        j = self.journal
+        if j is not None:
+            j.record("slot", pool=self.role, slot=m.slot, port=m.port,
+                     url=m.url, rid=m.rid, cmd_hash=_cmd_hash(m.cmd),
+                     phase=phase, pid=pid, boot_id=boot_id)
+
     def _spawn(self, m: ManagedReplica, now: float) -> None:
+        # the "spawning" record lands BEFORE the child exists: if the
+        # router dies between this append and the Popen returning, the
+        # next boot still knows the slot/port/cmd_hash and can adopt or
+        # reap whatever the half-spawn left behind (satellite: no
+        # untracked-child window)
+        m.adopted_pid = None
+        m.adopted_boot_id = None
+        self._journal_slot(m, "spawning", None)
         if self._spawn_fn is not None:
             m.proc = self._spawn_fn(m)
         else:
@@ -291,6 +365,7 @@ class ReplicaSupervisor:
                 out.close()  # the child holds its own fd now
         m.started_t = now
         m.next_restart_t = 0.0
+        self._journal_slot(m, "spawned", m.proc.pid)
         logger.info(
             f"supervisor: spawned replica {m.rid} "
             f"(pid {m.proc.pid}, port {m.port})"
@@ -327,6 +402,118 @@ class ReplicaSupervisor:
             desired += 1
         return started
 
+    # -- fleet re-adoption (docs/serving.md "Control-plane recovery") ----
+    def _probe_identity(self, url: str, timeout: float
+                        ) -> Optional[Dict[str, Any]]:
+        """GET /healthz on a slot's port -> its identity block, or None
+        when nothing answers (import is deferred: core.router is jax-free
+        but the supervisor must stay importable standalone)."""
+        from paddlefleetx_tpu.core.router import _http_request
+        try:
+            status, body, _, _ = _http_request(
+                url, "GET", "/healthz", timeout=timeout)
+            if status != 200:
+                return None
+            h = json.loads(body)
+            return h.get("identity") or {}
+        except Exception:  # noqa: BLE001 — any failure means no replica
+            return None
+
+    def adopt(self, slot_facts: Dict[str, Any], *,
+              probe_timeout_s: float = 2.0) -> List[ManagedReplica]:
+        """Reconcile journaled slot facts against what is actually
+        running (the Borg/Pathways reconcile step, PR 19): probe each
+        recorded slot's port, and a live replica whose /healthz identity
+        matches the journal (replica_id + pid + boot_id — never bare
+        pid) is RE-ADOPTED into its slot with zero restarts and no flap
+        budget spent.  A port answering with the WRONG identity is a
+        squatter — the slot is quarantined loudly rather than spawned
+        into a bind collision.  A journaled pid that is alive but not
+        answering is reaped ONLY when /proc/<pid>/cmdline hashes to the
+        slot's recorded spawn command (a recycled pid never gets our
+        SIGKILL).  Slots left empty respawn through the normal
+        ``ensure`` path.  With an EMPTY fact for a slot (journal lost),
+        a live replica answering with the slot's own replica_id is
+        still adopted — the probe on OUR port reporting OUR replica_id
+        is the identity match.  Returns the newly adopted slots (the
+        controller registers their urls like freshly spawned ones)."""
+        adopted: List[ManagedReplica] = []
+        now = time.monotonic()
+        for slot_key, fact in sorted(
+                (slot_facts or {}).items(), key=lambda kv: str(kv[0])):
+            try:
+                i = int(slot_key)
+            except (TypeError, ValueError):
+                continue
+            if not (0 <= i < self.max_replicas):
+                continue
+            fact = fact if isinstance(fact, dict) else {}
+            m = self._slot(i)
+            if m.proc is not None or m.adopted_pid is not None:
+                continue
+            ident = self._probe_identity(m.url, probe_timeout_s)
+            if ident is None:
+                # nothing answering: if the journaled pid is still alive
+                # AND provably ours (cmdline hash), it is a wedged corpse
+                # from the dead router — reap it so ensure() can respawn
+                # onto the port
+                pid = fact.get("pid")
+                if (isinstance(pid, int) and pid > 0 and _pid_alive(pid)
+                        and fact.get("cmd_hash")
+                        and _proc_cmd_hash(pid) == fact.get("cmd_hash")):
+                    logger.warning(
+                        f"supervisor: reaping stale replica corpse "
+                        f"{m.rid} (pid {pid} alive but /healthz silent; "
+                        "cmdline matches the journaled spawn command)")
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except OSError:
+                        pass
+                continue
+            live_pid = ident.get("pid")
+            live_boot = ident.get("boot_id")
+            rid_ok = ident.get("replica_id") == m.rid
+            if fact.get("pid") is not None or fact.get("boot_id"):
+                # journal has identity facts: the FULL triple must match
+                match = (rid_ok and live_pid == fact.get("pid")
+                         and (not fact.get("boot_id")
+                              or live_boot == fact.get("boot_id")))
+            else:
+                # journal lost/stale (self-registration rebuild path):
+                # the process answering on our slot's port with our
+                # replica_id IS the identity match
+                match = rid_ok
+            if not match:
+                m.quarantined = True
+                logger.error(
+                    f"QUARANTINE: slot {i} (port {m.port}) is held by a "
+                    f"process whose identity does not match "
+                    f"(journal pid={fact.get('pid')} "
+                    f"boot_id={fact.get('boot_id')}; live "
+                    f"pid={live_pid} boot_id={live_boot} "
+                    f"replica_id={ident.get('replica_id')!r}); NOT "
+                    "spawning into a bind collision — free the port and "
+                    "restart the control plane")
+                continue
+            m.desired = True
+            m.adopted_pid = int(live_pid) if live_pid is not None else None
+            m.adopted_boot_id = live_boot
+            m.started_t = now
+            m.next_restart_t = 0.0
+            m.last_exit_rc = None
+            adopted.append(m)
+            self._registry.counter(
+                "pfx_router_adopted_replicas_total", replica=m.rid
+            ).inc()
+            self._journal_slot(m, "adopted", m.adopted_pid,
+                               m.adopted_boot_id)
+            logger.info(
+                f"supervisor: re-adopted replica {m.rid} "
+                f"(pid {m.adopted_pid}, port {m.port}, "
+                f"boot_id {m.adopted_boot_id}) — zero restarts, no flap "
+                "budget spent")
+        return adopted
+
     def drain_slot(self, slot: int) -> ManagedReplica:
         """Mark a slot's exit EXPECTED (scale-down): the supervisor will
         not restart it.  The actual drain goes through the router's
@@ -350,6 +537,30 @@ class ReplicaSupervisor:
         backoff restarts, quarantine crash-loopers loudly."""
         now = time.monotonic() if now is None else now
         for m in self._snapshot():
+            if m.proc is None and m.adopted_pid is not None:
+                # adopted replicas are not our children: liveness is
+                # signal-0, and an exit's rc is unobservable — treat it
+                # like a clean out-of-band drain (flap budget untouched)
+                # and respawn if still desired
+                if _pid_alive(m.adopted_pid):
+                    continue
+                pid = m.adopted_pid
+                m.adopted_pid = None
+                m.adopted_boot_id = None
+                m.last_exit_rc = None
+                if not m.desired or m.quarantined:
+                    logger.info(
+                        f"supervisor: adopted replica {m.rid} "
+                        f"(pid {pid}) exited (expected: drained)")
+                    continue
+                m.flap_exempt = True
+                m.next_restart_t = now + self.backoff_base_s
+                logger.info(
+                    f"supervisor: adopted replica {m.rid} (pid {pid}) "
+                    f"exited (rc unobservable — not our child); "
+                    f"respawning in {self.backoff_base_s:.2f}s "
+                    "(flap budget not spent)")
+                continue
             if m.proc is not None:
                 rc = m.proc.poll()
                 if rc is None:
@@ -445,17 +656,35 @@ class ReplicaSupervisor:
                     m.proc.kill()
                 except OSError:
                     pass
+            elif m.adopted_pid is not None:
+                try:
+                    os.kill(m.adopted_pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                m.adopted_pid = None
+                m.adopted_boot_id = None
 
     def stop_all(self, timeout: float = 30.0) -> None:
         """Graceful teardown: SIGTERM every live child (each drains via
-        the PR 3 contract and exits 0), kill stragglers."""
+        the PR 3 contract and exits 0), kill stragglers.  Adopted
+        replicas (not our children — no Popen handle) get the same
+        SIGTERM and a signal-0 liveness wait."""
         live = [m for m in self._snapshot() if m.proc is not None]
+        adopted = [m for m in self._snapshot()
+                   if m.proc is None and m.adopted_pid is not None]
         for m in live:
             m.desired = False
             try:
                 m.proc.terminate()
             except OSError:
                 pass
+        for m in adopted:
+            m.desired = False
+            try:
+                os.kill(m.adopted_pid, signal.SIGTERM)
+            except OSError:
+                m.adopted_pid = None
+                m.adopted_boot_id = None
         deadline = time.monotonic() + timeout
         for m in live:
             if m.proc is None:
@@ -474,6 +703,22 @@ class ReplicaSupervisor:
                 except subprocess.TimeoutExpired:
                     pass
             m.proc = None
+        for m in adopted:
+            if m.adopted_pid is None:
+                continue
+            while (_pid_alive(m.adopted_pid)
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+            if _pid_alive(m.adopted_pid):
+                logger.warning(
+                    f"supervisor: adopted replica {m.rid} ignored "
+                    f"SIGTERM for {timeout:g}s; killing")
+                try:
+                    os.kill(m.adopted_pid, signal.SIGKILL)
+                except OSError:
+                    pass
+            m.adopted_pid = None
+            m.adopted_boot_id = None
 
 
 class ElasticController:
@@ -520,6 +765,10 @@ class ElasticController:
         self._last_up_t = float("-inf")
         self._last_scale_t = float("-inf")
         self._idle_since: Optional[float] = None
+        # optional control-plane journal (core.router.FleetJournal):
+        # every tick's decision + clock AGES land in it so a restarted
+        # router resumes cooldowns instead of insta-rescaling
+        self.journal: Optional[Any] = None
         self._at_max_warned = False
         self._no_slot_warned = False
         self._thread = None
@@ -698,6 +947,22 @@ class ElasticController:
         }
         with self._log_lock:
             self.decision_log.append(row)
+        j = self.journal
+        if j is not None:
+            # ages, not clock values: monotonic clocks never cross a
+            # process boundary — restore_clocks rebases them as
+            # new_now - (age + death window)
+            j.record(
+                "scale", pool=self.role, action=action, reason=reason,
+                target=self.target, tick=self._seq, serving=len(serving),
+                up_age_s=(round(now - self._last_up_t, 3)
+                          if self._last_up_t != float("-inf") else None),
+                scale_age_s=(round(now - self._last_scale_t, 3)
+                             if self._last_scale_t != float("-inf")
+                             else None),
+                idle_for_s=(round(now - self._idle_since, 3)
+                            if self._idle_since is not None else None),
+            )
         self._ticks.inc()
         if action == "scale_up":
             self._ups.inc()
@@ -706,6 +971,70 @@ class ElasticController:
         self._target_gauge.set(float(self.target))
         self._breach_gauge.set(1.0 if pressure else 0.0)
         return row
+
+    def journal_state(self) -> Dict[str, Any]:
+        """This controller's journal-snapshot row — the same age-based
+        clock encoding tick()'s ``scale`` records use, consumed by
+        :meth:`restore_clocks` on the next boot."""
+        now = time.monotonic()
+        return {
+            "target": self.target,
+            "tick": self._seq,
+            "up_age_s": (round(now - self._last_up_t, 3)
+                         if self._last_up_t != float("-inf") else None),
+            "scale_age_s": (round(now - self._last_scale_t, 3)
+                            if self._last_scale_t != float("-inf")
+                            else None),
+            "idle_for_s": (round(now - self._idle_since, 3)
+                           if self._idle_since is not None else None),
+        }
+
+    def restore_clocks(self, *, target: Optional[int] = None,
+                       tick: Optional[int] = None,
+                       up_age_s: Optional[float] = None,
+                       scale_age_s: Optional[float] = None,
+                       extra_age_s: float = 0.0) -> None:
+        """Resume from a journaled ``scale`` record (router restart):
+        the target is clamped into the current policy's bounds, the tick
+        sequence continues instead of restarting at 0, and the cooldown
+        clocks rebase as ``now - (journaled age + extra_age_s)`` where
+        ``extra_age_s`` is the death window — real wall time passed, so
+        cooldowns neither reset (which would allow an instant re-scale)
+        nor freeze.  The idle dwell is deliberately NOT restored:
+        idleness was not observed across the death window, and a restart
+        must never open with a scale-down."""
+        now = time.monotonic()
+        p = self.policy
+        extra = max(0.0, float(extra_age_s))
+        if target is not None:
+            try:
+                self.target = max(p.min_replicas,
+                                  min(p.max_replicas, int(target)))
+            except (TypeError, ValueError):
+                pass
+        if tick is not None:
+            try:
+                self._seq = max(self._seq, int(tick))
+            except (TypeError, ValueError):
+                pass
+        if up_age_s is not None:
+            try:
+                self._last_up_t = now - (max(0.0, float(up_age_s))
+                                         + extra)
+            except (TypeError, ValueError):
+                pass
+        if scale_age_s is not None:
+            try:
+                self._last_scale_t = now - (max(0.0, float(scale_age_s))
+                                            + extra)
+            except (TypeError, ValueError):
+                pass
+        self._idle_since = None
+        self._target_gauge.set(float(self.target))
+        logger.info(
+            f"controller[{self.role}]: clocks restored from the fleet "
+            f"journal (target {self.target}, tick {self._seq}, death "
+            f"window {extra:.1f}s)")
 
     def view(self) -> Dict[str, Any]:
         """Operator snapshot for GET /debug/controller (auth-gated)."""

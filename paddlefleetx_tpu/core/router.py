@@ -76,6 +76,7 @@ from paddlefleetx_tpu.core.tenancy import (
 from paddlefleetx_tpu.utils.log import logger
 from paddlefleetx_tpu.utils.telemetry import (
     _env_int,
+    atomic_artifact_write,
     get_registry,
     parse_exposition,
 )
@@ -199,6 +200,12 @@ class Replica:
     # from the /healthz identity block (tools/serve.py)
     replica_id: Optional[str] = None
     pid: Optional[int] = None
+    # boot_id is random per PROCESS START: pid+boot_id names one process
+    # incarnation, so adoption and the legacy drain-by-pid fallback can
+    # never signal a recycled pid (docs/serving.md "Control-plane
+    # recovery"); started_at is the incarnation's wall-clock birth
+    boot_id: Optional[str] = None
+    started_at: Optional[float] = None
     scheduler: Optional[str] = None
     # last poll view
     healthy: bool = False   # healthz ok (False while degraded)
@@ -244,6 +251,8 @@ class Replica:
             "state": self.state,
             "replica_id": self.replica_id,
             "pid": self.pid,
+            "boot_id": self.boot_id,
+            "started_at": self.started_at,
             "scheduler": self.scheduler,
             "healthy": self.healthy,
             "eligible": self.eligible(),
@@ -589,6 +598,247 @@ _FLEET_SAMPLE_FIELDS = {
     "migrate_failed_total": ("pfx_migrate_failed_total", {}),
 }
 
+# ---------------------------------------------------------------------------
+# crash-consistent control-plane journal (docs/serving.md "Control-plane
+# recovery"): the registry, supervisor slot table, controller clocks, and
+# tenant quota buckets all live in router memory — FleetJournal makes them
+# survive the router.  Same durability recipe the flight artifacts use:
+# every record is one complete JSON line appended to
+# <PFX_FLIGHT_DIR>/fleet_state.jsonl; every `snapshot_every` records the
+# file is REWRITTEN atomically (`atomic_artifact_write`) as one compacted
+# full-state snapshot line, so the journal is bounded and any prefix of it
+# replays to a valid (if slightly stale) control-plane view.  A torn tail
+# — the router died mid-append — is a loud note and a safe partial
+# recovery, never a crash and never a phantom replica.
+# ---------------------------------------------------------------------------
+
+FLEET_JOURNAL_SNAPSHOT_EVERY_ENV = "PFX_JOURNAL_SNAPSHOT_EVERY"
+
+
+class FleetJournal:
+    """Append log + periodic compacted snapshot of the control plane.
+
+    Record kinds (each one JSON line with ``ts`` wall-clock + ``kind``):
+
+    - ``replica``  — registry add / state transition (key, url, role,
+      state, why, and the /healthz identity triple replica_id/pid/boot_id)
+    - ``slot``     — supervisor slot fact (pool, slot, port, url, rid,
+      cmd_hash, pid, boot_id, phase ``spawning|spawned|adopted``); the
+      ``spawning`` record lands BEFORE the child process exists, so no
+      window exists where a spawned replica is untracked and unadoptable
+    - ``scale``    — controller decision + clock AGES (``up_age_s`` etc.
+      are ``now_monotonic - clock`` at record time: monotonic clocks
+      never cross a process boundary, ages + the death window do)
+    - ``tenants``  — tenant bucket/in-flight snapshot (rate-limited)
+    - ``snapshot`` — compaction: the full state a fresh replay starts from
+
+    Appends happen under callers' registry locks (core -> journal lock
+    order); compaction reads live state via ``snapshot_fn`` and therefore
+    runs ONLY from :meth:`maybe_compact` on the poll thread, which holds
+    no core lock.  Journal gauges are exposed via ``collect()``
+    (registry -> journal order), never pushed from ``record()``."""
+
+    def __init__(self, path: str, snapshot_every: Optional[int] = None
+                 ) -> None:
+        self.path = path
+        self.snapshot_every = (
+            _env_int(FLEET_JOURNAL_SNAPSHOT_EVERY_ENV, 256)
+            if snapshot_every is None else int(snapshot_every))
+        self._lock = threading.Lock()
+        self._warned = False
+        self._since_snapshot = 0
+        self._bytes = 0
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            pass
+        self._snapshot_fn = None  # () -> full-state dict (tools/router.py)
+        get_registry().register_collector(self)
+
+    def set_snapshot_fn(self, fn) -> None:
+        self._snapshot_fn = fn
+
+    def collect(self) -> List[Tuple[str, Dict[str, str], float]]:
+        with self._lock:
+            return [
+                ("pfx_router_journal_records", {},
+                 float(self._since_snapshot)),
+                ("pfx_router_journal_bytes", {}, float(self._bytes)),
+            ]
+
+    def record(self, kind: str, **fields: Any) -> None:
+        """Append one record.  Never raises (a dead disk must not take
+        the control plane with it — warn once and keep serving)."""
+        row: Dict[str, Any] = {"ts": round(time.time(), 3), "kind": kind}
+        row.update(fields)
+        line = json.dumps(row, default=str) + "\n"
+        with self._lock:
+            try:
+                d = os.path.dirname(os.path.abspath(self.path))
+                os.makedirs(d, exist_ok=True)
+                with open(self.path, "a") as f:
+                    f.write(line)
+            except OSError as e:
+                if not self._warned:
+                    self._warned = True
+                    logger.warning(
+                        f"fleet journal write to {self.path} failed: {e} "
+                        "— control-plane state will NOT survive this "
+                        "router (recovery falls back to /admin/register "
+                        "heartbeats)")
+                return
+            self._since_snapshot += 1
+            self._bytes += len(line)
+
+    def maybe_compact(self, force: bool = False) -> bool:
+        """Rewrite the journal as one snapshot line when the append tail
+        is due.  Called off the poll loop ONLY — ``snapshot_fn`` reads
+        live registry/controller/tenant state, so it must run on a
+        thread holding no core or registry lock.  A record racing the
+        atomic swap is superseded by the snapshot it raced (the snapshot
+        is built from live state); at worst the journal is one
+        transition stale until the next compaction."""
+        fn = self._snapshot_fn
+        if fn is None:
+            return False
+        with self._lock:
+            due = force or (self.snapshot_every > 0
+                            and self._since_snapshot >= self.snapshot_every)
+        if not due:
+            return False
+        try:
+            state = fn()
+        except Exception as e:  # noqa: BLE001 — snapshot is best-effort
+            logger.warning(f"fleet journal snapshot build failed: {e}")
+            return False
+        row = {"ts": round(time.time(), 3), "kind": "snapshot",
+               "state": state}
+        line = json.dumps(row, default=str) + "\n"
+        with self._lock:
+            if not atomic_artifact_write(
+                    self.path, lambda f: f.write(line)):
+                return False
+            self._since_snapshot = 0
+            self._bytes = len(line)
+        return True
+
+
+def read_fleet_journal(path: str
+                       ) -> Tuple[List[Dict[str, Any]], Optional[str]]:
+    """Load a fleet journal -> ``(records, note)``.
+
+    ``note`` is None for a clean read; a torn or corrupt line makes it a
+    loud human sentence and truncates the record list THERE — everything
+    before the tear is trusted, everything after it is dropped (ordering
+    past a corrupt line cannot be trusted, and a half-written JSON
+    object must never become a phantom replica).  A missing file is an
+    empty journal, not an error."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return [], None
+    records: List[Dict[str, Any]] = []
+    note: Optional[str] = None
+    lines = data.split(b"\n")
+    for i, ln in enumerate(lines):
+        if not ln.strip():
+            continue
+        try:
+            obj = json.loads(ln.decode("utf-8"))
+            if not isinstance(obj, dict) or "kind" not in obj:
+                raise ValueError("not a journal record")
+        except (ValueError, UnicodeDecodeError):
+            dropped = sum(1 for rest in lines[i:] if rest.strip())
+            note = (f"fleet journal {path}: torn/corrupt record at line "
+                    f"{i + 1}; recovered {len(records)} record(s), "
+                    f"dropped {dropped} from the tail")
+            logger.warning(note)
+            break
+        records.append(obj)
+    return records, note
+
+
+def replay_fleet_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold journal records into the control-plane view they describe.
+
+    The PR 8/11/12 replay contract, control-plane edition: recovery
+    CONSUMES this function's output (tools/router.py applies it to the
+    fresh registry/controller/tenant objects), so "replay equals the
+    recovered views" holds by construction and the drill only has to
+    compare this fold against the recovered router's HTTP surfaces.
+
+    Returns ``{"replicas": {key: {...}}, "slots": {pool: {slot: {...}}},
+    "controller": {pool: {...}}, "tenants": {"buckets", "in_flight"},
+    "wall": <ts of last folded record>, "records": n}``."""
+    state: Dict[str, Any] = {
+        "replicas": {}, "slots": {}, "controller": {},
+        "tenants": {"buckets": {}, "in_flight": {}},
+        "wall": None, "records": 0,
+    }
+    for rec in records:
+        kind = rec.get("kind")
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            state["wall"] = float(ts)
+        state["records"] += 1
+        if kind == "snapshot":
+            snap = rec.get("state") or {}
+            state["replicas"] = {
+                str(k): dict(v)
+                for k, v in (snap.get("replicas") or {}).items()
+                if isinstance(v, dict)}
+            state["slots"] = {
+                str(p): {str(s): dict(f) for s, f in pool.items()
+                         if isinstance(f, dict)}
+                for p, pool in (snap.get("slots") or {}).items()
+                if isinstance(pool, dict)}
+            state["controller"] = {
+                str(p): dict(v)
+                for p, v in (snap.get("controller") or {}).items()
+                if isinstance(v, dict)}
+            ten = snap.get("tenants") or {}
+            state["tenants"] = {
+                "buckets": dict(ten.get("buckets") or {}),
+                "in_flight": dict(ten.get("in_flight") or {}),
+            }
+        elif kind == "replica":
+            key = rec.get("key")
+            if not key:
+                continue
+            row = state["replicas"].setdefault(str(key), {})
+            for f in ("url", "role", "state", "why",
+                      "replica_id", "pid", "boot_id"):
+                if rec.get(f) is not None:
+                    row[f] = rec[f]
+        elif kind == "slot":
+            pool = str(rec.get("pool") or "monolith")
+            slot = rec.get("slot")
+            if slot is None:
+                continue
+            row = state["slots"].setdefault(pool, {}).setdefault(
+                str(slot), {})
+            for f in ("port", "url", "rid", "cmd_hash", "pid",
+                      "boot_id", "phase"):
+                if rec.get(f) is not None:
+                    row[f] = rec[f]
+        elif kind == "scale":
+            pool = str(rec.get("pool") or "monolith")
+            row = {}
+            for f in ("target", "tick", "action", "reason",
+                      "up_age_s", "scale_age_s", "idle_for_s"):
+                if rec.get(f) is not None:
+                    row[f] = rec[f]
+            row["wall"] = ts
+            state["controller"][pool] = row
+        elif kind == "tenants":
+            state["tenants"] = {
+                "buckets": dict(rec.get("buckets") or {}),
+                "in_flight": dict(rec.get("in_flight") or {}),
+            }
+    return state
+
+
 # prefix affinity is worth at most this many backlog units in `_score`:
 # enough to break a near-tie toward a warm cache, never enough to beat
 # a meaningfully shorter queue — and 5 orders of magnitude under the
@@ -695,6 +945,12 @@ class RouterCore:
         # optional fleet-observability artifact (tools/router.py wires
         # it in serve mode; library users opt in by assigning one)
         self.fleet_log: Optional[FleetLog] = None
+        # optional crash-consistent control-plane journal (tools/router.py
+        # wires one; docs/serving.md "Control-plane recovery").  Lock
+        # order: self._lock -> journal._lock — journal code never calls
+        # back into the router
+        self.journal: Optional[FleetJournal] = None
+        self._journal_last_tenants = 0.0
 
     # -- telemetry ------------------------------------------------------
     def collect(self):
@@ -750,6 +1006,10 @@ class RouterCore:
             # learns its topology from the registrations
             self.disaggregated = role != "monolith"
         logger.info(f"{self.name}: replica {key} registered ({url}, {role})")
+        j = self.journal
+        if j is not None:
+            j.record("replica", key=key, url=url, role=role,
+                     state="booting", why="registered")
         return key
 
     # -- health polling + lifecycle -------------------------------------
@@ -838,6 +1098,13 @@ class RouterCore:
             if ident:
                 r.replica_id = ident.get("replica_id", r.replica_id)
                 r.pid = ident.get("pid", r.pid)
+                r.boot_id = ident.get("boot_id", r.boot_id)
+                try:
+                    sa = ident.get("started_at")
+                    r.started_at = float(sa) if sa is not None \
+                        else r.started_at
+                except (TypeError, ValueError):
+                    pass
                 r.scheduler = ident.get("scheduler", r.scheduler)
                 reported = ident.get("role")
                 if reported and reported != r.role and not r.role_mismatch:
@@ -883,6 +1150,15 @@ class RouterCore:
                 f"{r.state} -> {state}: {why}"
             )
             r.state = state
+            j = self.journal
+            if j is not None:
+                # identity rides every transition record so replay can
+                # restore the pid/boot_id view without a separate stream
+                # (lock order core -> journal; record() never blocks on
+                # the registry)
+                j.record("replica", key=r.key, url=r.url, role=r.role,
+                         state=state, why=why, replica_id=r.replica_id,
+                         pid=r.pid, boot_id=r.boot_id)
             if state == "gone":
                 # a gone replica's federated series leave the scrape
                 # (they would otherwise re-export forever with growing
@@ -900,6 +1176,7 @@ class RouterCore:
             for r in list(self.replicas.values()):
                 self.poll_replica(r)
             self._fleet_sample()
+            self._journal_tick()
 
     def _fleet_sample(self) -> None:
         """One fleet-log sample after a poll sweep (rate-limited inside
@@ -923,6 +1200,111 @@ class RouterCore:
                 "tenants": self.tenant_snapshot(),
             },
         )
+
+    def _journal_tick(self) -> None:
+        """Periodic journal upkeep off the poll sweep: a rate-limited
+        tenant bucket/in-flight record, then compaction when the append
+        tail is due.  Runs HERE (poll thread, no core lock held) because
+        compaction reads live state via the snapshot provider — see
+        :meth:`FleetJournal.maybe_compact`."""
+        j = self.journal
+        if j is None:
+            return
+        now = time.monotonic()
+        if now - self._journal_last_tenants >= 1.0:
+            self._journal_last_tenants = now
+            j.record("tenants", **self.tenant_journal_snapshot())
+        j.maybe_compact()
+
+    def tenant_journal_snapshot(self) -> Dict[str, Any]:
+        """Tenant bucket + in-flight state for the fleet journal (the
+        shape ``restore_tenant_buckets`` folds back in; in-flight is
+        journaled for observability only — those requests die with the
+        router that admitted them)."""
+        return {
+            "buckets": self._tenant_admission.bucket_snapshot(),
+            "in_flight": self._tenant_admission.inflight_snapshot(),
+        }
+
+    # -- control-plane recovery (docs/serving.md "Control-plane
+    # recovery"): journal restore + replica self-registration ------------
+    def restore_tenant_buckets(self, buckets: Dict[str, Dict[str, float]],
+                               age_s: float = 0.0) -> int:
+        """Fold a journaled tenant bucket snapshot back into admission
+        (router restart): each bucket resumes from its recorded tokens
+        plus ``age_s`` seconds of refill — the death window earns
+        exactly the refill it would have earned, never a fresh burst
+        allowance.  Returns buckets restored."""
+        n = self._tenant_admission.restore_buckets(buckets or {},
+                                                   age_s=age_s)
+        if n:
+            logger.info(f"{self.name}: restored {n} tenant quota "
+                        f"bucket(s) from the fleet journal "
+                        f"(death window {age_s:.1f}s of refill)")
+        return n
+
+    def register_replica(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """One ``POST /admin/register`` heartbeat from a replica
+        (tools/serve.py ``--router-url``): idempotent add + identity
+        refresh, so a router restarted with a lost or stale journal
+        rediscovers its fleet from the replicas themselves.  A body with
+        ``deregister: true`` is the drain-exit goodbye — the replica is
+        walked to ``gone`` immediately instead of waiting out
+        ``eject_after`` failed polls, gated on an identity match so a
+        stale goodbye can never eject a redeployed successor.  Raises
+        ``ValueError`` on a malformed body (HTTP skin answers 400)."""
+        url = str(obj.get("url") or "").rstrip("/")
+        if not url or not urlsplit(url).netloc:
+            raise ValueError(
+                "register needs a base 'url' (http://host:port)")
+        ident = obj.get("identity")
+        if not isinstance(ident, dict):
+            ident = {}
+        if obj.get("deregister"):
+            with self._lock:
+                target = next((r for r in self.replicas.values()
+                               if r.url == url), None)
+                if target is None:
+                    return {"key": None, "state": "unknown"}
+                rid = ident.get("replica_id")
+                boot = ident.get("boot_id")
+                if ((rid and target.replica_id
+                     and rid != target.replica_id)
+                        or (boot and target.boot_id
+                            and boot != target.boot_id)):
+                    raise ValueError(
+                        f"deregister identity mismatch for {url}: "
+                        "a stale goodbye cannot eject the current "
+                        "process")
+                self._transition(target, "gone", "deregistered on drain")
+                key = target.key
+            get_registry().counter(
+                "pfx_replica_registrations_total", outcome="deregister"
+            ).inc()
+            return {"key": key, "state": "gone"}
+        role = str(obj.get("role") or "monolith")
+        key = self.add_replica(url, role)
+        with self._lock:
+            r = self.replicas[key]
+            if ident.get("replica_id"):
+                r.replica_id = str(ident["replica_id"])
+            if ident.get("pid") is not None:
+                try:
+                    r.pid = int(ident["pid"])
+                except (TypeError, ValueError):
+                    pass
+            if ident.get("boot_id"):
+                r.boot_id = str(ident["boot_id"])
+            if ident.get("started_at") is not None:
+                try:
+                    r.started_at = float(ident["started_at"])
+                except (TypeError, ValueError):
+                    pass
+            state = r.state
+        get_registry().counter(
+            "pfx_replica_registrations_total", outcome="register"
+        ).inc()
+        return {"key": key, "state": state}
 
     def start(self) -> "RouterCore":
         if self._poll_thread is None or not self._poll_thread.is_alive():
@@ -1634,6 +2016,11 @@ class RouterCore:
             pid = target.pid
             key = target.key
             url = target.url
+            # identity as recorded BEFORE the drain: the legacy SIGTERM
+            # fallback below must confirm the process answering on the
+            # url is still this incarnation before signalling its pid
+            rid_ident = target.replica_id
+            boot_ident = target.boot_id
             # surviving same-pool peers, least-loaded first: the drain
             # body names them so the draining replica can ship its
             # hottest cached prefixes to one before exiting (KV
@@ -1720,18 +2107,60 @@ class RouterCore:
             if pid is not None and _local_url(url):
                 # pre-/admin replica on THIS host: the legacy SIGTERM
                 # transport (a pid from another host must never be
-                # signalled here — it names an unrelated local process)
-                logger.warning(
-                    f"{self.name}: {key} has no /admin/drain (404); "
-                    f"falling back to SIGTERM on identity pid {pid} "
-                    "(same-host only)"
-                )
+                # signalled here — it names an unrelated local process).
+                # NEVER on the bare pid: a /healthz re-probe must confirm
+                # the process answering on the url is still the recorded
+                # incarnation (pid + replica_id + boot_id when published)
+                # — between the last poll and now the pid could have
+                # exited and been recycled by an unrelated process
+                confirmed = False
+                exited = False
                 try:
-                    os.kill(pid, signal.SIGTERM)
-                except ProcessLookupError:
+                    st2, body2, _, _ = _http_request(
+                        url, "GET", "/healthz",
+                        timeout=self.poll_timeout_s)
+                    ident2 = ((json.loads(body2) or {}).get("identity")
+                              or {}) if st2 == 200 else {}
+                    confirmed = (
+                        ident2.get("pid") == pid
+                        and (not rid_ident
+                             or ident2.get("replica_id")
+                             in (None, rid_ident))
+                        and (not boot_ident
+                             or ident2.get("boot_id")
+                             in (None, boot_ident)))
+                except ConnectionRefusedError:
+                    exited = True
+                except Exception:  # noqa: BLE001 — treat as unconfirmed
+                    confirmed = False
+                if exited:
                     with self._lock:
-                        self._transition(target, "gone",
-                                         "pid already exited")
+                        self._transition(
+                            target, "gone",
+                            "refused the identity re-probe: "
+                            "already exited")
+                elif not confirmed:
+                    _restore("identity re-probe mismatch")
+                    raise ValueError(
+                        f"replica {key} has no /admin/drain (404) and "
+                        f"the /healthz identity re-probe did not match "
+                        f"the recorded incarnation (pid {pid}, "
+                        f"boot_id {boot_ident}); refusing to SIGTERM a "
+                        "possibly-recycled pid — drain it on its own "
+                        "host"
+                    )
+                else:
+                    logger.warning(
+                        f"{self.name}: {key} has no /admin/drain (404); "
+                        f"falling back to SIGTERM on identity pid {pid} "
+                        "(same-host only, identity re-probe confirmed)"
+                    )
+                    try:
+                        os.kill(pid, signal.SIGTERM)
+                    except ProcessLookupError:
+                        with self._lock:
+                            self._transition(target, "gone",
+                                             "pid already exited")
             else:
                 _restore("no drain transport")
                 raise ValueError(

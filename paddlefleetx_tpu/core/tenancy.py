@@ -213,15 +213,21 @@ class TokenBucket:
         self.tokens = self.burst
         self._t_last: Optional[float] = None
 
-    def try_acquire(self, now: float) -> Tuple[bool, float]:
-        """Take one token.  Returns ``(ok, retry_after_s)`` where
-        ``retry_after_s`` is the ACTUAL time until the next whole token
-        refills (0.0 on success) — the honest Retry-After."""
+    def refill(self, now: float) -> None:
+        """Advance the refill clock to ``now`` without consuming — the
+        snapshot path uses it so journaled token counts are current as
+        of the snapshot instant, not as of the tenant's last request."""
         if self._t_last is None:
             self._t_last = now
         elapsed = max(0.0, now - self._t_last)
         self.tokens = min(self.burst, self.tokens + elapsed * self.rate)
         self._t_last = now
+
+    def try_acquire(self, now: float) -> Tuple[bool, float]:
+        """Take one token.  Returns ``(ok, retry_after_s)`` where
+        ``retry_after_s`` is the ACTUAL time until the next whole token
+        refills (0.0 on success) — the honest Retry-After."""
+        self.refill(now)
         if self.tokens >= 1.0:
             self.tokens -= 1.0
             return True, 0.0
@@ -280,6 +286,58 @@ class TenantAdmission:
     def inflight_snapshot(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._inflight)
+
+    # -- control-plane journal surface (core/router.py FleetJournal) ----
+    def bucket_snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant token-bucket state for the control-plane journal
+        (docs/serving.md "Control-plane recovery"): tokens are refilled
+        to NOW first, so the snapshot is current at the instant it is
+        taken and restorers only need the wall-clock age of the record
+        — the monotonic refill clock never leaves this process."""
+        with self._lock:
+            now = self._clock()
+            out: Dict[str, Dict[str, float]] = {}
+            for tn, b in self._buckets.items():
+                b.refill(now)
+                out[tn] = {
+                    "tokens": round(b.tokens, 6),
+                    "rate": b.rate,
+                    "burst": b.burst,
+                }
+            return out
+
+    def restore_buckets(self, buckets: Dict[str, Dict[str, float]],
+                        age_s: float = 0.0) -> int:
+        """Fold a journaled :meth:`bucket_snapshot` back in (router
+        restart): each tenant's bucket resumes from its recorded token
+        count plus ``age_s`` seconds of refill at the CURRENTLY
+        configured rate — the router's death window earns exactly the
+        refill it would have earned, never a fresh burst allowance
+        (that free window is the 429-storm hole this closes).  Tenants
+        whose current config no longer rate-limits are skipped (the
+        operator's new config wins); rate/burst come from the current
+        policy, not the journal, for the same reason.  Returns the
+        number of buckets restored."""
+        restored = 0
+        with self._lock:
+            now = self._clock()
+            for tn, snap in (buckets or {}).items():
+                pol = self.config.policy(str(tn))
+                if pol.rps is None:
+                    continue
+                try:
+                    tokens = float(snap.get("tokens", 0.0))
+                except (TypeError, ValueError, AttributeError):
+                    continue
+                b = TokenBucket(pol.rps, pol.burst)
+                b.tokens = min(
+                    b.burst,
+                    max(0.0, tokens) + max(0.0, float(age_s)) * b.rate,
+                )
+                b._t_last = now
+                self._buckets[str(tn)] = b
+                restored += 1
+        return restored
 
 
 class DeficitRoundRobin:

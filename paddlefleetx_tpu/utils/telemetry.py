@@ -219,6 +219,13 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_controller_breach": ("gauge", "1 while the controller sees a scale signal breached (SLO burn / depth / occupancy / low blocks; labels: pool on disaggregated pool controllers)"),
     "pfx_replica_restarts_total": ("counter", "Supervisor restarts of managed replicas after unexpected exits (labels: replica; only crashes spend the flap budget)"),
     "pfx_replica_quarantines_total": ("counter", "Managed replicas quarantined after crash-looping past the flap budget (labels: replica)"),
+    # control-plane survivability (core/router.py FleetJournal +
+    # tools/router.py recovery; docs/serving.md "Control-plane recovery")
+    "pfx_router_recoveries_total": ("counter", "Router boots that recovered control-plane state from the fleet journal (fleet_state.jsonl)"),
+    "pfx_router_adopted_replicas_total": ("counter", "Live replicas re-adopted into their supervised slots at boot without a respawn (labels: replica)"),
+    "pfx_router_journal_records": ("gauge", "Records appended to the fleet journal since its last compaction snapshot"),
+    "pfx_router_journal_bytes": ("gauge", "Bytes in the fleet journal file (compaction rewrites it atomically)"),
+    "pfx_replica_registrations_total": ("counter", "Replica self-registration heartbeats accepted at POST /admin/register (labels: outcome=register|deregister)"),
     # SLO burn rates (telemetry.SLOTracker; labels: objective, window)
     "pfx_slo_objective": ("gauge", "Configured SLO objective value by objective label"),
     "pfx_slo_burn_rate": ("gauge", "Error-budget burn rate over a rolling window (labels: objective, window)"),

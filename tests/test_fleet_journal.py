@@ -1,0 +1,607 @@
+"""Control-plane survivability units (`core/router.py` FleetJournal +
+`core/controller.py` adoption, docs/serving.md "Control-plane
+recovery"): the crash-consistent fleet journal (append / torn-tail
+read / compaction / replay exact-fold), tenant bucket snapshot-restore
+(no free burst window across a router death), supervisor re-adoption
+by identity triple (replica_id + pid + boot_id — never bare pid),
+controller clock restore, pre-spawn journaling order, and the
+/admin/register self-registration surface — all in-process (no jax, no
+model): the SIGKILL-the-router chaos drills live in
+tests/test_ha_drills.py.
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from paddlefleetx_tpu.core.controller import (
+    ElasticController,
+    ReplicaSupervisor,
+    ScalePolicy,
+    _cmd_hash,
+)
+from paddlefleetx_tpu.core.router import (
+    FleetJournal,
+    RouterCore,
+    read_fleet_journal,
+    replay_fleet_state,
+)
+from paddlefleetx_tpu.core.tenancy import TenantAdmission, TenantConfig
+from paddlefleetx_tpu.utils.telemetry import Registry
+
+
+def _journal(tmp_path, **kw):
+    return FleetJournal(str(tmp_path / "fleet_state.jsonl"), **kw)
+
+
+@contextmanager
+def _log_lines():
+    """Capture repo-logger messages (it prints, propagate=False — a
+    side handler is the only reliable tap under pytest's capture)."""
+    from paddlefleetx_tpu.utils.log import logger as pfx_logger
+
+    lines = []
+
+    class Sink(logging.Handler):
+        def emit(self, record):
+            lines.append(record.getMessage())
+
+    sink = Sink()
+    pfx_logger.addHandler(sink)
+    try:
+        yield lines
+    finally:
+        pfx_logger.removeHandler(sink)
+
+
+def _seed_records(j):
+    """A representative record mix (every kind the router writes)."""
+    j.record("replica", key="r0", url="http://127.0.0.1:9500",
+             role="monolith", state="booting", why="registered")
+    j.record("slot", pool="monolith", slot=0, port=9500,
+             url="http://127.0.0.1:9500", rid="m0", cmd_hash="abc123def456",
+             phase="spawning", pid=None, boot_id=None)
+    j.record("slot", pool="monolith", slot=0, port=9500,
+             url="http://127.0.0.1:9500", rid="m0", cmd_hash="abc123def456",
+             phase="spawned", pid=4242, boot_id=None)
+    j.record("replica", key="r0", url="http://127.0.0.1:9500",
+             role="monolith", state="serving", why="healthy",
+             replica_id="m0", pid=4242, boot_id="b0b0")
+    j.record("scale", pool="monolith", action="hold", reason="steady",
+             target=1, tick=3, serving=1, up_age_s=5.0, scale_age_s=5.0,
+             idle_for_s=None)
+    j.record("tenants",
+             buckets={"gold": {"tokens": 1.5, "rate": 2.0, "burst": 4.0}},
+             in_flight={"gold": 2})
+
+
+# ---------------------------------------------------------------------------
+# journal: append / read / replay round trip
+# ---------------------------------------------------------------------------
+
+
+def test_record_read_replay_roundtrip(tmp_path):
+    j = _journal(tmp_path)
+    _seed_records(j)
+    records, note = read_fleet_journal(j.path)
+    assert note is None and len(records) == 6
+    st = replay_fleet_state(records)
+    assert st["records"] == 6
+    r0 = st["replicas"]["r0"]
+    assert r0["state"] == "serving" and r0["pid"] == 4242
+    assert r0["boot_id"] == "b0b0" and r0["replica_id"] == "m0"
+    slot = st["slots"]["monolith"]["0"]
+    assert slot["phase"] == "spawned" and slot["pid"] == 4242
+    assert slot["cmd_hash"] == "abc123def456"
+    ctl = st["controller"]["monolith"]
+    assert ctl["target"] == 1 and ctl["tick"] == 3
+    assert ctl["up_age_s"] == 5.0
+    assert st["tenants"]["buckets"]["gold"]["tokens"] == 1.5
+    assert st["tenants"]["in_flight"]["gold"] == 2
+    # wall clock advances with the records (recovery ages buckets by it)
+    assert st["wall"] == pytest.approx(time.time(), abs=30)
+
+
+def test_missing_journal_is_empty_not_an_error(tmp_path):
+    records, note = read_fleet_journal(str(tmp_path / "absent.jsonl"))
+    assert records == [] and note is None
+    assert replay_fleet_state([])["replicas"] == {}
+
+
+def test_journal_gauges_ride_collect(tmp_path):
+    j = _journal(tmp_path)
+    _seed_records(j)
+    got = dict((name, val) for name, _labels, val in j.collect())
+    assert got["pfx_router_journal_records"] == 6.0
+    assert got["pfx_router_journal_bytes"] == os.path.getsize(j.path)
+
+
+def test_compaction_preserves_replay_equivalence(tmp_path):
+    """THE compaction contract: replacing the append tail with one
+    snapshot line must replay to the identical control-plane view."""
+    j = _journal(tmp_path, snapshot_every=4)
+    _seed_records(j)
+    before = replay_fleet_state(read_fleet_journal(j.path)[0])
+    # the snapshot_fn hands back live state; here: the folded view
+    j.set_snapshot_fn(lambda: {
+        "replicas": before["replicas"], "slots": before["slots"],
+        "controller": before["controller"], "tenants": before["tenants"],
+    })
+    assert j.maybe_compact()  # 6 records >= snapshot_every=4 -> due
+    records, note = read_fleet_journal(j.path)
+    assert note is None
+    assert len(records) == 1 and records[0]["kind"] == "snapshot"
+    after = replay_fleet_state(records)
+    for part in ("replicas", "slots", "controller", "tenants"):
+        assert after[part] == before[part], part
+    # the append counter reset; the next compaction is not due yet
+    got = dict((name, val) for name, _labels, val in j.collect())
+    assert got["pfx_router_journal_records"] == 0.0
+    assert not j.maybe_compact()
+    assert j.maybe_compact(force=True)  # force ignores the cadence
+
+
+def test_compaction_without_snapshot_fn_is_a_noop(tmp_path):
+    j = _journal(tmp_path, snapshot_every=1)
+    _seed_records(j)
+    assert not j.maybe_compact(force=True)
+    assert len(read_fleet_journal(j.path)[0]) == 6
+
+
+def test_record_survives_unwritable_path(tmp_path):
+    """A dead disk must not take the control plane with it: record()
+    warns once and keeps serving."""
+    j = FleetJournal(str(tmp_path))  # a DIRECTORY: open(..., "a") fails
+    with _log_lines() as lines:
+        j.record("replica", key="r0", state="serving")
+        j.record("replica", key="r0", state="gone")
+    warns = [ln for ln in lines if "fleet journal write" in ln]
+    assert len(warns) == 1  # once, not per-record
+    assert "/admin/register" in warns[0]
+
+
+# ---------------------------------------------------------------------------
+# torn-tail + corruption fuzz (the PFXH1 idiom, control-plane edition)
+# ---------------------------------------------------------------------------
+
+
+def test_torn_tail_fuzz_truncation_at_every_byte(tmp_path):
+    """Truncate the journal at EVERY byte offset: the read never
+    raises, the recovered records are always a clean prefix, a torn
+    tail is a loud note — and a half-written record never becomes a
+    phantom replica."""
+    j = _journal(tmp_path)
+    _seed_records(j)
+    data = open(j.path, "rb").read()
+    full, _ = read_fleet_journal(j.path)
+    full_keys = set(replay_fleet_state(full)["replicas"])
+    torn = tmp_path / "torn.jsonl"
+    for cut in range(len(data) + 1):
+        torn.write_bytes(data[:cut])
+        records, note = read_fleet_journal(str(torn))
+        # a prefix, record-for-record — never a reordered or invented one
+        assert records == full[:len(records)], cut
+        # torn mid-record (some bytes past the last full line) -> loud
+        consumed = sum(
+            len(json.dumps(r, default=str)) + 1 for r in records)
+        if cut > consumed and data[consumed:cut].strip():
+            assert note is not None and "torn/corrupt" in note, cut
+        # no phantom replicas out of half-written JSON
+        assert set(replay_fleet_state(records)["replicas"]) <= full_keys
+
+
+def test_mid_file_corruption_truncates_at_the_tear(tmp_path):
+    """Bytes flipped MID-file: everything before the tear is trusted,
+    everything after it is dropped (ordering past a corrupt line cannot
+    be trusted), and the note says how much was lost."""
+    j = _journal(tmp_path)
+    _seed_records(j)
+    lines = open(j.path, "rb").read().splitlines(keepends=True)
+    lines[2] = b'{"kind": "slot", "pool": \xff\xfe GARBAGE\n'
+    open(j.path, "wb").write(b"".join(lines))
+    records, note = read_fleet_journal(j.path)
+    assert len(records) == 2
+    assert note is not None and "line 3" in note
+    assert "dropped 4" in note  # the corrupt line + the 3 after it
+    # a record that parses but is not a journal record is also a tear
+    lines[2] = b'[1, 2, 3]\n'
+    open(j.path, "wb").write(b"".join(lines))
+    records, note = read_fleet_journal(j.path)
+    assert len(records) == 2 and note is not None
+
+
+# ---------------------------------------------------------------------------
+# replay exact-fold against a LIVE RouterCore (the PR 8/11/12 contract)
+# ---------------------------------------------------------------------------
+
+
+def _core(tmp_path, **kw):
+    kw.setdefault("allow_empty", True)
+    core = RouterCore([], **kw)
+    core.journal = _journal(tmp_path)
+    return core
+
+
+def test_replay_folds_registry_transitions_exactly(tmp_path):
+    core = _core(tmp_path)
+    k0 = core.add_replica("http://127.0.0.1:9500")
+    k1 = core.add_replica("http://127.0.0.1:9501")
+    with core._lock:
+        r0 = core.replicas[k0]
+        r0.replica_id, r0.pid, r0.boot_id = "m0", 111, "boot-a"
+        core._transition(r0, "serving", "healthy")
+        r1 = core.replicas[k1]
+        core._transition(r1, "gone", "poll failures")
+    st = replay_fleet_state(read_fleet_journal(core.journal.path)[0])
+    views = {v["key"]: v for v in core.replica_views()}
+    assert set(st["replicas"]) == set(views) == {k0, k1}
+    for key, view in views.items():
+        fold = st["replicas"][key]
+        assert fold["state"] == view["state"], key
+        assert fold["url"] == view["url"], key
+    assert st["replicas"][k0]["pid"] == 111
+    assert st["replicas"][k0]["boot_id"] == "boot-a"
+
+
+def test_replay_folds_tenant_snapshot_and_restore_agrees(tmp_path):
+    cfg = TenantConfig.from_obj(
+        {"tenants": {"flood": {"rps": 2, "burst": 4}}})
+    core = _core(tmp_path, tenant_config=cfg)
+    for _ in range(3):
+        core.acquire("flood")
+        core.release("flood")
+    core.journal.record("tenants", **core.tenant_journal_snapshot())
+    st = replay_fleet_state(read_fleet_journal(core.journal.path)[0])
+    snap = st["tenants"]["buckets"]["flood"]
+    assert snap["rate"] == 2.0 and snap["burst"] == 4.0
+    assert snap["tokens"] < 4.0  # the spend is in the journal
+    # a fresh router restores the spend (age 0: no free refill)
+    core2 = _core(tmp_path, tenant_config=cfg, name="router2")
+    assert core2.restore_tenant_buckets(st["tenants"]["buckets"]) == 1
+    got = core2.tenant_journal_snapshot()["buckets"]["flood"]
+    assert got["tokens"] == pytest.approx(snap["tokens"], abs=0.1)
+
+
+# ---------------------------------------------------------------------------
+# tenant bucket restore semantics (the free-burst-window hole)
+# ---------------------------------------------------------------------------
+
+
+def test_restored_bucket_denies_the_free_burst_window():
+    """A flooding tenant drained to zero tokens must still be rejected
+    by the RESTARTED router: restore with age 0 resumes the drained
+    bucket, it does not mint a fresh burst allowance."""
+    cfg = TenantConfig.from_obj(
+        {"tenants": {"flood": {"rps": 1, "burst": 2}}})
+    clock = [100.0]
+    adm = TenantAdmission(cfg, clock=lambda: clock[0])
+    assert adm.admit("flood")[0] and adm.admit("flood")[0]
+    ok, why, _retry = adm.admit("flood")
+    assert not ok and why == "rate"  # the bucket is drained
+    snap = adm.bucket_snapshot()
+    # the restarted router, same instant: still over quota
+    adm2 = TenantAdmission(cfg, clock=lambda: clock[0])
+    assert adm2.restore_buckets(snap, age_s=0.0) == 1
+    ok, why, _retry = adm2.admit("flood")
+    assert not ok and why == "rate"
+    # the death window earns EXACTLY its refill: 1s at 1 rps -> 1 admit
+    adm3 = TenantAdmission(cfg, clock=lambda: clock[0])
+    adm3.restore_buckets(snap, age_s=1.0)
+    assert adm3.admit("flood")[0]
+    assert not adm3.admit("flood")[0]
+
+
+def test_restore_skips_tenants_the_current_config_freed():
+    """The operator's NEW config wins: a journaled bucket for a tenant
+    no longer rate-limited is skipped, and rate/burst always come from
+    the current policy, not the journal."""
+    old = TenantConfig.from_obj(
+        {"tenants": {"a": {"rps": 1, "burst": 1}, "b": {"rps": 1}}})
+    adm = TenantAdmission(old)
+    adm.admit("a")
+    adm.admit("b")
+    snap = adm.bucket_snapshot()
+    new = TenantConfig.from_obj({"tenants": {"a": {"rps": 5, "burst": 9}}})
+    adm2 = TenantAdmission(new)
+    assert adm2.restore_buckets(snap) == 1  # "b" skipped: unlimited now
+    got = adm2.bucket_snapshot()
+    assert set(got) == {"a"}
+    assert got["a"]["rate"] == 5.0 and got["a"]["burst"] == 9.0
+
+
+# ---------------------------------------------------------------------------
+# supervisor re-adoption (identity triple, never bare pid)
+# ---------------------------------------------------------------------------
+
+
+class StubHealthz:
+    """A /healthz-only replica stand-in publishing a mutable identity."""
+
+    def __init__(self, identity):
+        self.identity = dict(identity)
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = json.dumps(
+                    {"ok": True, "state": "ok",
+                     "identity": stub.identity}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _supervisor(base_port, reg=None, **kw):
+    kw.setdefault("max_replicas", 2)
+    return ReplicaSupervisor(
+        "python serve.py --port {port} --replica-id {replica_id}",
+        base_port=base_port, registry=reg or Registry(), **kw)
+
+
+def test_adopt_full_identity_triple_match(tmp_path):
+    stub = StubHealthz({"replica_id": "m0", "pid": os.getpid(),
+                        "boot_id": "live-boot"})
+    try:
+        reg = Registry()
+        sup = _supervisor(stub.port, reg)
+        sup.journal = _journal(tmp_path)
+        fact = {"pid": os.getpid(), "boot_id": "live-boot",
+                "rid": "m0", "cmd_hash": "x"}
+        adopted = sup.adopt({"0": fact, "1": {}})
+        assert [m.slot for m in adopted] == [0]
+        m = sup.slots[0]
+        assert m.desired and not m.quarantined
+        assert m.adopted_pid == os.getpid()
+        assert m.adopted_boot_id == "live-boot"
+        assert m.proc is None and m.restarts == 0  # zero restarts
+        # the adoption is counted and journaled
+        assert reg.counter("pfx_router_adopted_replicas_total",
+                           replica="m0").get() == 1.0
+        records, _ = read_fleet_journal(sup.journal.path)
+        assert records[-1]["phase"] == "adopted"
+        assert records[-1]["pid"] == os.getpid()
+        # poll(): the adopted pid is alive -> nothing to do, no flap
+        sup.poll()
+        assert sup.slots[0].adopted_pid == os.getpid()
+        assert not sup.slots[0].flap_exempt
+    finally:
+        stub.stop()
+
+
+def test_adopt_wrong_boot_id_quarantines_never_bare_pid():
+    """Same pid, DIFFERENT boot_id: the pid was recycled into a new
+    process — adoption must refuse (bare-pid matching is the PR 11
+    hole this closes) and quarantine the slot loudly rather than spawn
+    into a bind collision."""
+    stub = StubHealthz({"replica_id": "m0", "pid": os.getpid(),
+                        "boot_id": "new-incarnation"})
+    try:
+        sup = _supervisor(stub.port)
+        fact = {"pid": os.getpid(), "boot_id": "journaled-boot",
+                "rid": "m0", "cmd_hash": "x"}
+        with _log_lines() as lines:
+            assert sup.adopt({"0": fact}) == []
+        assert sup.slots[0].quarantined
+        assert sup.slots[0].adopted_pid is None
+        assert any("QUARANTINE" in ln for ln in lines)  # LOUD
+    finally:
+        stub.stop()
+
+
+def test_adopt_wrong_replica_id_quarantines():
+    stub = StubHealthz({"replica_id": "imposter", "pid": os.getpid(),
+                        "boot_id": "b"})
+    try:
+        sup = _supervisor(stub.port)
+        with _log_lines() as lines:
+            assert sup.adopt({"0": {}}) == []
+        assert sup.slots[0].quarantined
+        assert any("QUARANTINE" in ln for ln in lines)
+    finally:
+        stub.stop()
+
+
+def test_adopt_empty_fact_matches_on_replica_id(tmp_path):
+    """The journal-lost path (self-registration rebuild): with no
+    journaled identity facts, a process answering on OUR slot's port
+    with OUR replica_id is the identity match."""
+    stub = StubHealthz({"replica_id": "m0", "pid": 777, "boot_id": "b"})
+    try:
+        sup = _supervisor(stub.port)
+        adopted = sup.adopt({"0": {}})
+        assert [m.slot for m in adopted] == [0]
+        assert sup.slots[0].adopted_pid == 777
+    finally:
+        stub.stop()
+
+
+def test_adopt_silent_port_leaves_slot_for_ensure(tmp_path):
+    """Nothing answering and no provably-ours corpse: the slot stays
+    empty (not quarantined) for the normal ensure() respawn path."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    sup = _supervisor(dead_port)
+    # a fact whose pid is long dead and whose cmd_hash matches nothing
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    assert sup.adopt(
+        {"0": {"pid": proc.pid, "cmd_hash": "notourhash", "rid": "m0"}}
+    ) == []
+    m = sup.slots[0]
+    assert not m.quarantined and not m.desired and m.adopted_pid is None
+
+
+def test_adopted_exit_is_flap_exempt(tmp_path):
+    """An adopted replica is not our child: its exit rc is
+    unobservable, so its death schedules a flap-EXEMPT respawn — a
+    router restart can never spend the fleet's flap budget."""
+    sup = _supervisor(19999)
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()  # reaped: the pid is dead
+    m = sup._slot(0)
+    m.desired = True
+    m.adopted_pid = proc.pid
+    m.adopted_boot_id = "gone-boot"
+    sup.poll(now=1000.0)
+    assert m.adopted_pid is None and m.flap_exempt
+    assert m.next_restart_t == 1000.0 + sup.backoff_base_s
+    # an UNDESIRED adopted exit is just a drain completing
+    m2 = sup._slot(1)
+    m2.desired = False
+    m2.adopted_pid = proc.pid
+    sup.poll(now=1000.0)
+    assert m2.adopted_pid is None and not m2.flap_exempt
+
+
+# ---------------------------------------------------------------------------
+# pre-spawn journaling order (no untracked-child window)
+# ---------------------------------------------------------------------------
+
+
+def test_spawning_record_lands_before_the_child_exists(tmp_path):
+    """If the router dies between journaling and Popen returning, the
+    next boot must still know the slot: the 'spawning' record is
+    REQUIRED to be on disk before the child process is created."""
+    j = _journal(tmp_path)
+    seen_at_spawn = []
+
+    class FakeProc:
+        pid = 4242
+
+        def poll(self):
+            return None
+
+    def spawn_fn(m):
+        seen_at_spawn.append(read_fleet_journal(j.path)[0])
+        return FakeProc()
+
+    sup = _supervisor(9500, spawn_fn=spawn_fn, max_replicas=1)
+    sup.journal = j
+    sup.ensure(1)
+    (at_spawn,) = seen_at_spawn
+    assert [r["phase"] for r in at_spawn] == ["spawning"]
+    assert at_spawn[0]["pid"] is None  # no child yet, by construction
+    assert at_spawn[0]["cmd_hash"] == _cmd_hash(sup.slots[0].cmd)
+    records, _ = read_fleet_journal(j.path)
+    assert [r["phase"] for r in records] == ["spawning", "spawned"]
+    assert records[1]["pid"] == 4242
+
+
+# ---------------------------------------------------------------------------
+# controller clock restore
+# ---------------------------------------------------------------------------
+
+
+class _StubCore:
+    def replica_views(self):
+        return []
+
+    def add_replica(self, url, role="monolith"):
+        return "r0"
+
+
+def test_restore_clocks_holds_cooldowns_and_resets_idle(tmp_path):
+    reg = Registry()
+    sup = _supervisor(9500, reg, spawn_fn=lambda m: None)
+    ctl = ElasticController(
+        _StubCore(), sup,
+        ScalePolicy(min_replicas=1, max_replicas=3, up_cooldown_s=30.0,
+                    down_cooldown_s=60.0, idle_s=30.0),
+        registry=reg)
+    ctl.restore_clocks(target=2, tick=17, up_age_s=5.0, scale_age_s=5.0,
+                       extra_age_s=2.0)
+    now = time.monotonic()
+    assert ctl.target == 2 and ctl._seq == 17
+    # cooldown clocks rebased by journaled age + death window: 7s into
+    # a 30s cooldown -> a restart can NOT insta-rescale
+    assert now - ctl._last_up_t == pytest.approx(7.0, abs=0.5)
+    assert now - ctl._last_scale_t == pytest.approx(7.0, abs=0.5)
+    # idle dwell deliberately NOT restored: idleness was never observed
+    # across the death window -> a restart can never open scale-down
+    assert ctl._idle_since is None
+    st = ctl.journal_state()
+    assert st["target"] == 2 and st["tick"] == 17
+    assert st["idle_for_s"] is None
+    # target clamps into the CURRENT policy bounds; tick never rewinds
+    ctl.restore_clocks(target=99, tick=3)
+    assert ctl.target == 3 and ctl._seq == 17
+
+
+# ---------------------------------------------------------------------------
+# replica self-registration (POST /admin/register core surface)
+# ---------------------------------------------------------------------------
+
+
+def test_register_replica_idempotent_with_identity_refresh(tmp_path):
+    core = _core(tmp_path)
+    body = {"url": "http://127.0.0.1:9500/", "role": "monolith",
+            "identity": {"replica_id": "m0", "pid": 321,
+                         "boot_id": "bb", "started_at": 1700000000.0}}
+    out = core.register_replica(body)
+    assert out["key"] == "r0" and out["state"] == "booting"
+    # the heartbeat is idempotent: same url -> same key, no second slot
+    assert core.register_replica(body)["key"] == "r0"
+    assert len(core.replica_views()) == 1
+    v = core.replica_views()[0]
+    assert v["pid"] == 321 and v["boot_id"] == "bb"
+    assert v["replica_id"] == "m0"
+    # the registration landed in the journal too (belt and braces)
+    st = replay_fleet_state(read_fleet_journal(core.journal.path)[0])
+    assert "r0" in st["replicas"]
+
+
+def test_register_replica_rejects_malformed_urls(tmp_path):
+    core = _core(tmp_path)
+    for bad in ({}, {"url": ""}, {"url": "not a url"}):
+        with pytest.raises(ValueError, match="url"):
+            core.register_replica(bad)
+
+
+def test_deregister_walks_gone_and_rejects_stale_goodbyes(tmp_path):
+    core = _core(tmp_path)
+    core.register_replica(
+        {"url": "http://127.0.0.1:9500",
+         "identity": {"replica_id": "m0", "boot_id": "current"}})
+    # a STALE goodbye (previous incarnation's boot_id) must not eject
+    # the current process
+    with pytest.raises(ValueError, match="stale goodbye"):
+        core.register_replica(
+            {"deregister": True, "url": "http://127.0.0.1:9500",
+             "identity": {"replica_id": "m0", "boot_id": "previous"}})
+    assert core.replica_views()[0]["state"] != "gone"
+    # an unknown url is a no-op answer, not an error (the replica may
+    # have been ejected already)
+    out = core.register_replica(
+        {"deregister": True, "url": "http://127.0.0.1:9999"})
+    assert out == {"key": None, "state": "unknown"}
+    # the honest goodbye walks the replica to gone IMMEDIATELY — no
+    # eject_after failed-poll wait
+    out = core.register_replica(
+        {"deregister": True, "url": "http://127.0.0.1:9500",
+         "identity": {"replica_id": "m0", "boot_id": "current"}})
+    assert out["state"] == "gone"
+    assert core.replica_views()[0]["state"] == "gone"

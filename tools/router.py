@@ -104,6 +104,7 @@ def serve_router(args) -> int:
 
     from paddlefleetx_tpu.core.request_queue import QueueClosed, QueueFull
     from paddlefleetx_tpu.core.router import (
+        FleetJournal,
         FleetLog,
         NoReplicaAvailable,
         ReplicaUnavailable,
@@ -112,6 +113,8 @@ def serve_router(args) -> int:
         _DownstreamError,
         admin_headers,
         check_admin,
+        read_fleet_journal,
+        replay_fleet_state,
     )
     from paddlefleetx_tpu.core.tenancy import (
         PRIORITY_HEADER,
@@ -234,6 +237,17 @@ def serve_router(args) -> int:
     core.fleet_log = FleetLog(
         os.path.join(flight_dir(), "fleet_metrics.jsonl")
     )
+    # crash-consistent control-plane journal (docs/serving.md
+    # "Control-plane recovery"): registry transitions, controller scale
+    # decisions, supervisor slot facts, and tenant buckets all survive
+    # THIS process — the recovery block below folds the previous
+    # incarnation's journal back in before the listener opens
+    journal_path = os.path.join(flight_dir(), "fleet_state.jsonl")
+    journal = FleetJournal(journal_path)
+    core.journal = journal
+    for ctl in controllers:
+        ctl.journal = journal
+        ctl.supervisor.journal = journal
     flags = {"draining": False}
     default_deadline = float(args.deadline)
     max_deadline = float(args.max_deadline)
@@ -357,6 +371,8 @@ def serve_router(args) -> int:
             parts = urlsplit(self.path)
             if parts.path == "/admin/drain":
                 return self._admin_drain()
+            if parts.path == "/admin/register":
+                return self._admin_register()
             if parts.path != "/generate":
                 return self._json(404, {"error": "unknown path"})
             return self._generate(parts)
@@ -379,6 +395,24 @@ def serve_router(args) -> int:
                 out = core.drain(req.get("replica"))
             except ValueError as e:
                 return self._json(409, {"error": str(e)})
+            return self._json(200, out)
+
+        def _admin_register(self):
+            # replica self-registration heartbeat (tools/serve.py
+            # --router-url): how a router restarted with a lost or
+            # stale journal rediscovers its fleet, and how a drained
+            # replica says goodbye without waiting out --eject-after
+            if not self._authorized("/admin"):
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(n) or b"{}")
+            except json.JSONDecodeError as e:
+                return self._json(400, {"error": f"bad JSON: {e}"})
+            try:
+                out = core.register_replica(req)
+            except ValueError as e:
+                return self._json(400, {"error": str(e)})
             return self._json(200, out)
 
         def _tenant_headers(self):
@@ -613,6 +647,101 @@ def serve_router(args) -> int:
 
     for sig in (signal.SIGTERM, signal.SIGINT):
         orig_handlers[sig] = signal.signal(sig, _on_signal)
+
+    # ---- control-plane recovery (docs/serving.md "Control-plane
+    # recovery"): fold the previous incarnation's journal back in BEFORE
+    # anything spawns — tenant buckets restore with the death window's
+    # worth of refill (no free burst allowance mid-429-storm), controller
+    # clocks rebase so the restart can neither double-spawn nor
+    # insta-rescale, and each supervisor reconciles its journaled slots
+    # against what is actually running (adopt, reap, or respawn) --------
+    def _journal_snapshot():
+        """Full control-plane state for FleetJournal compaction — the
+        same shape replay_fleet_state produces, so a compacted journal
+        replays identically to the append log it replaced."""
+        views = core.replica_views()
+        by_url = {v["url"]: v for v in views}
+        slots = {}
+        ctl_state = {}
+        for c in controllers:
+            pool = c.role or "monolith"
+            rows = {}
+            for mv in c.supervisor.views():
+                if not mv.get("desired") and mv.get("pid") is None:
+                    continue  # empty slot: nothing to recover
+                rv = by_url.get(mv["url"]) or {}
+                rows[str(mv["slot"])] = {
+                    "port": mv["port"], "url": mv["url"],
+                    "rid": mv["replica_id"],
+                    "cmd_hash": mv.get("cmd_hash"),
+                    "pid": mv.get("pid"),
+                    "boot_id": rv.get("boot_id"),
+                    "phase": ("adopted" if mv.get("adopted")
+                              else "spawned"),
+                }
+            slots[pool] = rows
+            ctl_state[pool] = c.journal_state()
+        return {
+            "replicas": {
+                v["key"]: {f: v.get(f) for f in (
+                    "url", "role", "state", "replica_id", "pid",
+                    "boot_id")}
+                for v in views
+            },
+            "slots": slots,
+            "controller": ctl_state,
+            "tenants": core.tenant_journal_snapshot(),
+        }
+
+    journal_records, journal_note = read_fleet_journal(journal_path)
+    if journal_note:
+        print(f"recovery: {journal_note}", flush=True)
+    replayed = (replay_fleet_state(journal_records)
+                if journal_records else None)
+    age_s = 0.0
+    if replayed is not None and replayed.get("wall"):
+        age_s = max(0.0, time.time() - float(replayed["wall"]))
+    if replayed is not None:
+        restored = core.restore_tenant_buckets(
+            (replayed.get("tenants") or {}).get("buckets") or {},
+            age_s=age_s,
+        )
+        print(
+            f"recovery: replayed {replayed['records']} journal "
+            f"record(s) (death window {age_s:.1f}s); restored "
+            f"{restored} tenant bucket(s)", flush=True,
+        )
+        reg.counter("pfx_router_recoveries_total").inc()
+    for ctl in controllers:
+        pool = ctl.role or "monolith"
+        facts = {}
+        if replayed is not None:
+            cs = (replayed.get("controller") or {}).get(pool)
+            if cs:
+                ctl.restore_clocks(
+                    target=cs.get("target"), tick=cs.get("tick"),
+                    up_age_s=cs.get("up_age_s"),
+                    scale_age_s=cs.get("scale_age_s"),
+                    extra_age_s=age_s,
+                )
+            facts = (replayed.get("slots") or {}).get(pool) or {}
+        # probe EVERY slot, journaled or not: with facts the full
+        # identity triple must match; without (journal lost), a live
+        # process answering with the slot's own replica_id is adopted —
+        # either way a surviving fleet is re-entered with zero respawns
+        probe = {
+            str(i): (facts.get(str(i)) or {})
+            for i in range(ctl.supervisor.max_replicas)
+        }
+        adopted = ctl.supervisor.adopt(probe)
+        if adopted:
+            ctl._register(adopted)
+            print(
+                f"recovery: re-adopted {len(adopted)} live "
+                f"replica(s) into the {pool} pool (zero respawns, "
+                "no flap budget spent)", flush=True,
+            )
+    journal.set_snapshot_fn(_journal_snapshot)
 
     core.start()
     for ctl in controllers:

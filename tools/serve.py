@@ -55,6 +55,7 @@ import argparse
 import json
 import math
 import os
+import secrets
 import sys
 import time
 
@@ -295,7 +296,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                slo_ttft_p99_s: float = 0.0, slo_error_rate: float = 0.0,
                slo_windows_s=(60.0, 600.0),
                role: str = "monolith", replica_id: str = "",
-               tenants_path: str = "", preempt_min_tokens: int = 8):
+               tenants_path: str = "", preempt_min_tokens: int = 8,
+               router_url: str = ""):
     import signal
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -414,12 +416,18 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     # the router (and a human with curl) can tell replicas apart, and
     # the pid is what lets `tools/router.py drain` ride the SIGTERM
     # drain contract on same-host topologies
+    # boot_id is random PER PROCESS START: pid+boot_id names this exact
+    # incarnation, so the router's re-adoption and legacy drain-by-pid
+    # paths can never mistake a recycled pid for this replica
+    # (docs/serving.md "Control-plane recovery")
     identity = {
         "replica_id": replica_id or f"{host}:{port}",
         "role": role,
         "scheduler": "queue" if role == "prefill" else scheduler,
         "listen": f"{host}:{port}",
         "pid": os.getpid(),
+        "boot_id": secrets.token_hex(8),
+        "started_at": round(time.time(), 3),
     }
     # label this process's spans for cross-process exports: the fleet's
     # stitched timelines name their Perfetto lanes off this identity
@@ -1694,6 +1702,63 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             f"recomputed on demand", flush=True,
         )
 
+    # -- replica self-registration (docs/serving.md "Control-plane
+    # recovery"): with --router-url, this replica announces itself to
+    # the router on an admin-gated heartbeat, so a router restarted with
+    # a lost or stale journal rediscovers the fleet from the replicas
+    # themselves; on drain it says goodbye instead of making the router
+    # wait out --eject-after failed polls ---------------------------------
+    advertise_host = ("127.0.0.1" if host in ("0.0.0.0", "::", "")
+                      else host)
+    advertise_url = f"http://{advertise_host}:{port}"
+
+    def _post_register(payload: dict, timeout: float) -> None:
+        import urllib.request
+
+        from paddlefleetx_tpu.core.router import admin_headers
+
+        req = urllib.request.Request(
+            router_url.rstrip("/") + "/admin/register",
+            data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json",
+                     **admin_headers()},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            resp.read()
+
+    def _register_heartbeat():
+        interval = float(os.environ.get("PFX_REGISTER_INTERVAL_S", "2")
+                         or 2)
+        warned = False
+        payload = {"url": advertise_url, "role": role,
+                   "identity": identity}
+        while not stop_event.is_set() and not flags["draining"]:
+            try:
+                _post_register(payload, timeout=5.0)
+                warned = False
+            except Exception as e:  # noqa: BLE001 — best-effort forever
+                if not warned:
+                    warned = True
+                    print(
+                        f"register: heartbeat to {router_url} failed "
+                        f"({e}); retrying every {interval:g}s",
+                        flush=True,
+                    )
+            stop_event.wait(interval)
+
+    def _deregister_from_router():
+        """Best-effort goodbye on drain exit — identity rides along so
+        a delayed goodbye can never eject a redeployed successor."""
+        try:
+            _post_register({"deregister": True, "url": advertise_url,
+                            "identity": identity}, timeout=3.0)
+            print("register: deregistered from router", flush=True)
+        except Exception as e:  # noqa: BLE001 — the drain must finish
+            print(
+                f"register: deregister failed ({e}); the router will "
+                "eject this replica after failed polls", flush=True,
+            )
+
     def initiate_drain(source: str, migrate_to=()) -> bool:
         """THE drain initiation, shared by the signal handler and the
         authenticated ``POST /admin/drain`` (the remote transport that
@@ -1728,6 +1793,8 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                     reg.counter("pfx_migrate_failed_total").inc()
                     print(f"migrate: failed ({e}); drain continues",
                           flush=True)
+            if router_url:
+                _deregister_from_router()
             httpd.shutdown()
 
         threading.Thread(target=_drain, name="serve-drain",
@@ -1761,6 +1828,9 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
     queue.start()
     threading.Thread(target=_watchdog, name="serve-watchdog",
                      daemon=True).start()
+    if router_url:
+        threading.Thread(target=_register_heartbeat,
+                         name="serve-register", daemon=True).start()
     endpoint = {"prefill": "POST /prefill", "decode": "POST /decode + /generate"}.get(
         role, "POST /generate"
     )
@@ -1918,6 +1988,15 @@ def main(argv=None):
                     "must have committed at least this many tokens "
                     "since its last admission before a higher-priority "
                     "arrival may preempt it")
+    ap.add_argument("--router-url", default="",
+                    help="base URL of the fleet router (e.g. "
+                    "http://127.0.0.1:8000): this replica self-registers "
+                    "on an admin-gated POST /admin/register heartbeat "
+                    "(every PFX_REGISTER_INTERVAL_S seconds) so a "
+                    "restarted router rediscovers the fleet even with a "
+                    "lost journal, and deregisters on drain exit instead "
+                    "of waiting out the router's --eject-after "
+                    "(docs/serving.md 'Control-plane recovery')")
     ap.add_argument("--compile-cache-dir", default="",
                     help="seed jax's persistent compilation cache from "
                     "this directory (warm boot: a scale-up replica "
@@ -2029,6 +2108,7 @@ def main(argv=None):
             replica_id=args.replica_id,
             tenants_path=args.tenants,
             preempt_min_tokens=args.preempt_min_tokens,
+            router_url=args.router_url,
         )
 
     # REPL: one prompt per line -> completion (ids mode when no tokenizer)
