@@ -1,6 +1,6 @@
 # Developer entry points
 
-.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel test-fleet-obs test-decode-overlap test-kv-tier test-tenant test-ha bench bench-check
+.PHONY: lint test-fast test-mid test-std test-all test-fault test-serve-drill test-data-drill test-obs test-paged test-prefix test-spec test-trace test-router test-elastic test-disagg test-parallel test-fleet-obs test-decode-overlap test-kv-tier test-tenant test-ha test-goodput bench bench-check
 
 # stdlib AST lint gate (no ruff/flake8 in the image): unused imports,
 # bare except, eval/exec, tabs, trailing whitespace, mutable defaults
@@ -139,6 +139,18 @@ test-prefix:
 test-kv-tier:
 	python -m pytest tests/test_kv_tier.py tests/test_kv_handoff.py -q
 	python -m pytest tests/test_bench_contract.py -q -k "decode_happy"
+
+# serving goodput-ledger gate: time/token ledger closure units (exact
+# token closure + <=1% time closure under a seeded adversarial mix),
+# the fault-marked closure + fleet-profiling drills through the real
+# serve/router CLIs, the train-ledger record surface, and the
+# dispatch-ahead goodput_frac bench contract (docs/observability.md
+# "Goodput ledger" + "On-demand profiling")
+test-goodput:
+	python -m pytest tests/test_goodput.py tests/test_tracing.py -q -m "not slow"
+	python -m pytest "tests/test_engine.py::test_metrics_file_stream" -q
+	python -m pytest tests/test_bench_contract.py -q -k "decode_happy"
+	python tools/lint.py
 
 # multi-tenant isolation gate: tenancy units (quotas/DRR/label cap/header
 # propagation), scheduler fairness + preemption parity, then the real-CLI

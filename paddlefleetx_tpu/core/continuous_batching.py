@@ -334,6 +334,15 @@ class PagedDecodeEngine:
         # ("host_gap_s"/"gap_steps" measure host time the device sat
         # idle between consuming one step's results and receiving the
         # next dispatch — benchmarks/bench_decode.py's host_gap_ms)
+        # (goodput time-ledger accumulators: wall time THIS thread spent
+        # in each phase — t_device_decode covers decode dispatches,
+        # t_device_prefill every donating dispatch (prefill / chunk /
+        # adopt / COW), t_readback the commit fetch barrier,
+        # t_stream_flush the SSE sink calls.  The scheduler baseline-
+        # diffs them per iteration, so warmup/driver time outside an
+        # iteration never enters the ledger.  "ledger_admitted" counts
+        # tokens COMMITTED into scheduler-owned rows — the token
+        # ledger's admission side, folded by _fold_admitted())
         self.stats: Dict[str, Any] = {
             "traces": 0, "steps": 0, "prefills": 0,
             "spec_proposed": 0, "spec_accepted": 0,
@@ -341,6 +350,9 @@ class PagedDecodeEngine:
             "prefill_tokens": 0, "prefill_chunks": 0,
             "host_gap_s": 0.0, "gap_steps": 0,
             "migrate_adopted": 0,
+            "t_device_decode": 0.0, "t_device_prefill": 0.0,
+            "t_readback": 0.0, "t_stream_flush": 0.0,
+            "ledger_admitted": 0,
         }
         # True only inside warmup(): warmup admits/steps are not traffic
         # and must not bump the traffic-facing registry counters (the
@@ -745,6 +757,7 @@ class PagedDecodeEngine:
         dead rows.  ONE spelling for the COW-copy / monolithic-prefill /
         chunk dispatches so the recovery contract cannot drift between
         them."""
+        t0 = time.monotonic()
         try:
             with self.mesh:
                 return thunk()
@@ -756,6 +769,10 @@ class PagedDecodeEngine:
                 f"{what} failed ({type(exc).__name__}: {exc}); arena reset",
                 dead,
             ) from exc
+        finally:
+            # time-ledger: every donating dispatch is prefill-side
+            # device work (decode steps go through _dispatch instead)
+            self.stats["t_device_prefill"] += time.monotonic() - t0
 
     def admit(self, prompt_ids: Sequence[int], max_new: int,
               entry: Optional[_CBEntry] = None, row_idx: int = 0) -> int:
@@ -1507,6 +1524,7 @@ class PagedDecodeEngine:
                 0.0, time.monotonic() - self._t_results
             )
             self.stats["gap_steps"] += 1
+        t_disp = time.monotonic()
         try:
             with self.mesh:
                 (window, ncommit, pools_t, logits, counts, positions_t,
@@ -1525,6 +1543,8 @@ class PagedDecodeEngine:
                 "arena reset",
                 dead,
             ) from exc
+        finally:
+            self.stats["t_device_decode"] += time.monotonic() - t_disp
         from paddlefleetx_tpu.models.gpt.generation import PagedPools
 
         self.pools = PagedPools(*pools_t)
@@ -1557,6 +1577,7 @@ class PagedDecodeEngine:
         failure, and the ArenaReset carries every live row — INCLUDING
         rows admitted while the step was in flight, whose pools chained
         onto the poisoned dispatch."""
+        t_rb = time.monotonic()
         try:
             maybe_fire("cb_commit_crash", int(self.stats["steps"]) + 1)
             window = np.array(fl["window"])
@@ -1565,12 +1586,16 @@ class PagedDecodeEngine:
             positions = np.array(fl["positions"])
             gen_steps = np.array(fl["gen_steps"])
         except BaseException as exc:
+            # stamp the failed fetch before the reset: reset/requeue cost
+            # belongs to host_sched (the iterate residual), not readback
+            self.stats["t_readback"] += time.monotonic() - t_rb
             dead = self.reset()
             raise ArenaReset(
                 f"decode step failed ({type(exc).__name__}: {exc}); "
                 "arena reset",
                 dead,
             ) from exc
+        self.stats["t_readback"] += time.monotonic() - t_rb
         self._t_results = time.monotonic()
         was_active = fl["was_active"]
         # merge, never overwrite: slots that joined (admit/adopt) or
@@ -1592,11 +1617,19 @@ class PagedDecodeEngine:
             for tok in window[i, :committed].tolist():
                 if tok != self.gen.eos_token_id:
                     r.tokens.append(int(tok))
+            if r.entry is not None:
+                # token ledger: commits into scheduler-owned rows are
+                # ADMITTED tokens — every one must later reach exactly
+                # one terminal disposition (delivered / evicted_lost /
+                # preempt_refunded / shed_after_admit).  EOS never
+                # appends, so it never enters the books.
+                self.stats["ledger_admitted"] += len(r.tokens) - start
             if (len(r.tokens) > start and not self._warmup
                     and r.entry is not None and r.entry.stream is not None):
                 # token streaming: push this step's commits as they
                 # land.  A broken sink must never kill the batch — the
                 # tokens are committed either way.
+                t_sf = time.monotonic()
                 try:
                     r.entry.emit_stream(r.row_idx, start, r.tokens[start:])
                 except Exception as sink_exc:
@@ -1604,6 +1637,8 @@ class PagedDecodeEngine:
                         f"stream sink failed for seq {r.seq_id}: "
                         f"{type(sink_exc).__name__}: {sink_exc}"
                     )
+                finally:
+                    self.stats["t_stream_flush"] += time.monotonic() - t_sf
             if r.trace is not None:
                 # per-chunk decode timeline: one event per iteration the
                 # row decoded in, carrying its commit + spec-accept
@@ -1980,6 +2015,38 @@ class ContinuousScheduler:
             maxlen=_env_int("PFX_DECISION_LOG_CAP", 4096)
         )
         self._iter_counter = 0
+        # goodput ledgers (docs/observability.md "Goodput ledger").
+        # Time: every scheduler-thread wall-second lands in exactly one
+        # bucket — idle is stamped in _run's wait loop, the device/
+        # readback/stream buckets are baseline-diffed off the engine's
+        # per-phase accumulators inside _iterate, and host_sched is the
+        # iterate residual, so the bucket sum closes against
+        # _sched_wall_s BY CONSTRUCTION (drilled to <=1%).
+        self._time_ledger: Dict[str, float] = {
+            "device_decode": 0.0, "device_prefill": 0.0,
+            "host_sched": 0.0, "readback": 0.0,
+            "stream_flush": 0.0, "idle": 0.0,
+        }
+        self._sched_wall_s = 0.0
+        # Tokens: bank accounting over ADMITTED (committed) tokens.
+        # admitted == delivered + evicted_lost + preempt_refunded +
+        # shed_after_admit + (tokens still on live rows) holds EXACTLY
+        # at every iteration boundary; preempt refunds the on-book
+        # amount and a resume re-admits its carried prefix, so the
+        # equation survives any preempt/resume interleaving.  Scheduler
+        # thread writes only; _ledger_admit_base folds the engine's
+        # commit-site counter per call site.
+        self._tok_ledger: Dict[str, int] = {
+            "admitted": 0, "delivered": 0, "evicted_lost": 0,
+            "preempt_refunded": 0, "shed_after_admit": 0,
+        }
+        self._ledger_admit_base = 0
+        # per-tenant-label occupancy integrals (billing-grade cost
+        # attribution): decode-slot seconds and KV-block seconds,
+        # accrued over each iteration's duration for every live row.
+        # The scheduler never parks with live rows (_run's wait
+        # predicate), so iterate durations cover all occupancy.
+        self._tenant_occ: Dict[str, Dict[str, float]] = {}
         # engine-side debug view published by the scheduler thread at
         # the end of every iteration (read by debug_state() without
         # taking any lock the scheduler holds during decode).  With
@@ -2049,6 +2116,41 @@ class ContinuousScheduler:
             out.append((
                 "pfx_spec_accept_rate", {},
                 float(eng.stats["spec_accepted"]) / prop if prop else 0.0,
+            ))
+        # goodput ledgers (docs/observability.md "Goodput ledger"):
+        # the per-bucket time counters close against the wall counter
+        # (<=1% drift) and the token dispositions close against
+        # admitted exactly once in_flight drains to zero
+        for b, v in sorted(self._time_ledger.items()):
+            out.append((
+                "pfx_sched_time_seconds_total", {"bucket": b}, round(v, 6),
+            ))
+        out.append((
+            "pfx_sched_wall_seconds_total", {}, round(self._sched_wall_s, 6),
+        ))
+        # device-starved host seconds (host_gap_s): overlaps the
+        # host_sched/readback buckets rather than joining the exhaustive
+        # bucket family — it is the goodput_frac subtrahend
+        # (goodput = 1 - host_gap / non-idle wall)
+        out.append((
+            "pfx_sched_host_gap_seconds_total", {},
+            round(float(eng.stats["host_gap_s"]), 6),
+        ))
+        for d, v in sorted(self._tok_ledger.items()):
+            out.append((
+                "pfx_token_ledger_total", {"disposition": d}, float(v),
+            ))
+        out.append((
+            "pfx_token_ledger_in_flight", {}, float(self._ledger_in_flight()),
+        ))
+        for lab, occ in sorted(self._tenant_occ.items()):
+            out.append((
+                "pfx_tenant_slot_seconds_total", {"tenant": lab},
+                round(occ["slot_s"], 6),
+            ))
+            out.append((
+                "pfx_tenant_kv_block_seconds_total", {"tenant": lab},
+                round(occ["kv_block_s"], 6),
             ))
         per_tenant: Dict[str, int] = {}
         with self._lock:
@@ -2190,6 +2292,53 @@ class ContinuousScheduler:
                 return 0.0
             return time.monotonic() - self._busy_since
 
+    # -- goodput ledgers ------------------------------------------------
+    def _fold_admitted(self) -> None:
+        """Fold the engine's commit-site admitted-token counter into the
+        scheduler ledger.  Called right after any step/flush that can
+        commit tokens and BEFORE the rows are resolved or failed, so
+        delivered/lost never outruns admitted within an iteration."""
+        cur = int(self.engine.stats["ledger_admitted"])
+        if cur != self._ledger_admit_base:
+            self._tok_ledger["admitted"] += cur - self._ledger_admit_base
+            self._ledger_admit_base = cur
+
+    def _row_on_books(self, row: "_Row") -> int:
+        """Tokens currently on the books for one live slot row: commits
+        since its (last) admission plus the resume prefix it re-admitted
+        (row_prefill carries it for rows seated via a resume)."""
+        if row.entry is None:
+            return 0
+        return len(row.tokens) + len(
+            row.entry.row_prefill.get(row.row_idx, ())
+        )
+
+    def _ledger_in_flight(self) -> int:
+        """Admitted tokens without a terminal disposition yet: the sum
+        over live scheduler-owned rows of their on-book tokens."""
+        return sum(
+            self._row_on_books(r)
+            for r in self.engine.slots if r is not None
+        )
+
+    def time_ledger(self) -> Dict[str, Any]:
+        """Snapshot of the scheduler-thread time ledger (bench/report
+        accessor): per-bucket seconds plus the wall total they close
+        against."""
+        return {
+            "buckets": dict(self._time_ledger),
+            "wall_s": self._sched_wall_s,
+        }
+
+    def token_ledger(self) -> Dict[str, int]:
+        """Snapshot of the token ledger plus the live in-flight count —
+        ``admitted == delivered + evicted_lost + preempt_refunded +
+        shed_after_admit + in_flight`` holds exactly at iteration
+        boundaries (and with ``in_flight == 0`` at quiescence)."""
+        out = dict(self._tok_ledger)
+        out["in_flight"] = self._ledger_in_flight()
+        return out
+
     def try_remove(self, future: RequestFuture) -> bool:
         """Shed a WAITING entry (no row admitted yet).  An entry already
         in the running batch resolves via mid-decode eviction at its
@@ -2257,6 +2406,25 @@ class ContinuousScheduler:
                 "step_families": len(eng._compiled_step),
                 "chunk_families": len(eng._compiled_chunk),
                 "traces": int(eng.stats["traces"]),
+            },
+            # goodput ledgers, snapshotted in the SAME build as the row
+            # list above: tokens.admitted == delivered + evicted_lost +
+            # preempt_refunded + shed_after_admit + tokens_in_flight
+            # holds EXACTLY within this view
+            "goodput": {
+                "time_s": {
+                    k: round(v, 6) for k, v in self._time_ledger.items()
+                },
+                "wall_s": round(self._sched_wall_s, 6),
+                "tokens": dict(self._tok_ledger),
+                "tokens_in_flight": self._ledger_in_flight(),
+                "tenant_occupancy": {
+                    lab: {
+                        "slot_s": round(occ["slot_s"], 6),
+                        "kv_block_s": round(occ["kv_block_s"], 6),
+                    }
+                    for lab, occ in sorted(self._tenant_occ.items())
+                },
             },
         }
         if eng.prefix_enabled or eng.prefill_chunk:
@@ -2408,13 +2576,20 @@ class ContinuousScheduler:
 
     def _run(self) -> None:
         while True:
+            t_wait0 = time.monotonic()
             with self._wake:
                 while (not self._entries and not self._admin_tasks
                        and not self._has_live_rows()):
                     if self._closed:
                         return  # drained
                     self._wake.wait()
-                self._busy_since = time.monotonic()
+                t_busy0 = time.monotonic()
+                self._busy_since = t_busy0
+                # time-ledger idle: the parked wait between iterations.
+                # _iterate accounts its own duration, so idle + the
+                # iterate folds cover this thread's whole wall clock.
+                self._time_ledger["idle"] += t_busy0 - t_wait0
+                self._sched_wall_s += t_busy0 - t_wait0
             try:
                 self._iterate()
             finally:
@@ -2436,11 +2611,20 @@ class ContinuousScheduler:
     def _evict_entry(self, entry: _CBEntry, reason: str) -> None:
         """Mid-decode eviction: free every admitted row of the entry and
         resolve its future.  Blocks return to the pool IMMEDIATELY — the
-        next admission can use them this same iteration."""
+        next admission can use them this same iteration.  Token ledger:
+        the rows' on-book tokens get their terminal disposition here —
+        ``shed_after_admit`` when the entry expired only PARTIALLY
+        admitted (reason ``expired_partial``), ``evicted_lost`` for a
+        fully-admitted entry evicted mid-decode."""
         eng = self.engine
+        disposition = (
+            "shed_after_admit" if reason == "expired_partial"
+            else "evicted_lost"
+        )
         n = 0
         for i, r in enumerate(eng.slots):
             if r is not None and r.entry is entry:
+                self._tok_ledger[disposition] += self._row_on_books(r)
                 eng.release(i)
                 n += 1
         self.stats["evictions"] += n
@@ -2460,6 +2644,17 @@ class ContinuousScheduler:
             )
 
     def _fail_rows(self, rows, exc: BaseException) -> None:
+        # token ledger: the rows died with their on-book tokens (the
+        # arena reset already released them) — every one is evicted_lost.
+        # Fold first: commits that landed before the crash must be
+        # admitted before they can be lost.
+        self._fold_admitted()
+        for r in rows:
+            if r.entry is not None:
+                self._tok_ledger["evicted_lost"] += (
+                    len(r.tokens)
+                    + len(r.entry.row_prefill.get(r.row_idx, ()))
+                )
         failed = {r.entry for r in rows if r.entry is not None}
         for e in failed:
             if not e.future.done():
@@ -2492,10 +2687,43 @@ class ContinuousScheduler:
         blocks_free0 = eng.cache.allocator.free_count()
         tadmit0 = dict(self._tenant_admitted)
         tpre0 = dict(self._tenant_preempted)
+        # goodput-ledger baselines: the iterate's wall duration is fully
+        # attributed — engine per-phase deltas plus a host_sched
+        # residual — and the token columns are per-iteration deltas of
+        # the same dicts the registry and /debug/state export
+        t_iter0 = time.monotonic()
+        tdd0 = float(eng.stats["t_device_decode"])
+        tdp0 = float(eng.stats["t_device_prefill"])
+        trb0 = float(eng.stats["t_readback"])
+        tsf0 = float(eng.stats["t_stream_flush"])
+        tok0 = dict(self._tok_ledger)
         n_finished = 0
         try:
             n_finished = self._iterate_inner()
         finally:
+            self._fold_admitted()
+            dur = time.monotonic() - t_iter0
+            dd = float(eng.stats["t_device_decode"]) - tdd0
+            dp = float(eng.stats["t_device_prefill"]) - tdp0
+            rb = float(eng.stats["t_readback"]) - trb0
+            sf = float(eng.stats["t_stream_flush"]) - tsf0
+            led = self._time_ledger
+            led["device_decode"] += dd
+            led["device_prefill"] += dp
+            led["readback"] += rb
+            led["stream_flush"] += sf
+            led["host_sched"] += max(0.0, dur - (dd + dp + rb + sf))
+            self._sched_wall_s += dur
+            # per-tenant occupancy integrals: every live row held its
+            # decode slot and KV blocks for this whole iteration
+            for r in eng.slots:
+                if r is not None and r.entry is not None:
+                    lab = self._tenant_labels.label(r.entry.tenant)
+                    occ = self._tenant_occ.setdefault(
+                        lab, {"slot_s": 0.0, "kv_block_s": 0.0}
+                    )
+                    occ["slot_s"] += dur
+                    occ["kv_block_s"] += len(r.table) * dur
             self._iter_counter += 1
             if get_trace_buffer().enabled:
                 row = {
@@ -2537,6 +2765,22 @@ class ContinuousScheduler:
                     "spill_discards": int(spill["discards"]) - spill_d0,
                     "migrate_adopted":
                         int(eng.stats["migrate_adopted"]) - mig_a0,
+                    # token-ledger columns (baseline-diffed like the
+                    # trio): folding an untruncated log reproduces the
+                    # pfx_token_ledger_total dispositions exactly
+                    "tok_admitted":
+                        self._tok_ledger["admitted"] - tok0["admitted"],
+                    "tok_delivered":
+                        self._tok_ledger["delivered"] - tok0["delivered"],
+                    "tok_evicted_lost":
+                        self._tok_ledger["evicted_lost"]
+                        - tok0["evicted_lost"],
+                    "tok_preempt_refunded":
+                        self._tok_ledger["preempt_refunded"]
+                        - tok0["preempt_refunded"],
+                    "tok_shed_after_admit":
+                        self._tok_ledger["shed_after_admit"]
+                        - tok0["shed_after_admit"],
                 }
                 # multi-tenant columns (same baseline-diff discipline):
                 # per-tenant-label admitted/preempted row counts — the
@@ -2637,10 +2881,16 @@ class ContinuousScheduler:
             # dispatched step first (dispatch-ahead), so evicted rows'
             # final state is folded in before their blocks return
             n_finished += self._flush_engine()
+        partial = set(expired_partial)
         for e in expired:
             if e.future.done():
                 continue  # the in-flight step completed it first
-            self._evict_entry(e, "mid-decode")
+            # reason doubles as the ledger disposition: a PARTIALLY
+            # admitted entry's on-book tokens are shed_after_admit, a
+            # fully-admitted one's are evicted_lost
+            self._evict_entry(
+                e, "expired_partial" if e in partial else "mid-decode"
+            )
 
         with self._wake:
             waiting = bool(self._entries)
@@ -2809,6 +3059,13 @@ class ContinuousScheduler:
                     eng.adopt(meta, arrays, entry=entry, row_idx=row_idx)
                 else:
                     eng.admit(prompt, mx, entry=entry, row_idx=row_idx)
+                if resumed:
+                    # token ledger: a resume re-admits the prefix its
+                    # preemption refunded — the tokens are back on the
+                    # books, and finished_tokens will deliver them
+                    self._tok_ledger["admitted"] += len(
+                        entry.row_prefill.get(row_idx, ())
+                    )
                 self.stats["prefill_admits"] += 1
                 lab = self._tenant_labels.label(entry.tenant)
                 self._tenant_admitted[lab] = (
@@ -2833,6 +3090,11 @@ class ContinuousScheduler:
                 self.stats["gen_errors"] += 1
                 for i, r in enumerate(eng.slots):
                     if r is not None and r.entry is entry:
+                        # sibling rows admitted earlier die with their
+                        # on-book tokens: evicted_lost
+                        self._tok_ledger["evicted_lost"] += (
+                            self._row_on_books(r)
+                        )
                         eng.release(i)
                 if not entry.future.done():
                     entry.future.set_exception(exc)
@@ -2862,6 +3124,7 @@ class ContinuousScheduler:
             self._fail_rows(exc.dead_rows, exc)
             logger.warning(f"{self.name}: {exc}")
             return 0
+        self._fold_admitted()  # before _finish_rows can deliver them
         self.stats["batches"] += 1
         return self._finish_rows(finished)
 
@@ -2879,6 +3142,7 @@ class ContinuousScheduler:
             self._fail_rows(exc.dead_rows, exc)
             logger.warning(f"{self.name}: {exc}")
             return 0
+        self._fold_admitted()  # before _finish_rows can deliver them
         return self._finish_rows(finished)
 
     def _next_unit(self, head: "_CBEntry") -> tuple:
@@ -2944,6 +3208,13 @@ class ContinuousScheduler:
             prev + committed if prev else committed
         )
         entry.requeue_rows.append(row.row_idx)
+        # token ledger: the row's WHOLE on-book amount (any earlier
+        # resume prefix + this stint's commits) leaves the books as a
+        # refund; the resume re-admits it, so books stay closed across
+        # any preempt/resume chain
+        self._tok_ledger["preempt_refunded"] += len(
+            entry.row_prefill[row.row_idx]
+        )
         self.stats["preemptions"] += 1
         lab = self._tenant_labels.label(entry.tenant)
         self._tenant_preempted[lab] = self._tenant_preempted.get(lab, 0) + 1
@@ -2978,6 +3249,11 @@ class ContinuousScheduler:
                 continue
             entry.results[row.row_idx] = entry.finished_tokens(
                 row.row_idx, row.tokens
+            )
+            # token ledger: the full output (resume prefix + this
+            # stint's commits) reached the results array — delivered
+            self._tok_ledger["delivered"] += len(
+                entry.results[row.row_idx]
             )
             entry.done_rows += 1
             if entry.done_rows == len(entry.prompts):

@@ -1421,6 +1421,13 @@ class Engine:
         # — the loop then does zero tracing work.
         fit_trace = get_trace_buffer().maybe_start("train")
         window_t0 = time.monotonic()
+        # goodput time ledger (docs/observability.md "Goodput ledger"):
+        # everything since loop_t0 is attributed to one of
+        # compile/data_wait/host/eval, and the unattributed remainder is
+        # device_step — dispatched device compute the async-dispatch loop
+        # never blocks on.  Buckets are exhaustive by construction.
+        loop_t0 = time.monotonic()
+        eval_total = 0.0
         # metrics of the previous step, observed AFTER the next step has
         # been dispatched: step N-1 necessarily finished before step N
         # runs on device, so the fetch resolves while step N computes and
@@ -1617,6 +1624,31 @@ class Engine:
                     window_t0 = now_mono
                     record["trace_id"] = fit_trace.trace_id
                 self._update_registry(record, ips)
+                # time ledger: attribute the whole fit's wall clock from
+                # the loop's OWN accumulators (not the record — a loader
+                # stats() override swaps in producer-side data_wait_s,
+                # which would break closure against this thread's wall).
+                # Exporter-style .set(): totals stay monotonic per fit.
+                buckets = {
+                    "compile": self._compile_s or 0.0,
+                    "data_wait": data_wait_total,
+                    "host": host_total,
+                    "eval": eval_total,
+                }
+                buckets["device_step"] = max(
+                    0.0,
+                    (time.monotonic() - loop_t0) - sum(buckets.values()),
+                )
+                reg = self._registry
+                for bname, bval in sorted(buckets.items()):
+                    reg.counter(
+                        "pfx_train_time_seconds_total", bucket=bname
+                    ).set(round(bval, 4))
+                # the record carries the same ledger so tools/report.py
+                # renders the stacked breakdown from artifacts alone
+                record["time_ledger"] = {
+                    k: round(v, 3) for k, v in buckets.items()
+                }
                 self._write_metrics(record)
                 t_last = time.time()
                 window_tokens = 0
@@ -1635,7 +1667,9 @@ class Engine:
                 # on_empty="event": a finite eval stream exhausting mid-fit
                 # logs loudly + emits a structured event instead of either
                 # nan-poisoning silently or killing the training run
+                t_eval = time.monotonic()
                 self.evaluate(eval_iter, iters=self.eval_iters, on_empty="event")
+                eval_total += time.monotonic() - t_eval
                 t_last = time.time()
                 window_tokens = 0
                 steps_in_window = 0

@@ -451,6 +451,22 @@ class FleetFederation:
                     return v
         return None
 
+    def samples(self, replica_key: str,
+                name: str) -> List[Tuple[Dict[str, str], float]]:
+        """Every stored (labels, value) sample of one family for one
+        replica — the accessor for dynamically-labeled families (e.g.
+        the per-tenant occupancy ledgers, whose tenant label set is not
+        known up front the way :meth:`value`'s callers know theirs)."""
+        out: List[Tuple[Dict[str, str], float]] = []
+        with self._lock:
+            rec = self._replicas.get(replica_key)
+            if rec is None:
+                return out
+            for n, lab, v in rec["rows"]:
+                if n == name:
+                    out.append((dict(lab), v))
+        return out
+
     def collect(self):
         """Registry-collector protocol: staleness per replica + every
         stored sample under the ``pfx_fleet_metric`` family, bounded by
@@ -596,6 +612,31 @@ _FLEET_SAMPLE_FIELDS = {
     "migrate_sent_total": ("pfx_migrate_sent_total", {}),
     "migrate_adopted_total": ("pfx_migrate_adopted_total", {}),
     "migrate_failed_total": ("pfx_migrate_failed_total", {}),
+    # goodput ledgers (docs/observability.md "Goodput ledger"): the
+    # scheduler's time buckets + token dispositions per replica — what
+    # tools/report.py --fleet renders as the stacked goodput breakdown
+    "sched_wall_s": ("pfx_sched_wall_seconds_total", {}),
+    "sched_host_gap_s": ("pfx_sched_host_gap_seconds_total", {}),
+    "sched_device_decode_s": ("pfx_sched_time_seconds_total",
+                              {"bucket": "device_decode"}),
+    "sched_device_prefill_s": ("pfx_sched_time_seconds_total",
+                               {"bucket": "device_prefill"}),
+    "sched_host_sched_s": ("pfx_sched_time_seconds_total",
+                           {"bucket": "host_sched"}),
+    "sched_readback_s": ("pfx_sched_time_seconds_total",
+                         {"bucket": "readback"}),
+    "sched_stream_flush_s": ("pfx_sched_time_seconds_total",
+                             {"bucket": "stream_flush"}),
+    "sched_idle_s": ("pfx_sched_time_seconds_total", {"bucket": "idle"}),
+    "tok_admitted": ("pfx_token_ledger_total", {"disposition": "admitted"}),
+    "tok_delivered": ("pfx_token_ledger_total",
+                      {"disposition": "delivered"}),
+    "tok_evicted_lost": ("pfx_token_ledger_total",
+                         {"disposition": "evicted_lost"}),
+    "tok_preempt_refunded": ("pfx_token_ledger_total",
+                             {"disposition": "preempt_refunded"}),
+    "tok_shed_after_admit": ("pfx_token_ledger_total",
+                             {"disposition": "shed_after_admit"}),
 }
 
 # ---------------------------------------------------------------------------

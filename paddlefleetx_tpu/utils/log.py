@@ -48,3 +48,22 @@ def advertise() -> None:
     logger.info("=" * 60)
     logger.info("PaddleFleetX-TPU: TPU-native big model toolkit (JAX/XLA/Pallas)")
     logger.info("=" * 60)
+
+
+def log_server_error(surface: str, code: int, path: str, **fields) -> None:
+    """ONE structured line for every 5xx a serving surface writes
+    (docs/observability.md): ``key=value`` pairs an operator can grep
+    and join against the trace timeline — trace_id (when the request
+    was sampled), replica_id, tenant, outcome.  None/empty fields are
+    dropped so the line carries only what the handler actually knew;
+    values are quoted when they contain spaces."""
+    parts = [f"surface={surface}", f"code={code}", f"path={path}"]
+    for key in sorted(fields):
+        val = fields[key]
+        if val is None or val == "":
+            continue
+        sval = str(val)
+        if " " in sval:
+            sval = '"' + sval.replace('"', "'") + '"'
+        parts.append(f"{key}={sval}")
+    logger.error("http_5xx " + " ".join(parts))

@@ -18,17 +18,231 @@ the reference's printed summary views (eager_engine.py:866-925):
 ``hlo_stats.json``, and ``summary_memory.txt`` (live device memory stats
 when the backend exposes them).  Conversion uses the xprof toolchain when
 importable and degrades to trace-only with a warning otherwise.
+
+The parsing layer is module-level (``newest_run_dir`` / ``hlo_stats_rows``
+/ ``trace_event_rows`` / ``op_summary_rows`` / ``device_host_split``) so
+the on-demand serving capture (``capture_profile``, behind ``POST
+/admin/profile`` in tools/serve.py) reuses the exact same toolchain as
+the training hook.  ``capture_profile`` enforces the two safety rules
+for profiling a *production* replica: one capture at a time per process
+(``ProfileBusy`` -> HTTP 409) and a hard duration cap
+(``PFX_PROFILE_MAX_SECONDS``, default 30 -> HTTP 400 when exceeded).
 """
 
 from __future__ import annotations
 
 import os
+import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
 from paddlefleetx_tpu.utils.log import logger
+
+# one capture at a time per process: jax.profiler.start_trace is a global
+# singleton, so a second concurrent capture would either crash or corrupt
+# the first — refuse loudly instead (serve.py maps ProfileBusy to 409)
+_CAPTURE_LOCK = threading.Lock()
+
+
+class ProfileBusy(RuntimeError):
+    """A profile capture is already active in this process."""
+
+
+def profile_max_seconds() -> float:
+    """Hard cap on an on-demand capture window (PFX_PROFILE_MAX_SECONDS,
+    default 30): profiling stalls nothing, but traces grow with wall time
+    and an unbounded window on a production replica is an outage hazard."""
+    from paddlefleetx_tpu.utils.telemetry import _env_float
+
+    return _env_float("PFX_PROFILE_MAX_SECONDS", 30.0, minimum=0.001)
+
+
+def newest_run_dir(log_dir: str) -> str:
+    """The newest TensorBoard profile run directory under ``log_dir``."""
+    import glob
+
+    runs = sorted(glob.glob(os.path.join(log_dir, "plugins", "profile", "*")))
+    if not runs:
+        raise FileNotFoundError(f"no profile runs under {log_dir}")
+    return runs[-1]
+
+
+def _newest_xplanes(log_dir: str):
+    import glob
+
+    run = newest_run_dir(log_dir)
+    planes = sorted(glob.glob(os.path.join(run, "*.xplane.pb")))
+    if not planes:
+        raise FileNotFoundError(f"no xplane.pb under {run}")
+    return planes
+
+
+def hlo_stats_rows(log_dir: str) -> List[Dict[str, Any]]:
+    """Per-HLO-op rows from xprof's hlo_stats tool (populated on real
+    accelerator traces; CPU traces carry no device-op events)."""
+    import json
+
+    from xprof.convert import raw_to_tool_data  # lazy: pulls in TF
+
+    planes = _newest_xplanes(log_dir)
+    data, _ = raw_to_tool_data.xspace_to_tool_data(planes, "hlo_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode()
+    with open(os.path.join(log_dir, "hlo_stats.json"), "w") as f:
+        f.write(data)
+
+    table = json.loads(data)
+    cols = [c["id"] for c in table["cols"]]
+    idx = {name: cols.index(name) for name in
+           ("category", "hlo_op_name", "occurrences", "total_time",
+            "total_self_time")}
+    rows = []
+    for row in table.get("rows", []):
+        vals = [cell.get("v") if isinstance(cell, dict) else cell for cell in row["c"]]
+        rows.append({
+            "op": vals[idx["hlo_op_name"]],
+            "category": vals[idx["category"]],
+            "occurrences": int(vals[idx["occurrences"]] or 0),
+            "total_us": float(vals[idx["total_time"]] or 0.0),
+            "self_us": float(vals[idx["total_self_time"]] or 0.0),
+        })
+    return rows
+
+
+def _newest_trace_events(log_dir: str) -> List[Dict[str, Any]]:
+    import glob
+    import gzip
+    import json
+
+    run = newest_run_dir(log_dir)
+    traces = sorted(glob.glob(os.path.join(run, "*.trace.json.gz")))
+    if not traces:
+        raise FileNotFoundError(f"no trace.json.gz under {run}")
+    with gzip.open(traces[-1], "rt") as f:
+        return json.load(f).get("traceEvents", [])
+
+
+def trace_event_rows(log_dir: str) -> List[Dict[str, Any]]:
+    """Fallback aggregation over the chrome-trace events: op name ->
+    occurrences + summed duration.  Available on every backend."""
+    agg: Dict[str, list] = {}
+    for e in _newest_trace_events(log_dir):
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        entry = agg.setdefault(e.get("name", "?"), [0, 0.0])
+        entry[0] += 1
+        entry[1] += float(e["dur"])
+    return [
+        {"op": name, "category": "trace", "occurrences": n,
+         "total_us": dur, "self_us": dur}
+        for name, (n, dur) in agg.items()
+    ]
+
+
+def device_host_split(log_dir: str) -> Tuple[float, float]:
+    """(device_us, host_us): summed complete-event durations split by
+    whether the emitting process is a device plane.  The chrome trace
+    names every pid via ``ph=="M"``/``process_name`` metadata; device
+    planes are the ``/device:...`` ones (TPU/GPU streams), everything
+    else (python threads, runtime) is host."""
+    device_pids = set()
+    events = _newest_trace_events(log_dir)
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pname = str((e.get("args") or {}).get("name", ""))
+            if pname.startswith("/device:"):
+                device_pids.add(e.get("pid"))
+    device_us = host_us = 0.0
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        if e.get("pid") in device_pids:
+            device_us += float(e["dur"])
+        else:
+            host_us += float(e["dur"])
+    return device_us, host_us
+
+
+def op_summary_rows(log_dir: str, hlo_fn=None, trace_fn=None) -> Tuple[List[Dict[str, Any]], str]:
+    """(rows sorted by self time desc, source label): hlo_stats when the
+    xprof toolchain can parse the trace, chrome-trace events otherwise.
+    ``hlo_fn``/``trace_fn`` override the row sources (ProfilerHook passes
+    its bound methods so tests can stub a toolchain failure)."""
+    try:
+        rows = (hlo_fn or (lambda: hlo_stats_rows(log_dir)))()
+        source = "hlo_stats"
+    except Exception as e:  # noqa: BLE001 — xprof missing / schema drift
+        logger.warning(f"profiler: hlo_stats unavailable ({e!r}); using trace events")
+        rows = []
+    if not rows:
+        rows = (trace_fn or (lambda: trace_event_rows(log_dir)))()
+        source = "trace events (backend emits no per-HLO device stats)"
+    rows.sort(key=lambda r: -r["self_us"])
+    return rows, source
+
+
+def capture_profile(seconds: float, log_dir: str, top: int = 20) -> Dict[str, Any]:
+    """Capture a ``jax.profiler`` trace of the LIVE process for ``seconds``
+    and answer with the parsed summary — the whole ``POST /admin/profile``
+    body in one call.  Raises ``ValueError`` on a bad/over-cap duration
+    (-> 400) and ``ProfileBusy`` when a capture is already running
+    (-> 409).  The capture adds no device sync: the profiler observes the
+    running dispatch loop, it never drives it."""
+    cap = profile_max_seconds()
+    try:
+        seconds = float(seconds)
+    except (TypeError, ValueError):
+        raise ValueError(f"profile seconds must be a number, got {seconds!r}") from None
+    if not seconds > 0:
+        raise ValueError(f"profile seconds must be > 0, got {seconds}")
+    if seconds > cap:
+        raise ValueError(
+            f"profile seconds={seconds} exceeds PFX_PROFILE_MAX_SECONDS={cap} "
+            f"(raise the cap explicitly if you really want a longer trace)"
+        )
+    if not _CAPTURE_LOCK.acquire(blocking=False):
+        raise ProfileBusy(
+            "a profile capture is already active in this process; "
+            "retry after it finishes"
+        )
+    try:
+        from paddlefleetx_tpu.utils.telemetry import get_registry
+
+        os.makedirs(log_dir, exist_ok=True)
+        t0 = time.monotonic()
+        jax.profiler.start_trace(log_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        trace_s = time.monotonic() - t0
+        reg = get_registry()
+        reg.counter("pfx_profiler_traces_total").inc()
+        reg.gauge("pfx_profiler_trace_seconds").set(round(trace_s, 3))
+        rows, source = op_summary_rows(log_dir)
+        try:
+            device_us, host_us = device_host_split(log_dir)
+        except Exception as e:  # noqa: BLE001 — split is best-effort
+            logger.warning(f"profiler: device/host split unavailable ({e!r})")
+            device_us = host_us = 0.0
+        total_self = sum(r["self_us"] for r in rows) or 1.0
+        top_ops = [
+            {**r, "self_frac": round(r["self_us"] / total_self, 4)}
+            for r in rows[: max(0, int(top))]
+        ]
+        return {
+            "seconds": round(trace_s, 3),
+            "trace_dir": log_dir,
+            "source": source,
+            "device_us": round(device_us, 1),
+            "host_us": round(host_us, 1),
+            "op_count": len(rows),
+            "top_ops": top_ops,
+        }
+    finally:
+        _CAPTURE_LOCK.release()
 
 
 class ProfilerHook:
@@ -105,6 +319,17 @@ class ProfilerHook:
             self._write_summary()
 
     # -- summary views (reference eager_engine.py:866-925) -----------------
+    # thin instance seams over the module-level parsers: the on-demand
+    # serving capture shares them, and tests stub toolchain failures here
+
+    def _newest_run_dir(self) -> str:
+        return newest_run_dir(self.log_dir)
+
+    def _hlo_stats_rows(self):
+        return hlo_stats_rows(self.log_dir)
+
+    def _trace_event_rows(self):
+        return trace_event_rows(self.log_dir)
 
     def _write_summary(self) -> None:
         if not self.summary:
@@ -118,91 +343,12 @@ class ProfilerHook:
         except Exception as e:  # noqa: BLE001
             logger.warning(f"profiler: memory summary unavailable ({e!r})")
 
-    def _newest_run_dir(self) -> str:
-        import glob
-
-        runs = sorted(glob.glob(os.path.join(self.log_dir, "plugins", "profile", "*")))
-        if not runs:
-            raise FileNotFoundError(f"no profile runs under {self.log_dir}")
-        return runs[-1]
-
-    def _newest_xplanes(self):
-        import glob
-
-        run = self._newest_run_dir()
-        planes = sorted(glob.glob(os.path.join(run, "*.xplane.pb")))
-        if not planes:
-            raise FileNotFoundError(f"no xplane.pb under {run}")
-        return planes
-
-    def _hlo_stats_rows(self):
-        """Per-HLO-op rows from xprof's hlo_stats tool (populated on real
-        accelerator traces; CPU traces carry no device-op events)."""
-        import json
-
-        from xprof.convert import raw_to_tool_data  # lazy: pulls in TF
-
-        planes = self._newest_xplanes()
-        data, _ = raw_to_tool_data.xspace_to_tool_data(planes, "hlo_stats", {})
-        if isinstance(data, bytes):
-            data = data.decode()
-        with open(os.path.join(self.log_dir, "hlo_stats.json"), "w") as f:
-            f.write(data)
-
-        table = json.loads(data)
-        cols = [c["id"] for c in table["cols"]]
-        idx = {name: cols.index(name) for name in
-               ("category", "hlo_op_name", "occurrences", "total_time",
-                "total_self_time")}
-        rows = []
-        for row in table.get("rows", []):
-            vals = [cell.get("v") if isinstance(cell, dict) else cell for cell in row["c"]]
-            rows.append({
-                "op": vals[idx["hlo_op_name"]],
-                "category": vals[idx["category"]],
-                "occurrences": int(vals[idx["occurrences"]] or 0),
-                "total_us": float(vals[idx["total_time"]] or 0.0),
-                "self_us": float(vals[idx["total_self_time"]] or 0.0),
-            })
-        return rows
-
-    def _trace_event_rows(self):
-        """Fallback aggregation over the chrome-trace events: op name ->
-        occurrences + summed duration.  Available on every backend."""
-        import glob
-        import gzip
-        import json
-
-        run = self._newest_run_dir()
-        traces = sorted(glob.glob(os.path.join(run, "*.trace.json.gz")))
-        if not traces:
-            raise FileNotFoundError(f"no trace.json.gz under {run}")
-        agg: Dict[str, list] = {}
-        with gzip.open(traces[-1], "rt") as f:
-            events = json.load(f).get("traceEvents", [])
-        for e in events:
-            if e.get("ph") != "X" or "dur" not in e:
-                continue
-            entry = agg.setdefault(e.get("name", "?"), [0, 0.0])
-            entry[0] += 1
-            entry[1] += float(e["dur"])
-        return [
-            {"op": name, "category": "trace", "occurrences": n,
-             "total_us": dur, "self_us": dur}
-            for name, (n, dur) in agg.items()
-        ]
-
     def _write_op_summary(self) -> None:
-        try:
-            rows = self._hlo_stats_rows()
-            source = "hlo_stats"
-        except Exception as e:  # noqa: BLE001 — xprof missing / schema drift
-            logger.warning(f"profiler: hlo_stats unavailable ({e!r}); using trace events")
-            rows = []
-        if not rows:
-            rows = self._trace_event_rows()
-            source = "trace events (backend emits no per-HLO device stats)"
-        rows.sort(key=lambda r: -r["self_us"])
+        rows, source = op_summary_rows(
+            self.log_dir,
+            hlo_fn=self._hlo_stats_rows,
+            trace_fn=self._trace_event_rows,
+        )
         total_self = sum(r["self_us"] for r in rows) or 1.0
 
         lines = [

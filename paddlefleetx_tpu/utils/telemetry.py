@@ -244,6 +244,20 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "pfx_tenant_queue_depth": ("gauge", "Entries waiting in the scheduler's admission queue per tenant (labels: tenant)"),
     "pfx_tenant_ttft_seconds": ("histogram", "Time to first token per tenant (labels: tenant)"),
     "pfx_tenant_slo_burn_rate": ("gauge", "Short-window SLO burn rate per tenant (labels: tenant, objective)"),
+    # goodput ledgers (core/continuous_batching.py ContinuousScheduler,
+    # core/engine.py fit loop; docs/observability.md "Goodput ledger").
+    # The time buckets are exhaustive and mutually exclusive — their sum
+    # closes against pfx_sched_wall_seconds_total within 1%; the token
+    # dispositions close EXACTLY: admitted == delivered + evicted_lost +
+    # preempt_refunded + shed_after_admit + in_flight
+    "pfx_sched_time_seconds_total": ("counter", "Scheduler-thread wall seconds by attribution bucket (labels: bucket=device_decode|device_prefill|host_sched|readback|stream_flush|idle)"),
+    "pfx_sched_wall_seconds_total": ("counter", "Total scheduler-thread wall seconds the time buckets must close against"),
+    "pfx_sched_host_gap_seconds_total": ("counter", "Host seconds the device sat idle waiting for its next dispatch (goodput_frac subtrahend; overlaps the bucket family)"),
+    "pfx_train_time_seconds_total": ("counter", "Fit-loop wall seconds by attribution bucket (labels: bucket=compile|device_step|data_wait|host|eval)"),
+    "pfx_token_ledger_total": ("counter", "Admitted-token dispositions (labels: disposition=admitted|delivered|evicted_lost|preempt_refunded|shed_after_admit)"),
+    "pfx_token_ledger_in_flight": ("gauge", "Admitted tokens still on the books in live decode slots (the exact-closure remainder)"),
+    "pfx_tenant_slot_seconds_total": ("counter", "Decode-slot occupancy in slot-seconds per tenant — billing-grade cost attribution (labels: tenant)"),
+    "pfx_tenant_kv_block_seconds_total": ("counter", "KV-block occupancy in block-seconds per tenant (labels: tenant)"),
 }
 
 # latency-shaped default buckets (seconds): sub-ms to minutes, exponential-ish

@@ -656,10 +656,12 @@ def chrome_trace(traces: List[TraceContext]) -> Dict[str, Any]:
         if t_end is None:
             t_end = max([e["t"] + e["dur"] for e in evs], default=tc.t0)
         bar_end = max(tc.t0, t_end)
+        bar_ts = round(mono_to_epoch(tc.t0) * 1e6, 3)
+        bar_dur = round((bar_end - tc.t0) * 1e6, 3)
         events.append({
             "ph": "X",
-            "ts": round(mono_to_epoch(tc.t0) * 1e6, 3),
-            "dur": round((bar_end - tc.t0) * 1e6, 3),
+            "ts": bar_ts,
+            "dur": bar_dur,
             "pid": pid,
             "tid": tid,
             "name": tc.name,
@@ -674,10 +676,21 @@ def chrome_trace(traces: List[TraceContext]) -> Dict[str, Any]:
             ev_pid = ev.get("pid") or pid
             if ev_pid not in proc_names and ev.get("proc"):
                 proc_names[ev_pid] = _proc_label(ev["proc"])
+            # SECOND clamp, in the ROUNDED domain: epoch-anchored ts is
+            # ~2^50 us, where one float64 ulp is 0.25 us and round(x, 3)
+            # can no longer move a value — independently rounded child
+            # endpoints can overshoot the bar by a few ulps (the nesting
+            # flake under contended laps).  Clamping the exported
+            # numbers themselves keeps the document's nesting exact
+            # instead of merely within float error.
+            ts_c = max(round(mono_to_epoch(t0) * 1e6, 3), bar_ts)
+            dur_c = max(
+                0.0, min(round(dur * 1e6, 3), bar_ts + bar_dur - ts_c)
+            )
             events.append({
                 "ph": "X",
-                "ts": round(mono_to_epoch(t0) * 1e6, 3),
-                "dur": round(dur * 1e6, 3),
+                "ts": ts_c,
+                "dur": dur_c,
                 "pid": ev_pid,
                 "tid": tid,
                 "name": ev["name"],
@@ -744,6 +757,11 @@ def replay_decision_log(rows) -> Dict[str, Any]:
         "spill_discards": 0,
         "migrate_adopted": 0,
         "preempted": 0,
+        "tok_admitted": 0,
+        "tok_delivered": 0,
+        "tok_evicted_lost": 0,
+        "tok_preempt_refunded": 0,
+        "tok_shed_after_admit": 0,
         "tenants": {},
         "preempted_tenants": {},
     }
@@ -764,6 +782,11 @@ def replay_decision_log(rows) -> Dict[str, Any]:
         out["spill_discards"] += int(row.get("spill_discards", 0))
         out["migrate_adopted"] += int(row.get("migrate_adopted", 0))
         out["preempted"] += int(row.get("preempted", 0))
+        # token-ledger columns (PR 20): folding an untruncated log
+        # reproduces every pfx_token_ledger_total disposition exactly
+        for key in ("tok_admitted", "tok_delivered", "tok_evicted_lost",
+                    "tok_preempt_refunded", "tok_shed_after_admit"):
+            out[key] += int(row.get(key, 0))
         for tn, n in (row.get("tenants") or {}).items():
             out["tenants"][tn] = out["tenants"].get(tn, 0) + int(n)
         for tn, n in (row.get("preempted_tenants") or {}).items():

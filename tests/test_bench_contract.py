@@ -216,8 +216,10 @@ def test_bench_decode_happy_path_contract(tmp_path):
     oa = rows["gpt345m_decode_overlap_ahead"]
     os_ = rows["gpt345m_decode_overlap_sync"]
     for row in (oa, os_):
-        assert {"host_gap_ms", "gap_steps", "device_steps",
-                "dispatch_ahead", "batch"} <= set(row), row
+        # the overlap row's key set is pinned in the case file itself
+        # (expect_overlap_keys) so chip-day tooling and this lock can't
+        # drift apart
+        assert set(case["expect_overlap_keys"]) <= set(row), row
         assert row["device_steps"] > 0, row
     assert oa["dispatch_ahead"] is True and os_["dispatch_ahead"] is False
     assert oa["batch"] == os_["batch"]  # identical traffic
@@ -225,6 +227,13 @@ def test_bench_decode_happy_path_contract(tmp_path):
     # the sync side pays the gap on (nearly) every step; the ahead side
     # skips it on every chained dispatch
     assert oa["gap_steps"] < os_["gap_steps"], (oa, os_)
+    # goodput ledger view of the same window: the overlapped side keeps
+    # the device productive for a STRICTLY larger fraction of non-idle
+    # scheduler wall — the host_gap win restated in closed-ledger terms
+    for row in (oa, os_):
+        assert 0.0 < row["goodput_frac"] <= 1.0 + 1e-6, row
+        assert 0.0 < row["device_util"] <= row["goodput_frac"] + 1e-6, row
+    assert oa["goodput_frac"] > os_["goodput_frac"], (oa, os_)
     assert oa["greedy_divergent_rows"] == 0, oa
 
     # spill-tier A/B pair: the SAME prefix-heavy staggered trace with a
@@ -257,10 +266,14 @@ def test_bench_decode_happy_path_contract(tmp_path):
     # the fair side's trickle-tenant p99 TTFT is no worse than FCFS's
     # (DRR hands the weighted tenant the next free slot instead of
     # parking it behind the burst; measured margin on this smoke shape
-    # is ~2x, asserted as <= to stay timing-honest) — and exact greedy
-    # token identity at the f32 smoke dtype: scheduling order must
-    # never change what a row decodes (docs/serving.md "Multi-tenant
-    # isolation").
+    # is ~2x) — and exact greedy token identity at the f32 smoke dtype:
+    # scheduling order must never change what a row decodes
+    # (docs/serving.md "Multi-tenant isolation").  With 3 trickle
+    # arrivals p99 is the max, and the max is decided by WHERE the last
+    # arrival lands relative to a slot release — one decode-step of
+    # granularity either side — so the comparison carries one
+    # single-request decode of slack (the row's own calibration,
+    # single_decode_s) instead of a bare <= that flakes on slot phase.
     tf = rows["gpt345m_decode_tenant_fair"]
     tn = rows["gpt345m_decode_tenant_fcfs"]
     for row in (tf, tn):
@@ -273,7 +286,9 @@ def test_bench_decode_happy_path_contract(tmp_path):
     assert tf["arrivals"] == tn["arrivals"]
     assert tf["mean_gap_s"] == tn["mean_gap_s"]
     assert tf["weights"] == {"flood": 1, "trickle": 8}, tf
-    assert tf["trickle_p99_ttft_s"] <= tn["trickle_p99_ttft_s"], (tf, tn)
+    slack = tf["single_decode_s"]
+    assert tf["trickle_p99_ttft_s"] <= tn["trickle_p99_ttft_s"] + slack, (
+        tf, tn)
     assert tf["greedy_divergent_rows"] == 0, tf
     assert tn["greedy_divergent_rows"] == 0, tn
 

@@ -325,6 +325,14 @@ def test_metrics_file_stream(tmp_path, devices8):
     assert len(lines) == 2  # logging_freq=4, max_steps=8
     assert {"step", "loss", "lr", "grad_norm", "ips", "consumed_samples"} <= set(lines[0])
     assert lines[-1]["step"] == 8 and np.isfinite(lines[-1]["loss"])
+    # training goodput ledger rides every record (docs/observability.md
+    # "Goodput ledger"): exhaustive fit-loop buckets, all non-negative,
+    # with compile attributed on the record that paid it
+    led = lines[-1]["time_ledger"]
+    assert set(led) == {"compile", "device_step", "data_wait", "host",
+                        "eval"}
+    assert all(v >= 0.0 for v in led.values()), led
+    assert sum(led.values()) > 0.0, led
 
 
 def _fake_ckpt(root, step, payload="state", meta=True, metadata=True, data=True):

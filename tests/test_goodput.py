@@ -1,0 +1,644 @@
+"""Serving goodput ledger closure (`make test-goodput`,
+docs/observability.md "Goodput ledger" + "On-demand profiling"):
+
+  in-process   the scheduler time ledger's six exhaustive buckets close
+               against scheduler-thread wall within 1%, and the token
+               ledger closes EXACTLY (admitted == delivered +
+               evicted_lost + preempt_refunded + shed_after_admit +
+               in_flight) under a seeded mix of admissions, a true
+               mid-decode eviction, a partial-admission expiry, a
+               forced preemption, deadline sheds, and streaming —
+               with the decision-log replay folding every disposition
+               to the same totals
+  cli-ledger   the same closure drilled through the REAL tools/serve.py
+               CLI: a preempt-storm replica and a step-hang replica
+               together produce >=1 eviction, >=1 preemption and >=1
+               post-admission shed; each replica's /metrics books close
+               exactly at quiescence, its time buckets close within 1%,
+               and GET /debug/state's decision log replays to the same
+               token totals
+  cli-profile  POST /admin/profile through tools/router.py captures a
+               live jax.profiler trace on a decoding replica and
+               returns the merged op summary; no token -> 401, a
+               concurrent capture -> 409, over PFX_PROFILE_MAX_SECONDS
+               -> 400
+
+Follows tests/test_tenant_drills.py conventions for the drills
+(`fault`-marked, subprocess-driven, tiny synthetic GPT, warm XLA
+compile cache via tests/conftest.py).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = {
+    "Global": {"global_batch_size": 8, "seed": 5},
+    "Engine": {"mix_precision": {"enable": False},
+               "save_load": {"save_steps": 0}},
+    "Model": {
+        "module": "GPTModule",
+        "vocab_size": 96,
+        "hidden_size": 32,
+        "num_layers": 2,
+        "num_attention_heads": 4,
+        "max_position_embeddings": 128,
+        "dtype": "float32",
+    },
+    "Distributed": {},
+    "Optimizer": {"name": "FusedAdamW",
+                  "lr": {"name": "Constant", "learning_rate": 1e-3}},
+    "Generation": {"max_dec_len": 8, "decode_strategy": "greedy_search",
+                   "pad_to_multiple": 16, "eos_token_id": 95,
+                   "pad_token_id": 0},
+}
+
+PROMPTS = [[1, 2, 3], [4, 5, 6, 7, 8], [9, 10], [11, 12, 13, 14]]
+
+BUCKETS = {"device_decode", "device_prefill", "host_sched",
+           "readback", "stream_flush", "idle"}
+TERMINAL = ("delivered", "evicted_lost", "preempt_refunded",
+            "shed_after_admit")
+
+
+@pytest.fixture(scope="module")
+def server():
+    import jax
+
+    from paddlefleetx_tpu.core.module import build_module
+    from paddlefleetx_tpu.core.serving import GenerationServer
+    from paddlefleetx_tpu.parallel.env import init_dist_env
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    cfg = AttrDict.from_nested(TINY)
+    cfg = process_configs(cfg, num_devices=jax.device_count())
+    mesh = init_dist_env(cfg)
+    module = build_module(cfg)
+    return GenerationServer(cfg, mesh, module)
+
+
+def _engine(server, **kw):
+    from paddlefleetx_tpu.core.continuous_batching import PagedDecodeEngine
+
+    kw.setdefault("max_batch", 4)
+    return PagedDecodeEngine(server, **kw)
+
+
+def _assert_time_closure(ledger, max_drift=0.01):
+    """The exhaustiveness contract: bucket names exactly, every bucket
+    non-negative, and the sum closes against wall within 1%."""
+    assert set(ledger["buckets"]) == BUCKETS, ledger
+    assert all(v >= 0.0 for v in ledger["buckets"].values()), ledger
+    wall = ledger["wall_s"]
+    assert wall > 0.0, ledger
+    drift = abs(sum(ledger["buckets"].values()) - wall)
+    assert drift <= max(max_drift * wall, 1e-6), (drift, ledger)
+
+
+def _assert_token_closure(ledger):
+    """The bank contract, EXACT: every admitted token has a terminal
+    disposition (or sits on a live row, counted in_flight)."""
+    assert ledger["admitted"] == sum(
+        ledger[d] for d in TERMINAL
+    ) + ledger["in_flight"], ledger
+
+
+# ---------------------------------------------------------------------------
+# in-process: time-ledger closure
+# ---------------------------------------------------------------------------
+
+
+def test_time_ledger_buckets_close_against_wall(server):
+    """A plain served batch: the six buckets are exhaustive and
+    mutually exclusive, so their sum closes against the scheduler
+    thread's own wall clock within 1% — and real decode work lands in
+    the device buckets, not in a catch-all."""
+    from paddlefleetx_tpu.core.continuous_batching import ContinuousScheduler
+
+    eng = _engine(server)
+    sched = ContinuousScheduler(eng, max_depth=16)
+    sched.warmup([4])
+    sched.start()
+    futs = [sched.submit([p], 6, deadline_s=120) for p in PROMPTS]
+    outs = [f.result(timeout=300)[0] for f in futs]
+    assert all(len(o) >= 1 for o in outs)
+    assert sched.shutdown(timeout=60)
+
+    tl = sched.time_ledger()
+    _assert_time_closure(tl)
+    assert tl["buckets"]["device_decode"] > 0.0, tl
+    # readback + host bookkeeping happened and was attributed somewhere
+    assert tl["buckets"]["readback"] > 0.0, tl
+    # the metrics families mirror the accessor exactly (per-instance
+    # collect(), no registry round-trip to conflate instances)
+    mets = {(name, frozenset(labels.items())): v
+            for name, labels, v in sched.collect()}
+    for b, v in tl["buckets"].items():
+        assert mets[
+            ("pfx_sched_time_seconds_total", frozenset({("bucket", b)}))
+        ] == pytest.approx(v, abs=2e-6)
+    assert mets[
+        ("pfx_sched_wall_seconds_total", frozenset())
+    ] == pytest.approx(tl["wall_s"], abs=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# in-process: token-ledger exact closure under a seeded adversarial mix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_token_ledger_exact_closure_seeded_mix(server, monkeypatch, seed):
+    """THE closure property test: one scheduler's books survive a true
+    mid-decode eviction, a partial-admission expiry (shed_after_admit),
+    a forced preemption (preempt_storm), queue-level deadline sheds,
+    streaming, and a seeded random traffic tail — and close EXACTLY,
+    with the decision-log replay reproducing every disposition and the
+    time buckets closing within 1%."""
+    from paddlefleetx_tpu.core.continuous_batching import ContinuousScheduler
+    from paddlefleetx_tpu.core.request_queue import DeadlineExceeded
+    from paddlefleetx_tpu.utils import resilience
+    from paddlefleetx_tpu.utils.tracing import replay_decision_log
+
+    resilience.reset_fault_state()
+    eng = _engine(server, max_batch=4)
+    sched = ContinuousScheduler(eng, max_depth=32, preempt_min_tokens=2)
+
+    # -- phase 1 (hand-driven): a TRUE mid-decode eviction of a fully
+    # admitted row — force its deadline into the past AFTER it decoded
+    doomed = sched.submit([PROMPTS[1]], 64, deadline_s=60)
+    sched._iterate()
+    assert eng.active_rows() == 1
+    row = next(r for r in eng.slots if r is not None)
+    row.entry.deadline = time.monotonic() - 1.0
+    sched._iterate()
+    assert sched.stats["evictions"] == 1
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=10)
+    ledger = sched.token_ledger()
+    assert ledger["evicted_lost"] >= 1, ledger
+    _assert_token_closure(ledger)
+
+    # -- phase 2 (hand-driven): partial-admission expiry — two more
+    # prompts than the engine has slots seats a full batch and leaves
+    # the remainder pending; expiring the entry while split books its
+    # on-board tokens as shed_after_admit
+    rng = np.random.default_rng(seed)
+    over = [rng.integers(1, 90, int(n)).tolist()
+            for n in rng.integers(2, 8, eng.capacity + 2)]
+    partial = sched.submit(over, 64, deadline_s=60)
+    sched._iterate()
+    assert 0 < eng.active_rows() <= eng.capacity
+    entry = next(r for r in eng.slots if r is not None).entry
+    assert entry.next_row < len(entry.prompts), "not partially admitted"
+    entry.deadline = time.monotonic() - 1.0
+    sched._iterate()
+    with pytest.raises(DeadlineExceeded):
+        partial.result(timeout=10)
+    ledger = sched.token_ledger()
+    assert ledger["shed_after_admit"] >= 1, ledger
+    _assert_token_closure(ledger)
+
+    # -- phase 3 (threaded): forced preemption two iterations out, plus
+    # a streaming + plain seeded tail and a queue-level deadline shed
+    # (never admitted -> must NOT touch the token books)
+    # fire after the wave's rows have >= preempt_min_tokens committed
+    # (admission at +1, so +5 leaves ~4 decode steps of progress)
+    monkeypatch.setenv(
+        "PFX_FAULT", f"preempt_storm:{sched._iter_counter + 5}"
+    )
+    resilience.reset_fault_state()
+    streams = {i: [] for i in range(len(PROMPTS))}
+    sched.start()
+    futs = [
+        sched.submit(
+            [p], 6, deadline_s=120,
+            stream=(lambda i: lambda r, s, t:
+                    streams[i].append((s, list(t))))(i),
+        )
+        for i, p in enumerate(PROMPTS)
+    ]
+    tail = [
+        sched.submit(
+            [rng.integers(1, 90, int(rng.integers(1, 12))).tolist()],
+            int(rng.integers(1, 8)), deadline_s=120,
+        )
+        for _ in range(6)
+    ]
+    outs = [f.result(timeout=300)[0] for f in futs]
+    tail_outs = [f.result(timeout=300)[0] for f in tail]
+    monkeypatch.delenv("PFX_FAULT")
+    resilience.reset_fault_state()
+    assert sched.stats["preemptions"] == 1
+
+    shed0 = sched.token_ledger()["admitted"]
+    late = sched.submit([PROMPTS[0]], 4, deadline_s=0.00001)
+    with pytest.raises(DeadlineExceeded):
+        late.result(timeout=30)
+    assert sched.stats["shed_deadline"] >= 1
+    assert sched.shutdown(timeout=60)
+
+    # -- the books, at quiescence: EXACT closure, nothing in flight,
+    # every disposition exercised at least once in this mix
+    ledger = sched.token_ledger()
+    assert ledger["in_flight"] == 0
+    _assert_token_closure(ledger)
+    for d in TERMINAL:
+        assert ledger[d] >= 1, (d, ledger)
+    delivered = sum(len(o) for o in outs) + sum(len(o) for o in tail_outs)
+    assert ledger["delivered"] == delivered, (ledger, delivered)
+    # the queue-level shed never admitted a token
+    assert ledger["admitted"] >= shed0  # monotone...
+    # streams reassemble into exactly the delivered outputs (offsets
+    # survived the preempt-resume rebase)
+    for i in range(len(PROMPTS)):
+        acc = []
+        for start, toks in streams[i]:
+            assert start == len(acc), f"row {i}: hole/overlap at {start}"
+            acc.extend(toks)
+        assert acc == outs[i]
+
+    # -- replay agreement: the decision log folds to the same totals
+    replay = replay_decision_log(sched.decision_log)
+    assert replay["tok_admitted"] == ledger["admitted"]
+    for d in TERMINAL:
+        assert replay[f"tok_{d}"] == ledger[d], (d, replay, ledger)
+
+    # -- and the time books on the same instance close within 1%
+    _assert_time_closure(sched.time_ledger())
+
+
+def test_tenant_occupancy_books_accrue(server):
+    """Cost attribution: decode-slot seconds and KV-block seconds
+    accrue under the request's tenant label and surface both in the
+    collect() families and the /debug/state goodput block."""
+    from paddlefleetx_tpu.core.continuous_batching import ContinuousScheduler
+
+    eng = _engine(server)
+    sched = ContinuousScheduler(eng, max_depth=16)
+    sched.start()
+    futs = [sched.submit([p], 6, deadline_s=120, tenant="acme")
+            for p in PROMPTS[:2]]
+    for f in futs:
+        f.result(timeout=300)
+    assert sched.shutdown(timeout=60)
+
+    occ = {
+        labels["tenant"]: v
+        for name, labels, v in sched.collect()
+        if name == "pfx_tenant_slot_seconds_total"
+    }
+    assert occ.get("acme", 0.0) > 0.0, occ
+    kv = {
+        labels["tenant"]: v
+        for name, labels, v in sched.collect()
+        if name == "pfx_tenant_kv_block_seconds_total"
+    }
+    assert kv.get("acme", 0.0) > 0.0, kv
+    dbg = sched._engine_debug_view()
+    ten = dbg["goodput"]["tenant_occupancy"]
+    assert ten["acme"]["slot_s"] > 0.0 and ten["acme"]["kv_block_s"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# CLI drills (fault-marked): real serve.py / router.py subprocesses
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env["PFX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("PFX_FAULT", None)
+    env.update(extra or {})
+    return env
+
+
+def _post(port, body, *, headers=None, timeout=90, path="/generate"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.load(r), dict(r.headers.items())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers.items())
+
+
+def _get(port, path, timeout=10, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", headers=headers or {}
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+def _metrics(port, timeout=10):
+    from test_telemetry import parse_prometheus
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=timeout
+    ) as r:
+        metrics, _ = parse_prometheus(r.read().decode())
+    return metrics
+
+
+def _fam(mets, name):
+    """{label_value_or_(): value} for one family, single-label."""
+    out = {}
+    for labels, v in mets.get(name, {}).items():
+        key = dict(labels)
+        out[tuple(sorted(key.values()))[0] if key else ""] = v
+    return out
+
+
+def _spawn_replica(cfg_path, port, *extra, extra_env=None):
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "-c", str(cfg_path), "--port", str(port),
+         "--queue-depth", "32", "--deadline", "60",
+         "--warmup-buckets", "4", "--warmup-batches", "1",
+         "--scheduler", "continuous", "--cb-batch", "4",
+         "--kv-blocks", "16", *extra],
+        env=_env(extra_env), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _wait_healthy(procs_ports, timeout=300):
+    end = time.time() + timeout
+    pending = dict(procs_ports)
+    while pending and time.time() < end:
+        for port, proc in list(pending.items()):
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"process on {port} died at boot: "
+                    f"{proc.stdout.read()[-3000:]}"
+                )
+            try:
+                if _get(port, "/healthz", timeout=5).get("ok"):
+                    del pending[port]
+            except Exception:
+                pass
+        time.sleep(0.3)
+    assert not pending, f"never healthy: {sorted(pending)}"
+
+
+def _finish(proc, timeout=30):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+    return proc.stdout.read()
+
+
+def _write_cfg(tmp_path):
+    import yaml
+
+    cfg_path = tmp_path / "tiny_serve.yaml"
+    cfg_path.write_text(yaml.safe_dump(TINY))
+    return cfg_path
+
+
+def _assert_cli_books_close(port):
+    """Exact token closure + 1%-time closure off a live /metrics scrape,
+    then decision-log replay agreement off /debug/state.  Returns the
+    token family for mix-wide disposition asserts."""
+    from paddlefleetx_tpu.utils.tracing import replay_decision_log
+
+    mets = _metrics(port)
+    tok = _fam(mets, "pfx_token_ledger_total")
+    in_flight = _fam(mets, "pfx_token_ledger_in_flight").get("", 0)
+    assert in_flight == 0, mets.get("pfx_token_ledger_in_flight")
+    assert tok.get("admitted", 0) == sum(
+        tok.get(d, 0) for d in TERMINAL
+    ), tok
+    assert tok.get("admitted", 0) > 0, tok
+
+    buckets = _fam(mets, "pfx_sched_time_seconds_total")
+    wall = _fam(mets, "pfx_sched_wall_seconds_total").get("", 0.0)
+    assert set(buckets) == BUCKETS, buckets
+    assert wall > 0.0
+    drift = abs(sum(buckets.values()) - wall)
+    assert drift <= max(0.01 * wall, 1e-4), (drift, buckets, wall)
+
+    dbg = _get(port, "/debug/state")
+    replay = replay_decision_log(dbg["decisions"])
+    assert replay["tok_admitted"] == tok.get("admitted", 0), (replay, tok)
+    for d in TERMINAL:
+        assert replay[f"tok_{d}"] == tok.get(d, 0), (d, replay, tok)
+    return tok
+
+
+@pytest.mark.fault
+def test_token_ledger_closes_through_real_cli(tmp_path):
+    """Closure end-to-end through the real CLI under a faulted mix:
+    replica A rides a preempt storm (>=1 preemption), replica B wedges
+    mid-decode twice past client deadlines (>=1 full-row eviction, then
+    >=1 partial-admission shed).  Each replica's books close EXACTLY on
+    /metrics at quiescence, its time buckets close within 1%, and the
+    /debug/state decision log replays to the same totals."""
+    cfg_path = _write_cfg(tmp_path)
+    aport, bport = _free_port(), _free_port()
+    rep_a = _spawn_replica(
+        cfg_path, aport, "--preempt-min-tokens", "2",
+        extra_env={"PFX_FAULT": "preempt_storm:6"},
+    )
+    rep_b = _spawn_replica(
+        cfg_path, bport, "--shed-slack", "1",
+        extra_env={"PFX_FAULT": "cb_step_hang:2:2",
+                   "PFX_FAULT_HANG_S": "3.0"},
+    )
+    try:
+        _wait_healthy({aport: rep_a, bport: rep_b})
+
+        # -- replica A: concurrent wave through the storm, all 200
+        results = [None] * 3
+        prompts = [[1, 2, 3], [4, 5, 6, 7, 8], [9, 10]]
+
+        def worker(i):
+            results[i] = _post(
+                aport, {"prompt_ids": prompts[i], "max_tokens": 8},
+                timeout=120,
+            )
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=150)
+            assert not t.is_alive(), "request hung across the storm"
+        assert all(r is not None and r[0] == 200 for r in results), results
+
+        # -- replica B, wave 1: one fully-admitted row wedged 3s past
+        # its 2s deadline -> honest 503, evicted_lost on the books
+        code, body, _ = _post(
+            bport, {"prompt_ids": [1, 2, 3], "max_tokens": 8,
+                    "deadline_s": 2.0}, timeout=60,
+        )
+        assert code == 503, (code, body)
+
+        # -- replica B, wave 2: 6 prompts into 4 slots seats 4 and
+        # leaves 2 pending; the second wedge expires the entry while
+        # PARTIALLY admitted -> shed_after_admit
+        code, body, _ = _post(
+            bport, {"prompts_ids": [[i + 1, i + 2] for i in range(6)],
+                    "max_tokens": 8, "deadline_s": 2.0}, timeout=60,
+        )
+        assert code == 503, (code, body)
+
+        # -- replica B delivers again after the wedges drain
+        code, body, _ = _post(
+            bport, {"prompt_ids": [7, 8, 9], "max_tokens": 4}, timeout=120
+        )
+        assert code == 200 and body["completion_ids"], body
+
+        tok_a = _assert_cli_books_close(aport)
+        tok_b = _assert_cli_books_close(bport)
+        # the drill's mix-wide guarantee: every disposition happened
+        assert tok_a.get("preempt_refunded", 0) >= 1, tok_a
+        assert tok_b.get("evicted_lost", 0) >= 1, tok_b
+        assert tok_b.get("shed_after_admit", 0) >= 1, tok_b
+        assert tok_a.get("delivered", 0) >= 1
+        assert tok_b.get("delivered", 0) >= 1
+    finally:
+        log_a = _finish(rep_a)
+        log_b = _finish(rep_b)
+    assert rep_a.returncode == 0, log_a[-3000:]
+    assert rep_b.returncode == 0, log_b[-3000:]
+    assert "Traceback" not in log_a, log_a[-3000:]
+    assert "Traceback" not in log_b, log_b[-3000:]
+
+
+@pytest.mark.fault
+def test_fleet_profile_capture_through_router(tmp_path):
+    """On-demand fleet profiling end-to-end: POST /admin/profile on the
+    router fans out to the live replica mid-decode and answers with a
+    merged op summary; a missing admin token is 401, a concurrent
+    capture is a loud 409, and a request over PFX_PROFILE_MAX_SECONDS
+    is 400 at the replica."""
+    cfg_path = _write_cfg(tmp_path)
+    sport, rport = _free_port(), _free_port()
+    token = "drill-profile-token"
+    env = {"PFX_ADMIN_TOKEN": token, "PFX_PROFILE_MAX_SECONDS": "10",
+           "PFX_FLIGHT_DIR": str(tmp_path / "flight")}
+    replica = _spawn_replica(cfg_path, sport, extra_env=env)
+    router = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "router.py"),
+         "--port", str(rport), "--poll-interval", "0.2",
+         "--replica", f"http://127.0.0.1:{sport}"],
+        env=_env(env), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    auth = {"Authorization": f"Bearer {token}"}
+    stop = threading.Event()
+
+    def decode_load():
+        while not stop.is_set():
+            _post(sport, {"prompt_ids": [1, 2, 3, 4], "max_tokens": 8},
+                  timeout=60)
+            # keep the replica decoding THROUGHOUT the capture without
+            # starving the profile handler's trace parse of the GIL
+            time.sleep(0.05)
+
+    load = threading.Thread(target=decode_load, daemon=True)
+    try:
+        _wait_healthy({sport: replica, rport: router})
+        end = time.time() + 30
+        while time.time() < end:
+            if _get(rport, "/healthz").get("eligible", 0) >= 1:
+                break
+            time.sleep(0.2)
+
+        # 401 first: no admin token, nothing captured
+        code, body, _ = _post(rport, {"seconds": 1.0},
+                              path="/admin/profile", timeout=30)
+        assert code == 401, (code, body)
+
+        # the real capture, from a replica decoding THROUGHOUT it
+        load.start()
+        time.sleep(0.5)
+        code, body, _ = _post(
+            rport, {"seconds": 1.5}, headers=auth,
+            path="/admin/profile", timeout=420,
+        )
+        assert code == 200, (code, body)
+        assert body["captured"] == 1 and body["requested"] == 1, body
+        (rep,) = body["replicas"].values()
+        assert rep["status"] == 200 and rep["replica_id"], rep
+        assert rep["op_count"] >= 1 and rep["source"], rep
+        # the merged fleet table carries real ops with durations
+        assert body["top_ops"], body
+        assert all(op["self_us"] >= 0 and op["op"]
+                   for op in body["top_ops"]), body["top_ops"]
+        assert body["device_us"] + body["host_us"] > 0.0, body
+        # the durable summary landed under the flight dir for report.py
+        found = []
+        for root, _dirs, files in os.walk(tmp_path / "flight"):
+            found += [os.path.join(root, f) for f in files
+                      if f == "profile_summary.json"]
+        assert found, "profile_summary.json not written to flight dir"
+        disk = json.load(open(found[0]))
+        assert disk["replica_id"] == rep["replica_id"], disk
+
+        # overlap guard: a second operator mid-capture is refused loudly
+        first = {}
+
+        def long_capture():
+            first["resp"] = _post(
+                rport, {"seconds": 4.0}, headers=auth,
+                path="/admin/profile", timeout=420,
+            )
+
+        t = threading.Thread(target=long_capture)
+        t.start()
+        time.sleep(1.0)
+        code, body, _ = _post(
+            rport, {"seconds": 1.0}, headers=auth,
+            path="/admin/profile", timeout=30,
+        )
+        assert code == 409, (code, body)
+        assert "active" in body["error"], body
+        t.join(timeout=430)
+        assert not t.is_alive(), "long capture never returned"
+        assert first["resp"][0] == 200, first["resp"][:2]
+
+        # duration cap: over PFX_PROFILE_MAX_SECONDS is an honest 400
+        code, body, _ = _post(
+            sport, {"seconds": 60.0}, headers=auth,
+            path="/admin/profile", timeout=30,
+        )
+        assert code == 400, (code, body)
+        assert "PFX_PROFILE_MAX_SECONDS" in body["error"], body
+    finally:
+        stop.set()
+        if load.is_alive():
+            load.join(timeout=70)
+        rlog = _finish(router)
+        slog = _finish(replica)
+    assert replica.returncode == 0, slog[-3000:]
+    assert "Traceback" not in slog, slog[-3000:]
+    assert "Traceback" not in rlog, rlog[-3000:]

@@ -10,6 +10,7 @@ live `/debug/traces` endpoint.
 """
 
 import json
+import math
 import os
 import sys
 import time
@@ -51,12 +52,19 @@ def validate_chrome_trace(doc):
         assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0, ev
         assert isinstance(ev["name"], str) and ev["name"], ev
         lanes.setdefault((ev["pid"], ev["tid"]), []).append(ev)
-    # tolerance: chrome_trace rounds ts and dur INDEPENDENTLY to 1e-3 µs,
-    # so a child clamped exactly to its parent's end can overshoot by up
-    # to ~2e-3 µs after rounding — 0.01 µs (10 ns) absorbs that while
-    # still catching any real partial overlap
-    eps = 1e-2
+    # tolerance: chrome_trace rounds ts and dur INDEPENDENTLY, and at
+    # epoch-anchored magnitude (~2^50 µs) one float64 ulp is 0.25 µs —
+    # round(x, 3) can no longer move a value there, and the ts+dur
+    # arithmetic BELOW accumulates a few ulps of its own even on a
+    # perfectly clamped document.  Scale the tolerance with the lane's
+    # magnitude (4 ulps ≈ 1 µs at epoch scale; floor 0.01 µs for small
+    # synthetic fixtures) — a real partial overlap is milliseconds.
     for lane, evs in lanes.items():
+        eps = max(
+            1e-2,
+            4 * math.ulp(max((abs(e["ts"]) + e["dur"] for e in evs),
+                             default=0.0)),
+        )
         # sort like Perfetto: by start, widest first at equal starts
         evs.sort(key=lambda e: (e["ts"], -e["dur"]))
         stack = []
@@ -211,6 +219,69 @@ def test_export_chrome_trace_lands_in_flight_dir(tmp_path, monkeypatch):
                                   buffer=_traced_buffer()) is None
 
 
+def test_chrome_trace_nesting_tolerates_epoch_scale_rounding():
+    """Regression pin for the PR 16/19 nesting flake: at epoch-anchored
+    magnitude (~1.75e15 µs, between 2**50 and 2**51) one float64 ulp is
+    0.25 µs — round(x, 3) can no longer move a value, and a child
+    rounded independently of its parent can overshoot the parent's end
+    by a few ulps.  This document replicates a captured flaky export
+    (child end 0.25 µs past the bar end); the validator must accept it
+    while still rejecting a REAL partial overlap at the same scale."""
+    base = 1754500000000000.0  # epoch µs at the flake's magnitude
+    assert math.ulp(base) == 0.25
+    flaky = {"traceEvents": [
+        {"ph": "X", "ts": base, "dur": 10.0, "pid": 7, "tid": 1,
+         "name": "request", "cat": "trace", "args": {}},
+        # ends one ulp past the bar: the rounding artifact, not overlap
+        {"ph": "X", "ts": base + 8.0, "dur": 2.25, "pid": 7, "tid": 1,
+         "name": "decode", "cat": "request", "args": {}},
+    ]}
+    validate_chrome_trace(flaky)
+    real_overlap = {"traceEvents": [
+        {"ph": "X", "ts": base, "dur": 10.0, "pid": 7, "tid": 1,
+         "name": "request", "cat": "trace", "args": {}},
+        # ends 5 µs (20 ulps) past the bar: a genuine partial overlap
+        {"ph": "X", "ts": base + 8.0, "dur": 7.0, "pid": 7, "tid": 1,
+         "name": "decode", "cat": "request", "args": {}},
+    ]}
+    with pytest.raises(AssertionError, match="partially"):
+        validate_chrome_trace(real_overlap)
+
+
+def test_chrome_trace_export_clamps_children_in_rounded_domain():
+    """The exporter's post-rounding clamp: exported child endpoints
+    never overshoot their enclosing bar, even though every ts is
+    epoch-anchored (where independent rounding used to let them drift a
+    few ulps past it — the nesting flake's source)."""
+    buf = TR.TraceBuffer(sample=1.0, cap=64)
+    t0 = time.monotonic()
+    for k in range(32):
+        # children ending exactly at the bar end, at awkward offsets —
+        # the rounding-sensitive shape
+        b = t0 + k * 0.010001
+        tc = buf.maybe_start("request", t0=b, kind="unit")
+        tc.span("decode", t0=b + 0.0012345, t1=b + 0.0098765)
+        tc.finish(t=b + 0.0098765)
+    doc = TR.chrome_trace(buf.traces())
+    bars = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") == "X" and ev["cat"] == "trace":
+            bars[ev["tid"]] = (ev["ts"], ev["ts"] + ev["dur"])
+    assert len(bars) == 32
+    children = 0
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") != "X" or ev["cat"] == "trace":
+            continue
+        children += 1
+        bar_ts, bar_end = bars[ev["tid"]]
+        assert ev["ts"] >= bar_ts
+        # within one ulp of the bar end (the clamp's ts + (E - ts)
+        # re-add is the only remaining float step)
+        assert ev["ts"] + ev["dur"] <= bar_end + math.ulp(bar_end)
+    assert children == 32
+    validate_chrome_trace(doc)
+
+
 # ---------------------------------------------------------------------------
 # decision-log replay
 # ---------------------------------------------------------------------------
@@ -235,6 +306,9 @@ def test_replay_decision_log_sums_rows():
         "migrate_adopted": 0,
         # multi-tenant columns default to empty/0 on legacy rows
         "tenants": {}, "preempted": 0, "preempted_tenants": {},
+        # token-ledger columns (PR 20) default to 0 on legacy rows
+        "tok_admitted": 0, "tok_delivered": 0, "tok_evicted_lost": 0,
+        "tok_preempt_refunded": 0, "tok_shed_after_admit": 0,
     }
 
 
@@ -341,6 +415,23 @@ def test_decision_log_replay_reproduces_counters_exactly(server):
     assert rows[-1]["blocks_free"] == eng.cache.allocator.free_count()
     # width buckets recorded as positive pow2s
     assert all(r["width_bucket"] >= 1 for r in rows)
+    # token-ledger agreement + closure (PR 20): the replay fold
+    # reproduces every disposition exactly, and the drained books close
+    # with nothing in flight — admitted == delivered + evicted_lost +
+    # preempt_refunded + shed_after_admit
+    ledger = sched.token_ledger()
+    assert replay["tok_admitted"] == ledger["admitted"]
+    assert replay["tok_delivered"] == ledger["delivered"]
+    assert replay["tok_evicted_lost"] == ledger["evicted_lost"]
+    assert replay["tok_preempt_refunded"] == ledger["preempt_refunded"]
+    assert replay["tok_shed_after_admit"] == ledger["shed_after_admit"]
+    assert ledger["in_flight"] == 0
+    assert ledger["admitted"] == (
+        ledger["delivered"] + ledger["evicted_lost"]
+        + ledger["preempt_refunded"] + ledger["shed_after_admit"]
+    )
+    assert ledger["delivered"] == sum(len(o) for o in outs)
+    assert ledger["evicted_lost"] >= 1  # the doomed row had decoded
 
 
 def test_request_trace_carries_full_continuous_timeline(server):

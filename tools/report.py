@@ -83,6 +83,15 @@ class RunData:
         self.compiles: List[Dict[str, Any]] = []
         self.flight_header: Optional[Dict[str, Any]] = None
         self.trace_summary: Optional[Dict[str, Any]] = None
+        self.profile: Optional[Dict[str, Any]] = None
+
+    def add_profile(self, path: str) -> None:
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"profile summary {path} is not an object")
+        self.profile = doc
+        self.sources.append(f"profile: {path}")
 
     def _ingest_row(self, row: Dict[str, Any], prefer: bool) -> None:
         kind = row.get("event", "step" if "loss" in row else None)
@@ -214,6 +223,30 @@ def _first_existing(*paths: str) -> Optional[str]:
     return None
 
 
+def find_profile_summary(args) -> Optional[str]:
+    """Resolve an on-demand profile capture's ``profile_summary.json``
+    (tools/serve.py POST /admin/profile): an explicit ``--profile PATH``
+    wins, then the NEWEST capture under the conventional
+    ``<dir>/profiles/<ts>/`` layout in --run-dir / $PFX_FLIGHT_DIR /
+    ./artifacts."""
+    import glob
+
+    prof = getattr(args, "profile", None)
+    if prof and prof != "auto":
+        return prof
+    roots = []
+    if getattr(args, "run_dir", None):
+        roots += [args.run_dir, os.path.join(args.run_dir, "artifacts")]
+    roots.append(os.environ.get("PFX_FLIGHT_DIR") or "artifacts")
+    for root in roots:
+        hits = sorted(glob.glob(
+            os.path.join(root, "profiles", "*", "profile_summary.json")
+        ))
+        if hits:
+            return hits[-1]
+    return None
+
+
 # ---------------------------------------------------------------------------
 # fleet artifact (core/router.FleetLog JSONL)
 # ---------------------------------------------------------------------------
@@ -232,6 +265,15 @@ class FleetData:
         self.router_rows: List[Dict[str, Any]] = []
         self.scale_events: List[Dict[str, Any]] = []
         self.t0: Optional[float] = None
+        self.profile: Optional[Dict[str, Any]] = None
+
+    def add_profile(self, path: str) -> None:
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"profile summary {path} is not an object")
+        self.profile = doc
+        self.sources.append(f"profile: {path}")
 
     def add(self, path: str) -> None:
         bad = 0
@@ -655,6 +697,15 @@ def render_html(data: RunData, title: str) -> str:
         )
     out.append("</table>")
 
+    gp = train_goodput_rows(data)
+    if gp:
+        out.append("<h2>Goodput ledger</h2>")
+        out.append(_html_table(_GOODPUT_TRAIN_COLS, gp))
+    if data.profile:
+        out.append("<h2>On-demand profile</h2>")
+        out.append(f"<p>{html.escape(profile_caption(data.profile))}</p>")
+        out.append(_html_table(_PROFILE_COLS, profile_rows(data.profile)))
+
     out.append("<h2>Curves</h2>")
     out.append(svg_line("loss", data.series("loss"), "#2563eb", markers))
     out.append(svg_line("learning rate", data.series("lr"), "#7c3aed", markers))
@@ -765,6 +816,151 @@ def tenant_rows(data: FleetData) -> List[List[str]]:
 _TENANT_COLS = ("tenant", "weight", "rps", "max in-flight", "in flight")
 
 
+# ---------------------------------------------------------------------------
+# goodput ledger + on-demand profile views (docs/observability.md
+# "Goodput ledger" / "On-demand profiling")
+# ---------------------------------------------------------------------------
+
+
+def _html_table(cols, rows) -> str:
+    out = ["<table><tr>" + "".join(
+        f"<th>{html.escape(str(c))}</th>" for c in cols) + "</tr>"]
+    for r in rows:
+        out.append("<tr>" + "".join(
+            f"<td>{html.escape(str(c))}</td>" for c in r) + "</tr>")
+    out.append("</table>")
+    return "\n".join(out)
+
+
+def _md_table(cols, rows) -> List[str]:
+    lines = ["| " + " | ".join(str(c) for c in cols) + " |",
+             "|" + "---|" * len(cols)]
+    for r in rows:
+        lines.append("| " + " | ".join(
+            str(c).replace("|", "\\|") for c in r) + " |")
+    return lines
+
+
+def train_goodput_rows(data: RunData) -> List[List[str]]:
+    """Stacked time-ledger breakdown off the LAST step record carrying
+    one (core/engine.py ``time_ledger``: the fit's cumulative wall
+    seconds per bucket, exhaustive by construction)."""
+    for s in reversed(data.steps()):
+        led = data.records[s].get("time_ledger")
+        if isinstance(led, dict) and led:
+            total = sum(float(v) for v in led.values()) or 1.0
+            return [
+                [k, f"{float(v):.3f}", f"{100.0 * float(v) / total:.1f}%"]
+                for k, v in sorted(
+                    led.items(), key=lambda kv: -float(kv[1])
+                )
+            ]
+    return []
+
+
+_GOODPUT_TRAIN_COLS = ("bucket", "seconds", "share")
+
+
+def fleet_goodput_rows(data: FleetData) -> List[List[str]]:
+    """Per-replica serving goodput off each replica's LAST fleet-log
+    sample (the federated scheduler time ledger): goodput_frac =
+    device-COVERED seconds / non-idle wall, where covered = non-idle
+    wall minus host_gap_s (host time the device sat starved waiting for
+    its next dispatch — same definition bench_decode's overlap case
+    pins).  device_util = the same numerator over TOTAL wall including
+    idle."""
+    rows = []
+    for r in data.replicas():
+        last = data.last(r)
+        wall = float(last.get("sched_wall_s", 0) or 0)
+        if wall <= 0:
+            continue
+        dd = float(last.get("sched_device_decode_s", 0) or 0)
+        dp = float(last.get("sched_device_prefill_s", 0) or 0)
+        rb = float(last.get("sched_readback_s", 0) or 0)
+        idle = float(last.get("sched_idle_s", 0) or 0)
+        gap = float(last.get("sched_host_gap_s", 0) or 0)
+        busy = max(wall - idle, 1e-9)
+        covered = max(busy - gap, 0.0)
+        rows.append([
+            r, f"{covered / busy:.3f}", f"{covered / wall:.3f}",
+            f"{dd:.2f}", f"{dp:.2f}",
+            f"{float(last.get('sched_host_sched_s', 0) or 0):.2f}",
+            f"{rb:.2f}",
+            f"{float(last.get('sched_stream_flush_s', 0) or 0):.2f}",
+            f"{gap:.3f}", f"{idle:.2f}", f"{wall:.2f}",
+        ])
+    return rows
+
+
+_FLEET_GOODPUT_COLS = (
+    "replica", "goodput_frac", "device_util", "decode_s", "prefill_s",
+    "host_s", "readback_s", "stream_s", "gap_s", "idle_s", "wall_s",
+)
+
+
+def fleet_token_rows(data: FleetData) -> List[List[str]]:
+    """Per-replica token-ledger dispositions off the last sample, with
+    the closure remainder made explicit: admitted minus the terminal
+    dispositions is exactly the tokens still in live decode slots."""
+    rows = []
+    for r in data.replicas():
+        last = data.last(r)
+        adm = last.get("tok_admitted")
+        if adm is None:
+            continue
+        adm = int(adm)
+        dlv = int(last.get("tok_delivered", 0) or 0)
+        ev = int(last.get("tok_evicted_lost", 0) or 0)
+        pr = int(last.get("tok_preempt_refunded", 0) or 0)
+        sh = int(last.get("tok_shed_after_admit", 0) or 0)
+        rem = adm - (dlv + ev + pr + sh)
+        rows.append([
+            r, str(adm), str(dlv), str(ev), str(pr), str(sh),
+            "closed" if rem == 0 else f"{rem} in flight",
+        ])
+    return rows
+
+
+_FLEET_TOKEN_COLS = (
+    "replica", "admitted", "delivered", "evicted_lost",
+    "preempt_refunded", "shed_after_admit", "books",
+)
+
+
+def profile_rows(profile: Dict[str, Any]) -> List[List[str]]:
+    rows = []
+    for op in (profile.get("top_ops") or [])[:20]:
+        rows.append([
+            str(op.get("op", "?"))[:60],
+            str(op.get("category", "")),
+            str(int(op.get("occurrences", 0) or 0)),
+            f"{float(op.get('total_us', 0) or 0):.1f}",
+            f"{float(op.get('self_us', 0) or 0):.1f}",
+            f"{100.0 * float(op.get('self_frac', 0) or 0):.1f}%",
+        ])
+    return rows
+
+
+_PROFILE_COLS = ("op", "category", "#", "total us", "self us", "self %")
+
+
+def profile_caption(profile: Dict[str, Any]) -> str:
+    dev = float(profile.get("device_us", 0) or 0)
+    host = float(profile.get("host_us", 0) or 0)
+    tot = (dev + host) or 1.0
+    who = profile.get("replica_id") or (
+        f"{profile.get('captured', '?')}/{profile.get('requested', '?')} "
+        "replicas" if "captured" in profile else "?"
+    )
+    return (
+        f"{profile.get('seconds', '?')}s capture on {who}, "
+        f"source: {profile.get('source', 'fleet aggregate')}; "
+        f"device {dev / 1e6:.3f}s ({100 * dev / tot:.1f}%) / "
+        f"host {host / 1e6:.3f}s ({100 * host / tot:.1f}%)"
+    )
+
+
 _FLEET_CURVES = (
     ("ttft_p99_s", "TTFT p99 (s) per replica"),
     ("itl_p99_s", "ITL p99 (s) per replica"),
@@ -851,6 +1047,19 @@ def render_fleet_html(data: FleetData, title: str) -> str:
         )
     out.append("</table>")
 
+    gp = fleet_goodput_rows(data)
+    if gp:
+        out.append("<h2>Goodput breakdown</h2>")
+        out.append(_html_table(_FLEET_GOODPUT_COLS, gp))
+    toks = fleet_token_rows(data)
+    if toks:
+        out.append("<h2>Token ledger</h2>")
+        out.append(_html_table(_FLEET_TOKEN_COLS, toks))
+    if data.profile:
+        out.append("<h2>On-demand profile</h2>")
+        out.append(f"<p>{html.escape(profile_caption(data.profile))}</p>")
+        out.append(_html_table(_PROFILE_COLS, profile_rows(data.profile)))
+
     trs = tenant_rows(data)
     if trs:
         out.append("<h2>Tenants (front door)</h2>")
@@ -896,6 +1105,18 @@ def render_fleet_markdown(data: FleetData, title: str) -> str:
     lines += ["", "## Summary", "", "| key | value |", "|---|---|"]
     for k, v in fleet_summary(data):
         lines.append(f"| {k} | {v} |")
+    gp = fleet_goodput_rows(data)
+    if gp:
+        lines += ["", "## Goodput breakdown", ""]
+        lines += _md_table(_FLEET_GOODPUT_COLS, gp)
+    toks = fleet_token_rows(data)
+    if toks:
+        lines += ["", "## Token ledger", ""]
+        lines += _md_table(_FLEET_TOKEN_COLS, toks)
+    if data.profile:
+        lines += ["", "## On-demand profile", "",
+                  profile_caption(data.profile), ""]
+        lines += _md_table(_PROFILE_COLS, profile_rows(data.profile))
     trs = tenant_rows(data)
     if trs:
         lines += ["", "## Tenants (front door)", "",
@@ -949,6 +1170,14 @@ def render_markdown(data: RunData, title: str) -> str:
     lines += ["", "## Summary", "", "| key | value |", "|---|---|"]
     for k, v in summarize(data):
         lines.append(f"| {k} | {v} |")
+    gp = train_goodput_rows(data)
+    if gp:
+        lines += ["", "## Goodput ledger", ""]
+        lines += _md_table(_GOODPUT_TRAIN_COLS, gp)
+    if data.profile:
+        lines += ["", "## On-demand profile", "",
+                  profile_caption(data.profile), ""]
+        lines += _md_table(_PROFILE_COLS, profile_rows(data.profile))
     loss = data.series("loss")
     if loss:
         lines += ["", "## Loss", "", "| step | loss |", "|---|---|"]
@@ -982,6 +1211,10 @@ def main(argv=None) -> int:
     ap.add_argument("--flight", help="flight_recorder.jsonl dump")
     ap.add_argument("--trace", help="Chrome-trace JSON export")
     ap.add_argument("--run-dir", help="directory to scan for the conventional names")
+    ap.add_argument("--profile", nargs="?", const="auto", default=None,
+                    help="inline an on-demand profile capture's "
+                    "profile_summary.json (optional path; default scans "
+                    "--run-dir / $PFX_FLIGHT_DIR profiles/)")
     ap.add_argument("--fleet", nargs="?", const="auto", default=None,
                     help="render the FLEET report from the router's "
                     "fleet_metrics.jsonl instead of a training run "
@@ -1010,6 +1243,13 @@ def main(argv=None) -> int:
             return 2
         if args.title == "PaddleFleetX-TPU run report":
             args.title = "PaddleFleetX-TPU fleet report"
+        ppath = find_profile_summary(args)
+        if ppath:
+            try:
+                data.add_profile(ppath)
+            except (OSError, ValueError) as e:
+                data.notes.append(
+                    f"could not read profile summary {ppath}: {e!r}")
         doc = (render_fleet_markdown if fmt == "md"
                else render_fleet_html)(data, args.title)
         return _emit(doc, args, fmt, what=(
@@ -1018,6 +1258,12 @@ def main(argv=None) -> int:
         ))
 
     data = find_artifacts(args)
+    ppath = find_profile_summary(args)
+    if ppath:
+        try:
+            data.add_profile(ppath)
+        except (OSError, ValueError) as e:
+            data.notes.append(f"could not read profile summary {ppath}: {e!r}")
     if not data.sources:
         print("report.py: no readable artifact (give --metrics/--flight/"
               "--trace or --run-dir)", file=sys.stderr)

@@ -111,11 +111,13 @@ def serve_router(args) -> int:
         RouterCore,
         TenantQuotaExceeded,
         _DownstreamError,
+        _http_request,
         admin_headers,
         check_admin,
         read_fleet_journal,
         replay_fleet_state,
     )
+    from paddlefleetx_tpu.utils.log import log_server_error
     from paddlefleetx_tpu.core.tenancy import (
         PRIORITY_HEADER,
         TENANT_HEADER,
@@ -251,6 +253,11 @@ def serve_router(args) -> int:
     flags = {"draining": False}
     default_deadline = float(args.deadline)
     max_deadline = float(args.max_deadline)
+    # one fleet profile capture at a time: each replica already refuses
+    # its own overlaps (409), but the router-level guard keeps a second
+    # operator from profiling a DIFFERENT slice of the fleet while the
+    # first capture is still distorting it
+    profile_lock = threading.Lock()
 
     class Handler(BaseHTTPRequestHandler):
         timeout = 120
@@ -259,6 +266,22 @@ def serve_router(args) -> int:
             pass
 
         def _send(self, code, body, ctype, headers=None):
+            if code >= 500:
+                # one structured line per 5xx (utils/log.log_server_error)
+                # joinable against the trace timeline by trace_id
+                outcome = None
+                if ctype == "application/json":
+                    try:
+                        outcome = json.loads(body.decode()).get("error")
+                    except (ValueError, UnicodeDecodeError):
+                        pass
+                log_server_error(
+                    "router", code, self.path,
+                    replica_id=identity["replica_id"],
+                    tenant=self.headers.get(TENANT_HEADER),
+                    trace_id=(headers or {}).get("X-Trace-Id"),
+                    outcome=outcome,
+                )
             try:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
@@ -325,8 +348,29 @@ def serve_router(args) -> int:
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
             if self.path == "/replicas":
+                # per-tenant occupancy ledgers ride the federation scrape
+                # (pfx_tenant_*_seconds_total, labels already folded
+                # through the replica's top-k cap) — billing-grade cost
+                # attribution per replica without a second poll
+                views = core.replica_views()
+                for v in views:
+                    occ = {}
+                    for fam, field in (
+                        ("pfx_tenant_slot_seconds_total", "slot_s"),
+                        ("pfx_tenant_kv_block_seconds_total",
+                         "kv_block_s"),
+                    ):
+                        for lab, val in core.federation.samples(
+                            v["key"], fam
+                        ):
+                            ten = lab.get("tenant", "?")
+                            occ.setdefault(
+                                ten, {"slot_s": 0.0, "kv_block_s": 0.0}
+                            )[field] = val
+                    if occ:
+                        v["tenant_occupancy"] = occ
                 return self._json(200, {
-                    "replicas": core.replica_views(),
+                    "replicas": views,
                     "tenants": core.tenant_snapshot(),
                 })
             if self.path.startswith("/debug/"):
@@ -373,6 +417,8 @@ def serve_router(args) -> int:
                 return self._admin_drain()
             if parts.path == "/admin/register":
                 return self._admin_register()
+            if parts.path == "/admin/profile":
+                return self._admin_profile()
             if parts.path != "/generate":
                 return self._json(404, {"error": "unknown path"})
             return self._generate(parts)
@@ -396,6 +442,132 @@ def serve_router(args) -> int:
             except ValueError as e:
                 return self._json(409, {"error": str(e)})
             return self._json(200, out)
+
+        def _admin_profile(self):
+            """POST /admin/profile — fan an on-demand jax.profiler
+            capture out to selected live replicas (optional body
+            filters: {"pool": "decode", "replica": "<key|id>"}) and
+            aggregate ONE fleet summary: per-replica outcomes plus a
+            merged top-op table (docs/observability.md "On-demand
+            profiling").  Each replica enforces its own single-capture
+            guard and duration cap; the router adds the fleet-level
+            overlap guard (409) so two operators cannot profile
+            different slices concurrently."""
+            if not self._authorized("/admin"):
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(n) or b"{}")
+            except json.JSONDecodeError as e:
+                return self._json(400, {"error": f"bad JSON: {e}"})
+            seconds = req.get("seconds", 3.0)
+            try:
+                seconds = float(seconds)
+            except (TypeError, ValueError):
+                return self._json(
+                    400, {"error": f"seconds must be a number, got "
+                                   f"{seconds!r}"})
+            top = int(req.get("top", 20))
+            pool = req.get("pool")
+            want = req.get("replica")
+            targets = [
+                v for v in core.replica_views()
+                if v["url"] and v["healthy"]
+                and v["state"] in ("serving", "draining")
+                and (pool is None or v["role"] == pool)
+                and (want is None or want in (v["key"], v["replica_id"]))
+            ]
+            if not targets:
+                return self._json(404, {
+                    "error": "no matching live replica to profile "
+                             f"(pool={pool!r}, replica={want!r})"
+                })
+            if not profile_lock.acquire(blocking=False):
+                return self._json(409, {
+                    "error": "a fleet profile capture is already "
+                             "active; retry after it finishes"
+                })
+            try:
+                results = {}
+
+                def _one(v):
+                    payload = json.dumps(
+                        {"seconds": seconds, "top": top}
+                    ).encode()
+                    try:
+                        code, data, _, _ = _http_request(
+                            v["url"], "POST", "/admin/profile",
+                            body=payload,
+                            headers={"Content-Type": "application/json",
+                                     **admin_headers()},
+                            # the replica sleeps `seconds` then parses
+                            # the trace in pure Python while its decode
+                            # threads keep the GIL busy — on a loaded
+                            # host the parse, not the capture, is the
+                            # long pole, so the headroom is generous
+                            timeout=seconds + 180.0,
+                        )
+                        try:
+                            out = json.loads(data.decode())
+                        except ValueError:
+                            out = {"error": data[:200].decode("replace")}
+                        results[v["key"]] = {"status": code, **out}
+                    except Exception as e:  # noqa: BLE001 — per-replica
+                        results[v["key"]] = {"status": 0, "error": str(e)}
+
+                threads = [
+                    threading.Thread(target=_one, args=(v,), daemon=True)
+                    for v in targets
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(seconds + 210.0)
+                # merge the per-replica op tables into one fleet view:
+                # same op name -> summed occurrences/durations
+                merged = {}
+                device_us = host_us = 0.0
+                captured = 0
+                for r in results.values():
+                    if r.get("status") != 200:
+                        continue
+                    captured += 1
+                    device_us += float(r.get("device_us", 0.0))
+                    host_us += float(r.get("host_us", 0.0))
+                    for op in r.get("top_ops", []):
+                        m = merged.setdefault(op["op"], {
+                            "op": op["op"],
+                            "category": op.get("category", "?"),
+                            "occurrences": 0,
+                            "total_us": 0.0, "self_us": 0.0,
+                        })
+                        m["occurrences"] += int(op.get("occurrences", 0))
+                        m["total_us"] += float(op.get("total_us", 0.0))
+                        m["self_us"] += float(op.get("self_us", 0.0))
+                top_ops = sorted(
+                    merged.values(), key=lambda r: -r["self_us"]
+                )[:top]
+                total_self = sum(r["self_us"] for r in merged.values()) or 1.0
+                for op in top_ops:
+                    op["self_frac"] = round(op["self_us"] / total_self, 4)
+                body = {
+                    "requested": len(targets),
+                    "captured": captured,
+                    "seconds": seconds,
+                    "device_us": round(device_us, 1),
+                    "host_us": round(host_us, 1),
+                    "top_ops": top_ops,
+                    "replicas": results,
+                }
+                recorder.record({
+                    "event": "fleet_profile_capture",
+                    "requested": len(targets), "captured": captured,
+                    "seconds": seconds,
+                })
+                # every replica failing is a gateway failure, honestly
+                return self._json(200 if captured else 502, body)
+            finally:
+                profile_lock.release()
 
         def _admin_register(self):
             # replica self-registration heartbeat (tools/serve.py

@@ -318,8 +318,11 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
         normalize_tenant,
         parse_priority,
     )
+    from paddlefleetx_tpu.utils.log import log_server_error
     from paddlefleetx_tpu.utils.telemetry import (
         SLOTracker,
+        atomic_artifact_write,
+        flight_dir,
         get_flight_recorder,
         get_registry,
     )
@@ -588,6 +591,24 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
             pass
 
         def _send(self, code: int, body: bytes, ctype: str, headers=None):
+            if code >= 500:
+                # one structured line per 5xx (utils/log.log_server_error):
+                # greppable key=value carrying whatever the handler knew —
+                # trace_id when the request was sampled (it rides the
+                # response headers), tenant, and the error body as outcome
+                outcome = None
+                if ctype == "application/json":
+                    try:
+                        outcome = json.loads(body.decode()).get("error")
+                    except (ValueError, UnicodeDecodeError):
+                        pass
+                log_server_error(
+                    "serve", code, self.path,
+                    replica_id=identity["replica_id"],
+                    tenant=self.headers.get(TENANT_HEADER),
+                    trace_id=(headers or {}).get("X-Trace-Id"),
+                    outcome=outcome,
+                )
             # disconnect-tolerant: a client that hung up while we write
             # (including on an error path) is counted as client_gone —
             # never a stack trace, never a skewed http_* counter
@@ -934,7 +955,59 @@ def serve_http(server, port: int, host: str = "127.0.0.1", *,
                 return
             if parts.path == "/admin/adopt_prefixes":
                 return self._adopt_prefixes()
+            if parts.path == "/admin/profile":
+                return self._profile()
             return self._json(404, {"error": "unknown admin path"})
+
+        def _profile(self):
+            """POST /admin/profile {"seconds": T} — capture a
+            jax.profiler trace of THIS live serving process and answer
+            with the parsed summary (docs/observability.md "On-demand
+            profiling").  Safety rails live in
+            utils/profiler.capture_profile: one capture at a time
+            (ProfileBusy -> 409) and the PFX_PROFILE_MAX_SECONDS hard
+            cap (-> 400).  The capture observes the running scheduler —
+            it drives nothing, so profiling a production replica under
+            load is bounded and safe."""
+            from paddlefleetx_tpu.utils.profiler import (
+                ProfileBusy,
+                capture_profile,
+            )
+
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(n) or b"{}")
+            except json.JSONDecodeError:
+                return self._json(400, {"error": "body must be JSON"})
+            seconds = req.get("seconds", 3.0)
+            top = int(req.get("top", 20))
+            # one dir per capture under the flight dir: the trace is a
+            # postmortem artifact and lands next to the crash ring
+            prof_dir = os.path.join(
+                flight_dir(), "profiles",
+                time.strftime("%Y%m%d-%H%M%S"),
+            )
+            try:
+                summary = capture_profile(seconds, prof_dir, top=top)
+            except ProfileBusy as e:
+                print(f"[serve] /admin/profile refused: {e}", flush=True)
+                return self._json(409, {"error": str(e)})
+            except ValueError as e:
+                return self._json(400, {"error": str(e)})
+            summary["replica_id"] = identity["replica_id"]
+            # durable copy next to the trace itself, torn-write-proof,
+            # so a fleet report can inline the op table later
+            atomic_artifact_write(
+                os.path.join(prof_dir, "profile_summary.json"),
+                lambda f: json.dump(summary, f, indent=1),
+            )
+            recorder.record({
+                "event": "profile_capture",
+                "seconds": summary["seconds"],
+                "trace_dir": prof_dir,
+                "source": summary["source"],
+            })
+            return self._json(200, summary)
 
         def _adopt_prefixes(self):
             """POST /admin/adopt_prefixes — the migration-receiver half
